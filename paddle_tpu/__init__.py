@@ -1,0 +1,13 @@
+"""paddle_tpu — a TPU-native framework with PaddlePaddle Fluid v1.8's
+capabilities, re-architected on JAX/XLA/Pallas (see SURVEY.md).
+
+``paddle_tpu.fluid`` mirrors the reference's ``paddle.fluid`` user API:
+static-graph programs, Executor with a TPU Place, layers, optimizers,
+Fleet-style distributed strategies.
+"""
+
+from . import ops            # registers all JAX op impls
+from . import fluid          # noqa: F401
+from .framework.core import TPUPlace, CPUPlace, CUDAPlace  # noqa: F401
+
+__version__ = "0.1.0"

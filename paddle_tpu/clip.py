@@ -1,0 +1,127 @@
+"""Gradient clipping (ref: python/paddle/fluid/clip.py —
+GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm)."""
+
+from __future__ import annotations
+
+from .framework import unique_name
+from .framework.core import default_main_program
+
+
+class GradientClipBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class GradientClipByValue(GradientClipBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        block = default_main_program().global_block()
+        out = []
+        for p, g in params_grads:
+            if not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            c = block.create_var(name=unique_name.generate("clip"),
+                                 shape=g.shape, dtype=g.dtype)
+            block.append_op(type="clip", inputs={"X": [g]},
+                            outputs={"Out": [c]},
+                            attrs={"min": self.min, "max": self.max})
+            out.append((p, c))
+        return out
+
+
+class GradientClipByNorm(GradientClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        block = default_main_program().global_block()
+        out = []
+        for p, g in params_grads:
+            if not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            c = block.create_var(name=unique_name.generate("clip_norm"),
+                                 shape=g.shape, dtype=g.dtype)
+            block.append_op(type="clip_by_norm", inputs={"X": [g]},
+                            outputs={"Out": [c]},
+                            attrs={"max_norm": self.clip_norm})
+            out.append((p, c))
+        return out
+
+
+class GradientClipByGlobalNorm(GradientClipBase):
+    """ref: clip.py GradientClipByGlobalNorm — scale = clip/max(clip, gnorm)
+    computed over ALL grads jointly."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        block = default_main_program().global_block()
+        sq_vars = []
+        for p, g in params_grads:
+            if not getattr(p, "need_clip", True):
+                continue
+            s = block.create_var(name=unique_name.generate("sq_l2"),
+                                 shape=(1,), dtype=g.dtype)
+            block.append_op(type="squared_l2_norm", inputs={"X": [g]},
+                            outputs={"Out": [s]})
+            sq_vars.append(s)
+        if not sq_vars:
+            return params_grads
+        total = block.create_var(name=unique_name.generate("global_norm_sq"),
+                                 shape=(1,), dtype=sq_vars[0].dtype)
+        block.append_op(type="sum", inputs={"X": sq_vars},
+                        outputs={"Out": [total]})
+        gnorm = block.create_var(name=unique_name.generate("global_norm"),
+                                 shape=(1,), dtype=total.dtype)
+        block.append_op(type="sqrt", inputs={"X": [total]},
+                        outputs={"Out": [gnorm]})
+        # denom = max(gnorm, clip); scale = clip / denom
+        clip_v = block.create_var(name=unique_name.generate("clip_const"),
+                                  shape=(1,), dtype=gnorm.dtype)
+        block.append_op(type="fill_constant", outputs={"Out": [clip_v]},
+                        attrs={"shape": [1], "dtype": gnorm.dtype,
+                               "value": self.clip_norm})
+        denom = block.create_var(name=unique_name.generate("clip_denom"),
+                                 shape=(1,), dtype=gnorm.dtype)
+        block.append_op(type="elementwise_max",
+                        inputs={"X": [gnorm], "Y": [clip_v]},
+                        outputs={"Out": [denom]}, attrs={"axis": -1})
+        scale = block.create_var(name=unique_name.generate("clip_scale"),
+                                 shape=(1,), dtype=gnorm.dtype)
+        block.append_op(type="elementwise_div",
+                        inputs={"X": [clip_v], "Y": [denom]},
+                        outputs={"Out": [scale]}, attrs={"axis": -1})
+        out = []
+        for p, g in params_grads:
+            if not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            c = block.create_var(name=unique_name.generate("clipped_grad"),
+                                 shape=g.shape, dtype=g.dtype)
+            block.append_op(type="elementwise_mul",
+                            inputs={"X": [g], "Y": [scale]},
+                            outputs={"Out": [c]}, attrs={"axis": -1})
+            out.append((p, c))
+        return out
+
+
+# legacy program-level clip (ref: clip.py set_gradient_clip) — stored and
+# picked up by Optimizer.apply_gradients when no grad_clip= was passed
+_global_gradient_clip = None
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    global _global_gradient_clip
+    if clip is not None and not isinstance(clip, GradientClipBase):
+        raise TypeError("set_gradient_clip expects a GradientClip* instance")
+    _global_gradient_clip = clip
+
+
+def get_gradient_clip():
+    return _global_gradient_clip

@@ -1,0 +1,42 @@
+"""fluid-compatible namespace (ref: python/paddle/fluid/__init__.py).
+
+A reference user's ``import paddle.fluid as fluid`` maps to
+``import paddle_tpu.fluid as fluid``; the training-script surface
+(Program/Executor/layers/optimizer/initializer/ParamAttr/places) is the
+same, with ``TPUPlace`` as the first-class device."""
+
+from ..framework.core import (Program, Variable, Parameter,  # noqa: F401
+                              default_main_program, default_startup_program,
+                              program_guard, CPUPlace, TPUPlace, CUDAPlace,
+                              is_compiled_with_tpu)
+from ..framework.executor import (Executor, Scope, global_scope,  # noqa: F401
+                                  scope_guard)
+from ..framework.backward import append_backward, gradients  # noqa: F401
+from ..framework.compiler import (CompiledProgram, BuildStrategy,  # noqa: F401
+                                  ExecutionStrategy)
+from ..framework.layer_helper import ParamAttr  # noqa: F401
+from ..framework import initializer  # noqa: F401
+from ..framework import unique_name  # noqa: F401
+from .. import layers        # noqa: F401
+from .. import optimizer     # noqa: F401
+from .. import regularizer   # noqa: F401
+from .. import clip          # noqa: F401
+from ..framework import core  # noqa: F401
+
+name_scope = unique_name.name_scope
+
+
+def cuda_places(device_ids=None):
+    """Script-compat: accelerator places (TPU chips here)."""
+    import jax
+    n = len(jax.devices())
+    ids = device_ids if device_ids is not None else range(n)
+    return [TPUPlace(i) for i in ids]
+
+
+def tpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def cpu_places(device_count=1):
+    return [CPUPlace() for _ in range(device_count)]

@@ -1,0 +1,12 @@
+from .core import (Program, Block, Variable, Parameter, Operator,
+                   default_main_program, default_startup_program,
+                   program_guard, switch_main_program,
+                   switch_startup_program, reset_default_programs,
+                   CPUPlace, TPUPlace, CUDAPlace, grad_var_name,
+                   convert_dtype, is_compiled_with_tpu)
+from .executor import Executor, Scope, global_scope, scope_guard
+from .backward import append_backward, gradients
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy, make_mesh
+from .layer_helper import LayerHelper, ParamAttr
+from . import initializer
+from . import unique_name

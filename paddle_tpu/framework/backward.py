@@ -1,0 +1,93 @@
+"""Autodiff over the Program IR (ref: python/paddle/fluid/backward.py:1215
+``append_backward``).
+
+The reference walks ops in reverse and asks each op's C++ GradOpMaker to emit
+grad-op descs.  TPU-natively the whole forward block is differentiated at
+lowering time with ``jax.value_and_grad`` (see executor.lower_block_with_backward),
+so ``append_backward`` only has to (a) declare the grad *variables* in the
+block — keeping the user-visible contract that ``param@GRAD`` vars exist and
+can be fetched/consumed by optimizer ops — and (b) insert one ``backward``
+meta-op recording loss, parameters and recompute checkpoints.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from .core import (Parameter, Variable, grad_var_name,
+                   default_main_program)
+
+
+def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
+                    checkpoints=None,
+                    callbacks=None) -> List[Tuple[Variable, Variable]]:
+    """Declare grads of ``loss`` w.r.t. trainable parameters.
+
+    Returns (param, grad) pairs exactly like the reference
+    (backward.py:1215); grad values materialise at executor lowering.
+    """
+    block = loss.block
+    program = block.program
+
+    if parameter_list is not None:
+        params = []
+        for p in parameter_list:
+            if isinstance(p, str):
+                params.append(block.var(p))
+            else:
+                params.append(p)
+    else:
+        params = [p for p in program.all_parameters() if p.trainable]
+
+    no_grad = {v.name if isinstance(v, Variable) else str(v)
+               for v in (no_grad_set or ())}
+    params = [p for p in params if p.name not in no_grad]
+
+    grad_vars = []
+    for p in params:
+        g = block.create_var(name=grad_var_name(p.name), shape=p.shape,
+                             dtype=p.dtype, stop_gradient=True)
+        grad_vars.append(g)
+    loss_grad = block.create_var(name=grad_var_name(loss.name),
+                                 shape=loss.shape, dtype=loss.dtype)
+
+    ckpt_names = None
+    if checkpoints:
+        ckpt_names = [c.name if isinstance(c, Variable) else str(c)
+                      for c in checkpoints]
+
+    block.append_op(
+        type="backward",
+        inputs={"Loss": [loss]},
+        outputs={"Grads": grad_vars, "LossGrad": [loss_grad]},
+        attrs={"loss_name": loss.name,
+               "param_names": [p.name for p in params],
+               "checkpoints": ckpt_names,
+               "loss_scale": 1.0})
+    return list(zip(params, grad_vars))
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Grads of ``targets`` w.r.t. arbitrary ``inputs``
+    (ref: backward.py:1795 ``gradients``)."""
+    if isinstance(targets, Variable):
+        targets = [targets]
+    if isinstance(inputs, Variable):
+        inputs = [inputs]
+    assert len(targets) == 1, "multi-target gradients: sum targets first"
+    loss = targets[0]
+    block = loss.block
+    grad_vars = []
+    for v in inputs:
+        g = block.create_var(name=grad_var_name(v.name), shape=v.shape,
+                             dtype=v.dtype, stop_gradient=True)
+        grad_vars.append(g)
+    block.append_op(
+        type="backward",
+        inputs={"Loss": [loss]},
+        outputs={"Grads": grad_vars},
+        attrs={"loss_name": loss.name,
+               "param_names": [v.name for v in inputs],
+               "checkpoints": None,
+               "loss_scale": 1.0})
+    return grad_vars

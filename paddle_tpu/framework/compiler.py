@@ -1,0 +1,132 @@
+"""CompiledProgram — multi-device compilation wrapper
+(ref: python/paddle/fluid/compiler.py:87 CompiledProgram,
+:160 with_data_parallel).
+
+The reference's ``with_data_parallel`` builds a C++ ParallelExecutor that
+clones the SSA graph per GPU and inserts NCCL allreduce op-handles
+(ref: ir/multi_devices_graph_pass/multi_devices_graph_pass.cc:464).  Here the
+equivalent is declarative: record a ``jax.sharding.Mesh`` + the batch axis,
+insert the same ``scale`` + ``c_allreduce_sum`` grad ops the reference's
+collective transpiler inserts (ref: transpiler/collective.py:178 GradAllReduce),
+and let the executor lower the whole step under shard_map so those ops become
+XLA AllReduce over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .core import Program, grad_var_name
+
+
+def make_mesh(num_devices: Optional[int] = None, axis_name: str = "dp",
+              devices=None):
+    import jax
+    from jax.sharding import Mesh
+    devs = devices if devices is not None else jax.devices()
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+class BuildStrategy:
+    """Kept for API parity (ref: details/build_strategy.h).  Most knobs are
+    XLA's job now; the meaningful ones are recorded and applied at lowering."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.fuse_all_reduce_ops = True      # XLA fuses collectives itself
+        self.fuse_elewise_add_act_ops = True  # XLA general fusion
+        self.enable_inplace = True            # buffer donation
+        self.memory_optimize = True
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    """ref: details/execution_strategy.h — scheduling knobs, now XLA-owned."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 1
+        self.use_experimental_executor = False
+
+
+class CompiledProgram:
+    def __init__(self, program: Program):
+        self._program = program
+        self._mesh = None
+        self._axis_names = ()
+        self._batch_axis = None
+        self._loss_name = None
+
+    def with_data_parallel(self, loss_name: Optional[str] = None,
+                           build_strategy: Optional[BuildStrategy] = None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None, mesh=None, axis_name: str = "dp"):
+        import jax
+        if mesh is None:
+            devices = None
+            if places:
+                from .core import _jax_device_for
+                devices = [_jax_device_for(p) for p in places]
+            mesh = make_mesh(axis_name=axis_name, devices=devices)
+        self._mesh = mesh
+        self._axis_names = tuple(mesh.axis_names)
+        self._batch_axis = axis_name if axis_name in mesh.axis_names \
+            else mesh.axis_names[0]
+        self._loss_name = loss_name
+        nranks = mesh.devices.size
+
+        strategy = build_strategy or BuildStrategy()
+        if nranks > 1 and loss_name is not None:
+            self._insert_grad_allreduce(strategy, nranks)
+        return self
+
+    def _insert_grad_allreduce(self, strategy, nranks):
+        """Insert scale + c_allreduce_sum after the backward op for every
+        param grad — the exact rewrite of the reference's GradAllReduce
+        transpiler (transpiler/collective.py:190-226) minus the stream-sync
+        ops XLA makes unnecessary."""
+        block = self._program.global_block()
+        bw_idx = next((i for i, op in enumerate(block.ops)
+                       if op.type == "backward"), None)
+        if bw_idx is None:
+            return
+        bw = block.ops[bw_idx]
+        if bw.attrs.get("_allreduce_inserted"):
+            return
+        bw.attrs["_allreduce_inserted"] = True
+        scale_strategy = strategy.gradient_scale_strategy
+        insert_at = bw_idx + 1
+        for pname in bw.attrs["param_names"]:
+            pvar = block._find_var_recursive(pname)
+            if pvar is not None and getattr(pvar, "is_distributed", False):
+                continue  # ref: collective.py:226 skips distributed params
+            g = grad_var_name(pname)
+            if scale_strategy == BuildStrategy.GradientScaleStrategy.CoeffNumDevice:
+                block._insert_op(insert_at, type="scale",
+                                 inputs={"X": [g]}, outputs={"Out": [g]},
+                                 attrs={"scale": 1.0 / nranks})
+                insert_at += 1
+            block._insert_op(insert_at, type="c_allreduce_sum",
+                             inputs={"X": [g]}, outputs={"Out": [g]},
+                             attrs={"ring_id": 0})
+            insert_at += 1
+
+    # pass-through conveniences so CompiledProgram quacks like Program
+    def __getattr__(self, item):
+        return getattr(self._program, item)

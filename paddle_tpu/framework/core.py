@@ -1,0 +1,506 @@
+"""Program IR for the TPU-native framework.
+
+The reference (PaddlePaddle Fluid v1.8) describes computation as a
+``ProgramDesc{BlockDesc{VarDesc, OpDesc}}`` protobuf built from Python and
+interpreted op-by-op by a C++ executor (ref: framework/framework.proto:211,
+python/paddle/fluid/framework.py:3857).  This rebuild keeps the *contract* —
+a serializable, Python-built static program with named variables and ops —
+but the execution model is trace → XLA-compile → execute: an entire block
+lowers to ONE jitted JAX function instead of an op-by-op interpreter loop
+(see executor.py).  Ops therefore carry no kernels here; they are symbolic
+nodes resolved against the JAX op registry (paddle_tpu/ops/registry.py) at
+lowering time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import copy
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import unique_name
+
+# ---------------------------------------------------------------------------
+# dtype handling
+# ---------------------------------------------------------------------------
+
+_DTYPE_ALIASES = {
+    "float32": "float32", "fp32": "float32", np.float32: "float32",
+    "float64": "float64", "fp64": "float64", np.float64: "float64",
+    "float16": "float16", "fp16": "float16", np.float16: "float16",
+    "bfloat16": "bfloat16", "bf16": "bfloat16",
+    "int8": "int8", np.int8: "int8",
+    "uint8": "uint8", np.uint8: "uint8",
+    "int16": "int16", np.int16: "int16",
+    "int32": "int32", np.int32: "int32",
+    "int64": "int64", np.int64: "int64",
+    "bool": "bool", np.bool_: "bool", bool: "bool",
+    float: "float32", int: "int64",
+}
+
+
+def convert_dtype(dtype) -> str:
+    """Normalise any dtype spelling to a canonical string."""
+    if isinstance(dtype, str) and dtype in _DTYPE_ALIASES:
+        return _DTYPE_ALIASES[dtype]
+    if dtype in _DTYPE_ALIASES:
+        return _DTYPE_ALIASES[dtype]
+    try:
+        return np.dtype(dtype).name
+    except TypeError:
+        pass
+    # jax dtypes (e.g. jnp.bfloat16) expose a name
+    name = getattr(dtype, "name", None) or getattr(dtype, "__name__", None)
+    if name in ("bfloat16", "float32", "float64", "float16", "int8", "uint8",
+                "int16", "int32", "int64", "bool"):
+        return name
+    raise ValueError(f"unsupported dtype: {dtype!r}")
+
+
+# ---------------------------------------------------------------------------
+# Variable / Parameter
+# ---------------------------------------------------------------------------
+
+
+class Variable:
+    """A named tensor slot in a Block (ref: fluid framework.py:834).
+
+    Unlike the reference there is no LoD machinery on device — ragged
+    sequences are handled on the host by bucketing/padding (SURVEY §5
+    "long-context").  ``shape`` may contain -1 (unknown/batch dims); concrete
+    shapes are bound at executor lowering time from the feeds.
+    """
+
+    def __init__(self, block: "Block", name: str, shape: Sequence[int] = (),
+                 dtype="float32", persistable: bool = False,
+                 stop_gradient: bool = True, trainable: bool = False,
+                 is_data: bool = False, initializer=None):
+        self.block = block
+        self.name = name
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = convert_dtype(dtype)
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.trainable = trainable
+        self.is_data = is_data
+        self.initializer = initializer
+        # Optional jax.sharding.PartitionSpec-like annotation used by the
+        # distributed lowering (parallel/); None means replicated/auto.
+        self.sharding = None
+
+    # -- python sugar mirroring the reference's Variable operators --------
+    def _elementwise(self, other, op):
+        from ..layers import math_ops
+        return math_ops._binary(op, self, other)
+
+    def __add__(self, other):
+        return self._elementwise(other, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._elementwise(other, "elementwise_sub")
+
+    def __rsub__(self, other):
+        from ..layers import math_ops
+        return math_ops._binary("elementwise_sub", other, self)
+
+    def __mul__(self, other):
+        return self._elementwise(other, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._elementwise(other, "elementwise_div")
+
+    def __matmul__(self, other):
+        from ..layers import math_ops
+        return math_ops.matmul(self, other)
+
+    def __neg__(self):
+        from ..layers import math_ops
+        return math_ops.scale(self, scale=-1.0)
+
+    @property
+    def grad_name(self) -> str:
+        return grad_var_name(self.name)
+
+    def astype(self, dtype):
+        from ..layers import tensor_ops
+        return tensor_ops.cast(self, dtype)
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={self.shape}, "
+                f"dtype={self.dtype}, persistable={self.persistable})")
+
+    __str__ = __repr__
+
+
+class Parameter(Variable):
+    """A trainable persistable Variable (ref: framework.py:5100)."""
+
+    def __init__(self, block, name, shape, dtype="float32", initializer=None,
+                 regularizer=None, need_clip=True, trainable=True,
+                 is_distributed=False):
+        super().__init__(block, name, shape, dtype, persistable=True,
+                         stop_gradient=not trainable, trainable=trainable,
+                         initializer=initializer)
+        self.regularizer = regularizer
+        self.need_clip = need_clip
+        self.is_distributed = is_distributed
+        self.optimize_attrs = {"learning_rate": 1.0}
+
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+# ---------------------------------------------------------------------------
+# Operator
+# ---------------------------------------------------------------------------
+
+
+class Operator:
+    """Symbolic op node (ref: framework.py:1821 / framework.proto:42 OpDesc).
+
+    ``inputs``/``outputs`` map slot names → lists of variable *names* (same
+    slot convention as the reference: "X", "Y", "Out", ...).  The callable
+    semantics live in the JAX op registry keyed by ``type``.
+    """
+
+    def __init__(self, block: "Block", type: str,
+                 inputs: Optional[Dict[str, Any]] = None,
+                 outputs: Optional[Dict[str, Any]] = None,
+                 attrs: Optional[Dict[str, Any]] = None):
+        self.block = block
+        self.type = type
+        self.inputs = {k: _to_name_list(v) for k, v in (inputs or {}).items()}
+        self.outputs = {k: _to_name_list(v) for k, v in (outputs or {}).items()}
+        self.attrs = dict(attrs or {})
+
+    def input(self, slot):
+        return self.inputs.get(slot, [])
+
+    def output(self, slot):
+        return self.outputs.get(slot, [])
+
+    def input_names(self):
+        return [n for ns in self.inputs.values() for n in ns]
+
+    def output_names(self):
+        return [n for ns in self.outputs.values() for n in ns]
+
+    def __repr__(self):
+        ins = {k: v for k, v in self.inputs.items()}
+        outs = {k: v for k, v in self.outputs.items()}
+        return f"Op({self.type}, in={ins}, out={outs})"
+
+
+def _to_name_list(v) -> List[str]:
+    if v is None:
+        return []
+    if isinstance(v, (Variable, str)):
+        v = [v]
+    return [x.name if isinstance(x, Variable) else str(x) for x in v]
+
+
+# ---------------------------------------------------------------------------
+# Block / Program
+# ---------------------------------------------------------------------------
+
+
+class Block:
+    """Ordered op list + var scope (ref: framework.py:2395, BlockDesc)."""
+
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[Operator] = []
+
+    @property
+    def parent_block(self):
+        if self.parent_idx < 0:
+            return None
+        return self.program.blocks[self.parent_idx]
+
+    def var(self, name: str) -> Variable:
+        v = self._find_var_recursive(name)
+        if v is None:
+            raise ValueError(f"variable {name!r} not found in block {self.idx}")
+        return v
+
+    def has_var(self, name: str) -> bool:
+        return self._find_var_recursive(name) is not None
+
+    def _find_var_recursive(self, name: str) -> Optional[Variable]:
+        b: Optional[Block] = self
+        while b is not None:
+            if name in b.vars:
+                return b.vars[name]
+            b = b.parent_block
+        return None
+
+    def create_var(self, name=None, shape=(), dtype="float32",
+                   persistable=False, stop_gradient=True, is_data=False,
+                   initializer=None, **kw) -> Variable:
+        if name is None:
+            name = unique_name.generate("tmp")
+        if name in self.vars:
+            return self.vars[name]
+        v = Variable(self, name, shape, dtype, persistable=persistable,
+                     stop_gradient=stop_gradient, is_data=is_data,
+                     initializer=initializer)
+        self.vars[name] = v
+        self.program._bump_version()
+        return v
+
+    def create_parameter(self, name, shape, dtype="float32", initializer=None,
+                         regularizer=None, trainable=True, need_clip=True,
+                         is_distributed=False) -> Parameter:
+        if name in self.vars:
+            existing = self.vars[name]
+            assert isinstance(existing, Parameter)
+            return existing
+        p = Parameter(self, name, shape, dtype, initializer=initializer,
+                      regularizer=regularizer, trainable=trainable,
+                      need_clip=need_clip, is_distributed=is_distributed)
+        self.vars[name] = p
+        self.program._bump_version()
+        return p
+
+    def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.append(op)
+        self.program._bump_version()
+        return op
+
+    def _insert_op(self, index: int, type: str, inputs=None, outputs=None,
+                   attrs=None) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.insert(index, op)
+        self.program._bump_version()
+        return op
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    def __repr__(self):
+        return f"Block(idx={self.idx}, ops={len(self.ops)}, vars={len(self.vars)})"
+
+
+class Program:
+    """A whole training/inference program (ref: framework.py:3857).
+
+    Two implicit global programs exist at any time, exactly like the
+    reference: the *main* program (compute) and the *startup* program
+    (parameter initialisation) — see ``default_main_program()``.
+    """
+
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0)]
+        self.current_block_idx = 0
+        self.random_seed = 0
+        self._version = 0          # bumped on mutation; keys executor caches
+        self._is_test = False
+        # distributed annotations filled by parallel/ transforms
+        self._mesh = None
+        self._dist_attrs: Dict[str, Any] = {}
+
+    # -- structure -------------------------------------------------------
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[self.current_block_idx]
+
+    def _create_block(self, parent_idx=None) -> Block:
+        parent_idx = self.current_block_idx if parent_idx is None else parent_idx
+        b = Block(self, len(self.blocks), parent_idx)
+        self.blocks.append(b)
+        self.current_block_idx = b.idx
+        return b
+
+    def _rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+
+    def _bump_version(self):
+        self._version += 1
+
+    # -- queries ---------------------------------------------------------
+    def all_parameters(self) -> List[Parameter]:
+        out = []
+        for b in self.blocks:
+            out.extend(b.all_parameters())
+        return out
+
+    def list_vars(self):
+        for b in self.blocks:
+            yield from b.vars.values()
+
+    # -- cloning (ref: framework.py:4202 Program.clone) ------------------
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program.__new__(Program)
+        p.blocks = []
+        p.current_block_idx = self.current_block_idx
+        p.random_seed = self.random_seed
+        p._version = 0
+        p._is_test = for_test or self._is_test
+        p._mesh = self._mesh
+        p._dist_attrs = dict(self._dist_attrs)
+        for b in self.blocks:
+            nb = Block(p, b.idx, b.parent_idx)
+            for name, v in b.vars.items():
+                nv = copy.copy(v)
+                nv.block = nb
+                nb.vars[name] = nv
+            for op in b.ops:
+                nop = Operator(nb, op.type, dict(op.inputs), dict(op.outputs),
+                               copy.deepcopy(op.attrs))
+                nb.ops.append(nop)
+            p.blocks.append(nb)
+        if for_test:
+            p._set_test_mode()
+        return p
+
+    def _set_test_mode(self):
+        for b in self.blocks:
+            for op in b.ops:
+                if "is_test" in _TEST_MODE_OPS.get(op.type, ()):
+                    op.attrs["is_test"] = True
+        self._bump_version()
+
+    # -- pruning (ref: framework.py:4399 _prune) -------------------------
+    def _prune(self, targets: Sequence[Variable]) -> "Program":
+        """Return a clone keeping only ops needed to compute ``targets``."""
+        p = self.clone()
+        target_names = {t.name if isinstance(t, Variable) else str(t)
+                        for t in targets}
+        blk = p.global_block()
+        needed = set(target_names)
+        kept = []
+        for op in reversed(blk.ops):
+            if set(op.output_names()) & needed:
+                kept.append(op)
+                needed |= set(op.input_names())
+        blk.ops = list(reversed(kept))
+        p._bump_version()
+        return p
+
+    def __repr__(self):
+        return f"Program(blocks={len(self.blocks)}, version={self._version})"
+
+
+# ops whose behavior flips in eval mode
+_TEST_MODE_OPS = {
+    "dropout": ("is_test",),
+    "batch_norm": ("is_test",),
+}
+
+
+# ---------------------------------------------------------------------------
+# global program state (ref: framework.py default_main_program etc.)
+# ---------------------------------------------------------------------------
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def switch_main_program(p: Program) -> Program:
+    global _main_program
+    old, _main_program = _main_program, p
+    return old
+
+
+def switch_startup_program(p: Program) -> Program:
+    global _startup_program
+    old, _startup_program = _startup_program, p
+    return old
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    old_main = switch_main_program(main_program)
+    old_startup = None
+    if startup_program is not None:
+        old_startup = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(old_main)
+        if old_startup is not None:
+            switch_startup_program(old_startup)
+
+
+def reset_default_programs():
+    """Fresh global programs (used by tests)."""
+    global _main_program, _startup_program
+    _main_program = Program()
+    _startup_program = Program()
+    unique_name.reset()
+
+
+# ---------------------------------------------------------------------------
+# Places — TPU is first-class (ref: platform/place.h:79)
+# ---------------------------------------------------------------------------
+
+
+class Place:
+    _kind = "undefined"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and getattr(self, "device_id", 0) == \
+            getattr(other, "device_id", 0)
+
+    def __hash__(self):
+        return hash((self._kind, getattr(self, "device_id", 0)))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({getattr(self, 'device_id', '')})"
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+
+
+class TPUPlace(Place):
+    """First-class TPU device (the rebuild's analog of CUDAPlace)."""
+    _kind = "tpu"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+
+# CUDAPlace kept as an alias for script compatibility; maps to the
+# accelerator backend jax exposes (TPU here).
+CUDAPlace = TPUPlace
+
+
+def _jax_device_for(place: Place):
+    import jax
+    if isinstance(place, CPUPlace):
+        for d in jax.devices("cpu"):
+            return d
+        return jax.devices()[0]
+    devs = jax.devices()
+    idx = getattr(place, "device_id", 0)
+    return devs[idx % len(devs)]
+
+
+def is_compiled_with_tpu() -> bool:
+    import jax
+    return any(d.platform != "cpu" for d in jax.devices())

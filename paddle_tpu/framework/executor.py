@@ -1,0 +1,394 @@
+"""Executor: lowers a whole Program block to ONE jitted XLA function.
+
+The reference executes programs with a per-op interpreter hot loop
+(ref: framework/executor.cc:465-472) and a multi-device SSA-graph executor
+(ref: framework/details/fast_threaded_ssa_graph_executor.h:32).  On TPU the
+idiomatic equivalent is: trace every op symbolically over JAX values,
+``jax.jit`` the resulting function once per (program-version, feed-signature)
+— the cache plays the role of ``ExecutorPrepareContext`` caching
+(ref: executor.py:1084 _run_impl's ctx cache) — and let XLA fuse/schedule.
+
+Static-graph mutation semantics (persistable vars updated across ``run()``
+calls, ref: framework/scope.h:46) are preserved by an explicit VarStore: the
+Scope holds device arrays; each compiled step is a pure function
+``(feeds, state) -> (fetches, state')`` whose state buffers are donated, so
+parameter updates are in-place at the XLA level — the analog of the
+reference's inplace/memory-reuse passes (ref: framework/ir/memory_optimize_pass/).
+
+The ``backward`` meta-op (inserted by backward.append_backward) is lowered
+with ``jax.value_and_grad`` over the forward segment — replacing the
+reference's per-op GradOpMaker machinery (ref: framework/grad_op_desc_maker.h,
+python backward.py:1215) with XLA-native autodiff.  Recompute checkpoints
+map to ``jax.checkpoint`` over op segments (ref: backward.py:629).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core import (Program, Variable, Place, TPUPlace, CPUPlace,
+                   default_main_program, _jax_device_for, grad_var_name)
+from ..ops.registry import get_op, LoweringContext
+
+_RNG_VAR = "@RNG_STATE@"
+
+
+class Scope:
+    """Name → device-array store (ref: framework/scope.h:46)."""
+
+    def __init__(self):
+        self.vars: Dict[str, Any] = {}
+
+    def var_names(self):
+        return list(self.vars)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+    def set_var(self, name, value):
+        self.vars[name] = value
+
+    def drop_all(self):
+        self.vars.clear()
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _global_scope
+    old, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = old
+
+
+# ---------------------------------------------------------------------------
+# symbolic block interpretation
+# ---------------------------------------------------------------------------
+
+
+def _gather_inputs(op, env):
+    ins = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if n not in env:
+                raise KeyError(
+                    f"op {op.type!r} input {slot}={n!r} not computed/fed; "
+                    f"known vars: {sorted(list(env))[:20]}...")
+            vals.append(env[n])
+        ins[slot] = vals
+    return ins
+
+
+def _scatter_outputs(op, outs, env):
+    for slot, names in op.outputs.items():
+        if slot not in outs:
+            continue
+        vals = outs[slot]
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        for n, v in zip(names, vals):
+            env[n] = v
+
+
+def run_ops(ops, env, ctx):
+    """Interpret a straight-line op list symbolically (the trace loop — the
+    analog of the reference's hot loop at executor.cc:465, but traced once)."""
+    for op in ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        impl = get_op(op.type)
+        outs = impl(ctx, _gather_inputs(op, env), op.attrs)
+        _scatter_outputs(op, outs, env)
+    return env
+
+
+def _segment_at_checkpoints(ops, checkpoint_names):
+    """Split ops into segments ending right after each checkpoint var is
+    produced (for jax.checkpoint, ref: backward.py:629 recompute segments)."""
+    if not checkpoint_names:
+        return [list(ops)]
+    remaining = set(checkpoint_names)
+    segments, cur = [], []
+    for op in ops:
+        cur.append(op)
+        produced = set(op.output_names()) & remaining
+        if produced:
+            remaining -= produced
+            segments.append(cur)
+            cur = []
+    if cur:
+        segments.append(cur)
+    return segments
+
+
+def _live_names_after(segments, seg_idx, always_live):
+    live = set(always_live)
+    for seg in segments[seg_idx + 1:]:
+        for op in seg:
+            live |= set(op.input_names())
+    return live
+
+
+def lower_block_with_backward(ops, env, ctx, bw_idx, fetch_names,
+                              state_out_names):
+    """Lower [forward ops][backward meta-op][update ops] with value_and_grad."""
+    bw_op = ops[bw_idx]
+    fwd_ops = ops[:bw_idx]
+    tail_ops = ops[bw_idx + 1:]
+    param_names = list(bw_op.attrs["param_names"])
+    loss_name = bw_op.attrs["loss_name"]
+    checkpoints = bw_op.attrs.get("checkpoints") or []
+    loss_scale = bw_op.attrs.get("loss_scale", 1.0)
+
+    pvals = {n: env[n] for n in param_names}
+    base_env = {k: v for k, v in env.items() if k not in pvals}
+    always_live = set(fetch_names) | set(state_out_names) | {loss_name}
+
+    segments = _segment_at_checkpoints(fwd_ops, checkpoints)
+
+    def fwd(p, key):
+        e = dict(base_env)
+        e.update(p)
+        sub = LoweringContext(key, ctx.mesh, ctx.axis_names, ctx.is_test)
+        if len(segments) == 1:
+            e = run_ops(segments[0], e, sub)
+        else:
+            for i, seg in enumerate(segments):
+                live = _live_names_after(segments, i, always_live)
+                if i < len(segments) - 1:
+                    def seg_fn(e_in, k_in, _seg=seg, _live=live):
+                        c = LoweringContext(k_in, ctx.mesh, ctx.axis_names,
+                                            ctx.is_test)
+                        e_out = run_ops(_seg, dict(e_in), c)
+                        return ({k: v for k, v in e_out.items()
+                                 if k in _live or k in e_in}, c.key)
+                    e, new_key = jax.checkpoint(seg_fn)(e, sub.key)
+                    sub.key = new_key
+                else:
+                    e = run_ops(seg, e, sub)
+        loss = e[loss_name]
+        return jnp.sum(loss) * loss_scale, (e, sub.key)
+
+    (loss_val, (env2, new_key)), grads = jax.value_and_grad(
+        fwd, has_aux=True)(pvals, ctx.key)
+    ctx.key = new_key
+    env2.update(pvals)          # params themselves still visible downstream
+    for n in param_names:
+        env2[grad_var_name(n)] = grads[n]
+    env2[grad_var_name(loss_name)] = jnp.ones_like(env2[loss_name])
+    return run_ops(tail_ops, env2, ctx)
+
+
+def _merge_fetch(v, name, block, ctx, batch_axis):
+    """Cross-device fetch semantics under data parallelism — the analog of
+    the reference's FetchOpHandle merging per-device results
+    (ref: framework/details/fetch_op_handle.cc): batch-sharded tensors are
+    all-gathered back to the global batch; scalar float metrics (mean loss,
+    accuracy) are averaged; scalar int counters (Correct/Total) are summed;
+    persistable vars are replicated already."""
+    if not ctx.axis_names or batch_axis is None:
+        return v
+    var = block._find_var_recursive(name)
+    if var is not None and var.persistable:
+        return v
+    if getattr(v, "ndim", 0) == 0:
+        if jnp.issubdtype(v.dtype, jnp.integer):
+            return jax.lax.psum(v, batch_axis)
+        return jax.lax.pmean(v, batch_axis)
+    return jax.lax.all_gather(v, batch_axis, axis=0, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+
+class _CompiledStep:
+    def __init__(self, fn, state_in_names, state_out_names, feed_names,
+                 fetch_names):
+        self.fn = fn
+        self.state_in_names = state_in_names
+        self.state_out_names = state_out_names
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+
+
+class Executor:
+    """User-facing executor (ref: python executor.py:896 Executor.run)."""
+
+    def __init__(self, place: Optional[Place] = None):
+        self.place = place if place is not None else TPUPlace(0)
+        self._device = _jax_device_for(self.place)
+        self._cache: Dict[Any, _CompiledStep] = {}
+
+    # -- public API ------------------------------------------------------
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, scope: Optional[Scope] = None,
+            return_numpy: bool = True):
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+
+        # CompiledProgram wrapper (data parallel etc.)
+        from .compiler import CompiledProgram
+        mesh = None
+        axis_names = ()
+        batch_axis = None
+        if isinstance(program, CompiledProgram):
+            mesh = program._mesh
+            axis_names = program._axis_names
+            batch_axis = program._batch_axis
+            program = program._program
+
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+        feed = {k: np.asarray(v) if not hasattr(v, "dtype") else v
+                for k, v in feed.items()}
+
+        step = self._compile(program, feed, fetch_names, scope, mesh,
+                             axis_names, batch_axis)
+
+        state_in = {}
+        for n in step.state_in_names:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"persistable var {n!r} not initialised in scope — run the "
+                    f"startup program first (ref semantics: executor.cc scope vars)")
+            state_in[n] = v
+        key = scope.find_var(_RNG_VAR)
+        if key is None:
+            key = jax.random.PRNGKey(program.random_seed)
+
+        feed_vals = {k: feed[k] for k in step.feed_names}
+        fetches, state_out, new_key = step.fn(feed_vals, state_in, key)
+        scope.set_var(_RNG_VAR, new_key)
+        for n, v in state_out.items():
+            scope.set_var(n, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    # -- compilation -----------------------------------------------------
+    def _feed_signature(self, feed):
+        return tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in feed.items()))
+
+    def _compile(self, program, feed, fetch_names, scope, mesh, axis_names,
+                 batch_axis):
+        key = (id(program), program._version, self._feed_signature(feed),
+               tuple(fetch_names), id(mesh))
+        if key in self._cache:
+            return self._cache[key]
+
+        block = program.global_block()
+        ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+
+        feed_names = sorted(feed)
+        written: set = set()
+        state_in_names: List[str] = []
+        for op in ops:
+            for n in op.input_names():
+                if n in written or n in feed_names or n in state_in_names:
+                    continue
+                var = block._find_var_recursive(n)
+                if var is not None and (var.persistable or
+                                        scope.find_var(n) is not None):
+                    state_in_names.append(n)
+            written |= set(op.output_names())
+        # fetch of a persistable that no op writes (e.g. fetch a param)
+        for n in fetch_names:
+            if n not in written and n not in feed_names and \
+                    n not in state_in_names:
+                state_in_names.append(n)
+
+        # every state input must come back out (read-only vars pass through
+        # unchanged) — their buffers are donated, so the scope must be handed
+        # fresh (aliased) arrays or it would retain deleted buffers
+        state_out_names = list(state_in_names)
+        for op in ops:
+            for n in op.output_names():
+                var = block._find_var_recursive(n)
+                if var is not None and var.persistable and \
+                        n not in state_out_names:
+                    state_out_names.append(n)
+
+        bw_idx = next((i for i, op in enumerate(ops)
+                       if op.type == "backward"), None)
+        is_test = program._is_test
+
+        def step(feed_vals, state_vals, rng_key):
+            if mesh is not None and batch_axis is not None:
+                # distinct randomness per shard (dropout masks must differ
+                # across devices, as each device has a different NCCL-rank
+                # curand seed in the reference); the carried key advances
+                # from the replicated base so state stays replicated
+                shard_key = jax.random.fold_in(
+                    rng_key, jax.lax.axis_index(batch_axis))
+                next_base = jax.random.split(rng_key, 1)[0]
+            else:
+                shard_key, next_base = rng_key, None
+            ctx = LoweringContext(shard_key, mesh, axis_names, is_test)
+            env = {}
+            env.update(state_vals)
+            env.update(feed_vals)
+            if bw_idx is None:
+                env = run_ops(ops, env, ctx)
+            else:
+                env = lower_block_with_backward(
+                    ops, env, ctx, bw_idx, fetch_names, state_out_names)
+            fetches = [_merge_fetch(env[n], n, block, ctx, batch_axis)
+                       for n in fetch_names]
+            state_out = {n: env[n] for n in state_out_names}
+            return fetches, state_out, \
+                (next_base if next_base is not None else ctx.key)
+
+        if mesh is not None:
+            fn = self._wrap_data_parallel(step, mesh, axis_names, batch_axis)
+        else:
+            fn = jax.jit(step, donate_argnums=(1,))
+
+        compiled = _CompiledStep(fn, state_in_names, state_out_names,
+                                 feed_names, fetch_names)
+        self._cache[key] = compiled
+        return compiled
+
+    def _wrap_data_parallel(self, step, mesh, axis_names, batch_axis):
+        """Run the step under shard_map: feeds sharded on their batch dim,
+        state replicated.  Collective ops inside (c_allreduce_sum inserted by
+        the collective transpiler, ref: transpiler/collective.py:209) become
+        lax.psum over the mesh axis."""
+        from jax.sharding import PartitionSpec as P
+
+        axis = batch_axis or axis_names[0]
+
+        def sharded(feed_vals, state_vals, rng_key):
+            in_specs = ({k: P(axis) for k in feed_vals},
+                        {k: P() for k in state_vals}, P())
+            # fetches/state are replicated after the grad allreduce
+            fn = jax.shard_map(step, mesh=mesh, in_specs=in_specs,
+                               out_specs=(P(), P(), P()), check_vma=False)
+            return fn(feed_vals, state_vals, rng_key)
+
+        return jax.jit(sharded, donate_argnums=(1,))
+
+    def close(self):
+        self._cache.clear()
+
+
+__all__ = ["Executor", "Scope", "global_scope", "scope_guard"]

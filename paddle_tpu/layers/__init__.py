@@ -1,0 +1,18 @@
+"""`layers` namespace (ref: python/paddle/fluid/layers/__init__.py) — flat
+re-export of all graph-building layer functions."""
+
+from .math_ops import *          # noqa: F401,F403
+from .math_ops import (_binary, _to_variable, _broadcast_shape)  # noqa: F401
+from .nn import *                # noqa: F401,F403
+from .nn import data             # noqa: F401
+from .tensor_ops import *        # noqa: F401,F403
+from .loss import *              # noqa: F401,F403
+from .metric_op import accuracy  # noqa: F401
+from ..lr_scheduler import (noam_decay, exponential_decay,  # noqa: F401
+                            natural_exp_decay, inverse_time_decay,
+                            polynomial_decay, piecewise_decay, cosine_decay,
+                            linear_lr_warmup)
+
+# submodule aliases mirroring fluid.layers.* module layout
+from . import math_ops as ops    # noqa: F401
+from . import tensor_ops as tensor  # noqa: F401

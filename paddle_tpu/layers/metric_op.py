@@ -1,0 +1,29 @@
+"""Metric layers (ref: python/paddle/fluid/layers/metric_op.py)."""
+
+from __future__ import annotations
+
+from ..framework.layer_helper import LayerHelper
+from . import nn
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """ref: metric_op.py accuracy — top-k accuracy over a batch."""
+    helper = LayerHelper("accuracy", name=name)
+    topk_out, topk_idx = nn.topk(input, k=k)
+    acc = helper.create_variable_for_type_inference("float32", (),
+                                                    stop_gradient=True)
+    correct = correct or helper.create_variable_for_type_inference(
+        "int32", (), stop_gradient=True)
+    total = total or helper.create_variable_for_type_inference(
+        "int32", (), stop_gradient=True)
+    helper.append_op(type="accuracy",
+                     inputs={"Out": [topk_out], "Indices": [topk_idx],
+                             "Label": [label]},
+                     outputs={"Accuracy": [acc], "Correct": [correct],
+                              "Total": [total]})
+    return acc
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, topk=1, slide_steps=1):
+    raise NotImplementedError(
+        "auc metric: use paddle_tpu.metrics.Auc host-side accumulator")

@@ -1,0 +1,271 @@
+"""Tensor manipulation layers (ref: python/paddle/fluid/layers/tensor.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Variable, convert_dtype
+from ..framework.layer_helper import LayerHelper
+
+
+def cast(x, dtype, name=None):
+    helper = LayerHelper("cast", name=name)
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype, x.shape)
+    helper.append_op(type="cast", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"out_dtype": dtype})
+    return out
+
+
+def fill_constant(shape, dtype, value, name=None):
+    helper = LayerHelper("fill_constant", name=name)
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype, tuple(shape),
+                                                    stop_gradient=True)
+    helper.append_op(type="fill_constant", outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "value": float(value)})
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  name=None):
+    helper = LayerHelper("fill_constant_batch_size_like", name=name)
+    oshape = list(shape)
+    oshape[output_dim_idx] = input.shape[input_dim_idx]
+    out = helper.create_variable_for_type_inference(
+        convert_dtype(dtype), tuple(oshape), stop_gradient=True)
+    helper.append_op(type="fill_constant_batch_size_like",
+                     inputs={"Input": [input]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "dtype": convert_dtype(dtype),
+                            "value": float(value),
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out
+
+
+def zeros(shape, dtype="float32", name=None):
+    return fill_constant(shape, dtype, 0.0, name)
+
+
+def ones(shape, dtype="float32", name=None):
+    return fill_constant(shape, dtype, 1.0, name)
+
+
+def zeros_like(x, name=None):
+    helper = LayerHelper("fill_zeros_like", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="fill_zeros_like", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def ones_like(x, name=None):
+    helper = LayerHelper("fill_any_like", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="fill_any_like", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"value": 1.0})
+    return out
+
+
+def assign(input, output=None, name=None):
+    helper = LayerHelper("assign", name=name)
+    if isinstance(input, np.ndarray) or np.isscalar(input):
+        arr = np.asarray(input)
+        out = output if output is not None else \
+            helper.create_variable_for_type_inference(str(arr.dtype),
+                                                      arr.shape)
+        helper.append_op(type="assign_value", outputs={"Out": [out]},
+                         attrs={"shape": list(arr.shape),
+                                "dtype": convert_dtype(arr.dtype),
+                                "values": arr.reshape(-1).tolist()})
+        return out
+    out = output if output is not None else \
+        helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op(type="assign", inputs={"X": [input]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def reshape(x, shape, inplace=False, name=None):
+    helper = LayerHelper("reshape2", name=name)
+    new_shape = list(shape)
+    for i, s in enumerate(new_shape):
+        if s == 0:
+            new_shape[i] = x.shape[i]
+    known = 1
+    for s in new_shape:
+        if s > 0:
+            known *= s
+    if -1 in new_shape and all(d >= 0 for d in x.shape):
+        new_shape[new_shape.index(-1)] = int(np.prod(x.shape) // known)
+    out = helper.create_variable_for_type_inference(x.dtype, tuple(new_shape))
+    xshape = helper.create_variable_for_type_inference(x.dtype, (0,) + tuple(x.shape))
+    helper.append_op(type="reshape2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"shape": list(shape)})
+    return out
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose2", name=name)
+    shape = tuple(x.shape[p] for p in perm)
+    out = helper.create_variable_for_type_inference(x.dtype, shape)
+    xshape = helper.create_variable_for_type_inference(x.dtype, (0,) + tuple(x.shape))
+    helper.append_op(type="transpose2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axis": list(perm)})
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    nd = len(input[0].shape)
+    ax = axis % nd
+    dim = 0
+    for v in input:
+        if v.shape[ax] == -1:
+            dim = -1
+            break
+        dim += v.shape[ax]
+    shape = tuple(dim if i == ax else s
+                  for i, s in enumerate(input[0].shape))
+    out = helper.create_variable_for_type_inference(input[0].dtype, shape)
+    helper.append_op(type="concat", inputs={"X": list(input)},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    nd = len(input.shape)
+    ax = dim % nd
+    total = input.shape[ax]
+    if isinstance(num_or_sections, int):
+        sections = [total // num_or_sections] * num_or_sections
+        attrs = {"num": num_or_sections, "sections": [], "axis": ax}
+    else:
+        sections = list(num_or_sections)
+        attrs = {"num": 0, "sections": sections, "axis": ax}
+    outs = []
+    for s in sections:
+        shape = tuple(s if i == ax else d for i, d in enumerate(input.shape))
+        outs.append(helper.create_variable_for_type_inference(input.dtype,
+                                                              shape))
+    helper.append_op(type="split", inputs={"X": [input]},
+                     outputs={"Out": outs}, attrs=attrs)
+    return outs
+
+
+def stack(x, axis=0, name=None):
+    helper = LayerHelper("stack", name=name)
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    nd = len(xs[0].shape) + 1
+    ax = axis % nd
+    shape = list(xs[0].shape)
+    shape.insert(ax, len(xs))
+    out = helper.create_variable_for_type_inference(xs[0].dtype, tuple(shape))
+    helper.append_op(type="stack", inputs={"X": list(xs)},
+                     outputs={"Y": [out]}, attrs={"axis": axis})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze2", name=name)
+    axes = [axes] if isinstance(axes, int) else list(axes)
+    shape = list(input.shape)
+    for ax in sorted(axes):
+        shape.insert(ax if ax >= 0 else ax + len(shape) + 1, 1)
+    out = helper.create_variable_for_type_inference(input.dtype, tuple(shape))
+    xshape = helper.create_variable_for_type_inference(
+        input.dtype, (0,) + tuple(input.shape))
+    helper.append_op(type="unsqueeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axes": axes})
+    return out
+
+
+def squeeze(input, axes=None, name=None):
+    helper = LayerHelper("squeeze2", name=name)
+    axes = axes or []
+    nd = len(input.shape)
+    norm = [ax % nd for ax in axes]
+    if norm:
+        shape = tuple(s for i, s in enumerate(input.shape) if i not in norm)
+    else:
+        shape = tuple(s for s in input.shape if s != 1)
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
+    xshape = helper.create_variable_for_type_inference(
+        input.dtype, (0,) + tuple(input.shape))
+    helper.append_op(type="squeeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axes": axes})
+    return out
+
+
+def slice(input, axes, starts, ends, name=None):
+    helper = LayerHelper("slice", name=name)
+    shape = list(input.shape)
+    for ax, s, e in zip(axes, starts, ends):
+        dim = shape[ax]
+        if dim == -1:
+            continue
+        s2 = max(s + dim, 0) if s < 0 else min(s, dim)
+        e2 = max(e + dim, 0) if e < 0 else min(e, dim)
+        shape[ax] = max(e2 - s2, 0)
+    out = helper.create_variable_for_type_inference(input.dtype, tuple(shape))
+    helper.append_op(type="slice", inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends), "decrease_axis": []})
+    return out
+
+
+def gather(input, index, axis=0, name=None):
+    helper = LayerHelper("gather", name=name)
+    n = index.shape[0] if index.shape else -1
+    shape = tuple(input.shape)
+    shape = shape[:axis] + (n,) + shape[axis + 1:]
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
+    helper.append_op(type="gather", inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand", name=name)
+    shape = tuple(-1 if s == -1 else s * t
+                  for s, t in zip(x.shape, expand_times))
+    out = helper.create_variable_for_type_inference(x.dtype, shape)
+    helper.append_op(type="expand", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"expand_times": list(expand_times)})
+    return out
+
+
+def shape(input, name=None):
+    helper = LayerHelper("shape", name=name)
+    out = helper.create_variable_for_type_inference(
+        "int32", (len(input.shape),), stop_gradient=True)
+    helper.append_op(type="shape", inputs={"Input": [input]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def increment(x, value=1.0, in_place=True, name=None):
+    helper = LayerHelper("increment", name=name)
+    out = x if in_place else helper.create_variable_for_type_inference(
+        x.dtype, x.shape)
+    helper.append_op(type="increment", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"step": float(value)})
+    return out
+
+
+def where(condition, x, y, name=None):
+    helper = LayerHelper("where", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="where",
+                     inputs={"Condition": [condition], "X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out

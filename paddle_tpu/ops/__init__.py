@@ -1,0 +1,8 @@
+"""JAX op implementations — importing this package registers all ops."""
+
+from .registry import OPS, register, get_op, has_op, LoweringContext
+from . import math_ops      # noqa: F401
+from . import nn_ops        # noqa: F401
+from . import tensor_ops    # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import collective_ops  # noqa: F401

@@ -1,0 +1,162 @@
+"""Collective communication ops (ref: operators/collective/c_allreduce_op.h,
+c_broadcast_op.h, c_allgather_op.h, c_reducescatter_op.h).
+
+The reference implements these over NCCL comms keyed by ring_id, with
+explicit stream-sync ops.  TPU-natively they are XLA collectives over ICI:
+``ring_id`` maps to a mesh *axis name* and the ops lower to ``lax.psum`` /
+``all_gather`` / ``psum_scatter`` / ``ppermute`` inside the shard_map the
+executor wraps around data/model-parallel programs (executor.py).  Outside a
+mapped axis (single device) they are identity — same as running the
+reference single-rank.  No comm-init or stream ordering ops are needed: XLA
+owns topology and scheduling (SURVEY §5 "Distributed communication backend"),
+so ``c_comm_init``/``c_gen_nccl_id``/``c_sync_*_stream`` register as no-ops
+for script compatibility.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+
+
+def _ring_axis(ctx, attrs):
+    """ring_id → mesh axis name; None when not running under shard_map."""
+    if not ctx.axis_names:
+        return None
+    ring_id = attrs.get("ring_id", 0)
+    # the executor records the ring→axis mapping; default ring 0 = first axis
+    mapping = attrs.get("_axis_name")
+    if mapping:
+        return mapping if mapping in ctx.axis_names else None
+    if isinstance(ring_id, int) and ring_id < len(ctx.axis_names):
+        return ctx.axis_names[ring_id]
+    return ctx.axis_names[0]
+
+
+def _allreduce(reducer):
+    def impl(ctx, ins, attrs):
+        a = x(ins, "X")
+        axis = _ring_axis(ctx, attrs)
+        if axis is None:
+            return {"Out": a}
+        return {"Out": reducer(a, axis)}
+    return impl
+
+
+register("c_allreduce_sum")(_allreduce(lambda a, ax: lax.psum(a, ax)))
+register("c_allreduce_max")(_allreduce(lambda a, ax: lax.pmax(a, ax)))
+register("c_allreduce_min")(_allreduce(lambda a, ax: lax.pmin(a, ax)))
+register("c_allreduce_prod")(_allreduce(
+    lambda a, ax: jnp.exp(lax.psum(jnp.log(a), ax))))
+
+
+@register("c_broadcast")
+def _c_broadcast(ctx, ins, attrs):
+    a = x(ins, "X")
+    axis = _ring_axis(ctx, attrs)
+    if axis is None:
+        return {"Out": a}
+    root = attrs.get("root", 0)
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, a, jnp.zeros_like(a))
+    return {"Out": lax.psum(masked, axis)}
+
+
+@register("c_allgather")
+def _c_allgather(ctx, ins, attrs):
+    a = x(ins, "X")
+    axis = _ring_axis(ctx, attrs)
+    if axis is None:
+        return {"Out": a}
+    return {"Out": lax.all_gather(a, axis, axis=0, tiled=True)}
+
+
+@register("c_reducescatter")
+def _c_reducescatter(ctx, ins, attrs):
+    a = x(ins, "X")
+    axis = _ring_axis(ctx, attrs)
+    if axis is None:
+        return {"Out": a}
+    return {"Out": lax.psum_scatter(a, axis, scatter_dimension=0, tiled=True)}
+
+
+@register("c_concat")
+def _c_concat(ctx, ins, attrs):
+    return _c_allgather(ctx, ins, attrs)
+
+
+@register("c_split")
+def _c_split(ctx, ins, attrs):
+    a = x(ins, "X")
+    axis = _ring_axis(ctx, attrs)
+    if axis is None:
+        return {"Out": a}
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    piece = a.shape[0] // n
+    return {"Out": lax.dynamic_slice_in_dim(a, idx * piece, piece, axis=0)}
+
+
+@register("alltoall")
+def _alltoall(ctx, ins, attrs):
+    a = x(ins, "X")
+    axis = _ring_axis(ctx, attrs)
+    if axis is None:
+        return {"Out": a}
+    n = lax.axis_size(axis)
+    parts = a.reshape((n, a.shape[0] // n) + a.shape[1:])
+    return {"Out": lax.all_to_all(parts, axis, split_axis=0, concat_axis=0)
+            .reshape(a.shape)}
+
+
+@register("c_embedding")
+def _c_embedding(ctx, ins, attrs):
+    """Vocab-sharded embedding lookup (model parallel)."""
+    w, ids = x(ins, "W"), x(ins, "Ids")
+    axis = _ring_axis(ctx, attrs)
+    start = attrs.get("start_index", 0)
+    local = ids.astype(jnp.int32) - start
+    valid = (local >= 0) & (local < w.shape[0])
+    out = jnp.take(w, jnp.clip(local, 0, w.shape[0] - 1), axis=0)
+    out = jnp.where(valid[..., None], out, 0.0)
+    if axis is not None:
+        out = lax.psum(out, axis)
+    return {"Out": out}
+
+
+@register("c_identity")
+def _c_identity(ctx, ins, attrs):
+    return {"Out": x(ins, "X")}
+
+
+@register("c_sync_calc_stream")
+@register("c_sync_comm_stream")
+def _c_sync_stream(ctx, ins, attrs):
+    # XLA schedules collectives; stream ordering ops are identity
+    return {"Out": x(ins, "X")}
+
+
+def _noop(ctx, ins, attrs):
+    return {}
+
+
+register("c_comm_init")(_noop)
+register("c_comm_init_all")(_noop)
+register("c_gen_nccl_id")(_noop)
+register("barrier")(_noop)
+
+
+@register("collective_permute")
+def _collective_permute(ctx, ins, attrs):
+    """Ring shift (used by pipeline/sequence parallelism)."""
+    a = x(ins, "X")
+    axis = _ring_axis(ctx, attrs)
+    if axis is None:
+        return {"Out": a}
+    n = lax.axis_size(axis)
+    shift = attrs.get("shift", 1)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return {"Out": lax.ppermute(a, axis, perm)}

@@ -1,0 +1,347 @@
+"""Dense math ops (ref: operators/*.cc elementwise/activation/reduce/matmul
+families, operators/math/blas.h).  Each op keeps the reference's slot names
+and attribute semantics; kernels are jax/lax compositions that XLA fuses and
+tiles onto the MXU — no hand-written per-dtype kernels needed."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary family (ref: operators/elementwise/)
+# Paddle broadcasting: Y's shape aligns to X starting at `axis`
+# (axis == -1 → numpy-style trailing alignment).
+# ---------------------------------------------------------------------------
+
+def _bcast(a, b, axis):
+    if axis is None or axis == -1 or a.ndim == b.ndim:
+        return a, b
+    # align b's dims to a at `axis`, padding trailing 1s
+    new_shape = [1] * a.ndim
+    for i, s in enumerate(b.shape):
+        new_shape[axis + i] = s
+    return a, b.reshape(new_shape)
+
+
+def _elementwise(fn):
+    def impl(ctx, ins, attrs):
+        a, b = x(ins, "X"), x(ins, "Y")
+        a, b = _bcast(a, b, attrs.get("axis", -1))
+        return {"Out": fn(a, b)}
+    return impl
+
+
+register("elementwise_add")(_elementwise(jnp.add))
+register("elementwise_sub")(_elementwise(jnp.subtract))
+register("elementwise_mul")(_elementwise(jnp.multiply))
+register("elementwise_div")(_elementwise(jnp.divide))
+register("elementwise_max")(_elementwise(jnp.maximum))
+register("elementwise_min")(_elementwise(jnp.minimum))
+register("elementwise_pow")(_elementwise(jnp.power))
+register("elementwise_mod")(_elementwise(jnp.mod))
+register("elementwise_floordiv")(_elementwise(jnp.floor_divide))
+
+
+@register("sum")
+def _sum(ctx, ins, attrs):
+    xs = ins["X"]
+    out = xs[0]
+    for v in xs[1:]:
+        out = out + v
+    return {"Out": out}
+
+
+@register("scale")
+def _scale(ctx, ins, attrs):
+    a = x(ins, "X")
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": a * s + b}
+    return {"Out": (a + b) * s}
+
+
+# ---------------------------------------------------------------------------
+# matmul / mul / fc (ref: operators/matmul_op.cc, mul_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _flatten2(a, num_col_dims):
+    lead = 1
+    for s in a.shape[:num_col_dims]:
+        lead *= s
+    rest = 1
+    for s in a.shape[num_col_dims:]:
+        rest *= s
+    return a.reshape(lead, rest)
+
+
+@register("mul")
+def _mul(ctx, ins, attrs):
+    """2-D GEMM with leading-dim flattening (ref: mul_op.cc)."""
+    a, b = x(ins, "X"), x(ins, "Y")
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    out_shape = a.shape[:xn] + b.shape[yn:]
+    a2 = _flatten2(a, xn)
+    b2 = _flatten2(b, yn)
+    out = jnp.matmul(a2, b2, preferred_element_type=jnp.float32).astype(a.dtype)
+    return {"Out": out.reshape(out_shape)}
+
+
+@register("matmul")
+def _matmul(ctx, ins, attrs):
+    a, b = x(ins, "X"), x(ins, "Y")
+    ta = attrs.get("transpose_X", False)
+    tb = attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if ta:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if tb:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    out = jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+@register("matmul_v2")
+def _matmul_v2(ctx, ins, attrs):
+    a, b = x(ins, "X"), x(ins, "Y")
+    if attrs.get("trans_x", False):
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs.get("trans_y", False):
+        b = jnp.swapaxes(b, -1, -2)
+    out = jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# activations (ref: operators/activation_op.cc)
+# ---------------------------------------------------------------------------
+
+def _unary(fn):
+    def impl(ctx, ins, attrs):
+        return {"Out": fn(x(ins, "X"))}
+    return impl
+
+
+register("relu")(_unary(jax.nn.relu))
+register("sigmoid")(_unary(jax.nn.sigmoid))
+register("tanh")(_unary(jnp.tanh))
+register("exp")(_unary(jnp.exp))
+register("log")(_unary(jnp.log))
+register("sqrt")(_unary(jnp.sqrt))
+register("rsqrt")(_unary(lax.rsqrt))
+register("square")(_unary(jnp.square))
+register("abs")(_unary(jnp.abs))
+register("floor")(_unary(jnp.floor))
+register("ceil")(_unary(jnp.ceil))
+register("round")(_unary(jnp.round))
+register("reciprocal")(_unary(jnp.reciprocal))
+register("softsign")(_unary(jax.nn.soft_sign))
+register("softplus")(_unary(jax.nn.softplus))
+register("sin")(_unary(jnp.sin))
+register("cos")(_unary(jnp.cos))
+register("erf")(_unary(lax.erf))
+register("logsigmoid")(_unary(jax.nn.log_sigmoid))
+
+
+@register("gelu")
+def _gelu(ctx, ins, attrs):
+    return {"Out": jax.nn.gelu(x(ins, "X"),
+                               approximate=attrs.get("approximate", False))}
+
+
+@register("leaky_relu")
+def _leaky_relu(ctx, ins, attrs):
+    return {"Out": jax.nn.leaky_relu(x(ins, "X"),
+                                     negative_slope=attrs.get("alpha", 0.02))}
+
+
+@register("elu")
+def _elu(ctx, ins, attrs):
+    return {"Out": jax.nn.elu(x(ins, "X"), alpha=attrs.get("alpha", 1.0))}
+
+
+@register("relu6")
+def _relu6(ctx, ins, attrs):
+    return {"Out": jnp.clip(x(ins, "X"), 0.0, attrs.get("threshold", 6.0))}
+
+
+@register("pow")
+def _pow(ctx, ins, attrs):
+    return {"Out": jnp.power(x(ins, "X"), attrs.get("factor", 1.0))}
+
+
+@register("hard_sigmoid")
+def _hard_sigmoid(ctx, ins, attrs):
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    return {"Out": jnp.clip(x(ins, "X") * slope + offset, 0.0, 1.0)}
+
+
+@register("hard_swish")
+def _hard_swish(ctx, ins, attrs):
+    a = x(ins, "X")
+    threshold = attrs.get("threshold", 6.0)
+    scale = attrs.get("scale", 6.0)
+    offset = attrs.get("offset", 3.0)
+    return {"Out": a * jnp.clip(a + offset, 0.0, threshold) / scale}
+
+
+@register("swish")
+def _swish(ctx, ins, attrs):
+    a = x(ins, "X")
+    return {"Out": a * jax.nn.sigmoid(attrs.get("beta", 1.0) * a)}
+
+
+@register("mish")
+def _mish(ctx, ins, attrs):
+    a = x(ins, "X")
+    return {"Out": a * jnp.tanh(jax.nn.softplus(a))}
+
+
+# ---------------------------------------------------------------------------
+# reductions (ref: operators/reduce_ops/)
+# ---------------------------------------------------------------------------
+
+def _reduce(fn):
+    def impl(ctx, ins, attrs):
+        a = x(ins, "X")
+        if attrs.get("reduce_all", False):
+            axis = None
+        else:
+            dim = attrs.get("dim", [0])
+            if isinstance(dim, int):
+                dim = [dim]
+            axis = tuple(d % a.ndim for d in dim) if dim else None
+        return {"Out": fn(a, axis=axis, keepdims=attrs.get("keep_dim", False))}
+    return impl
+
+
+register("reduce_sum")(_reduce(jnp.sum))
+register("reduce_mean")(_reduce(jnp.mean))
+register("reduce_max")(_reduce(jnp.max))
+register("reduce_min")(_reduce(jnp.min))
+register("reduce_prod")(_reduce(jnp.prod))
+register("reduce_any")(_reduce(jnp.any))
+register("reduce_all")(_reduce(jnp.all))
+
+
+@register("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": jnp.mean(x(ins, "X"))}
+
+
+@register("logsumexp")
+def _logsumexp(ctx, ins, attrs):
+    a = x(ins, "X")
+    dim = attrs.get("axis", attrs.get("dim", None))
+    if attrs.get("reduce_all", False) or dim is None:
+        axis = None
+    else:
+        axis = tuple(d % a.ndim for d in (dim if isinstance(dim, (list, tuple)) else [dim]))
+    return {"Out": jax.scipy.special.logsumexp(
+        a, axis=axis, keepdims=attrs.get("keepdim", attrs.get("keep_dim", False)))}
+
+
+# ---------------------------------------------------------------------------
+# clipping / comparison / logical
+# ---------------------------------------------------------------------------
+
+
+@register("clip")
+def _clip(ctx, ins, attrs):
+    return {"Out": jnp.clip(x(ins, "X"), attrs.get("min"), attrs.get("max"))}
+
+
+@register("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    a = x(ins, "X")
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(a)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": a * scale.astype(a.dtype)}
+
+
+def _cmp(fn):
+    def impl(ctx, ins, attrs):
+        return {"Out": fn(x(ins, "X"), x(ins, "Y"))}
+    return impl
+
+
+register("equal")(_cmp(jnp.equal))
+register("not_equal")(_cmp(jnp.not_equal))
+register("less_than")(_cmp(jnp.less))
+register("less_equal")(_cmp(jnp.less_equal))
+register("greater_than")(_cmp(jnp.greater))
+register("greater_equal")(_cmp(jnp.greater_equal))
+register("logical_and")(_cmp(jnp.logical_and))
+register("logical_or")(_cmp(jnp.logical_or))
+register("logical_xor")(_cmp(jnp.logical_xor))
+register("logical_not")(_unary(jnp.logical_not))
+register("isfinite_v2")(_unary(jnp.isfinite))
+register("isnan_v2")(_unary(jnp.isnan))
+register("isinf_v2")(_unary(jnp.isinf))
+
+
+@register("maximum")
+def _maximum(ctx, ins, attrs):
+    return {"Out": jnp.maximum(x(ins, "X"), x(ins, "Y"))}
+
+
+@register("minimum")
+def _minimum(ctx, ins, attrs):
+    return {"Out": jnp.minimum(x(ins, "X"), x(ins, "Y"))}
+
+
+# ---------------------------------------------------------------------------
+# linalg extras
+# ---------------------------------------------------------------------------
+
+
+@register("p_norm")
+def _p_norm(ctx, ins, attrs):
+    a = x(ins, "X")
+    porder = attrs.get("porder", 2.0)
+    axis = attrs.get("axis", None)
+    keepdim = attrs.get("keepdim", False)
+    return {"Out": jnp.linalg.norm(a, ord=porder, axis=axis, keepdims=keepdim)}
+
+
+@register("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    a = x(ins, "X")
+    return {"Out": jnp.sum(jnp.square(a)).reshape(1)}
+
+
+@register("dot")
+def _dot(ctx, ins, attrs):
+    a, b = x(ins, "X"), x(ins, "Y")
+    return {"Out": jnp.sum(a * b, axis=-1)}
+
+
+@register("cumsum")
+def _cumsum(ctx, ins, attrs):
+    a = x(ins, "X")
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        a = a.reshape(-1)
+        axis = 0
+    if attrs.get("reverse", False):
+        b = jnp.flip(a, axis)
+        out = jnp.cumsum(b, axis=axis)
+        if attrs.get("exclusive", False):
+            out = out - b
+        out = jnp.flip(out, axis)
+    else:
+        out = jnp.cumsum(a, axis=axis)
+        if attrs.get("exclusive", False):
+            out = out - a
+    return {"Out": out}

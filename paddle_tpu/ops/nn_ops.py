@@ -1,0 +1,548 @@
+"""Neural-net ops (ref: operators/conv_op.cc, pool_op.cc, batch_norm_op.cc,
+layer_norm_op.cc, softmax_op.cc, cross_entropy_op.cc, dropout_op.cc,
+lookup_table_op.cc, ...).  Convs/matmuls go through lax conv/dot so XLA can
+tile them onto the MXU; normalisations are jnp compositions XLA fuses."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+
+
+# ---------------------------------------------------------------------------
+# convolution (ref: operators/conv_op.cc — NCHW layout default)
+# ---------------------------------------------------------------------------
+
+def _pair(v, n=2):
+    if isinstance(v, int):
+        return [v] * n
+    return list(v)
+
+
+@register("conv2d")
+def _conv2d(ctx, ins, attrs):
+    inp, filt = x(ins, "Input"), x(ins, "Filter")
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1) or 1
+    data_format = attrs.get("data_format", "NCHW")
+    if data_format in ("NCHW", "AnyLayout"):
+        dn = ("NCHW", "OIHW", "NCHW")
+    else:
+        dn = ("NHWC", "HWIO", "NHWC")
+        if filt.ndim == 4 and filt.shape[-1] != inp.shape[-1] // groups:
+            # filters always stored OIHW (paddle convention); convert
+            filt = jnp.transpose(filt, (2, 3, 1, 0))
+    if len(paddings) == 2:
+        pads = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    else:  # [top, bottom, left, right]
+        pads = [(paddings[0], paddings[1]), (paddings[2], paddings[3])]
+    padding_alg = attrs.get("padding_algorithm", "EXPLICIT")
+    if padding_alg == "SAME":
+        pads = "SAME"
+    elif padding_alg == "VALID":
+        pads = "VALID"
+    out = lax.conv_general_dilated(
+        inp, filt, window_strides=strides, padding=pads,
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=dn,
+        preferred_element_type=jnp.float32).astype(inp.dtype)
+    return {"Output": out}
+
+
+@register("depthwise_conv2d")
+def _depthwise_conv2d(ctx, ins, attrs):
+    # groups == in_channels; same lowering as conv2d
+    return _conv2d(ctx, ins, attrs)
+
+
+@register("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    inp, filt = x(ins, "Input"), x(ins, "Filter")
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1) or 1
+    pads = [(p, p) for p in paddings] if len(paddings) == 2 else \
+        [(paddings[0], paddings[1]), (paddings[2], paddings[3])]
+    # paddle filter layout for transpose conv: (in, out//groups, H, W)
+    out = lax.conv_transpose(
+        inp, jnp.transpose(filt, (1, 0, 2, 3)), strides=strides,
+        padding=[(s * 0 + p[0], p[1]) for s, p in zip(strides, pads)],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        transpose_kernel=True)
+    return {"Output": out.astype(inp.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# pooling (ref: operators/pool_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("pool2d")
+def _pool2d(ctx, ins, attrs):
+    a = x(ins, "X")
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    global_pool = attrs.get("global_pooling", False)
+    adaptive = attrs.get("adaptive", False)
+    exclusive = attrs.get("exclusive", True)
+
+    if global_pool or (adaptive and tuple(ksize) == (1, 1)):
+        fn = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": fn(a, axis=(2, 3), keepdims=True)}
+
+    window = (1, 1, ksize[0], ksize[1])
+    stride = (1, 1, strides[0], strides[1])
+    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]),
+            (paddings[1], paddings[1]))
+    if attrs.get("ceil_mode", False):
+        # extend right/bottom pad so the last partial window is included
+        def extra(size, k, s, p):
+            out = -(-(size + 2 * p - k) // s) + 1
+            needed = (out - 1) * s + k - (size + 2 * p)
+            return max(0, needed)
+        pads = ((0, 0), (0, 0),
+                (paddings[0], paddings[0] + extra(a.shape[2], ksize[0], strides[0], paddings[0])),
+                (paddings[1], paddings[1] + extra(a.shape[3], ksize[1], strides[1], paddings[1])))
+
+    import numpy as np
+    # init values must be numpy scalars so lax dispatches to the monoid
+    # (differentiable) reduce_window_{max,add} primitives
+    if ptype == "max":
+        init = np.array(-np.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                        else np.iinfo(a.dtype).min, a.dtype)
+        out = lax.reduce_window(a, init, lax.max, window, stride, pads)
+    else:
+        zero = np.array(0, a.dtype)
+        summed = lax.reduce_window(a, zero, lax.add, window, stride, pads)
+        if exclusive and (paddings[0] or paddings[1]):
+            ones = jnp.ones_like(a)
+            counts = lax.reduce_window(ones, zero, lax.add,
+                                       window, stride, pads)
+            out = summed / counts
+        else:
+            out = summed / (ksize[0] * ksize[1])
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# normalisation
+# ---------------------------------------------------------------------------
+
+
+@register("batch_norm")
+def _batch_norm(ctx, ins, attrs):
+    """ref: operators/batch_norm_op.cc — NCHW; updates running stats in the
+    forward pass (MeanOut/VarianceOut alias the persistable Mean/Variance
+    vars; the executor's functional env makes the aliasing explicit)."""
+    a = x(ins, "X")
+    scale, bias = x(ins, "Scale"), x(ins, "Bias")
+    mean, var = x(ins, "Mean"), x(ins, "Variance")
+    momentum = attrs.get("momentum", 0.9)
+    eps = attrs.get("epsilon", 1e-5)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    layout = attrs.get("data_layout", "NCHW")
+    axes = tuple(i for i in range(a.ndim)
+                 if i != (1 if layout == "NCHW" else a.ndim - 1))
+    shape = [1] * a.ndim
+    shape[1 if layout == "NCHW" else a.ndim - 1] = -1
+
+    if is_test or attrs.get("use_global_stats", False):
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = jnp.zeros_like(mean)
+        saved_var = jnp.zeros_like(var)
+    else:
+        bm = jnp.mean(a, axis=axes)
+        bv = jnp.var(a, axis=axes)
+        use_mean, use_var = bm, bv
+        mean_out = lax.stop_gradient(mean * momentum + bm * (1 - momentum))
+        var_out = lax.stop_gradient(var * momentum + bv * (1 - momentum))
+        saved_mean = bm
+        saved_var = 1.0 / jnp.sqrt(bv + eps)
+
+    inv = lax.rsqrt(use_var + eps)
+    out = (a - use_mean.reshape(shape)) * (inv * scale).reshape(shape) \
+        + bias.reshape(shape)
+    return {"Y": out.astype(a.dtype), "MeanOut": mean_out,
+            "VarianceOut": var_out, "SavedMean": saved_mean,
+            "SavedVariance": saved_var}
+
+
+@register("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    """ref: operators/layer_norm_op.cc — normalise over dims
+    [begin_norm_axis:]; Scale/Bias are flattened over those dims."""
+    a = x(ins, "X")
+    scale, bias = x(ins, "Scale"), x(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    bna = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(bna, a.ndim))
+    mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+    var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    out = (a - mean) * inv
+    tail = a.shape[bna:]
+    if scale is not None:
+        out = out * scale.reshape(tail)
+    if bias is not None:
+        out = out + bias.reshape(tail)
+    return {"Y": out.astype(a.dtype),
+            "Mean": mean.reshape(a.shape[:bna]),
+            "Variance": var.reshape(a.shape[:bna])}
+
+
+@register("instance_norm")
+def _instance_norm(ctx, ins, attrs):
+    a = x(ins, "X")   # NCHW
+    scale, bias = x(ins, "Scale"), x(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, a.ndim))
+    mean = jnp.mean(a, axis=axes, keepdims=True)
+    var = jnp.var(a, axis=axes, keepdims=True)
+    out = (a - mean) * lax.rsqrt(var + eps)
+    shape = [1, -1] + [1] * (a.ndim - 2)
+    if scale is not None:
+        out = out * scale.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return {"Y": out, "SavedMean": mean.reshape(a.shape[0], a.shape[1]),
+            "SavedVariance": var.reshape(a.shape[0], a.shape[1])}
+
+
+@register("group_norm")
+def _group_norm(ctx, ins, attrs):
+    a = x(ins, "X")   # NCHW
+    scale, bias = x(ins, "Scale"), x(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    groups = attrs.get("groups", 1)
+    n, c = a.shape[0], a.shape[1]
+    g = a.reshape(n, groups, c // groups, *a.shape[2:])
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    out = ((g - mean) * lax.rsqrt(var + eps)).reshape(a.shape)
+    shape = [1, -1] + [1] * (a.ndim - 2)
+    if scale is not None:
+        out = out * scale.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return {"Y": out, "Mean": mean.reshape(n, groups),
+            "Variance": var.reshape(n, groups)}
+
+
+# ---------------------------------------------------------------------------
+# softmax / losses (ref: softmax_op.cc, cross_entropy_op.cc,
+# softmax_with_cross_entropy_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("softmax")
+def _softmax(ctx, ins, attrs):
+    return {"Out": jax.nn.softmax(x(ins, "X"), axis=attrs.get("axis", -1))}
+
+
+@register("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    return {"Out": jax.nn.log_softmax(x(ins, "X"), axis=attrs.get("axis", -1))}
+
+
+def _gather_label_logp(logp, label, ignore_index=-100):
+    lbl = label.reshape(logp.shape[:-1]).astype(jnp.int32)
+    safe = jnp.where(lbl == ignore_index, 0, lbl)
+    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    picked = jnp.where(lbl == ignore_index, 0.0, picked)
+    return picked, lbl
+
+
+@register("cross_entropy")
+def _cross_entropy(ctx, ins, attrs):
+    prob = x(ins, "X")
+    label = x(ins, "Label")
+    ignore_index = attrs.get("ignore_index", -100)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(prob, 1e-20)), axis=-1,
+                        keepdims=True)
+    else:
+        logp = jnp.log(jnp.maximum(prob, 1e-20))
+        picked, _ = _gather_label_logp(logp, label, ignore_index)
+        loss = -picked[..., None]
+    return {"Y": loss}
+
+
+@register("cross_entropy2")
+def _cross_entropy2(ctx, ins, attrs):
+    out = _cross_entropy(ctx, ins, attrs)
+    prob = x(ins, "X")
+    return {"Y": out["Y"], "XShape": jnp.zeros(prob.shape, prob.dtype),
+            "MatchX": jnp.exp(-out["Y"])}
+
+
+@register("softmax_with_cross_entropy")
+def _softmax_with_cross_entropy(ctx, ins, attrs):
+    logits = x(ins, "Logits")
+    label = x(ins, "Label")
+    axis = attrs.get("axis", -1)
+    softmax = jax.nn.softmax(logits, axis=axis)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        picked, _ = _gather_label_logp(
+            jnp.moveaxis(logp, axis, -1), label,
+            attrs.get("ignore_index", -100))
+        loss = picked[..., None]
+        loss = -loss
+    return {"Softmax": softmax, "Loss": loss}
+
+
+@register("sigmoid_cross_entropy_with_logits")
+def _bce_logits(ctx, ins, attrs):
+    a = x(ins, "X")
+    label = x(ins, "Label")
+    ignore_index = attrs.get("ignore_index", -100)
+    loss = jnp.maximum(a, 0) - a * label + jnp.log1p(jnp.exp(-jnp.abs(a)))
+    mask = (label != ignore_index).astype(a.dtype)
+    loss = loss * mask
+    if attrs.get("normalize", False):
+        loss = loss / jnp.maximum(jnp.sum(mask), 1.0)
+    return {"Out": loss}
+
+
+@register("square_error_cost")
+def _square_error(ctx, ins, attrs):
+    return {"Out": jnp.square(x(ins, "X") - x(ins, "Label"))}
+
+
+@register("smooth_l1_loss")
+def _smooth_l1(ctx, ins, attrs):
+    a = x(ins, "X") - x(ins, "Y")
+    sigma2 = attrs.get("sigma", 1.0) ** 2
+    ab = jnp.abs(a)
+    loss = jnp.where(ab < 1.0 / sigma2, 0.5 * sigma2 * a * a, ab - 0.5 / sigma2)
+    return {"Out": jnp.sum(loss, axis=tuple(range(1, a.ndim)), keepdims=False)
+            .reshape(a.shape[0], 1), "Diff": a}
+
+
+@register("huber_loss")
+def _huber(ctx, ins, attrs):
+    delta = attrs.get("delta", 1.0)
+    r = x(ins, "Y") - x(ins, "X")
+    ab = jnp.abs(r)
+    loss = jnp.where(ab <= delta, 0.5 * r * r, delta * (ab - 0.5 * delta))
+    return {"Out": loss, "Residual": r}
+
+
+@register("kldiv_loss")
+def _kldiv(ctx, ins, attrs):
+    a = x(ins, "X")
+    target = x(ins, "Target")
+    loss = target * (jnp.log(jnp.maximum(target, 1e-20)) - a)
+    loss = jnp.where(target <= 0, 0.0, loss)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / a.shape[0]
+    return {"Loss": loss}
+
+
+# ---------------------------------------------------------------------------
+# dropout / embedding / misc
+# ---------------------------------------------------------------------------
+
+
+@register("dropout")
+def _dropout(ctx, ins, attrs):
+    a = x(ins, "X")
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = a if impl == "upscale_in_train" else a * (1.0 - p)
+        return {"Out": out, "Mask": jnp.ones(a.shape, jnp.uint8)}
+    keep = jax.random.bernoulli(ctx.next_key(), 1.0 - p, a.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, a / jnp.maximum(1.0 - p, 1e-12), 0.0)
+    else:
+        out = jnp.where(keep, a, 0.0)
+    return {"Out": out.astype(a.dtype), "Mask": keep.astype(jnp.uint8)}
+
+
+def _embedding_lookup(w, ids, padding_idx):
+    flat = ids.reshape(-1).astype(jnp.int32)
+    out = jnp.take(w, flat, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((flat == padding_idx)[:, None], 0.0, out)
+    return out.reshape(ids.shape + (w.shape[-1],))
+
+
+@register("lookup_table")
+def _lookup_table(ctx, ins, attrs):
+    """ref: lookup_table_op.cc — ids carry a trailing 1 dim."""
+    w, ids = x(ins, "W"), x(ins, "Ids")
+    if ids.ndim > 1 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    return {"Out": _embedding_lookup(w, ids, attrs.get("padding_idx", -1))}
+
+
+@register("lookup_table_v2")
+def _lookup_table_v2(ctx, ins, attrs):
+    w, ids = x(ins, "W"), x(ins, "Ids")
+    return {"Out": _embedding_lookup(w, ids, attrs.get("padding_idx", -1))}
+
+
+@register("one_hot")
+def _one_hot(ctx, ins, attrs):
+    ids = x(ins, "X")
+    depth = attrs["depth"]
+    if ids.ndim > 1 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    return {"Out": jax.nn.one_hot(ids.astype(jnp.int32), depth)}
+
+
+@register("one_hot_v2")
+def _one_hot_v2(ctx, ins, attrs):
+    return _one_hot(ctx, ins, attrs)
+
+
+@register("accuracy")
+def _accuracy(ctx, ins, attrs):
+    """ref: operators/metrics/accuracy_op.cc — Indices from top_k."""
+    indices = x(ins, "Indices")
+    label = x(ins, "Label")
+    lbl = label.reshape(-1, 1).astype(indices.dtype)
+    correct = jnp.any(indices == lbl, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = jnp.array(indices.shape[0], jnp.int32)
+    return {"Accuracy": (num_correct / indices.shape[0]).reshape(()),
+            "Correct": num_correct.astype(jnp.int32),
+            "Total": total}
+
+
+@register("top_k")
+def _top_k(ctx, ins, attrs):
+    a = x(ins, "X")
+    k = attrs.get("k", 1)
+    vals, idx = lax.top_k(a, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register("top_k_v2")
+def _top_k_v2(ctx, ins, attrs):
+    a = x(ins, "X")
+    k = attrs.get("k", 1)
+    axis = attrs.get("axis", -1)
+    if axis not in (-1, a.ndim - 1):
+        a = jnp.moveaxis(a, axis, -1)
+    largest = attrs.get("largest", True)
+    vals, idx = lax.top_k(a if largest else -a, k)
+    if not largest:
+        vals = -vals
+    if axis not in (-1, a.ndim - 1):
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register("arg_max")
+def _arg_max(ctx, ins, attrs):
+    a = x(ins, "X")
+    axis = attrs.get("axis", -1)
+    out = jnp.argmax(a, axis=axis)
+    if attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": out.astype(jnp.int64)}
+
+
+@register("arg_min")
+def _arg_min(ctx, ins, attrs):
+    a = x(ins, "X")
+    axis = attrs.get("axis", -1)
+    out = jnp.argmin(a, axis=axis)
+    if attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": out.astype(jnp.int64)}
+
+
+@register("argsort")
+def _argsort(ctx, ins, attrs):
+    a = x(ins, "X")
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-a if desc else a, axis=axis)
+    out = jnp.take_along_axis(a, idx, axis=axis)
+    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+
+
+@register("interp_nearest")
+@register("nearest_interp")
+def _nearest_interp(ctx, ins, attrs):
+    a = x(ins, "X")  # NCHW
+    out_h = attrs.get("out_h", -1)
+    out_w = attrs.get("out_w", -1)
+    scale = attrs.get("scale", 0.0)
+    if (out_h is None or out_h <= 0) and scale:
+        out_h = int(a.shape[2] * scale)
+        out_w = int(a.shape[3] * scale)
+    out = jax.image.resize(a, (a.shape[0], a.shape[1], out_h, out_w),
+                           method="nearest")
+    return {"Out": out}
+
+
+@register("bilinear_interp")
+def _bilinear_interp(ctx, ins, attrs):
+    a = x(ins, "X")
+    out_h = attrs.get("out_h", -1)
+    out_w = attrs.get("out_w", -1)
+    scale = attrs.get("scale", 0.0)
+    if (out_h is None or out_h <= 0) and scale:
+        out_h = int(a.shape[2] * scale)
+        out_w = int(a.shape[3] * scale)
+    out = jax.image.resize(a, (a.shape[0], a.shape[1], out_h, out_w),
+                           method="bilinear")
+    return {"Out": out}
+
+
+@register("pad")
+def _pad(ctx, ins, attrs):
+    a = x(ins, "X")
+    p = attrs.get("paddings", [])
+    value = attrs.get("pad_value", 0.0)
+    cfg = [(p[2 * i], p[2 * i + 1]) for i in range(a.ndim)]
+    return {"Out": jnp.pad(a, cfg, constant_values=value)}
+
+
+@register("pad2d")
+def _pad2d(ctx, ins, attrs):
+    a = x(ins, "X")
+    p = attrs.get("paddings", [0, 0, 0, 0])  # t b l r
+    mode = attrs.get("mode", "constant")
+    cfg = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        return {"Out": jnp.pad(a, cfg, constant_values=attrs.get("pad_value", 0.0))}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": jnp.pad(a, cfg, mode=jmode)}
+
+
+@register("label_smooth")
+def _label_smooth(ctx, ins, attrs):
+    a = x(ins, "X")
+    eps = attrs.get("epsilon", 0.0)
+    prior = x(ins, "PriorDist")
+    k = a.shape[-1]
+    if prior is not None:
+        out = (1 - eps) * a + eps * prior
+    else:
+        out = (1 - eps) * a + eps / k
+    return {"Out": out}

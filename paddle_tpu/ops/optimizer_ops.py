@@ -1,0 +1,271 @@
+"""Optimizer update ops (ref: operators/optimizers/*.cc — sgd_op, momentum_op,
+adam_op, lamb_op, lars_momentum_op, adagrad_op, rmsprop_op, adadelta_op,
+adamax_op, ftrl_op, decayed_adagrad_op, dpsgd_op).
+
+In the reference each optimizer op mutates Param/accumulators in place; here
+outputs (ParamOut, MomentOut, ...) are new arrays the executor writes back to
+the same variable names — the functional-update equivalent.  XLA fuses the
+whole update chain into a couple of kernels, which is what the reference's
+fuse_optimizer_ops_pass hand-builds (ref: framework/ir/fuse_optimizer_ops_pass/)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+
+
+@register("sgd")
+def _sgd(ctx, ins, attrs):
+    p, g, lr = x(ins, "Param"), x(ins, "Grad"), x(ins, "LearningRate")
+    return {"ParamOut": p - lr.astype(p.dtype) * g.astype(p.dtype)}
+
+
+@register("momentum")
+def _momentum(ctx, ins, attrs):
+    p, g, v, lr = x(ins, "Param"), x(ins, "Grad"), x(ins, "Velocity"), \
+        x(ins, "LearningRate")
+    mu = attrs.get("mu", 0.9)
+    use_nesterov = attrs.get("use_nesterov", False)
+    lr = lr.astype(p.dtype)
+    g = g.astype(p.dtype)
+    # L2 regularization folded into the op (ref: momentum_op.h regularization_method)
+    if attrs.get("regularization_method", "") == "l2_decay":
+        g = g + attrs.get("regularization_coeff", 0.0) * p
+    v_out = mu * v + g
+    if use_nesterov:
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": p_out, "VelocityOut": v_out}
+
+
+@register("lars_momentum")
+def _lars_momentum(ctx, ins, attrs):
+    p, g, v, lr = x(ins, "Param"), x(ins, "Grad"), x(ins, "Velocity"), \
+        x(ins, "LearningRate")
+    mu = attrs.get("mu", 0.9)
+    lars_coeff = attrs.get("lars_coeff", 0.001)
+    lars_wd = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 0.0)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * lars_coeff * p_norm / (g_norm + lars_wd * p_norm + eps), lr)
+    v_out = mu * v + local_lr * (g + lars_wd * p)
+    return {"ParamOut": p - v_out, "VelocityOut": v_out}
+
+
+@register("adam")
+def _adam(ctx, ins, attrs):
+    p, g, lr = x(ins, "Param"), x(ins, "Grad"), x(ins, "LearningRate")
+    m1, m2 = x(ins, "Moment1"), x(ins, "Moment2")
+    b1p, b2p = x(ins, "Beta1Pow"), x(ins, "Beta2Pow")
+    beta1 = attrs.get("beta1", 0.9)
+    beta2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    g = g.astype(m1.dtype)
+    m1_out = beta1 * m1 + (1 - beta1) * g
+    m2_out = beta2 * m2 + (1 - beta2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p - lr_t.astype(p.dtype) * (
+        m1_out / (jnp.sqrt(m2_out) + eps)).astype(p.dtype)
+    return {"ParamOut": p_out, "Moment1Out": m1_out, "Moment2Out": m2_out,
+            "Beta1PowOut": b1p * beta1, "Beta2PowOut": b2p * beta2}
+
+
+@register("adamw")
+def _adamw(ctx, ins, attrs):
+    coeff = attrs.get("coeff", 0.01)
+    p, lr = x(ins, "Param"), x(ins, "LearningRate")
+    out = _adam(ctx, ins, attrs)
+    if not attrs.get("with_decay", True):
+        return out
+    out["ParamOut"] = out["ParamOut"] - lr.astype(p.dtype) * coeff * p
+    return out
+
+
+@register("lamb")
+def _lamb(ctx, ins, attrs):
+    """ref: operators/optimizers/lamb_op.h — layer-adaptive large-batch."""
+    p, g, lr = x(ins, "Param"), x(ins, "Grad"), x(ins, "LearningRate")
+    m1, m2 = x(ins, "Moment1"), x(ins, "Moment2")
+    b1p, b2p = x(ins, "Beta1Pow"), x(ins, "Beta2Pow")
+    beta1 = attrs.get("beta1", 0.9)
+    beta2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    g = g.astype(m1.dtype)
+    m1_out = beta1 * m1 + (1 - beta1) * g
+    m2_out = beta2 * m2 + (1 - beta2) * g * g
+    m1_hat = m1_out / (1 - b1p)
+    m2_hat = m2_out / (1 - b2p)
+    r = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    ratio = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    p_out = p - (lr * ratio).astype(p.dtype) * r.astype(p.dtype)
+    return {"ParamOut": p_out, "Moment1Out": m1_out, "Moment2Out": m2_out,
+            "Beta1PowOut": b1p * beta1, "Beta2PowOut": b2p * beta2}
+
+
+@register("adagrad")
+def _adagrad(ctx, ins, attrs):
+    p, g, mom, lr = x(ins, "Param"), x(ins, "Grad"), x(ins, "Moment"), \
+        x(ins, "LearningRate")
+    eps = attrs.get("epsilon", 1e-6)
+    mom_out = mom + g * g
+    p_out = p - lr.astype(p.dtype) * g / (jnp.sqrt(mom_out) + eps)
+    return {"ParamOut": p_out, "MomentOut": mom_out}
+
+
+@register("decayed_adagrad")
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, mom, lr = x(ins, "Param"), x(ins, "Grad"), x(ins, "Moment"), \
+        x(ins, "LearningRate")
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mom_out = decay * mom + (1 - decay) * g * g
+    p_out = p - lr.astype(p.dtype) * g / (jnp.sqrt(mom_out) + eps)
+    return {"ParamOut": p_out, "MomentOut": mom_out}
+
+
+@register("rmsprop")
+def _rmsprop(ctx, ins, attrs):
+    p, g, lr = x(ins, "Param"), x(ins, "Grad"), x(ins, "LearningRate")
+    ms, mom = x(ins, "MeanSquare"), x(ins, "Moment")
+    mg = x(ins, "MeanGrad")
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    momentum = attrs.get("momentum", 0.0)
+    ms_out = rho * ms + (1 - rho) * g * g
+    if attrs.get("centered", False):
+        mg_out = rho * mg + (1 - rho) * g
+        denom = ms_out - mg_out * mg_out + eps
+    else:
+        mg_out = mg
+        denom = ms_out + eps
+    mom_out = momentum * mom + lr.astype(p.dtype) * g / jnp.sqrt(denom)
+    return {"ParamOut": p - mom_out, "MomentOut": mom_out,
+            "MeanSquareOut": ms_out, "MeanGradOut": mg_out}
+
+
+@register("adadelta")
+def _adadelta(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    avg_sq_g, avg_sq_u = x(ins, "AvgSquaredGrad"), x(ins, "AvgSquaredUpdate")
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g2 = rho * avg_sq_g + (1 - rho) * g * g
+    update = -jnp.sqrt((avg_sq_u + eps) / (g2 + eps)) * g
+    u2 = rho * avg_sq_u + (1 - rho) * update * update
+    return {"ParamOut": p + update, "AvgSquaredGradOut": g2,
+            "AvgSquaredUpdateOut": u2}
+
+
+@register("adamax")
+def _adamax(ctx, ins, attrs):
+    p, g, lr = x(ins, "Param"), x(ins, "Grad"), x(ins, "LearningRate")
+    mom, inf_norm, b1p = x(ins, "Moment"), x(ins, "InfNorm"), x(ins, "Beta1Pow")
+    beta1 = attrs.get("beta1", 0.9)
+    beta2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    mom_out = beta1 * mom + (1 - beta1) * g
+    inf_out = jnp.maximum(beta2 * inf_norm, jnp.abs(g))
+    lr_t = lr / (1 - b1p)
+    p_out = p - lr_t.astype(p.dtype) * mom_out / (inf_out + eps)
+    # beta1_pow advances each step (the reference does this in
+    # AdamaxOptimizer._finish_update, optimizer.py)
+    return {"ParamOut": p_out, "MomentOut": mom_out, "InfNormOut": inf_out,
+            "Beta1PowOut": b1p * beta1}
+
+
+@register("ftrl")
+def _ftrl(ctx, ins, attrs):
+    p, g, lr = x(ins, "Param"), x(ins, "Grad"), x(ins, "LearningRate")
+    sq, lin = x(ins, "SquaredAccumulator"), x(ins, "LinearAccumulator")
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    new_sq = sq + g * g
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    lin_out = lin + g - sigma * p
+    if lr_power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pre = jnp.clip(lin_out, -l1, l1) - lin_out
+    p_out = pre / denom
+    return {"ParamOut": p_out, "SquaredAccumOut": new_sq,
+            "LinearAccumOut": lin_out}
+
+
+@register("dpsgd")
+def _dpsgd(ctx, ins, attrs):
+    """Differentially-private SGD (ref: optimizers/dpsgd_op.h): clip grad
+    to `clip` L2-norm, add gaussian noise sigma*clip/batch_size."""
+    import jax
+    p, g, lr = x(ins, "Param"), x(ins, "Grad"), x(ins, "LearningRate")
+    clip = attrs.get("clip", 10.0)
+    batch_size = attrs.get("batch_size", 16.0)
+    sigma = attrs.get("sigma", 1.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    scale = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+    noise = jax.random.normal(ctx.next_key(), g.shape, g.dtype) * \
+        (sigma * clip / batch_size)
+    return {"ParamOut": p - lr.astype(p.dtype) * (g * scale + noise)}
+
+
+# ---------------------------------------------------------------------------
+# AMP loss-scaling support ops (ref: operators/amp/)
+# ---------------------------------------------------------------------------
+
+
+@register("check_finite_and_unscale")
+def _check_finite_and_unscale(ctx, ins, attrs):
+    xs = ins["X"]
+    scale = x(ins, "Scale")
+    finite = jnp.array(True)
+    outs = []
+    for g in xs:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+        outs.append(g / scale.astype(g.dtype))
+    found_inf = jnp.logical_not(finite)
+    outs = [jnp.where(found_inf, jnp.zeros_like(g), g) for g in outs]
+    return {"Out": outs, "FoundInfinite": found_inf}
+
+
+@register("amp_check_finite_and_scale")
+def _amp_check_finite_and_scale(ctx, ins, attrs):
+    return _check_finite_and_unscale(ctx, ins, attrs)
+
+
+@register("update_loss_scaling")
+def _update_loss_scaling(ctx, ins, attrs):
+    """ref: operators/amp/update_loss_scaling_op.h — dynamic loss scale."""
+    found_inf = x(ins, "FoundInfinite")
+    scale = x(ins, "PrevLossScaling")
+    good = x(ins, "InGoodSteps")
+    bad = x(ins, "InBadSteps")
+    incr_every = attrs.get("incr_every_n_steps", 1000)
+    decr_every = attrs.get("decr_every_n_nan_or_inf", 2)
+    incr_ratio = attrs.get("incr_ratio", 2.0)
+    decr_ratio = attrs.get("decr_ratio", 0.5)
+    good_new = jnp.where(found_inf, 0, good + 1)
+    bad_new = jnp.where(found_inf, bad + 1, 0)
+    scale_up = good_new >= incr_every
+    scale_down = bad_new >= decr_every
+    new_scale = jnp.where(scale_up, scale * incr_ratio,
+                          jnp.where(scale_down,
+                                    jnp.maximum(scale * decr_ratio, 1.0), scale))
+    good_new = jnp.where(scale_up, 0, good_new)
+    bad_new = jnp.where(scale_down, 0, bad_new)
+    outs = [jnp.where(found_inf, jnp.zeros_like(g), g) for g in ins.get("X", [])]
+    return {"Out": outs, "LossScaling": new_scale,
+            "OutGoodSteps": good_new.astype(jnp.int32),
+            "OutBadSteps": bad_new.astype(jnp.int32)}

@@ -1,0 +1,71 @@
+"""JAX op registry — the analog of the reference's OpRegistry + kernel
+dispatch (ref: framework/op_registry.h:223, operator.cc:1032 ChooseKernel).
+
+In the reference every op carries per-(dtype, place, layout) kernels picked
+at runtime.  Here there is exactly one implementation per op — a pure JAX
+function — because XLA owns dtype/layout/device specialisation.  An op impl
+has signature::
+
+    fn(ctx, ins, attrs) -> {slot: array | [arrays]}
+
+where ``ins`` maps input slot names → lists of jax arrays (the reference's
+slot convention: "X", "Y", "Out", ...) and ``ctx`` provides PRNG-key
+threading and lowering-time info (mesh, train/eval).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+
+OPS: Dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        if name in OPS:
+            raise ValueError(f"op {name!r} registered twice")
+        OPS[name] = fn
+        return fn
+    return deco
+
+
+def get_op(name: str) -> Callable:
+    try:
+        return OPS[name]
+    except KeyError:
+        raise NotImplementedError(
+            f"op {name!r} has no JAX implementation registered "
+            f"({len(OPS)} ops available)") from None
+
+
+def has_op(name: str) -> bool:
+    return name in OPS
+
+
+class LoweringContext:
+    """Threaded through one block lowering.
+
+    Carries the PRNG key (functional analog of the per-device curand states
+    the reference's dropout/random ops use) plus mesh/axis info for
+    collective ops lowered under shard_map.
+    """
+
+    def __init__(self, key, mesh=None, axis_names=(), is_test=False):
+        self.key = key
+        self.mesh = mesh
+        self.axis_names = tuple(axis_names)
+        self.is_test = is_test
+
+    def next_key(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+def x(ins, slot, i=0):
+    """Fetch input ``slot[i]``, or None if absent/empty."""
+    v = ins.get(slot)
+    if not v:
+        return None
+    return v[i]

@@ -1,0 +1,426 @@
+"""Tensor manipulation + initialisation ops (ref: operators/fill_constant_op.cc,
+gaussian_random_op.cc, reshape_op.cc, transpose_op.cc, concat_op.cc, ...)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+from ..framework.core import convert_dtype
+
+
+def _np_dtype(attrs, default="float32"):
+    return np.dtype(convert_dtype(attrs.get("dtype", default))) \
+        if convert_dtype(attrs.get("dtype", default)) != "bfloat16" else jnp.bfloat16
+
+
+# ---------------------------------------------------------------------------
+# initialisation / constants
+# ---------------------------------------------------------------------------
+
+
+@register("fill_constant")
+def _fill_constant(ctx, ins, attrs):
+    shape = attrs.get("shape", [1])
+    value = attrs.get("value", 0.0)
+    return {"Out": jnp.full(shape, value, dtype=_np_dtype(attrs))}
+
+
+@register("fill_constant_batch_size_like")
+def _fill_constant_bsl(ctx, ins, attrs):
+    ref = x(ins, "Input")
+    shape = list(attrs.get("shape", [1]))
+    in_dim = attrs.get("input_dim_idx", 0)
+    out_dim = attrs.get("output_dim_idx", 0)
+    shape[out_dim] = ref.shape[in_dim]
+    return {"Out": jnp.full(shape, attrs.get("value", 0.0), dtype=_np_dtype(attrs))}
+
+
+@register("fill_zeros_like")
+def _fill_zeros_like(ctx, ins, attrs):
+    return {"Out": jnp.zeros_like(x(ins, "X"))}
+
+
+@register("fill_any_like")
+def _fill_any_like(ctx, ins, attrs):
+    a = x(ins, "X")
+    dt = attrs.get("dtype")
+    dtype = a.dtype if dt in (None, -1) else convert_dtype(dt)
+    return {"Out": jnp.full(a.shape, attrs.get("value", 0.0), dtype=dtype)}
+
+
+@register("gaussian_random")
+def _gaussian_random(ctx, ins, attrs):
+    shape = attrs.get("shape", [1])
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    out = jax.random.normal(ctx.next_key(), shape) * std + mean
+    return {"Out": out.astype(_np_dtype(attrs))}
+
+
+@register("uniform_random")
+def _uniform_random(ctx, ins, attrs):
+    shape = attrs.get("shape", [1])
+    lo = attrs.get("min", -1.0)
+    hi = attrs.get("max", 1.0)
+    out = jax.random.uniform(ctx.next_key(), shape, minval=lo, maxval=hi)
+    return {"Out": out.astype(_np_dtype(attrs))}
+
+
+@register("truncated_gaussian_random")
+def _truncated_gaussian_random(ctx, ins, attrs):
+    shape = attrs.get("shape", [1])
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    out = jax.random.truncated_normal(ctx.next_key(), -2.0, 2.0, shape) * std + mean
+    return {"Out": out.astype(_np_dtype(attrs))}
+
+
+@register("randint")
+def _randint(ctx, ins, attrs):
+    shape = attrs.get("shape", [1])
+    out = jax.random.randint(ctx.next_key(), shape, attrs.get("low", 0),
+                             attrs.get("high", 100))
+    return {"Out": out.astype(_np_dtype(attrs, "int64"))}
+
+
+@register("assign")
+def _assign(ctx, ins, attrs):
+    return {"Out": x(ins, "X")}
+
+
+@register("assign_value")
+def _assign_value(ctx, ins, attrs):
+    shape = attrs.get("shape")
+    dtype = _np_dtype(attrs)
+    values = attrs.get("values", attrs.get("fp32_values") or attrs.get("int32_values"))
+    return {"Out": jnp.asarray(np.asarray(values).reshape(shape), dtype=dtype)}
+
+
+@register("range")
+def _range(ctx, ins, attrs):
+    start, end, step = x(ins, "Start"), x(ins, "End"), x(ins, "Step")
+    if start is None:
+        start = attrs.get("start", 0)
+        end = attrs.get("end")
+        step = attrs.get("step", 1)
+        return {"Out": jnp.arange(start, end, step, dtype=_np_dtype(attrs))}
+    # dynamic range is shape-dynamic; only static python scalars supported
+    raise NotImplementedError(
+        "range with tensor start/end is data-dependent-shape; pass python "
+        "scalars (XLA requires static shapes)")
+
+
+@register("eye")
+def _eye(ctx, ins, attrs):
+    return {"Out": jnp.eye(attrs["num_rows"],
+                           attrs.get("num_columns", attrs["num_rows"]),
+                           dtype=_np_dtype(attrs))}
+
+
+@register("linspace")
+def _linspace(ctx, ins, attrs):
+    start, stop, num = x(ins, "Start"), x(ins, "Stop"), x(ins, "Num")
+    raise NotImplementedError("use python scalars via layers.linspace")
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+
+def _resolve_shape(shape, a):
+    """Handle 0 (copy input dim) and -1 (infer) entries (ref: reshape_op.cc)."""
+    shape = list(shape)
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = a.shape[i]
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape[shape.index(-1)] = int(np.prod(a.shape) // known)
+    return shape
+
+
+@register("reshape")
+def _reshape(ctx, ins, attrs):
+    a = x(ins, "X")
+    return {"Out": a.reshape(_resolve_shape(attrs["shape"], a))}
+
+
+@register("reshape2")
+def _reshape2(ctx, ins, attrs):
+    a = x(ins, "X")
+    out = a.reshape(_resolve_shape(attrs["shape"], a))
+    return {"Out": out, "XShape": jnp.zeros((0,) + a.shape, a.dtype)}
+
+
+@register("transpose")
+def _transpose(ctx, ins, attrs):
+    a = x(ins, "X")
+    return {"Out": jnp.transpose(a, attrs["axis"])}
+
+
+@register("transpose2")
+def _transpose2(ctx, ins, attrs):
+    a = x(ins, "X")
+    return {"Out": jnp.transpose(a, attrs["axis"]),
+            "XShape": jnp.zeros((0,) + a.shape, a.dtype)}
+
+
+@register("flatten")
+def _flatten(ctx, ins, attrs):
+    a = x(ins, "X")
+    ax = attrs.get("axis", 1)
+    lead = int(np.prod(a.shape[:ax])) if ax > 0 else 1
+    return {"Out": a.reshape(lead, -1)}
+
+
+@register("flatten2")
+def _flatten2(ctx, ins, attrs):
+    out = _flatten(ctx, ins, attrs)["Out"]
+    a = x(ins, "X")
+    return {"Out": out, "XShape": jnp.zeros((0,) + a.shape, a.dtype)}
+
+
+@register("flatten_contiguous_range")
+def _flatten_range(ctx, ins, attrs):
+    a = x(ins, "X")
+    start = attrs.get("start_axis", 1) % a.ndim
+    stop = attrs.get("stop_axis", -1) % a.ndim
+    shape = a.shape[:start] + (-1,) + a.shape[stop + 1:]
+    return {"Out": a.reshape(shape)}
+
+
+@register("squeeze")
+def _squeeze(ctx, ins, attrs):
+    a = x(ins, "X")
+    axes = attrs.get("axes", [])
+    if not axes:
+        return {"Out": jnp.squeeze(a)}
+    return {"Out": jnp.squeeze(a, axis=tuple(ax % a.ndim for ax in axes))}
+
+
+@register("squeeze2")
+def _squeeze2(ctx, ins, attrs):
+    a = x(ins, "X")
+    out = _squeeze(ctx, ins, attrs)["Out"]
+    return {"Out": out, "XShape": jnp.zeros((0,) + a.shape, a.dtype)}
+
+
+@register("unsqueeze")
+def _unsqueeze(ctx, ins, attrs):
+    a = x(ins, "X")
+    for ax in sorted(attrs["axes"]):
+        a = jnp.expand_dims(a, ax)
+    return {"Out": a}
+
+
+@register("unsqueeze2")
+def _unsqueeze2(ctx, ins, attrs):
+    orig = x(ins, "X")
+    out = _unsqueeze(ctx, ins, attrs)["Out"]
+    return {"Out": out, "XShape": jnp.zeros((0,) + orig.shape, orig.dtype)}
+
+
+@register("concat")
+def _concat(ctx, ins, attrs):
+    xs = ins["X"]
+    axis = attrs.get("axis", 0)
+    return {"Out": jnp.concatenate(xs, axis=axis)}
+
+
+@register("split")
+def _split(ctx, ins, attrs):
+    a = x(ins, "X")
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(a, idx, axis=axis)
+    else:
+        outs = jnp.split(a, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register("stack")
+def _stack(ctx, ins, attrs):
+    return {"Y": jnp.stack(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register("unstack")
+def _unstack(ctx, ins, attrs):
+    a = x(ins, "X")
+    axis = attrs.get("axis", 0)
+    n = a.shape[axis]
+    return {"Y": [jnp.squeeze(s, axis) for s in jnp.split(a, n, axis=axis)]}
+
+
+@register("slice")
+def _slice(ctx, ins, attrs):
+    a = x(ins, "Input")
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * a.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        dim = a.shape[ax]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[ax] = slice(s, e)
+    out = a[tuple(idx)]
+    for ax in sorted(attrs.get("decrease_axis", []), reverse=True):
+        out = jnp.squeeze(out, axis=ax)
+    return {"Out": out}
+
+
+@register("strided_slice")
+def _strided_slice(ctx, ins, attrs):
+    a = x(ins, "Input")
+    idx = [slice(None)] * a.ndim
+    for ax, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                            attrs["strides"]):
+        idx[ax] = slice(s, e, st)
+    return {"Out": a[tuple(idx)]}
+
+
+@register("expand")
+def _expand(ctx, ins, attrs):
+    a = x(ins, "X")
+    times = attrs["expand_times"]
+    return {"Out": jnp.tile(a, times)}
+
+
+@register("expand_as")
+def _expand_as(ctx, ins, attrs):
+    a, target = x(ins, "X"), x(ins, "target_tensor")
+    times = [t // s for t, s in zip(target.shape, a.shape)]
+    return {"Out": jnp.tile(a, times)}
+
+
+@register("expand_v2")
+def _expand_v2(ctx, ins, attrs):
+    a = x(ins, "X")
+    shape = list(attrs["shape"])
+    for i, s in enumerate(shape):
+        if s == -1:
+            shape[i] = a.shape[i - len(shape) + a.ndim]
+    return {"Out": jnp.broadcast_to(a, shape)}
+
+
+@register("tile")
+def _tile(ctx, ins, attrs):
+    return {"Out": jnp.tile(x(ins, "X"), attrs["repeat_times"])}
+
+
+@register("cast")
+def _cast(ctx, ins, attrs):
+    dtype = convert_dtype(attrs.get("out_dtype", attrs.get("dtype", "float32")))
+    return {"Out": x(ins, "X").astype(dtype)}
+
+
+@register("shape")
+def _shape(ctx, ins, attrs):
+    a = x(ins, "Input")
+    return {"Out": jnp.array(a.shape, dtype=jnp.int32)}
+
+
+@register("gather")
+def _gather(ctx, ins, attrs):
+    a, idx = x(ins, "X"), x(ins, "Index")
+    axis = attrs.get("axis", 0)
+    return {"Out": jnp.take(a, idx.reshape(-1).astype(jnp.int32), axis=axis)}
+
+
+@register("gather_nd")
+def _gather_nd(ctx, ins, attrs):
+    a, idx = x(ins, "X"), x(ins, "Index")
+    idx = idx.astype(jnp.int32)
+    out = a[tuple(jnp.moveaxis(idx, -1, 0))]
+    return {"Out": out}
+
+
+@register("scatter")
+def _scatter(ctx, ins, attrs):
+    a, idx, upd = x(ins, "X"), x(ins, "Ids"), x(ins, "Updates")
+    idx = idx.reshape(-1).astype(jnp.int32)
+    if attrs.get("overwrite", True):
+        out = a.at[idx].set(upd)
+    else:
+        out = a.at[idx].add(upd)
+    return {"Out": out}
+
+
+@register("scatter_nd_add")
+def _scatter_nd_add(ctx, ins, attrs):
+    a, idx, upd = x(ins, "X"), x(ins, "Index"), x(ins, "Updates")
+    return {"Out": a.at[tuple(jnp.moveaxis(idx.astype(jnp.int32), -1, 0))].add(upd)}
+
+
+@register("index_select")
+def _index_select(ctx, ins, attrs):
+    a, idx = x(ins, "X"), x(ins, "Index")
+    return {"Out": jnp.take(a, idx.astype(jnp.int32), axis=attrs.get("dim", 0))}
+
+
+@register("where")
+def _where(ctx, ins, attrs):
+    return {"Out": jnp.where(x(ins, "Condition"), x(ins, "X"), x(ins, "Y"))}
+
+
+@register("where_index")
+def _where_index(ctx, ins, attrs):
+    raise NotImplementedError(
+        "where_index produces a data-dependent shape; use masking "
+        "(XLA requires static shapes)")
+
+
+@register("tril_triu")
+def _tril_triu(ctx, ins, attrs):
+    a = x(ins, "X")
+    diag = attrs.get("diagonal", 0)
+    if attrs.get("lower", True):
+        return {"Out": jnp.tril(a, diag)}
+    return {"Out": jnp.triu(a, diag)}
+
+
+@register("roll")
+def _roll(ctx, ins, attrs):
+    a = x(ins, "X")
+    shifts = attrs["shifts"]
+    axis = attrs.get("axis", None)
+    return {"Out": jnp.roll(a, shifts, axis=tuple(axis) if axis else None)}
+
+
+@register("flip")
+def _flip(ctx, ins, attrs):
+    return {"Out": jnp.flip(x(ins, "X"), axis=tuple(attrs["axis"]))}
+
+
+@register("increment")
+def _increment(ctx, ins, attrs):
+    return {"Out": x(ins, "X") + attrs.get("step", 1.0)}
+
+
+@register("share_data")
+def _share_data(ctx, ins, attrs):
+    return {"Out": x(ins, "X")}
+
+
+@register("memcpy")
+def _memcpy(ctx, ins, attrs):
+    # device placement is XLA's job; pass through
+    return {"Out": x(ins, "X")}
+
+
+@register("print")
+def _print(ctx, ins, attrs):
+    a = x(ins, "In")
+    jax.debug.print("{msg}: {v}", msg=attrs.get("message", ""), v=a)
+    return {"Out": a}

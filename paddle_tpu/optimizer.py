@@ -1,0 +1,620 @@
+"""Optimizers (ref: python/paddle/fluid/optimizer.py — Optimizer base :56,
+SGD:914, Momentum:1008, LarsMomentum:1558, Adagrad:1672, Adam:1788,
+Adamax:2054, DecayedAdagrad:2321, Adadelta:2431, RMSProp:2550, Ftrl:2738,
+Lamb:2897, plus wrapper optimizers RecomputeOptimizer:4479 and
+GradientMergeOptimizer:4949 in incubate/).
+
+Same architecture as the reference: ``minimize = append_backward +
+apply_gradients``; accumulators are persistable vars initialised in the
+startup program; each parameter gets one optimizer *op* appended to the main
+program.  XLA fuses the whole per-param update chain (the hand-built
+fuse_optimizer_ops_pass of the reference comes for free)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .framework.core import (Parameter, Variable, default_main_program,
+                             default_startup_program, grad_var_name)
+from .framework import unique_name
+from .framework.backward import append_backward
+from .framework.layer_helper import LayerHelper
+from .framework.initializer import ConstantInitializer
+from .layers import math_ops
+from .regularizer import append_regularization_ops
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, grad_clip=None,
+                 name=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self._lr_var: Optional[Variable] = None
+        self.type = getattr(self, "type", "sgd")
+
+    # -- learning rate ---------------------------------------------------
+    def _create_global_learning_rate(self):
+        if self._lr_var is not None:
+            return
+        from .lr_scheduler import LRScheduler
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return
+        if isinstance(self._learning_rate, LRScheduler):
+            self._lr_var = self._learning_rate._create_ops()
+            return
+        name = unique_name.generate("learning_rate")
+        main = default_main_program().global_block()
+        startup = default_startup_program().global_block()
+        self._lr_var = main.create_var(name=name, shape=(1,),
+                                       dtype="float32", persistable=True)
+        sv = startup.create_var(name=name, shape=(1,), dtype="float32",
+                                persistable=True)
+        startup.append_op(type="fill_constant", outputs={"Out": [sv]},
+                          attrs={"shape": [1], "dtype": "float32",
+                                 "value": float(self._learning_rate)})
+
+    @property
+    def learning_rate_var(self):
+        return self._lr_var
+
+    def _param_lr(self, param):
+        """Per-parameter LR multiplier (ref: optimizer.py _create_param_lr —
+        ParamAttr(learning_rate=...) scales the global LR)."""
+        mult = getattr(param, "optimize_attrs", {}).get("learning_rate", 1.0)
+        if mult == 1.0:
+            return self._lr_var
+        block = default_main_program().global_block()
+        scaled = block.create_var(
+            name=unique_name.generate(f"{param.name}_lr"),
+            shape=(1,), dtype="float32")
+        block.append_op(type="scale", inputs={"X": [self._lr_var]},
+                        outputs={"Out": [scaled]},
+                        attrs={"scale": float(mult)})
+        return scaled
+
+    # -- accumulators (ref: optimizer.py _add_accumulator) ---------------
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None,
+                         dtype=None):
+        if name not in self._accumulators:
+            self._accumulators[name] = {}
+        if param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        var_name = unique_name.generate(f"{param.name}_{name}")
+        shape = list(shape if shape is not None else param.shape)
+        dtype = dtype or param.dtype
+        main = default_main_program().global_block()
+        startup = default_startup_program().global_block()
+        v = main.create_var(name=var_name, shape=shape, dtype=dtype,
+                            persistable=True)
+        sv = startup.create_var(name=var_name, shape=shape, dtype=dtype,
+                                persistable=True)
+        startup.append_op(type="fill_constant", outputs={"Out": [sv]},
+                          attrs={"shape": shape, "dtype": dtype,
+                                 "value": float(fill_value)})
+        self._accumulators[name][param.name] = v
+        return v
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- to be overridden ------------------------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    # -- main entry points (ref: optimizer.py minimize/apply_gradients) --
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None, checkpoints=None):
+        return append_backward(loss, parameter_list, no_grad_set,
+                               checkpoints=checkpoints)
+
+    def apply_gradients(self, params_grads):
+        block = default_main_program().global_block()
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        grad_clip = self._grad_clip
+        if grad_clip is None:
+            from .clip import get_gradient_clip
+            grad_clip = get_gradient_clip()
+        if grad_clip is not None:
+            params_grads = grad_clip(params_grads)
+        self._create_global_learning_rate()
+        self._create_accumulators(block, [p for p, _ in params_grads])
+        opt_ops = []
+        for pg in params_grads:
+            opt_ops.append(self._append_optimize_op(block, pg))
+        return opt_ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        opt_ops = self.apply_gradients(params_grads)
+        return opt_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    type = "sgd"
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            type="sgd",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p]})
+
+
+class MomentumOptimizer(Optimizer):
+    type = "momentum"
+
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False,
+                 regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            type="momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                    "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p], "VelocityOut": [v]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class LarsMomentumOptimizer(Optimizer):
+    type = "lars_momentum"
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, epsilon=0, regularization=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            type="lars_momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                    "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p], "VelocityOut": [v]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay,
+                   "epsilon": self._epsilon})
+
+
+class AdamOptimizer(Optimizer):
+    type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, grad_clip=None,
+                 lazy_mode=False, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        return block.append_op(
+            type=self.type,
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._param_lr(p)],
+                    "Moment1": [m1], "Moment2": [m2],
+                    "Beta1Pow": [b1p], "Beta2Pow": [b2p]},
+            outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs=self._op_attrs())
+
+    def _op_attrs(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon}
+
+
+class AdamWOptimizer(AdamOptimizer):
+    type = "adamw"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kw)
+        self._coeff = weight_decay
+
+    def _op_attrs(self):
+        attrs = super()._op_attrs()
+        attrs["coeff"] = self._coeff
+        return attrs
+
+
+class LambOptimizer(AdamOptimizer):
+    type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, regularization=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon,
+                         regularization=regularization, grad_clip=grad_clip,
+                         name=name)
+        self._weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        wd = self._weight_decay
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        return block.append_op(
+            type="lamb",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._param_lr(p)],
+                    "Moment1": [m1], "Moment2": [m2],
+                    "Beta1Pow": [b1p], "Beta2Pow": [b2p]},
+            outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "weight_decay": wd})
+
+
+class AdagradOptimizer(Optimizer):
+    type = "adagrad"
+
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0,
+                 regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            type="adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs={"epsilon": self._epsilon})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    type = "decayed_adagrad"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            type="decayed_adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon})
+
+
+class RMSPropOptimizer(Optimizer):
+    type = "rmsprop"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, regularization=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+            self._add_accumulator("momentum", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            type="rmsprop",
+            inputs={"Param": [p], "Grad": [g],
+                    "MeanSquare": [self._get_accumulator("mean_square", p)],
+                    "MeanGrad": [self._get_accumulator("mean_grad", p)],
+                    "Moment": [self._get_accumulator("momentum", p)],
+                    "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p],
+                     "MeanSquareOut": [self._get_accumulator("mean_square", p)],
+                     "MeanGradOut": [self._get_accumulator("mean_grad", p)],
+                     "MomentOut": [self._get_accumulator("momentum", p)]},
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered})
+
+
+class AdadeltaOptimizer(Optimizer):
+    type = "adadelta"
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95,
+                 regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            type="adadelta",
+            inputs={"Param": [p], "Grad": [g],
+                    "AvgSquaredGrad": [self._get_accumulator("avg_squared_grad", p)],
+                    "AvgSquaredUpdate": [self._get_accumulator("avg_squared_update", p)]},
+            outputs={"ParamOut": [p],
+                     "AvgSquaredGradOut": [self._get_accumulator("avg_squared_grad", p)],
+                     "AvgSquaredUpdateOut": [self._get_accumulator("avg_squared_update", p)]},
+            attrs={"rho": self._rho, "epsilon": self._epsilon})
+
+
+class AdamaxOptimizer(Optimizer):
+    type = "adamax"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            type="adamax",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._param_lr(p)],
+                    "Moment": [self._get_accumulator("moment", p)],
+                    "InfNorm": [self._get_accumulator("inf_norm", p)],
+                    "Beta1Pow": [self._get_accumulator("beta1_pow_acc", p)]},
+            outputs={"ParamOut": [p],
+                     "MomentOut": [self._get_accumulator("moment", p)],
+                     "InfNormOut": [self._get_accumulator("inf_norm", p)],
+                     "Beta1PowOut": [self._get_accumulator("beta1_pow_acc", p)]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+
+
+class FtrlOptimizer(Optimizer):
+    type = "ftrl"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            type="ftrl",
+            inputs={"Param": [p], "Grad": [g],
+                    "SquaredAccumulator": [self._get_accumulator("squared", p)],
+                    "LinearAccumulator": [self._get_accumulator("linear", p)],
+                    "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p],
+                     "SquaredAccumOut": [self._get_accumulator("squared", p)],
+                     "LinearAccumOut": [self._get_accumulator("linear", p)]},
+            attrs={"l1": self._l1, "l2": self._l2,
+                   "lr_power": self._lr_power})
+
+
+class DpsgdOptimizer(Optimizer):
+    type = "dpsgd"
+
+    def __init__(self, learning_rate, clip=10.0, batch_size=16.0, sigma=1.0,
+                 name=None):
+        super().__init__(learning_rate, name=name)
+        self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            type="dpsgd",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._param_lr(p)]},
+            outputs={"ParamOut": [p]},
+            attrs={"clip": self._clip, "batch_size": self._batch_size,
+                   "sigma": self._sigma})
+
+
+class RecomputeOptimizer(Optimizer):
+    """Activation recomputation wrapper (ref: optimizer.py:4479).
+
+    ``checkpoints`` mark segment boundaries; the executor lowers segments
+    with ``jax.checkpoint`` (executor._segment_at_checkpoints)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self._optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set,
+            checkpoints=self._checkpoints)
+        opt_ops = self._optimizer.apply_gradients(params_grads)
+        return opt_ops, params_grads
+
+
+class GradientMergeOptimizer(Optimizer):
+    """Gradient accumulation over k micro-steps (ref: optimizer.py:4949).
+
+    Accumulates grads into persistable buffers and applies the inner
+    optimizer every ``k_steps`` runs, gated by lax.cond-free arithmetic
+    (the update is multiplied by a 0/1 apply-mask, keeping the step a single
+    static XLA program)."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self._inner = inner_optimizer
+        self.k_steps = k_steps
+        self.avg = avg
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .layers import tensor_ops
+        main = default_main_program().global_block()
+        startup = default_startup_program().global_block()
+        params_grads = self._inner.backward(loss, startup_program,
+                                            parameter_list, no_grad_set)
+        # step counter
+        step_name = unique_name.generate("grad_merge_step")
+        step = main.create_var(name=step_name, shape=(1,), dtype="float32",
+                               persistable=True)
+        sstep = startup.create_var(name=step_name, shape=(1,),
+                                   dtype="float32", persistable=True)
+        startup.append_op(type="fill_constant", outputs={"Out": [sstep]},
+                          attrs={"shape": [1], "dtype": "float32",
+                                 "value": 0.0})
+        main.append_op(type="increment", inputs={"X": [step]},
+                       outputs={"Out": [step]}, attrs={"step": 1.0})
+        # apply_mask = (step % k == 0)
+        modk = main.create_var(name=unique_name.generate("gm_modk"),
+                               shape=(1,), dtype="float32")
+        main.append_op(type="elementwise_mod", inputs={
+            "X": [step], "Y": [_const_var(main, startup, float(self.k_steps))]},
+            outputs={"Out": [modk]}, attrs={"axis": -1})
+        mask = main.create_var(name=unique_name.generate("gm_mask"),
+                               shape=(1,), dtype="bool")
+        main.append_op(type="equal", inputs={
+            "X": [modk], "Y": [_const_var(main, startup, 0.0)]},
+            outputs={"Out": [mask]})
+        maskf = main.create_var(name=unique_name.generate("gm_maskf"),
+                                shape=(1,), dtype="float32")
+        main.append_op(type="cast", inputs={"X": [mask]},
+                       outputs={"Out": [maskf]},
+                       attrs={"out_dtype": "float32"})
+
+        merged = []
+        for p, g in params_grads:
+            acc_name = unique_name.generate(f"{p.name}_gm_acc")
+            acc = main.create_var(name=acc_name, shape=p.shape, dtype=p.dtype,
+                                  persistable=True)
+            sacc = startup.create_var(name=acc_name, shape=p.shape,
+                                      dtype=p.dtype, persistable=True)
+            startup.append_op(type="fill_constant", outputs={"Out": [sacc]},
+                              attrs={"shape": list(p.shape), "dtype": p.dtype,
+                                     "value": 0.0})
+            main.append_op(type="sum", inputs={"X": [acc, g]},
+                           outputs={"Out": [acc]})
+            eff_name = unique_name.generate(f"{p.name}_gm_eff")
+            eff = main.create_var(name=eff_name, shape=p.shape, dtype=p.dtype)
+            scale = 1.0 / self.k_steps if self.avg else 1.0
+            main.append_op(type="scale", inputs={"X": [acc]},
+                           outputs={"Out": [eff]}, attrs={"scale": scale})
+            # grad used by the inner op = mask * merged (zero when skipping)
+            main.append_op(type="elementwise_mul",
+                           inputs={"X": [eff], "Y": [maskf]},
+                           outputs={"Out": [eff]}, attrs={"axis": -1})
+            merged.append((p, eff))
+            # reset acc when applied: acc *= (1 - mask)
+            inv_name = unique_name.generate("gm_inv_mask")
+            inv = main.create_var(name=inv_name, shape=(1,), dtype="float32")
+            main.append_op(type="scale", inputs={"X": [maskf]},
+                           outputs={"Out": [inv]},
+                           attrs={"scale": -1.0, "bias": 1.0})
+            main.append_op(type="elementwise_mul",
+                           inputs={"X": [acc], "Y": [inv]},
+                           outputs={"Out": [acc]}, attrs={"axis": -1})
+        # NOTE: masked-grad trick means optimizer state (e.g. momentum)
+        # decays slightly on skip steps for stateful optimizers; exact skip
+        # needs lax.cond lowering (future work).
+        opt_ops = self._inner.apply_gradients(merged)
+        return opt_ops, merged
+
+
+def _const_var(main, startup, value):
+    name = unique_name.generate("const")
+    v = main.create_var(name=name, shape=(1,), dtype="float32",
+                        persistable=True)
+    sv = startup.create_var(name=name, shape=(1,), dtype="float32",
+                            persistable=True)
+    startup.append_op(type="fill_constant", outputs={"Out": [sv]},
+                      attrs={"shape": [1], "dtype": "float32",
+                             "value": float(value)})
+    return v
+
+
+# public aliases matching the reference's exports (optimizer.py bottom)
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adamax = AdamaxOptimizer
+Adagrad = AdagradOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer
+Dpsgd = DpsgdOptimizer

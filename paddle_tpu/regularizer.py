@@ -1,0 +1,82 @@
+"""Weight-decay regularizers (ref: python/paddle/fluid/regularizer.py).
+
+Same contract: regularization appends ops that add the penalty gradient to
+each parameter's grad before the optimizer op consumes it."""
+
+from __future__ import annotations
+
+from .framework import unique_name
+from .framework.core import default_main_program
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        decay = block.create_var(name=unique_name.generate("l2_decay"),
+                                 shape=param.shape, dtype=param.dtype)
+        block.append_op(type="scale", inputs={"X": [param]},
+                        outputs={"Out": [decay]},
+                        attrs={"scale": self.coeff})
+        out = block.create_var(name=unique_name.generate("reg_grad"),
+                               shape=param.shape, dtype=param.dtype)
+        block.append_op(type="sum", inputs={"X": [grad, decay]},
+                        outputs={"Out": [out]})
+        return out
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        sign = block.create_var(name=unique_name.generate("l1_sign"),
+                                shape=param.shape, dtype=param.dtype)
+        # sign(p) = p / (|p| + eps) via ops; use clip of p*BIG for simplicity
+        absv = block.create_var(name=unique_name.generate("l1_abs"),
+                                shape=param.shape, dtype=param.dtype)
+        block.append_op(type="abs", inputs={"X": [param]},
+                        outputs={"Out": [absv]})
+        eps = block.create_var(name=unique_name.generate("l1_eps"),
+                               shape=param.shape, dtype=param.dtype)
+        block.append_op(type="scale", inputs={"X": [absv]},
+                        outputs={"Out": [eps]},
+                        attrs={"scale": 1.0, "bias": 1e-12})
+        block.append_op(type="elementwise_div",
+                        inputs={"X": [param], "Y": [eps]},
+                        outputs={"Out": [sign]}, attrs={"axis": -1})
+        decay = block.create_var(name=unique_name.generate("l1_decay"),
+                                 shape=param.shape, dtype=param.dtype)
+        block.append_op(type="scale", inputs={"X": [sign]},
+                        outputs={"Out": [decay]},
+                        attrs={"scale": self.coeff})
+        out = block.create_var(name=unique_name.generate("reg_grad"),
+                               shape=param.shape, dtype=param.dtype)
+        block.append_op(type="sum", inputs={"X": [grad, decay]},
+                        outputs={"Out": [out]})
+        return out
+
+
+def append_regularization_ops(params_grads, regularization=None):
+    """ref: regularizer.py append_regularization_ops — param-level
+    regularizer wins over the optimizer-level one."""
+    out = []
+    block = default_main_program().global_block()
+    for p, g in params_grads:
+        reg = getattr(p, "regularizer", None) or regularization
+        if reg is None:
+            out.append((p, g))
+        else:
+            out.append((p, reg(p, g, block)))
+    return out
+
+
+# aliases matching reference exports
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

@@ -1,0 +1,30 @@
+"""Test harness config: force an 8-device virtual CPU mesh so distributed
+(sharding/collective) paths are exercised without TPU hardware, per the
+reference's localhost-subprocess test strategy (SURVEY §4.4) translated to
+JAX's virtual-device equivalent."""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test gets fresh global programs + scope (the reference resets
+    Program state between unit tests the same way)."""
+    from paddle_tpu.framework.core import reset_default_programs
+    from paddle_tpu.framework.executor import global_scope
+    reset_default_programs()
+    global_scope().drop_all()
+    yield
+    reset_default_programs()
+    global_scope().drop_all()

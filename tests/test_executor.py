@@ -1,0 +1,126 @@
+"""Executor semantics tests: persistable mutation across runs, caching,
+rng threading, backward lowering (ref: test_executor_and_mul.py etc.)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import Program, program_guard
+
+
+def _build_sgd_step(lr=0.5):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2])
+        w = fluid.layers.fc(x, 1, bias_attr=False,
+                            param_attr=fluid.ParamAttr(
+                                name="w",
+                                initializer=fluid.initializer.Constant(1.0)))
+        loss = fluid.layers.mean(w)
+        fluid.optimizer.SGD(lr).minimize(loss)
+    return main, startup, loss
+
+
+def test_persistable_state_mutates_across_runs():
+    main, startup, loss = _build_sgd_step()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = np.ones((4, 2), np.float32)
+    l1, = exe.run(main, feed={"x": x}, fetch_list=[loss])
+    l2, = exe.run(main, feed={"x": x}, fetch_list=[loss])
+    # loss = mean(x @ w); sgd step reduces w, so loss strictly decreases
+    assert float(l2) < float(l1)
+
+
+def test_scope_isolation():
+    main, startup, loss = _build_sgd_step()
+    exe = fluid.Executor(fluid.CPUPlace())
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    x = np.ones((2, 2), np.float32)
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        exe.run(main, feed={"x": x}, fetch_list=[loss])
+    with fluid.scope_guard(s2):
+        exe.run(startup)
+        l_fresh, = exe.run(main, feed={"x": x}, fetch_list=[loss])
+    # fresh scope starts from initialised params again
+    assert np.isclose(float(l_fresh), 2.0)
+
+
+def test_shape_polymorphism_via_recompile():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        y = fluid.layers.fc(x, 2, bias_attr=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    o1, = exe.run(main, feed={"x": np.zeros((4, 3), np.float32)},
+                  fetch_list=[y])
+    o2, = exe.run(main, feed={"x": np.zeros((9, 3), np.float32)},
+                  fetch_list=[y])
+    assert o1.shape == (4, 2) and o2.shape == (9, 2)
+
+
+def test_dropout_rng_varies_across_steps():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[100])
+        y = fluid.layers.dropout(x, dropout_prob=0.5,
+                                 dropout_implementation="upscale_in_train")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x_in = np.ones((1, 100), np.float32)
+    o1, = exe.run(main, feed={"x": x_in}, fetch_list=[y])
+    o2, = exe.run(main, feed={"x": x_in}, fetch_list=[y])
+    assert not np.array_equal(o1, o2), "rng key must advance between runs"
+
+
+def test_gradients_wrt_input():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        x.stop_gradient = False
+        y = fluid.layers.reduce_sum(fluid.layers.square(x))
+        (gx,) = fluid.gradients(y, x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.array([[1.0, -2.0, 3.0]], np.float32)
+    g, = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2 * xv, rtol=1e-5)
+
+
+def test_backward_with_checkpoints_matches_plain():
+    def build(use_ckpt):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4])
+            h1 = fluid.layers.fc(x, 8, act="tanh",
+                                 param_attr=fluid.ParamAttr(
+                                     name="w1",
+                                     initializer=fluid.initializer.Constant(0.1)),
+                                 bias_attr=False)
+            h2 = fluid.layers.fc(h1, 8, act="tanh",
+                                 param_attr=fluid.ParamAttr(
+                                     name="w2",
+                                     initializer=fluid.initializer.Constant(0.1)),
+                                 bias_attr=False)
+            loss = fluid.layers.mean(h2)
+            opt = fluid.optimizer.SGD(0.1)
+            if use_ckpt:
+                opt = fluid.optimizer.RecomputeOptimizer(opt)
+                opt._set_checkpoints([h1])
+            opt.minimize(loss)
+        return main, startup, loss
+
+    results = []
+    for use_ckpt in (False, True):
+        main, startup, loss = build(use_ckpt)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            x = np.linspace(-1, 1, 8).reshape(2, 4).astype(np.float32)
+            for _ in range(3):
+                l, = exe.run(main, feed={"x": x}, fetch_list=[loss])
+            results.append(float(l))
+    assert np.isclose(results[0], results[1], rtol=1e-5), \
+        "recompute must not change numerics"

@@ -1,0 +1,92 @@
+"""Program IR tests (ref: test_program.py, test_variable.py,
+test_operator_desc.py in the reference's unittests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import (Program, program_guard,
+                                       default_main_program)
+
+
+def test_program_blocks_and_vars():
+    p = Program()
+    b = p.global_block()
+    v = b.create_var(name="x", shape=(2, 3), dtype="float32")
+    assert b.var("x") is v
+    assert v.shape == (2, 3)
+    assert not v.persistable
+    w = b.create_parameter(name="w", shape=(3, 4))
+    assert w.persistable and w.trainable
+    assert p.all_parameters() == [w]
+
+
+def test_program_guard_switches_globals():
+    p = Program()
+    with program_guard(p):
+        assert default_main_program() is p
+        x = fluid.layers.data("x", shape=[4])
+        assert x.block.program is p
+    assert default_main_program() is not p
+
+
+def test_clone_for_test_flips_dropout():
+    p = Program()
+    with program_guard(p, Program()):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.dropout(x, dropout_prob=0.5)
+    test_p = p.clone(for_test=True)
+    drop_ops = [op for op in test_p.global_block().ops
+                if op.type == "dropout"]
+    assert drop_ops and all(op.attrs["is_test"] for op in drop_ops)
+    # original untouched
+    assert not any(op.attrs.get("is_test")
+                   for op in p.global_block().ops if op.type == "dropout")
+
+
+def test_prune_keeps_needed_ops_only():
+    p = Program()
+    with program_guard(p, Program()):
+        x = fluid.layers.data("x", shape=[4])
+        h1 = fluid.layers.fc(x, 8)
+        h2 = fluid.layers.fc(x, 8)     # dead branch for target h1
+    pruned = p._prune([h1])
+    kept_outputs = {n for op in pruned.global_block().ops
+                    for n in op.output_names()}
+    assert h1.name in kept_outputs
+    assert h2.name not in kept_outputs
+
+
+def test_variable_operator_sugar():
+    p = Program()
+    with program_guard(p, Program()):
+        a = fluid.layers.data("a", shape=[3])
+        b = fluid.layers.data("b", shape=[3])
+        c = a + b
+        d = a * 2.0
+        assert c.shape[-1] == 3
+        assert d.shape[-1] == 3
+    types = [op.type for op in p.global_block().ops]
+    assert "elementwise_add" in types
+    assert "elementwise_mul" in types
+
+
+def test_version_bumps_invalidate_cache_key():
+    p = Program()
+    v0 = p._version
+    p.global_block().create_var(name="t", shape=(1,))
+    assert p._version > v0
+
+
+def test_fetch_parameter_value():
+    p = Program()
+    sp = Program()
+    with program_guard(p, sp):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.fc(x, 2, bias_attr=False,
+                            param_attr=fluid.ParamAttr(name="fcw"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(sp)
+    w, = exe.run(p, feed={"x": np.zeros((1, 4), np.float32)},
+                 fetch_list=["fcw"])
+    assert w.shape == (4, 2)
