@@ -1,0 +1,76 @@
+"""Headline benchmark: BERT-base pretrain throughput on one TPU chip
+(BASELINE config 3, the north-star metric).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline = measured model FLOP utilisation / 0.35 (the BASELINE.json MFU
+target), so 1.0 means the north-star efficiency target is met on-chip.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bert_flops_per_step(cfg, batch, seq, num_masks):
+    """Analytic matmul FLOPs for one fwd+bwd step (2 flops per MAC; bwd
+    costs 2x fwd for GEMMs)."""
+    d = cfg.hidden_size
+    ff = cfg.intermediate_size
+    tokens = batch * seq
+    per_layer = 2 * tokens * (d * 3 * d          # qkv proj
+                              + d * d            # attn out proj
+                              + 2 * d * ff)      # ffn
+    attn = 2 * batch * cfg.num_attention_heads * seq * seq * \
+        (d // cfg.num_attention_heads) * 2       # QK^T and PV
+    heads = 2 * (batch * num_masks) * d * cfg.vocab_size \
+        + 2 * batch * d * d
+    fwd = cfg.num_hidden_layers * (per_layer + attn) + heads
+    return 3 * fwd
+
+
+def main():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import bert
+
+    batch, seq, num_masks = 96, 128, 20
+    cfg = bert.BertConfig.base()
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        feeds, total, mlm, nsp = bert.build_pretrain_network(cfg)
+        from paddle_tpu.contrib.mixed_precision import decorate
+        opt = decorate(fluid.optimizer.Adam(1e-4), use_pure_bf16=True)
+        opt.minimize(total)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    data = bert.make_fake_batch(rng, cfg, batch_size=batch, seq_len=seq,
+                                num_masks=num_masks)
+    # warmup (compile)
+    l, = exe.run(main_prog, feed=data, fetch_list=[total])
+    assert np.isfinite(l).all()
+    steps = 20
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l, = exe.run(main_prog, feed=data, fetch_list=[total])
+    dt = (time.perf_counter() - t0) / steps
+
+    samples_per_sec = batch / dt
+    flops = bert_flops_per_step(cfg, batch, seq, num_masks)
+    peak = 197e12  # v5e bf16 peak FLOP/s (MFU basis from BASELINE)
+    mfu = flops / dt / peak
+    print(json.dumps({
+        "metric": "bert_base_pretrain_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(mfu / 0.35, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from .reader import DataLoader                      # noqa: F401
+from .dataset import Dataset, IterableDataset       # noqa: F401
+from .batch_sampler import BatchSampler, RandomSampler, SequenceSampler  # noqa: F401

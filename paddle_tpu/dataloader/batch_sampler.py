@@ -1,0 +1,64 @@
+"""Samplers (ref: python/paddle/fluid/dataloader/batch_sampler.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SequenceSampler:
+    def __init__(self, data_source):
+        self.n = len(data_source)
+
+    def __iter__(self):
+        return iter(range(self.n))
+
+    def __len__(self):
+        return self.n
+
+
+class RandomSampler:
+    def __init__(self, data_source, seed=None):
+        self.n = len(data_source)
+        self.rng = np.random.RandomState(seed)
+
+    def __iter__(self):
+        return iter(self.rng.permutation(self.n).tolist())
+
+    def __len__(self):
+        return self.n
+
+
+class BatchSampler:
+    """ref: batch_sampler.py BatchSampler — also carries the per-replica
+    sharding used for multi-host data parallelism (each host loads its own
+    1/num_replicas slice, the TPU analog of trainer_id file splits)."""
+
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False, num_replicas=1, rank=0,
+                 seed=None):
+        if sampler is None:
+            sampler = RandomSampler(dataset, seed) if shuffle \
+                else SequenceSampler(dataset)
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.num_replicas = num_replicas
+        self.rank = rank
+
+    def __iter__(self):
+        batch = []
+        for i, idx in enumerate(self.sampler):
+            if self.num_replicas > 1 and i % self.num_replicas != self.rank:
+                continue
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler) // self.num_replicas
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size

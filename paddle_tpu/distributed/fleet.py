@@ -1,0 +1,306 @@
+"""Fleet — the distributed-training API
+(ref: python/paddle/fluid/incubate/fleet/base/fleet_base.py,
+incubate/fleet/collective/__init__.py:64 Collective(Fleet), :343
+DistributedStrategy, :393 CollectiveOptimizer; and the 2.0-preview
+python/paddle/fleet with meta-optimizer composition).
+
+TPU-native mapping:
+- RoleMaker env discovery (PaddleCloudRoleMaker reading PADDLE_* env vars)
+  → TPU slice metadata via jax.distributed / jax.process_index(); a
+  UserDefinedRoleMaker equivalent still exists for tests.
+- NCCL comm init / nccl_comm_num / hierarchical_allreduce knobs → no-ops:
+  XLA owns ICI topology and collective scheduling.
+- strategy.{amp, recompute, gradient_merge, lamb, localsgd} → meta-optimizer
+  composition exactly like the reference's strategy compiler
+  (fleet/base/strategy_compiler.py), producing one rewritten program.
+- with_data_parallel graph rewrite → mesh + shard_map lowering
+  (framework/compiler.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# role makers (ref: incubate/fleet/base/role_maker.py)
+# ---------------------------------------------------------------------------
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._worker_index = 0
+        self._worker_num = 1
+
+    def worker_index(self):
+        return self._worker_index
+
+    def worker_num(self):
+        return self._worker_num
+
+    def is_first_worker(self):
+        return self._worker_index == 0
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def generate_role(self):
+        pass
+
+
+class TPURoleMaker(RoleMakerBase):
+    """Discovers pod topology from the JAX runtime (the analog of
+    PaddleCloudRoleMaker's env-var discovery, role_maker.py:480).  In a
+    multi-host pod each host is one jax process; jax.distributed is
+    initialised by the launcher (or automatically on Cloud TPU)."""
+
+    def __init__(self, coordinator_address: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None):
+        super().__init__()
+        self._coordinator = coordinator_address
+        self._num_processes = num_processes
+        self._process_id = process_id
+        self._generated = False
+
+    def generate_role(self):
+        if self._generated:
+            return
+        import jax
+        if self._coordinator:
+            jax.distributed.initialize(self._coordinator,
+                                       self._num_processes,
+                                       self._process_id)
+        self._worker_index = jax.process_index()
+        self._worker_num = jax.process_count()
+        self._generated = True
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """ref: role_maker.py:991 — fake topology for tests."""
+
+    def __init__(self, current_id=0, workers=1, **kw):
+        super().__init__()
+        self._worker_index = current_id
+        self._worker_num = workers
+
+
+PaddleCloudRoleMaker = TPURoleMaker
+
+
+# ---------------------------------------------------------------------------
+# DistributedStrategy (ref: incubate/fleet/collective/__init__.py:343 and
+# framework/distributed_strategy.proto)
+# ---------------------------------------------------------------------------
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # feature toggles (same names as the reference strategy)
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 2.0 ** 15,
+                            "use_dynamic_loss_scaling": True,
+                            "use_pure_bf16": True}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1}
+        self.lamb = False
+        self.lamb_configs = {"lamb_weight_decay": 0.01}
+        self.use_dgc = False          # N/A on ICI (bandwidth-rich); no-op
+        self.sharding = False         # ZeRO-style optimizer sharding
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1}
+        # legacy knobs kept for script compat; XLA owns these
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.fuse_all_reduce_ops = True
+        self.mesh = None              # explicit jax Mesh override
+        # execution/build strategies accepted and largely absorbed by XLA
+        self.exec_strategy = None
+        self.build_strategy = None
+
+
+# ---------------------------------------------------------------------------
+# Fleet singleton (ref: fleet_base.py Fleet)
+# ---------------------------------------------------------------------------
+
+
+class _Fleet:
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._origin_program = None
+        self._compiled_program = None
+        self._mesh = None
+
+    # -- lifecycle -------------------------------------------------------
+    def init(self, role_maker: Optional[RoleMakerBase] = None,
+             is_collective: bool = True):
+        self._role_maker = role_maker or TPURoleMaker()
+        self._role_maker.generate_role()
+        return self
+
+    def _ensure_init(self):
+        if self._role_maker is None:
+            self.init()
+
+    # -- topology --------------------------------------------------------
+    def worker_index(self):
+        self._ensure_init()
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        self._ensure_init()
+        return self._role_maker.worker_num()
+
+    def is_first_worker(self):
+        self._ensure_init()
+        return self._role_maker.is_first_worker()
+
+    def worker_endpoints(self, to_string=False):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        return ",".join(eps) if to_string else eps
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    # -- programs --------------------------------------------------------
+    @property
+    def main_program(self):
+        """The distributed-compiled program (feed to Executor.run)."""
+        return self._compiled_program or self._origin_program
+
+    @property
+    def _origin_main_program(self):
+        return self._origin_program
+
+    # -- training artifacts ---------------------------------------------
+    def save_persistables(self, executor, dirname, main_program=None):
+        from .. import io
+        io.save_persistables(executor, dirname, main_program)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None):
+        from .. import io
+        return io.save_inference_model(dirname, feeded_var_names,
+                                       target_vars, executor, main_program)
+
+    def save_checkpoint(self, executor, path, train_status,
+                        main_program=None, **kw):
+        from .. import io
+        return io.save_checkpoint(executor, path, train_status,
+                                  main_program, **kw)
+
+    def load_checkpoint(self, executor, path, trainer_id=0,
+                        main_program=None):
+        from .. import io
+        return io.load_checkpoint(executor, path, trainer_id, main_program)
+
+
+fleet = _Fleet()
+
+
+# ---------------------------------------------------------------------------
+# CollectiveOptimizer (ref: collective/__init__.py:393) via meta-optimizer
+# composition (ref: fleet/base/meta_optimizer_factory.py)
+# ---------------------------------------------------------------------------
+
+
+class CollectiveOptimizer:
+    def __init__(self, optimizer, strategy: Optional[DistributedStrategy]):
+        self._inner = optimizer
+        self._strategy = strategy or DistributedStrategy()
+
+    def _compose(self, optimizer):
+        """Apply meta-optimizers in the reference's order: LAMB swap, AMP,
+        recompute, gradient merge (strategy_compiler.py ordering)."""
+        from .. import optimizer as opt_mod
+        s = self._strategy
+        if s.lamb and not isinstance(optimizer, opt_mod.LambOptimizer):
+            optimizer = opt_mod.LambOptimizer(
+                learning_rate=optimizer._learning_rate,
+                lamb_weight_decay=s.lamb_configs.get("lamb_weight_decay",
+                                                     0.01))
+        if s.amp:
+            from ..contrib.mixed_precision import decorate
+            optimizer = decorate(
+                optimizer,
+                init_loss_scaling=s.amp_configs.get("init_loss_scaling",
+                                                    2.0 ** 15),
+                use_dynamic_loss_scaling=s.amp_configs.get(
+                    "use_dynamic_loss_scaling", True),
+                use_pure_bf16=s.amp_configs.get("use_pure_bf16", True))
+        if s.recompute:
+            rc = opt_mod.RecomputeOptimizer(optimizer)
+            rc._set_checkpoints(s.recompute_configs.get("checkpoints", []))
+            optimizer = rc
+        if s.gradient_merge:
+            optimizer = opt_mod.GradientMergeOptimizer(
+                optimizer, k_steps=s.gradient_merge_configs.get("k_steps", 1),
+                avg=s.gradient_merge_configs.get("avg", True))
+        return optimizer
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        fleet._ensure_init()
+        fleet._strategy = self._strategy
+        optimizer = self._compose(self._inner)
+        opt_ops, params_grads = optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+
+        program = loss.block.program
+        fleet._origin_program = program
+        mesh = self._strategy.mesh
+        if mesh is None:
+            import jax
+            from jax.sharding import Mesh
+            devs = jax.devices()
+            if len(devs) > 1:
+                mesh = Mesh(np.array(devs), ("dp",))
+        fleet._mesh = mesh
+        if mesh is not None and mesh.devices.size > 1:
+            from ..framework.compiler import CompiledProgram
+            fleet._compiled_program = CompiledProgram(
+                program).with_data_parallel(loss_name=loss.name, mesh=mesh)
+        else:
+            fleet._compiled_program = None
+        return opt_ops, params_grads
+
+
+def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy]
+                          = None):
+    """ref: fleet_base.py distributed_optimizer entry point."""
+    return CollectiveOptimizer(optimizer, strategy)
+
+
+fleet.distributed_optimizer = distributed_optimizer
+fleet.DistributedStrategy = DistributedStrategy
+
+
+# -- dygraph-style helpers (paddle.distributed API surface) ---------------
+
+def init_parallel_env():
+    fleet._ensure_init()
+    return fleet
+
+
+def get_world_size():
+    import jax
+    return jax.device_count()
+
+
+def get_rank():
+    import jax
+    return jax.process_index()

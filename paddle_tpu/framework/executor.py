@@ -153,6 +153,8 @@ def lower_block_with_backward(ops, env, ctx, bw_idx, fetch_names,
     loss_name = bw_op.attrs["loss_name"]
     checkpoints = bw_op.attrs.get("checkpoints") or []
     loss_scale = bw_op.attrs.get("loss_scale", 1.0)
+    # dynamic loss scaling (AMP fp16 mode): scale lives in a persistable var
+    loss_scale_var = bw_op.attrs.get("loss_scale_var")
 
     pvals = {n: env[n] for n in param_names}
     base_env = {k: v for k, v in env.items() if k not in pvals}
@@ -181,7 +183,11 @@ def lower_block_with_backward(ops, env, ctx, bw_idx, fetch_names,
                 else:
                     e = run_ops(seg, e, sub)
         loss = e[loss_name]
-        return jnp.sum(loss) * loss_scale, (e, sub.key)
+        total = jnp.sum(loss) * loss_scale
+        if loss_scale_var is not None:
+            total = total * jax.lax.stop_gradient(
+                e[loss_scale_var].reshape(()).astype(total.dtype))
+        return total, (e, sub.key)
 
     (loss_val, (env2, new_key)), grads = jax.value_and_grad(
         fwd, has_aux=True)(pvals, ctx.key)
@@ -193,14 +199,17 @@ def lower_block_with_backward(ops, env, ctx, bw_idx, fetch_names,
     return run_ops(tail_ops, env2, ctx)
 
 
-def _merge_fetch(v, name, block, ctx, batch_axis):
+def _merge_fetch(v, name, block, ctx, batch_axis, replicated_names):
     """Cross-device fetch semantics under data parallelism — the analog of
     the reference's FetchOpHandle merging per-device results
     (ref: framework/details/fetch_op_handle.cc): batch-sharded tensors are
     all-gathered back to the global batch; scalar float metrics (mean loss,
     accuracy) are averaged; scalar int counters (Correct/Total) are summed;
-    persistable vars are replicated already."""
+    replicated values (persistables, allreduced grads, optimizer-zone
+    temporaries) pass through untouched."""
     if not ctx.axis_names or batch_axis is None:
+        return v
+    if name in replicated_names:
         return v
     var = block._find_var_recursive(name)
     if var is not None and var.persistable:
@@ -212,6 +221,18 @@ def _merge_fetch(v, name, block, ctx, batch_axis):
     return jax.lax.all_gather(v, batch_axis, axis=0, tiled=True)
 
 
+def _replicated_var_names(ops, bw_idx):
+    """Vars that are replicated (not batch-sharded) under dp: param grads
+    after the inserted c_allreduce_sum, plus everything first written by
+    ops after the backward op (LR/optimizer zone)."""
+    if bw_idx is None:
+        return set()
+    out = set()
+    for op in ops[bw_idx:]:
+        out |= set(op.output_names())
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Executor
 # ---------------------------------------------------------------------------
@@ -219,8 +240,9 @@ def _merge_fetch(v, name, block, ctx, batch_axis):
 
 class _CompiledStep:
     def __init__(self, fn, state_in_names, state_out_names, feed_names,
-                 fetch_names):
-        self.fn = fn
+                 fetch_names, raw_fn=None):
+        self.fn = fn                 # jitted, donating state buffers
+        self.raw_fn = raw_fn or fn   # unjitted pure step (for export)
         self.state_in_names = state_in_names
         self.state_out_names = state_out_names
         self.feed_names = feed_names
@@ -331,6 +353,7 @@ class Executor:
         bw_idx = next((i for i, op in enumerate(ops)
                        if op.type == "backward"), None)
         is_test = program._is_test
+        replicated_names = _replicated_var_names(ops, bw_idx)
 
         def step(feed_vals, state_vals, rng_key):
             if mesh is not None and batch_axis is not None:
@@ -352,7 +375,8 @@ class Executor:
             else:
                 env = lower_block_with_backward(
                     ops, env, ctx, bw_idx, fetch_names, state_out_names)
-            fetches = [_merge_fetch(env[n], n, block, ctx, batch_axis)
+            fetches = [_merge_fetch(env[n], n, block, ctx, batch_axis,
+                                    replicated_names)
                        for n in fetch_names]
             state_out = {n: env[n] for n in state_out_names}
             return fetches, state_out, \
@@ -364,7 +388,7 @@ class Executor:
             fn = jax.jit(step, donate_argnums=(1,))
 
         compiled = _CompiledStep(fn, state_in_names, state_out_names,
-                                 feed_names, fetch_names)
+                                 feed_names, fetch_names, raw_fn=step)
         self._cache[key] = compiled
         return compiled
 

@@ -1,0 +1,63 @@
+"""Export a Program as a pure jittable function — the analog of the
+reference's save_inference_model → NaiveExecutor path
+(ref: io.py:1164, framework/naive_executor.cc), TPU-native: the artifact is
+a (pure_fn, params_pytree) pair you can jit / pjit / serialize via
+jax.export."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+from .core import Program, Variable
+from .executor import Executor, Scope, global_scope
+
+
+def program_to_fn(program: Program, example_feed: dict,
+                  fetch_list: Sequence, scope: Optional[Scope] = None,
+                  seed: int = 0):
+    """Lower ``program`` to ``fn(feed_dict, state_dict) -> [fetches]`` plus
+    the initial state pytree taken from ``scope``.
+
+    ``fn`` is pure and jittable; randomness is frozen to ``seed`` (export
+    semantics match inference / compile-checking use)."""
+    scope = scope or global_scope()
+    exe = Executor()
+    fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                   for f in fetch_list]
+    import numpy as np
+    feed = {k: np.asarray(v) for k, v in example_feed.items()}
+    step = exe._compile(program, feed, fetch_names, scope, None, (), None)
+    state = {n: scope.find_var(n) for n in step.state_in_names}
+    missing = [n for n, v in state.items() if v is None]
+    if missing:
+        raise RuntimeError(f"scope missing persistable vars {missing}; "
+                           f"run the startup program first")
+    key = jax.random.PRNGKey(seed)
+
+    def fn(feed_vals, state_vals):
+        fetches, _, _ = step.raw_fn(feed_vals, state_vals, key)
+        return fetches
+
+    return fn, state
+
+
+def program_train_step_fn(program: Program, example_feed: dict,
+                          fetch_list: Sequence,
+                          scope: Optional[Scope] = None, mesh=None,
+                          batch_axis: Optional[str] = None, seed: int = 0):
+    """Like program_to_fn but returns the full training step
+    ``fn(feed, state, key) -> (fetches, new_state, new_key)`` — state
+    threading included so the caller can drive the loop (or shard it)."""
+    scope = scope or global_scope()
+    exe = Executor()
+    fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                   for f in fetch_list]
+    import numpy as np
+    feed = {k: np.asarray(v) for k, v in example_feed.items()}
+    axis_names = tuple(mesh.axis_names) if mesh is not None else ()
+    step = exe._compile(program, feed, fetch_names, scope, mesh, axis_names,
+                        batch_axis)
+    state = {n: scope.find_var(n) for n in step.state_in_names}
+    return step.raw_fn, state

@@ -1,0 +1,203 @@
+"""Persistence: save/load persistables, inference model export, and
+fleet-style checkpoint/resume (ref: python/paddle/fluid/io.py:598
+save_persistables, :1164 save_inference_model;
+incubate/fleet/collective/__init__.py:236 save_checkpoint + TrainStatus:49).
+
+Format: one ``.npz`` with every persistable (params + optimizer
+accumulators + bn stats) — the analog of save_combine — plus a pickled
+program for inference models.  Orbax-style async sharded checkpointing can
+layer on later; the on-disk contract (dir layout, TrainStatus bookkeeping,
+auto-cleanup of stale checkpoints) matches the reference."""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+from typing import List, Optional
+
+import numpy as np
+
+from .framework.core import Program, Variable, default_main_program
+from .framework.executor import Scope, global_scope
+
+_RNG_VAR = "@RNG_STATE@"
+
+
+def _persistable_names(program: Program) -> List[str]:
+    # every persistable except the RNG key (saved separately by
+    # save_checkpoint) — LR-scheduler step counters etc. MUST be included
+    # or resumed training restarts schedules from step 0
+    return [v.name for v in program.list_vars()
+            if v.persistable and v.name != _RNG_VAR]
+
+
+def save_persistables(executor, dirname, main_program: Optional[Program] = None,
+                      filename: Optional[str] = None,
+                      scope: Optional[Scope] = None):
+    """ref: io.py:598 — saves every persistable var of the program."""
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    filename = filename or "params.npz"
+    arrays = {}
+    for name in _persistable_names(main_program):
+        v = scope.find_var(name)
+        if v is not None:
+            arrays[name] = np.asarray(v)
+    np.savez(os.path.join(dirname, filename), **arrays)
+
+
+def load_persistables(executor, dirname, main_program: Optional[Program] = None,
+                      filename: Optional[str] = None,
+                      scope: Optional[Scope] = None):
+    """ref: io.py load_persistables."""
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    filename = filename or "params.npz"
+    path = os.path.join(dirname, filename)
+    with np.load(path) as data:
+        wanted = set(_persistable_names(main_program))
+        for name in data.files:
+            if name in wanted:
+                scope.set_var(name, np.array(data[name]))
+
+
+# aliases matching the reference's finer-grained savers (params vs
+# persistables differ only by optimizer accumulators; both live in scope)
+def save_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    save_persistables(executor, dirname, main_program, filename, scope)
+
+
+def load_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    load_persistables(executor, dirname, main_program, filename, scope)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program: Optional[Program] = None,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None,
+                         scope: Optional[Scope] = None):
+    """ref: io.py:1164 — prunes the program to the inference subgraph and
+    saves program + params."""
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    pruned = main_program.clone(for_test=True)._prune(target_vars)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [v.name if isinstance(v, Variable) else str(v)
+                        for v in target_vars],
+    }
+    with open(os.path.join(dirname, model_filename or "__model__"),
+              "wb") as f:
+        pickle.dump({"program": pruned, "meta": meta}, f)
+    save_persistables(executor, dirname, pruned,
+                      params_filename or "params.npz", scope)
+    return meta["fetch_names"]
+
+
+def load_inference_model(dirname, executor,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None,
+                         scope: Optional[Scope] = None):
+    """ref: io.py:1374 — returns (program, feed_names, fetch_vars)."""
+    scope = scope or global_scope()
+    with open(os.path.join(dirname, model_filename or "__model__"),
+              "rb") as f:
+        payload = pickle.load(f)
+    program: Program = payload["program"]
+    meta = payload["meta"]
+    load_persistables(executor, dirname, program,
+                      params_filename or "params.npz", scope)
+    fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars
+
+
+# ---------------------------------------------------------------------------
+# checkpoint/resume with TrainStatus (ref: incubate/fleet/collective:49,236)
+# ---------------------------------------------------------------------------
+
+
+class TrainStatus:
+    def __init__(self, epoch_no: int = -1, step: int = 0):
+        self.epoch_no = epoch_no
+        self.step = step
+
+    def next(self):
+        return self.epoch_no + 1
+
+    def to_dict(self):
+        return {"epoch_no": self.epoch_no, "step": self.step}
+
+    @staticmethod
+    def from_dict(d):
+        return TrainStatus(d.get("epoch_no", -1), d.get("step", 0))
+
+    def __eq__(self, other):
+        return isinstance(other, TrainStatus) and \
+            self.to_dict() == other.to_dict()
+
+
+def save_checkpoint(executor, path, train_status: TrainStatus,
+                    main_program: Optional[Program] = None,
+                    scope: Optional[Scope] = None, remain_all_checkpoint=False,
+                    max_checkpoints: int = 3):
+    """Checkpoint = persistables + rng state + TrainStatus; keeps the last
+    ``max_checkpoints`` dirs (ref auto-cleanup: collective/__init__.py:206)."""
+    scope = scope or global_scope()
+    ckpt_id = train_status.epoch_no
+    d = os.path.join(path, f"checkpoint_{ckpt_id}")
+    os.makedirs(d, exist_ok=True)
+    save_persistables(executor, d, main_program, scope=scope)
+    rng = scope.find_var(_RNG_VAR)
+    if rng is not None:
+        np.save(os.path.join(d, "rng.npy"), np.asarray(rng))
+    with open(os.path.join(d, "train_status.json"), "w") as f:
+        json.dump(train_status.to_dict(), f)
+    if not remain_all_checkpoint:
+        _cleanup_stale(path, max_checkpoints)
+    return d
+
+
+def _list_checkpoints(path):
+    if not os.path.isdir(path):
+        return []
+    out = []
+    for n in os.listdir(path):
+        if n.startswith("checkpoint_"):
+            try:
+                out.append((int(n.split("_")[1]), os.path.join(path, n)))
+            except ValueError:
+                pass
+    return sorted(out)
+
+
+def _cleanup_stale(path, keep):
+    cks = _list_checkpoints(path)
+    for _, d in cks[:-keep] if keep else []:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def load_checkpoint(executor, path, trainer_id=0,
+                    main_program: Optional[Program] = None,
+                    scope: Optional[Scope] = None) -> TrainStatus:
+    """Load the newest checkpoint; returns its TrainStatus (epoch -1 when
+    none exists — cold start)."""
+    scope = scope or global_scope()
+    cks = _list_checkpoints(path)
+    if not cks:
+        return TrainStatus(-1)
+    _, d = cks[-1]
+    load_persistables(executor, d, main_program, scope=scope)
+    rng_path = os.path.join(d, "rng.npy")
+    if os.path.exists(rng_path):
+        import jax
+        raw = np.load(rng_path)
+        key = jax.numpy.asarray(raw)
+        scope.set_var(_RNG_VAR, key)
+    with open(os.path.join(d, "train_status.json")) as f:
+        return TrainStatus.from_dict(json.load(f))

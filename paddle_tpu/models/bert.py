@@ -1,0 +1,225 @@
+"""BERT pretraining model (BASELINE config 3; ref recipe: PaddleNLP BERT /
+LARK, built on the reference's transformer_encoder.py pattern).
+
+Static-graph builder: embeddings + N transformer encoder layers
+(post-layer-norm, as BERT) + masked-LM and next-sentence-prediction heads.
+Attention uses the single fused_attention op (ops/attention_ops.py) which
+dispatches to the Pallas flash kernel on TPU."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .. import layers
+from ..framework.layer_helper import ParamAttr
+from ..framework.initializer import TruncatedNormalInitializer
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    dtype: str = "float32"
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def tiny():
+        return BertConfig(vocab_size=1024, hidden_size=128,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=512, max_position_embeddings=128,
+                          type_vocab_size=2)
+
+
+def _init(cfg):
+    return TruncatedNormalInitializer(0.0, cfg.initializer_range)
+
+
+def _attr(name, cfg):
+    return ParamAttr(name=name, initializer=_init(cfg))
+
+
+def encoder_layer(x, attn_bias, cfg: BertConfig, name: str, is_test=False):
+    """Post-LN transformer layer (ref: transformer_encoder.py
+    encoder_layer with preprocess_cmd='', postprocess_cmd='dan')."""
+    d = cfg.hidden_size
+    # fused QKV projection: one (d, 3d) GEMM keeps the MXU busy (the
+    # reference's fc per q/k/v is three small GEMMs)
+    qkv = layers.fc(x, 3 * d, num_flatten_dims=2,
+                    param_attr=_attr(f"{name}_qkv_w", cfg),
+                    bias_attr=ParamAttr(name=f"{name}_qkv_b"))
+    q, k, v = layers.split(qkv, 3, dim=2)
+    ctx = fused_attention(q, k, v, attn_bias, cfg.num_attention_heads,
+                          cfg.attention_probs_dropout_prob, is_test,
+                          name=name)
+    attn_out = layers.fc(ctx, d, num_flatten_dims=2,
+                         param_attr=_attr(f"{name}_out_w", cfg),
+                         bias_attr=ParamAttr(name=f"{name}_out_b"))
+    attn_out = layers.dropout(attn_out, cfg.hidden_dropout_prob,
+                              is_test=is_test,
+                              dropout_implementation="upscale_in_train")
+    x = layers.layer_norm(x + attn_out, begin_norm_axis=2,
+                          param_attr=ParamAttr(name=f"{name}_ln1_scale"),
+                          bias_attr=ParamAttr(name=f"{name}_ln1_bias"))
+    ffn = layers.fc(x, cfg.intermediate_size, num_flatten_dims=2,
+                    act=cfg.hidden_act,
+                    param_attr=_attr(f"{name}_ffn1_w", cfg),
+                    bias_attr=ParamAttr(name=f"{name}_ffn1_b"))
+    ffn = layers.fc(ffn, d, num_flatten_dims=2,
+                    param_attr=_attr(f"{name}_ffn2_w", cfg),
+                    bias_attr=ParamAttr(name=f"{name}_ffn2_b"))
+    ffn = layers.dropout(ffn, cfg.hidden_dropout_prob, is_test=is_test,
+                         dropout_implementation="upscale_in_train")
+    return layers.layer_norm(x + ffn, begin_norm_axis=2,
+                             param_attr=ParamAttr(name=f"{name}_ln2_scale"),
+                             bias_attr=ParamAttr(name=f"{name}_ln2_bias"))
+
+
+def fused_attention(q, k, v, attn_bias, n_head, dropout_rate, is_test,
+                    name):
+    from ..framework.layer_helper import LayerHelper
+    helper = LayerHelper("fused_attention", name=f"{name}_attn")
+    out = helper.create_variable_for_type_inference(q.dtype, q.shape)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if attn_bias is not None:
+        inputs["AttnBias"] = [attn_bias]
+    helper.append_op(type="fused_attention", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"n_head": n_head, "dropout_rate": dropout_rate,
+                            "is_test": is_test})
+    return out
+
+
+def bert_encoder(src_ids, position_ids, sentence_ids, input_mask,
+                 cfg: BertConfig, is_test=False):
+    """Returns (sequence_output, next_sentence_feat)."""
+    emb = layers.embedding(src_ids, size=[cfg.vocab_size, cfg.hidden_size],
+                           dtype=cfg.dtype,
+                           param_attr=_attr("word_embedding", cfg))
+    pos = layers.embedding(position_ids,
+                           size=[cfg.max_position_embeddings,
+                                 cfg.hidden_size], dtype=cfg.dtype,
+                           param_attr=_attr("pos_embedding", cfg))
+    sent = layers.embedding(sentence_ids,
+                            size=[cfg.type_vocab_size, cfg.hidden_size],
+                            dtype=cfg.dtype,
+                            param_attr=_attr("sent_embedding", cfg))
+    emb = emb + pos + sent
+    emb = layers.layer_norm(emb, begin_norm_axis=2,
+                            param_attr=ParamAttr(name="pre_encoder_ln_scale"),
+                            bias_attr=ParamAttr(name="pre_encoder_ln_bias"))
+    emb = layers.dropout(emb, cfg.hidden_dropout_prob, is_test=is_test,
+                         dropout_implementation="upscale_in_train")
+
+    # additive attention bias from the padding mask:
+    # (B, S, 1) x (B, 1, S) -> (B, 1, S, S), 0 keep / -1e4 drop
+    # (ref recipe computes self_attn_mask = matmul(mask, mask, transpose))
+    mask_sq = layers.matmul(input_mask, input_mask, transpose_y=True)
+    attn_bias = layers.scale(mask_sq, scale=1e4, bias=-1e4)
+    attn_bias = layers.unsqueeze(attn_bias, axes=[1])
+    attn_bias.stop_gradient = True
+
+    x = emb
+    for i in range(cfg.num_hidden_layers):
+        x = encoder_layer(x, attn_bias, cfg, name=f"encoder_layer_{i}",
+                          is_test=is_test)
+
+    # pooled output: first token -> fc tanh
+    first_tok = layers.slice(x, axes=[1], starts=[0], ends=[1])
+    first_tok = layers.reshape(first_tok, [-1, cfg.hidden_size])
+    pooled = layers.fc(first_tok, cfg.hidden_size, act="tanh",
+                       param_attr=_attr("pooled_fc.w_0", cfg),
+                       bias_attr=ParamAttr(name="pooled_fc.b_0"))
+    return x, pooled
+
+
+def bert_pretrain_loss(seq_out, pooled, mask_label, mask_pos, labels,
+                       cfg: BertConfig):
+    """Masked-LM + next-sentence losses (ref recipe: BertModel pretrain
+    head).  mask_pos are flat indices into (B*S, H)."""
+    d = cfg.hidden_size
+    from ..framework.layer_helper import LayerHelper
+    gh = LayerHelper("gather_tokens")
+    mask_feat = gh.create_variable_for_type_inference(seq_out.dtype,
+                                                      (-1, d))
+    gh.append_op(type="gather_tokens",
+                 inputs={"X": [seq_out], "Index": [mask_pos]},
+                 outputs={"Out": [mask_feat]})
+    mask_trans = layers.fc(mask_feat, d, act=cfg.hidden_act,
+                           param_attr=_attr("mask_lm_trans_fc.w_0", cfg),
+                           bias_attr=ParamAttr(name="mask_lm_trans_fc.b_0"))
+    mask_trans = layers.layer_norm(
+        mask_trans, begin_norm_axis=1,
+        param_attr=ParamAttr(name="mask_lm_trans_ln_scale"),
+        bias_attr=ParamAttr(name="mask_lm_trans_ln_bias"))
+    # decode with tied word embedding (transpose) + output bias
+    word_emb = mask_trans.block.program.global_block().var("word_embedding")
+    from ..framework.layer_helper import LayerHelper
+    helper = LayerHelper("mask_lm_out")
+    bias = helper.create_parameter(
+        ParamAttr(name="mask_lm_out_fc.b_0"), [cfg.vocab_size], cfg.dtype,
+        is_bias=True)
+    logits = layers.matmul(mask_trans, word_emb, transpose_y=True)
+    logits = layers.elementwise_add(logits, bias)
+    mask_lm_loss = layers.softmax_with_cross_entropy(logits, mask_label)
+    mask_lm_loss = layers.mean(mask_lm_loss)
+
+    ns_logits = layers.fc(pooled, 2,
+                          param_attr=_attr("next_sent_fc.w_0", cfg),
+                          bias_attr=ParamAttr(name="next_sent_fc.b_0"))
+    ns_loss = layers.mean(
+        layers.softmax_with_cross_entropy(ns_logits, labels))
+    return mask_lm_loss + ns_loss, mask_lm_loss, ns_loss
+
+
+def build_pretrain_network(cfg: BertConfig, is_test=False):
+    src_ids = layers.data("src_ids", shape=[-1, -1], dtype="int64",
+                          append_batch_size=False)
+    pos_ids = layers.data("pos_ids", shape=[-1, -1], dtype="int64",
+                          append_batch_size=False)
+    sent_ids = layers.data("sent_ids", shape=[-1, -1], dtype="int64",
+                           append_batch_size=False)
+    input_mask = layers.data("input_mask", shape=[-1, -1, 1],
+                             dtype="float32", append_batch_size=False)
+    mask_label = layers.data("mask_label", shape=[-1, 1], dtype="int64",
+                             append_batch_size=False)
+    mask_pos = layers.data("mask_pos", shape=[-1, -1], dtype="int64",
+                           append_batch_size=False)
+    labels = layers.data("labels", shape=[-1, 1], dtype="int64",
+                         append_batch_size=False)
+    seq_out, pooled = bert_encoder(src_ids, pos_ids, sent_ids, input_mask,
+                                   cfg, is_test=is_test)
+    total, mlm, nsp = bert_pretrain_loss(seq_out, pooled, mask_label,
+                                         mask_pos, labels, cfg)
+    feeds = [src_ids, pos_ids, sent_ids, input_mask, mask_label, mask_pos,
+             labels]
+    return feeds, total, mlm, nsp
+
+
+def make_fake_batch(rng, cfg: BertConfig, batch_size=8, seq_len=128,
+                    num_masks=20):
+    """Synthetic pretrain batch with the feed layout above."""
+    import numpy as np
+    b, s = batch_size, seq_len
+    data = {
+        "src_ids": rng.randint(0, cfg.vocab_size, (b, s)).astype("int64"),
+        "pos_ids": np.tile(np.arange(s, dtype="int64"), (b, 1)),
+        "sent_ids": rng.randint(0, cfg.type_vocab_size, (b, s)).astype("int64"),
+        "input_mask": np.ones((b, s, 1), dtype="float32"),
+        "mask_label": rng.randint(0, cfg.vocab_size,
+                                  (b * num_masks, 1)).astype("int64"),
+        "mask_pos": rng.randint(0, s, (b, num_masks)).astype("int64"),
+        "labels": rng.randint(0, 2, (b, 1)).astype("int64"),
+    }
+    return data

@@ -1,0 +1,34 @@
+"""recognize_digits models (ref: tests/book/test_recognize_digits.py —
+BASELINE config 1)."""
+
+from __future__ import annotations
+
+from .. import layers
+from ..layers import metric_op
+
+
+def softmax_regression(img):
+    return layers.fc(img, 10, act="softmax")
+
+
+def multilayer_perceptron(img):
+    h1 = layers.fc(img, 200, act="tanh")
+    h2 = layers.fc(h1, 200, act="tanh")
+    return layers.fc(h2, 10, act="softmax")
+
+
+def convolutional_neural_network(img):
+    conv1 = layers.conv2d(img, num_filters=20, filter_size=5, act="relu")
+    pool1 = layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = layers.conv2d(pool1, num_filters=50, filter_size=5, act="relu")
+    pool2 = layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    return layers.fc(pool2, 10, act="softmax")
+
+
+def build_train_network(net_fn=convolutional_neural_network):
+    img = layers.data("img", shape=[1, 28, 28])
+    label = layers.data("label", shape=[1], dtype="int64")
+    prediction = net_fn(img)
+    loss = layers.mean(layers.cross_entropy(prediction, label))
+    acc = metric_op.accuracy(prediction, label)
+    return img, label, prediction, loss, acc

@@ -1,0 +1,102 @@
+"""ResNet for ImageNet (ref recipe: PaddleCV image_classification ResNet —
+BASELINE config 2).  Static-graph builder on the layers API; NCHW layout;
+conv+bn+relu chains fuse under XLA."""
+
+from __future__ import annotations
+
+from .. import layers
+from ..framework.layer_helper import ParamAttr
+from ..framework.initializer import MSRAInitializer
+from ..layers import metric_op
+
+_DEPTH_CFG = {
+    18: ([2, 2, 2, 2], "basic"),
+    34: ([3, 4, 6, 3], "basic"),
+    50: ([3, 4, 6, 3], "bottleneck"),
+    101: ([3, 4, 23, 3], "bottleneck"),
+    152: ([3, 8, 36, 3], "bottleneck"),
+}
+
+
+def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1,
+                  act=None, name=None, is_test=False):
+    conv = layers.conv2d(
+        input, num_filters=num_filters, filter_size=filter_size,
+        stride=stride, padding=(filter_size - 1) // 2, groups=groups,
+        act=None, bias_attr=False,
+        param_attr=ParamAttr(name=f"{name}_weights",
+                             initializer=MSRAInitializer(uniform=False)),
+        name=name)
+    return layers.batch_norm(conv, act=act, is_test=is_test,
+                             param_attr=ParamAttr(name=f"{name}_bn_scale"),
+                             bias_attr=ParamAttr(name=f"{name}_bn_offset"),
+                             moving_mean_name=f"{name}_bn_mean",
+                             moving_variance_name=f"{name}_bn_variance")
+
+
+def shortcut(input, ch_out, stride, name, is_test=False):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, name=name,
+                             is_test=is_test)
+    return input
+
+
+def basic_block(input, num_filters, stride, name, is_test=False):
+    conv0 = conv_bn_layer(input, num_filters, 3, stride, act="relu",
+                          name=name + "_branch2a", is_test=is_test)
+    conv1 = conv_bn_layer(conv0, num_filters, 3, 1, act=None,
+                          name=name + "_branch2b", is_test=is_test)
+    short = shortcut(input, num_filters, stride, name + "_branch1",
+                     is_test=is_test)
+    return layers.relu(short + conv1)
+
+
+def bottleneck_block(input, num_filters, stride, name, is_test=False):
+    conv0 = conv_bn_layer(input, num_filters, 1, act="relu",
+                          name=name + "_branch2a", is_test=is_test)
+    conv1 = conv_bn_layer(conv0, num_filters, 3, stride, act="relu",
+                          name=name + "_branch2b", is_test=is_test)
+    conv2 = conv_bn_layer(conv1, num_filters * 4, 1, act=None,
+                          name=name + "_branch2c", is_test=is_test)
+    short = shortcut(input, num_filters * 4, stride, name + "_branch1",
+                     is_test=is_test)
+    return layers.relu(short + conv2)
+
+
+def resnet(input, class_dim=1000, depth=50, is_test=False):
+    stages, block_kind = _DEPTH_CFG[depth]
+    num_filters = [64, 128, 256, 512]
+
+    conv = conv_bn_layer(input, 64, 7, stride=2, act="relu", name="conv1",
+                         is_test=is_test)
+    conv = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1,
+                         pool_type="max")
+    block_fn = bottleneck_block if block_kind == "bottleneck" else basic_block
+    for stage, count in enumerate(stages):
+        for i in range(count):
+            name = f"res{stage + 2}{chr(ord('a') + i)}"
+            conv = block_fn(conv, num_filters[stage],
+                            stride=2 if i == 0 and stage != 0 else 1,
+                            name=name, is_test=is_test)
+    pool = layers.pool2d(conv, pool_type="avg", global_pooling=True)
+    import math
+    stdv = 1.0 / math.sqrt(pool.shape[1] * 1.0)
+    from ..framework.initializer import UniformInitializer
+    return layers.fc(pool, class_dim, act=None,
+                     param_attr=ParamAttr(
+                         name="fc_0.w_0",
+                         initializer=UniformInitializer(-stdv, stdv)))
+
+
+def build_train_network(class_dim=1000, depth=50, image_shape=(3, 224, 224),
+                        is_test=False):
+    img = layers.data("image", shape=list(image_shape))
+    label = layers.data("label", shape=[1], dtype="int64")
+    logits = resnet(img, class_dim=class_dim, depth=depth, is_test=is_test)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, label))
+    softmax = layers.softmax(logits)
+    acc1 = metric_op.accuracy(softmax, label, k=1)
+    acc5 = metric_op.accuracy(softmax, label, k=5)
+    return img, label, loss, acc1, acc5

@@ -1,0 +1,70 @@
+"""Fused attention op — the analog of the reference's fused multihead
+attention kernels (ref: operators/fused/multihead_matmul_op.cu and
+math/bert_encoder_functor.cu), TPU-native.
+
+One op takes projected Q/K/V in (B, S, H*D) layout plus an additive
+attention bias and produces the context in (B, S, H*D).  Keeping the whole
+attention in a single op gives a clean seam to swap the implementation for
+the Pallas flash-attention kernel (ops/pallas/flash_attention.py) on TPU
+while the jnp composition remains the CPU/interpret fallback."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, x
+
+
+def _split_heads(t, n_head):
+    b, s, hd = t.shape
+    return t.reshape(b, s, n_head, hd // n_head).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(t):
+    b, h, s, d = t.shape
+    return t.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+
+def reference_attention(q, k, v, bias, n_head, dropout_rate, ctx,
+                        is_test):
+    """Plain jnp attention, numerically the spec for the pallas kernel."""
+    d_key = q.shape[-1] // n_head
+    qh = _split_heads(q, n_head)
+    kh = _split_heads(k, n_head)
+    vh = _split_heads(v, n_head)
+    scores = jnp.einsum("bhsd,bhtd->bhst", qh, kh,
+                        preferred_element_type=jnp.float32)
+    scores = scores * (1.0 / jnp.sqrt(d_key).astype(jnp.float32))
+    if bias is not None:
+        scores = scores + bias.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate and not is_test:
+        keep = jax.random.bernoulli(ctx.next_key(), 1.0 - dropout_rate,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    ctxv = jnp.einsum("bhst,bhtd->bhsd", probs.astype(vh.dtype), vh,
+                      preferred_element_type=jnp.float32).astype(vh.dtype)
+    return _merge_heads(ctxv)
+
+
+@register("fused_attention")
+def _fused_attention(ctx, ins, attrs):
+    q, k, v = x(ins, "Q"), x(ins, "K"), x(ins, "V")
+    bias = x(ins, "AttnBias")
+    n_head = attrs["n_head"]
+    dropout_rate = attrs.get("dropout_rate", 0.0)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    use_pallas = attrs.get("use_flash", True)
+    if use_pallas and not dropout_rate:
+        try:
+            from .pallas.flash_attention import flash_attention_bshd
+            d = q.shape[-1] // n_head
+            out = flash_attention_bshd(
+                _split_heads(q, n_head), _split_heads(k, n_head),
+                _split_heads(v, n_head), bias)
+            return {"Out": _merge_heads(out)}
+        except Exception:
+            pass  # interpret/CPU or unsupported shape: jnp fallback
+    return {"Out": reference_attention(q, k, v, bias, n_head, dropout_rate,
+                                       ctx, is_test)}

@@ -535,6 +535,17 @@ def _pad2d(ctx, ins, attrs):
     return {"Out": jnp.pad(a, cfg, mode=jmode)}
 
 
+@register("gather_tokens")
+def _gather_tokens(ctx, ins, attrs):
+    """Pick per-sample token positions: (B,S,D) x (B,M) -> (B*M, D).
+    Replaces the reference BERT recipe's flat-global-index gather so the
+    op stays correct when the batch dim is sharded over a dp mesh axis."""
+    seq = x(ins, "X")
+    pos = x(ins, "Index").astype(jnp.int32)
+    out = jnp.take_along_axis(seq, pos[..., None], axis=1)
+    return {"Out": out.reshape(-1, seq.shape[-1])}
+
+
 @register("label_smooth")
 def _label_smooth(ctx, ins, attrs):
     a = x(ins, "X")

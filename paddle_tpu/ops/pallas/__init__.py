@@ -1,0 +1,2 @@
+"""Pallas TPU kernels — the analog of the reference's hand-written fused
+CUDA kernels (operators/fused/, operators/math/bert_encoder_functor.cu)."""

@@ -136,9 +136,15 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        params_grads = self.backward(loss, startup_program, parameter_list,
-                                     no_grad_set)
-        opt_ops = self.apply_gradients(params_grads)
+        # ops must land in LOSS's program even when minimize is called
+        # outside the program_guard that built the net (ref: optimizer.py
+        # minimize wraps in program_guard(loss.block.program))
+        from .framework.core import program_guard
+        with program_guard(loss.block.program,
+                           startup_program or default_startup_program()):
+            params_grads = self.backward(loss, startup_program,
+                                         parameter_list, no_grad_set)
+            opt_ops = self.apply_gradients(params_grads)
         return opt_ops, params_grads
 
 
@@ -494,12 +500,25 @@ class RecomputeOptimizer(Optimizer):
     def __getattr__(self, item):
         return getattr(self._optimizer, item)
 
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None, checkpoints=None):
+        # wrappers stacked on top (e.g. GradientMerge) reach the inner
+        # optimizer through here; inject our checkpoints
+        return self._optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set, callbacks,
+            checkpoints=checkpoints or self._checkpoints)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        params_grads = self._optimizer.backward(
-            loss, startup_program, parameter_list, no_grad_set,
-            checkpoints=self._checkpoints)
-        opt_ops = self._optimizer.apply_gradients(params_grads)
+        from .framework.core import program_guard
+        with program_guard(loss.block.program,
+                           startup_program or default_startup_program()):
+            params_grads = self.backward(loss, startup_program,
+                                         parameter_list, no_grad_set)
+            opt_ops = self.apply_gradients(params_grads)
         return opt_ops, params_grads
 
 
@@ -521,7 +540,14 @@ class GradientMergeOptimizer(Optimizer):
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        from .layers import tensor_ops
+        from .framework.core import program_guard
+        with program_guard(loss.block.program,
+                           startup_program or default_startup_program()):
+            return self._minimize_impl(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+    def _minimize_impl(self, loss, startup_program, parameter_list,
+                       no_grad_set):
         main = default_main_program().global_block()
         startup = default_startup_program().global_block()
         params_grads = self._inner.backward(loss, startup_program,

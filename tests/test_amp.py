@@ -1,0 +1,95 @@
+"""AMP tests (ref: test_mixed_precision.py family): cast insertion, bf16
+numerics close to fp32, dynamic loss scaling state machine."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.contrib.mixed_precision import decorate
+
+
+def _build(amp_mode):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu",
+                            param_attr=fluid.ParamAttr(
+                                name="w1",
+                                initializer=fluid.initializer.Constant(0.02)),
+                            bias_attr=False)
+        logits = fluid.layers.fc(h, 4,
+                                 param_attr=fluid.ParamAttr(
+                                     name="w2",
+                                     initializer=fluid.initializer.Constant(0.02)),
+                                 bias_attr=False)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        opt = fluid.optimizer.SGD(0.1)
+        if amp_mode == "bf16":
+            opt = decorate(opt, use_pure_bf16=True)
+        elif amp_mode == "fp16":
+            opt = decorate(opt, use_pure_bf16=False)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def _run(main, startup, loss, steps=5):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 16).astype(np.float32)
+    ys = rng.randint(0, 4, (16, 1)).astype(np.int64)
+    out = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            l, = exe.run(main, feed={"x": xs, "label": ys},
+                         fetch_list=[loss])
+            out.append(float(l))
+    return out
+
+
+def test_bf16_close_to_fp32():
+    ref = _run(*_build(None))
+    bf16 = _run(*_build("bf16"))
+    assert all(np.isfinite(bf16))
+    # same downward trend, small numeric gap
+    assert bf16[-1] < bf16[0]
+    np.testing.assert_allclose(ref, bf16, rtol=0.1)
+
+
+def test_fp16_loss_scaling_trains():
+    losses = _run(*_build("fp16"))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_cast_ops_inserted():
+    main, startup, loss = _build("bf16")
+    types = [op.type for op in main.global_block().ops]
+    assert "cast" in types
+    # white-list GEMM (mul) must consume bf16 inputs
+    mul_ops = [op for op in main.global_block().ops if op.type == "mul"]
+    block = main.global_block()
+    for op in mul_ops:
+        for n in op.input_names():
+            v = block._find_var_recursive(n)
+            assert v.dtype == "bfloat16", f"{n} is {v.dtype}"
+
+
+def test_loss_stays_fp32():
+    main, startup, loss = _build("bf16")
+    out = _run(main, startup, loss, steps=1)
+    assert np.isfinite(out[0])
+    v = main.global_block()._find_var_recursive(loss.name)
+    # softmax_with_cross_entropy is black-listed: loss computed in fp32
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        l = exe.run(main, feed={"x": rng.randn(4, 16).astype(np.float32),
+                                "label": np.zeros((4, 1), np.int64)},
+                    fetch_list=[loss], return_numpy=False)[0]
+    assert str(l.dtype) == "float32"

@@ -1,0 +1,76 @@
+"""DataLoader tests (ref: test_dataloader_* in the reference unittests)."""
+
+import numpy as np
+
+from paddle_tpu.dataloader import DataLoader, BatchSampler
+from paddle_tpu.dataloader.dataset import TensorDataset
+
+
+def test_map_style_batching():
+    xs = np.arange(20, dtype=np.float32).reshape(10, 2)
+    ys = np.arange(10, dtype=np.int64)
+    ds = TensorDataset(xs, ys)
+    dl = DataLoader(ds, batch_size=4, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 2)
+    assert batches[-1][0].shape == (2, 2)
+    np.testing.assert_array_equal(batches[0][1], [0, 1, 2, 3])
+
+
+def test_shuffle_covers_all_samples():
+    ds = TensorDataset(np.arange(32).reshape(32, 1))
+    dl = DataLoader(ds, batch_size=8, shuffle=True, seed=0)
+    seen = np.concatenate([b[0][:, 0] for b in dl])
+    assert sorted(seen.tolist()) == list(range(32))
+
+
+def test_replica_sharding_partitions():
+    ds = TensorDataset(np.arange(16).reshape(16, 1))
+    seen = []
+    for rank in range(4):
+        dl = DataLoader(ds, batch_size=2, num_replicas=4, rank=rank)
+        seen.extend(int(v) for b in dl for v in b[0][:, 0])
+    assert sorted(seen) == list(range(16))
+
+
+def test_generator_path_feed_dicts():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.core import Program, program_guard
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2])
+    loader = DataLoader.from_generator(feed_list=[x], capacity=4)
+
+    def reader():
+        for i in range(3):
+            yield np.full((4, 2), i, np.float32),
+    loader.set_batch_generator(
+        lambda: ((np.full((4, 2), i, np.float32),) for i in range(3)))
+    feeds = list(loader)
+    assert len(feeds) == 3
+    assert set(feeds[0]) == {"x"}
+    assert feeds[2]["x"][0, 0] == 2.0
+
+
+def test_prefetch_propagates_errors():
+    def bad():
+        yield {"x": np.zeros(1)}
+        raise RuntimeError("boom")
+    dl = DataLoader.from_generator(feed_list=None, capacity=2)
+    dl.set_batch_generator(bad)
+    it = iter(dl)
+    next(it)
+    import pytest
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+
+
+def test_sample_generator_batches():
+    dl = DataLoader.from_generator(feed_list=None, capacity=2)
+    dl.set_sample_generator(
+        lambda: iter([(np.float32(i), np.int64(i)) for i in range(10)]),
+        batch_size=4, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 2
+    assert batches[0][0].shape == (4,)
