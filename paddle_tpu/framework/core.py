@@ -295,6 +295,23 @@ class Block:
         return f"Block(idx={self.idx}, ops={len(self.ops)}, vars={len(self.vars)})"
 
 
+def _clone_attrs(attrs, new_program):
+    """Copy op attrs for Program.clone, remapping Block references into the
+    cloned program (everything else is deep-copied)."""
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, Block):
+            out[k] = new_program.blocks[v.idx]
+        elif isinstance(v, (list, tuple)) and any(
+                isinstance(x, Block) for x in v):
+            out[k] = type(v)(new_program.blocks[x.idx]
+                             if isinstance(x, Block) else copy.deepcopy(x)
+                             for x in v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
 class Program:
     """A whole training/inference program (ref: framework.py:3857).
 
@@ -354,17 +371,21 @@ class Program:
         p._is_test = for_test or self._is_test
         p._mesh = self._mesh
         p._dist_attrs = dict(self._dist_attrs)
+        # two passes so sub-block attrs (control-flow ops) can be remapped to
+        # the cloned program's blocks by index (the reference stores sub-block
+        # *indices* in OpDesc attrs for the same reason, ref:
+        # framework.proto:42 BLOCK attr type)
         for b in self.blocks:
-            nb = Block(p, b.idx, b.parent_idx)
+            p.blocks.append(Block(p, b.idx, b.parent_idx))
+        for b, nb in zip(self.blocks, p.blocks):
             for name, v in b.vars.items():
                 nv = copy.copy(v)
                 nv.block = nb
                 nb.vars[name] = nv
             for op in b.ops:
                 nop = Operator(nb, op.type, dict(op.inputs), dict(op.outputs),
-                               copy.deepcopy(op.attrs))
+                               _clone_attrs(op.attrs, p))
                 nb.ops.append(nop)
-            p.blocks.append(nb)
         if for_test:
             p._set_test_mode()
         return p

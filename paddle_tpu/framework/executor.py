@@ -329,8 +329,11 @@ class Executor:
                 if n in written or n in feed_names or n in state_in_names:
                     continue
                 var = block._find_var_recursive(n)
-                if var is not None and (var.persistable or
-                                        scope.find_var(n) is not None):
+                # vars declared only in sub-blocks (e.g. params created inside
+                # a StaticRNN/while step block) aren't visible from the global
+                # block, but live in the scope after the startup program ran
+                if (var is not None and var.persistable) or \
+                        scope.find_var(n) is not None:
                     state_in_names.append(n)
             written |= set(op.output_names())
         # fetch of a persistable that no op writes (e.g. fetch a param)

@@ -8,6 +8,8 @@ from .nn import data             # noqa: F401
 from .tensor_ops import *        # noqa: F401,F403
 from .loss import *              # noqa: F401,F403
 from .metric_op import accuracy  # noqa: F401
+from .control_flow import (while_loop, cond, case, switch_case,  # noqa: F401
+                           StaticRNN)
 from ..lr_scheduler import (noam_decay, exponential_decay,  # noqa: F401
                             natural_exp_decay, inverse_time_decay,
                             polynomial_decay, piecewise_decay, cosine_decay,

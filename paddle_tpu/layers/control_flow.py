@@ -1,0 +1,320 @@
+"""Graph-building control-flow API (ref: python/paddle/fluid/layers/
+control_flow.py — While:1034, while_loop:1174, cond in
+layers/control_flow.py + conditional_block:63, case:2789,
+switch_case:3011, StaticRNN:409).
+
+Builders create sub-blocks in the current Program, run the user's Python
+closure once to trace ops into them, compute the closure-variable set at
+build time (replacing the reference's runtime scope-chain lookup), and
+append a single structured op that the executor lowers to
+`lax.while_loop` / `lax.cond` / `lax.switch` / `lax.scan`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from ..framework.core import Variable, default_main_program
+from ..framework import unique_name
+
+
+def _flatten_vars(out):
+    if out is None:
+        return []
+    if isinstance(out, Variable):
+        return [out]
+    if isinstance(out, (list, tuple)):
+        res = []
+        for o in out:
+            res.extend(_flatten_vars(o))
+        return res
+    raise TypeError(f"branch functions must return Variables, got {type(out)}")
+
+
+def _closure_names(blocks, bound_names) -> List[str]:
+    """Outer var names read by the given blocks.
+
+    Nested control-flow ops already list their own closures as explicit
+    inputs, so a linear scan per block suffices (no recursion)."""
+    bound = set(bound_names)
+    needed: List[str] = []
+    for block in blocks:
+        local = set(bound)
+        for op in block.ops:
+            if op.type in ("feed", "fetch"):
+                continue
+            for n in op.input_names():
+                if n not in local and n not in needed:
+                    needed.append(n)
+            local |= set(op.output_names())
+    return needed
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars: Sequence[Variable],
+               is_test: bool = False, name: Optional[str] = None,
+               maximum_trip_count: Optional[int] = None) -> List[Variable]:
+    """ref: layers/control_flow.py:1174 while_loop.
+
+    `maximum_trip_count` is a TPU-native extension: with it the loop lowers
+    to a bounded masked `lax.scan`, making it reverse-differentiable (the
+    analog of the reference's while_grad support, ref:
+    operators/controlflow/while_op.cc); without it the loop lowers to
+    `lax.while_loop` (forward/inference only)."""
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise TypeError("loop_vars must be a non-empty list of Variables")
+    loop_vars = list(loop_vars)
+    main = default_main_program()
+    parent = main.current_block()
+
+    cond_block = main._create_block()
+    cond_out = cond(*loop_vars)
+    if not isinstance(cond_out, Variable):
+        raise TypeError("cond must return a boolean Variable")
+    main._rollback()
+
+    body_block = main._create_block()
+    body_out = body(*loop_vars)
+    body_out_vars = _flatten_vars(body_out)
+    main._rollback()
+    if len(body_out_vars) != len(loop_vars):
+        raise ValueError(
+            f"body must return as many values as loop_vars "
+            f"({len(body_out_vars)} vs {len(loop_vars)})")
+
+    x_names = [v.name for v in loop_vars]
+    closure = _closure_names([cond_block, body_block], x_names)
+    outs = [parent.create_var(
+        name=unique_name.generate(name or "while_loop"),
+        shape=v.shape, dtype=v.dtype) for v in loop_vars]
+    parent.append_op(
+        type="while_loop",
+        inputs={"X": loop_vars, "Closure": closure},
+        outputs={"Out": outs},
+        attrs={"x_names": x_names, "closure_names": closure,
+               "cond_block": cond_block, "body_block": body_block,
+               "cond_out": cond_out.name,
+               "body_out_names": [v.name for v in body_out_vars],
+               "maximum_trip_count": maximum_trip_count,
+               "is_test": is_test})
+    return outs
+
+
+def cond(pred: Variable, true_fn: Optional[Callable] = None,
+         false_fn: Optional[Callable] = None, name: Optional[str] = None):
+    """ref: layers/control_flow.py cond / conditional_block_op.cc.
+    Both branches must return matching structures (same contract as the
+    reference and as `lax.cond`)."""
+    main = default_main_program()
+    parent = main.current_block()
+
+    true_block = main._create_block()
+    t_out = true_fn() if true_fn is not None else None
+    t_vars = _flatten_vars(t_out)
+    main._rollback()
+
+    false_block = main._create_block()
+    f_out = false_fn() if false_fn is not None else None
+    f_vars = _flatten_vars(f_out)
+    main._rollback()
+
+    if len(t_vars) != len(f_vars):
+        raise ValueError(
+            "true_fn and false_fn must return the same number of outputs "
+            f"({len(t_vars)} vs {len(f_vars)})")
+    if not t_vars:
+        raise ValueError("cond with no outputs is a no-op under XLA; "
+                         "return the values the branches compute")
+
+    closure = _closure_names([true_block, false_block], [])
+    outs = [parent.create_var(
+        name=unique_name.generate(name or "cond"),
+        shape=v.shape, dtype=v.dtype) for v in t_vars]
+    parent.append_op(
+        type="conditional_block",
+        inputs={"Cond": [pred], "Closure": closure},
+        outputs={"Out": outs},
+        attrs={"closure_names": closure,
+               "true_block": true_block, "false_block": false_block,
+               "true_out_names": [v.name for v in t_vars],
+               "false_out_names": [v.name for v in f_vars]})
+    if isinstance(t_out, Variable):
+        return outs[0]
+    return outs
+
+
+def case(pred_fn_pairs, default: Optional[Callable] = None,
+         name: Optional[str] = None):
+    """ref: layers/control_flow.py:2789 — chained conds."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    (pred, fn), rest = pred_fn_pairs[0], pred_fn_pairs[1:]
+    if rest:
+        return cond(pred, fn, lambda: case(rest, default), name=name)
+    if default is None:
+        _, default = pred_fn_pairs[-1]
+        return cond(pred, fn, default, name=name)
+    return cond(pred, fn, default, name=name)
+
+
+def switch_case(branch_index: Variable, branch_fns, default=None,
+                name: Optional[str] = None):
+    """ref: layers/control_flow.py:3011 switch_case ↦ lax.switch."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    max_index = max(i for i, _ in items)
+    fns = []
+    fn_map = dict(items)
+    for i in range(max_index + 1):
+        f = fn_map.get(i, default)
+        if f is None:
+            raise ValueError(f"no branch for index {i} and no default")
+        fns.append(f)
+    if default is not None:
+        fns.append(default)          # out-of-range → default (last branch)
+
+    main = default_main_program()
+    parent = main.current_block()
+    blocks, out_names, first_vars = [], [], None
+    for f in fns:
+        b = main._create_block()
+        vars_ = _flatten_vars(f())
+        main._rollback()
+        blocks.append(b)
+        out_names.append([v.name for v in vars_])
+        if first_vars is None:
+            first_vars = vars_
+        elif len(vars_) != len(first_vars):
+            raise ValueError("all branches must return the same number "
+                             "of outputs")
+
+    closure = _closure_names(blocks, [])
+    outs = [parent.create_var(
+        name=unique_name.generate(name or "switch_case"),
+        shape=v.shape, dtype=v.dtype) for v in first_vars]
+    parent.append_op(
+        type="switch_case",
+        inputs={"Index": [branch_index], "Closure": closure},
+        outputs={"Out": outs},
+        attrs={"closure_names": closure, "branch_blocks": blocks,
+               "branch_out_names": out_names})
+    return outs[0] if len(outs) == 1 else outs
+
+
+class StaticRNN:
+    """Recurrent builder (ref: layers/control_flow.py:409 StaticRNN;
+    executed by operators/recurrent_op.cc in the reference, lowered to one
+    `lax.scan` here).  Sequence inputs are time-major ``[T, batch, ...]``."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._name = name or "static_rnn"
+        self._main = default_main_program()
+        self._parent = self._main.current_block()
+        self._block = None
+        self._seq_inputs: List[Variable] = []     # parent [T, ...] vars
+        self._step_inputs: List[Variable] = []    # in-block slices
+        self._mem_init: List[Variable] = []       # parent init values
+        self._mems: List[Variable] = []           # in-block memory vars
+        self._mem_updates = {}                    # mem name -> update var
+        self._step_outputs: List[Variable] = []
+        self._outputs: List[Variable] = []
+        self._finalized = False
+
+    # -- builder context ------------------------------------------------
+    def step(self):
+        rnn = self
+
+        class _Ctx:
+            def __enter__(self):
+                rnn._block = rnn._main._create_block()
+                return rnn
+
+            def __exit__(self, exc_type, exc, tb):
+                rnn._main._rollback()
+                if exc_type is None:
+                    rnn._finalize()
+                return False
+
+        return _Ctx()
+
+    def _in_step(self):
+        if self._block is None or self._finalized:
+            raise RuntimeError("must be called inside `with rnn.step():`")
+
+    def step_input(self, x: Variable) -> Variable:
+        self._in_step()
+        slice_var = self._block.create_var(
+            name=unique_name.generate(f"{self._name}.x"),
+            shape=tuple(x.shape[1:]), dtype=x.dtype)
+        self._seq_inputs.append(x)
+        self._step_inputs.append(slice_var)
+        return slice_var
+
+    def memory(self, init: Variable) -> Variable:
+        self._in_step()
+        mem = self._block.create_var(
+            name=unique_name.generate(f"{self._name}.mem"),
+            shape=init.shape, dtype=init.dtype)
+        self._mem_init.append(init)
+        self._mems.append(mem)
+        return mem
+
+    def update_memory(self, mem: Variable, new: Variable):
+        self._in_step()
+        self._mem_updates[mem.name] = new
+
+    def step_output(self, o: Variable):
+        self._in_step()
+        self._step_outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    # -- finalization ----------------------------------------------------
+    def _finalize(self):
+        self._finalized = True
+        if not self._step_outputs:
+            raise ValueError("StaticRNN needs at least one step_output")
+        mem_update_names = []
+        for m in self._mems:
+            if m.name not in self._mem_updates:
+                raise ValueError(f"memory {m.name!r} never updated — call "
+                                 "rnn.update_memory(mem, new)")
+            mem_update_names.append(self._mem_updates[m.name].name)
+
+        bound = [v.name for v in self._step_inputs + self._mems]
+        closure = _closure_names([self._block], bound)
+
+        T = self._seq_inputs[0].shape[0] if self._seq_inputs else None
+        outs = [self._parent.create_var(
+            name=unique_name.generate(f"{self._name}.out"),
+            shape=(T,) + tuple(o.shape), dtype=o.dtype)
+            for o in self._step_outputs]
+        finals = [self._parent.create_var(
+            name=unique_name.generate(f"{self._name}.final"),
+            shape=m.shape, dtype=m.dtype) for m in self._mems]
+        self._parent.append_op(
+            type="static_rnn",
+            inputs={"X": self._seq_inputs, "MemInit": self._mem_init,
+                    "Closure": closure},
+            outputs={"Out": outs, "FinalMem": finals},
+            attrs={"closure_names": closure, "step_block": self._block,
+                   "step_input_names": [v.name for v in self._step_inputs],
+                   "mem_names": [v.name for v in self._mems],
+                   "mem_update_names": mem_update_names,
+                   "step_output_names": [v.name for v in self._step_outputs]})
+        self._outputs = outs
+        self._final_mems = finals
+
+    def __call__(self):
+        if not self._finalized:
+            raise RuntimeError("StaticRNN not finalized — exit the "
+                               "`with rnn.step():` block first")
+        if len(self._outputs) == 1:
+            return self._outputs[0]
+        return self._outputs
+
+
+__all__ = ["while_loop", "cond", "case", "switch_case", "StaticRNN"]

@@ -7,3 +7,4 @@ from . import tensor_ops    # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
+from . import controlflow_ops  # noqa: F401

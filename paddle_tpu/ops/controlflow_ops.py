@@ -1,0 +1,179 @@
+"""Control-flow ops: sub-block ops lowered to XLA structured control flow.
+
+The reference implements control flow as ops holding sub-block indices,
+executed by nested interpreter Executors on child scopes
+(ref: operators/controlflow/while_op.cc, conditional_block_op.cc,
+recurrent_op.cc).  TPU-natively a sub-block is traced into the SAME XLA
+computation as a `lax.while_loop` / `lax.cond` / `lax.scan` region — no
+nested executor, no scopes; closure vars are passed explicitly (the
+builder records them in the "Closure" input slot, replacing the
+reference's runtime scope-chain lookup, ref: framework/scope.h:46).
+
+Autodiff: `lax.scan`/`lax.cond` regions are reverse-differentiable, so
+grads through loops come from XLA's native adjoint instead of the
+reference's `while_grad` op machinery (ref: while_op.cc WhileGradOp).
+`lax.while_loop` (truly dynamic trip count) is forward-only; training
+loops must pass `maximum_trip_count` to get the bounded, masked-scan
+lowering.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, LoweringContext
+
+
+def _block_ops(block):
+    return [op for op in block.ops if op.type not in ("feed", "fetch")]
+
+
+def _run_block(block, env, ctx):
+    from ..framework.executor import run_ops
+    return run_ops(_block_ops(block), env, ctx)
+
+
+def _sub_ctx(ctx, key):
+    return LoweringContext(key, ctx.mesh, ctx.axis_names, ctx.is_test)
+
+
+def _scalar_bool(v):
+    return jnp.reshape(v, ()).astype(bool)
+
+
+@register("while_loop")
+def _while_loop_op(ctx, ins, attrs):
+    xs = list(ins.get("X") or [])
+    closure = list(ins.get("Closure") or [])
+    x_names = list(attrs["x_names"])
+    closure_names = list(attrs["closure_names"])
+    cond_block = attrs["cond_block"]
+    body_block = attrs["body_block"]
+    cond_out = attrs["cond_out"]
+    body_out_names = list(attrs["body_out_names"])
+    max_trip = attrs.get("maximum_trip_count")
+
+    base_env = dict(zip(closure_names, closure))
+
+    def eval_cond(vals, key):
+        env = dict(base_env)
+        env.update(zip(x_names, vals))
+        env = _run_block(cond_block, env, _sub_ctx(ctx, key))
+        return _scalar_bool(env[cond_out])
+
+    def eval_body(vals, key):
+        env = dict(base_env)
+        env.update(zip(x_names, vals))
+        sub = _sub_ctx(ctx, key)
+        env = _run_block(body_block, env, sub)
+        return tuple(env[n] for n in body_out_names)
+
+    init = tuple(xs)
+    if max_trip is None:
+        # dynamic trip count → lax.while_loop (forward-only)
+        def cond_fn(carry):
+            vals, key = carry
+            return eval_cond(vals, key)
+
+        def body_fn(carry):
+            vals, key = carry
+            k_step, k_next = jax.random.split(key)
+            return eval_body(vals, k_step), k_next
+
+        out_vals, _ = jax.lax.while_loop(cond_fn, body_fn,
+                                         (init, ctx.next_key()))
+    else:
+        # bounded loop → masked scan: runs max_trip iterations, freezing the
+        # carry once the predicate goes false; reverse-differentiable.
+        def scan_fn(carry, key):
+            vals, done = carry
+            pred = jnp.logical_and(eval_cond(vals, key), ~done)
+            new_vals = eval_body(vals, key)
+            sel = tuple(jnp.where(pred, nv, v)
+                        for nv, v in zip(new_vals, vals))
+            return (sel, ~pred), None
+
+        keys = jax.random.split(ctx.next_key(), int(max_trip))
+        (out_vals, _), _ = jax.lax.scan(
+            scan_fn, (init, jnp.asarray(False)), keys)
+    return {"Out": list(out_vals)}
+
+
+@register("conditional_block")
+def _conditional_block_op(ctx, ins, attrs):
+    pred = _scalar_bool(ins["Cond"][0])
+    closure = list(ins.get("Closure") or [])
+    closure_names = list(attrs["closure_names"])
+    true_block = attrs["true_block"]
+    false_block = attrs["false_block"]
+    true_out_names = list(attrs["true_out_names"])
+    false_out_names = list(attrs["false_out_names"])
+
+    base_env = dict(zip(closure_names, closure))
+
+    def branch(block, out_names):
+        def f(key):
+            env = _run_block(block, dict(base_env), _sub_ctx(ctx, key))
+            return tuple(env[n] for n in out_names)
+        return f
+
+    out = jax.lax.cond(pred, branch(true_block, true_out_names),
+                       branch(false_block, false_out_names), ctx.next_key())
+    return {"Out": list(out)}
+
+
+@register("switch_case")
+def _switch_case_op(ctx, ins, attrs):
+    index = jnp.reshape(ins["Index"][0], ()).astype(jnp.int32)
+    closure = list(ins.get("Closure") or [])
+    closure_names = list(attrs["closure_names"])
+    blocks = attrs["branch_blocks"]
+    out_names_per = attrs["branch_out_names"]
+
+    base_env = dict(zip(closure_names, closure))
+
+    def make_branch(block, out_names):
+        def f(key):
+            env = _run_block(block, dict(base_env), _sub_ctx(ctx, key))
+            return tuple(env[n] for n in out_names)
+        return f
+
+    branches = [make_branch(b, on) for b, on in zip(blocks, out_names_per)]
+    index = jnp.clip(index, 0, len(branches) - 1)
+    out = jax.lax.switch(index, branches, ctx.next_key())
+    return {"Out": list(out)}
+
+
+@register("static_rnn")
+def _static_rnn_op(ctx, ins, attrs):
+    """Recurrent region ↦ lax.scan (ref: operators/recurrent_op.cc runs the
+    step block once per time step on per-step scopes; here the step block
+    becomes the scan body, differentiated by XLA's scan adjoint)."""
+    seq_vals = list(ins.get("X") or [])           # each [T, ...] time-major
+    mem_init = list(ins.get("MemInit") or [])
+    closure = list(ins.get("Closure") or [])
+    closure_names = list(attrs["closure_names"])
+    block = attrs["step_block"]
+    x_names = list(attrs["step_input_names"])      # in-block per-step slices
+    mem_names = list(attrs["mem_names"])           # in-block memory vars
+    mem_update_names = list(attrs["mem_update_names"])
+    out_names = list(attrs["step_output_names"])
+
+    base_env = dict(zip(closure_names, closure))
+
+    def scan_fn(carry, xs):
+        mems, key = carry
+        x_slices, k_step = xs, key
+        k_step, k_next = jax.random.split(key)
+        env = dict(base_env)
+        env.update(zip(x_names, x_slices))
+        env.update(zip(mem_names, mems))
+        env = _run_block(block, env, _sub_ctx(ctx, k_step))
+        new_mems = tuple(env[n] for n in mem_update_names)
+        outs = tuple(env[n] for n in out_names)
+        return (new_mems, k_next), outs
+
+    (final_mems, _), stacked = jax.lax.scan(
+        scan_fn, (tuple(mem_init), ctx.next_key()), tuple(seq_vals))
+    return {"Out": list(stacked), "FinalMem": list(final_mems)}

@@ -1,0 +1,79 @@
+"""Tensor-model-parallel collective ops with explicit forward/backward
+collective placement (the Megatron f/g pair).
+
+The reference has only the is_distributed/DistFC hooks for model parallelism
+(ref: transpiler/collective.py:226, incubate/fleet/collective/__init__.py:44
+DistFCConfig); full TP is a new capability here (SURVEY §2.3 "Tensor/model
+parallel: supersedes the reference").  Under shard_map, autodiff of raw
+collectives does not automatically produce the partial-sum reductions TP
+needs, so these ops pin the VJP explicitly:
+
+- ``mp_copy``      (Megatron f): identity forward, AllReduce backward —
+  placed where a replicated activation enters a column-parallel region.
+- ``mp_allreduce_sum`` (Megatron g): AllReduce forward, identity backward —
+  placed where row-parallel partial sums merge back to replicated.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+
+from .registry import register, x
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _mp_copy(v, axis):
+    return v
+
+
+def _mp_copy_fwd(v, axis):
+    return v, None
+
+
+def _mp_copy_bwd(axis, _, g):
+    return (lax.psum(g, axis),)
+
+
+_mp_copy.defvjp(_mp_copy_fwd, _mp_copy_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _mp_reduce(v, axis):
+    return lax.psum(v, axis)
+
+
+def _mp_reduce_fwd(v, axis):
+    return lax.psum(v, axis), None
+
+
+def _mp_reduce_bwd(axis, _, g):
+    return (g,)
+
+
+_mp_reduce.defvjp(_mp_reduce_fwd, _mp_reduce_bwd)
+
+
+def _axis(ctx, attrs):
+    name = attrs.get("_axis_name", "tp")
+    return name if name in ctx.axis_names else None
+
+
+@register("mp_copy")
+def _mp_copy_op(ctx, ins, attrs):
+    a = x(ins, "X")
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": a}
+    return {"Out": _mp_copy(a, axis)}
+
+
+@register("mp_allreduce_sum")
+def _mp_allreduce_op(ctx, ins, attrs):
+    a = x(ins, "X")
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": a}
+    return {"Out": _mp_reduce(a, axis)}

@@ -1,0 +1,87 @@
+"""Device topology / mesh construction.
+
+The reference discovers cluster topology through role makers reading
+launcher env vars (ref: incubate/fleet/base/role_maker.py:480
+PaddleCloudRoleMaker) and builds NCCL rings keyed by ring_id
+(ref: platform/collective_helper.h:62).  TPU-natively the topology is ONE
+`jax.sharding.Mesh` whose named axes carry every parallelism dimension;
+XLA owns the ICI ring/torus mapping underneath.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+_AXIS_ORDER = ("dp", "pp", "tp", "sp", "ep")
+
+
+class DeviceTopology:
+    """Named-axis topology over the available devices (the analog of the
+    reference's RoleMaker + NCCLContextMap pair)."""
+
+    def __init__(self, axes: Dict[str, int], devices=None):
+        import jax
+        self.axes = dict(axes)
+        devs = list(devices) if devices is not None else jax.devices()
+        n = int(np.prod(list(axes.values()))) if axes else 1
+        if n > len(devs):
+            raise ValueError(
+                f"topology {axes} needs {n} devices, have {len(devs)}")
+        self.devices = devs[:n]
+
+    @property
+    def world_size(self) -> int:
+        return len(self.devices)
+
+    def mesh(self):
+        from jax.sharding import Mesh
+        names = [a for a in _AXIS_ORDER if a in self.axes]
+        names += [a for a in self.axes if a not in names]
+        shape = [self.axes[a] for a in names]
+        arr = np.array(self.devices).reshape(shape)
+        return Mesh(arr, tuple(names))
+
+
+def build_mesh(axes: Dict[str, int], devices=None):
+    """`build_mesh({"dp": 2, "tp": 4})` → Mesh with axes (dp, tp)."""
+    return DeviceTopology(axes, devices).mesh()
+
+
+def _factor(n: int, ways: int) -> list:
+    """Split n into `ways` factors, largest first (greedy powers of two)."""
+    out = []
+    for i in range(ways - 1, 0, -1):
+        f = 1
+        while n % 2 == 0 and f * f * (2 ** i) <= n:
+            n //= 2
+            f *= 2
+        out.append(f)
+    out.append(n)
+    return sorted(out, reverse=True)
+
+
+def auto_mesh(n_devices: Optional[int] = None,
+              axis_names: Sequence[str] = ("dp", "tp"), devices=None):
+    """Factor the device count over the requested axes — the analog of the
+    reference's automatic nccl_comm_num / hierarchical allreduce layout
+    choices (ref: incubate/fleet/collective/__init__.py:489)."""
+    import jax
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    factors = _factor(n, len(axis_names))
+    axes = dict(zip(axis_names, factors))
+    return build_mesh(axes, devs)
+
+
+def tpu_slice_env() -> Dict[str, str]:
+    """TPU pod slice metadata from env (the PaddleCloudRoleMaker analog:
+    env-var cluster discovery, ref: role_maker.py:480)."""
+    keys = ("TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES", "TPU_ACCELERATOR_TYPE",
+            "MEGASCALE_NUM_SLICES", "MEGASCALE_SLICE_ID")
+    return {k: os.environ[k] for k in keys if k in os.environ}

@@ -1,0 +1,193 @@
+"""Control-flow op tests (ref: test_while_loop_op.py, test_cond.py,
+test_case.py, test_switch_case.py, test_static_rnn — SURVEY §4.2)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import Program, program_guard
+
+layers = fluid.layers
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_while_loop_dynamic_trip_count():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        s = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        n = layers.fill_constant(shape=[1], dtype="int32", value=10)
+
+        def cond(i, s):
+            return layers.less_than(i, n)
+
+        def body(i, s):
+            return [i + 1, s + layers.cast(i, "float32")]
+
+        i_out, s_out = layers.while_loop(cond, body, [i, s])
+    s_val, = _run(main, startup, {}, [s_out])
+    assert np.isclose(float(s_val), sum(range(10)))
+
+
+def test_while_loop_bounded_is_differentiable():
+    # loss = w^4 via 3 bounded loop iterations x <- x*w starting at x=1*? :
+    # iterate twice: x = x*w; loss = mean(x) — d loss/dw known analytically
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xd = layers.data("xd", shape=[1])
+        w = fluid.layers.fc(xd, 1, bias_attr=False,
+                            param_attr=fluid.ParamAttr(
+                                name="w_loop",
+                                initializer=fluid.initializer.Constant(2.0)))
+        i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        three = layers.fill_constant(shape=[1], dtype="int32", value=3)
+
+        def cond(i, acc):
+            return layers.less_than(i, three)
+
+        def body(i, acc):
+            return [i + 1, acc * 0.5]
+
+        _, acc = layers.while_loop(cond, body, [i, w],
+                                   maximum_trip_count=8)
+        loss = layers.mean(acc)
+        fluid.optimizer.SGD(1.0).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = np.ones((1, 1), np.float32)
+    l1, = exe.run(main, feed={"xd": x}, fetch_list=[loss])
+    # loss = mean(w * x * 0.125); grad wrt w = x/8; w starts at 2
+    assert np.isclose(float(l1), 2.0 * 0.125, atol=1e-5)
+    l2, = exe.run(main, feed={"xd": x}, fetch_list=[loss])
+    # sgd with lr=1: w <- w - 0.125 = 1.875 → loss = 0.234375
+    assert np.isclose(float(l2), 1.875 * 0.125, atol=1e-5)
+
+
+def test_cond_branches():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        a = layers.fill_constant(shape=[2], dtype="float32", value=3.0)
+        b = layers.fill_constant(shape=[2], dtype="float32", value=5.0)
+        pred = layers.less_than(layers.reduce_sum(a), layers.reduce_sum(b))
+        out = layers.cond(pred, lambda: a + b, lambda: a - b)
+        out2 = layers.cond(layers.logical_not(pred),
+                           lambda: a + b, lambda: a * b)
+    o1, o2 = _run(main, startup, {}, [out, out2])
+    np.testing.assert_allclose(o1, [8.0, 8.0])
+    np.testing.assert_allclose(o2, [15.0, 15.0])
+
+
+def test_cond_gradient_flows_through_taken_branch():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data("x", shape=[1])
+        w = fluid.layers.fc(x, 1, bias_attr=False,
+                            param_attr=fluid.ParamAttr(
+                                name="w_cond",
+                                initializer=fluid.initializer.Constant(1.0)))
+        pred = layers.less_than(layers.reduce_sum(w),
+                                layers.fill_constant([1], "float32", 100.0))
+        out = layers.cond(pred, lambda: w * 3.0, lambda: w * 5.0)
+        loss = layers.mean(out)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = np.ones((1, 1), np.float32)
+    exe.run(main, feed={"x": x}, fetch_list=[loss])
+    w_val = np.asarray(fluid.global_scope().find_var("w_cond"))
+    # taken branch grad = 3 * 0.1 → w = 1 - 0.3
+    assert np.isclose(float(w_val.reshape(())), 0.7, atol=1e-5)
+
+
+def test_case_and_switch_case():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        one = layers.fill_constant([1], "float32", 1.0)
+        two = layers.fill_constant([1], "float32", 2.0)
+        p_false = layers.less_than(two, one)
+        p_true = layers.less_than(one, two)
+        c = layers.case([(p_false, lambda: one + 10.0),
+                         (p_true, lambda: two + 20.0)],
+                        default=lambda: one * 0.0)
+        idx = layers.fill_constant([1], "int32", 1)
+        s = layers.switch_case(idx, {0: lambda: one * 100.0,
+                                     1: lambda: two * 100.0},
+                               default=lambda: one * 0.0)
+    c_val, s_val = _run(main, startup, {}, [c, s])
+    assert np.isclose(float(np.asarray(c_val).reshape(())), 22.0)
+    assert np.isclose(float(np.asarray(s_val).reshape(())), 200.0)
+
+
+def test_static_rnn_matches_numpy():
+    T, B, H = 4, 2, 3
+    x_np = np.random.RandomState(0).rand(T, B, H).astype(np.float32)
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data("x", shape=[B, H], dtype="float32")  # fed as [T,B,H]
+        init = layers.fill_constant([B, H], "float32", 0.0)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(init=init)
+            new = mem + xt
+            rnn.update_memory(mem, new)
+            rnn.step_output(new)
+        outs = rnn()
+    out_val, = _run(main, startup, {"x": x_np}, [outs])
+    np.testing.assert_allclose(out_val, np.cumsum(x_np, axis=0), rtol=1e-5)
+
+
+def test_static_rnn_trains():
+    # tiny recurrent regression: y = sum_t x_t @ w ; loss decreases
+    T, B, H = 3, 4, 2
+    rng = np.random.RandomState(1)
+    x_np = rng.rand(T, B, H).astype(np.float32)
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data("x", shape=[B, H], dtype="float32")
+        h0 = layers.fill_constant([B, 1], "float32", 0.0)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(init=h0)
+            proj = fluid.layers.fc(xt, 1, bias_attr=False,
+                                   param_attr=fluid.ParamAttr(name="w_rnn"))
+            new = mem + proj
+            rnn.update_memory(mem, new)
+            rnn.step_output(new)
+        outs = rnn()
+        loss = layers.mean(layers.square(outs))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    l1, = exe.run(main, feed={"x": x_np}, fetch_list=[loss])
+    for _ in range(5):
+        l2, = exe.run(main, feed={"x": x_np}, fetch_list=[loss])
+    assert float(l2) < float(l1)
+
+
+def test_nested_control_flow():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        i = layers.fill_constant([1], "int32", 0)
+        s = layers.fill_constant([1], "float32", 0.0)
+        n = layers.fill_constant([1], "int32", 4)
+        thresh = layers.fill_constant([1], "float32", 2.0)
+
+        def cond_fn(i, s):
+            return layers.less_than(i, n)
+
+        def body(i, s):
+            fi = layers.cast(i, "float32")
+            add = layers.cond(layers.less_than(fi, thresh),
+                              lambda: fi * 1.0, lambda: fi * 10.0)
+            return [i + 1, s + add]
+
+        _, s_out = layers.while_loop(cond_fn, body, [i, s])
+    s_val, = _run(main, startup, {}, [s_out])
+    # i=0,1 → +0,+1 ; i=2,3 → +20,+30 → 51
+    assert np.isclose(float(np.asarray(s_val).reshape(())), 51.0)
