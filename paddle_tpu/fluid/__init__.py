@@ -7,7 +7,8 @@ same, with ``TPUPlace`` as the first-class device."""
 
 from ..framework.core import (Program, Variable, Parameter,  # noqa: F401
                               default_main_program, default_startup_program,
-                              program_guard, CPUPlace, TPUPlace, CUDAPlace,
+                              program_guard, device_guard,
+                              CPUPlace, TPUPlace, CUDAPlace,
                               is_compiled_with_tpu)
 from ..framework.executor import (Executor, Scope, global_scope,  # noqa: F401
                                   scope_guard)

@@ -71,6 +71,8 @@ class CompiledProgram:
         self._mesh = None
         self._axis_names = ()
         self._batch_axis = None
+        self._seq_axis = None
+        self._feed_specs = {}
         self._loss_name = None
 
     def with_data_parallel(self, loss_name: Optional[str] = None,
@@ -96,7 +98,36 @@ class CompiledProgram:
             self._insert_grad_allreduce(strategy, nranks)
         return self
 
-    def _insert_grad_allreduce(self, strategy, nranks):
+    def with_mesh(self, mesh, loss_name: Optional[str] = None,
+                  batch_axis: str = "dp", seq_axis: Optional[str] = None,
+                  feed_specs=None,
+                  build_strategy: Optional[BuildStrategy] = None):
+        """Full N-D mesh compilation: dp (batch) + tp (param shards, from
+        Variable.dist_attr) + sp (sequence shards via feed_specs/ring
+        attention) + pp (pipeline stages).  Generalises with_data_parallel
+        — the analog of composing the reference's fleet DistributedStrategy
+        options (ref: incubate/fleet/collective/__init__.py:343) into one
+        declarative layout."""
+        self._mesh = mesh
+        self._axis_names = tuple(mesh.axis_names)
+        self._batch_axis = batch_axis if batch_axis in mesh.axis_names \
+            else None
+        self._seq_axis = seq_axis if seq_axis and seq_axis in mesh.axis_names \
+            else None
+        self._feed_specs = dict(feed_specs or {})
+        self._loss_name = loss_name
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        # grads are partial over BOTH dp (batch shards) and sp (token
+        # shards) — reduce over every axis the loss tokens are sharded on
+        reduce_axes = tuple(a for a in (self._batch_axis, self._seq_axis)
+                            if a and sizes.get(a, 1) > 1)
+        if loss_name is not None and reduce_axes:
+            n = int(np.prod([sizes[a] for a in reduce_axes]))
+            self._insert_grad_allreduce(build_strategy or BuildStrategy(),
+                                        n, axis_name=reduce_axes)
+        return self
+
+    def _insert_grad_allreduce(self, strategy, nranks, axis_name=None):
         """Insert scale + c_allreduce_sum after the backward op for every
         param grad — the exact rewrite of the reference's GradAllReduce
         transpiler (transpiler/collective.py:190-226) minus the stream-sync
@@ -124,7 +155,9 @@ class CompiledProgram:
                 insert_at += 1
             block._insert_op(insert_at, type="c_allreduce_sum",
                              inputs={"X": [g]}, outputs={"Out": [g]},
-                             attrs={"ring_id": 0})
+                             attrs={"ring_id": 0,
+                                    "_axis_name": axis_name or
+                                    self._batch_axis or "dp"})
             insert_at += 1
 
     # pass-through conveniences so CompiledProgram quacks like Program

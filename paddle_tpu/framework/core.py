@@ -201,6 +201,21 @@ class Operator:
         return f"Op({self.type}, in={ins}, out={outs})"
 
 
+_device_guard_stack: List[str] = []
+
+
+@contextlib.contextmanager
+def device_guard(device: Optional[str] = None):
+    """Pipeline stage annotation (ref: fluid.device_guard — consumed by
+    PipelineOptimizer._split_program, optimizer.py:3751).  Accepts
+    "tpu:k"/"gpu:k" — k is the pipeline stage index."""
+    _device_guard_stack.append(device)
+    try:
+        yield
+    finally:
+        _device_guard_stack.pop()
+
+
 def _to_name_list(v) -> List[str]:
     if v is None:
         return []
@@ -277,6 +292,8 @@ class Block:
 
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
         op = Operator(self, type, inputs, outputs, attrs)
+        if _device_guard_stack and "op_device" not in op.attrs:
+            op.attrs["op_device"] = _device_guard_stack[-1]
         self.ops.append(op)
         self.program._bump_version()
         return op

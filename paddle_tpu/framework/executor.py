@@ -199,14 +199,16 @@ def lower_block_with_backward(ops, env, ctx, bw_idx, fetch_names,
     return run_ops(tail_ops, env2, ctx)
 
 
-def _merge_fetch(v, name, block, ctx, batch_axis, replicated_names):
+def _merge_fetch(v, name, block, ctx, batch_axis, replicated_names,
+                 seq_axis=None):
     """Cross-device fetch semantics under data parallelism — the analog of
     the reference's FetchOpHandle merging per-device results
     (ref: framework/details/fetch_op_handle.cc): batch-sharded tensors are
     all-gathered back to the global batch; scalar float metrics (mean loss,
     accuracy) are averaged; scalar int counters (Correct/Total) are summed;
     replicated values (persistables, allreduced grads, optimizer-zone
-    temporaries) pass through untouched."""
+    temporaries) pass through untouched.  Scalars also reduce over the
+    sequence-parallel axis (per-token losses are sharded over sp too)."""
     if not ctx.axis_names or batch_axis is None:
         return v
     if name in replicated_names:
@@ -214,10 +216,12 @@ def _merge_fetch(v, name, block, ctx, batch_axis, replicated_names):
     var = block._find_var_recursive(name)
     if var is not None and var.persistable:
         return v
+    reduce_axes = tuple(a for a in (batch_axis, seq_axis)
+                        if a and a in ctx.axis_names)
     if getattr(v, "ndim", 0) == 0:
         if jnp.issubdtype(v.dtype, jnp.integer):
-            return jax.lax.psum(v, batch_axis)
-        return jax.lax.pmean(v, batch_axis)
+            return jax.lax.psum(v, reduce_axes)
+        return jax.lax.pmean(v, reduce_axes)
     return jax.lax.all_gather(v, batch_axis, axis=0, tiled=True)
 
 
@@ -271,10 +275,14 @@ class Executor:
         mesh = None
         axis_names = ()
         batch_axis = None
+        seq_axis = None
+        feed_specs = {}
         if isinstance(program, CompiledProgram):
             mesh = program._mesh
             axis_names = program._axis_names
             batch_axis = program._batch_axis
+            seq_axis = program._seq_axis
+            feed_specs = program._feed_specs
             program = program._program
 
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
@@ -283,7 +291,7 @@ class Executor:
                 for k, v in feed.items()}
 
         step = self._compile(program, feed, fetch_names, scope, mesh,
-                             axis_names, batch_axis)
+                             axis_names, batch_axis, seq_axis, feed_specs)
 
         state_in = {}
         for n in step.state_in_names:
@@ -312,7 +320,7 @@ class Executor:
                             for k, v in feed.items()))
 
     def _compile(self, program, feed, fetch_names, scope, mesh, axis_names,
-                 batch_axis):
+                 batch_axis, seq_axis=None, feed_specs=None):
         key = (id(program), program._version, self._feed_signature(feed),
                tuple(fetch_names), id(mesh))
         if key in self._cache:
@@ -359,13 +367,18 @@ class Executor:
         replicated_names = _replicated_var_names(ops, bw_idx)
 
         def step(feed_vals, state_vals, rng_key):
-            if mesh is not None and batch_axis is not None:
-                # distinct randomness per shard (dropout masks must differ
-                # across devices, as each device has a different NCCL-rank
-                # curand seed in the reference); the carried key advances
-                # from the replicated base so state stays replicated
-                shard_key = jax.random.fold_in(
-                    rng_key, jax.lax.axis_index(batch_axis))
+            # distinct randomness per data/sequence shard (dropout masks must
+            # differ across devices, as each device has a different NCCL-rank
+            # curand seed in the reference) — but NOT across tp/pp, where
+            # activations are replicated and masks must agree; the carried
+            # key advances from the replicated base so state stays replicated
+            fold_axes = [a for a in (batch_axis, seq_axis)
+                         if a and a in axis_names]
+            if mesh is not None and fold_axes:
+                shard_key = rng_key
+                for a in fold_axes:
+                    shard_key = jax.random.fold_in(
+                        shard_key, jax.lax.axis_index(a))
                 next_base = jax.random.split(rng_key, 1)[0]
             else:
                 shard_key, next_base = rng_key, None
@@ -379,14 +392,16 @@ class Executor:
                 env = lower_block_with_backward(
                     ops, env, ctx, bw_idx, fetch_names, state_out_names)
             fetches = [_merge_fetch(env[n], n, block, ctx, batch_axis,
-                                    replicated_names)
+                                    replicated_names, seq_axis)
                        for n in fetch_names]
             state_out = {n: env[n] for n in state_out_names}
             return fetches, state_out, \
                 (next_base if next_base is not None else ctx.key)
 
         if mesh is not None:
-            fn = self._wrap_data_parallel(step, mesh, axis_names, batch_axis)
+            fn = self._wrap_sharded(step, mesh, axis_names, batch_axis,
+                                    program, feed_names, state_in_names,
+                                    state_out_names, feed_specs or {})
         else:
             fn = jax.jit(step, donate_argnums=(1,))
 
@@ -395,21 +410,47 @@ class Executor:
         self._cache[key] = compiled
         return compiled
 
-    def _wrap_data_parallel(self, step, mesh, axis_names, batch_axis):
-        """Run the step under shard_map: feeds sharded on their batch dim,
-        state replicated.  Collective ops inside (c_allreduce_sum inserted by
-        the collective transpiler, ref: transpiler/collective.py:209) become
-        lax.psum over the mesh axis."""
+    def _wrap_sharded(self, step, mesh, axis_names, batch_axis, program,
+                      feed_names, state_in_names, state_out_names,
+                      feed_specs):
+        """Run the step under shard_map over the FULL named mesh: feeds
+        sharded on their batch (dp) / sequence (sp) dims, params per their
+        ``dist_attr`` PartitionSpec (tensor-parallel shards), everything
+        else replicated.  Collective ops inside (c_allreduce_sum inserted by
+        the collective transpiler, ref: transpiler/collective.py:209; the
+        Megatron f/g pair from parallel/tp_layers.py) become XLA collectives
+        over the corresponding ICI axes."""
         from jax.sharding import PartitionSpec as P
 
-        axis = batch_axis or axis_names[0]
+        def var_spec(name):
+            for b in program.blocks:
+                v = b.vars.get(name)
+                if v is not None:
+                    da = getattr(v, "dist_attr", None)
+                    if da:
+                        return P(*da)
+                    return P()
+            return P()
+
+        def feed_spec(name):
+            if name in feed_specs:
+                s = feed_specs[name]
+                return s if isinstance(s, P) else P(*s)
+            # default: batch dim sharded over dp (feeds replicated when the
+            # mesh has no data-parallel axis, e.g. pure tp/pp programs)
+            return P(batch_axis) if batch_axis else P()
+
+        state_in_specs = {n: var_spec(n) for n in state_in_names}
+        state_out_specs = {n: var_spec(n) for n in state_out_names}
 
         def sharded(feed_vals, state_vals, rng_key):
-            in_specs = ({k: P(axis) for k in feed_vals},
-                        {k: P() for k in state_vals}, P())
-            # fetches/state are replicated after the grad allreduce
+            in_specs = ({k: feed_spec(k) for k in feed_vals},
+                        {k: state_in_specs[k] for k in state_vals}, P())
+            # fetches are merged to replicated inside the step; state keeps
+            # its (possibly tp-sharded) layout
             fn = jax.shard_map(step, mesh=mesh, in_specs=in_specs,
-                               out_specs=(P(), P(), P()), check_vma=False)
+                               out_specs=(P(), state_out_specs, P()),
+                               check_vma=False)
             return fn(feed_vals, state_vals, rng_key)
 
         return jax.jit(sharded, donate_argnums=(1,))

@@ -8,3 +8,5 @@ from . import optimizer_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import controlflow_ops  # noqa: F401
+from . import tp_ops        # noqa: F401
+from . import pipeline_op   # noqa: F401

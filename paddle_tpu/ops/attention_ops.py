@@ -56,6 +56,22 @@ def _fused_attention(ctx, ins, attrs):
     dropout_rate = attrs.get("dropout_rate", 0.0)
     is_test = attrs.get("is_test", False) or ctx.is_test
     use_pallas = attrs.get("use_flash", True)
+    # sequence parallelism: attention rings over the sp axis (the q/k/v
+    # entering here hold only this device's sequence shard)
+    seq_axis = attrs.get("_seq_axis")
+    if seq_axis and seq_axis in ctx.axis_names:
+        from ..parallel.ring_attention import ring_attention
+        kv_mask = x(ins, "KVMask")
+        out = ring_attention(
+            _split_heads(q, n_head), _split_heads(k, n_head),
+            _split_heads(v, n_head), seq_axis,
+            causal=attrs.get("causal", False), kv_mask=kv_mask)
+        return {"Out": _merge_heads(out)}
+    if bias is None:
+        kv_mask = x(ins, "KVMask")
+        if kv_mask is not None:        # [B, S] 0/1 valid-key mask → bias
+            bias = (1.0 - kv_mask.astype(jnp.float32))[:, None, None, :] \
+                * -1e9
     if use_pallas and not dropout_rate:
         try:
             from .pallas.flash_attention import flash_attention_bshd

@@ -23,13 +23,18 @@ from .registry import register, x
 
 
 def _ring_axis(ctx, attrs):
-    """ring_id → mesh axis name; None when not running under shard_map."""
+    """ring_id → mesh axis name(s); None when not running under shard_map.
+    `_axis_name` may be a tuple (reduce over several axes at once — e.g.
+    grad allreduce over (dp, sp))."""
     if not ctx.axis_names:
         return None
     ring_id = attrs.get("ring_id", 0)
     # the executor records the ring→axis mapping; default ring 0 = first axis
     mapping = attrs.get("_axis_name")
     if mapping:
+        if isinstance(mapping, (tuple, list)):
+            axes = tuple(a for a in mapping if a in ctx.axis_names)
+            return axes or None
         return mapping if mapping in ctx.axis_names else None
     if isinstance(ring_id, int) and ring_id < len(ctx.axis_names):
         return ctx.axis_names[ring_id]
@@ -71,7 +76,10 @@ def _c_allgather(ctx, ins, attrs):
     axis = _ring_axis(ctx, attrs)
     if axis is None:
         return {"Out": a}
-    return {"Out": lax.all_gather(a, axis, axis=0, tiled=True)}
+    dim = attrs.get("gather_dim", 0)
+    if dim < 0:
+        dim += a.ndim
+    return {"Out": lax.all_gather(a, axis, axis=dim, tiled=True)}
 
 
 @register("c_reducescatter")
@@ -117,7 +125,10 @@ def _c_embedding(ctx, ins, attrs):
     """Vocab-sharded embedding lookup (model parallel)."""
     w, ids = x(ins, "W"), x(ins, "Ids")
     axis = _ring_axis(ctx, attrs)
-    start = attrs.get("start_index", 0)
+    if "per_shard_rows" in attrs and axis is not None:
+        start = lax.axis_index(axis) * attrs["per_shard_rows"]
+    else:
+        start = attrs.get("start_index", 0)
     local = ids.astype(jnp.int32) - start
     valid = (local >= 0) & (local < w.shape[0])
     out = jnp.take(w, jnp.clip(local, 0, w.shape[0] - 1), axis=0)

@@ -92,6 +92,11 @@ class Optimizer:
                             persistable=True)
         sv = startup.create_var(name=var_name, shape=shape, dtype=dtype,
                                 persistable=True)
+        # moment/accumulator shards follow the param's tp sharding
+        da = getattr(param, "dist_attr", None)
+        if da and (shape == list(param.shape)):
+            v.dist_attr = da
+            sv.dist_attr = da
         startup.append_op(type="fill_constant", outputs={"Out": [sv]},
                           attrs={"shape": shape, "dtype": dtype,
                                  "value": float(fill_value)})

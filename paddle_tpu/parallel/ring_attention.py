@@ -1,0 +1,99 @@
+"""Ring attention — sequence/context parallelism over an ICI mesh axis.
+
+NEW capability: the reference (2020) has no sequence parallelism
+(SURVEY §5 "Long-context: Absent").  Design follows blockwise ring
+attention: every device holds the full Q for its sequence shard and
+rotates K/V shards around the `sp` ring with `lax.ppermute`, maintaining
+numerically-stable online-softmax accumulators (m, l, acc) exactly like
+flash attention — so the full S×S score matrix never materialises and
+sequence length scales linearly with the number of devices.
+
+Pure-jax formulation: XLA overlaps the ppermute with the per-block matmuls
+(async collectives over ICI), and reverse-mode autodiff of the scan gives
+the backward pass without a hand-written kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_attention(q, k, v, axis_name: str,
+                   bias: Optional[jax.Array] = None,
+                   causal: bool = False,
+                   kv_mask: Optional[jax.Array] = None):
+    """Blockwise ring attention.
+
+    Args:
+      q, k, v: [B, H, S_local, D] — this device's sequence shard.
+      axis_name: the sp mesh axis to ring over.
+      bias: optional additive bias for the LOCAL block grid, shape
+        broadcastable to [B, H, S_local, S_local] applied per source block
+        (rare; prefer kv_mask).
+      causal: apply causal masking using global positions.
+      kv_mask: [B, S_local] bool/0-1 — valid-key mask for the local shard;
+        travels around the ring with K/V.
+
+    Returns [B, H, S_local, D].
+    """
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def _vary(t):
+        # mark freshly-created accumulators as varying over the sp axis so
+        # the scan carry types match (shard_map VMA tracking)
+        try:
+            return lax.pcast(t, (axis_name,), to="varying")
+        except (AttributeError, TypeError):   # older jax: no VMA tracking
+            try:
+                return lax.pvary(t, (axis_name,))
+            except AttributeError:
+                return t
+
+    q32 = q.astype(jnp.float32)
+    m0 = _vary(jnp.full((b, h, s_loc), -jnp.inf, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, s_loc), jnp.float32))
+    acc0 = _vary(jnp.zeros((b, h, s_loc, d), jnp.float32))
+    mask0 = kv_mask if kv_mask is not None else _vary(
+        jnp.ones((b, s_loc), jnp.float32))
+    q_pos = my_idx * s_loc + jnp.arange(s_loc)
+
+    def step(carry, i):
+        k_blk, v_blk, msk, m, l, acc = carry
+        src = (my_idx - i) % n                       # owner of this K/V block
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32))
+        s = s * scale
+        if bias is not None:
+            s = s + bias.astype(s.dtype)
+        neg = jnp.asarray(-1e30, s.dtype)
+        s = jnp.where(msk[:, None, None, :].astype(bool), s, neg)
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            cm = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(cm[None, None], s, neg)
+        blk_max = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, blk_max)
+        # renormalise previous accumulators to the new running max
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        msk = lax.ppermute(msk, axis_name, perm)
+        return (k_blk, v_blk, msk, m_new, l_new, acc_new), None
+
+    (_, _, _, m, l, acc), _ = lax.scan(
+        step, (k, v, mask0, m0, l0, acc0), jnp.arange(n))
+    # all-masked rows (fully padded) → zeros, not NaN
+    safe_l = jnp.where(l > 0, l, 1.0)
+    out = acc / safe_l[..., None]
+    return out.astype(q.dtype)
