@@ -8,6 +8,7 @@ Fleet-style distributed strategies.
 
 from . import ops            # registers all JAX op impls
 from . import fluid          # noqa: F401
+from . import dygraph        # noqa: F401
 from .framework.core import TPUPlace, CPUPlace, CUDAPlace  # noqa: F401
 
 __version__ = "0.1.0"

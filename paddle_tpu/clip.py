@@ -11,6 +11,10 @@ class GradientClipBase:
     def __call__(self, params_grads):
         raise NotImplementedError
 
+    def _eager_clip(self, params_grads):
+        """Dygraph-mode clipping over (param, grad-array) pairs."""
+        raise NotImplementedError
+
 
 class GradientClipByValue(GradientClipBase):
     def __init__(self, max, min=None):
@@ -32,6 +36,12 @@ class GradientClipByValue(GradientClipBase):
             out.append((p, c))
         return out
 
+    def _eager_clip(self, params_grads):
+        import jax.numpy as jnp
+        return [(p, jnp.clip(g, self.min, self.max)
+                 if getattr(p, "need_clip", True) else g)
+                for p, g in params_grads]
+
 
 class GradientClipByNorm(GradientClipBase):
     def __init__(self, clip_norm):
@@ -50,6 +60,17 @@ class GradientClipByNorm(GradientClipBase):
                             outputs={"Out": [c]},
                             attrs={"max_norm": self.clip_norm})
             out.append((p, c))
+        return out
+
+    def _eager_clip(self, params_grads):
+        import jax.numpy as jnp
+        out = []
+        for p, g in params_grads:
+            if getattr(p, "need_clip", True):
+                n = jnp.sqrt(jnp.sum(jnp.square(g)))
+                g = jnp.where(n > self.clip_norm,
+                              g * (self.clip_norm / n), g)
+            out.append((p, g))
         return out
 
 
@@ -109,6 +130,17 @@ class GradientClipByGlobalNorm(GradientClipBase):
                             outputs={"Out": [c]}, attrs={"axis": -1})
             out.append((p, c))
         return out
+
+    def _eager_clip(self, params_grads):
+        import jax.numpy as jnp
+        sq = [jnp.sum(jnp.square(g)) for p, g in params_grads
+              if getattr(p, "need_clip", True)]
+        if not sq:
+            return params_grads
+        gnorm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return [(p, g * scale if getattr(p, "need_clip", True) else g)
+                for p, g in params_grads]
 
 
 # legacy program-level clip (ref: clip.py set_gradient_clip) — stored and

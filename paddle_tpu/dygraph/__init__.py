@@ -1,0 +1,22 @@
+"""Imperative (dygraph) mode — eager execution over the same JAX op library
+the static-graph executor lowers.
+
+Reference analog: ``paddle/fluid/imperative/`` (C++ Tracer + BasicEngine)
+and ``python/paddle/fluid/dygraph/`` (Layer/nn/base/checkpoint/parallel).
+See SURVEY.md §2.1 "Imperative engine" and §3.4 for the traced call stack.
+"""
+
+from .base import (guard, enabled, in_dygraph_mode, enable_dygraph,  # noqa
+                   disable_dygraph, no_grad, to_variable)
+from .varbase import VarBase  # noqa: F401
+from .tracer import tracer, Tracer  # noqa: F401
+from .layers import Layer, seed_parameters  # noqa: F401
+from .nn import (Linear, Conv2D, Conv2DTranspose, Pool2D, BatchNorm,  # noqa
+                 Embedding, LayerNorm, GroupNorm, InstanceNorm, Dropout,
+                 PRelu, Sequential, LayerList, ParameterList)
+from .checkpoint import save_dygraph, load_dygraph  # noqa: F401
+from .parallel import (ParallelEnv, Env, prepare_context,  # noqa: F401
+                       DataParallel)
+from .. import jit  # noqa: F401  (dygraph→static lives at paddle_tpu.jit)
+from ..jit import (declarative, to_static, TracedLayer,  # noqa: F401
+                   ProgramTranslator)

@@ -1,0 +1,80 @@
+"""Dygraph mode state: guard / enable / disable, no_grad, to_variable.
+
+Reference analog: python/paddle/fluid/dygraph/base.py (``guard``:167,
+``enabled``, ``no_grad``:120, ``to_variable``:268) backed by the C++ tracer
+toggled via ``framework._dygraph_guard``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import numpy as np
+
+from .tracer import tracer
+from .varbase import VarBase
+
+_in_dygraph = False
+
+
+def enabled() -> bool:
+    return _in_dygraph
+
+
+def in_dygraph_mode() -> bool:
+    return _in_dygraph
+
+
+def enable_dygraph(place=None):
+    global _in_dygraph
+    _in_dygraph = True
+
+
+def disable_dygraph():
+    global _in_dygraph
+    _in_dygraph = False
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """``with fluid.dygraph.guard():`` — eager mode on, tape reset."""
+    global _in_dygraph
+    prev = _in_dygraph
+    _in_dygraph = True
+    tracer().reset()
+    try:
+        yield
+    finally:
+        _in_dygraph = prev
+
+
+class no_grad:
+    """Context manager AND decorator disabling tape recording
+    (ref: dygraph/base.py no_grad)."""
+
+    def __enter__(self):
+        self._prev = tracer()._grad_enabled
+        tracer()._grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        tracer()._grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+def to_variable(value, name=None, zero_copy=None):
+    """numpy / list / VarBase → VarBase (ref: dygraph/base.py:268).
+
+    Host→device transfer happens here (the analog of the reference's
+    PrepareData H2D copy); XLA keeps the array on the TPU afterwards."""
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(np.asarray(value), name=name)

@@ -1,0 +1,51 @@
+"""save_dygraph / load_dygraph (ref: python/paddle/fluid/dygraph/
+checkpoint.py — ``.pdparams`` param dicts and ``.pdopt`` optimizer state).
+
+Arrays are stored host-side with numpy's npz container (the analog of the
+reference's save_combine binary); TPU arrays are pulled to host here and
+pushed back on load."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def _to_numpy_dict(state_dict):
+    out = {}
+    for k, v in state_dict.items():
+        out[k] = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+    return out
+
+
+def save_dygraph(state_dict, model_path: str):
+    """state_dict → ``<model_path>.pdparams`` (or ``.pdopt`` when the dict
+    came from an optimizer)."""
+    base = os.path.dirname(model_path)
+    if base:
+        os.makedirs(base, exist_ok=True)
+    is_opt = any(k == "__opt__" or k.endswith("__step__")
+                 for k in state_dict) or state_dict.get("_is_optimizer")
+    suffix = ".pdopt" if is_opt else ".pdparams"
+    np.savez(model_path + suffix, **_to_numpy_dict(
+        {k: v for k, v in state_dict.items() if k != "_is_optimizer"}))
+    # np.savez appends .npz — rename to the paddle-style extension
+    os.replace(model_path + suffix + ".npz", model_path + suffix)
+
+
+def load_dygraph(model_path: str):
+    """Returns (param_dict, opt_dict); either may be None
+    (ref: checkpoint.py load_dygraph)."""
+    params, opt = None, None
+    p = model_path + ".pdparams"
+    o = model_path + ".pdopt"
+    if os.path.exists(p):
+        with np.load(p, allow_pickle=False) as z:
+            params = {k: z[k] for k in z.files}
+    if os.path.exists(o):
+        with np.load(o, allow_pickle=False) as z:
+            opt = {k: z[k] for k in z.files}
+    if params is None and opt is None:
+        raise ValueError(f"no checkpoint found at {model_path}(.pdparams)")
+    return params, opt

@@ -1,0 +1,219 @@
+"""``Layer`` — the dygraph module base class
+(ref: python/paddle/fluid/dygraph/layers.py Layer).
+
+Parameters are eager VarBases (stop_gradient=False, persistable=True)
+initialised host-side with the same distributions the static-mode startup
+program would use (framework/initializer.py numpy_value)."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from .varbase import VarBase
+from .tracer import tracer
+from ..framework import unique_name
+from ..framework.initializer import (Initializer, XavierInitializer,
+                                     ConstantInitializer)
+from ..framework.layer_helper import ParamAttr
+
+_param_rng = np.random.RandomState(90210)
+
+
+def seed_parameters(s: int):
+    """Deterministic eager param init (test hook)."""
+    global _param_rng
+    _param_rng = np.random.RandomState(s)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None,
+                 dtype: str = "float32"):
+        self._full_name = unique_name.generate(
+            name_scope or self.__class__.__name__.lower())
+        self._dtype = dtype
+        self.training = True
+        self._parameters: "OrderedDict[str, VarBase]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, VarBase]" = OrderedDict()
+
+    # -- construction ----------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None,
+                         is_bias=False, default_initializer=None):
+        dtype = dtype or self._dtype
+        init = default_initializer
+        name = None
+        if isinstance(attr, ParamAttr):
+            if attr.initializer is not None:
+                init = attr.initializer
+            name = attr.name
+        elif isinstance(attr, Initializer):
+            init = attr
+        elif attr is False:
+            return None
+        if init is None:
+            init = ConstantInitializer(0.0) if is_bias \
+                else XavierInitializer()
+        value = init.numpy_value(tuple(shape), dtype, _param_rng)
+        p = VarBase(value, name=name or unique_name.generate(
+            f"{self._full_name}.w"), stop_gradient=False, persistable=True)
+        if isinstance(attr, ParamAttr):
+            p.optimize_attrs = {"learning_rate": attr.learning_rate}
+            p.regularizer = attr.regularizer
+            p.trainable = attr.trainable
+            p.need_clip = attr.need_clip
+            if not attr.trainable:
+                p.stop_gradient = True
+        return p
+
+    def add_parameter(self, name: str, parameter: Optional[VarBase]):
+        if parameter is not None:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, value, persistable=True):
+        b = value if isinstance(value, VarBase) else VarBase(value)
+        b.stop_gradient = True
+        b.persistable = persistable
+        self._buffers[name] = b
+        return b
+
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        if isinstance(value, VarBase) and params is not None \
+                and value.persistable:
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer) and subs is not None:
+            subs[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"{self.__class__.__name__!r} has no attribute {name!r}")
+
+    # -- traversal -------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers)]
+
+    def named_parameters(self, include_sublayers=True, prefix=""
+                         ) -> Iterator[Tuple[str, VarBase]]:
+        out, seen = [], set()
+        for n, p in self._parameters.items():
+            if id(p) not in seen:
+                seen.add(id(p))
+                out.append((f"{prefix}{n}" if prefix else n, p))
+        if include_sublayers:
+            for sn, sub in self._sub_layers.items():
+                for n, p in sub.named_parameters(
+                        True, prefix=f"{prefix}{sn}."):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        out.append((n, p))
+        return out
+
+    def sublayers(self, include_self=False):
+        out = [self] if include_self else []
+        for sub in self._sub_layers.values():
+            out.extend(sub.sublayers(include_self=True))
+        return out
+
+    def named_sublayers(self, prefix=""):
+        out = []
+        for n, sub in self._sub_layers.items():
+            full = f"{prefix}{n}" if prefix else n
+            out.append((full, sub))
+            out.extend(sub.named_sublayers(prefix=f"{full}."))
+        return out
+
+    def buffers(self, include_sublayers=True):
+        out = list(self._buffers.values())
+        if include_sublayers:
+            for sub in self._sub_layers.values():
+                out.extend(sub.buffers(True))
+        return out
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # -- train/eval mode (ref: layers.py train/eval) --------------------
+    def train(self):
+        self.training = True
+        tracer().train_mode = True
+        for sub in self._sub_layers.values():
+            sub.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        tracer().train_mode = False
+        for sub in self._sub_layers.values():
+            sub.eval()
+        return self
+
+    # -- state dict ------------------------------------------------------
+    def state_dict(self, include_sublayers=True):
+        sd = OrderedDict()
+        for n, p in self.named_parameters(include_sublayers):
+            sd[n] = p.numpy()
+        for n, b in self._named_buffers():
+            sd[n] = b.numpy()
+        return sd
+
+    def _named_buffers(self, prefix=""):
+        out = []
+        for n, b in self._buffers.items():
+            out.append((f"{prefix}{n}" if prefix else n, b))
+        for sn, sub in self._sub_layers.items():
+            out.extend(sub._named_buffers(prefix=f"{prefix}{sn}."))
+        return out
+
+    def set_state_dict(self, state_dict, include_sublayers=True):
+        own = dict(self.named_parameters(include_sublayers))
+        own.update(dict(self._named_buffers()))
+        missing = []
+        for n, v in state_dict.items():
+            if n in own:
+                tgt = own[n]
+                v = np.asarray(v)
+                if list(v.shape) != tgt.shape:
+                    raise ValueError(
+                        f"shape mismatch for {n}: checkpoint "
+                        f"{list(v.shape)} vs layer {tgt.shape}")
+                tgt.set_value(v.astype(tgt.dtype))
+            else:
+                missing.append(n)
+        return missing
+
+    # aliases matching the reference
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- forward ---------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)

@@ -1,0 +1,364 @@
+"""Dygraph layer classes (ref: python/paddle/fluid/dygraph/nn.py — Linear,
+Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm, GroupNorm, InstanceNorm,
+Dropout, Conv2DTranspose, PRelu).
+
+Each forward traces the SAME registered JAX op the static-graph executor
+lowers (ops/nn_ops.py), so eager and static numerics match exactly."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .layers import Layer
+from .tracer import tracer
+from .varbase import VarBase
+from ..framework.initializer import ConstantInitializer
+
+
+def _op(op_type, ins, attrs=None):
+    return tracer().trace_op(op_type, ins, attrs)
+
+
+_ACTS = {"relu", "sigmoid", "tanh", "gelu", "leaky_relu", "relu6",
+         "softmax", "elu", "swish", "hard_swish", "hard_sigmoid"}
+
+
+def _maybe_act(out, act):
+    if act is None:
+        return out
+    if act not in _ACTS:
+        raise ValueError(f"unsupported activation {act!r}")
+    return _op(act, {"X": [out]})["Out"]
+
+
+class Linear(Layer):
+    """ref: dygraph/nn.py Linear — y = act(xW + b), W shape [in, out]."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter([input_dim, output_dim],
+                                            attr=param_attr)
+        self.bias = self.create_parameter([output_dim], attr=bias_attr,
+                                          is_bias=True)
+        self._act = act
+
+    def forward(self, input):
+        out = _op("matmul", {"X": [input], "Y": [self.weight]})["Out"]
+        if self.bias is not None:
+            out = _op("elementwise_add",
+                      {"X": [out], "Y": [self.bias]}, {"axis": -1})["Out"]
+        return _maybe_act(out, self._act)
+
+
+class Conv2D(Layer):
+    """ref: dygraph/nn.py Conv2D (NCHW, filters OIHW)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) \
+            else (filter_size, filter_size)
+        self._attrs = {
+            "strides": list(stride) if isinstance(stride, (list, tuple))
+            else [stride, stride],
+            "paddings": list(padding) if isinstance(padding, (list, tuple))
+            else [padding, padding],
+            "dilations": list(dilation)
+            if isinstance(dilation, (list, tuple)) else [dilation, dilation],
+            "groups": groups}
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups, fs[0], fs[1]],
+            attr=param_attr)
+        self.bias = self.create_parameter([num_filters], attr=bias_attr,
+                                          is_bias=True)
+        self._act = act
+
+    def forward(self, input):
+        out = _op("conv2d", {"Input": [input], "Filter": [self.weight]},
+                  self._attrs)["Output"]
+        if self.bias is not None:
+            b = self.bias.reshape([1, -1, 1, 1])
+            out = out + b
+        return _maybe_act(out, self._act)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) \
+            else (filter_size, filter_size)
+        self._attrs = {
+            "strides": [stride, stride] if not isinstance(
+                stride, (list, tuple)) else list(stride),
+            "paddings": [padding, padding] if not isinstance(
+                padding, (list, tuple)) else list(padding),
+            "dilations": [dilation, dilation] if not isinstance(
+                dilation, (list, tuple)) else list(dilation),
+            "groups": groups}
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // groups, fs[0], fs[1]],
+            attr=param_attr)
+        self.bias = self.create_parameter([num_filters], attr=bias_attr,
+                                          is_bias=True)
+        self._act = act
+
+    def forward(self, input):
+        out = _op("conv2d_transpose",
+                  {"Input": [input], "Filter": [self.weight]},
+                  self._attrs)["Output"]
+        if self.bias is not None:
+            out = out + self.bias.reshape([1, -1, 1, 1])
+        return _maybe_act(out, self._act)
+
+
+class Pool2D(Layer):
+    """ref: dygraph/nn.py Pool2D."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, ceil_mode=False,
+                 exclusive=True):
+        super().__init__()
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": [pool_size, pool_size] if not isinstance(
+                pool_size, (list, tuple)) else list(pool_size),
+            "strides": [pool_stride, pool_stride] if not isinstance(
+                pool_stride, (list, tuple)) else list(pool_stride),
+            "paddings": [pool_padding, pool_padding] if not isinstance(
+                pool_padding, (list, tuple)) else list(pool_padding),
+            "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+            "exclusive": exclusive}
+
+    def forward(self, input):
+        return _op("pool2d", {"X": [input]}, self._attrs)["Out"]
+
+
+class BatchNorm(Layer):
+    """ref: dygraph/nn.py BatchNorm — running stats are buffers updated
+    in-place each training forward (MeanOut/VarianceOut write-back)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout="NCHW", use_global_stats=False):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [num_channels], attr=param_attr,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr,
+                                          is_bias=True)
+        self.register_buffer("_mean",
+                             np.zeros([num_channels], dtype=dtype))
+        self.register_buffer("_variance",
+                             np.ones([num_channels], dtype=dtype))
+        self._attrs = {"momentum": momentum, "epsilon": epsilon,
+                       "data_layout": data_layout,
+                       "use_global_stats": use_global_stats}
+        self._act = act
+
+    def forward(self, input):
+        attrs = dict(self._attrs, is_test=not self.training)
+        outs = _op("batch_norm",
+                   {"X": [input], "Scale": [self.weight],
+                    "Bias": [self.bias], "Mean": [self._buffers["_mean"]],
+                    "Variance": [self._buffers["_variance"]]}, attrs)
+        if self.training and not self._attrs["use_global_stats"]:
+            self._buffers["_mean"].set_value(outs["MeanOut"].value)
+            self._buffers["_variance"].set_value(outs["VarianceOut"].value)
+        return _maybe_act(outs["Y"], self._act)
+
+
+class Embedding(Layer):
+    """ref: dygraph/nn.py Embedding (lookup_table_v2)."""
+
+    def __init__(self, size, is_sparse=False, padding_idx=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._size = list(size)
+        self._padding_idx = -1 if padding_idx is None else (
+            padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+        self.weight = self.create_parameter(self._size, attr=param_attr)
+
+    def forward(self, input):
+        return _op("lookup_table_v2",
+                   {"W": [self.weight], "Ids": [input]},
+                   {"padding_idx": self._padding_idx})["Out"]
+
+
+class LayerNorm(Layer):
+    """ref: dygraph/nn.py LayerNorm (normalises trailing dims)."""
+
+    def __init__(self, normalized_shape, scale=True, shift=True,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        n = int(np.prod(self._normalized_shape))
+        self.weight = self.create_parameter(
+            [n], attr=param_attr,
+            default_initializer=ConstantInitializer(1.0)) if scale else None
+        self.bias = self.create_parameter([n], attr=bias_attr,
+                                          is_bias=True) if shift else None
+        self._epsilon = epsilon
+        self._act = act
+
+    def forward(self, input):
+        ins = {"X": [input]}
+        if self.weight is not None:
+            ins["Scale"] = [self.weight]
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        begin = len(input.shape) - len(self._normalized_shape)
+        out = _op("layer_norm", ins,
+                  {"epsilon": self._epsilon,
+                   "begin_norm_axis": begin})["Y"]
+        return _maybe_act(out, self._act)
+
+
+class GroupNorm(Layer):
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [channels], attr=param_attr,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([channels], attr=bias_attr,
+                                          is_bias=True)
+        self._attrs = {"groups": groups, "epsilon": epsilon}
+        self._act = act
+
+    def forward(self, input):
+        out = _op("group_norm",
+                  {"X": [input], "Scale": [self.weight],
+                   "Bias": [self.bias]}, self._attrs)["Y"]
+        return _maybe_act(out, self._act)
+
+
+class InstanceNorm(Layer):
+    def __init__(self, num_channels, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.scale = self.create_parameter(
+            [num_channels], attr=param_attr,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr,
+                                          is_bias=True)
+        self._epsilon = epsilon
+
+    def forward(self, input):
+        return _op("instance_norm",
+                   {"X": [input], "Scale": [self.scale],
+                    "Bias": [self.bias]}, {"epsilon": self._epsilon})["Y"]
+
+
+class Dropout(Layer):
+    """ref: dygraph/nn.py Dropout — active only in train mode."""
+
+    def __init__(self, p=0.5,
+                 dropout_implementation="downgrade_in_infer"):
+        super().__init__()
+        self._p = p
+        self._impl = dropout_implementation
+
+    def forward(self, input):
+        return _op("dropout", {"X": [input]},
+                   {"dropout_prob": self._p,
+                    "dropout_implementation": self._impl,
+                    "is_test": not self.training})["Out"]
+
+
+class PRelu(Layer):
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [channel]
+        else:
+            shape = list(input_shape)[1:]
+        self.weight = self.create_parameter(
+            shape, attr=param_attr,
+            default_initializer=ConstantInitializer(0.25))
+        self._mode = mode
+
+    def forward(self, input):
+        import jax.numpy as jnp
+
+        def fn(a, w):
+            if self._mode == "channel":
+                w = w.reshape((1, -1) + (1,) * (a.ndim - 2))
+            return jnp.where(a >= 0, a, a * w)
+        return tracer().trace_fn(fn, [input, self.weight],
+                                 op_type="prelu")[0]
+
+
+class Sequential(Layer):
+    """ref: dygraph/container.py Sequential."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        for i, item in enumerate(layers):
+            if isinstance(item, tuple):
+                name, layer = item
+            else:
+                name, layer = str(i), item
+            self.add_sublayer(name, layer)
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def forward(self, input):
+        for layer in self._sub_layers.values():
+            input = layer(input)
+        return input
+
+
+class LayerList(Layer):
+    """ref: dygraph/container.py LayerList."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        for i, layer in enumerate(sublayers or []):
+            self.add_sublayer(str(i), layer)
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def __getitem__(self, idx):
+        return self._sub_layers[str(idx)]
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        for i, p in enumerate(parameters or []):
+            self.add_parameter(str(i), p)
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)]

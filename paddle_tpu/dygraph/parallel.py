@@ -1,0 +1,129 @@
+"""Dygraph data parallelism (ref: python/paddle/fluid/dygraph/parallel.py —
+``ParallelEnv``, ``prepare_context``, ``DataParallel`` with scale_loss +
+apply_collective_grads over imperative/all_reduce.cc).
+
+TPU-native realisation: per-process eager replicas coordinated the way the
+reference's multi-process NCCL dygraph DP is — each process holds one
+replica; gradients are allreduced across processes after ``backward``.
+On a single-process TPU slice the efficient path is dygraph-to-static
+(``paddle_tpu.jit.to_static``) + pjit over the dp mesh axis, which subsumes
+this wrapper; eager DataParallel therefore allreduces via
+``jax.experimental.multihost_utils`` when a multi-process jax runtime is
+initialised and is an exact no-op when world_size == 1."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from .layers import Layer
+
+
+class ParallelEnv:
+    """Trainer topology from env vars (ref: dygraph/parallel.py Env —
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS)."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get(
+            "PADDLE_TRAINER_ID", os.environ.get("TPU_WORKER_ID", 0)))
+        self._world_size = int(os.environ.get(
+            "PADDLE_TRAINERS_NUM", os.environ.get("TPU_WORKER_COUNT", 1)))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._endpoints = [e for e in eps.split(",") if e]
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._endpoints
+
+
+Env = ParallelEnv  # 1.8 alias
+
+
+def prepare_context(strategy=None):
+    """ref: dygraph/parallel.py prepare_context — in the reference this
+    boots NCCLParallelContext; here multi-process jax is initialised by
+    ``paddle_tpu.distributed.init_parallel_env`` (jax.distributed)."""
+    return ParallelEnv()
+
+
+class DataParallel(Layer):
+    """Wraps a Layer for multi-process data parallelism."""
+
+    def __init__(self, layers: Layer, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._env = strategy if isinstance(strategy, ParallelEnv) \
+            else ParallelEnv()
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    @property
+    def nranks(self):
+        return max(self._env.world_size, 1)
+
+    def scale_loss(self, loss):
+        """loss / nranks before backward, matching the reference's
+        scale_loss (dygraph/parallel.py:340) and the transpiler's
+        loss-scaling semantics (transpiler/collective.py:190)."""
+        if self.nranks <= 1:
+            return loss
+        return loss * (1.0 / self.nranks)
+
+    def apply_collective_grads(self):
+        """Allreduce-sum every parameter gradient across processes
+        (analog of imperative/all_reduce.cc grouped allreduce)."""
+        if self.nranks <= 1:
+            return
+        if jax.process_count() <= 1:
+            raise RuntimeError(
+                "apply_collective_grads needs an initialised multi-process "
+                "jax runtime (call distributed.init_parallel_env first)")
+        from jax.experimental import multihost_utils
+        for p in self._layers.parameters():
+            if p._grad is not None:
+                summed = multihost_utils.process_allgather(p._grad)
+                p._grad = summed.sum(axis=0)
+
+    # delegate state to the wrapped layer
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, include_sublayers=True, prefix=""):
+        return self._layers.named_parameters(include_sublayers, prefix)
+
+    def state_dict(self, include_sublayers=True):
+        return self._layers.state_dict(include_sublayers)
+
+    def set_state_dict(self, state_dict, include_sublayers=True):
+        return self._layers.set_state_dict(state_dict, include_sublayers)
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict

@@ -1,0 +1,204 @@
+"""Eager-mode tracer + autograd engine.
+
+Reference analog: ``imperative::Tracer::TraceOp`` (imperative/tracer.cc:45)
+runs each op immediately and records a grad node;
+``BasicEngine::Execute`` (imperative/basic_engine.cc:161) walks the nodes in
+reverse and accumulates gradients (imperative/gradient_accumulator.cc).
+
+TPU-native realisation: ops are the same pure JAX functions the static-graph
+executor lowers (ops/registry.py).  When gradients are required, the op runs
+through ``jax.vjp`` and the tape node stores the VJP closure (residuals live
+as device arrays — the analog of the reference keeping forward buffers alive
+for the backward pass).  ``backward()`` replays the tape in reverse, summing
+fan-in like GradientAccumulator.  There is no per-op kernel dispatch: XLA
+owns dtype/device specialisation, and hot eager loops should be wrapped with
+``paddle_tpu.jit.to_static`` (the ProgramTranslator analog) to get one fused
+XLA executable.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import get_op, LoweringContext
+
+
+class _TapeNode:
+    __slots__ = ("inputs", "outputs", "out_avals", "vjp_fn", "op_type")
+
+    def __init__(self, op_type, inputs, outputs, out_avals, vjp_fn):
+        self.op_type = op_type
+        self.inputs = inputs            # list[VarBase] (diff inputs only)
+        self.outputs = outputs          # list[weakref to VarBase]
+        self.out_avals = out_avals      # [(shape, dtype)] — survives GC of
+        #                                 unused outputs (multi-output ops)
+        self.vjp_fn = vjp_fn
+
+
+class Tracer:
+    """Global eager tracer: runs ops, records the autograd tape."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.PRNGKey(seed)
+        self._tape: List[_TapeNode] = []
+        self._grad_enabled = True
+        self.train_mode = True
+
+    # -- PRNG (functional analog of per-device curand generator state) ---
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def seed(self, s: int):
+        self._key = jax.random.PRNGKey(s)
+
+    # -- tape ------------------------------------------------------------
+    def reset(self):
+        self._tape.clear()
+
+    def trace_fn(self, fn, inputs, op_type="py_fn", n_outputs=None):
+        """Run ``fn(*arrays) -> array | tuple`` eagerly; record VJP if any
+        input requires grad.  ``inputs`` are VarBase or raw arrays/scalars."""
+        from .varbase import VarBase
+
+        arrays = []
+        diff_idx = []
+        for i, v in enumerate(inputs):
+            if isinstance(v, VarBase):
+                arrays.append(v.value)
+                if self._grad_enabled and not v.stop_gradient:
+                    diff_idx.append(i)
+            else:
+                arrays.append(jnp.asarray(v))
+
+        record = bool(diff_idx)
+        if record:
+            const = list(arrays)
+
+            def fn_of_diff(*diff_arrays):
+                full = list(const)
+                for j, i in enumerate(diff_idx):
+                    full[i] = diff_arrays[j]
+                out = fn(*full)
+                return out if isinstance(out, tuple) else (out,)
+
+            outs, vjp_fn = jax.vjp(fn_of_diff,
+                                   *[arrays[i] for i in diff_idx])
+        else:
+            out = fn(*arrays)
+            outs = out if isinstance(out, tuple) else (out,)
+            vjp_fn = None
+
+        out_vars = [VarBase(o, stop_gradient=not record) for o in outs]
+        if record:
+            node = _TapeNode(
+                op_type,
+                [inputs[i] for i in diff_idx],
+                [weakref.ref(v) for v in out_vars],
+                [(o.shape, o.dtype) for o in outs],
+                vjp_fn)
+            self._tape.append(node)
+        return out_vars
+
+    def trace_op(self, op_type: str, ins: Dict[str, list],
+                 attrs: Optional[dict] = None, out_slots=None,
+                 stop_gradient_slots=()):
+        """Run a registered op (same slot convention as static mode).
+
+        ``ins`` maps slot → list of VarBase/arrays; returns dict
+        slot → VarBase (or list when the impl returns a list).
+        """
+        attrs = dict(attrs or {})
+        slots = [(slot, i) for slot, vs in ins.items()
+                 for i in range(len(vs))]
+        flat = [ins[slot][i] for slot, i in slots]
+        op_fn = get_op(op_type)
+        key = self.next_key()
+        is_test = not self.train_mode
+
+        out_spec: List[tuple] = []  # (slot, count, is_list)
+
+        def fn(*arrays):
+            d: Dict[str, list] = {}
+            for (slot, i), a in zip(slots, arrays):
+                d.setdefault(slot, []).append(a)
+            ctx = LoweringContext(key, is_test=is_test)
+            res = op_fn(ctx, d, attrs)
+            if not out_spec:
+                for s in sorted(res.keys()):
+                    v = res[s]
+                    if isinstance(v, list):
+                        out_spec.append((s, len(v), True))
+                    else:
+                        out_spec.append((s, 1, False))
+            flat_out = []
+            for s, n, is_list in out_spec:
+                v = res[s]
+                flat_out.extend(v if is_list else [v])
+            return tuple(flat_out)
+
+        out_vars = self.trace_fn(fn, flat, op_type=op_type)
+        result: Dict[str, object] = {}
+        it = iter(out_vars)
+        for s, n, is_list in out_spec:
+            if is_list:
+                result[s] = [next(it) for _ in range(n)]
+            else:
+                result[s] = next(it)
+        for s in stop_gradient_slots:
+            if s in result and hasattr(result[s], "stop_gradient"):
+                result[s].stop_gradient = True
+        return result
+
+    # -- backward (BasicEngine analog) -----------------------------------
+    def run_backward(self, root, grad=None, retain_graph=False):
+        from .varbase import VarBase
+        assert isinstance(root, VarBase)
+        if grad is None:
+            grad = jnp.ones_like(root.value)
+        grads: Dict[int, jnp.ndarray] = {id(root): grad}
+
+        for node in reversed(self._tape):
+            out_grads = []
+            any_live = False
+            for ref, (shape, dtype) in zip(node.outputs, node.out_avals):
+                v = ref()
+                g = grads.get(id(v)) if v is not None else None
+                if g is None:
+                    # dead or grad-free output → zero cotangent (a GC'd
+                    # side-output like layer_norm's Mean must not drop
+                    # the whole node)
+                    g = jnp.zeros(shape, dtype)
+                else:
+                    any_live = True
+                out_grads.append(g)
+            if not any_live:
+                continue
+            in_grads = node.vjp_fn(tuple(out_grads))
+            for v, g in zip(node.inputs, in_grads):
+                prev = grads.get(id(v))
+                grads[id(v)] = g if prev is None else prev + g
+
+        # materialise .grad on leaves and intermediates that asked for it
+        seen = set()
+        for node in self._tape:
+            for v in node.inputs:
+                if id(v) in grads and id(v) not in seen:
+                    seen.add(id(v))
+                    g = grads[id(v)]
+                    v._grad = g if v._grad is None else v._grad + g
+        if id(root) not in seen and not root.stop_gradient:
+            root._grad = grad if root._grad is None else root._grad + grad
+        if not retain_graph:
+            self.reset()
+
+
+_tracer = Tracer()
+
+
+def tracer() -> Tracer:
+    return _tracer

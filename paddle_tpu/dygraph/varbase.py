@@ -1,0 +1,188 @@
+"""Eager tensor — the analog of the reference's ``imperative::VarBase``
+(imperative/layer.h) exposed to Python as ``core.VarBase``
+(pybind/imperative.cc:387).
+
+Wraps one JAX device array.  ``stop_gradient`` defaults to True for data
+(like the reference, where only Parameters and explicitly-marked vars
+require grad); ``backward()`` drives the tape engine in tracer.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .tracer import tracer
+
+
+class VarBase:
+    def __init__(self, value, name=None, stop_gradient=True,
+                 persistable=False):
+        self.value = jnp.asarray(value)
+        self.name = name or ""
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self._grad = None
+
+    # -- basic introspection --------------------------------------------
+    @property
+    def shape(self):
+        return list(self.value.shape)
+
+    @property
+    def dtype(self):
+        return str(self.value.dtype)
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def __len__(self):
+        return self.value.shape[0]
+
+    def __float__(self):
+        return float(self.value)
+
+    def __repr__(self):
+        g = "" if self.stop_gradient else ", grad"
+        return f"VarBase(shape={self.shape}, dtype={self.dtype}{g})"
+
+    # -- autograd --------------------------------------------------------
+    @property
+    def grad(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    @property
+    def gradient_value(self):
+        return self._grad
+
+    def backward(self, retain_graph=False):
+        if getattr(self, "_static_output", False):
+            raise RuntimeError(
+                "this VarBase came out of a @to_static/@declarative "
+                "forward, which compiles inference only — use "
+                "paddle_tpu.jit.train_step for a compiled training step, "
+                "or call the undecorated forward for eager autograd")
+        tracer().run_backward(self, retain_graph=retain_graph)
+
+    def gradient(self):
+        return self.grad
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def detach(self):
+        v = VarBase(self.value, name=self.name, stop_gradient=True)
+        return v
+
+    def stop_gradient_(self, flag=True):
+        self.stop_gradient = flag
+        return self
+
+    # -- in-place value update (optimizer writes) ------------------------
+    def set_value(self, value):
+        if isinstance(value, VarBase):
+            value = value.value
+        self.value = jnp.asarray(value)
+
+    # -- traced elementwise ops ------------------------------------------
+    def _binop(self, other, fn, name):
+        outs = tracer().trace_fn(fn, [self, other], op_type=name)
+        return outs[0]
+
+    def __add__(self, other):
+        return self._binop(other, lambda a, b: a + b, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, lambda a, b: a - b, "elementwise_sub")
+
+    def __rsub__(self, other):
+        return self._binop(other, lambda a, b: b - a, "elementwise_sub")
+
+    def __mul__(self, other):
+        return self._binop(other, lambda a, b: a * b, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, lambda a, b: a / b, "elementwise_div")
+
+    def __rtruediv__(self, other):
+        return self._binop(other, lambda a, b: b / a, "elementwise_div")
+
+    def __pow__(self, other):
+        return self._binop(other, lambda a, b: a ** b, "elementwise_pow")
+
+    def __matmul__(self, other):
+        return self._binop(other, lambda a, b: a @ b, "matmul")
+
+    def __neg__(self):
+        return tracer().trace_fn(lambda a: -a, [self], op_type="scale")[0]
+
+    def __getitem__(self, idx):
+        return tracer().trace_fn(lambda a: a[idx], [self],
+                                 op_type="slice")[0]
+
+    # comparisons produce non-differentiable bools
+    def __lt__(self, other):
+        return self._cmp(other, lambda a, b: a < b)
+
+    def __le__(self, other):
+        return self._cmp(other, lambda a, b: a <= b)
+
+    def __gt__(self, other):
+        return self._cmp(other, lambda a, b: a > b)
+
+    def __ge__(self, other):
+        return self._cmp(other, lambda a, b: a >= b)
+
+    def _cmp(self, other, fn):
+        b = other.value if isinstance(other, VarBase) else other
+        return VarBase(fn(self.value, b), stop_gradient=True)
+
+    # -- common methods mirrored from the reference VarBase -------------
+    def astype(self, dtype):
+        from ..framework.core import convert_dtype
+        d = convert_dtype(dtype)
+        return tracer().trace_fn(lambda a: a.astype(d), [self],
+                                 op_type="cast")[0]
+
+    def reshape(self, shape):
+        return tracer().trace_fn(lambda a: jnp.reshape(a, shape), [self],
+                                 op_type="reshape")[0]
+
+    def transpose(self, perm):
+        return tracer().trace_fn(lambda a: jnp.transpose(a, perm), [self],
+                                 op_type="transpose")[0]
+
+    def mean(self, axis=None, keepdim=False):
+        return tracer().trace_fn(
+            lambda a: jnp.mean(a, axis=axis, keepdims=keepdim), [self],
+            op_type="reduce_mean")[0]
+
+    def sum(self, axis=None, keepdim=False):
+        return tracer().trace_fn(
+            lambda a: jnp.sum(a, axis=axis, keepdims=keepdim), [self],
+            op_type="reduce_sum")[0]
+
+    def max(self, axis=None, keepdim=False):
+        return tracer().trace_fn(
+            lambda a: jnp.max(a, axis=axis, keepdims=keepdim), [self],
+            op_type="reduce_max")[0]
+
+    def sqrt(self):
+        return tracer().trace_fn(jnp.sqrt, [self], op_type="sqrt")[0]
+
+    def exp(self):
+        return tracer().trace_fn(jnp.exp, [self], op_type="exp")[0]
+
+    def log(self):
+        return tracer().trace_fn(jnp.log, [self], op_type="log")[0]
+
+    def tanh(self):
+        return tracer().trace_fn(jnp.tanh, [self], op_type="tanh")[0]

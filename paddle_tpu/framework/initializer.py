@@ -17,6 +17,11 @@ class Initializer:
     def __call__(self, var, block):
         raise NotImplementedError
 
+    def numpy_value(self, shape, dtype, rng: "np.random.RandomState"):
+        """Eager (dygraph) initialisation — same distribution as the init op
+        this class appends in static mode, computed host-side."""
+        raise NotImplementedError
+
 
 class ConstantInitializer(Initializer):
     def __init__(self, value: float = 0.0):
@@ -26,6 +31,9 @@ class ConstantInitializer(Initializer):
         block.append_op(type="fill_constant", outputs={"Out": [var]},
                         attrs={"shape": list(var.shape), "dtype": var.dtype,
                                "value": float(self.value)})
+
+    def numpy_value(self, shape, dtype, rng):
+        return np.full(shape, self.value, dtype=dtype)
 
 
 class UniformInitializer(Initializer):
@@ -38,6 +46,9 @@ class UniformInitializer(Initializer):
                                "min": self.low, "max": self.high,
                                "seed": self.seed})
 
+    def numpy_value(self, shape, dtype, rng):
+        return rng.uniform(self.low, self.high, size=shape).astype(dtype)
+
 
 class NormalInitializer(Initializer):
     def __init__(self, loc=0.0, scale=1.0, seed=0):
@@ -48,6 +59,9 @@ class NormalInitializer(Initializer):
                         attrs={"shape": list(var.shape), "dtype": var.dtype,
                                "mean": self.loc, "std": self.scale,
                                "seed": self.seed})
+
+    def numpy_value(self, shape, dtype, rng):
+        return rng.normal(self.loc, self.scale, size=shape).astype(dtype)
 
 
 class TruncatedNormalInitializer(Initializer):
@@ -60,6 +74,15 @@ class TruncatedNormalInitializer(Initializer):
                         attrs={"shape": list(var.shape), "dtype": var.dtype,
                                "mean": self.loc, "std": self.scale,
                                "seed": self.seed})
+
+    def numpy_value(self, shape, dtype, rng):
+        # resample out-of-[-2σ,2σ] draws, like truncated_gaussian_random
+        v = rng.normal(self.loc, self.scale, size=shape)
+        bad = np.abs(v - self.loc) > 2 * self.scale
+        while bad.any():
+            v[bad] = rng.normal(self.loc, self.scale, size=int(bad.sum()))
+            bad = np.abs(v - self.loc) > 2 * self.scale
+        return v.astype(dtype)
 
 
 def _fan_in_out(shape):
@@ -91,6 +114,16 @@ class XavierInitializer(Initializer):
             std = math.sqrt(2.0 / (fi + fo))
             NormalInitializer(0.0, std, self.seed)(var, block)
 
+    def numpy_value(self, shape, dtype, rng):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            return rng.uniform(-limit, limit, size=shape).astype(dtype)
+        std = math.sqrt(2.0 / (fi + fo))
+        return rng.normal(0.0, std, size=shape).astype(dtype)
+
 
 class MSRAInitializer(Initializer):
     """Kaiming/He init (ref: initializer.py MSRAInitializer)."""
@@ -107,6 +140,14 @@ class MSRAInitializer(Initializer):
         else:
             NormalInitializer(0.0, math.sqrt(2.0 / fi), self.seed)(var, block)
 
+    def numpy_value(self, shape, dtype, rng):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            return rng.uniform(-limit, limit, size=shape).astype(dtype)
+        return rng.normal(0.0, math.sqrt(2.0 / fi), size=shape).astype(dtype)
+
 
 class NumpyArrayInitializer(Initializer):
     def __init__(self, value):
@@ -117,6 +158,9 @@ class NumpyArrayInitializer(Initializer):
                         attrs={"shape": list(self.value.shape),
                                "dtype": var.dtype,
                                "values": self.value.reshape(-1).tolist()})
+
+    def numpy_value(self, shape, dtype, rng):
+        return self.value.reshape(shape).astype(dtype)
 
 
 # public aliases matching the reference's exported names

@@ -1,0 +1,305 @@
+"""Dygraph→static translation (ref: python/paddle/fluid/dygraph/jit.py and
+dygraph_to_static/program_translator.py — ``@declarative``, ``TracedLayer``).
+
+The reference rewrites Python ASTs into ProgramDesc.  The TPU-native analog
+is direct: every eager op is already a pure JAX function, so tracing the
+user's Python under ``jax.jit`` yields one fused XLA executable — the same
+"whole program" the AST path produces, without source rewriting.  Autograd
+composes too: the eager tape records VJP closures of *traced* arrays, so a
+full train step (forward + backward + optimizer) compiles into a single
+XLA program with buffer donation (``train_step`` below) — the analog of
+static-mode ``minimize`` + Executor, reached from dygraph code.
+
+Caching is per (shapes, dtypes, train-flag) like the reference's
+per-signature ConcreteProgram cache (program_translator.py CacheKey).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dygraph.layers import Layer
+from .dygraph.varbase import VarBase
+from .dygraph.tracer import tracer
+
+
+def _as_array(v):
+    if isinstance(v, VarBase):
+        return v.value
+    return jnp.asarray(v)
+
+
+def _sig_of(arrays, extra=()):
+    return tuple((a.shape, str(a.dtype)) for a in arrays) + tuple(extra)
+
+
+class _FreshTape:
+    """Run traced python on a clean tape, restoring the user's eager tape
+    (and a concrete PRNG key) afterwards so no tracers leak out."""
+
+    def __enter__(self):
+        t = tracer()
+        self._saved_tape = t._tape
+        self._saved_key = t._key
+        t._tape = []
+        return t
+
+    def __exit__(self, *exc):
+        t = tracer()
+        t._tape = self._saved_tape
+        t._key = self._saved_key
+        return False
+
+
+def _swap_values(vars_, new_values):
+    old = [v.value for v in vars_]
+    for v, nv in zip(vars_, new_values):
+        v.value = nv
+    return old
+
+
+class StaticFunction:
+    """A dygraph callable compiled per input signature
+    (ref: program_translator.py StaticFunction)."""
+
+    def __init__(self, fn: Callable, layer: Optional[Layer] = None):
+        self._fn = fn
+        self._layer = layer
+        self._cache: Dict[tuple, Callable] = {}
+
+    def _bind_layer(self, args):
+        if self._layer is not None:
+            return self._layer, args
+        if args and isinstance(args[0], Layer):
+            return args[0], args[1:]
+        return None, args
+
+    def __call__(self, *args):
+        layer, call_args = self._bind_layer(args)
+        arrays = [_as_array(a) for a in call_args]
+        params = layer.parameters() if layer is not None else []
+        buffers = layer.buffers() if layer is not None else []
+        training = layer.training if layer is not None else \
+            tracer().train_mode
+        sig = _sig_of(arrays, extra=(training, len(params)))
+
+        if sig not in self._cache:
+            fn, lyr = self._fn, layer
+            out_is_tuple = [False]
+
+            def pure(param_vals, buf_vals, key, input_vals):
+                with _FreshTape() as t:
+                    t._key = key
+                    t.train_mode = training
+                    old_p = _swap_values(params, param_vals)
+                    old_b = _swap_values(buffers, buf_vals)
+                    try:
+                        ins = [VarBase(v) for v in input_vals]
+                        out = fn(lyr, *ins) if lyr is not None \
+                            else fn(*ins)
+                        if isinstance(out, (tuple, list)):
+                            out_is_tuple[0] = True
+                            out_vals = [o.value for o in out]
+                        else:
+                            out_vals = [out.value]
+                        new_buf = [b.value for b in buffers]
+                    finally:
+                        _swap_values(params, old_p)
+                        _swap_values(buffers, old_b)
+                    return out_vals, new_buf
+
+            self._cache[sig] = (jax.jit(pure), out_is_tuple)
+
+        jitted, out_is_tuple = self._cache[sig]
+        key = tracer().next_key()
+        out_vals, new_buf = jitted([p.value for p in params],
+                                   [b.value for b in buffers], key, arrays)
+        for b, nv in zip(buffers, new_buf):
+            b.value = nv
+        outs = []
+        for v in out_vals:
+            o = VarBase(v)
+            o._static_output = True   # .backward() raises with guidance
+            outs.append(o)
+        return tuple(outs) if out_is_tuple[0] else outs[0]
+
+
+def declarative(fn=None):
+    """``@declarative`` / ``@to_static`` decorator
+    (ref: dygraph/jit.py declarative)."""
+    if fn is None:
+        return declarative
+
+    @functools.wraps(fn)
+    def wrapper(*args):
+        if not ProgramTranslator.enabled_flag:
+            return fn(*args)        # fall through to eager (ref: enable())
+        if not hasattr(wrapper, "_static"):
+            wrapper._static = StaticFunction(fn)
+        return wrapper._static(*args)
+    wrapper.__wrapped__ = fn
+    return wrapper
+
+
+to_static = declarative
+
+
+class TracedLayer:
+    """ref: dygraph/jit.py TracedLayer — wraps a Layer with a compiled
+    forward; ``save_inference_model`` exports params + input spec."""
+
+    def __init__(self, layer: Layer, static_fn: StaticFunction):
+        self._layer = layer
+        self._static = static_fn
+
+    @staticmethod
+    def trace(layer: Layer, inputs):
+        sf = StaticFunction(type(layer).forward, layer=layer)
+        out = sf(*inputs)
+        return out, TracedLayer(layer, sf)
+
+    def __call__(self, *inputs):
+        return self._static(*inputs)
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        import os
+        os.makedirs(dirname, exist_ok=True)
+        sd = self._layer.state_dict()
+        np.savez(os.path.join(dirname, "params.npz"),
+                 **{k: np.asarray(v) for k, v in sd.items()})
+
+
+class ProgramTranslator:
+    """ref: program_translator.py ProgramTranslator singleton —
+    enable(False) makes @declarative fall through to eager."""
+
+    _instance = None
+    enabled_flag = True
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def enable(self, flag: bool):
+        ProgramTranslator.enabled_flag = bool(flag)
+
+    @staticmethod
+    def get_instance():
+        return ProgramTranslator()
+
+
+class TrainStep:
+    """One fully-compiled dygraph train step: forward + tape backward +
+    optimizer update fused into a single XLA executable with donated
+    param/accumulator buffers.
+
+    The analog of the reference's whole-program static train step
+    (Executor over a program with backward + optimizer ops), reached from
+    eager code:
+
+        step = paddle_tpu.jit.train_step(model, opt, loss_fn)
+        loss = step(x, y)          # params/accumulators updated in place
+    """
+
+    def __init__(self, model: Layer, optimizer, loss_fn: Callable):
+        self._model = model
+        self._opt = optimizer
+        self._loss_fn = loss_fn
+        self._cache: Dict[tuple, Callable] = {}
+
+    def _flat_accs(self, params):
+        """Flatten optimizer accumulators in deterministic order."""
+        spec = self._opt._EAGER_ACCS[self._opt.type]
+        flat = []
+        for p in params:
+            accs = self._opt._eager_accs.get(id(p))
+            for key, _, _, fill_attr, scalar in spec:
+                if accs is None:
+                    fill = getattr(self._opt, fill_attr) if fill_attr \
+                        else 0.0
+                    shape = (1,) if scalar else p.value.shape
+                    flat.append(jnp.full(
+                        shape, fill,
+                        dtype=jnp.float32 if scalar else p.value.dtype))
+                else:
+                    flat.append(accs[key])
+        return flat
+
+    def _write_accs(self, params, flat):
+        spec = self._opt._EAGER_ACCS[self._opt.type]
+        i = 0
+        for p in params:
+            accs = self._opt._eager_accs.setdefault(id(p), {})
+            for key, *_ in spec:
+                accs[key] = flat[i]
+                i += 1
+
+    def __call__(self, *batch):
+        model, opt = self._model, self._opt
+        params = opt._parameter_list or model.parameters()
+        buffers = model.buffers()
+        arrays = [_as_array(b) for b in batch]
+        sig = _sig_of(arrays)
+
+        if sig not in self._cache:
+            loss_fn = self._loss_fn
+            spec_len = len(opt._EAGER_ACCS[opt.type])
+
+            def pure(param_vals, acc_flat, buf_vals, step, key,
+                     input_vals):
+                with _FreshTape() as t:
+                    t._key = key
+                    t.train_mode = True
+                    old_p = _swap_values(params, param_vals)
+                    old_b = _swap_values(buffers, buf_vals)
+                    old_accs = {k: dict(v)
+                                for k, v in opt._eager_accs.items()}
+                    old_step = opt._eager_step
+                    try:
+                        self._write_accs(params, acc_flat)
+                        opt._eager_step = step
+                        ins = [VarBase(v) for v in input_vals]
+                        loss = loss_fn(model, *ins)
+                        t.run_backward(loss)
+                        opt._dygraph_minimize(loss, params)
+                        new_p = [p.value for p in params]
+                        new_accs = self._flat_accs(params)
+                        new_b = [b.value for b in buffers]
+                        loss_val = loss.value
+                    finally:
+                        for p in params:
+                            p._grad = None
+                        _swap_values(params, old_p)
+                        _swap_values(buffers, old_b)
+                        opt._eager_accs = old_accs
+                        opt._eager_step = old_step
+                    return new_p, new_accs, new_b, loss_val
+
+            self._cache[sig] = jax.jit(pure, donate_argnums=(0, 1))
+            # first call seeds accumulators so acc_flat has stable shapes
+            _ = spec_len
+
+        jitted = self._cache[sig]
+        key = tracer().next_key()
+        acc_flat = self._flat_accs(params)
+        new_p, new_accs, new_b, loss_val = jitted(
+            [p.value for p in params], acc_flat,
+            [b.value for b in buffers], jnp.asarray(opt._eager_step),
+            key, arrays)
+        for p, nv in zip(params, new_p):
+            p.value = nv
+        self._write_accs(params, new_accs)
+        for b, nv in zip(buffers, new_b):
+            b.value = nv
+        opt._eager_step += 1
+        return VarBase(loss_val)
+
+
+def train_step(model: Layer, optimizer, loss_fn: Callable) -> TrainStep:
+    return TrainStep(model, optimizer, loss_fn)

@@ -114,6 +114,13 @@ class LRScheduler:
         self.attrs.update(extra)
         return self
 
+    def eager_value(self, step: int):
+        """Dygraph-mode LR: evaluate the schedule at ``step`` host-side
+        using the same formula the lr_schedule op lowers."""
+        out = _lr_schedule_op(None, {"Step": [jnp.asarray([step])]},
+                              {"kind": self.kind, **self.attrs})
+        return out["Out"]
+
 
 def noam_decay(d_model, warmup_steps, learning_rate=1.0):
     return LRScheduler("noam", lr=learning_rate, d_model=d_model,

@@ -14,6 +14,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from .framework.core import (Parameter, Variable, default_main_program,
                              default_startup_program, grad_var_name)
 from .framework import unique_name
@@ -26,7 +28,7 @@ from .regularizer import append_regularization_ops
 
 class Optimizer:
     def __init__(self, learning_rate, regularization=None, grad_clip=None,
-                 name=None):
+                 name=None, parameter_list=None):
         self._learning_rate = learning_rate
         self.regularization = regularization
         self._grad_clip = grad_clip
@@ -34,6 +36,11 @@ class Optimizer:
         self._accumulators: Dict[str, Dict[str, Variable]] = {}
         self._lr_var: Optional[Variable] = None
         self.type = getattr(self, "type", "sgd")
+        # dygraph-mode state (ref: optimizer.py accepts parameter_list in
+        # dygraph; accumulators live on the optimizer, step drives LR)
+        self._parameter_list = list(parameter_list) if parameter_list else None
+        self._eager_accs: Dict[int, Dict[str, object]] = {}
+        self._eager_step = 0
 
     # -- learning rate ---------------------------------------------------
     def _create_global_learning_rate(self):
@@ -139,8 +146,171 @@ class Optimizer:
     def apply_optimize(self, loss, startup_program, params_grads):
         return self.apply_gradients(params_grads)
 
+    # -- dygraph (eager) path (ref: optimizer.py dygraph branch of
+    # minimize; imperative mode applies the same optimizer ops directly) --
+    _EAGER_ACCS = {
+        "sgd": [], "dpsgd": [],
+        "momentum": [("velocity", "Velocity", "VelocityOut", None, False)],
+        "lars_momentum": [("velocity", "Velocity", "VelocityOut",
+                           None, False)],
+        "adam": [("moment1", "Moment1", "Moment1Out", None, False),
+                 ("moment2", "Moment2", "Moment2Out", None, False),
+                 ("beta1_pow_acc", "Beta1Pow", "Beta1PowOut",
+                  "_beta1", True),
+                 ("beta2_pow_acc", "Beta2Pow", "Beta2PowOut",
+                  "_beta2", True)],
+        "adagrad": [("moment", "Moment", "MomentOut", "_initial", False)],
+        "decayed_adagrad": [("moment", "Moment", "MomentOut", None, False)],
+        "rmsprop": [("mean_square", "MeanSquare", "MeanSquareOut",
+                     None, False),
+                    ("mean_grad", "MeanGrad", "MeanGradOut", None, False),
+                    ("momentum", "Moment", "MomentOut", None, False)],
+        "adadelta": [("avg_squared_grad", "AvgSquaredGrad",
+                      "AvgSquaredGradOut", None, False),
+                     ("avg_squared_update", "AvgSquaredUpdate",
+                      "AvgSquaredUpdateOut", None, False)],
+        "adamax": [("moment", "Moment", "MomentOut", None, False),
+                   ("inf_norm", "InfNorm", "InfNormOut", None, False),
+                   ("beta1_pow_acc", "Beta1Pow", "Beta1PowOut",
+                    "_beta1", True)],
+        "ftrl": [("squared", "SquaredAccumulator", "SquaredAccumOut",
+                  None, False),
+                 ("linear", "LinearAccumulator", "LinearAccumOut",
+                  None, False)],
+    }
+    _EAGER_ACCS["adamw"] = _EAGER_ACCS["adam"]
+    _EAGER_ACCS["lamb"] = _EAGER_ACCS["adam"]
+
+    def _eager_attrs(self, param):
+        t = self.type
+        if t == "momentum":
+            return {"mu": self._momentum, "use_nesterov": self._use_nesterov}
+        if t == "lars_momentum":
+            return {"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                    "lars_weight_decay": self._lars_weight_decay,
+                    "epsilon": self._epsilon}
+        if t in ("adam", "adamw"):
+            return self._op_attrs()
+        if t == "lamb":
+            wd = self._weight_decay
+            if self._exclude_fn is not None and self._exclude_fn(param):
+                wd = 0.0
+            return {"beta1": self._beta1, "beta2": self._beta2,
+                    "epsilon": self._epsilon, "weight_decay": wd}
+        if t == "adagrad":
+            return {"epsilon": self._epsilon}
+        if t == "decayed_adagrad":
+            return {"decay": self._decay, "epsilon": self._epsilon}
+        if t == "rmsprop":
+            return {"decay": self._rho, "epsilon": self._epsilon,
+                    "momentum": self._momentum, "centered": self._centered}
+        if t == "adadelta":
+            return {"rho": self._rho, "epsilon": self._epsilon}
+        if t == "adamax":
+            return {"beta1": self._beta1, "beta2": self._beta2,
+                    "epsilon": self._epsilon}
+        if t == "ftrl":
+            return {"l1": self._l1, "l2": self._l2,
+                    "lr_power": self._lr_power}
+        if t == "dpsgd":
+            return {"clip": self._clip, "batch_size": self._batch_size,
+                    "sigma": self._sigma}
+        return {}
+
+    def _eager_lr(self):
+        import jax.numpy as jnp
+        from .lr_scheduler import LRScheduler
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate.eager_value(self._eager_step)
+        return jnp.asarray([float(self._learning_rate)], jnp.float32)
+
+    def current_step_lr(self):
+        return float(np.asarray(self._eager_lr())[0])
+
+    def _dygraph_minimize(self, loss, parameter_list=None):
+        import jax.numpy as jnp
+        from .ops.registry import get_op, LoweringContext
+        from .dygraph.tracer import tracer as _dytracer
+        from .regularizer import L2Decay, L1Decay
+
+        if self.type not in self._EAGER_ACCS:
+            raise NotImplementedError(
+                f"optimizer type {self.type!r} has no dygraph path")
+        params = parameter_list or self._parameter_list
+        if params is None:
+            raise ValueError(
+                "dygraph minimize needs parameter_list (pass it to the "
+                "optimizer constructor or to minimize())")
+        op_fn = get_op(self.type)
+        lr = self._eager_lr()
+        # regularization BEFORE clipping, matching apply_gradients order
+        pgs = []
+        for p in params:
+            if p._grad is None:
+                continue
+            g = p._grad
+            reg = getattr(p, "regularizer", None) or self.regularization
+            if isinstance(reg, L2Decay):
+                g = g + reg.coeff * p.value
+            elif isinstance(reg, L1Decay):
+                g = g + reg.coeff * jnp.sign(p.value)
+            pgs.append((p, g))
+        if self._grad_clip is not None:
+            pgs = self._grad_clip._eager_clip(pgs)
+        for p, g in pgs:
+            accs = self._eager_accs.get(id(p))
+            if accs is None:
+                accs = {}
+                for key, _, _, fill_attr, scalar in \
+                        self._EAGER_ACCS[self.type]:
+                    fill = getattr(self, fill_attr) if fill_attr else 0.0
+                    shape = (1,) if scalar else p.value.shape
+                    accs[key] = jnp.full(shape, fill,
+                                         dtype=jnp.float32 if scalar
+                                         else p.value.dtype)
+                self._eager_accs[id(p)] = accs
+            mult = getattr(p, "optimize_attrs", {}).get("learning_rate", 1.0)
+            ins = {"Param": [p.value], "Grad": [g],
+                   "LearningRate": [lr * mult]}
+            for key, in_slot, _, _, _ in self._EAGER_ACCS[self.type]:
+                ins[in_slot] = [accs[key]]
+            res = op_fn(LoweringContext(_dytracer().next_key()), ins,
+                        self._eager_attrs(p))
+            p.set_value(res["ParamOut"])
+            for key, _, out_slot, _, _ in self._EAGER_ACCS[self.type]:
+                if out_slot in res:
+                    accs[key] = res[out_slot]
+        self._eager_step += 1
+        return None, [(p, g) for p, g in pgs]
+
+    def state_dict(self):
+        """Optimizer accumulators for save_dygraph (.pdopt)."""
+        sd = {"__step__": np.asarray([self._eager_step])}
+        names = {id(p): p.name for p in (self._parameter_list or [])}
+        for pid, accs in self._eager_accs.items():
+            pname = names.get(pid, str(pid))
+            for key, v in accs.items():
+                sd[f"{pname}@{key}"] = np.asarray(v)
+        return sd
+
+    def set_state_dict(self, sd):
+        import jax.numpy as jnp
+        self._eager_step = int(np.asarray(sd.get("__step__", [0]))[0]) \
+            if "__step__" in sd else 0
+        names = {p.name: id(p) for p in (self._parameter_list or [])}
+        for k, v in sd.items():
+            if "@" not in k:
+                continue
+            pname, key = k.rsplit("@", 1)
+            pid = names.get(pname)
+            if pid is not None:
+                self._eager_accs.setdefault(pid, {})[key] = jnp.asarray(v)
+
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        from .dygraph.base import in_dygraph_mode
+        if in_dygraph_mode():
+            return self._dygraph_minimize(loss, parameter_list)
         # ops must land in LOSS's program even when minimize is called
         # outside the program_guard that built the net (ref: optimizer.py
         # minimize wraps in program_guard(loss.block.program))
@@ -169,8 +339,10 @@ class MomentumOptimizer(Optimizer):
     type = "momentum"
 
     def __init__(self, learning_rate, momentum=0.9, use_nesterov=False,
-                 regularization=None, grad_clip=None, name=None):
-        super().__init__(learning_rate, regularization, grad_clip, name)
+                 regularization=None, grad_clip=None, name=None,
+                 parameter_list=None):
+        super().__init__(learning_rate, regularization, grad_clip, name,
+                         parameter_list=parameter_list)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
 
@@ -223,8 +395,9 @@ class AdamOptimizer(Optimizer):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, regularization=None, grad_clip=None,
-                 lazy_mode=False, name=None):
-        super().__init__(learning_rate, regularization, grad_clip, name)
+                 lazy_mode=False, name=None, parameter_list=None):
+        super().__init__(learning_rate, regularization, grad_clip, name,
+                         parameter_list=parameter_list)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
     def _create_accumulators(self, block, parameters):

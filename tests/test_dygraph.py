@@ -1,0 +1,275 @@
+"""Dygraph (imperative) mode tests — eager autograd, Layer API, optimizer
+eager path, save/load, no_grad, BatchNorm train/eval.
+
+Mirrors the reference's dygraph unit tests
+(tests/unittests/test_imperative_basic.py, test_imperative_mnist.py,
+test_imperative_save_load.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph import (to_variable, no_grad, Linear, Conv2D,
+                                Pool2D, BatchNorm, Embedding, LayerNorm,
+                                Dropout, Sequential)
+from paddle_tpu.optimizer import (SGDOptimizer, AdamOptimizer,
+                                  MomentumOptimizer)
+
+
+def test_eager_autograd_matches_analytic():
+    with fluid.dygraph.guard():
+        x = to_variable(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        x.stop_gradient = False
+        y = (x * x).sum()          # d/dx sum(x^2) = 2x
+        y.backward()
+        np.testing.assert_allclose(x.grad, 2 * x.numpy(), rtol=1e-6)
+
+
+def test_chain_rule_through_ops():
+    with fluid.dygraph.guard():
+        w = to_variable(np.ones((3, 1), np.float32))
+        w.stop_gradient = False
+        x = to_variable(np.array([[0.1, 0.2, 0.3]], np.float32))
+        out = (x @ w).tanh().sum()
+        out.backward()
+        # d tanh(x.w)/dw = (1 - tanh^2) * x^T
+        pre = x.numpy() @ np.ones((3, 1), np.float32)
+        expect = (1 - np.tanh(pre) ** 2) * x.numpy().T
+        np.testing.assert_allclose(w.grad, expect, rtol=1e-4)
+
+
+def test_fan_in_grad_accumulation():
+    with fluid.dygraph.guard():
+        x = to_variable(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        y = x * x + x * 3.0        # dy/dx = 2x + 3 = 7
+        y.backward()
+        np.testing.assert_allclose(x.grad, [7.0], rtol=1e-6)
+
+
+def test_no_grad_blocks_tape():
+    with fluid.dygraph.guard():
+        x = to_variable(np.ones((2,), np.float32))
+        x.stop_gradient = False
+        with no_grad():
+            y = x * 2.0
+        assert y.stop_gradient
+        z = x * 3.0
+        z.backward(retain_graph=False)
+        np.testing.assert_allclose(x.grad, [3.0, 3.0])
+
+
+def test_linear_regression_converges():
+    rng = np.random.RandomState(0)
+    w_true = np.array([[2.0], [-3.4]], np.float32)
+    with fluid.dygraph.guard():
+        model = Linear(2, 1)
+        opt = SGDOptimizer(learning_rate=0.1,
+                           parameter_list=model.parameters())
+        for _ in range(200):
+            xb = rng.randn(32, 2).astype(np.float32)
+            yb = xb @ w_true + 4.2
+            pred = model(to_variable(xb))
+            loss = ((pred - to_variable(yb)) ** 2).mean()
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+        learned_w = model.weight.numpy()
+        learned_b = model.bias.numpy()
+        np.testing.assert_allclose(learned_w, w_true, atol=0.1)
+        np.testing.assert_allclose(learned_b, [4.2], atol=0.1)
+
+
+def test_mnist_style_convnet_trains_eagerly():
+    rng = np.random.RandomState(1)
+
+    class ConvNet(dygraph.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = Conv2D(1, 8, 3, padding=1, act="relu")
+            self.pool = Pool2D(2, "max", 2)
+            self.fc = Linear(8 * 4 * 4, 10)
+
+        def forward(self, x):
+            h = self.pool(self.conv(x))
+            h = h.reshape([x.shape[0], -1])
+            return self.fc(h)
+
+    with fluid.dygraph.guard():
+        model = ConvNet()
+        opt = AdamOptimizer(learning_rate=1e-2,
+                            parameter_list=model.parameters())
+        losses = []
+        xb = rng.randn(16, 1, 8, 8).astype(np.float32)
+        yb = rng.randint(0, 10, (16, 1))
+        for _ in range(30):
+            logits = model(to_variable(xb))
+            loss_d = dygraph.tracer().trace_op(
+                "softmax_with_cross_entropy",
+                {"Logits": [logits], "Label": [to_variable(yb)]}, {})
+            loss = loss_d["Loss"].mean()
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5
+
+
+def test_batchnorm_train_eval_modes():
+    with fluid.dygraph.guard():
+        bn = BatchNorm(4)
+        x = np.random.RandomState(2).randn(8, 4, 5, 5).astype(np.float32) \
+            * 3 + 1
+        bn.train()
+        _ = bn(to_variable(x))
+        mean_after = bn._buffers["_mean"].numpy().copy()
+        assert not np.allclose(mean_after, 0)   # running stats moved
+        bn.eval()
+        out1 = bn(to_variable(x)).numpy()
+        out2 = bn(to_variable(x)).numpy()
+        np.testing.assert_allclose(out1, out2)  # eval is deterministic
+        assert np.allclose(bn._buffers["_mean"].numpy(), mean_after)
+
+
+def test_dropout_respects_mode():
+    with fluid.dygraph.guard():
+        d = Dropout(0.5)
+        x = to_variable(np.ones((1000,), np.float32))
+        d.train()
+        out_train = d(x).numpy()
+        assert (out_train == 0).mean() > 0.3
+        d.eval()
+        out_eval = d(x).numpy()
+        np.testing.assert_allclose(out_eval, 0.5 * np.ones(1000), rtol=1e-6)
+
+
+def test_embedding_and_layernorm():
+    with fluid.dygraph.guard():
+        emb = Embedding([10, 6])
+        ln = LayerNorm(6)
+        ids = to_variable(np.array([[1, 2, 3]], np.int64))
+        out = ln(emb(ids))
+        assert out.shape == [1, 3, 6]
+        np.testing.assert_allclose(out.numpy().mean(-1),
+                                   np.zeros((1, 3)), atol=1e-5)
+
+
+def test_state_dict_save_load_roundtrip(tmp_path):
+    with fluid.dygraph.guard():
+        m1 = Sequential(Linear(4, 8, act="relu"), Linear(8, 2))
+        sd = m1.state_dict()
+        assert len(sd) == 4
+        path = str(tmp_path / "ckpt" / "model")
+        dygraph.save_dygraph(sd, path)
+        params, _ = dygraph.load_dygraph(path)
+        m2 = Sequential(Linear(4, 8, act="relu"), Linear(8, 2))
+        m2.set_state_dict(params)
+        x = to_variable(np.random.RandomState(3).randn(5, 4)
+                        .astype(np.float32))
+        np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_optimizer_state_roundtrip(tmp_path):
+    with fluid.dygraph.guard():
+        model = Linear(3, 3)
+        opt = AdamOptimizer(learning_rate=0.1,
+                            parameter_list=model.parameters())
+        x = to_variable(np.ones((2, 3), np.float32))
+        loss = model(x).mean()
+        loss.backward()
+        opt.minimize(loss)
+        sd = opt.state_dict()
+        sd["_is_optimizer"] = True
+        dygraph.save_dygraph(sd, str(tmp_path / "opt"))
+        _, opt_sd = dygraph.load_dygraph(str(tmp_path / "opt"))
+        opt2 = AdamOptimizer(learning_rate=0.1,
+                             parameter_list=model.parameters())
+        opt2.set_state_dict(opt_sd)
+        assert opt2._eager_step == 1
+        assert len(opt2._eager_accs) == len(opt._eager_accs)
+
+
+def test_momentum_eager_matches_static_formula():
+    with fluid.dygraph.guard():
+        p0 = np.array([1.0, 2.0], np.float32)
+        model = dygraph.ParameterList(
+            [dygraph.VarBase(p0.copy(), stop_gradient=False,
+                             persistable=True)])
+        p = model["0"]
+        p.name = "p0"
+        opt = MomentumOptimizer(0.1, momentum=0.9,
+                                parameter_list=[p])
+        for _ in range(2):
+            loss = (p * p).sum()
+            loss.backward()
+            opt.minimize(loss)
+            p.clear_gradient()
+        # replicate: v1=2p0, p1=p0-0.1*v1 ; v2=0.9*v1+2p1, p2=p1-0.1*v2
+        v1 = 2 * p0
+        p1 = p0 - 0.1 * v1
+        v2 = 0.9 * v1 + 2 * p1
+        p2 = p1 - 0.1 * v2
+        np.testing.assert_allclose(p.numpy(), p2, rtol=1e-5)
+
+
+def test_grad_clip_global_norm_eager():
+    from paddle_tpu.clip import GradientClipByGlobalNorm
+    with fluid.dygraph.guard():
+        model = Linear(2, 2)
+        opt = SGDOptimizer(1.0, grad_clip=GradientClipByGlobalNorm(1e-8),
+                           parameter_list=model.parameters())
+        before = model.weight.numpy().copy()
+        loss = (model(to_variable(np.ones((1, 2), np.float32)))
+                * 1000.0).sum()
+        loss.backward()
+        opt.minimize(loss)
+        # clipped to ~zero norm → params barely move
+        np.testing.assert_allclose(model.weight.numpy(), before, atol=1e-5)
+
+
+def test_train_eval_propagates_to_sublayers():
+    with fluid.dygraph.guard():
+        m = Sequential(Linear(2, 2), Sequential(Dropout(0.5)))
+        m.eval()
+        assert all(not layer.training for layer in m.sublayers())
+        m.train()
+        assert all(layer.training for layer in m.sublayers())
+
+
+def test_grads_flow_through_multi_output_ops():
+    # regression: GC'd side outputs (layer_norm Mean/Variance) must not
+    # drop the node's gradient contribution
+    with fluid.dygraph.guard():
+        ln = LayerNorm(4)
+        x = to_variable(np.random.RandomState(5).randn(2, 4)
+                        .astype(np.float32))
+        x.stop_gradient = False
+        loss = (ln(x) ** 2).sum()   # nonlinear so dx != 0
+        loss.backward()
+        assert ln.weight.grad is not None
+        assert x.grad is not None
+        assert not np.allclose(x.grad, 0)
+
+
+def test_frozen_param_kept_in_state_dict():
+    from paddle_tpu.fluid import ParamAttr
+    with fluid.dygraph.guard():
+        m = Linear(2, 2, param_attr=ParamAttr(trainable=False))
+        names = dict(m.named_parameters()).keys()
+        assert "weight" in names and "bias" in names
+        assert "weight" in m.state_dict()
+        assert m.weight.stop_gradient
+
+
+def test_named_parameters_and_buffers():
+    with fluid.dygraph.guard():
+        class M(dygraph.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = Linear(2, 3)
+                self.bn = BatchNorm(3)
+
+        names = dict(M().named_parameters()).keys()
+        assert any(n.startswith("fc.") for n in names)
+        assert any(n.startswith("bn.") for n in names)
