@@ -1,0 +1,205 @@
+"""Out-of-core Dataset API over the native C++ datafeed
+(ref: python/paddle/fluid/dataset.py — DatasetFactory:29,
+InMemoryDataset:271, QueueDataset:636; C++ framework/data_set.h:43,
+data_feed.h MultiSlotDataFeed).
+
+File format is the reference's MultiSlot text format: one instance per
+line, per slot ``<n> v1 ... vn`` in slot order.  Parsing, shuffling and
+batch assembly run in native threads (paddle_tpu/native/src/datafeed.cc)
+behind a bounded channel so host input overlaps TPU steps.
+
+Ragged id slots are delivered as (values, lod) pairs — the LoDTensor
+analog — and padded into power-of-two buckets at feed time so XLA sees a
+small set of static shapes (SURVEY.md §7 "dynamic shapes" strategy).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+def _bucket(n: int, lo: int = 1) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class DatasetBase:
+    def __init__(self):
+        self._native = None
+        self._slots = []          # [(name, "float"|"uint64")]
+        self._use_vars = []
+        self._batch_size = 1
+        self._thread = 1
+        self._filelist: List[str] = []
+        self._seed = 0
+        self._streaming = False
+        self._started = False
+
+    # -- reference API ---------------------------------------------------
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num: int):
+        self._thread = int(thread_num)
+
+    def set_filelist(self, filelist: List[str]):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        """Declare the program vars this dataset feeds, in slot order
+        (ref: dataset.py set_use_var builds the DataFeedDesc)."""
+        self._use_vars = list(var_list)
+        self._slots = []
+        for v in var_list:
+            is_int = "int" in str(v.dtype)
+            self._slots.append((v.name, "uint64" if is_int else "float"))
+
+    def set_pipe_command(self, cmd: str):
+        """Accepted for API parity; the native reader parses the MultiSlot
+        text directly (no subprocess pipe — ref: data_feed.proto
+        pipe_command is a gradient of the same idea)."""
+        self._pipe_command = cmd
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        self._hdfs = (fs_name, fs_ugi)   # parity stub: local FS only
+
+    # -- internals -------------------------------------------------------
+    def _ensure_native(self):
+        if self._native is None:
+            if not self._slots:
+                raise ValueError("call set_use_var before loading data")
+            from .native import NativeDataset
+            self._native = NativeDataset(
+                [(n, t, True) for n, t in self._slots])
+        self._native.set_batch_size(self._batch_size)
+        self._native.set_thread(self._thread)
+        self._native.set_filelist(self._filelist)
+        return self._native
+
+    def _start(self, drop_last=False):
+        nd = self._ensure_native()
+        nd.start(streaming=self._streaming, drop_last=drop_last)
+        self._started = True
+
+    def _stop(self):
+        if self._native is not None and self._started:
+            self._native.stop()
+            self._started = False
+
+    def _iter_feed_dicts(self, drop_last=False):
+        """Yield feed dicts: dense float slots as [b, dim]; ragged id
+        slots bucket-padded [b, L] plus '<name>.lens' int32 lengths."""
+        self._start(drop_last=drop_last)
+        nd = self._native
+        fi = ii = 0
+        slot_kinds = []
+        for name, t in self._slots:
+            if t == "float":
+                slot_kinds.append((name, "f", fi))
+                fi += 1
+            else:
+                slot_kinds.append((name, "i", ii))
+                ii += 1
+        try:
+            while True:
+                b = nd.next()
+                if b is None:
+                    break
+                feed = {}
+                bs = b.batch_size
+                for name, kind, idx in slot_kinds:
+                    if kind == "f":
+                        vals, lod = b.float_slot(idx)
+                        widths = np.diff(lod)
+                        if widths.size and (widths == widths[0]).all():
+                            feed[name] = vals.reshape(bs, -1)
+                        else:
+                            feed[name], feed[f"{name}.lens"] = \
+                                self._pad(vals, lod, np.float32)
+                    else:
+                        vals, lod = b.id_slot(idx)
+                        ids, lens = self._pad(vals, lod, np.int64)
+                        feed[name] = ids
+                        feed[f"{name}.lens"] = lens
+                b.free()
+                yield feed
+        finally:
+            self._stop()
+
+    @staticmethod
+    def _pad(vals, lod, dtype):
+        widths = np.diff(lod)
+        L = _bucket(int(widths.max()) if widths.size else 1)
+        out = np.zeros((len(widths), L), dtype)
+        for r, (s, e) in enumerate(zip(lod[:-1], lod[1:])):
+            out[r, :e - s] = vals[s:e]
+        return out, widths.astype(np.int32)
+
+
+class InMemoryDataset(DatasetBase):
+    """ref: dataset.py InMemoryDataset:271 — load, shuffle, iterate."""
+
+    def __init__(self):
+        super().__init__()
+        self._streaming = False
+
+    def load_into_memory(self):
+        self._ensure_native().load_into_memory()
+
+    def local_shuffle(self):
+        self._ensure_native().local_shuffle(self._seed)
+        self._seed += 1
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        """Shared-seed shuffle + deterministic 1/nranks partition (the
+        reference redistributes instances across trainers via RPC,
+        ref: data_set.cc GlobalShuffle; on a TPU pod each host keeps its
+        hash partition — same statistical effect, no DCN traffic)."""
+        tid, tnum = 0, 1
+        if fleet is not None:
+            tid = fleet.worker_index()
+            tnum = fleet.worker_num()
+        self._ensure_native().global_shuffle(self._seed, tid, tnum)
+        self._seed += 1
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return self._ensure_native().memory_size()
+
+    def get_shuffle_data_size(self, fleet=None) -> int:
+        return self.get_memory_data_size(fleet)
+
+    def release_memory(self):
+        self._ensure_native().release_memory()
+
+
+class QueueDataset(DatasetBase):
+    """ref: dataset.py QueueDataset:636 — streaming, no materialisation;
+    reader threads parse straight into the batch channel."""
+
+    def __init__(self):
+        super().__init__()
+        self._streaming = True
+
+    def local_shuffle(self):
+        raise RuntimeError(
+            "QueueDataset streams files; use InMemoryDataset for shuffles "
+            "(same contract as the reference)")
+
+    def global_shuffle(self, fleet=None):
+        raise RuntimeError(
+            "QueueDataset streams files; use InMemoryDataset for shuffles")
+
+
+class DatasetFactory:
+    """ref: dataset.py DatasetFactory:29."""
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")

@@ -20,6 +20,9 @@ from ..framework import initializer  # noqa: F401
 from ..framework import unique_name  # noqa: F401
 from .. import layers        # noqa: F401
 from .. import dygraph       # noqa: F401
+from .. import dataset       # noqa: F401
+from ..dataset import (DatasetFactory, InMemoryDataset,  # noqa: F401
+                       QueueDataset)
 from .. import optimizer     # noqa: F401
 from .. import regularizer   # noqa: F401
 from .. import clip          # noqa: F401

@@ -253,6 +253,11 @@ class _CompiledStep:
         self.fetch_names = fetch_names
 
 
+def _fetch_names(fetch_list):
+    return [f.name if isinstance(f, Variable) else str(f)
+            for f in fetch_list]
+
+
 class Executor:
     """User-facing executor (ref: python executor.py:896 Executor.run)."""
 
@@ -285,8 +290,7 @@ class Executor:
             feed_specs = program._feed_specs
             program = program._program
 
-        fetch_names = [f.name if isinstance(f, Variable) else str(f)
-                       for f in fetch_list]
+        fetch_names = _fetch_names(fetch_list)
         feed = {k: np.asarray(v) if not hasattr(v, "dtype") else v
                 for k, v in feed.items()}
 
@@ -313,6 +317,52 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    # -- dataset training (ref: executor.py:1479 train_from_dataset →
+    # TrainerDesc/DeviceWorker C++ threads; here the native datafeed
+    # assembles batches behind a channel and ONE compiled XLA step
+    # consumes them — thread-per-core hogwild doesn't map to a TPU, the
+    # parallelism lives inside the compiled step) ------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           drop_last=True):
+        return self._run_from_dataset(program, dataset, scope, fetch_list,
+                                      fetch_info, print_period, debug,
+                                      drop_last)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           drop_last=False):
+        return self._run_from_dataset(program, dataset, scope, fetch_list,
+                                      fetch_info, print_period, debug,
+                                      drop_last)
+
+    def _run_from_dataset(self, program, dataset, scope, fetch_list,
+                          fetch_info, print_period, debug, drop_last):
+        if dataset is None:
+            raise ValueError("dataset must be provided")
+        fetch_list = fetch_list or []
+        fetch_info = fetch_info or _fetch_names(fetch_list)
+        step = 0
+        last = None
+        # feed dicts may include '<slot>.lens' vars the program doesn't
+        # declare — drop those (programs opt in by declaring them)
+        prog = program or default_main_program()
+        from .compiler import CompiledProgram
+        block = (prog._program if isinstance(prog, CompiledProgram)
+                 else prog).global_block()
+        for feed in dataset._iter_feed_dicts(drop_last=drop_last):
+            feed = {k: v for k, v in feed.items() if block.has_var(k)}
+            last = self.run(prog, feed=feed, fetch_list=fetch_list,
+                            scope=scope)
+            step += 1
+            if fetch_list and (debug or step % print_period == 0):
+                vals = ", ".join(f"{n}={np.asarray(v).ravel()[:4]}"
+                                 for n, v in zip(fetch_info, last))
+                print(f"[train_from_dataset] step {step}: {vals}")
+        return last
 
     # -- compilation -----------------------------------------------------
     def _feed_signature(self, feed):

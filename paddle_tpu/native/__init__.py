@@ -1,0 +1,188 @@
+"""ctypes bindings over the native runtime library (datafeed + KV store).
+
+The reference exposes its C++ core through one pybind module
+(pybind/pybind.cc); here the native pieces speak a C ABI loaded with
+ctypes — no compiled Python extension needed, same zero-copy numpy
+hand-off (ref: pybind/tensor_py.h)."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional
+
+import numpy as np
+
+_lib = None
+
+
+def load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    from .build import lib_path
+    lib = ctypes.CDLL(lib_path())
+
+    lib.ptds_create.restype = ctypes.c_void_p
+    lib.ptds_create.argtypes = [ctypes.c_char_p]
+    lib.ptds_destroy.argtypes = [ctypes.c_void_p]
+    lib.ptds_set_filelist.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int]
+    lib.ptds_set_thread.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptds_set_batch.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptds_load_into_memory.argtypes = [ctypes.c_void_p]
+    lib.ptds_local_shuffle.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.ptds_global_shuffle.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
+    lib.ptds_memory_size.restype = ctypes.c_int64
+    lib.ptds_memory_size.argtypes = [ctypes.c_void_p]
+    lib.ptds_release_memory.argtypes = [ctypes.c_void_p]
+    lib.ptds_start.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+    lib.ptds_stop.argtypes = [ctypes.c_void_p]
+    lib.ptds_next.restype = ctypes.c_void_p
+    lib.ptds_next.argtypes = [ctypes.c_void_p]
+    lib.ptds_batch_free.argtypes = [ctypes.c_void_p]
+    lib.ptds_batch_size.restype = ctypes.c_int
+    lib.ptds_batch_size.argtypes = [ctypes.c_void_p]
+    for fn in ("ptds_batch_fslot_len", "ptds_batch_islot_len"):
+        getattr(lib, fn).restype = ctypes.c_int64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptds_batch_fslot.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float)]
+    lib.ptds_batch_islot.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64)]
+    lib.ptds_batch_flod.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64)]
+    lib.ptds_batch_ilod.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64)]
+
+    _bind_kv(lib)
+    _lib = lib
+    return lib
+
+
+def _bind_kv(lib):
+    """LargeScaleKV C ABI (present once largescale_kv.cc is built)."""
+    if not hasattr(lib, "ptkv_create"):
+        return
+    lib.ptkv_create.restype = ctypes.c_void_p
+    lib.ptkv_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int64]
+    lib.ptkv_destroy.argtypes = [ctypes.c_void_p]
+    lib.ptkv_size.restype = ctypes.c_int64
+    lib.ptkv_size.argtypes = [ctypes.c_void_p]
+    lib.ptkv_pull.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+    lib.ptkv_push_grad.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_float]
+    lib.ptkv_push_assign.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float)]
+    lib.ptkv_keys.argtypes = [ctypes.c_void_p,
+                              ctypes.POINTER(ctypes.c_int64)]
+    lib.ptkv_shrink.argtypes = [ctypes.c_void_p, ctypes.c_int]
+
+
+class NativeBatch:
+    """Owned view of one assembled batch; converts slots to numpy."""
+
+    def __init__(self, lib, handle, n_float, n_id):
+        self._lib = lib
+        self._h = handle
+        self.batch_size = lib.ptds_batch_size(handle)
+        self._nf, self._ni = n_float, n_id
+
+    def float_slot(self, s: int):
+        n = self._lib.ptds_batch_fslot_len(self._h, s)
+        vals = np.empty(n, np.float32)
+        lod = np.empty(self.batch_size + 1, np.int64)
+        if n:
+            self._lib.ptds_batch_fslot(
+                self._h, s, vals.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_float)))
+        self._lib.ptds_batch_flod(
+            self._h, s, lod.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return vals, lod
+
+    def id_slot(self, s: int):
+        n = self._lib.ptds_batch_islot_len(self._h, s)
+        vals = np.empty(n, np.int64)
+        lod = np.empty(self.batch_size + 1, np.int64)
+        if n:
+            self._lib.ptds_batch_islot(
+                self._h, s, vals.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_int64)))
+        self._lib.ptds_batch_ilod(
+            self._h, s, lod.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return vals, lod
+
+    def free(self):
+        if self._h:
+            self._lib.ptds_batch_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.free()
+        except Exception:
+            pass
+
+
+class NativeDataset:
+    """Thin OO wrapper over the ptds_* ABI."""
+
+    def __init__(self, slots: List[tuple]):
+        # slots: [(name, "float"|"uint64", used: bool), ...]
+        self._lib = load()
+        desc = ";".join(f"{n}:{t}:{1 if u else 0}" for n, t, u in slots)
+        self._h = self._lib.ptds_create(desc.encode())
+        self._nf = sum(1 for _, t, u in slots if u and t == "float")
+        self._ni = sum(1 for _, t, u in slots if u and t != "float")
+
+    def set_filelist(self, files: List[str]):
+        arr = (ctypes.c_char_p * len(files))(
+            *[f.encode() for f in files])
+        self._lib.ptds_set_filelist(self._h, arr, len(files))
+
+    def set_thread(self, n: int):
+        self._lib.ptds_set_thread(self._h, n)
+
+    def set_batch_size(self, b: int):
+        self._lib.ptds_set_batch(self._h, b)
+
+    def load_into_memory(self):
+        self._lib.ptds_load_into_memory(self._h)
+
+    def local_shuffle(self, seed: int = 0):
+        self._lib.ptds_local_shuffle(self._h, seed)
+
+    def global_shuffle(self, seed: int = 0, trainer_id: int = 0,
+                       trainer_num: int = 1):
+        self._lib.ptds_global_shuffle(self._h, seed, trainer_id,
+                                      trainer_num)
+
+    def memory_size(self) -> int:
+        return self._lib.ptds_memory_size(self._h)
+
+    def release_memory(self):
+        self._lib.ptds_release_memory(self._h)
+
+    def start(self, streaming=False, drop_last=False):
+        self._lib.ptds_start(self._h, int(streaming), int(drop_last))
+
+    def stop(self):
+        self._lib.ptds_stop(self._h)
+
+    def next(self) -> Optional[NativeBatch]:
+        h = self._lib.ptds_next(self._h)
+        if not h:
+            return None
+        return NativeBatch(self._lib, h, self._nf, self._ni)
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.ptds_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass

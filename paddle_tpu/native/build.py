@@ -1,0 +1,56 @@
+"""On-demand g++ build of the native runtime library with content-hash
+caching (the analog of the reference's cmake build of the core .so;
+ref: cmake/generic.cmake cc_library)."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.join(_HERE, "src")
+_BUILD_DIR = os.path.join(_HERE, "build")
+_LOCK = threading.Lock()
+
+_SOURCES = ["datafeed.cc", "largescale_kv.cc"]
+
+
+def _source_hash():
+    h = hashlib.sha256()
+    for name in _SOURCES:
+        p = os.path.join(_SRC_DIR, name)
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def lib_path() -> str:
+    """Build (if stale) and return the shared library path."""
+    with _LOCK:
+        tag = _source_hash()
+        so = os.path.join(_BUILD_DIR, f"libpaddle_tpu_native_{tag}.so")
+        if os.path.exists(so):
+            return so
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES
+                if os.path.exists(os.path.join(_SRC_DIR, s))]
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+               "-pthread", "-o", so + ".tmp", *srcs]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native build failed:\n{e.stderr}") from None
+        os.replace(so + ".tmp", so)
+        # drop stale builds
+        for f in os.listdir(_BUILD_DIR):
+            if f.startswith("libpaddle_tpu_native_") and \
+                    not f.endswith(f"{tag}.so"):
+                try:
+                    os.remove(os.path.join(_BUILD_DIR, f))
+                except OSError:
+                    pass
+        return so

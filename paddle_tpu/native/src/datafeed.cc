@@ -1,0 +1,443 @@
+// Native out-of-core data pipeline for paddle_tpu.
+//
+// TPU-native equivalent of the reference's C++ DataFeed/Dataset stack:
+//   - MultiSlot text parsing        (ref: framework/data_feed.cc
+//     MultiSlotDataFeed::ParseOneInstance — per slot "<n> v1..vn")
+//   - InMemory dataset + shuffles   (ref: framework/data_set.cc
+//     DatasetImpl::LoadIntoMemory / LocalShuffle / GlobalShuffle)
+//   - blocking channel              (ref: framework/channel.h,
+//     blocking_queue.h)
+//   - multi-threaded file readers   (ref: data_feed thread partitioning)
+//
+// The device side is XLA's problem; this library owns the host side:
+// parse files with N threads into compact slot-major records, shuffle,
+// and assemble dense/ragged batches behind a bounded channel so batch
+// assembly overlaps TPU steps.  Exposed as a C ABI consumed via ctypes
+// (the reference's pybind layer analog).
+//
+// Build: g++ -O2 -shared -fPIC -pthread (see paddle_tpu/native/build.py).
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct SlotConf {
+  std::string name;
+  bool is_float = false;  // else uint64 ids
+  bool used = true;
+};
+
+// One training instance: per *used* slot, a ragged run of values.
+struct Record {
+  std::vector<std::vector<float>> fvals;    // float slots, in used order
+  std::vector<std::vector<int64_t>> ivals;  // id slots, in used order
+};
+
+// Bounded MPMC channel (ref: framework/blocking_queue.h).
+template <typename T>
+class BlockingChannel {
+ public:
+  explicit BlockingChannel(size_t cap) : cap_(cap) {}
+
+  bool Put(T&& v) {
+    std::unique_lock<std::mutex> lk(mu_);
+    send_cv_.wait(lk, [&] { return closed_ || q_.size() < cap_; });
+    if (closed_) return false;
+    q_.push_back(std::move(v));
+    recv_cv_.notify_one();
+    return true;
+  }
+
+  bool Get(T* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    recv_cv_.wait(lk, [&] { return closed_ || !q_.empty(); });
+    if (q_.empty()) return false;  // closed and drained
+    *out = std::move(q_.front());
+    q_.pop_front();
+    send_cv_.notify_one();
+    return true;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    send_cv_.notify_all();
+    recv_cv_.notify_all();
+  }
+
+  void Reopen() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = false;
+    q_.clear();
+  }
+
+ private:
+  size_t cap_;
+  bool closed_ = false;
+  std::deque<T> q_;
+  std::mutex mu_;
+  std::condition_variable send_cv_, recv_cv_;
+};
+
+// One assembled batch, slot-major, ragged via lod offsets
+// (the LoDTensor analog: host keeps ragged, device gets padded buckets).
+struct Batch {
+  int batch_size = 0;
+  // per used-float-slot
+  std::vector<std::vector<float>> fdata;
+  std::vector<std::vector<int64_t>> flod;
+  // per used-id-slot
+  std::vector<std::vector<int64_t>> idata;
+  std::vector<std::vector<int64_t>> ilod;
+};
+
+bool ParseLine(const std::string& line, const std::vector<SlotConf>& slots,
+               Record* rec) {
+  const char* p = line.c_str();
+  const char* end = p + line.size();
+  auto next_tok = [&](char* buf, size_t cap) -> bool {
+    while (p < end && (*p == ' ' || *p == '\t')) ++p;
+    if (p >= end) return false;
+    size_t n = 0;
+    while (p < end && *p != ' ' && *p != '\t' && n + 1 < cap)
+      buf[n++] = *p++;
+    buf[n] = 0;
+    return n > 0;
+  };
+  char tok[64];
+  for (const auto& s : slots) {
+    if (!next_tok(tok, sizeof tok)) return false;
+    long cnt = strtol(tok, nullptr, 10);
+    if (cnt < 0) return false;
+    if (s.used) {
+      if (s.is_float) {
+        rec->fvals.emplace_back();
+        auto& v = rec->fvals.back();
+        v.reserve(cnt);
+        for (long i = 0; i < cnt; ++i) {
+          if (!next_tok(tok, sizeof tok)) return false;
+          v.push_back(strtof(tok, nullptr));
+        }
+      } else {
+        rec->ivals.emplace_back();
+        auto& v = rec->ivals.back();
+        v.reserve(cnt);
+        for (long i = 0; i < cnt; ++i) {
+          if (!next_tok(tok, sizeof tok)) return false;
+          v.push_back(static_cast<int64_t>(strtoull(tok, nullptr, 10)));
+        }
+      }
+    } else {
+      for (long i = 0; i < cnt; ++i)
+        if (!next_tok(tok, sizeof tok)) return false;
+    }
+  }
+  return true;
+}
+
+class Dataset {
+ public:
+  explicit Dataset(std::vector<SlotConf> slots)
+      : slots_(std::move(slots)), channel_(64) {
+    for (const auto& s : slots_) {
+      if (!s.used) continue;
+      if (s.is_float)
+        nf_++;
+      else
+        ni_++;
+    }
+  }
+
+  ~Dataset() { StopStreaming(); }
+
+  void SetFileList(std::vector<std::string> files) {
+    files_ = std::move(files);
+  }
+  void SetThreadNum(int n) { thread_num_ = n > 0 ? n : 1; }
+  void SetBatchSize(int b) { batch_size_ = b > 0 ? b : 1; }
+
+  // ---- in-memory mode (ref: DatasetImpl::LoadIntoMemory) ----
+  void LoadIntoMemory() {
+    records_.clear();
+    std::mutex merge_mu;
+    std::atomic<size_t> next_file{0};
+    auto worker = [&] {
+      std::vector<Record> local;
+      size_t fi;
+      while ((fi = next_file.fetch_add(1)) < files_.size()) {
+        std::ifstream in(files_[fi]);
+        std::string line;
+        while (std::getline(in, line)) {
+          if (line.empty()) continue;
+          Record r;
+          if (ParseLine(line, slots_, &r)) local.push_back(std::move(r));
+        }
+      }
+      std::lock_guard<std::mutex> lk(merge_mu);
+      for (auto& r : local) records_.push_back(std::move(r));
+    };
+    std::vector<std::thread> ths;
+    int n = std::min<int>(thread_num_, std::max<size_t>(files_.size(), 1));
+    for (int i = 0; i < n; ++i) ths.emplace_back(worker);
+    for (auto& t : ths) t.join();
+  }
+
+  void LocalShuffle(uint64_t seed) {
+    std::mt19937_64 rng(seed);
+    std::shuffle(records_.begin(), records_.end(), rng);
+  }
+
+  // Global shuffle for trainer_num workers without a PS: shuffle with the
+  // SHARED seed, then keep the deterministic 1/trainer_num partition for
+  // this trainer (ref semantics: data_set.cc GlobalShuffle redistributes
+  // instances across trainers by hash).
+  void GlobalShuffle(uint64_t seed, int trainer_id, int trainer_num) {
+    LocalShuffle(seed);
+    if (trainer_num <= 1) return;
+    std::vector<Record> mine;
+    for (size_t i = trainer_id; i < records_.size();
+         i += static_cast<size_t>(trainer_num))
+      mine.push_back(std::move(records_[i]));
+    records_.swap(mine);
+  }
+
+  int64_t MemorySize() const { return static_cast<int64_t>(records_.size()); }
+  void ReleaseMemory() {
+    records_.clear();
+    records_.shrink_to_fit();
+  }
+
+  // ---- batch iteration ----
+  // In-memory: background thread assembles batches into the channel.
+  // Streaming (QueueDataset): reader threads parse files straight into
+  // record channel, assembler builds batches — no full materialisation.
+  void Start(bool streaming, bool drop_last) {
+    StopStreaming();
+    channel_.Reopen();
+    drop_last_ = drop_last;
+    if (streaming) {
+      rec_channel_.reset(new BlockingChannel<Record>(4096));
+      auto next_file = std::make_shared<std::atomic<size_t>>(0);
+      readers_done_.store(0);
+      int n = std::min<int>(thread_num_, std::max<size_t>(files_.size(), 1));
+      n_readers_ = n;
+      for (int i = 0; i < n; ++i) {
+        threads_.emplace_back([this, next_file, n] {
+          size_t fi;
+          while ((fi = next_file->fetch_add(1)) < files_.size()) {
+            std::ifstream in(files_[fi]);
+            std::string line;
+            while (std::getline(in, line)) {
+              if (line.empty()) continue;
+              Record r;
+              if (ParseLine(line, slots_, &r))
+                if (!rec_channel_->Put(std::move(r))) return;
+            }
+          }
+          if (readers_done_.fetch_add(1) + 1 == n_readers_)
+            rec_channel_->Close();
+        });
+      }
+      threads_.emplace_back([this] {
+        std::vector<Record> buf;
+        Record r;
+        while (rec_channel_->Get(&r)) {
+          buf.push_back(std::move(r));
+          if (static_cast<int>(buf.size()) == batch_size_) {
+            if (!channel_.Put(Assemble(buf))) return;
+            buf.clear();
+          }
+        }
+        if (!buf.empty() && !drop_last_) channel_.Put(Assemble(buf));
+        channel_.Close();
+      });
+    } else {
+      threads_.emplace_back([this] {
+        std::vector<Record> buf;
+        for (auto& rec : records_) {
+          buf.push_back(rec);  // copy: records stay resident for re-epochs
+          if (static_cast<int>(buf.size()) == batch_size_) {
+            if (!channel_.Put(Assemble(buf))) return;
+            buf.clear();
+          }
+        }
+        if (!buf.empty() && !drop_last_) channel_.Put(Assemble(buf));
+        channel_.Close();
+      });
+    }
+  }
+
+  Batch* Next() {
+    Batch b;
+    if (!channel_.Get(&b)) return nullptr;
+    return new Batch(std::move(b));
+  }
+
+  void StopStreaming() {
+    channel_.Close();
+    if (rec_channel_) rec_channel_->Close();
+    for (auto& t : threads_)
+      if (t.joinable()) t.join();
+    threads_.clear();
+    rec_channel_.reset();
+  }
+
+  int nf() const { return nf_; }
+  int ni() const { return ni_; }
+
+ private:
+  Batch Assemble(const std::vector<Record>& rs) {
+    Batch b;
+    b.batch_size = static_cast<int>(rs.size());
+    b.fdata.resize(nf_);
+    b.flod.assign(nf_, {0});
+    b.idata.resize(ni_);
+    b.ilod.assign(ni_, {0});
+    for (const auto& r : rs) {
+      for (int s = 0; s < nf_; ++s) {
+        const auto& v = r.fvals[s];
+        b.fdata[s].insert(b.fdata[s].end(), v.begin(), v.end());
+        b.flod[s].push_back(static_cast<int64_t>(b.fdata[s].size()));
+      }
+      for (int s = 0; s < ni_; ++s) {
+        const auto& v = r.ivals[s];
+        b.idata[s].insert(b.idata[s].end(), v.begin(), v.end());
+        b.ilod[s].push_back(static_cast<int64_t>(b.idata[s].size()));
+      }
+    }
+    return b;
+  }
+
+  std::vector<SlotConf> slots_;
+  int nf_ = 0, ni_ = 0;
+  std::vector<std::string> files_;
+  int thread_num_ = 1;
+  int batch_size_ = 1;
+  bool drop_last_ = false;
+  std::vector<Record> records_;
+  BlockingChannel<Batch> channel_;
+  std::unique_ptr<BlockingChannel<Record>> rec_channel_;
+  std::vector<std::thread> threads_;
+  std::atomic<int> readers_done_{0};
+  int n_readers_ = 0;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// slots_desc: semicolon-separated "name:type:used" with type in
+// {float,uint64}, used in {0,1} — e.g. "click:float:1;ids:uint64:1"
+void* ptds_create(const char* slots_desc) {
+  std::vector<SlotConf> slots;
+  std::stringstream ss(slots_desc);
+  std::string item;
+  while (std::getline(ss, item, ';')) {
+    if (item.empty()) continue;
+    SlotConf c;
+    size_t a = item.find(':');
+    size_t b = item.find(':', a + 1);
+    c.name = item.substr(0, a);
+    c.is_float = item.substr(a + 1, b - a - 1) == "float";
+    c.used = item.substr(b + 1) == "1";
+    slots.push_back(std::move(c));
+  }
+  return new Dataset(std::move(slots));
+}
+
+void ptds_destroy(void* h) { delete static_cast<Dataset*>(h); }
+
+void ptds_set_filelist(void* h, const char** files, int n) {
+  std::vector<std::string> fs(files, files + n);
+  static_cast<Dataset*>(h)->SetFileList(std::move(fs));
+}
+
+void ptds_set_thread(void* h, int n) {
+  static_cast<Dataset*>(h)->SetThreadNum(n);
+}
+
+void ptds_set_batch(void* h, int b) {
+  static_cast<Dataset*>(h)->SetBatchSize(b);
+}
+
+void ptds_load_into_memory(void* h) {
+  static_cast<Dataset*>(h)->LoadIntoMemory();
+}
+
+void ptds_local_shuffle(void* h, uint64_t seed) {
+  static_cast<Dataset*>(h)->LocalShuffle(seed);
+}
+
+void ptds_global_shuffle(void* h, uint64_t seed, int trainer_id,
+                         int trainer_num) {
+  static_cast<Dataset*>(h)->GlobalShuffle(seed, trainer_id, trainer_num);
+}
+
+int64_t ptds_memory_size(void* h) {
+  return static_cast<Dataset*>(h)->MemorySize();
+}
+
+void ptds_release_memory(void* h) {
+  static_cast<Dataset*>(h)->ReleaseMemory();
+}
+
+void ptds_start(void* h, int streaming, int drop_last) {
+  static_cast<Dataset*>(h)->Start(streaming != 0, drop_last != 0);
+}
+
+void ptds_stop(void* h) { static_cast<Dataset*>(h)->StopStreaming(); }
+
+// returns NULL at end of epoch
+void* ptds_next(void* h) { return static_cast<Dataset*>(h)->Next(); }
+
+void ptds_batch_free(void* b) { delete static_cast<Batch*>(b); }
+
+int ptds_batch_size(void* b) { return static_cast<Batch*>(b)->batch_size; }
+
+int64_t ptds_batch_fslot_len(void* b, int s) {
+  return static_cast<int64_t>(static_cast<Batch*>(b)->fdata[s].size());
+}
+
+int64_t ptds_batch_islot_len(void* b, int s) {
+  return static_cast<int64_t>(static_cast<Batch*>(b)->idata[s].size());
+}
+
+void ptds_batch_fslot(void* b, int s, float* out) {
+  const auto& v = static_cast<Batch*>(b)->fdata[s];
+  std::memcpy(out, v.data(), v.size() * sizeof(float));
+}
+
+void ptds_batch_islot(void* b, int s, int64_t* out) {
+  const auto& v = static_cast<Batch*>(b)->idata[s];
+  std::memcpy(out, v.data(), v.size() * sizeof(int64_t));
+}
+
+void ptds_batch_flod(void* b, int s, int64_t* out) {
+  const auto& v = static_cast<Batch*>(b)->flod[s];
+  std::memcpy(out, v.data(), v.size() * sizeof(int64_t));
+}
+
+void ptds_batch_ilod(void* b, int s, int64_t* out) {
+  const auto& v = static_cast<Batch*>(b)->ilod[s];
+  std::memcpy(out, v.data(), v.size() * sizeof(int64_t));
+}
+
+}  // extern "C"

@@ -1,0 +1,173 @@
+// Host-RAM sharded sparse parameter table.
+//
+// TPU-native equivalent of the reference's LargeScaleKV
+// (operators/distributed/large_scale_kv.h:262 SparseVariable, :769
+// LargeScaleKV singleton): an id → embedding-row hash table sharded by
+// id hash across N internal shards, each with its own mutex so pulls
+// and pushes from many threads proceed in parallel.  The dense model
+// lives on the TPU; this table holds the 100B-feature tier in host RAM,
+// pulled/pushed per batch (ref: fleet_wrapper.h PullSparseVarsSync /
+// PushSparseVarsWithLabelAsync).
+//
+// Rows carry an access count for entry/shrink policies (ref:
+// large_scale_kv.h CountFilterEntry / ProbabilityEntry).
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Row {
+  std::vector<float> emb;   // [dim] value (+ optimizer slots appended)
+  uint32_t count = 0;       // access count for shrink policies
+};
+
+class KVTable {
+ public:
+  KVTable(int dim, int n_shards, int64_t seed)
+      : dim_(dim), n_shards_(n_shards > 0 ? n_shards : 16),
+        shards_(n_shards_), mus_(n_shards_), seed_(seed) {}
+
+  int dim() const { return dim_; }
+
+  int64_t Size() const {
+    int64_t n = 0;
+    for (int s = 0; s < n_shards_; ++s) {
+      std::lock_guard<std::mutex> lk(mus_[s]);
+      n += static_cast<int64_t>(shards_[s].size());
+    }
+    return n;
+  }
+
+  // Pull rows for ids; missing ids are initialised (uniform [-scale,scale]
+  // keyed by id hash — deterministic across pulls and hosts).
+  // init_mode: 0 = zeros, 1 = uniform.
+  void Pull(const int64_t* ids, int64_t n, float* out, int init_mode) {
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t id = ids[i];
+      int s = Shard(id);
+      std::lock_guard<std::mutex> lk(mus_[s]);
+      auto it = shards_[s].find(id);
+      if (it == shards_[s].end()) {
+        Row r;
+        r.emb.resize(dim_);
+        if (init_mode == 1) {
+          std::mt19937_64 rng(static_cast<uint64_t>(id) ^
+                              static_cast<uint64_t>(seed_));
+          std::uniform_real_distribution<float> d(-0.1f, 0.1f);
+          for (int k = 0; k < dim_; ++k) r.emb[k] = d(rng);
+        }
+        it = shards_[s].emplace(id, std::move(r)).first;
+      }
+      it->second.count++;
+      std::memcpy(out + i * dim_, it->second.emb.data(),
+                  dim_ * sizeof(float));
+    }
+  }
+
+  // SGD push: row -= lr * grad   (duplicate ids accumulate naturally,
+  // matching the reference's push-merge semantics)
+  void PushGrad(const int64_t* ids, int64_t n, const float* grads,
+                float lr) {
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t id = ids[i];
+      int s = Shard(id);
+      std::lock_guard<std::mutex> lk(mus_[s]);
+      auto it = shards_[s].find(id);
+      if (it == shards_[s].end()) continue;
+      float* e = it->second.emb.data();
+      const float* g = grads + i * dim_;
+      for (int k = 0; k < dim_; ++k) e[k] -= lr * g[k];
+    }
+  }
+
+  void PushAssign(const int64_t* ids, int64_t n, const float* vals) {
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t id = ids[i];
+      int s = Shard(id);
+      std::lock_guard<std::mutex> lk(mus_[s]);
+      auto& row = shards_[s][id];
+      row.emb.assign(vals + i * dim_, vals + (i + 1) * dim_);
+    }
+  }
+
+  // copy all keys into out (caller sized via Size())
+  void Keys(int64_t* out) const {
+    int64_t i = 0;
+    for (int s = 0; s < n_shards_; ++s) {
+      std::lock_guard<std::mutex> lk(mus_[s]);
+      for (const auto& kv : shards_[s]) out[i++] = kv.first;
+    }
+  }
+
+  // drop rows accessed fewer than `threshold` times, reset counts
+  // (ref: large_scale_kv.h Shrink + CountFilterEntry)
+  void Shrink(int threshold) {
+    for (int s = 0; s < n_shards_; ++s) {
+      std::lock_guard<std::mutex> lk(mus_[s]);
+      for (auto it = shards_[s].begin(); it != shards_[s].end();) {
+        if (static_cast<int>(it->second.count) < threshold)
+          it = shards_[s].erase(it);
+        else {
+          it->second.count = 0;
+          ++it;
+        }
+      }
+    }
+  }
+
+ private:
+  int Shard(int64_t id) const {
+    uint64_t h = static_cast<uint64_t>(id);
+    h ^= h >> 33;
+    h *= 0xff51afd7ed558ccdULL;
+    h ^= h >> 33;
+    return static_cast<int>(h % static_cast<uint64_t>(n_shards_));
+  }
+
+  int dim_;
+  int n_shards_;
+  std::vector<std::unordered_map<int64_t, Row>> shards_;
+  mutable std::vector<std::mutex> mus_;
+  int64_t seed_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptkv_create(int dim, int n_shards, int64_t seed) {
+  return new KVTable(dim, n_shards, seed);
+}
+
+void ptkv_destroy(void* h) { delete static_cast<KVTable*>(h); }
+
+int64_t ptkv_size(void* h) { return static_cast<KVTable*>(h)->Size(); }
+
+void ptkv_pull(void* h, int64_t* ids, int64_t n, float* out,
+               int init_mode) {
+  static_cast<KVTable*>(h)->Pull(ids, n, out, init_mode);
+}
+
+void ptkv_push_grad(void* h, int64_t* ids, int64_t n, float* grads,
+                    float lr) {
+  static_cast<KVTable*>(h)->PushGrad(ids, n, grads, lr);
+}
+
+void ptkv_push_assign(void* h, int64_t* ids, int64_t n, float* vals) {
+  static_cast<KVTable*>(h)->PushAssign(ids, n, vals);
+}
+
+void ptkv_keys(void* h, int64_t* out) {
+  static_cast<KVTable*>(h)->Keys(out);
+}
+
+void ptkv_shrink(void* h, int threshold) {
+  static_cast<KVTable*>(h)->Shrink(threshold);
+}
+
+}  // extern "C"
