@@ -228,6 +228,16 @@ class CollectiveOptimizer:
         recompute, gradient merge (strategy_compiler.py ordering)."""
         from .. import optimizer as opt_mod
         s = self._strategy
+        # DGC swap happens on the raw inner optimizer, before any wrapper
+        # hides its type (ref: incubate/fleet/collective/__init__.py:478)
+        if s.use_dgc and isinstance(optimizer, opt_mod.MomentumOptimizer):
+            optimizer = opt_mod.DGCMomentumOptimizer(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                rampup_begin_step=0,
+                use_nesterov=optimizer._use_nesterov,
+                regularization=optimizer.regularization,
+                grad_clip=optimizer._grad_clip)
         if s.lamb and not isinstance(optimizer, opt_mod.LambOptimizer):
             optimizer = opt_mod.LambOptimizer(
                 learning_rate=optimizer._learning_rate,
@@ -250,6 +260,10 @@ class CollectiveOptimizer:
             optimizer = opt_mod.GradientMergeOptimizer(
                 optimizer, k_steps=s.gradient_merge_configs.get("k_steps", 1),
                 avg=s.gradient_merge_configs.get("avg", True))
+        if s.localsgd:
+            optimizer = opt_mod.LocalSGDOptimizer(
+                optimizer, k_steps=s.localsgd_configs.get("k_steps", 1),
+                begin_step=s.localsgd_configs.get("begin_step", 1))
         return optimizer
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
@@ -272,8 +286,12 @@ class CollectiveOptimizer:
         fleet._mesh = mesh
         if mesh is not None and mesh.devices.size > 1:
             from ..framework.compiler import CompiledProgram
+            # LocalSGD replaces per-step grad allreduce with periodic param
+            # averaging (already appended by LocalSGDOptimizer) — pass
+            # loss_name=None so no grad allreduce is inserted
+            ln = None if self._strategy.localsgd else loss.name
             fleet._compiled_program = CompiledProgram(
-                program).with_data_parallel(loss_name=loss.name, mesh=mesh)
+                program).with_data_parallel(loss_name=ln, mesh=mesh)
         else:
             fleet._compiled_program = None
         return opt_ops, params_grads

@@ -171,3 +171,29 @@ def _collective_permute(ctx, ins, attrs):
     shift = attrs.get("shift", 1)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return {"Out": lax.ppermute(a, axis, perm)}
+
+
+@register("local_sgd_sync")
+def _local_sgd_sync(ctx, ins, attrs):
+    """k-periodic parameter averaging for LocalSGD (ref:
+    transpiler/collective.py:270 LocalSGD, localsgd_optimizer.py).
+
+    All params are averaged over the dp axis inside one ``lax.cond`` gated
+    on the (replicated) step counter, so the AllReduce only executes on
+    sync steps — the communication saving that is LocalSGD's whole point.
+    Safe under shard_map because every device holds the same step value and
+    takes the same branch."""
+    step = x(ins, "Step").reshape(()).astype(jnp.float32)
+    params = tuple(ins.get("Params", []))
+    axis = _ring_axis(ctx, attrs)
+    if axis is None or not params:
+        return {"Out": list(params)}
+    k = float(attrs.get("k_steps", 1))
+    begin = float(attrs.get("begin_step", 1))
+    do_sync = jnp.logical_and(jnp.mod(step, k) == 0.0, step >= begin)
+    outs = lax.cond(
+        do_sync,
+        lambda ps: tuple(lax.pmean(p, axis) for p in ps),
+        lambda ps: ps,
+        params)
+    return {"Out": list(outs)}

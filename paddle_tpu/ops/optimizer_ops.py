@@ -269,3 +269,103 @@ def _update_loss_scaling(ctx, ins, attrs):
     return {"Out": outs, "LossScaling": new_scale,
             "OutGoodSteps": good_new.astype(jnp.int32),
             "OutBadSteps": bad_new.astype(jnp.int32)}
+
+
+@register("average_accumulates")
+def _average_accumulates(ctx, ins, attrs):
+    """Sliding-window parameter averaging accumulator (ref:
+    operators/optimizers/average_accumulates_op.h, used by ModelAverage
+    optimizer.py:3069).
+
+    State machine (identical to the reference, expressed with jnp.where so
+    the step stays one static XLA program):
+      num_updates += 1; num_accumulates += 1; sum_1 += param
+      if num_updates % kMaxNumAccumulates == 0: sum_2 += sum_1; sum_1 = 0
+      if num_accumulates >= min_average_window and
+         num_accumulates >= min(max_average_window,
+                                num_updates * average_window_rate):
+          sum_3 = sum_1 + sum_2; sum_1 = sum_2 = 0
+          old_num_accumulates = num_accumulates; num_accumulates = 0
+    """
+    p = x(ins, "param")
+    s1, s2, s3 = x(ins, "in_sum_1"), x(ins, "in_sum_2"), x(ins, "in_sum_3")
+    num_acc = x(ins, "in_num_accumulates")
+    old_num = x(ins, "in_old_num_accumulates")
+    num_upd = x(ins, "in_num_updates")
+    rate = attrs.get("average_window", 0.0)
+    max_win = attrs.get("max_average_window", 10000)
+    min_win = attrs.get("min_average_window", 10000)
+    k_max = 16384  # kMaxNumAccumulates in the reference
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    s1 = s1 + p.astype(s1.dtype)
+    roll = (num_upd % k_max) == 0
+    s2 = jnp.where(roll, s2 + s1, s2)
+    s1 = jnp.where(roll, jnp.zeros_like(s1), s1)
+    window = jnp.minimum(jnp.asarray(float(max_win)),
+                         num_upd.astype(jnp.float32) * rate)
+    shift = jnp.logical_and(num_acc >= min_win,
+                            num_acc.astype(jnp.float32) >= window)
+    s3 = jnp.where(shift, s1 + s2, s3)
+    s1 = jnp.where(shift, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(shift, jnp.zeros_like(s2), s2)
+    old_num = jnp.where(shift, num_acc, old_num)
+    num_acc = jnp.where(shift, jnp.zeros_like(num_acc), num_acc)
+    return {"out_sum_1": s1, "out_sum_2": s2, "out_sum_3": s3,
+            "out_num_accumulates": num_acc,
+            "out_old_num_accumulates": old_num,
+            "out_num_updates": num_upd}
+
+
+@register("dgc_momentum")
+def _dgc_momentum(ctx, ins, attrs):
+    """Deep Gradient Compression momentum step (ref: operators/dgc_op.cc +
+    optimizers/momentum via DGCMomentumOptimizer optimizer.py:1143).
+
+    DGC keeps two accumulators: U (momentum-corrected velocity) and V (the
+    residual of unsent gradient mass).  Each step the top-(1-s) fraction of
+    |V| by magnitude is "sent" (here: kept dense and psum'd over ICI — the
+    bandwidth motivation for sparsifying disappears on TPU interconnect, but
+    the *convergence semantics* of masked updates + residual accumulation
+    are preserved exactly).  Before ``rampup_begin_step`` it is plain
+    momentum.  The sparsity ratio ramps through ``sparsity`` over
+    ``rampup_step`` steps; the top-k threshold is computed as a dynamic
+    quantile so the program stays shape-static.
+    """
+    p, g, lr = x(ins, "Param"), x(ins, "Grad"), x(ins, "LearningRate")
+    u, v = x(ins, "U"), x(ins, "V")
+    step = x(ins, "CurrentStep")
+    mu = attrs.get("momentum", 0.9)
+    use_nesterov = attrs.get("use_nesterov", False)
+    rampup_begin = float(attrs.get("rampup_begin_step", 0.0))
+    rampup_step = max(float(attrs.get("rampup_step", 1.0)), 1.0)
+    sparsity = list(attrs.get("sparsity", [0.999]))
+
+    lr = lr.astype(p.dtype)
+    g = g.astype(p.dtype)
+    stepf = step.reshape(()).astype(jnp.float32)
+
+    # sparsity schedule: index into the sparsity list over the ramp window
+    prog = jnp.clip((stepf - rampup_begin) / rampup_step, 0.0, 1.0)
+    sched = jnp.asarray(sparsity, jnp.float32)
+    idx = jnp.minimum((prog * len(sparsity)).astype(jnp.int32),
+                      len(sparsity) - 1)
+    ratio = sched[idx]
+
+    # momentum correction (DGC paper eq. 4): U accumulates, V holds residual
+    u_new = mu * u + g
+    v_new = v + u_new
+    absv = jnp.abs(v_new).reshape(-1)
+    thr = jnp.quantile(absv.astype(jnp.float32), ratio).astype(p.dtype)
+    mask = (jnp.abs(v_new) >= thr).astype(p.dtype)
+    sent = v_new * mask                    # dense "encoded" gradient
+    v_keep = v_new * (1.0 - mask)
+    u_keep = u_new * (1.0 - mask)
+
+    dgc_on = stepf >= rampup_begin
+    plain_update = g + mu * u_new if use_nesterov else u_new
+    p_out = jnp.where(dgc_on, p - lr * sent, p - lr * plain_update)
+    u_out = jnp.where(dgc_on, u_keep, u_new)
+    v_out = jnp.where(dgc_on, v_keep, v)
+    return {"ParamOut": p_out, "UOut": u_out, "VOut": v_out}

@@ -730,33 +730,8 @@ class GradientMergeOptimizer(Optimizer):
         startup = default_startup_program().global_block()
         params_grads = self._inner.backward(loss, startup_program,
                                             parameter_list, no_grad_set)
-        # step counter
-        step_name = unique_name.generate("grad_merge_step")
-        step = main.create_var(name=step_name, shape=(1,), dtype="float32",
-                               persistable=True)
-        sstep = startup.create_var(name=step_name, shape=(1,),
-                                   dtype="float32", persistable=True)
-        startup.append_op(type="fill_constant", outputs={"Out": [sstep]},
-                          attrs={"shape": [1], "dtype": "float32",
-                                 "value": 0.0})
-        main.append_op(type="increment", inputs={"X": [step]},
-                       outputs={"Out": [step]}, attrs={"step": 1.0})
         # apply_mask = (step % k == 0)
-        modk = main.create_var(name=unique_name.generate("gm_modk"),
-                               shape=(1,), dtype="float32")
-        main.append_op(type="elementwise_mod", inputs={
-            "X": [step], "Y": [_const_var(main, startup, float(self.k_steps))]},
-            outputs={"Out": [modk]}, attrs={"axis": -1})
-        mask = main.create_var(name=unique_name.generate("gm_mask"),
-                               shape=(1,), dtype="bool")
-        main.append_op(type="equal", inputs={
-            "X": [modk], "Y": [_const_var(main, startup, 0.0)]},
-            outputs={"Out": [mask]})
-        maskf = main.create_var(name=unique_name.generate("gm_maskf"),
-                                shape=(1,), dtype="float32")
-        main.append_op(type="cast", inputs={"X": [mask]},
-                       outputs={"Out": [maskf]},
-                       attrs={"out_dtype": "float32"})
+        maskf, inv_mask = _periodic_mask(main, startup, self.k_steps, "gm")
 
         merged = []
         for p, g in params_grads:
@@ -781,19 +756,66 @@ class GradientMergeOptimizer(Optimizer):
                            outputs={"Out": [eff]}, attrs={"axis": -1})
             merged.append((p, eff))
             # reset acc when applied: acc *= (1 - mask)
-            inv_name = unique_name.generate("gm_inv_mask")
-            inv = main.create_var(name=inv_name, shape=(1,), dtype="float32")
-            main.append_op(type="scale", inputs={"X": [maskf]},
-                           outputs={"Out": [inv]},
-                           attrs={"scale": -1.0, "bias": 1.0})
             main.append_op(type="elementwise_mul",
-                           inputs={"X": [acc], "Y": [inv]},
+                           inputs={"X": [acc], "Y": [inv_mask]},
                            outputs={"Out": [acc]}, attrs={"axis": -1})
         # NOTE: masked-grad trick means optimizer state (e.g. momentum)
         # decays slightly on skip steps for stateful optimizers; exact skip
         # needs lax.cond lowering (future work).
         opt_ops = self._inner.apply_gradients(merged)
         return opt_ops, merged
+
+
+def _periodic_mask(main, startup, k, prefix="pm"):
+    """Append a persistable step counter + ``mask = (step % k == 0)`` ops;
+    returns (maskf, inv_maskf) float32 (1,) vars.  Shared scaffolding for
+    the k-periodic wrapper optimizers (GradientMerge, Lookahead)."""
+    step_name = unique_name.generate(f"{prefix}_step")
+    step = main.create_var(name=step_name, shape=(1,), dtype="float32",
+                           persistable=True)
+    sstep = startup.create_var(name=step_name, shape=(1,), dtype="float32",
+                               persistable=True)
+    startup.append_op(type="fill_constant", outputs={"Out": [sstep]},
+                      attrs={"shape": [1], "dtype": "float32", "value": 0.0})
+    main.append_op(type="increment", inputs={"X": [step]},
+                   outputs={"Out": [step]}, attrs={"step": 1.0})
+    modk = main.create_var(name=unique_name.generate(f"{prefix}_modk"),
+                           shape=(1,), dtype="float32")
+    main.append_op(type="elementwise_mod", inputs={
+        "X": [step], "Y": [_const_var(main, startup, float(k))]},
+        outputs={"Out": [modk]}, attrs={"axis": -1})
+    mask = main.create_var(name=unique_name.generate(f"{prefix}_mask"),
+                           shape=(1,), dtype="bool")
+    main.append_op(type="equal", inputs={
+        "X": [modk], "Y": [_const_var(main, startup, 0.0)]},
+        outputs={"Out": [mask]})
+    maskf = main.create_var(name=unique_name.generate(f"{prefix}_maskf"),
+                            shape=(1,), dtype="float32")
+    main.append_op(type="cast", inputs={"X": [mask]},
+                   outputs={"Out": [maskf]},
+                   attrs={"out_dtype": "float32"})
+    inv = main.create_var(name=unique_name.generate(f"{prefix}_inv"),
+                          shape=(1,), dtype="float32")
+    main.append_op(type="scale", inputs={"X": [maskf]},
+                   outputs={"Out": [inv]},
+                   attrs={"scale": -1.0, "bias": 1.0})
+    return maskf, inv
+
+
+def _swap_context(executor, apply_program, restore_fn, need_restore):
+    """Shared apply()/restore() contextmanager for the param-swapping
+    averaging optimizers (ModelAverage, EMA)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ctx():
+        executor.run(apply_program)
+        try:
+            yield
+        finally:
+            if need_restore:
+                restore_fn(executor)
+    return _ctx()
 
 
 def _const_var(main, startup, value):
@@ -806,6 +828,484 @@ def _const_var(main, startup, value):
                       attrs={"shape": [1], "dtype": "float32",
                              "value": float(value)})
     return v
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """Deep Gradient Compression momentum (ref: optimizer.py:1143
+    DGCMomentumOptimizer; kernels operators/dgc_op.cc,
+    details/sparse_all_reduce_op_handle.cc).
+
+    The reference sparsifies gradients to save NCCL bandwidth; on TPU the
+    allreduce rides ICI and stays dense, but the DGC *convergence semantics*
+    (momentum correction, masked top-k updates, local residual accumulation,
+    momentum factor masking) are reproduced exactly by the ``dgc_momentum``
+    op.  ``num_trainers`` and the clip-norm knob are accepted for script
+    compatibility."""
+
+    type = "dgc_momentum"
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step,
+                 rampup_step=1, sparsity=None, use_nesterov=False,
+                 local_grad_clip_norm=None, num_trainers=None,
+                 regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+        self._rampup_begin_step = rampup_begin_step
+        self._rampup_step = rampup_step
+        self._sparsity = list(sparsity or [0.999])
+        self._step_var = None
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("u_velocity", p)
+            self._add_accumulator("v_residual", p)
+        if self._step_var is None:
+            main = default_main_program().global_block()
+            startup = default_startup_program().global_block()
+            name = unique_name.generate("dgc_step")
+            self._step_var = main.create_var(
+                name=name, shape=(1,), dtype="float32", persistable=True)
+            sv = startup.create_var(name=name, shape=(1,), dtype="float32",
+                                    persistable=True)
+            startup.append_op(type="fill_constant", outputs={"Out": [sv]},
+                              attrs={"shape": [1], "dtype": "float32",
+                                     "value": 0.0})
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            type="dgc_momentum",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._param_lr(p)],
+                    "U": [self._get_accumulator("u_velocity", p)],
+                    "V": [self._get_accumulator("v_residual", p)],
+                    "CurrentStep": [self._step_var]},
+            outputs={"ParamOut": [p],
+                     "UOut": [self._get_accumulator("u_velocity", p)],
+                     "VOut": [self._get_accumulator("v_residual", p)]},
+            attrs={"momentum": self._momentum,
+                   "use_nesterov": self._use_nesterov,
+                   "rampup_begin_step": float(self._rampup_begin_step),
+                   "rampup_step": float(self._rampup_step),
+                   "sparsity": self._sparsity})
+
+    def apply_gradients(self, params_grads):
+        opt_ops = super().apply_gradients(params_grads)
+        block = default_main_program().global_block()
+        block.append_op(type="increment", inputs={"X": [self._step_var]},
+                        outputs={"Out": [self._step_var]},
+                        attrs={"step": 1.0})
+        return opt_ops
+
+
+class ModelAverage(Optimizer):
+    """Sliding-window parameter averaging (ref: optimizer.py:3069
+    ModelAverage; op operators/optimizers/average_accumulates_op.h).
+
+    Appends an ``average_accumulates`` op per parameter to the main program;
+    ``apply()`` swaps parameters for their windowed average (inference-time
+    weights), ``restore()`` swaps back.  Like the reference, apply/restore
+    are standalone programs run against the shared scope."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization, None, name)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._params = [
+            v for v in default_main_program().global_block().vars.values()
+            if isinstance(v, Parameter) and v.trainable]
+        main = default_main_program().global_block()
+        for p in self._params:
+            self._add_accumulator("sum_1", p)
+            self._add_accumulator("sum_2", p)
+            self._add_accumulator("sum_3", p)
+            self._add_accumulator("num_accumulates", p, shape=(1,),
+                                  dtype="int32")
+            self._add_accumulator("old_num_accumulates", p, shape=(1,),
+                                  dtype="int32")
+            self._add_accumulator("num_updates", p, shape=(1,),
+                                  dtype="int32")
+            acc = {n: self._get_accumulator(n, p) for n in
+                   ("sum_1", "sum_2", "sum_3", "num_accumulates",
+                    "old_num_accumulates", "num_updates")}
+            main.append_op(
+                type="average_accumulates",
+                inputs={"param": [p],
+                        "in_sum_1": [acc["sum_1"]],
+                        "in_sum_2": [acc["sum_2"]],
+                        "in_sum_3": [acc["sum_3"]],
+                        "in_num_accumulates": [acc["num_accumulates"]],
+                        "in_old_num_accumulates":
+                            [acc["old_num_accumulates"]],
+                        "in_num_updates": [acc["num_updates"]]},
+                outputs={"out_sum_1": [acc["sum_1"]],
+                         "out_sum_2": [acc["sum_2"]],
+                         "out_sum_3": [acc["sum_3"]],
+                         "out_num_accumulates": [acc["num_accumulates"]],
+                         "out_old_num_accumulates":
+                             [acc["old_num_accumulates"]],
+                         "out_num_updates": [acc["num_updates"]]},
+                attrs={"average_window": float(self.average_window),
+                       "min_average_window": int(self.min_average_window),
+                       "max_average_window": int(self.max_average_window)})
+        self._apply_program, self._restore_program = self._build_swap()
+
+    def _build_swap(self):
+        from .framework.core import Program, program_guard
+        apply_prog, restore_prog = Program(), Program()
+        acc_names = {p.name: {n: self._get_accumulator(n, p).name
+                              for n in ("sum_1", "sum_2", "sum_3",
+                                        "num_accumulates",
+                                        "old_num_accumulates")}
+                     for p in self._params}
+        with program_guard(apply_prog, Program()):
+            blk = apply_prog.global_block()
+            for p in self._params:
+                names = acc_names[p.name]
+                pv = blk.create_var(name=p.name, shape=p.shape,
+                                    dtype=p.dtype, persistable=True)
+                backup = blk.create_var(name=f"{p.name}@MA_BACKUP",
+                                        shape=p.shape, dtype=p.dtype,
+                                        persistable=True)
+                blk.append_op(type="assign", inputs={"X": [pv]},
+                              outputs={"Out": [backup]})
+                sums = []
+                for n in ("sum_1", "sum_2", "sum_3"):
+                    sums.append(blk.create_var(
+                        name=names[n], shape=p.shape, dtype=p.dtype,
+                        persistable=True))
+                total = blk.create_var(name=f"{p.name}@MA_SUM",
+                                       shape=p.shape, dtype=p.dtype)
+                blk.append_op(type="sum", inputs={"X": sums},
+                              outputs={"Out": [total]})
+                counts = []
+                for n in ("num_accumulates", "old_num_accumulates"):
+                    counts.append(blk.create_var(
+                        name=names[n], shape=(1,), dtype="int32",
+                        persistable=True))
+                cnt = blk.create_var(name=f"{p.name}@MA_CNT", shape=(1,),
+                                     dtype="int32")
+                blk.append_op(type="sum", inputs={"X": counts},
+                              outputs={"Out": [cnt]})
+                cntf = blk.create_var(name=f"{p.name}@MA_CNTF", shape=(1,),
+                                      dtype=p.dtype)
+                blk.append_op(type="cast", inputs={"X": [cnt]},
+                              outputs={"Out": [cntf]},
+                              attrs={"out_dtype": p.dtype})
+                one = blk.create_var(name=f"{p.name}@MA_ONE", shape=(1,),
+                                     dtype=p.dtype)
+                blk.append_op(type="fill_constant", outputs={"Out": [one]},
+                              attrs={"shape": [1], "dtype": p.dtype,
+                                     "value": 1.0})
+                denom = blk.create_var(name=f"{p.name}@MA_DEN", shape=(1,),
+                                       dtype=p.dtype)
+                blk.append_op(type="elementwise_max",
+                              inputs={"X": [cntf], "Y": [one]},
+                              outputs={"Out": [denom]}, attrs={"axis": -1})
+                blk.append_op(type="elementwise_div",
+                              inputs={"X": [total], "Y": [denom]},
+                              outputs={"Out": [pv]}, attrs={"axis": -1})
+        with program_guard(restore_prog, Program()):
+            blk = restore_prog.global_block()
+            for p in self._params:
+                pv = blk.create_var(name=p.name, shape=p.shape,
+                                    dtype=p.dtype, persistable=True)
+                backup = blk.create_var(name=f"{p.name}@MA_BACKUP",
+                                        shape=p.shape, dtype=p.dtype,
+                                        persistable=True)
+                blk.append_op(type="assign", inputs={"X": [backup]},
+                              outputs={"Out": [pv]})
+        return apply_prog, restore_prog
+
+    def apply(self, executor, need_restore=True):
+        """Context manager swapping params for averaged values
+        (ref: optimizer.py ModelAverage.apply)."""
+        return _swap_context(executor, self._apply_program, self.restore,
+                             need_restore)
+
+    def restore(self, executor):
+        executor.run(self._restore_program)
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (ref: optimizer.py:3378 ExponentialMovingAverage).
+
+    ``update()`` appends ``ema = decay_t * ema + (1 - decay_t) * param`` ops
+    to the main program (decay_t ramps as min(decay, (1+step)/(10+step))
+    when ``thres_steps`` is given, matching the reference); ``apply()``
+    swaps in bias-corrected EMA weights, ``restore()`` swaps back."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._thres_steps = thres_steps
+        self._name = name or ""
+        self._ema_vars = {}
+        self._params = []
+        self._step_var = None
+        self._apply_program = None
+        self._restore_program = None
+
+    def update(self):
+        main = default_main_program().global_block()
+        startup = default_startup_program().global_block()
+        self._params = [v for v in main.vars.values()
+                        if isinstance(v, Parameter) and v.trainable]
+        step_name = unique_name.generate("ema_step")
+        self._step_var = main.create_var(name=step_name, shape=(1,),
+                                         dtype="float32", persistable=True)
+        sv = startup.create_var(name=step_name, shape=(1,), dtype="float32",
+                                persistable=True)
+        startup.append_op(type="fill_constant", outputs={"Out": [sv]},
+                          attrs={"shape": [1], "dtype": "float32",
+                                 "value": 0.0})
+        main.append_op(type="increment", inputs={"X": [self._step_var]},
+                       outputs={"Out": [self._step_var]},
+                       attrs={"step": 1.0})
+        # decay_t: constant, or ramped by the thres_steps variable
+        if self._thres_steps is not None:
+            t = self._thres_steps
+            ramp = main.create_var(name=unique_name.generate("ema_ramp"),
+                                   shape=(1,), dtype="float32")
+            num = main.create_var(name=unique_name.generate("ema_num"),
+                                  shape=(1,), dtype="float32")
+            den = main.create_var(name=unique_name.generate("ema_den"),
+                                  shape=(1,), dtype="float32")
+            main.append_op(type="scale", inputs={"X": [t]},
+                           outputs={"Out": [num]},
+                           attrs={"scale": 1.0, "bias": 1.0})
+            main.append_op(type="scale", inputs={"X": [t]},
+                           outputs={"Out": [den]},
+                           attrs={"scale": 1.0, "bias": 10.0})
+            main.append_op(type="elementwise_div",
+                           inputs={"X": [num], "Y": [den]},
+                           outputs={"Out": [ramp]}, attrs={"axis": -1})
+            decay_var = main.create_var(
+                name=unique_name.generate("ema_decay"), shape=(1,),
+                dtype="float32")
+            cd = _const_var(main, startup, self._decay)
+            main.append_op(type="elementwise_min",
+                           inputs={"X": [ramp], "Y": [cd]},
+                           outputs={"Out": [decay_var]}, attrs={"axis": -1})
+        else:
+            decay_var = _const_var(main, startup, self._decay)
+        self._decay_var_name = decay_var.name
+        for p in self._params:
+            ema_name = unique_name.generate(f"{p.name}_ema")
+            ema = main.create_var(name=ema_name, shape=p.shape,
+                                  dtype=p.dtype, persistable=True)
+            sev = startup.create_var(name=ema_name, shape=p.shape,
+                                     dtype=p.dtype, persistable=True)
+            startup.append_op(type="fill_constant", outputs={"Out": [sev]},
+                              attrs={"shape": list(p.shape),
+                                     "dtype": p.dtype, "value": 0.0})
+            self._ema_vars[p.name] = ema
+            # ema = decay*ema + (1-decay)*param
+            t1 = main.create_var(name=unique_name.generate("ema_t1"),
+                                 shape=p.shape, dtype=p.dtype)
+            main.append_op(type="elementwise_mul",
+                           inputs={"X": [ema], "Y": [decay_var]},
+                           outputs={"Out": [t1]}, attrs={"axis": -1})
+            omd = main.create_var(name=unique_name.generate("ema_omd"),
+                                  shape=(1,), dtype="float32")
+            main.append_op(type="scale", inputs={"X": [decay_var]},
+                           outputs={"Out": [omd]},
+                           attrs={"scale": -1.0, "bias": 1.0})
+            t2 = main.create_var(name=unique_name.generate("ema_t2"),
+                                 shape=p.shape, dtype=p.dtype)
+            main.append_op(type="elementwise_mul",
+                           inputs={"X": [p], "Y": [omd]},
+                           outputs={"Out": [t2]}, attrs={"axis": -1})
+            main.append_op(type="elementwise_add",
+                           inputs={"X": [t1], "Y": [t2]},
+                           outputs={"Out": [ema]}, attrs={"axis": -1})
+        self._apply_program, self._restore_program = self._build_swap()
+
+    def _build_swap(self):
+        from .framework.core import Program, program_guard
+        apply_prog, restore_prog = Program(), Program()
+        with program_guard(apply_prog, Program()):
+            blk = apply_prog.global_block()
+            step = blk.create_var(name=self._step_var.name, shape=(1,),
+                                  dtype="float32", persistable=True)
+            # bias correction factor 1 - decay^step = 1 - exp(step*ln(decay))
+            logd = blk.create_var(name=unique_name.generate("ema_logd"),
+                                  shape=(1,), dtype="float32")
+            blk.append_op(type="scale", inputs={"X": [step]},
+                          outputs={"Out": [logd]},
+                          attrs={"scale": float(np.log(self._decay))})
+            powd = blk.create_var(name=unique_name.generate("ema_powd"),
+                                  shape=(1,), dtype="float32")
+            blk.append_op(type="exp", inputs={"X": [logd]},
+                          outputs={"Out": [powd]})
+            factor = blk.create_var(name=unique_name.generate("ema_factor"),
+                                    shape=(1,), dtype="float32")
+            blk.append_op(type="scale", inputs={"X": [powd]},
+                          outputs={"Out": [factor]},
+                          attrs={"scale": -1.0, "bias": 1.0})
+            for p in self._params:
+                pv = blk.create_var(name=p.name, shape=p.shape,
+                                    dtype=p.dtype, persistable=True)
+                ema = blk.create_var(name=self._ema_vars[p.name].name,
+                                     shape=p.shape, dtype=p.dtype,
+                                     persistable=True)
+                backup = blk.create_var(name=f"{p.name}@EMA_BACKUP",
+                                        shape=p.shape, dtype=p.dtype,
+                                        persistable=True)
+                blk.append_op(type="assign", inputs={"X": [pv]},
+                              outputs={"Out": [backup]})
+                blk.append_op(type="elementwise_div",
+                              inputs={"X": [ema], "Y": [factor]},
+                              outputs={"Out": [pv]}, attrs={"axis": -1})
+        with program_guard(restore_prog, Program()):
+            blk = restore_prog.global_block()
+            for p in self._params:
+                pv = blk.create_var(name=p.name, shape=p.shape,
+                                    dtype=p.dtype, persistable=True)
+                backup = blk.create_var(name=f"{p.name}@EMA_BACKUP",
+                                        shape=p.shape, dtype=p.dtype,
+                                        persistable=True)
+                blk.append_op(type="assign", inputs={"X": [backup]},
+                              outputs={"Out": [pv]})
+        return apply_prog, restore_prog
+
+    def apply(self, executor, need_restore=True):
+        return _swap_context(executor, self._apply_program, self.restore,
+                             need_restore)
+
+    def restore(self, executor):
+        executor.run(self._restore_program)
+
+
+class LookaheadOptimizer:
+    """Lookahead wrapper (ref: optimizer.py:4788 LookaheadOptimizer):
+    fast weights step with the inner optimizer every step; every ``k``
+    steps the slow weights move ``alpha`` toward the fast weights and the
+    fast weights reset to the slow weights.  The k-periodic swap is
+    expressed with a 0/1 mask so the step stays one static XLA program."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        assert inner_optimizer is not None
+        assert 0.0 <= alpha <= 1.0
+        assert k >= 1 and isinstance(k, int)
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self.type = "lookahead"
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .framework.core import program_guard
+        opt_ops, params_grads = self.inner_optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        with program_guard(loss.block.program,
+                           startup_program or default_startup_program()):
+            self._append_lookahead(params_grads)
+        return opt_ops, params_grads
+
+    def _append_lookahead(self, params_grads):
+        main = default_main_program().global_block()
+        startup = default_startup_program().global_block()
+        maskf, inv = _periodic_mask(main, startup, self.k, "la")
+        for p, _ in params_grads:
+            slow_name = unique_name.generate(f"{p.name}_slow")
+            slow = main.create_var(name=slow_name, shape=p.shape,
+                                   dtype=p.dtype, persistable=True)
+            sslow = startup.create_var(name=slow_name, shape=p.shape,
+                                       dtype=p.dtype, persistable=True)
+            # slow weights start equal to the initialised fast weights
+            startup.append_op(type="assign", inputs={"X": [p.name]},
+                              outputs={"Out": [sslow]})
+            # slow' = slow + mask*alpha*(fast - slow)
+            diff = main.create_var(name=unique_name.generate("la_diff"),
+                                   shape=p.shape, dtype=p.dtype)
+            main.append_op(type="elementwise_sub",
+                           inputs={"X": [p], "Y": [slow]},
+                           outputs={"Out": [diff]}, attrs={"axis": -1})
+            scaled = main.create_var(name=unique_name.generate("la_sc"),
+                                     shape=p.shape, dtype=p.dtype)
+            main.append_op(type="scale", inputs={"X": [diff]},
+                           outputs={"Out": [scaled]},
+                           attrs={"scale": float(self.alpha)})
+            masked = main.create_var(name=unique_name.generate("la_msk"),
+                                     shape=p.shape, dtype=p.dtype)
+            main.append_op(type="elementwise_mul",
+                           inputs={"X": [scaled], "Y": [maskf]},
+                           outputs={"Out": [masked]}, attrs={"axis": -1})
+            main.append_op(type="elementwise_add",
+                           inputs={"X": [slow], "Y": [masked]},
+                           outputs={"Out": [slow]}, attrs={"axis": -1})
+            # fast' = mask*slow' + (1-mask)*fast
+            t1 = main.create_var(name=unique_name.generate("la_t1"),
+                                 shape=p.shape, dtype=p.dtype)
+            main.append_op(type="elementwise_mul",
+                           inputs={"X": [slow], "Y": [maskf]},
+                           outputs={"Out": [t1]}, attrs={"axis": -1})
+            t2 = main.create_var(name=unique_name.generate("la_t2"),
+                                 shape=p.shape, dtype=p.dtype)
+            main.append_op(type="elementwise_mul",
+                           inputs={"X": [p], "Y": [inv]},
+                           outputs={"Out": [t2]}, attrs={"axis": -1})
+            main.append_op(type="elementwise_add",
+                           inputs={"X": [t1], "Y": [t2]},
+                           outputs={"Out": [p]}, attrs={"axis": -1})
+
+
+class LocalSGDOptimizer:
+    """Local SGD (ref: transpiler/collective.py:270 LocalSGD,
+    fleet/meta_optimizers/localsgd_optimizer.py): workers step locally
+    (no per-step grad allreduce) and every ``k_steps`` the parameters are
+    averaged across the data-parallel axis.  The averaging is a masked
+    ``c_allreduce_sum`` + divide, which lowers to an XLA AllReduce over ICI
+    under the executor's shard_map; on a single device it is identity."""
+
+    def __init__(self, inner_optimizer, k_steps=1, begin_step=1,
+                 axis_name="dp"):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = k_steps
+        self.begin_step = begin_step
+        self.axis_name = axis_name
+        self.type = "localsgd"
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .framework.core import program_guard
+        opt_ops, params_grads = self.inner_optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        with program_guard(loss.block.program,
+                           startup_program or default_startup_program()):
+            self._append_avg(params_grads)
+        return opt_ops, params_grads
+
+    def _append_avg(self, params_grads):
+        main = default_main_program().global_block()
+        startup = default_startup_program().global_block()
+        step_name = unique_name.generate("localsgd_step")
+        step = main.create_var(name=step_name, shape=(1,), dtype="float32",
+                               persistable=True)
+        sstep = startup.create_var(name=step_name, shape=(1,),
+                                   dtype="float32", persistable=True)
+        startup.append_op(type="fill_constant", outputs={"Out": [sstep]},
+                          attrs={"shape": [1], "dtype": "float32",
+                                 "value": 0.0})
+        main.append_op(type="increment", inputs={"X": [step]},
+                       outputs={"Out": [step]}, attrs={"step": 1.0})
+        params = [p for p, _ in params_grads]
+        main.append_op(
+            type="local_sgd_sync",
+            inputs={"Step": [step], "Params": params},
+            outputs={"Out": params},
+            attrs={"k_steps": float(self.k_steps),
+                   "begin_step": float(self.begin_step),
+                   "ring_id": 0, "_axis_name": self.axis_name})
 
 
 # public aliases matching the reference's exports (optimizer.py bottom)

@@ -77,3 +77,49 @@ def test_role_maker_topology():
     assert rm.worker_index() == 2
     assert rm.worker_num() == 4
     assert not rm.is_first_worker()
+
+
+def test_fleet_localsgd_on_mesh():
+    """LocalSGD strategy: no per-step grad allreduce; periodic masked param
+    averaging over dp (ref: localsgd meta optimizer / collective.py:270)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        loss = _model()
+        fleet.init(UserDefinedRoleMaker(0, 1))
+        strategy = DistributedStrategy()
+        strategy.localsgd = True
+        strategy.localsgd_configs = {"k_steps": 2}
+        strategy.mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+        opt = distributed_optimizer(fluid.optimizer.SGD(0.1), strategy)
+        opt.minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    # cond-gated param sync present; no per-step grad allreduce inserted
+    assert "local_sgd_sync" in types
+    assert "c_allreduce_sum" not in types
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 8).astype(np.float32)
+    ys = (xs.sum(1) > 0).astype(np.int64).reshape(-1, 1)
+    losses = []
+    for _ in range(10):
+        l, = exe.run(fleet.main_program, feed={"x": xs, "label": ys},
+                     fetch_list=[loss])
+        losses.append(float(l))
+    assert losses[-1] < losses[0]
+
+
+def test_fleet_dgc_swap():
+    """strategy.use_dgc swaps Momentum for DGCMomentum
+    (ref: incubate/fleet/collective/__init__.py:478)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        loss = _model()
+        fleet.init(UserDefinedRoleMaker(0, 1))
+        strategy = DistributedStrategy()
+        strategy.use_dgc = True
+        opt = distributed_optimizer(
+            fluid.optimizer.Momentum(0.05, momentum=0.9), strategy)
+        opt.minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "dgc_momentum" in types

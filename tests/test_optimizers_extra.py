@@ -1,0 +1,143 @@
+"""Wrapper/averaging optimizers: DGCMomentum, ModelAverage, EMA, Lookahead,
+LocalSGD (ref: test_dgc_momentum_op.py, test_modelaverage.py / ModelAverage
+optimizer.py:3069, test_ema.py, test_lookahead.py, localsgd meta optimizer)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import Program, program_guard
+
+
+def _linreg(opt, steps=8, seed=0):
+    """Train 1-param linear regression; return (losses, main, startup, exe,
+    loss_var)."""
+    rng = np.random.RandomState(seed)
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(x, 1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(
+                                   name="w",
+                                   initializer=fluid.initializer.Constant(
+                                       0.25)))
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        opt_obj = opt()
+        opt_obj.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    for _ in range(steps):
+        xb = rng.randn(16, 4).astype(np.float32)
+        yb = xb @ w_true
+        l, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        losses.append(float(l))
+    return losses, main, startup, exe, loss, opt_obj
+
+
+def test_dgc_momentum_converges():
+    losses, *_ = _linreg(
+        lambda: fluid.optimizer.DGCMomentumOptimizer(
+            learning_rate=0.05, momentum=0.9, rampup_begin_step=2,
+            rampup_step=4, sparsity=[0.7, 0.9]), steps=30)
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_dgc_momentum_matches_momentum_before_rampup():
+    """Before rampup_begin_step DGC is plain momentum (ref: dgc op docs)."""
+    l_dgc, *_ = _linreg(
+        lambda: fluid.optimizer.DGCMomentumOptimizer(
+            learning_rate=0.05, momentum=0.9, rampup_begin_step=1000),
+        steps=5, seed=3)
+    l_mom, *_ = _linreg(
+        lambda: fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9),
+        steps=5, seed=3)
+    np.testing.assert_allclose(l_dgc, l_mom, rtol=1e-5)
+
+
+def test_lookahead_converges_and_syncs():
+    losses, main, startup, exe, loss, _ = _linreg(
+        lambda: fluid.optimizer.LookaheadOptimizer(
+            fluid.optimizer.SGD(0.1), alpha=0.5, k=3), steps=30)
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_localsgd_single_device_converges():
+    # single device: the periodic param-average allreduce is identity
+    losses, *_ = _linreg(
+        lambda: fluid.optimizer.LocalSGDOptimizer(
+            fluid.optimizer.SGD(0.1), k_steps=4), steps=20)
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_model_average_apply_restore():
+    losses, main, startup, exe, loss, _ = _linreg(
+        lambda: fluid.optimizer.SGD(0.1), steps=1)
+    # ModelAverage must be built inside the same program context
+    with program_guard(main, startup):
+        ma = fluid.optimizer.ModelAverage(0.15, min_average_window=2,
+                                          max_average_window=10)
+    exe.run(startup)  # re-init (new accumulator vars were added)
+    rng = np.random.RandomState(0)
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    for _ in range(6):
+        xb = rng.randn(16, 4).astype(np.float32)
+        exe.run(main, feed={"x": xb, "y": xb @ w_true}, fetch_list=[loss])
+    from paddle_tpu.framework.executor import global_scope
+    w_cur = np.asarray(global_scope().find_var("w"))
+    with ma.apply(exe):
+        w_avg = np.asarray(global_scope().find_var("w"))
+        # averaged weights differ from the last-step weights
+        assert not np.allclose(w_avg, w_cur)
+    w_back = np.asarray(global_scope().find_var("w"))
+    np.testing.assert_allclose(w_back, w_cur, rtol=1e-6)
+
+
+def test_ema_tracks_params():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        pred = fluid.layers.fc(x, 1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(pred)
+        fluid.optimizer.SGD(0.0).minimize(loss)  # lr=0: params frozen
+        ema = fluid.optimizer.ExponentialMovingAverage(0.5)
+        ema.update()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    from paddle_tpu.framework.executor import global_scope
+    w0 = np.asarray(global_scope().find_var("w")).copy()
+    for _ in range(12):
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+    # params never moved; bias-corrected EMA must equal the param exactly
+    with ema.apply(exe):
+        w_ema = np.asarray(global_scope().find_var("w"))
+        np.testing.assert_allclose(w_ema, w0, rtol=1e-4)
+    w_back = np.asarray(global_scope().find_var("w"))
+    np.testing.assert_allclose(w_back, w0, rtol=1e-6)
+
+
+def test_ema_converges_toward_moving_param():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2])
+        pred = fluid.layers.fc(x, 1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(pred)
+        fluid.optimizer.SGD(0.01).minimize(loss)
+        ema = fluid.optimizer.ExponentialMovingAverage(0.9)
+        ema.update()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    from paddle_tpu.framework.executor import global_scope
+    for _ in range(5):
+        exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                fetch_list=[loss])
+    w_cur = np.asarray(global_scope().find_var("w")).copy()
+    with ema.apply(exe):
+        w_ema = np.asarray(global_scope().find_var("w"))
+        # EMA lags the descending param => strictly larger
+        assert (w_ema > w_cur).all()
