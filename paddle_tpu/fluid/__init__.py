@@ -26,6 +26,7 @@ from ..dataset import (DatasetFactory, InMemoryDataset,  # noqa: F401
 from .. import optimizer     # noqa: F401
 from .. import regularizer   # noqa: F401
 from .. import clip          # noqa: F401
+from .. import io            # noqa: F401
 from ..framework import core  # noqa: F401
 
 name_scope = unique_name.name_scope

@@ -96,6 +96,12 @@ class CompiledProgram:
         strategy = build_strategy or BuildStrategy()
         if nranks > 1 and loss_name is not None:
             self._insert_grad_allreduce(strategy, nranks)
+        if strategy.fuse_elewise_add_act_ops:
+            # ref: build_strategy.cc:51 runs fuse_elewise_add_act_pass in
+            # the training pass pipeline; grads of the fused op come from
+            # jax autodiff at lowering
+            from .passes import apply_pass
+            apply_pass(self._program, "fuse_elemwise_add_act")
         return self
 
     def with_mesh(self, mesh, loss_name: Optional[str] = None,

@@ -1,0 +1,278 @@
+"""Program-level optimization passes (ref: framework/ir/ — pass.h Pass
+registry, graph_pattern_detector.h, and the fusion passes
+fuse_elewise_add_act_pass.cc, fuse_bn_act_pass.cc,
+multihead_matmul_fuse_pass.cc, plus build_strategy.cc:51's pass pipeline).
+
+The reference rewrites an SSA ir::Graph; here passes rewrite the Program's
+op list directly — our IR is already a flat op sequence per block, and XLA
+does general fusion downstream, so the only passes worth keeping are
+(a) dead-code elimination for pruned inference programs, and (b) pattern
+fusions that either shrink the interpreter op count or route work onto
+Pallas kernels XLA cannot synthesize (flash attention).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .core import Program
+
+PASSES: Dict[str, Callable] = {}
+
+
+def register_pass(name: str):
+    def deco(fn):
+        PASSES[name] = fn
+        return fn
+    return deco
+
+
+def apply_pass(program: Program, name: str, **kwargs) -> Program:
+    """Apply one pass in place (ref: pass.h Pass::Apply)."""
+    PASSES[name](program, **kwargs)
+    program._bump_version()
+    return program
+
+
+class PassBuilder:
+    """Ordered pass pipeline (ref: framework/ir/pass_builder.h +
+    inference/analysis/ir_pass_manager.h)."""
+
+    #: default inference pipeline, mirroring the reference's
+    #: GpuPassStrategy order: fusions first, DCE last
+    INFERENCE_PASSES = ["fuse_elemwise_add_act", "fuse_bn_act",
+                       "multihead_matmul_fuse", "dead_code_elimination"]
+
+    def __init__(self, passes: Optional[Sequence[str]] = None):
+        self._passes: List[str] = list(
+            passes if passes is not None else self.INFERENCE_PASSES)
+
+    def all_passes(self) -> List[str]:
+        return list(self._passes)
+
+    def append_pass(self, name: str):
+        self._passes.append(name)
+        return self
+
+    def delete_pass(self, name: str):
+        self._passes = [p for p in self._passes if p != name]
+        return self
+
+    def apply(self, program: Program, **kwargs) -> Program:
+        for name in self._passes:
+            apply_pass(program, name, **kwargs)
+        return program
+
+
+# ---------------------------------------------------------------------------
+# helpers — the GraphPatternDetector analog for a flat op list
+# ---------------------------------------------------------------------------
+
+
+def _use_counts(block, keep_names=()):
+    """name → number of consuming ops; fetched/kept names get +1."""
+    uses: Dict[str, int] = {}
+    for op in block.ops:
+        for n in op.input_names():
+            uses[n] = uses.get(n, 0) + 1
+        for attr in op.attrs.values():
+            # sub-block closures (control flow) capture outer vars
+            if hasattr(attr, "ops"):
+                for sub in attr.ops:
+                    for n in sub.input_names():
+                        uses[n] = uses.get(n, 0) + 1
+    for n in keep_names:
+        uses[n] = uses.get(n, 0) + 1
+    return uses
+
+
+def _single_use_chain(block, i, uses, next_types):
+    """If op i's first output feeds exactly one consumer whose type is in
+    ``next_types``, return (consumer_index, consumer); else None."""
+    op = block.ops[i]
+    outs = op.output_names()
+    if not outs:
+        return None
+    out = outs[0]
+    if uses.get(out, 0) != 1:
+        return None
+    for j in range(i + 1, len(block.ops)):
+        nxt = block.ops[j]
+        if out in nxt.input_names():
+            return (j, nxt) if nxt.type in next_types else None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# passes
+# ---------------------------------------------------------------------------
+
+
+@register_pass("dead_code_elimination")
+def dead_code_elimination(program: Program, fetch_names=(), **_):
+    """Remove ops none of whose outputs are consumed, fetched, or
+    persistable (ref: the reference gets this via graph pruning +
+    eager_deletion; for us it shrinks cloned/pruned inference programs)."""
+    for block in program.blocks:
+        changed = True
+        while changed:
+            changed = False
+            persist = {name for name, v in block.vars.items()
+                       if getattr(v, "persistable", False)}
+            uses = _use_counts(block, keep_names=fetch_names)
+            kept = []
+            for op in block.ops:
+                outs = op.output_names()
+                live = (not outs  # side-effect-only ops stay
+                        or any(uses.get(n, 0) > 0 or n in persist
+                               for n in outs)
+                        or op.type in ("backward", "fetch", "feed",
+                                       "pipeline"))
+                if live:
+                    kept.append(op)
+                else:
+                    changed = True
+            block.ops[:] = kept
+
+
+_FUSABLE_ACTS = ("relu", "sigmoid", "tanh", "gelu")
+
+
+@register_pass("fuse_elemwise_add_act")
+def fuse_elemwise_add_act(program: Program, fetch_names=(), **_):
+    """elementwise_add → act  ⇒  fused_elemwise_activation
+    (ref: framework/ir/fuse_elewise_add_act_pass.cc)."""
+    for block in program.blocks:
+        uses = _use_counts(block, keep_names=fetch_names)
+        i, drop = 0, set()
+        for i, op in enumerate(block.ops):
+            if op.type != "elementwise_add" or i in drop:
+                continue
+            hit = _single_use_chain(block, i, uses, _FUSABLE_ACTS)
+            if hit is None:
+                continue
+            j, act = hit
+            op.type = "fused_elemwise_activation"
+            op.attrs["functor_list"] = ["elementwise_add", act.type]
+            op.outputs = {"Out": list(act.outputs.values())[0]}
+            drop.add(j)
+        block.ops[:] = [op for k, op in enumerate(block.ops)
+                        if k not in drop]
+
+
+@register_pass("fuse_bn_act")
+def fuse_bn_act(program: Program, fetch_names=(), **_):
+    """batch_norm → act  ⇒  fused_bn_activation
+    (ref: framework/ir/fuse_bn_act_pass.cc)."""
+    for block in program.blocks:
+        uses = _use_counts(block, keep_names=fetch_names)
+        drop = set()
+        for i, op in enumerate(block.ops):
+            if op.type != "batch_norm" or i in drop:
+                continue
+            out = op.outputs.get("Y", [None])[0]
+            if out is None or uses.get(out, 0) != 1:
+                continue
+            hit = None
+            for j in range(i + 1, len(block.ops)):
+                nxt = block.ops[j]
+                if out in nxt.input_names():
+                    hit = (j, nxt) if nxt.type in _FUSABLE_ACTS else None
+                    break
+            if hit is None:
+                continue
+            j, act = hit
+            op.type = "fused_bn_activation"
+            op.attrs["act_type"] = act.type
+            op.outputs = dict(op.outputs)
+            op.outputs["Y"] = list(act.outputs.values())[0]
+            drop.add(j)
+        block.ops[:] = [op for k, op in enumerate(block.ops)
+                        if k not in drop]
+
+
+@register_pass("multihead_matmul_fuse")
+def multihead_matmul_fuse(program: Program, fetch_names=(), **_):
+    """matmul(Q,K,transpose_Y) [→scale] [→add bias] → softmax [→dropout]
+    → matmul(·,V)  ⇒  one ``multihead_matmul`` op running the Pallas flash
+    attention kernel (ref: framework/ir/multihead_matmul_fuse_pass.cc; the
+    reference fuses into operators/fused/multihead_matmul_op.cu)."""
+    for block in program.blocks:
+        uses = _use_counts(block, keep_names=fetch_names)
+        drop = set()
+        for i, op in enumerate(block.ops):
+            if op.type != "matmul" or i in drop:
+                continue
+            if not op.attrs.get("transpose_Y", False) \
+                    or op.attrs.get("transpose_X", False):
+                continue
+            alpha = float(op.attrs.get("alpha", 1.0))
+            chain = [i]
+            bias_name = None
+            cur = i
+            # optional scale
+            hit = _single_use_chain(block, cur, uses, ("scale",))
+            if hit is not None:
+                j, sc = hit
+                if sc.attrs.get("bias", 0.0) == 0.0:
+                    alpha *= float(sc.attrs.get("scale", 1.0))
+                    chain.append(j)
+                    cur = j
+            # optional additive bias
+            hit = _single_use_chain(block, cur, uses, ("elementwise_add",))
+            if hit is not None:
+                j, add = hit
+                prev_out = block.ops[cur].output_names()[0]
+                xs, ys = add.inputs.get("X", []), add.inputs.get("Y", [])
+                other = ys[0] if xs and xs[0] == prev_out else xs[0]
+                bias_name = other
+                chain.append(j)
+                cur = j
+            hit = _single_use_chain(block, cur, uses, ("softmax",))
+            if hit is None:
+                continue
+            chain.append(hit[0])
+            cur = hit[0]
+            dropout_rate = 0.0
+            dropout_impl = "downgrade_in_infer"
+            is_test = op.attrs.get("is_test", False)
+            hit2 = _single_use_chain(block, cur, uses, ("dropout",))
+            if hit2 is not None:
+                dattrs = block.ops[hit2[0]].attrs
+                dropout_rate = float(dattrs.get("dropout_prob", 0.0))
+                dropout_impl = dattrs.get("dropout_implementation",
+                                          "downgrade_in_infer")
+                is_test = is_test or dattrs.get("is_test", False)
+                chain.append(hit2[0])
+                cur = hit2[0]
+            hit = _single_use_chain(block, cur, uses, ("matmul",))
+            if hit is None:
+                continue
+            j, mm2 = hit
+            if mm2.attrs.get("transpose_X", False) \
+                    or mm2.attrs.get("transpose_Y", False):
+                continue
+            # probs must be the X operand of the context matmul
+            probs_name = block.ops[cur].output_names()[0]
+            if mm2.inputs.get("X", [None])[0] != probs_name:
+                continue
+            chain.append(j)
+            q_name = op.inputs["X"][0]
+            k_name = op.inputs["Y"][0]
+            v_name = mm2.inputs["Y"][0]
+            qv = block._find_var_recursive(q_name)
+            if qv is not None and qv.shape is not None \
+                    and len(qv.shape) != 4:
+                continue  # only head-split [B,H,S,D] operands
+            inputs = {"Q": [q_name], "K": [k_name], "V": [v_name]}
+            if bias_name is not None:
+                inputs["BiasQK"] = [bias_name]
+            op.type = "multihead_matmul"
+            op.inputs = {k: list(v) for k, v in inputs.items()}
+            op.outputs = {"Out": list(mm2.outputs["Out"])}
+            op.attrs = {"alpha": alpha, "dropout_rate": dropout_rate,
+                        "dropout_implementation": dropout_impl,
+                        "is_test": is_test}
+            drop.update(chain[1:])
+        block.ops[:] = [op for k, op in enumerate(block.ops)
+                        if k not in drop]

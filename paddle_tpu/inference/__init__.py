@@ -1,0 +1,152 @@
+"""Inference engine (ref: paddle/fluid/inference/ — api/analysis_predictor.h
+AnalysisPredictor, api/paddle_analysis_config.h AnalysisConfig,
+analysis/ir_pass_manager.h).
+
+The reference loads a saved ProgramDesc, runs ~40 IR fusion passes, and
+interprets the optimized program with a NaiveExecutor (TensorRT/Lite taking
+subgraphs).  TPU-natively: load the saved Program, run the (much shorter)
+pass pipeline — XLA is the TensorRT analog and owns general fusion — and
+execute the whole block as one cached jitted XLA executable via the
+Executor.  Zero-copy semantics come free: feeds are device arrays, fetches
+stay on device until read."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.core import Program
+from ..framework.executor import Executor, Scope, scope_guard
+from ..framework.passes import PassBuilder
+from ..framework import core as _core
+
+
+class AnalysisConfig:
+    """ref: inference/api/paddle_analysis_config.h."""
+
+    def __init__(self, model_dir: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self.model_dir = model_dir
+        self.prog_file = None
+        self.params_file = params_file
+        self._ir_optim = True
+        self._use_tpu = True
+        self._pass_builder = PassBuilder()
+
+    # -- reference API surface -------------------------------------------
+    def set_model(self, model_dir, params_file=None):
+        self.model_dir = model_dir
+        self.params_file = params_file
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag
+
+    def ir_optim(self) -> bool:
+        return self._ir_optim
+
+    def enable_use_gpu(self, memory_pool_mb=100, device_id=0):
+        # accepted for script compat; TPU is the device
+        self._use_tpu = True
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def use_gpu(self) -> bool:
+        return self._use_tpu
+
+    def enable_memory_optim(self):
+        pass  # XLA buffer assignment owns memory
+
+    def pass_builder(self) -> PassBuilder:
+        return self._pass_builder
+
+    def delete_pass(self, name: str):
+        self._pass_builder.delete_pass(name)
+
+
+class _ZeroCopyTensor:
+    """Handle into the predictor scope (ref: api ZeroCopyTensor)."""
+
+    def __init__(self, scope: Scope, name: str):
+        self._scope = scope
+        self._name = name
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        import jax.numpy as jnp
+        self._scope.set_var(self._name, jnp.asarray(arr))
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._scope.find_var(self._name))
+
+    @property
+    def name(self):
+        return self._name
+
+    def shape(self):
+        v = self._scope.find_var(self._name)
+        return None if v is None else list(v.shape)
+
+
+class AnalysisPredictor:
+    """ref: inference/api/analysis_predictor.cc — load → analyze (passes)
+    → per-request ZeroCopyRun over a private scope."""
+
+    def __init__(self, config: AnalysisConfig):
+        from .. import io
+        from ..framework.core import TPUPlace, CPUPlace
+        self._config = config
+        self._scope = Scope()
+        place = TPUPlace(0) if config.use_gpu() else CPUPlace()
+        self._exe = Executor(place)
+        with scope_guard(self._scope):
+            program, feed_names, fetch_vars = io.load_inference_model(
+                config.model_dir, self._exe,
+                model_filename=config.prog_file,
+                params_filename=config.params_file)
+        self._fetch_names = [v.name for v in fetch_vars]
+        if config.ir_optim():
+            program = config.pass_builder().apply(
+                program, fetch_names=self._fetch_names)
+        self._program = program
+        self._feed_names = list(feed_names)
+        self._fetch_vars = [program.global_block().var(n)
+                            for n in self._fetch_names]
+
+    # -- zero-copy API ----------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def get_input_tensor(self, name: str) -> _ZeroCopyTensor:
+        return _ZeroCopyTensor(self._scope, name)
+
+    def get_output_tensor(self, name: str) -> _ZeroCopyTensor:
+        return _ZeroCopyTensor(self._scope, name)
+
+    def zero_copy_run(self):
+        feed = {n: self._scope.find_var(n) for n in self._feed_names}
+        outs = self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_vars,
+                             scope=self._scope)
+        for n, v in zip(self._fetch_names, outs):
+            self._scope.set_var(n, v)
+
+    # -- batch API (ref: PaddlePredictor::Run) ----------------------------
+    def run(self, inputs: Sequence[np.ndarray]) -> List[np.ndarray]:
+        feed = {n: a for n, a in zip(self._feed_names, inputs)}
+        outs = self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_vars,
+                             scope=self._scope)
+        return [np.asarray(o) for o in outs]
+
+    @property
+    def program(self) -> Program:
+        return self._program
+
+
+def create_paddle_predictor(config: AnalysisConfig) -> AnalysisPredictor:
+    """ref: inference/api/analysis_predictor.cc CreatePaddlePredictor."""
+    return AnalysisPredictor(config)

@@ -1,0 +1,103 @@
+"""Fused kernels targeted by the IR fusion passes (ref: operators/fused/ —
+fused_elemwise_activation_op.cc, fused_bn_activation_op.cu,
+multihead_matmul_op.cu).
+
+The reference hand-writes these CUDA kernels and pattern-matches them in via
+framework/ir fuse passes.  Here the ops are jax compositions XLA fuses into
+single kernels; the win from the pass is (a) fewer interpreter-level ops,
+(b) routing matched attention patterns onto the Pallas flash-attention
+kernel, which XLA's general fuser cannot produce."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, x
+
+_ACTS = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "gelu": jax.nn.gelu,
+    "identity": lambda a: a,
+    "": lambda a: a,
+}
+
+
+@register("fused_elemwise_activation")
+def _fused_elemwise_activation(ctx, ins, attrs):
+    """ref: operators/fused/fused_elemwise_activation_op.cc —
+    functor_list like ["elementwise_add", "relu"]."""
+    from .registry import get_op
+    functors = list(attrs.get("functor_list", ["elementwise_add", "relu"]))
+    binary, unary = functors[0], functors[1]
+    # delegate the binary to the stock elementwise op so axis-broadcast
+    # semantics (e.g. fc's bias add with axis=1) match exactly
+    out = get_op(binary)(ctx, ins, attrs)["Out"]
+    return {"Out": _ACTS[unary](out)}
+
+
+@register("fused_bn_activation")
+def _fused_bn_activation(ctx, ins, attrs):
+    """ref: operators/fused/fused_bn_activation_op.cu — batch_norm + act
+    in one kernel.  Delegates to the batch_norm op then applies act, which
+    XLA fuses into one kernel."""
+    from .registry import get_op
+    outs = get_op("batch_norm")(ctx, ins, attrs)
+    act = attrs.get("act_type", "relu")
+    outs["Y"] = _ACTS[act](outs["Y"])
+    return outs
+
+
+@register("multihead_matmul")
+def _multihead_matmul(ctx, ins, attrs):
+    """ref: operators/fused/multihead_matmul_op.cu — the QKV attention core
+    softmax(alpha * Q K^T + bias) V on head-split [B, H, S, D] operands,
+    produced by the multihead_matmul_fuse pass (ref:
+    framework/ir/multihead_matmul_fuse_pass.cc).  Routes to the Pallas
+    flash-attention kernel when there is no dropout."""
+    q, k, v = x(ins, "Q"), x(ins, "K"), x(ins, "V")
+    bias = x(ins, "BiasQK")
+    alpha = attrs.get("alpha", 1.0)
+    dropout_rate = attrs.get("dropout_rate", 0.0)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    # downgrade_in_infer dropout scales probs by (1-p) at inference; probs
+    # enter the context matmul linearly, so scaling the output is identical
+    post = (1.0 - dropout_rate) \
+        if (dropout_rate and is_test and impl == "downgrade_in_infer") \
+        else 1.0
+    if not dropout_rate or is_test:
+        try:
+            from .pallas.flash_attention import flash_attention_bshd
+            # the kernel scales scores by 1/sqrt(d) internally; fold the
+            # matched pattern's alpha in by pre-scaling q
+            d = q.shape[-1]
+            comp = alpha * (d ** 0.5)
+            qq = q if comp == 1.0 else q * jnp.asarray(comp, q.dtype)
+            out = flash_attention_bshd(qq, k, v, bias)
+            if post != 1.0:
+                out = out * jnp.asarray(post, out.dtype)
+            return {"Out": out}
+        except Exception:
+            pass  # CPU/interpret or unsupported shape: jnp fallback
+    if alpha != 1.0:
+        q = q * jnp.asarray(alpha, q.dtype)
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k,
+                        preferred_element_type=jnp.float32)
+    if bias is not None:
+        scores = scores + bias.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate and not is_test:
+        keep = jax.random.bernoulli(ctx.next_key(), 1.0 - dropout_rate,
+                                    probs.shape)
+        if impl == "upscale_in_train":
+            probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+        else:  # downgrade_in_infer: plain drop at train, (1-p)· at infer
+            probs = jnp.where(keep, probs, 0.0)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    if post != 1.0:
+        out = out * jnp.asarray(post, out.dtype)
+    return {"Out": out.astype(v.dtype)}

@@ -766,19 +766,33 @@ class GradientMergeOptimizer(Optimizer):
         return opt_ops, merged
 
 
+def _persistable_scalar(main, startup, prefix, value=0.0):
+    """Create a persistable (1,) float32 var in main+startup, startup-filled
+    with ``value``.  Shared by every step-counter/accumulator below."""
+    name = unique_name.generate(prefix)
+    v = main.create_var(name=name, shape=(1,), dtype="float32",
+                        persistable=True)
+    sv = startup.create_var(name=name, shape=(1,), dtype="float32",
+                            persistable=True)
+    startup.append_op(type="fill_constant", outputs={"Out": [sv]},
+                      attrs={"shape": [1], "dtype": "float32",
+                             "value": float(value)})
+    return v
+
+
+def _step_counter(main, startup, prefix):
+    """Persistable step counter incremented once per main-program run."""
+    step = _persistable_scalar(main, startup, f"{prefix}_step")
+    main.append_op(type="increment", inputs={"X": [step]},
+                   outputs={"Out": [step]}, attrs={"step": 1.0})
+    return step
+
+
 def _periodic_mask(main, startup, k, prefix="pm"):
     """Append a persistable step counter + ``mask = (step % k == 0)`` ops;
     returns (maskf, inv_maskf) float32 (1,) vars.  Shared scaffolding for
     the k-periodic wrapper optimizers (GradientMerge, Lookahead)."""
-    step_name = unique_name.generate(f"{prefix}_step")
-    step = main.create_var(name=step_name, shape=(1,), dtype="float32",
-                           persistable=True)
-    sstep = startup.create_var(name=step_name, shape=(1,), dtype="float32",
-                               persistable=True)
-    startup.append_op(type="fill_constant", outputs={"Out": [sstep]},
-                      attrs={"shape": [1], "dtype": "float32", "value": 0.0})
-    main.append_op(type="increment", inputs={"X": [step]},
-                   outputs={"Out": [step]}, attrs={"step": 1.0})
+    step = _step_counter(main, startup, prefix)
     modk = main.create_var(name=unique_name.generate(f"{prefix}_modk"),
                            shape=(1,), dtype="float32")
     main.append_op(type="elementwise_mod", inputs={
@@ -863,14 +877,7 @@ class DGCMomentumOptimizer(Optimizer):
         if self._step_var is None:
             main = default_main_program().global_block()
             startup = default_startup_program().global_block()
-            name = unique_name.generate("dgc_step")
-            self._step_var = main.create_var(
-                name=name, shape=(1,), dtype="float32", persistable=True)
-            sv = startup.create_var(name=name, shape=(1,), dtype="float32",
-                                    persistable=True)
-            startup.append_op(type="fill_constant", outputs={"Out": [sv]},
-                              attrs={"shape": [1], "dtype": "float32",
-                                     "value": 0.0})
+            self._step_var = _persistable_scalar(main, startup, "dgc_step")
 
     def _append_optimize_op(self, block, pg):
         p, g = pg
@@ -1053,17 +1060,11 @@ class ExponentialMovingAverage:
         startup = default_startup_program().global_block()
         self._params = [v for v in main.vars.values()
                         if isinstance(v, Parameter) and v.trainable]
-        step_name = unique_name.generate("ema_step")
-        self._step_var = main.create_var(name=step_name, shape=(1,),
-                                         dtype="float32", persistable=True)
-        sv = startup.create_var(name=step_name, shape=(1,), dtype="float32",
-                                persistable=True)
-        startup.append_op(type="fill_constant", outputs={"Out": [sv]},
-                          attrs={"shape": [1], "dtype": "float32",
-                                 "value": 0.0})
-        main.append_op(type="increment", inputs={"X": [self._step_var]},
-                       outputs={"Out": [self._step_var]},
-                       attrs={"step": 1.0})
+        self._step_var = _step_counter(main, startup, "ema")
+        # running ∏ decay_t for exact bias correction even when thres_steps
+        # ramps the decay (apply divides by 1 - ∏decay_t)
+        self._decay_prod = _persistable_scalar(main, startup,
+                                               "ema_decay_prod", 1.0)
         # decay_t: constant, or ramped by the thres_steps variable
         if self._thres_steps is not None:
             t = self._thres_steps
@@ -1092,6 +1093,10 @@ class ExponentialMovingAverage:
         else:
             decay_var = _const_var(main, startup, self._decay)
         self._decay_var_name = decay_var.name
+        main.append_op(type="elementwise_mul",
+                       inputs={"X": [self._decay_prod], "Y": [decay_var]},
+                       outputs={"Out": [self._decay_prod]},
+                       attrs={"axis": -1})
         for p in self._params:
             ema_name = unique_name.generate(f"{p.name}_ema")
             ema = main.create_var(name=ema_name, shape=p.shape,
@@ -1128,21 +1133,13 @@ class ExponentialMovingAverage:
         apply_prog, restore_prog = Program(), Program()
         with program_guard(apply_prog, Program()):
             blk = apply_prog.global_block()
-            step = blk.create_var(name=self._step_var.name, shape=(1,),
+            # exact bias correction: factor = 1 - ∏decay_t (tracked by the
+            # update ops; correct under thres_steps decay ramping too)
+            prod = blk.create_var(name=self._decay_prod.name, shape=(1,),
                                   dtype="float32", persistable=True)
-            # bias correction factor 1 - decay^step = 1 - exp(step*ln(decay))
-            logd = blk.create_var(name=unique_name.generate("ema_logd"),
-                                  shape=(1,), dtype="float32")
-            blk.append_op(type="scale", inputs={"X": [step]},
-                          outputs={"Out": [logd]},
-                          attrs={"scale": float(np.log(self._decay))})
-            powd = blk.create_var(name=unique_name.generate("ema_powd"),
-                                  shape=(1,), dtype="float32")
-            blk.append_op(type="exp", inputs={"X": [logd]},
-                          outputs={"Out": [powd]})
             factor = blk.create_var(name=unique_name.generate("ema_factor"),
                                     shape=(1,), dtype="float32")
-            blk.append_op(type="scale", inputs={"X": [powd]},
+            blk.append_op(type="scale", inputs={"X": [prod]},
                           outputs={"Out": [factor]},
                           attrs={"scale": -1.0, "bias": 1.0})
             for p in self._params:
@@ -1288,16 +1285,7 @@ class LocalSGDOptimizer:
     def _append_avg(self, params_grads):
         main = default_main_program().global_block()
         startup = default_startup_program().global_block()
-        step_name = unique_name.generate("localsgd_step")
-        step = main.create_var(name=step_name, shape=(1,), dtype="float32",
-                               persistable=True)
-        sstep = startup.create_var(name=step_name, shape=(1,),
-                                   dtype="float32", persistable=True)
-        startup.append_op(type="fill_constant", outputs={"Out": [sstep]},
-                          attrs={"shape": [1], "dtype": "float32",
-                                 "value": 0.0})
-        main.append_op(type="increment", inputs={"X": [step]},
-                       outputs={"Out": [step]}, attrs={"step": 1.0})
+        step = _step_counter(main, startup, "localsgd")
         params = [p for p, _ in params_grads]
         main.append_op(
             type="local_sgd_sync",

@@ -1,0 +1,210 @@
+"""IR pass framework tests (ref: framework/ir pass tests —
+test_fuse_elewise_add_act_pass.py, test_ir_fusion patterns, and
+inference/tests/api for the predictor)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.framework.passes import apply_pass, PassBuilder
+
+
+def _run(main, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_fuse_elemwise_add_act():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[8])
+        b = fluid.layers.data("b", shape=[8])
+        s = fluid.layers.elementwise_add(a, b)
+        out = fluid.layers.relu(s)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"a": np.random.randn(4, 8).astype(np.float32),
+            "b": np.random.randn(4, 8).astype(np.float32)}
+    ref, = exe.run(main, feed=feed, fetch_list=[out])
+    apply_pass(main, "fuse_elemwise_add_act")
+    types = [op.type for op in main.global_block().ops]
+    assert "fused_elemwise_activation" in types
+    assert "relu" not in types and "elementwise_add" not in types
+    got, = exe.run(main, feed=feed, fetch_list=[out])
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_fuse_bn_act():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3, 8, 8])
+        c = fluid.layers.conv2d(x, 4, 3, padding=1)
+        bn = fluid.layers.batch_norm(c, is_test=True)
+        out = fluid.layers.relu(bn)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.random.randn(2, 3, 8, 8).astype(np.float32)}
+    ref, = exe.run(main, feed=feed, fetch_list=[out])
+    apply_pass(main, "fuse_bn_act")
+    types = [op.type for op in main.global_block().ops]
+    assert "fused_bn_activation" in types and "relu" not in types
+    got, = exe.run(main, feed=feed, fetch_list=[out])
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_multihead_matmul_fuse():
+    B, H, S, D = 2, 4, 16, 8
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        q = fluid.layers.data("q", shape=[H, S, D])
+        k = fluid.layers.data("k", shape=[H, S, D])
+        v = fluid.layers.data("v", shape=[H, S, D])
+        bias = fluid.layers.data("bias", shape=[H, S, S])
+        scores = fluid.layers.matmul(q, k, transpose_y=True)
+        scores = fluid.layers.scale(scores, scale=1.0 / np.sqrt(D))
+        scores = fluid.layers.elementwise_add(scores, bias)
+        probs = fluid.layers.softmax(scores)
+        out = fluid.layers.matmul(probs, v)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"q": rng.randn(B, H, S, D).astype(np.float32),
+            "k": rng.randn(B, H, S, D).astype(np.float32),
+            "v": rng.randn(B, H, S, D).astype(np.float32),
+            "bias": np.zeros((B, H, S, S), np.float32)}
+    ref, = exe.run(main, feed=feed, fetch_list=[out])
+    apply_pass(main, "multihead_matmul_fuse")
+    types = [op.type for op in main.global_block().ops]
+    assert types.count("multihead_matmul") == 1
+    assert "softmax" not in types and "matmul" not in types
+    got, = exe.run(main, feed=feed, fetch_list=[out])
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_dead_code_elimination():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        used = fluid.layers.relu(x)
+        _unused = fluid.layers.tanh(x)     # noqa: F841 — should be pruned
+    n_before = len(main.global_block().ops)
+    apply_pass(main, "dead_code_elimination", fetch_names=[used.name])
+    types = [op.type for op in main.global_block().ops]
+    assert "tanh" not in types and "relu" in types
+    assert len(types) < n_before
+
+
+def test_inference_predictor_with_passes(tmp_path):
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6])
+        h = fluid.layers.fc(x, 8, act="relu")
+        y = fluid.layers.fc(h, 3, act="softmax")
+        fluid.optimizer.SGD(0.1).minimize(
+            fluid.layers.mean(y))  # train ops must be pruned away on save
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xb = np.random.RandomState(0).randn(5, 6).astype(np.float32)
+    ref, = exe.run(main.clone(for_test=True), feed={"x": xb},
+                   fetch_list=[y])
+    model_dir = str(tmp_path / "infer_model")
+    fluid.io.save_inference_model(model_dir, ["x"], [y], exe, main)
+
+    config = AnalysisConfig(model_dir)
+    config.disable_gpu()  # CPU in tests
+    pred = create_paddle_predictor(config)
+    assert pred.get_input_names() == ["x"]
+    # batch API
+    out, = pred.run([xb])
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    # zero-copy API
+    t = pred.get_input_tensor("x")
+    t.copy_from_cpu(xb)
+    pred.zero_copy_run()
+    out2 = pred.get_output_tensor(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out2, ref, rtol=1e-5)
+
+
+def test_pass_builder_customisation():
+    pb = PassBuilder()
+    pb.delete_pass("multihead_matmul_fuse")
+    assert "multihead_matmul_fuse" not in pb.all_passes()
+    pb.append_pass("multihead_matmul_fuse")
+    assert pb.all_passes()[-1] == "multihead_matmul_fuse"
+
+
+def test_fuse_respects_fetched_intermediates():
+    """A fetched intermediate must not be fused away (ref: ir passes run
+    under the fetch-var protection of build_strategy)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[8])
+        b = fluid.layers.data("b", shape=[8])
+        s = fluid.layers.elementwise_add(a, b)   # fetched below
+        out = fluid.layers.relu(s)
+    apply_pass(main, "fuse_elemwise_add_act", fetch_names=[s.name])
+    types = [op.type for op in main.global_block().ops]
+    assert "fused_elemwise_activation" not in types
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"a": np.ones((2, 8), np.float32), "b": np.ones((2, 8), np.float32)}
+    sv, ov = exe.run(main, feed=feed, fetch_list=[s, out])
+    np.testing.assert_allclose(sv, 2 * np.ones((2, 8)), rtol=1e-6)
+
+
+def test_multihead_fuse_dropout_downgrade_in_infer():
+    """downgrade_in_infer dropout scales probs by (1-p) at inference; the
+    fused op must reproduce that (ref: multihead_matmul fusion must be
+    output-equivalent to the unfused graph)."""
+    B, H, S, D = 2, 2, 8, 4
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        q = fluid.layers.data("q", shape=[H, S, D])
+        k = fluid.layers.data("k", shape=[H, S, D])
+        v = fluid.layers.data("v", shape=[H, S, D])
+        scores = fluid.layers.matmul(q, k, transpose_y=True)
+        probs = fluid.layers.softmax(scores)
+        probs = fluid.layers.dropout(probs, 0.25, is_test=True)
+        out = fluid.layers.matmul(probs, v)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {n: rng.randn(B, H, S, D).astype(np.float32)
+            for n in ("q", "k", "v")}
+    ref, = exe.run(main, feed=feed, fetch_list=[out])
+    apply_pass(main, "multihead_matmul_fuse")
+    assert [op.type for op in main.global_block().ops].count(
+        "multihead_matmul") == 1
+    got, = exe.run(main, feed=feed, fetch_list=[out])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_ema_with_thres_steps_bias_correction():
+    """Ramped decay: apply() must divide by 1-∏decay_t, not 1-decay^t."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        pred = fluid.layers.fc(x, 1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(pred)
+        fluid.optimizer.SGD(0.0).minimize(loss)   # frozen params
+        thres = fluid.layers.fill_constant([1], "float32", 5.0)
+        ema = fluid.optimizer.ExponentialMovingAverage(0.999,
+                                                       thres_steps=thres)
+        ema.update()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    from paddle_tpu.framework.executor import global_scope
+    w0 = np.asarray(global_scope().find_var("w")).copy()
+    for _ in range(8):
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+    # frozen params ⇒ bias-corrected EMA equals params exactly, even with
+    # the (1+t)/(10+t) decay ramp active
+    with ema.apply(exe):
+        np.testing.assert_allclose(
+            np.asarray(global_scope().find_var("w")), w0, rtol=1e-4)
