@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.registry import get_op, LoweringContext
+from .. import profiler as _profiler
 
 
 class _TapeNode:
@@ -141,7 +142,11 @@ class Tracer:
                 flat_out.extend(v if is_list else [v])
             return tuple(flat_out)
 
-        out_vars = self.trace_fn(fn, flat, op_type=op_type)
+        if _profiler._enabled:
+            with _profiler.RecordEvent(f"dygraph::{op_type}"):
+                out_vars = self.trace_fn(fn, flat, op_type=op_type)
+        else:
+            out_vars = self.trace_fn(fn, flat, op_type=op_type)
         result: Dict[str, object] = {}
         it = iter(out_vars)
         for s, n, is_list in out_spec:

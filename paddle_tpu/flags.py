@@ -1,0 +1,76 @@
+"""Process-level flags (ref: platform/flags.cc:33-485 gflags definitions +
+pybind/global_value_getter_setter.cc runtime get/set).
+
+The reference defines ~40 gflags read from ``FLAGS_*`` env vars at process
+start and settable at runtime via ``fluid.get_flags``/``set_flags``.  Same
+contract here; flags whose job XLA now owns (memory fractions, cudnn
+autotune) are accepted for script compatibility and documented as no-ops.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, List, Union
+
+_REGISTRY: Dict[str, Any] = {}
+_NOOP: set = set()
+
+
+def _register(name: str, default, noop: bool = False):
+    env = os.environ.get(f"FLAGS_{name}")
+    if env is not None:
+        if isinstance(default, bool):
+            default = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            default = int(env)
+        elif isinstance(default, float):
+            default = float(env)
+        else:
+            default = env
+    _REGISTRY[name] = default
+    if noop:
+        _NOOP.add(name)
+
+
+# live flags (consulted by the framework)
+_register("check_nan_inf", False)          # ref: platform/flags.cc:44
+_register("use_flash_attention", True)     # pallas kernel gate (TPU-new)
+_register("benchmark", False)              # ref: flags.cc benchmark
+_register("print_executor_cache_hits", False)
+# accepted no-ops: XLA owns these concerns (ref: flags.cc lines noted)
+_register("fraction_of_gpu_memory_to_use", 0.92, noop=True)   # :343
+_register("eager_delete_tensor_gb", 0.0, noop=True)           # :257
+_register("allocator_strategy", "auto_growth", noop=True)     # :316
+_register("cudnn_deterministic", False, noop=True)            # :133
+_register("cudnn_exhaustive_search", False, noop=True)
+_register("conv_workspace_size_limit", 512, noop=True)
+_register("memory_fraction_of_eager_deletion", 1.0, noop=True)
+_register("fuse_parameter_memory_size", -1, noop=True)
+_register("communicator_send_queue_size", 20, noop=True)      # :200
+_register("sync_nccl_allreduce", True, noop=True)
+
+
+def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:
+    """ref: fluid.get_flags (pybind/global_value_getter_setter.cc)."""
+    names: List[str] = [flags] if isinstance(flags, str) else list(flags)
+    out = {}
+    for n in names:
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise ValueError(f"flag {n!r} is not registered")
+        out[n] = _REGISTRY[key]
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    """ref: fluid.set_flags."""
+    for n, v in flags.items():
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise ValueError(f"flag {n!r} is not registered")
+        _REGISTRY[key] = v
+
+
+def flag(name: str):
+    """Internal fast accessor."""
+    return _REGISTRY[name]

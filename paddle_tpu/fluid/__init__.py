@@ -27,6 +27,9 @@ from .. import optimizer     # noqa: F401
 from .. import regularizer   # noqa: F401
 from .. import clip          # noqa: F401
 from .. import io            # noqa: F401
+from .. import profiler      # noqa: F401
+from .. import monitor       # noqa: F401
+from ..flags import get_flags, set_flags  # noqa: F401
 from ..framework import core  # noqa: F401
 
 name_scope = unique_name.name_scope

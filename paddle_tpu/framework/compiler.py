@@ -49,7 +49,9 @@ class BuildStrategy:
         self.gradient_scale_strategy = \
             BuildStrategy.GradientScaleStrategy.CoeffNumDevice
         self.fuse_all_reduce_ops = True      # XLA fuses collectives itself
-        self.fuse_elewise_add_act_ops = True  # XLA general fusion
+        # off by default like the reference (build_strategy.h); XLA fuses
+        # elementwise chains anyway — enabling only shrinks the op list
+        self.fuse_elewise_add_act_ops = False
         self.enable_inplace = True            # buffer donation
         self.memory_optimize = True
         self.num_trainers = 1
@@ -74,6 +76,7 @@ class CompiledProgram:
         self._seq_axis = None
         self._feed_specs = {}
         self._loss_name = None
+        self._pending_passes = []
 
     def with_data_parallel(self, loss_name: Optional[str] = None,
                            build_strategy: Optional[BuildStrategy] = None,
@@ -98,10 +101,10 @@ class CompiledProgram:
             self._insert_grad_allreduce(strategy, nranks)
         if strategy.fuse_elewise_add_act_ops:
             # ref: build_strategy.cc:51 runs fuse_elewise_add_act_pass in
-            # the training pass pipeline; grads of the fused op come from
-            # jax autodiff at lowering
-            from .passes import apply_pass
-            apply_pass(self._program, "fuse_elemwise_add_act")
+            # the training pipeline; deferred to the executor's first
+            # compile, where the fetch list is known (fetched intermediates
+            # must not be fused away)
+            self._pending_passes.append("fuse_elemwise_add_act")
         return self
 
     def with_mesh(self, mesh, loss_name: Optional[str] = None,

@@ -282,7 +282,9 @@ class Executor:
         batch_axis = None
         seq_axis = None
         feed_specs = {}
+        compiled_wrapper = None
         if isinstance(program, CompiledProgram):
+            compiled_wrapper = program
             mesh = program._mesh
             axis_names = program._axis_names
             batch_axis = program._batch_axis
@@ -291,11 +293,22 @@ class Executor:
             program = program._program
 
         fetch_names = _fetch_names(fetch_list)
+        if compiled_wrapper is not None and compiled_wrapper._pending_passes:
+            # strategy passes run once the fetch list is known, so fetched
+            # intermediates are protected from fusion
+            from .passes import apply_pass
+            for pname in compiled_wrapper._pending_passes:
+                apply_pass(program, pname, fetch_names=fetch_names)
+            compiled_wrapper._pending_passes = []
         feed = {k: np.asarray(v) if not hasattr(v, "dtype") else v
                 for k, v in feed.items()}
 
-        step = self._compile(program, feed, fetch_names, scope, mesh,
-                             axis_names, batch_axis, seq_axis, feed_specs)
+        from ..profiler import RecordEvent
+        from ..monitor import stat
+        with RecordEvent("executor::compile"):
+            step = self._compile(program, feed, fetch_names, scope, mesh,
+                                 axis_names, batch_axis, seq_axis,
+                                 feed_specs)
 
         state_in = {}
         for n in step.state_in_names:
@@ -310,13 +323,41 @@ class Executor:
             key = jax.random.PRNGKey(program.random_seed)
 
         feed_vals = {k: feed[k] for k in step.feed_names}
-        fetches, state_out, new_key = step.fn(feed_vals, state_in, key)
+        from ..flags import flag
+        with RecordEvent("executor::run"):
+            fetches, state_out, new_key = step.fn(feed_vals, state_in, key)
+            if flag("benchmark"):
+                # ref: FLAGS_benchmark forces a device sync per run so
+                # wall-clock timing is accurate
+                jax.block_until_ready(fetches)
+        stat("executor_run_count").add()
         scope.set_var(_RNG_VAR, new_key)
         for n, v in state_out.items():
             scope.set_var(n, v)
+
+        if flag("check_nan_inf"):
+            # ref: FLAGS_check_nan_inf scans every op output
+            # (framework/details/nan_inf_utils.h); here the whole block is
+            # one XLA program, so the scan covers its observable outputs —
+            # fetches and every persistable/state var — after each step
+            self._check_nan_inf(fetch_names, fetches, state_out)
+
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    @staticmethod
+    def _check_nan_inf(fetch_names, fetches, state_out):
+        bad = []
+        for n, v in list(zip(fetch_names, fetches)) + list(state_out.items()):
+            a = np.asarray(v)
+            if np.issubdtype(a.dtype, np.floating) and \
+                    not np.isfinite(a).all():
+                bad.append(n)
+        if bad:
+            raise RuntimeError(
+                f"Operator output contains NaN/Inf (FLAGS_check_nan_inf): "
+                f"{bad} (ref: nan_inf_utils_detail PrintNanInf)")
 
     # -- dataset training (ref: executor.py:1479 train_from_dataset →
     # TrainerDesc/DeviceWorker C++ threads; here the native datafeed
@@ -371,10 +412,16 @@ class Executor:
 
     def _compile(self, program, feed, fetch_names, scope, mesh, axis_names,
                  batch_axis, seq_axis=None, feed_specs=None):
+        from ..flags import flag
+        # flags consulted at trace time are part of the executable identity
         key = (id(program), program._version, self._feed_signature(feed),
-               tuple(fetch_names), id(mesh))
+               tuple(fetch_names), id(mesh), flag("use_flash_attention"))
         if key in self._cache:
+            if flag("print_executor_cache_hits"):
+                print(f"executor cache hit: program v{program._version}")
             return self._cache[key]
+        from ..monitor import stat
+        stat("executor_compile_count").add()
 
         block = program.global_block()
         ops = [op for op in block.ops if op.type not in ("feed", "fetch")]

@@ -86,14 +86,17 @@ def _use_counts(block, keep_names=()):
     return uses
 
 
-def _single_use_chain(block, i, uses, next_types):
-    """If op i's first output feeds exactly one consumer whose type is in
-    ``next_types``, return (consumer_index, consumer); else None."""
+def _single_use_chain(block, i, uses, next_types, out_name=None):
+    """If op i's output (first, or ``out_name``) feeds exactly one consumer
+    whose type is in ``next_types``, return (consumer_index, consumer)."""
     op = block.ops[i]
-    outs = op.output_names()
-    if not outs:
-        return None
-    out = outs[0]
+    if out_name is None:
+        outs = op.output_names()
+        if not outs:
+            return None
+        out = outs[0]
+    else:
+        out = out_name
     if uses.get(out, 0) != 1:
         return None
     for j in range(i + 1, len(block.ops)):
@@ -171,14 +174,10 @@ def fuse_bn_act(program: Program, fetch_names=(), **_):
             if op.type != "batch_norm" or i in drop:
                 continue
             out = op.outputs.get("Y", [None])[0]
-            if out is None or uses.get(out, 0) != 1:
+            if out is None:
                 continue
-            hit = None
-            for j in range(i + 1, len(block.ops)):
-                nxt = block.ops[j]
-                if out in nxt.input_names():
-                    hit = (j, nxt) if nxt.type in _FUSABLE_ACTS else None
-                    break
+            hit = _single_use_chain(block, i, uses, _FUSABLE_ACTS,
+                                    out_name=out)
             if hit is None:
                 continue
             j, act = hit

@@ -128,9 +128,11 @@ class AnalysisPredictor:
 
     def zero_copy_run(self):
         feed = {n: self._scope.find_var(n) for n in self._feed_names}
+        # return_numpy=False: outputs stay device arrays in the scope until
+        # copy_to_cpu reads them — the actual zero-copy contract
         outs = self._exe.run(self._program, feed=feed,
                              fetch_list=self._fetch_vars,
-                             scope=self._scope)
+                             scope=self._scope, return_numpy=False)
         for n, v in zip(self._fetch_names, outs):
             self._scope.set_var(n, v)
 

@@ -1,0 +1,54 @@
+"""Runtime metric counters (ref: platform/monitor.h:43 StatValue registry,
+STAT_ADD/STAT_RESET macros).
+
+Framework components bump named counters (executor runs, compiles, datafeed
+batches); users read them for observability, same contract as the
+reference's monitor."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class StatValue:
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def add(self, v: int = 1) -> int:
+        with self._lock:
+            self._value += v
+            return self._value
+
+    def set(self, v: int):
+        with self._lock:
+            self._value = v
+
+    def get(self) -> int:
+        return self._value
+
+    def reset(self):
+        self.set(0)
+
+
+_stats: Dict[str, StatValue] = {}
+_reg_lock = threading.Lock()
+
+
+def stat(name: str) -> StatValue:
+    """Get-or-create a counter (ref: StatRegistry::get)."""
+    with _reg_lock:
+        if name not in _stats:
+            _stats[name] = StatValue(name)
+        return _stats[name]
+
+
+def get_all_stats() -> Dict[str, int]:
+    return {k: v.get() for k, v in _stats.items()}
+
+
+def reset_all():
+    for v in _stats.values():
+        v.reset()

@@ -55,7 +55,8 @@ def _fused_attention(ctx, ins, attrs):
     n_head = attrs["n_head"]
     dropout_rate = attrs.get("dropout_rate", 0.0)
     is_test = attrs.get("is_test", False) or ctx.is_test
-    use_pallas = attrs.get("use_flash", True)
+    from ..flags import flag
+    use_pallas = attrs.get("use_flash", flag("use_flash_attention"))
     # sequence parallelism: attention rings over the sp axis (the q/k/v
     # entering here hold only this device's sequence shard)
     seq_axis = attrs.get("_seq_axis")

@@ -68,7 +68,8 @@ def _multihead_matmul(ctx, ins, attrs):
     post = (1.0 - dropout_rate) \
         if (dropout_rate and is_test and impl == "downgrade_in_infer") \
         else 1.0
-    if not dropout_rate or is_test:
+    from ..flags import flag
+    if (not dropout_rate or is_test) and flag("use_flash_attention"):
         try:
             from .pallas.flash_attention import flash_attention_bshd
             # the kernel scales scores by 1/sqrt(d) internally; fold the

@@ -1,0 +1,157 @@
+"""Profiler (ref: platform/profiler.h:201-211 RecordEvent/Enable/Disable,
+python/paddle/fluid/profiler.py context managers, tools/timeline.py chrome
+trace output).
+
+Host side: ``RecordEvent`` RAII markers collected into an in-process event
+buffer; ``stop_profiler`` prints the reference-style aggregated table
+(calls/total/min/max/avg per event name) and can dump a Chrome trace JSON
+readable at chrome://tracing — the reference needs tools/timeline.py to
+convert its proto, here the trace is written directly.
+
+Device side: the reference uses a CUPTI DeviceTracer; the TPU analog is
+jax.profiler (XPlane/TensorBoard).  ``start_profiler`` forwards to
+``jax.profiler.start_trace`` when a trace dir is given."""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import List, Optional
+
+_enabled = False
+_events: List[tuple] = []   # (name, start_ns, end_ns, tid)
+_lock = threading.Lock()
+_jax_trace_dir: Optional[str] = None
+
+
+def is_profiler_enabled() -> bool:
+    return _enabled
+
+
+class RecordEvent:
+    """RAII host event marker (ref: platform/profiler.h:201).  Cheap no-op
+    when the profiler is off."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._start = None
+
+    def __enter__(self):
+        if _enabled:
+            self._start = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        if self._start is not None:
+            end = time.perf_counter_ns()
+            with _lock:
+                _events.append((self.name, self._start, end,
+                                threading.get_ident()))
+        return False
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    with RecordEvent(name):
+        yield
+
+
+def reset_profiler():
+    """ref: fluid/profiler.py reset_profiler."""
+    with _lock:
+        _events.clear()
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default",
+                   trace_dir: Optional[str] = None):
+    """ref: fluid/profiler.py start_profiler.  ``state`` in
+    {CPU, GPU, All} — device states additionally start a jax.profiler trace
+    when ``trace_dir`` is given (TensorBoard XPlane, the CUPTI analog)."""
+    global _enabled, _jax_trace_dir
+    if state not in ("CPU", "GPU", "All"):
+        raise ValueError("state must be 'CPU', 'GPU' or 'All'")
+    _enabled = True
+    if trace_dir and state in ("GPU", "All"):
+        import jax
+        try:
+            jax.profiler.start_trace(trace_dir)
+            _jax_trace_dir = trace_dir
+        except Exception:
+            _jax_trace_dir = None   # tracing unsupported on this backend
+
+
+def stop_profiler(sorted_key: str = "total",
+                  profile_path: Optional[str] = None):
+    """ref: fluid/profiler.py stop_profiler — prints the aggregated event
+    table; writes a Chrome trace JSON to ``profile_path`` if given."""
+    global _enabled, _jax_trace_dir
+    _enabled = False
+    if _jax_trace_dir is not None:
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _jax_trace_dir = None
+    with _lock:
+        events = list(_events)
+    if profile_path:
+        save_chrome_trace(profile_path, events)
+    _print_summary(events, sorted_key)
+    return events
+
+
+def save_chrome_trace(path: str, events=None):
+    """Chrome trace (tools/timeline.py output format parity)."""
+    with _lock:
+        events = list(_events) if events is None else events
+    trace = {"traceEvents": [
+        {"name": name, "cat": "host", "ph": "X",
+         "ts": start / 1e3,                 # chrome wants microseconds
+         "dur": (end - start) / 1e3,
+         "pid": 0, "tid": tid}
+        for name, start, end, tid in events]}
+    with open(path, "w") as f:
+        json.dump(trace, f)
+
+
+def _print_summary(events, sorted_key):
+    agg = {}
+    for name, start, end, _ in events:
+        ms = (end - start) / 1e6
+        c = agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
+        c[0] += 1
+        c[1] += ms
+        c[2] = min(c[2], ms)
+        c[3] = max(c[3], ms)
+    keyfn = {"total": lambda kv: -kv[1][1], "calls": lambda kv: -kv[1][0],
+             "max": lambda kv: -kv[1][3], "min": lambda kv: kv[1][2],
+             "ave": lambda kv: -(kv[1][1] / kv[1][0])}.get(
+                 sorted_key, lambda kv: -kv[1][1])
+    rows = sorted(agg.items(), key=keyfn)
+    if not rows:
+        return
+    print(f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Min(ms)':>10}"
+          f"{'Max(ms)':>10}{'Ave(ms)':>10}")
+    for name, (calls, total, mn, mx) in rows:
+        print(f"{name:<40}{calls:>8}{total:>12.3f}{mn:>10.3f}"
+              f"{mx:>10.3f}{total / calls:>10.3f}")
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             profile_path: Optional[str] = None,
+             trace_dir: Optional[str] = None):
+    """ref: fluid/profiler.py profiler context manager."""
+    start_profiler(state, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+def get_events():
+    with _lock:
+        return list(_events)

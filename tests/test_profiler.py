@@ -1,0 +1,116 @@
+"""Profiler / flags / monitor / nan-inf subsystem tests (ref:
+test_profiler.py, test_get_set_flags.py, nan_inf_utils tests)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu import profiler, monitor
+from paddle_tpu.flags import get_flags, set_flags
+
+
+def _step_program():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        loss = fluid.layers.mean(fluid.layers.fc(x, 2))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_profiler_records_and_dumps_chrome_trace(tmp_path):
+    main, startup, loss = _step_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    profiler.reset_profiler()
+    trace_file = str(tmp_path / "profile.json")
+    with profiler.profiler("CPU", "total", profile_path=trace_file):
+        for _ in range(3):
+            exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[loss])
+        with profiler.RecordEvent("user_section"):
+            pass
+    events = profiler.get_events()
+    names = {e[0] for e in events}
+    assert "executor::run" in names and "user_section" in names
+    trace = json.load(open(trace_file))
+    assert any(ev["name"] == "executor::run"
+               for ev in trace["traceEvents"])
+    # off by default: RecordEvent outside profiling adds nothing
+    n = len(profiler.get_events())
+    with profiler.RecordEvent("ignored"):
+        pass
+    assert len(profiler.get_events()) == n
+
+
+def test_timeline_merge_tool(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.timeline import merge
+    t1 = {"traceEvents": [{"name": "a", "ph": "X", "ts": 0, "dur": 1,
+                           "pid": 0, "tid": 1}]}
+    t2 = {"traceEvents": [{"name": "b", "ph": "X", "ts": 0, "dur": 1,
+                           "pid": 0, "tid": 1}]}
+    p1, p2 = tmp_path / "t1.json", tmp_path / "t2.json"
+    p1.write_text(json.dumps(t1))
+    p2.write_text(json.dumps(t2))
+    out = tmp_path / "merged.json"
+    merge([f"trainer0:{p1}", f"trainer1:{p2}"], str(out))
+    merged = json.load(open(out))
+    pids = {ev.get("pid") for ev in merged["traceEvents"]}
+    assert pids == {0, 1}
+
+
+def test_flags_get_set_roundtrip():
+    f = get_flags("FLAGS_check_nan_inf")
+    assert f["FLAGS_check_nan_inf"] is False
+    set_flags({"FLAGS_check_nan_inf": True})
+    assert get_flags(["check_nan_inf"])["check_nan_inf"] is True
+    set_flags({"FLAGS_check_nan_inf": False})
+    with pytest.raises(ValueError):
+        set_flags({"FLAGS_no_such_flag": 1})
+    with pytest.raises(ValueError):
+        get_flags("FLAGS_no_such_flag")
+    # no-op compat flags are present
+    assert "fraction_of_gpu_memory_to_use" in str(
+        get_flags("FLAGS_fraction_of_gpu_memory_to_use"))
+
+
+def test_check_nan_inf_raises_with_var_name():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2])
+        y = fluid.layers.log(x)        # log(-1) = nan
+        out = fluid.layers.mean(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(RuntimeError, match="NaN/Inf"):
+            exe.run(main, feed={"x": -np.ones((2, 2), np.float32)},
+                    fetch_list=[out])
+        # healthy input passes
+        exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                fetch_list=[out])
+    finally:
+        set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_monitor_counters():
+    monitor.reset_all()
+    main, startup, loss = _step_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    before = monitor.stat("executor_run_count").get()
+    for _ in range(4):
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+    assert monitor.stat("executor_run_count").get() == before + 4
+    assert monitor.stat("executor_compile_count").get() >= 1
+    s = monitor.stat("custom")
+    s.add(5)
+    assert monitor.get_all_stats()["custom"] == 5

@@ -1,0 +1,48 @@
+"""Merge per-process profiler traces into one Chrome trace
+(ref: tools/timeline.py:32,115 — the reference converts profiler protos;
+here each process already writes Chrome JSON via
+``profiler.stop_profiler(profile_path=...)`` and this tool merges them,
+one chrome `pid` per training process).
+
+Usage:
+    python tools/timeline.py --profile_path trainer0.json,trainer1.json \
+        --timeline_path merged.json
+"""
+
+import argparse
+import json
+
+
+def merge(paths, out_path):
+    merged = {"traceEvents": []}
+    for pid, path in enumerate(paths):
+        name = path
+        if ":" in path:  # "name:file.json" form, like the reference
+            name, path = path.split(":", 1)
+        with open(path) as f:
+            trace = json.load(f)
+        merged["traceEvents"].append(
+            {"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": name}})
+        for ev in trace.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            merged["traceEvents"].append(ev)
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    return len(merged["traceEvents"])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile_path", type=str, required=True,
+                    help="comma-separated trace files, optionally "
+                         "'displayname:file.json'")
+    ap.add_argument("--timeline_path", type=str, required=True)
+    args = ap.parse_args()
+    n = merge(args.profile_path.split(","), args.timeline_path)
+    print(f"wrote {n} events to {args.timeline_path}")
+
+
+if __name__ == "__main__":
+    main()
