@@ -303,6 +303,9 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy]
     return CollectiveOptimizer(optimizer, strategy)
 
 
+from . import metrics as _fleet_metrics  # noqa: E402
+
+fleet.metrics = _fleet_metrics  # ref: paddle.fleet.metrics namespace
 fleet.distributed_optimizer = distributed_optimizer
 fleet.DistributedStrategy = DistributedStrategy
 

@@ -1,0 +1,69 @@
+"""Cross-trainer metric aggregation (ref: python/paddle/fleet/metrics/
+metric.py — sum/max/min/auc/mae/rmse/acc over Gloo allreduce among
+trainers).
+
+The reference allreduces host numpy values over Gloo.  TPU-natively the
+same role is played by the jax.distributed coordination service:
+``multihost_utils.process_allgather`` gathers per-host values over DCN.
+Single-process (including the virtual CPU mesh, where every "trainer" is a
+mesh shard inside one process and host values are already global) it is the
+identity — matching running the reference with one trainer."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _gather(value: np.ndarray) -> np.ndarray:
+    """[num_hosts, ...] stack of every host's value (identity stack of one
+    for single-process)."""
+    import jax
+    value = np.asarray(value)
+    if jax.process_count() == 1:
+        return value[None]
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(value))
+
+
+def sum(input):  # noqa: A001 — reference API name (fleet.metrics.sum)
+    return _gather(input).sum(axis=0).astype(np.float64) \
+        if np.asarray(input).ndim else float(_gather(input).sum())
+
+
+def max(input):  # noqa: A001
+    return float(np.max(_gather(input)))
+
+
+def min(input):  # noqa: A001
+    return float(np.min(_gather(input)))
+
+
+def acc(correct, total):
+    """Global accuracy from per-trainer correct/total counts
+    (ref: metric.py acc)."""
+    c = float(_gather(np.asarray(correct, np.float64)).sum())
+    t = float(_gather(np.asarray(total, np.float64)).sum())
+    return c / t if t else 0.0
+
+
+def mae(abserr, total_ins_num):
+    """Global mean absolute error (ref: metric.py mae)."""
+    e = float(_gather(np.asarray(abserr, np.float64)).sum())
+    n = float(_gather(np.asarray(total_ins_num, np.float64)).sum())
+    return e / n if n else 0.0
+
+
+def rmse(sqrerr, total_ins_num):
+    """Global RMSE (ref: metric.py rmse)."""
+    e = float(_gather(np.asarray(sqrerr, np.float64)).sum())
+    n = float(_gather(np.asarray(total_ins_num, np.float64)).sum())
+    return (e / n) ** 0.5 if n else 0.0
+
+
+def auc(stat_pos, stat_neg):
+    """Global AUC from per-trainer threshold buckets (ref: metric.py auc —
+    allreduce the bucket histograms, then one trapezoid integration)."""
+    from ..metrics import auc_from_buckets
+    pos = _gather(np.asarray(stat_pos, np.int64)).sum(axis=0)
+    neg = _gather(np.asarray(stat_neg, np.int64)).sum(axis=0)
+    return auc_from_buckets(pos, neg)

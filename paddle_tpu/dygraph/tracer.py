@@ -142,10 +142,7 @@ class Tracer:
                 flat_out.extend(v if is_list else [v])
             return tuple(flat_out)
 
-        if _profiler._enabled:
-            with _profiler.RecordEvent(f"dygraph::{op_type}"):
-                out_vars = self.trace_fn(fn, flat, op_type=op_type)
-        else:
+        with _profiler.RecordEvent(f"dygraph::{op_type}"):
             out_vars = self.trace_fn(fn, flat, op_type=op_type)
         result: Dict[str, object] = {}
         it = iter(out_vars)

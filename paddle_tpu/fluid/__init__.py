@@ -28,6 +28,7 @@ from .. import regularizer   # noqa: F401
 from .. import clip          # noqa: F401
 from .. import io            # noqa: F401
 from .. import profiler      # noqa: F401
+from .. import metrics       # noqa: F401
 from .. import monitor       # noqa: F401
 from ..flags import get_flags, set_flags  # noqa: F401
 from ..framework import core  # noqa: F401

@@ -294,12 +294,19 @@ class Executor:
 
         fetch_names = _fetch_names(fetch_list)
         if compiled_wrapper is not None and compiled_wrapper._pending_passes:
-            # strategy passes run once the fetch list is known, so fetched
-            # intermediates are protected from fusion
-            from .passes import apply_pass
-            for pname in compiled_wrapper._pending_passes:
-                apply_pass(program, pname, fetch_names=fetch_names)
-            compiled_wrapper._pending_passes = []
+            # strategy passes run against a clone per fetch list: fetched
+            # intermediates are protected, and a later run with different
+            # fetches sees the untouched original (no run-order dependence)
+            variants = compiled_wrapper.__dict__.setdefault(
+                "_pass_variants", {})
+            vkey = tuple(fetch_names)
+            if vkey not in variants:
+                from .passes import apply_pass
+                clone = program.clone()
+                for pname in compiled_wrapper._pending_passes:
+                    apply_pass(clone, pname, fetch_names=fetch_names)
+                variants[vkey] = clone
+            program = variants[vkey]
         feed = {k: np.asarray(v) if not hasattr(v, "dtype") else v
                 for k, v in feed.items()}
 

@@ -35,7 +35,11 @@ def _as_array(v):
 
 
 def _sig_of(arrays, extra=()):
-    return tuple((a.shape, str(a.dtype)) for a in arrays) + tuple(extra)
+    # flags consulted at trace time are part of the executable identity
+    # (same rule as the static executor's compile cache key)
+    from .flags import flag
+    return tuple((a.shape, str(a.dtype)) for a in arrays) + tuple(extra) \
+        + (flag("use_flash_attention"),)
 
 
 class _FreshTape:

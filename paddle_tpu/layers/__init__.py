@@ -16,5 +16,6 @@ from ..lr_scheduler import (noam_decay, exponential_decay,  # noqa: F401
                             linear_lr_warmup)
 
 # submodule aliases mirroring fluid.layers.* module layout
+from .sequence_lod import *      # noqa: F401,F403
 from . import math_ops as ops    # noqa: F401
 from . import tensor_ops as tensor  # noqa: F401

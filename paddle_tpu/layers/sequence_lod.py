@@ -1,0 +1,135 @@
+"""Sequence layers (ref: python/paddle/fluid/layers/sequence_lod.py —
+sequence_pool:360, sequence_softmax, sequence_pad:1093, sequence_unpad,
+sequence_concat, sequence_expand_as, sequence_reverse, sequence_mask,
+sequence_enumerate, sequence_first_step:487, sequence_last_step:527).
+
+API divergence from the reference, by design: LoD tensors carry their
+ragged offsets implicitly; on TPU the ragged structure travels as an
+explicit ``length`` Variable next to dense padded data (see
+ops/sequence_ops.py).  Every layer takes ``length=`` where the reference
+reads lod — scripts pad on the host (DataFeeder/datafeed emit
+(padded, length) pairs)."""
+
+from __future__ import annotations
+
+from ..framework.layer_helper import LayerHelper
+
+
+def _seq_inputs(input, length):
+    ins = {"X": [input]}
+    if length is not None:
+        ins["Length"] = [length]
+    return ins
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
+                  length=None):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (input.shape[0],) + tuple(input.shape[2:]))
+    helper.append_op(type="sequence_pool",
+                     inputs=_seq_inputs(input, length),
+                     outputs={"Out": [out]},
+                     attrs={"pooltype": pool_type.upper(),
+                            "pad_value": pad_value})
+    return out
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length=length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length=length)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, length=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op(type="sequence_softmax",
+                     inputs=_seq_inputs(input, length),
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_reverse(x, name=None, length=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="sequence_reverse",
+                     inputs=_seq_inputs(x, length),
+                     outputs={"Y": [out]})
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(
+        dtype, (x.shape[0], maxlen))
+    helper.append_op(type="sequence_mask", inputs={"X": [x]},
+                     outputs={"Y": [out]},
+                     attrs={"maxlen": maxlen, "out_dtype": dtype})
+    return out
+
+
+def sequence_pad(x, pad_value=0.0, maxlen=None, name=None, length=None):
+    """Returns (padded, length) like the reference (sequence_lod.py:1093).
+    Data is already dense here; the op re-masks pad positions."""
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    len_out = helper.create_variable_for_type_inference(
+        "int32", (x.shape[0],))
+    helper.append_op(type="sequence_pad",
+                     inputs=_seq_inputs(x, length),
+                     outputs={"Out": [out], "Length": [len_out]},
+                     attrs={"pad_value": float(pad_value)})
+    return out, len_out
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="sequence_unpad",
+                     inputs={"X": [x], "Length": [length]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_concat(input, lengths, name=None):
+    """``input``: list of padded [B, Ti, ...]; ``lengths``: matching length
+    Variables.  Output time dim = ΣTi."""
+    helper = LayerHelper("sequence_concat", name=name)
+    T = sum(v.shape[1] for v in input)
+    out = helper.create_variable_for_type_inference(
+        input[0].dtype, (input[0].shape[0], T) + tuple(input[0].shape[2:]))
+    len_out = helper.create_variable_for_type_inference(
+        "int32", (input[0].shape[0],))
+    helper.append_op(type="sequence_concat",
+                     inputs={"X": list(input), "Length": list(lengths)},
+                     outputs={"Out": [out], "Length": [len_out]})
+    return out, len_out
+
+
+def sequence_expand_as(x, y, name=None, length=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    T = y.shape[1]
+    feat = tuple(x.shape[2:]) if len(x.shape) > 2 else tuple(x.shape[1:])
+    out = helper.create_variable_for_type_inference(
+        x.dtype, (x.shape[0], T) + feat)
+    ins = {"X": [x], "Y": [y]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="sequence_expand_as", inputs=ins,
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None,
+                       length=None):
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, tuple(input.shape[:2]) + (win_size,))
+    helper.append_op(type="sequence_enumerate",
+                     inputs=_seq_inputs(input, length),
+                     outputs={"Out": [out]},
+                     attrs={"win_size": win_size, "pad_value": pad_value})
+    return out

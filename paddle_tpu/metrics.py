@@ -1,0 +1,223 @@
+"""Training metrics (ref: python/paddle/fluid/metrics.py — MetricBase:54,
+CompositeMetric:156, Precision:219, Recall:287, Accuracy:354,
+ChunkEvaluator:430, EditDistance:512, Auc:662, DetectionMAP:733).
+
+Same host-side accumulator design as the reference: ``update`` consumes
+numpy outputs fetched from the executor, ``eval`` returns the running
+value, ``reset`` clears state.  Device-side per-batch computation stays in
+the graph via ``layers.accuracy``/``layers.auc`` (layers/metric_op.py);
+these classes aggregate across batches."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = str(name) if name is not None else self.__class__.__name__
+
+    def get_config(self):
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")}
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    """ref: metrics.py:156 — several metrics sharing one update stream."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        if not isinstance(metric, MetricBase):
+            raise ValueError("metric must be a MetricBase instance")
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds=preds, labels=labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+
+class Precision(MetricBase):
+    """Binary precision = tp / (tp + fp) (ref: metrics.py:219)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        pos = preds == 1
+        self.tp += int(np.sum(pos & (labels == 1)))
+        self.fp += int(np.sum(pos & (labels != 1)))
+
+    def eval(self):
+        ap = self.tp + self.fp
+        return float(self.tp) / ap if ap != 0 else 0.0
+
+
+class Recall(MetricBase):
+    """Binary recall = tp / (tp + fn) (ref: metrics.py:287)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        rel = labels == 1
+        self.tp += int(np.sum(rel & (preds == 1)))
+        self.fn += int(np.sum(rel & (preds != 1)))
+
+    def eval(self):
+        recall = self.tp + self.fn
+        return float(self.tp) / recall if recall != 0 else 0.0
+
+
+class Accuracy(MetricBase):
+    """Weighted running accuracy (ref: metrics.py:354) — feed it the
+    per-batch value from ``layers.accuracy`` plus the batch size."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        if weight < 0:
+            raise ValueError("weight must be non-negative")
+        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("no batches accumulated — call update first")
+        return self.value / self.weight
+
+
+class ChunkEvaluator(MetricBase):
+    """Chunking F1 from (num_infer, num_label, num_correct) counts
+    (ref: metrics.py:430, fed by layers chunk_eval outputs)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(num_infer_chunks)
+        self.num_label_chunks += int(num_label_chunks)
+        self.num_correct_chunks += int(num_correct_chunks)
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    """Average edit distance + instance error rate (ref: metrics.py:512)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        distances = np.asarray(distances, np.float64).reshape(-1)
+        self.total_distance += float(distances.sum())
+        self.seq_num += int(seq_num)
+        self.instance_error += int(np.sum(distances != 0))
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("no batches accumulated — call update first")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class Auc(MetricBase):
+    """Threshold-bucketed ROC AUC, identical statistic to the reference
+    (ref: metrics.py:662 — _stat_pos/_stat_neg buckets + trapezoid)."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._curve = curve
+        self._num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        n = self._num_thresholds + 1
+        self._stat_pos = np.zeros(n, np.int64)
+        self._stat_neg = np.zeros(n, np.int64)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).reshape(-1)
+        pos_prob = preds[:, 1] if preds.ndim == 2 else preds.reshape(-1)
+        idx = np.minimum((pos_prob * self._num_thresholds).astype(np.int64),
+                         self._num_thresholds)
+        np.add.at(self._stat_pos, idx[labels == 1], 1)
+        np.add.at(self._stat_neg, idx[labels != 1], 1)
+
+    @staticmethod
+    def trapezoid_area(x1, x2, y1, y2):
+        return abs(x1 - x2) * (y1 + y2) / 2.0
+
+    def eval(self):
+        return auc_from_buckets(self._stat_pos, self._stat_neg)
+
+
+def auc_from_buckets(stat_pos, stat_neg) -> float:
+    """Trapezoid ROC integration over threshold buckets — shared by
+    ``Auc.eval`` and fleet's cross-trainer auc (distributed/metrics.py)."""
+    tot_pos = tot_neg = 0.0
+    area = 0.0
+    for i in range(len(stat_pos) - 1, -1, -1):
+        prev_pos, prev_neg = tot_pos, tot_neg
+        tot_pos += float(stat_pos[i])
+        tot_neg += float(stat_neg[i])
+        area += Auc.trapezoid_area(prev_neg, tot_neg, prev_pos, tot_pos)
+    return area / (tot_pos * tot_neg) if tot_pos * tot_neg else 0.0

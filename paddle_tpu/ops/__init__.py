@@ -9,5 +9,6 @@ from . import collective_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import fused_ops     # noqa: F401
 from . import controlflow_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401
 from . import tp_ops        # noqa: F401
 from . import pipeline_op   # noqa: F401

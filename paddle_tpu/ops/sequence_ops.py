@@ -1,0 +1,212 @@
+"""Sequence ops (ref: operators/sequence_ops/ — sequence_pool_op.h,
+sequence_softmax_op.h, sequence_reverse_op.h, sequence_pad_op.cc,
+sequence_unpad_op.cc, sequence_concat_op.h, sequence_enumerate_op.cc,
+sequence_expand_as_op.cc, sequence_mask_op.h).
+
+The reference operates on LoDTensors: ragged rows described by lod offset
+vectors, kernels looping per-sequence.  Ragged shapes defeat XLA tiling, so
+the TPU-native representation is **dense padded [B, T, ...] plus an explicit
+Length [B] vector** (the same (data, length) pair `sequence_pad` produces in
+the reference, made the universal convention).  Every op here is a masked
+dense computation — vectorised over the batch, MXU/VPU friendly, and
+shape-static so one compiled executable serves all batches.  Ops accept the
+length via the "Length" input slot; absent a Length the full time dimension
+is valid (plain dense behavior)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, x
+
+
+def _length_mask(a, length, time_axis=1):
+    """[B, T] bool validity mask broadcastable against ``a``."""
+    T = a.shape[time_axis]
+    if length is None:
+        return None
+    t = jnp.arange(T)
+    mask = t[None, :] < length.reshape(-1, 1)  # [B, T]
+    extra = a.ndim - 2
+    return mask.reshape(mask.shape + (1,) * extra)
+
+
+@register("sequence_mask")
+def _sequence_mask(ctx, ins, attrs):
+    """ref: sequence_mask_op.h — lengths → [B, maxlen] 0/1."""
+    lens = x(ins, "X").reshape(-1)
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        raise ValueError(
+            "sequence_mask needs a static maxlen attr on TPU (dynamic "
+            "max(length) would make the output shape data-dependent)")
+    out_dtype = attrs.get("out_dtype", "int64")
+    mask = jnp.arange(maxlen)[None, :] < lens[:, None]
+    return {"Y": mask.astype(jnp.int64 if out_dtype == "int64"
+                             else jnp.dtype(out_dtype))}
+
+
+@register("sequence_pool")
+def _sequence_pool(ctx, ins, attrs):
+    """ref: sequence_pool_op.h — SUM/AVERAGE/SQRT/MAX/LAST/FIRST over the
+    valid timesteps of each row."""
+    a = x(ins, "X")
+    length = x(ins, "Length")
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    pad_value = attrs.get("pad_value", 0.0)
+    B, T = a.shape[0], a.shape[1]
+    lens = (length.reshape(-1).astype(jnp.int32) if length is not None
+            else jnp.full((B,), T, jnp.int32))
+    mask = _length_mask(a, lens)
+    masked = jnp.where(mask, a, jnp.zeros_like(a))
+    denom = jnp.maximum(lens, 1).astype(a.dtype).reshape(
+        (-1,) + (1,) * (a.ndim - 2))
+    if ptype == "SUM":
+        out = masked.sum(axis=1)
+    elif ptype == "AVERAGE":
+        out = masked.sum(axis=1) / denom
+    elif ptype == "SQRT":
+        out = masked.sum(axis=1) / jnp.sqrt(denom)
+    elif ptype == "MAX":
+        neg = jnp.full_like(a, -jnp.inf)
+        out = jnp.where(mask, a, neg).max(axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(lens - 1, 0)
+        out = jnp.take_along_axis(
+            a, idx.reshape((-1, 1) + (1,) * (a.ndim - 2)), axis=1
+        ).squeeze(1)
+    elif ptype == "FIRST":
+        out = a[:, 0]
+    else:
+        raise NotImplementedError(f"sequence_pool type {ptype!r}")
+    # empty sequences yield pad_value (ref: sequence_pool pad_value attr)
+    empty = (lens == 0).reshape((-1,) + (1,) * (a.ndim - 2))
+    out = jnp.where(empty, jnp.asarray(pad_value, a.dtype), out)
+    return {"Out": out}
+
+
+@register("sequence_softmax")
+def _sequence_softmax(ctx, ins, attrs):
+    """ref: sequence_softmax_op.h — softmax within each row's valid
+    prefix; padding gets probability 0."""
+    a = x(ins, "X")
+    length = x(ins, "Length")
+    if length is None:
+        return {"Out": jax.nn.softmax(a, axis=1)}
+    mask = _length_mask(a, length.reshape(-1).astype(jnp.int32))
+    scores = jnp.where(mask, a, jnp.full_like(a, -jnp.inf))
+    out = jax.nn.softmax(scores, axis=1)
+    return {"Out": jnp.where(mask, out, jnp.zeros_like(out))}
+
+
+@register("sequence_reverse")
+def _sequence_reverse(ctx, ins, attrs):
+    """ref: sequence_reverse_op.h — reverse the valid prefix, keep pad."""
+    a = x(ins, "X")
+    length = x(ins, "Length")
+    T = a.shape[1]
+    lens = (length.reshape(-1).astype(jnp.int32) if length is not None
+            else jnp.full((a.shape[0],), T, jnp.int32))
+    t = jnp.arange(T)[None, :]
+    src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+    return {"Y": jnp.take_along_axis(
+        a, src.reshape(src.shape + (1,) * (a.ndim - 2)), axis=1)}
+
+
+@register("sequence_expand_as")
+def _sequence_expand_as(ctx, ins, attrs):
+    """ref: sequence_expand_as_op.cc — broadcast each row vector over the
+    valid timesteps of the reference sequence."""
+    a = x(ins, "X")          # [B, D] (or [B, 1, D])
+    length = x(ins, "Length")  # ref sequence lengths [B]
+    T = attrs.get("maxlen")
+    if T is None:
+        y = x(ins, "Y")
+        if y is None:
+            raise ValueError("sequence_expand_as needs Y or maxlen")
+        T = y.shape[1]
+    if a.ndim == 2:
+        a = a[:, None, :]
+    out = jnp.broadcast_to(a, (a.shape[0], T) + a.shape[2:])
+    if length is not None:
+        mask = _length_mask(out, length.reshape(-1).astype(jnp.int32))
+        out = jnp.where(mask, out, jnp.zeros_like(out))
+    return {"Out": out}
+
+
+@register("sequence_pad")
+def _sequence_pad(ctx, ins, attrs):
+    """ref: sequence_pad_op.cc — here data is already dense [B, T, ...];
+    the op re-masks padding to ``pad_value`` and emits Length (the ragged→
+    padded conversion itself happens host-side in the datafeed)."""
+    a = x(ins, "X")
+    length = x(ins, "Length")
+    pad_value = attrs.get("pad_value", 0.0)
+    lens = (length.reshape(-1).astype(jnp.int32) if length is not None
+            else jnp.full((a.shape[0],), a.shape[1], jnp.int32))
+    mask = _length_mask(a, lens)
+    out = jnp.where(mask, a, jnp.asarray(pad_value, a.dtype))
+    return {"Out": out, "Length": lens.astype(jnp.int32)}
+
+
+@register("sequence_unpad")
+def _sequence_unpad(ctx, ins, attrs):
+    """ref: sequence_unpad_op.cc — zero the padding (static shapes forbid
+    a ragged output; consumers use Length)."""
+    a = x(ins, "X")
+    length = x(ins, "Length")
+    lens = length.reshape(-1).astype(jnp.int32)
+    mask = _length_mask(a, lens)
+    return {"Out": jnp.where(mask, a, jnp.zeros_like(a))}
+
+
+@register("sequence_concat")
+def _sequence_concat(ctx, ins, attrs):
+    """ref: sequence_concat_op.h — concatenate along time per row:
+    row i = x[i, :lx[i]] ++ y[i, :ly[i]], padded to Tx+Ty."""
+    xs = ins.get("X", [])
+    lengths = ins.get("Length", [])
+    if len(xs) != len(lengths):
+        raise ValueError("sequence_concat needs one Length per input")
+    B = xs[0].shape[0]
+    T_out = sum(a.shape[1] for a in xs)
+    lens = [ln.reshape(-1).astype(jnp.int32) for ln in lengths]
+    total = sum(lens)
+    out = jnp.zeros((B, T_out) + xs[0].shape[2:], xs[0].dtype)
+    t_out = jnp.arange(T_out)[None, :]                       # [1, T_out]
+    offset = jnp.zeros((B,), jnp.int32)
+    for a, ln in zip(xs, lens):
+        T = a.shape[1]
+        # scatter a's valid prefix at per-row offset
+        src_t = t_out - offset[:, None]                      # [B, T_out]
+        valid = (src_t >= 0) & (src_t < ln[:, None])
+        src_idx = jnp.clip(src_t, 0, T - 1)
+        gathered = jnp.take_along_axis(
+            a, src_idx.reshape((B, T_out) + (1,) * (a.ndim - 2)), axis=1)
+        out = jnp.where(
+            valid.reshape((B, T_out) + (1,) * (a.ndim - 2)), gathered, out)
+        offset = offset + ln
+    return {"Out": out, "Length": total}
+
+
+@register("sequence_enumerate")
+def _sequence_enumerate(ctx, ins, attrs):
+    """ref: sequence_enumerate_op.cc — sliding windows of ids with
+    pad_value beyond each row's valid length."""
+    ids = x(ins, "X")        # [B, T] int
+    length = x(ins, "Length")
+    win = attrs["win_size"]
+    pad_value = attrs.get("pad_value", 0)
+    B, T = ids.shape[0], ids.shape[1]
+    lens = (length.reshape(-1).astype(jnp.int32) if length is not None
+            else jnp.full((B,), T, jnp.int32))
+    t = jnp.arange(T)[None, :, None]                 # [1, T, 1]
+    w = jnp.arange(win)[None, None, :]               # [1, 1, win]
+    src = t + w                                      # [1, T, win]
+    valid = src < lens[:, None, None]
+    src_idx = jnp.clip(src, 0, T - 1)
+    gathered = jnp.take_along_axis(
+        ids[:, :, None], jnp.broadcast_to(src_idx, (B, T, win)), axis=1)
+    return {"Out": jnp.where(valid, gathered,
+                             jnp.asarray(pad_value, ids.dtype))}

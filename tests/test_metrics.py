@@ -1,0 +1,114 @@
+"""Metrics tests (ref: test_metrics.py, fleet metrics tests) — each class
+checked against a straightforward numpy reference."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import metrics
+from paddle_tpu.distributed import metrics as fleet_metrics
+
+
+def _auc_reference(scores, labels):
+    """Exact ROC AUC by pairwise comparison (slow but unambiguous)."""
+    pos = scores[labels == 1]
+    neg = scores[labels == 0]
+    wins = (pos[:, None] > neg[None, :]).sum() \
+        + 0.5 * (pos[:, None] == neg[None, :]).sum()
+    return wins / (len(pos) * len(neg))
+
+
+def test_accuracy_weighted():
+    m = metrics.Accuracy()
+    m.update(value=0.5, weight=10)
+    m.update(value=1.0, weight=30)
+    assert np.isclose(m.eval(), (0.5 * 10 + 1.0 * 30) / 40)
+    m.reset()
+    m.update(value=0.25, weight=4)
+    assert np.isclose(m.eval(), 0.25)
+
+
+def test_precision_recall():
+    preds = np.array([1, 1, 0, 1, 0, 0, 1])
+    labels = np.array([1, 0, 0, 1, 1, 0, 0])
+    p = metrics.Precision()
+    r = metrics.Recall()
+    p.update(preds, labels)
+    r.update(preds, labels)
+    # tp=2 (idx 0,3), fp=2 (idx 1,6), fn=1 (idx 4)
+    assert np.isclose(p.eval(), 2 / 4)
+    assert np.isclose(r.eval(), 2 / 3)
+
+
+def test_auc_matches_pairwise_reference():
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 2, 1000)
+    scores = np.clip(labels * 0.3 + rng.rand(1000) * 0.7, 0, 1)
+    m = metrics.Auc(num_thresholds=4095)
+    # streaming updates in two chunks
+    m.update(scores[:500], labels[:500])
+    m.update(scores[500:], labels[500:])
+    ref = _auc_reference(scores, labels)
+    assert abs(m.eval() - ref) < 5e-3
+
+
+def test_auc_two_column_softmax_input():
+    labels = np.array([0, 1, 1, 0])
+    probs = np.array([[0.9, 0.1], [0.2, 0.8], [0.3, 0.7], [0.6, 0.4]])
+    m = metrics.Auc()
+    m.update(probs, labels)
+    assert m.eval() == 1.0  # perfectly separable
+
+
+def test_edit_distance():
+    m = metrics.EditDistance()
+    m.update(np.array([0.0, 2.0, 1.0]), 3)
+    m.update(np.array([0.0]), 1)
+    avg, err = m.eval()
+    assert np.isclose(avg, 3.0 / 4)
+    assert np.isclose(err, 2 / 4)
+
+
+def test_chunk_evaluator():
+    m = metrics.ChunkEvaluator()
+    m.update(10, 8, 6)
+    precision, recall, f1 = m.eval()
+    assert np.isclose(precision, 6 / 10)
+    assert np.isclose(recall, 6 / 8)
+    assert np.isclose(f1, 2 * precision * recall / (precision + recall))
+
+
+def test_composite_metric():
+    c = metrics.CompositeMetric()
+    c.add_metric(metrics.Precision())
+    c.add_metric(metrics.Recall())
+    preds = np.array([1, 0, 1])
+    labels = np.array([1, 1, 0])
+    c.update(preds, labels)
+    p, r = c.eval()
+    assert np.isclose(p, 0.5) and np.isclose(r, 0.5)
+
+
+def test_fleet_metrics_single_process():
+    assert fleet_metrics.sum(np.array(3.0)) == 3.0
+    assert fleet_metrics.max(np.array([1.0, 5.0])) == 5.0
+    assert fleet_metrics.min(np.array([1.0, 5.0])) == 1.0
+    assert np.isclose(fleet_metrics.acc(np.array(80.0), np.array(100.0)),
+                      0.8)
+    assert np.isclose(fleet_metrics.mae(np.array(5.0), np.array(10.0)), 0.5)
+    assert np.isclose(fleet_metrics.rmse(np.array(4.0), np.array(16.0)),
+                      0.5)
+
+
+def test_fleet_metrics_auc_from_buckets():
+    """fleet.metrics.auc aggregates the same buckets fluid.metrics.Auc
+    keeps, so the two must agree."""
+    rng = np.random.RandomState(1)
+    labels = rng.randint(0, 2, 500)
+    scores = np.clip(labels * 0.4 + rng.rand(500) * 0.6, 0, 1)
+    m = metrics.Auc(num_thresholds=4095)
+    m.update(scores, labels)
+    via_fleet = fleet_metrics.auc(m._stat_pos, m._stat_neg)
+    assert np.isclose(via_fleet, m.eval())
+    # fleet namespace is attached to the singleton
+    from paddle_tpu.distributed.fleet import fleet
+    assert fleet.metrics is fleet_metrics

@@ -237,3 +237,32 @@ def test_strategy_fusion_protects_fetches_via_compiled_program():
     assert sv.shape == (4, 8)
     types = [op.type for op in main.global_block().ops]
     assert "elementwise_add" in types    # protected
+
+
+def test_strategy_fusion_no_run_order_dependence():
+    """Fetching a fused intermediate must work in ANY run order — each
+    fetch list gets its own pass-applied clone."""
+    import jax
+    from jax.sharding import Mesh
+    from paddle_tpu.framework.compiler import BuildStrategy
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[8])
+        w = fluid.layers.fc(a, 8, bias_attr=False)
+        s = fluid.layers.elementwise_add(a, w)
+        out = fluid.layers.relu(s)
+        loss = fluid.layers.mean(out)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    bs = BuildStrategy()
+    bs.fuse_elewise_add_act_ops = True
+    mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+    cp = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, build_strategy=bs, mesh=mesh)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"a": np.ones((4, 8), np.float32)}
+    # loss-only run FIRST (fuses s away in its own clone) ...
+    exe.run(cp, feed=feed, fetch_list=[loss])
+    # ... then fetching s must still work
+    sv, _ = exe.run(cp, feed=feed, fetch_list=[s, loss])
+    assert sv.shape == (4, 8)

@@ -1,0 +1,184 @@
+"""Sequence op tests against numpy ragged references (ref:
+test_sequence_pool.py, test_sequence_softmax_op.py, test_sequence_reverse.py,
+test_sequence_pad_op.py, test_sequence_concat.py, test_sequence_enumerate_op.py,
+test_sequence_mask.py — the reference checks LoD kernels; here the padded
+dense + length convention is checked against per-row ragged numpy)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import Program, program_guard
+
+B, T, D = 4, 6, 3
+LENS = np.array([6, 3, 1, 4], np.int64)
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, T, D).astype(np.float32)
+    for i, l in enumerate(LENS):      # garbage in the pad region
+        x[i, l:] = 99.0
+    return x
+
+
+def _run_layer(build, feeds):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        outs = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    return exe.run(main, feed=feeds, fetch_list=list(outs))
+
+
+@pytest.mark.parametrize("ptype,ref", [
+    ("sum", lambda row: row.sum(0)),
+    ("average", lambda row: row.mean(0)),
+    ("sqrt", lambda row: row.sum(0) / np.sqrt(len(row))),
+    ("max", lambda row: row.max(0)),
+    ("first", lambda row: row[0]),
+    ("last", lambda row: row[-1]),
+])
+def test_sequence_pool(ptype, ref):
+    xv = _data()
+
+    def build():
+        x = fluid.layers.data("x", shape=[T, D])
+        ln = fluid.layers.data("len", shape=[1], dtype="int64",
+                               append_batch_size=False)
+        return fluid.layers.sequence_pool(x, ptype, length=ln)
+
+    out, = _run_layer(build, {"x": xv, "len": LENS})
+    want = np.stack([ref(xv[i, :LENS[i]]) for i in range(B)])
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_softmax():
+    xv = _data()[:, :, 0]   # [B, T]
+
+    def build():
+        x = fluid.layers.data("x", shape=[T])
+        ln = fluid.layers.data("len", shape=[1], dtype="int64",
+                               append_batch_size=False)
+        return fluid.layers.sequence_softmax(x, length=ln)
+
+    out, = _run_layer(build, {"x": xv, "len": LENS})
+    for i in range(B):
+        l = LENS[i]
+        e = np.exp(xv[i, :l] - xv[i, :l].max())
+        np.testing.assert_allclose(out[i, :l], e / e.sum(), rtol=1e-5)
+        assert (out[i, l:] == 0).all()
+
+
+def test_sequence_reverse():
+    xv = _data()
+
+    def build():
+        x = fluid.layers.data("x", shape=[T, D])
+        ln = fluid.layers.data("len", shape=[1], dtype="int64",
+                               append_batch_size=False)
+        return fluid.layers.sequence_reverse(x, length=ln)
+
+    out, = _run_layer(build, {"x": xv, "len": LENS})
+    for i in range(B):
+        l = LENS[i]
+        np.testing.assert_allclose(out[i, :l], xv[i, :l][::-1])
+        np.testing.assert_allclose(out[i, l:], xv[i, l:])  # pad untouched
+
+
+def test_sequence_mask():
+    def build():
+        ln = fluid.layers.data("len", shape=[1], dtype="int64",
+                               append_batch_size=False)
+        return fluid.layers.sequence_mask(ln, maxlen=T, dtype="float32")
+
+    out, = _run_layer(build, {"len": LENS})
+    want = (np.arange(T)[None, :] < LENS[:, None]).astype(np.float32)
+    np.testing.assert_allclose(out, want)
+
+
+def test_sequence_pad_and_unpad():
+    xv = _data()
+
+    def build():
+        x = fluid.layers.data("x", shape=[T, D])
+        ln = fluid.layers.data("len", shape=[1], dtype="int64",
+                               append_batch_size=False)
+        padded, plen = fluid.layers.sequence_pad(x, pad_value=-1.0,
+                                                 length=ln)
+        unpadded = fluid.layers.sequence_unpad(x, ln)
+        return padded, plen, unpadded
+
+    padded, plen, unpadded = _run_layer(build, {"x": xv, "len": LENS})
+    np.testing.assert_array_equal(plen, LENS.astype(np.int32))
+    for i in range(B):
+        l = LENS[i]
+        np.testing.assert_allclose(padded[i, :l], xv[i, :l])
+        assert (padded[i, l:] == -1.0).all()
+        assert (unpadded[i, l:] == 0.0).all()
+
+
+def test_sequence_concat():
+    rng = np.random.RandomState(1)
+    x1 = rng.randn(B, 4, D).astype(np.float32)
+    x2 = rng.randn(B, 3, D).astype(np.float32)
+    l1 = np.array([4, 2, 1, 3], np.int64)
+    l2 = np.array([1, 3, 2, 0], np.int64)
+
+    def build():
+        a = fluid.layers.data("a", shape=[4, D])
+        b = fluid.layers.data("b", shape=[3, D])
+        la = fluid.layers.data("la", shape=[1], dtype="int64",
+                               append_batch_size=False)
+        lb = fluid.layers.data("lb", shape=[1], dtype="int64",
+                               append_batch_size=False)
+        return fluid.layers.sequence_concat([a, b], [la, lb])
+
+    out, lens = _run_layer(build, {"a": x1, "b": x2, "la": l1, "lb": l2})
+    assert out.shape == (B, 7, D)
+    np.testing.assert_array_equal(lens, (l1 + l2).astype(np.int32))
+    for i in range(B):
+        want = np.concatenate([x1[i, :l1[i]], x2[i, :l2[i]]], axis=0)
+        np.testing.assert_allclose(out[i, :l1[i] + l2[i]], want, rtol=1e-6)
+        assert (out[i, l1[i] + l2[i]:] == 0).all()
+
+
+def test_sequence_expand_as():
+    rng = np.random.RandomState(2)
+    xv = rng.randn(B, D).astype(np.float32)
+    yv = rng.randn(B, T, D).astype(np.float32)
+
+    def build():
+        x = fluid.layers.data("x", shape=[D])
+        y = fluid.layers.data("y", shape=[T, D])
+        ln = fluid.layers.data("len", shape=[1], dtype="int64",
+                               append_batch_size=False)
+        return fluid.layers.sequence_expand_as(x, y, length=ln)
+
+    out, = _run_layer(build, {"x": xv, "y": yv, "len": LENS})
+    for i in range(B):
+        l = LENS[i]
+        np.testing.assert_allclose(out[i, :l],
+                                   np.tile(xv[i][None], (l, 1)))
+        assert (out[i, l:] == 0).all()
+
+
+def test_sequence_enumerate():
+    ids = np.array([[1, 2, 3, 4, 0, 0],
+                    [7, 8, 0, 0, 0, 0]], np.int64)
+    lens = np.array([4, 2], np.int64)
+
+    def build():
+        x = fluid.layers.data("x", shape=[T], dtype="int64")
+        ln = fluid.layers.data("len", shape=[1], dtype="int64",
+                               append_batch_size=False)
+        return fluid.layers.sequence_enumerate(x, win_size=2, pad_value=0,
+                                               length=ln)
+
+    out, = _run_layer(build, {"x": ids, "len": lens})
+    # row 0: windows [1,2],[2,3],[3,4],[4,0],[0,0],[0,0]
+    np.testing.assert_array_equal(out[0, 0], [1, 2])
+    np.testing.assert_array_equal(out[0, 2], [3, 4])
+    np.testing.assert_array_equal(out[0, 3], [4, 0])   # beyond len → pad
+    np.testing.assert_array_equal(out[1, 1], [8, 0])
