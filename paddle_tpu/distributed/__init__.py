@@ -2,3 +2,4 @@ from . import fleet          # noqa: F401
 from .fleet import init_parallel_env, get_world_size, get_rank  # noqa: F401
 from .launch import launch    # noqa: F401
 from . import metrics         # noqa: F401
+from . import ps              # noqa: F401

@@ -1,0 +1,10 @@
+"""Parameter-server capability tier (ref: SURVEY §2.3 "Parameter server" +
+§5 distributed backends — the DCN/host-RAM side of the framework; TPU
+device collectives never touch this path)."""
+
+from .rpc import RPCClient, RPCServer                     # noqa: F401
+from .server import ParameterServer, HeartBeatMonitor     # noqa: F401
+from .transpiler import (DistributeTranspiler,            # noqa: F401
+                         DistributeTranspilerConfig, GeoSgdTranspiler)
+from .communicator import Communicator                    # noqa: F401
+from ...ops.ps_ops import FleetWrapper, reset_clients     # noqa: F401

@@ -1,0 +1,82 @@
+"""Async gradient communicator (ref: operators/distributed/communicator.h —
+AsyncCommunicator:253 with send queues + merge threads, HalfAsync:326).
+
+In async PS mode the trainer must not block on the push RPC.  ps_send
+enqueues grads here; a background thread merges queued grads per variable
+(merge-add then average, like the reference's MergeVars) and pushes batches
+to each pserver.  ``stop()`` flushes."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class Communicator:
+    _global: Optional["Communicator"] = None
+
+    def __init__(self, send_interval_s: float = 0.005,
+                 trainer_id: int = 0):
+        self._interval = send_interval_s
+        self.trainer_id = trainer_id
+        self._pending: Dict[str, Dict[str, list]] = {}   # ep → name → [g]
+        self._lock = threading.Lock()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        #: set when the background sender dies; the next ps_send raises it
+        #: instead of silently enqueueing forever
+        self.error: Optional[BaseException] = None
+
+    # -- reference API surface (fluid/communicator.py) -------------------
+    def start(self):
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        Communicator._global = self
+
+    def stop(self):
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._flush()
+        if Communicator._global is self:
+            Communicator._global = None
+
+    def is_running(self):
+        return self._running
+
+    # -- producer side ----------------------------------------------------
+    def put(self, endpoint: str, grads: Dict[str, np.ndarray]):
+        with self._lock:
+            per_ep = self._pending.setdefault(endpoint, {})
+            for n, g in grads.items():
+                per_ep.setdefault(n, []).append(np.asarray(g))
+
+    # -- background sender -------------------------------------------------
+    def _loop(self):
+        try:
+            while self._running:
+                self._flush()
+                time.sleep(self._interval)
+        except BaseException as e:  # noqa: BLE001 — surfaced via .error
+            self.error = e
+            self._running = False
+
+    def _flush(self):
+        from ...ops.ps_ops import _client
+        with self._lock:
+            pending, self._pending = self._pending, {}
+        for ep, by_name in pending.items():
+            if not by_name:
+                continue
+            merged = {n: np.mean(gs, axis=0) if len(gs) > 1 else gs[0]
+                      for n, gs in by_name.items()}
+            try:
+                _client(ep).call("push_dense", trainer_id=self.trainer_id,
+                                 grads=merged)
+            except Exception:
+                if self._running:
+                    raise

@@ -1,0 +1,142 @@
+"""Host-side RPC for the parameter-server tier (ref:
+operators/distributed/grpc/grpc_client.h:176 RPCClient,
+grpc_server.h:46 RPCServer, serde grpc_serde.cc).
+
+The reference ships gRPC and BRPC backends for PS traffic over DCN.  Here
+the transport is the stdlib ``multiprocessing.connection`` (length-prefixed
+pickle over TCP) — dependency-free, preserving the same request surface
+(pull/push dense & sparse, barriers, heartbeat).  TPU device collectives
+never touch this path; it exists purely for the host-RAM parameter/
+embedding service the PS capability tier requires (SURVEY §5 comm
+backends: "DCN … host-side PS traffic")."""
+
+from __future__ import annotations
+
+import threading
+from multiprocessing.connection import Client, Listener
+from typing import Any, Callable, Dict, Tuple
+
+def _authkey() -> bytes:
+    """Per-job secret for the connection HMAC handshake.  The payload is
+    pickle, so authentication is the security boundary: a non-loopback
+    server REQUIRES an explicit secret via PADDLE_TPU_PS_AUTHKEY (a fixed
+    public key would hand remote code execution to anyone who can reach
+    the port); the well-known default is accepted for localhost only."""
+    import os
+    key = os.environ.get("PADDLE_TPU_PS_AUTHKEY")
+    return key.encode() if key else b"paddle_tpu_ps_localhost"
+
+
+class RPCServer:
+    """Threaded request server: one thread per connected worker
+    (ref: grpc_server.h RequestHandler registry)."""
+
+    def __init__(self, endpoint: str):
+        import os
+        host, port = endpoint.rsplit(":", 1)
+        if host not in ("127.0.0.1", "localhost", "::1") and \
+                not os.environ.get("PADDLE_TPU_PS_AUTHKEY"):
+            raise RuntimeError(
+                "binding a pserver on a non-loopback address requires a "
+                "per-job secret in PADDLE_TPU_PS_AUTHKEY (the transport "
+                "unpickles authenticated payloads)")
+        self._listener = Listener((host, int(port)), authkey=_authkey())
+        self.endpoint = f"{host}:{self._listener.address[1]}"
+        self._handlers: Dict[str, Callable] = {}
+        self._threads = []
+        self._running = False
+
+    def register(self, method: str, fn: Callable):
+        self._handlers[method] = fn
+
+    def serve_forever(self):
+        """Accept loop — blocks (the listen_and_serv event loop,
+        ref: listen_and_serv_op.cc:352).  Closes the listening socket on
+        exit so stop/restart cycles don't leak bound ports."""
+        self._running = True
+        try:
+            while self._running:
+                try:
+                    conn = self._listener.accept()
+                except (OSError, EOFError):
+                    break
+                t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+        finally:
+            self.close()
+
+    def start_background(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                method, payload = conn.recv()
+                if method == "__stop__":
+                    conn.send(("ok", None))
+                    self._running = False
+                    # unblock the accept loop
+                    try:
+                        Client(self._listener.address,
+                               authkey=_authkey()).close()
+                    except OSError:
+                        pass
+                    break
+                fn = self._handlers.get(method)
+                if fn is None:
+                    conn.send(("error", f"no handler for {method!r}"))
+                    continue
+                try:
+                    conn.send(("ok", fn(**payload)))
+                except Exception as e:  # noqa: BLE001 — surface to client
+                    conn.send(("error", f"{type(e).__name__}: {e}"))
+        except (EOFError, OSError):
+            pass  # worker disconnected
+
+    def close(self):
+        self._running = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class RPCClient:
+    """Per-endpoint connection with retry (ref: grpc_client.h retries and
+    deadlines via FLAGS_communicator_send_wait_times)."""
+
+    def __init__(self, endpoint: str, retries: int = 50,
+                 retry_wait: float = 0.1):
+        import time
+        host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
+        last = None
+        for _ in range(retries):
+            try:
+                self._conn = Client((host, int(port)), authkey=_authkey())
+                break
+            except (ConnectionRefusedError, OSError) as e:
+                last = e
+                time.sleep(retry_wait)
+        else:
+            raise ConnectionError(
+                f"cannot reach pserver {endpoint}: {last}")
+        self._lock = threading.Lock()
+
+    def call(self, method: str, **payload) -> Any:
+        with self._lock:
+            self._conn.send((method, payload))
+            status, result = self._conn.recv()
+        if status != "ok":
+            raise RuntimeError(f"pserver {self.endpoint} {method}: {result}")
+        return result
+
+    def close(self):
+        try:
+            self._conn.close()
+        except OSError:
+            pass

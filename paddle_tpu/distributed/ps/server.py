@@ -1,0 +1,291 @@
+"""Parameter server (ref: operators/distributed_ops/listen_and_serv_op.cc —
+RunSyncLoop:127, RunAsyncLoop:244 — plus operators/distributed/
+heart_beat_monitor.h:54 and large_scale_kv.h LargeScaleKV).
+
+Holds dense parameter shards and sparse id→row tables in host RAM and
+applies optimizer updates server-side, exactly the reference's split:
+TPU workers compute grads, CPU hosts own the (potentially 100B-feature)
+parameter state.  Three update disciplines, as in the reference:
+
+- sync:       grads from all n trainers are summed per step, one optimizer
+              step applied, then waiting pulls release (barrier-per-step).
+- async:      each push applies immediately (hogwild, RunAsyncLoop).
+- geo:        workers train locally and push parameter *deltas* that are
+              added to the global copy (GeoCommunicator semantics).
+
+Dense optimizer updates reuse the registered JAX optimizer op impls on CPU
+arrays — the same kernel the trainer would have run, so PS-mode and local
+training converge identically."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .rpc import RPCServer
+
+
+class HeartBeatMonitor:
+    """ref: heart_beat_monitor.h:54 — tracks per-worker last ping and
+    reports workers silent longer than ``timeout_s``."""
+
+    def __init__(self, timeout_s: float = 30.0):
+        self._last: Dict[int, float] = {}
+        self._timeout = timeout_s
+        self._lock = threading.Lock()
+
+    def ping(self, worker_id: int):
+        with self._lock:
+            self._last[int(worker_id)] = time.time()
+
+    def lost_workers(self) -> List[int]:
+        now = time.time()
+        with self._lock:
+            return [w for w, t in self._last.items()
+                    if now - t > self._timeout]
+
+    def worker_status(self) -> Dict[int, float]:
+        now = time.time()
+        with self._lock:
+            return {w: now - t for w, t in self._last.items()}
+
+
+class _DenseTable:
+    """One dense parameter + its optimizer state + update rule."""
+
+    def __init__(self, name: str, value: np.ndarray, opt_desc: dict):
+        self.name = name
+        self.value = np.asarray(value, np.float32)
+        self.opt_type = opt_desc.get("type", "sgd")
+        self.attrs = dict(opt_desc.get("attrs", {}))
+        self.lr = float(opt_desc.get("lr", 0.01))
+        self._accs: Dict[str, np.ndarray] = {}
+        self._acc_spec = self._spec()
+
+    _SPECS = {
+        "sgd": [],
+        "momentum": [("velocity", "Velocity", "VelocityOut", 0.0, False)],
+        "adam": [("moment1", "Moment1", "Moment1Out", 0.0, False),
+                 ("moment2", "Moment2", "Moment2Out", 0.0, False),
+                 ("beta1_pow", "Beta1Pow", "Beta1PowOut", "beta1", True),
+                 ("beta2_pow", "Beta2Pow", "Beta2PowOut", "beta2", True)],
+        "adagrad": [("moment", "Moment", "MomentOut", 0.0, False)],
+    }
+
+    def _spec(self):
+        if self.opt_type not in self._SPECS:
+            raise NotImplementedError(
+                f"pserver optimizer {self.opt_type!r} (supported: "
+                f"{sorted(self._SPECS)})")
+        return self._SPECS[self.opt_type]
+
+    def apply(self, grad: np.ndarray):
+        from ...ops.registry import get_op, LoweringContext
+        import jax
+        ins = {"Param": [self.value], "Grad": [np.asarray(grad, np.float32)],
+               "LearningRate": [np.asarray([self.lr], np.float32)]}
+        for key, in_slot, _, fill, scalar in self._acc_spec:
+            if key not in self._accs:
+                fill_v = self.attrs.get(fill, 0.9) if isinstance(fill, str) \
+                    else fill
+                shape = (1,) if scalar else self.value.shape
+                self._accs[key] = np.full(shape, fill_v, np.float32)
+            ins[in_slot] = [self._accs[key]]
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            res = get_op(self.opt_type)(
+                LoweringContext(jax.random.PRNGKey(0)), ins, self.attrs)
+        self.value = np.asarray(res["ParamOut"])
+        for key, _, out_slot, _, _ in self._acc_spec:
+            if out_slot in res:
+                self._accs[key] = np.asarray(res[out_slot])
+
+
+class _SparseTable:
+    """id → embedding rows (native LargeScaleKV when built, python dict
+    fallback) with SGD push (ref: large_scale_kv.h SparseVariable)."""
+
+    def __init__(self, name: str, dim: int, lr: float = 0.01,
+                 init_mode: int = 1, seed: int = 0):
+        self.name = name
+        self.dim = dim
+        self.lr = lr
+        self._native = None
+        try:
+            from ...native import KVTable  # built lazily
+            self._native = KVTable(dim, 16, seed)
+        except Exception:
+            self._rows: Dict[int, np.ndarray] = {}
+            self._seed = seed
+        self._init_mode = init_mode
+        self._lock = threading.Lock()
+
+    def _init_row(self, id_) -> np.ndarray:
+        if self._init_mode == 0:
+            return np.zeros(self.dim, np.float32)
+        rng = np.random.RandomState((int(id_) ^ self._seed) % (2 ** 31))
+        scale = 1.0 / np.sqrt(self.dim)
+        return rng.uniform(-scale, scale, self.dim).astype(np.float32)
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        if self._native is not None:
+            return self._native.pull(ids, init_mode=self._init_mode)
+        with self._lock:
+            out = np.empty((len(ids), self.dim), np.float32)
+            for i, id_ in enumerate(ids):
+                row = self._rows.get(int(id_))
+                if row is None:
+                    row = self._init_row(id_)
+                    self._rows[int(id_)] = row
+                out[i] = row
+            return out
+
+    def push_grad(self, ids: np.ndarray, grads: np.ndarray):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        if self._native is not None:
+            self._native.push_grad(ids, grads, self.lr)
+            return
+        with self._lock:
+            for id_, g in zip(ids, grads):
+                row = self._rows.get(int(id_))
+                if row is None:
+                    row = self._init_row(id_)
+                self._rows[int(id_)] = row - self.lr * g
+
+    def size(self) -> int:
+        if self._native is not None:
+            return self._native.size()
+        with self._lock:
+            return len(self._rows)
+
+
+class ParameterServer:
+    """One PS process/thread serving a shard of the model
+    (ref: listen_and_serv_op.cc; the optimize blocks it executes per grad
+    are the _DenseTable.apply calls here)."""
+
+    def __init__(self, endpoint: str, n_trainers: int = 1,
+                 mode: str = "sync"):
+        assert mode in ("sync", "async", "half_async", "geo")
+        self.mode = mode
+        self.n_trainers = n_trainers
+        self._dense: Dict[str, _DenseTable] = {}
+        self._sparse: Dict[str, _SparseTable] = {}
+        self.monitor = HeartBeatMonitor()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending: Dict[str, np.ndarray] = {}
+        self._push_count = 0
+        self._version = 0
+        self._rpc = RPCServer(endpoint)
+        self.endpoint = self._rpc.endpoint
+        for m, fn in [("init_dense", self.init_dense),
+                      ("init_sparse", self.init_sparse),
+                      ("pull_dense", self.pull_dense),
+                      ("push_dense", self.push_dense),
+                      ("pull_sparse", self.pull_sparse),
+                      ("push_sparse", self.push_sparse),
+                      ("heartbeat", self.heartbeat),
+                      ("barrier_info", self.barrier_info),
+                      ("worker_status", self.worker_status)]:
+            self._rpc.register(m, fn)
+
+    # -- lifecycle --------------------------------------------------------
+    def run(self):
+        """Blocking serve loop (exe.run(pserver_program) lands here)."""
+        self._rpc.serve_forever()
+
+    def start_background(self):
+        return self._rpc.start_background()
+
+    def stop(self):
+        self._rpc.close()
+
+    # -- handlers ---------------------------------------------------------
+    def init_dense(self, params: Dict[str, np.ndarray],
+                   opt_descs: Dict[str, dict]):
+        with self._lock:
+            for name, value in params.items():
+                if name not in self._dense:   # first trainer wins
+                    self._dense[name] = _DenseTable(
+                        name, value, opt_descs.get(name, {}))
+        return sorted(self._dense)
+
+    def init_sparse(self, name: str, dim: int, lr: float = 0.01,
+                    init_mode: int = 1):
+        with self._lock:
+            if name not in self._sparse:
+                self._sparse[name] = _SparseTable(name, dim, lr, init_mode)
+        return name
+
+    def pull_dense(self, names: List[str], wait_version: int = -1):
+        with self._cv:
+            if self.mode == "sync" and wait_version >= 0:
+                # barrier: wait until the round containing the caller's
+                # push has been applied (push_dense returned that round's
+                # target version)
+                ok = self._cv.wait_for(
+                    lambda: self._version >= wait_version, timeout=60.0)
+                if not ok:
+                    raise TimeoutError(
+                        f"sync barrier timed out waiting for version "
+                        f"{wait_version} (stuck trainers? "
+                        f"{self.monitor.lost_workers()})")
+            return {n: self._dense[n].value for n in names}, self._version
+
+    def push_dense(self, trainer_id: int, grads: Dict[str, np.ndarray]):
+        self.monitor.ping(trainer_id)
+        with self._cv:
+            if self.mode in ("async", "half_async"):
+                for n, g in grads.items():
+                    self._dense[n].apply(np.asarray(g))
+                self._version += 1
+                return self._version
+            if self.mode == "geo":
+                # deltas add straight into the global weights
+                for n, d in grads.items():
+                    self._dense[n].value = self._dense[n].value \
+                        + np.asarray(d, np.float32)
+                self._version += 1
+                return self._version
+            # sync: accumulate; last pusher triggers the optimizer step.
+            # Returns the TARGET version (the round that will contain this
+            # push) so the matching pull can barrier on it.
+            target = self._version + 1
+            for n, g in grads.items():
+                g = np.asarray(g, np.float32)
+                self._pending[n] = self._pending.get(n, 0.0) + g
+            self._push_count += 1
+            if self._push_count >= self.n_trainers:
+                for n, g in self._pending.items():
+                    self._dense[n].apply(g / self.n_trainers)
+                self._pending.clear()
+                self._push_count = 0
+                self._version += 1
+                self._cv.notify_all()
+            return target
+
+    def pull_sparse(self, name: str, ids):
+        return self._sparse[name].pull(np.asarray(ids))
+
+    def push_sparse(self, trainer_id: int, name: str, ids, grads):
+        self.monitor.ping(trainer_id)
+        self._sparse[name].push_grad(np.asarray(ids), np.asarray(grads))
+        return True
+
+    def heartbeat(self, trainer_id: int):
+        self.monitor.ping(trainer_id)
+        return time.time()
+
+    def barrier_info(self):
+        with self._lock:
+            return {"version": self._version,
+                    "pending_pushes": self._push_count}
+
+    def worker_status(self):
+        return {"alive": self.monitor.worker_status(),
+                "lost": self.monitor.lost_workers()}

@@ -1,0 +1,228 @@
+"""DistributeTranspiler — parameter-server program rewriting (ref:
+python/paddle/fluid/transpiler/distribute_transpiler.py:256
+DistributeTranspiler, :545 transpile, :1018 get_trainer_program, :1153
+get_pserver_program; geo_sgd_transpiler.py GeoSgdTranspiler;
+ps_dispatcher.py RoundRobin).
+
+Same contract as the reference: after ``optimizer.minimize`` the trainer
+program contains backward + optimizer ops; ``transpile`` assigns each
+parameter to a pserver endpoint (round-robin over name-sorted params),
+strips the optimizer ops from the trainer program, and appends host
+``ps_recv``/``ps_send`` ops so each step pulls fresh params and pushes
+grads.  The pserver program is a single blocking ``listen_and_serv`` op
+that applies the shipped optimizer descs server-side.
+
+Divergence, by design: the reference slices big params into blocks across
+servers (VarBlock, distribute_transpiler.py:80); here placement is whole-
+param round-robin — XLA owns intra-device layout and the sharded-embedding
+scale case goes through the sparse KV tier instead."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ...framework.core import (Program, default_main_program,
+                               default_startup_program, grad_var_name)
+
+OPT_OP_TYPES = ("sgd", "momentum", "adam", "adamw", "lamb", "adagrad",
+                "rmsprop", "adadelta", "adamax", "ftrl", "decayed_adagrad",
+                "lars_momentum", "dpsgd", "dgc_momentum")
+
+
+class DistributeTranspilerConfig:
+    """ref: distribute_transpiler.py DistributeTranspilerConfig."""
+
+    def __init__(self):
+        self.slice_var_up = False      # whole-param placement (see module doc)
+        self.split_method = "RoundRobin"
+        self.min_block_size = 8192
+        self.sync_mode = True
+        self.geo_sgd_mode = False
+        self.geo_sgd_need_push_nums = 100
+        self.half_async = False
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._trainer_program: Optional[Program] = None
+        self._pserver_programs: Dict[str, Program] = {}
+        self._placement: Dict[str, str] = {}
+
+    # -- main entry (ref: transpile :545) --------------------------------
+    def transpile(self, trainer_id: int, program: Optional[Program] = None,
+                  pservers: str = "127.0.0.1:6174", trainers: int = 1,
+                  sync_mode: bool = True, startup_program=None,
+                  current_endpoint: str = ""):
+        program = program or default_main_program()
+        startup_program = startup_program or default_startup_program()
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        endpoints = [e.strip() for e in pservers.split(",") if e.strip()]
+        if self.config.geo_sgd_mode:
+            self.mode = "geo"
+        elif sync_mode and not self.config.half_async:
+            self.mode = "sync"
+        elif self.config.half_async:
+            self.mode = "half_async"
+        else:
+            self.mode = "async"
+
+        block = program.global_block()
+        # 1) harvest optimizer op descs per param, then strip them
+        opt_descs: Dict[str, dict] = {}
+        lr_values = self._lr_values(startup_program)
+        for op in block.ops:
+            if op.type in OPT_OP_TYPES:
+                pname = op.inputs["Param"][0]
+                lr_name = op.inputs.get("LearningRate", [None])[0]
+                opt_descs[pname] = {
+                    "type": op.type,
+                    "attrs": {k: v for k, v in op.attrs.items()
+                              if isinstance(v, (int, float, bool, str,
+                                                list, tuple))},
+                    # static best-effort; init_worker re-resolves the live
+                    # value from the scope (robust to program_guard scoping
+                    # and to LR schedulers' current value)
+                    "lr": lr_values.get(lr_name, 0.01),
+                    "lr_name": lr_name,
+                }
+        if not opt_descs:
+            raise ValueError(
+                "transpile must run after optimizer.minimize (no optimizer "
+                "ops found, ref: distribute_transpiler.py:560)")
+
+        # 2) round-robin placement (ref: ps_dispatcher.py RoundRobin)
+        self._opt_descs = opt_descs
+        params = sorted(opt_descs)
+        self._placement = {p: endpoints[i % len(endpoints)]
+                           for i, p in enumerate(params)}
+
+        # 3) trainer program: strip optimizer, append send + recv host ops
+        trainer = program.clone()
+        tblock = trainer.global_block()
+        grad_names = [grad_var_name(p) for p in params]
+        grad_to_param = dict(zip(grad_names, params))
+        if self.mode != "geo":
+            # strip optimizer ops — updates happen server-side
+            tblock.ops[:] = [op for op in tblock.ops
+                             if op.type not in OPT_OP_TYPES]
+            # params ride along as Param inputs so the first send can
+            # lazily init the server when init_worker wasn't called
+            tblock.append_op(
+                type="ps_send",
+                inputs={"X": grad_names, "Param": list(params)},
+                outputs={},
+                attrs={"grad_names": grad_names,
+                       "grad_to_param": grad_to_param,
+                       "param_names": list(params),
+                       "opt_descs": opt_descs,
+                       "endpoint_map": dict(self._placement),
+                       "trainer_id": trainer_id, "mode": self.mode})
+            tblock.append_op(
+                type="ps_recv",
+                inputs={"X": list(params)},
+                outputs={"Out": list(params)},
+                attrs={"param_names": list(params),
+                       "endpoint_map": dict(self._placement),
+                       "opt_descs": opt_descs,
+                       "trainer_id": trainer_id, "mode": self.mode})
+        else:
+            # geo: local optimizer ops STAY; periodic delta push/pull is a
+            # single fused host op (ref: geo_sgd_transpiler.py +
+            # GeoCommunicator distributed/communicator.h:403)
+            tblock.append_op(
+                type="geo_sgd_sync",
+                inputs={"X": list(params)},
+                outputs={"Out": list(params)},
+                attrs={"param_names": list(params),
+                       "endpoint_map": dict(self._placement),
+                       "trainer_id": trainer_id,
+                       "push_nums": self.config.geo_sgd_need_push_nums})
+        self._trainer_program = trainer
+
+        # 4) pserver programs (ref: get_pserver_program :1153)
+        for ep in endpoints:
+            prog = Program()
+            prog.global_block().append_op(
+                type="listen_and_serv", inputs={}, outputs={},
+                attrs={"endpoint": ep, "n_trainers": trainers,
+                       "mode": self.mode,
+                       "param_names": [p for p in params
+                                       if self._placement[p] == ep],
+                       "sparse_tables": []})
+            self._pserver_programs[ep] = prog
+        return self
+
+    @staticmethod
+    def _lr_values(startup_program) -> Dict[str, float]:
+        vals = {}
+        for op in startup_program.global_block().ops:
+            if op.type == "fill_constant":
+                out = op.outputs.get("Out", [None])[0]
+                if out is not None and "learning_rate" in str(out):
+                    vals[out] = float(op.attrs.get("value", 0.01))
+        return vals
+
+    def init_worker(self, scope=None):
+        """Push this trainer's initial params + optimizer descs to their
+        owning pservers (ref: fleet PS init_worker; the raw-transpiler
+        equivalent of running the pserver startup program).  Must run after
+        the local startup program, before the first training step."""
+        import numpy as np
+        from ...framework.executor import global_scope
+        from ...ops.ps_ops import _client, _initialized
+        scope = scope or global_scope()
+        by_ep: Dict[str, dict] = {}
+        for p, ep in self._placement.items():
+            v = scope.find_var(p)
+            if v is None:
+                raise RuntimeError(
+                    f"param {p!r} not in scope — run the startup program "
+                    f"before init_worker")
+            by_ep.setdefault(ep, {})[p] = np.asarray(v)
+            lr_name = self._opt_descs[p].get("lr_name")
+            lr_v = scope.find_var(lr_name) if lr_name else None
+            if lr_v is not None:
+                self._opt_descs[p]["lr"] = float(np.asarray(lr_v).ravel()[0])
+        for ep, params in by_ep.items():
+            _client(ep).call(
+                "init_dense", params=params,
+                opt_descs={n: self._opt_descs[n] for n in params})
+            _initialized.add(ep)
+
+    # -- artifacts (ref: :1018, :1153) -----------------------------------
+    def get_trainer_program(self, wait_port=True) -> Program:
+        if self._trainer_program is None:
+            raise RuntimeError("call transpile() first")
+        return self._trainer_program
+
+    def get_pserver_program(self, endpoint: str) -> Program:
+        if endpoint not in self._pserver_programs:
+            raise RuntimeError(
+                f"{endpoint!r} is not one of this job's pservers "
+                f"({sorted(self._pserver_programs)})")
+        return self._pserver_programs[endpoint]
+
+    def get_pserver_programs(self, endpoint: str):
+        return self.get_pserver_program(endpoint), Program()
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None) -> Program:
+        """Server startup is empty — tables materialise lazily from the
+        first trainer contact (ps_recv init push)."""
+        return Program()
+
+    @property
+    def placement(self):
+        return dict(self._placement)
+
+
+class GeoSgdTranspiler(DistributeTranspiler):
+    """ref: transpiler/geo_sgd_transpiler.py — local SGD with periodic
+    delta push to the PS (GEO-SGD)."""
+
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        config = config or DistributeTranspilerConfig()
+        config.geo_sgd_mode = True
+        super().__init__(config)

@@ -31,6 +31,9 @@ from .. import profiler      # noqa: F401
 from .. import metrics       # noqa: F401
 from .. import monitor       # noqa: F401
 from ..flags import get_flags, set_flags  # noqa: F401
+from ..distributed.ps import (DistributeTranspiler,  # noqa: F401
+                              DistributeTranspilerConfig)
+from ..distributed import ps as transpiler  # noqa: F401 — fluid.transpiler
 from ..framework import core  # noqa: F401
 
 name_scope = unique_name.name_scope

@@ -305,6 +305,11 @@ class Executor:
                 clone = program.clone()
                 for pname in compiled_wrapper._pending_passes:
                     apply_pass(clone, pname, fetch_names=fetch_names)
+                if len(variants) >= 8:   # bound clone retention (LRU-ish)
+                    oldest = next(iter(variants))
+                    stale = variants.pop(oldest)
+                    self._cache = {k: v for k, v in self._cache.items()
+                                   if k[0] != id(stale)}
                 variants[vkey] = clone
             program = variants[vkey]
         feed = {k: np.asarray(v) if not hasattr(v, "dtype") else v
@@ -502,7 +507,26 @@ class Executor:
             return fetches, state_out, \
                 (next_base if next_base is not None else ctx.key)
 
-        if mesh is not None:
+        from ..ops.registry import HOST_OPS
+        host_idxs = [i for i, op in enumerate(ops) if op.type in HOST_OPS]
+        if host_idxs:
+            # PS-tier programs: host RPC ops (ps_send/ps_recv/
+            # listen_and_serv/...) cannot live inside jit.  They sit before
+            # the forward or after the backward by construction
+            # (transpiler), so the step runs unjitted: jax ops execute
+            # eagerly, host ops do RPC — the reference's op-loop semantics
+            # (executor.cc:465 interleaves compute and RPC ops the same way)
+            if bw_idx is not None and any(i < bw_idx for i in host_idxs):
+                raise NotImplementedError(
+                    "host ops inside the differentiated forward section "
+                    "are not supported — pull host data before the step "
+                    "(FleetWrapper pattern, ref: downpour_worker.cc:726)")
+            if mesh is not None:
+                raise NotImplementedError(
+                    "PS host ops with a device mesh in one program are "
+                    "unsupported; PS data-parallelism is multi-process")
+            fn = step
+        elif mesh is not None:
             fn = self._wrap_sharded(step, mesh, axis_names, batch_axis,
                                     program, feed_names, state_in_names,
                                     state_out_names, feed_specs or {})

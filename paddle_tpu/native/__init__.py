@@ -186,3 +186,66 @@ class NativeDataset:
                 self._h = None
         except Exception:
             pass
+
+
+class KVTable:
+    """Python wrapper over the native LargeScaleKV store (ref:
+    operators/distributed/large_scale_kv.h:769 LargeScaleKV,
+    fleet_wrapper.h pull/push sparse)."""
+
+    def __init__(self, dim: int, n_shards: int = 16, seed: int = 0):
+        self._lib = load()
+        if not hasattr(self._lib, "ptkv_create"):
+            raise RuntimeError("native KV store not built")
+        self._h = self._lib.ptkv_create(int(dim), int(n_shards), int(seed))
+        self.dim = int(dim)
+
+    def size(self) -> int:
+        return int(self._lib.ptkv_size(self._h))
+
+    def pull(self, ids, init_mode: int = 1):
+        import numpy as np
+        ids = np.ascontiguousarray(ids, dtype=np.int64).reshape(-1)
+        out = np.empty((len(ids), self.dim), np.float32)
+        self._lib.ptkv_pull(
+            self._h, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(ids), out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            int(init_mode))
+        return out
+
+    def push_grad(self, ids, grads, lr: float):
+        import numpy as np
+        ids = np.ascontiguousarray(ids, dtype=np.int64).reshape(-1)
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        self._lib.ptkv_push_grad(
+            self._h, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(ids), grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            float(lr))
+
+    def push_assign(self, ids, values):
+        import numpy as np
+        ids = np.ascontiguousarray(ids, dtype=np.int64).reshape(-1)
+        values = np.ascontiguousarray(values, dtype=np.float32)
+        self._lib.ptkv_push_assign(
+            self._h, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(ids), values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+
+    def keys(self):
+        import numpy as np
+        n = self.size()
+        out = np.empty(n, np.int64)
+        if n:
+            self._lib.ptkv_keys(
+                self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return out
+
+    def shrink(self, threshold: int):
+        """Drop rows with access count below threshold (ref:
+        large_scale_kv.h Shrink / CountFilterEntry)."""
+        self._lib.ptkv_shrink(self._h, int(threshold))
+
+    def __del__(self):
+        try:
+            self._lib.ptkv_destroy(self._h)
+        except Exception:
+            pass

@@ -12,3 +12,4 @@ from . import controlflow_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import tp_ops        # noqa: F401
 from . import pipeline_op   # noqa: F401
+from . import ps_ops        # noqa: F401

@@ -1,0 +1,273 @@
+"""Parameter-server host ops (ref: operators/distributed_ops/ — send_op.cc,
+recv_op.cc, listen_and_serv_op.cc; operators/distributed/
+parameter_send.cc, parameter_recv.cc; distributed_lookup_table_op).
+
+These are HOST ops: they do RPC, not device math, exactly as in the
+reference (send/recv ops block on gRPC inside the executor's op loop).
+The executor runs programs containing them in host-segmented mode
+(framework/executor.py): leading/trailing host ops execute eagerly around
+the jittable core, so the XLA step itself stays pure."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import numpy as np
+
+from .registry import register, HOST_OPS, x
+
+# Client state is per-thread: each trainer (a process in the reference, a
+# thread in in-process tests) owns its connection — a shared connection
+# would serialize one trainer's blocking sync-pull against another's push.
+_tls = threading.local()
+
+
+def _state():
+    if not hasattr(_tls, "clients"):
+        _tls.clients = {}
+        _tls.versions = {}
+        _tls.initialized = set()
+    return _tls
+
+
+# module-level views for the common single-thread case (init_worker etc.)
+class _TLView:
+    def add(self, item):
+        _state().initialized.add(item)
+
+    def __contains__(self, item):
+        return item in _state().initialized
+
+
+_initialized = _TLView()
+_versions_get = lambda ep, d=-1: _state().versions.get(ep, d)  # noqa: E731
+
+
+def _client(endpoint: str):
+    from ..distributed.ps.rpc import RPCClient
+    st = _state()
+    if endpoint not in st.clients:
+        st.clients[endpoint] = RPCClient(endpoint)
+    return st.clients[endpoint]
+
+
+def reset_clients():
+    """Drop this thread's cached connections/state (between tests)."""
+    st = _state()
+    for c in st.clients.values():
+        c.close()
+    st.clients.clear()
+    st.versions.clear()
+    st.initialized.clear()
+    _geo_state.clear()
+
+
+HOST_OPS.add("ps_send")
+
+
+@register("ps_send")
+def _ps_send(ctx, ins, attrs):
+    """Push grads to their owning pservers (ref: send_op.cc +
+    parameter_send.cc; sync semantics of send_barrier folded in: the
+    server's returned version is remembered for the matching recv)."""
+    grads = ins.get("X", [])
+    names = list(attrs["grad_names"])
+    ep_map = attrs["endpoint_map"]          # param/grad base name → endpoint
+    trainer_id = attrs.get("trainer_id", 0)
+    by_ep: Dict[str, Dict[str, np.ndarray]] = {}
+    for n, g in zip(names, grads):
+        base = attrs["grad_to_param"][n]
+        by_ep.setdefault(ep_map[base], {})[base] = np.asarray(g)
+    # lazy server init when init_worker() wasn't called: params ride along
+    # as inputs so first contact can seed the tables
+    pvals = dict(zip(attrs.get("param_names", []), ins.get("Param", [])))
+    opt_descs = attrs.get("opt_descs", {})
+    for ep in by_ep:
+        if pvals and ep not in _initialized:
+            mine = {n: np.asarray(v) for n, v in pvals.items()
+                    if ep_map[n] == ep}
+            _client(ep).call("init_dense", params=mine,
+                             opt_descs={n: opt_descs.get(n, {})
+                                        for n in mine})
+            _initialized.add(ep)
+    if attrs.get("mode") in ("async", "half_async"):
+        from ..distributed.ps.communicator import Communicator
+        comm = Communicator._global
+        if comm is not None:
+            if comm.error is not None:
+                raise RuntimeError(
+                    "async communicator failed") from comm.error
+            if comm.is_running():
+                # non-blocking: background communicator merges and pushes
+                for ep, payload in by_ep.items():
+                    comm.put(ep, payload)
+                return {}
+    for ep, payload in by_ep.items():
+        version = _client(ep).call("push_dense", trainer_id=trainer_id,
+                                   grads=payload)
+        _state().versions[ep] = version
+    return {}
+
+
+HOST_OPS.add("ps_recv")
+
+
+@register("ps_recv")
+def _ps_recv(ctx, ins, attrs):
+    """Pull fresh params from the pservers (ref: recv_op.cc +
+    parameter_recv.cc).  First call per endpoint lazily pushes the
+    trainer's initial params + optimizer descs (the reference ships server
+    startup programs; lazy init-on-first-contact keeps one code path)."""
+    params = ins.get("X", [])
+    names = list(attrs["param_names"])
+    ep_map = attrs["endpoint_map"]
+    opt_descs = attrs.get("opt_descs", {})
+    mode = attrs.get("mode", "sync")
+    by_ep: Dict[str, list] = {}
+    for n, p in zip(names, params):
+        by_ep.setdefault(ep_map[n], []).append((n, p))
+    out = {}
+    for ep, items in by_ep.items():
+        cli = _client(ep)
+        if ep not in _initialized:
+            cli.call("init_dense",
+                     params={n: np.asarray(p) for n, p in items},
+                     opt_descs={n: opt_descs.get(n, {}) for n, _ in items})
+            _initialized.add(ep)
+        wait = _versions_get(ep) if mode == "sync" else -1
+        vals, version = cli.call("pull_dense",
+                                 names=[n for n, _ in items],
+                                 wait_version=wait)
+        _state().versions[ep] = version
+        out.update(vals)
+    return {"Out": [out[n] for n in names]}
+
+
+HOST_OPS.add("listen_and_serv")
+
+
+@register("listen_and_serv")
+def _listen_and_serv(ctx, ins, attrs):
+    """Run the parameter server event loop — blocks until stopped
+    (ref: listen_and_serv_op.cc:352)."""
+    from ..distributed.ps.server import ParameterServer
+    server = ParameterServer(attrs["endpoint"],
+                             n_trainers=attrs.get("n_trainers", 1),
+                             mode=attrs.get("mode", "sync"))
+    for name, dim, lr in attrs.get("sparse_tables", []):
+        server.init_sparse(name, dim, lr)
+    # expose for in-process tests / graceful shutdown
+    _running_servers[attrs["endpoint"]] = server
+    server.run()
+    return {}
+
+
+_running_servers: Dict[str, object] = {}
+
+
+HOST_OPS.add("distributed_lookup_table")
+
+
+@register("distributed_lookup_table")
+def _distributed_lookup_table(ctx, ins, attrs):
+    """Sparse embedding pull by ids (ref: distributed_lookup_table_op.cc →
+    parameter_prefetch.cc).  Forward-only host op; the training path
+    pulls/pushes around the step via FleetWrapper, matching the
+    DownpourWorker design (framework/downpour_worker.cc:726)."""
+    ids = np.asarray(x(ins, "Ids"))
+    ep = attrs["endpoint"]
+    table = attrs["table_name"]
+    rows = _client(ep).call("pull_sparse", name=table,
+                            ids=ids.reshape(-1))
+    dim = rows.shape[-1]
+    return {"Out": rows.reshape(ids.shape + (dim,))}
+
+
+HOST_OPS.add("geo_sgd_sync")
+
+_geo_state: Dict[int, dict] = {}
+
+
+@register("geo_sgd_sync")
+def _geo_sgd_sync(ctx, ins, attrs):
+    """GEO-SGD periodic delta exchange (ref: GeoCommunicator,
+    distributed/communicator.h:403): every ``push_nums`` local steps push
+    (param - shadow) to the server, pull the global param back, and reset
+    the shadow.  Between syncs the local optimizer ops train alone."""
+    params = ins.get("X", [])
+    names = list(attrs["param_names"])
+    ep_map = attrs["endpoint_map"]
+    trainer_id = attrs.get("trainer_id", 0)
+    push_nums = attrs.get("push_nums", 100)
+    st = _geo_state.setdefault(trainer_id, {"step": 0, "shadow": {}})
+    st["step"] += 1
+    cur = {n: np.asarray(p) for n, p in zip(names, params)}
+    if not st["shadow"]:
+        # first touch: seed server (first trainer wins) + local shadow
+        by_ep: Dict[str, Dict[str, np.ndarray]] = {}
+        for n in names:
+            by_ep.setdefault(ep_map[n], {})[n] = cur[n]
+        for ep, payload in by_ep.items():
+            if ep not in _initialized:
+                _client(ep).call("init_dense", params=payload,
+                                 opt_descs={n: {"type": "sgd", "lr": 1.0}
+                                            for n in payload})
+                _initialized.add(ep)
+        st["shadow"] = dict(cur)
+        return {"Out": [cur[n] for n in names]}
+    if st["step"] % push_nums != 0:
+        return {"Out": [cur[n] for n in names]}
+    by_ep: Dict[str, list] = {}
+    for n in names:
+        by_ep.setdefault(ep_map[n], []).append(n)
+    out = dict(cur)
+    for ep, ns in by_ep.items():
+        cli = _client(ep)
+        cli.call("push_dense", trainer_id=trainer_id,
+                 grads={n: cur[n] - st["shadow"][n] for n in ns})
+        vals, _ = cli.call("pull_dense", names=ns, wait_version=-1)
+        out.update(vals)
+    st["shadow"] = dict(out)
+    return {"Out": [out[n] for n in names]}
+
+
+class FleetWrapper:
+    """Sparse pull/push client (ref: framework/fleet/fleet_wrapper.h:59 —
+    PullSparseVarsSync:86, PushSparseVarsWithLabelAsync:158).  Matches the
+    DownpourWorker pattern (downpour_worker.cc:726): pull rows for the
+    batch's ids BEFORE the step, feed them as a dense input, fetch the row
+    grads, push them AFTER the step."""
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+
+    def init_table(self, name: str, dim: int, lr: float = 0.01,
+                   init_mode: int = 1):
+        return _client(self.endpoint).call("init_sparse", name=name,
+                                           dim=dim, lr=lr,
+                                           init_mode=init_mode)
+
+    def pull_sparse(self, table: str, ids) -> np.ndarray:
+        return _client(self.endpoint).call(
+            "pull_sparse", name=table,
+            ids=np.asarray(ids, np.int64).reshape(-1))
+
+    def push_sparse(self, table: str, ids, grads, trainer_id: int = 0):
+        return _client(self.endpoint).call(
+            "push_sparse", trainer_id=trainer_id, name=table,
+            ids=np.asarray(ids, np.int64).reshape(-1),
+            grads=np.asarray(grads, np.float32))
+
+    def heartbeat(self, trainer_id: int = 0):
+        return _client(self.endpoint).call("heartbeat",
+                                           trainer_id=trainer_id)
+
+    def worker_status(self):
+        return _client(self.endpoint).call("worker_status")
+
+    def stop_server(self):
+        try:
+            _client(self.endpoint).call("__stop__")
+        except Exception:
+            pass

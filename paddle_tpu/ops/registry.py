@@ -21,6 +21,10 @@ import jax
 
 OPS: Dict[str, Callable] = {}
 
+#: ops that perform host-side I/O (RPC) and must run outside jit — the
+#: executor runs programs containing them in host-segmented mode
+HOST_OPS: set = set()
+
 
 def register(name: str):
     def deco(fn):

@@ -1,0 +1,67 @@
+"""Subprocess entry for the PS integration test (ref: the dist_mnist.py /
+test_dist_base.py split: model script run as pserver or trainer by role
+env/argv).  Usage: dist_ps_runner.py {pserver|trainer} endpoint trainer_id
+n_trainers."""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def build():
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    from paddle_tpu.framework.core import program_guard
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(
+            x, 1, bias_attr=False,
+            param_attr=fluid.ParamAttr(
+                name="w", initializer=fluid.initializer.Constant(0.1)))
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed.ps import DistributeTranspiler
+
+    role, endpoint, trainer_id, n_trainers = (
+        sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
+    prog, startup, loss = build()
+    t = DistributeTranspiler()
+    t.transpile(trainer_id, program=prog, pservers=endpoint,
+                trainers=n_trainers, sync_mode=True,
+                startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    if role == "pserver":
+        exe.run(t.get_pserver_program(endpoint))
+        return
+    exe.run(startup)
+    if trainer_id == 0:
+        t.init_worker()
+    else:
+        import time
+        time.sleep(1.0)   # let trainer 0's init land
+    rng = np.random.RandomState(100 + trainer_id)
+    w_true = np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+    losses = []
+    tp = t.get_trainer_program()
+    for _ in range(8):
+        xb = rng.randn(8, 4).astype(np.float32)
+        l, = exe.run(tp, feed={"x": xb, "y": xb @ w_true},
+                     fetch_list=[loss])
+        losses.append(float(l))
+    print("LOSSES " + json.dumps(losses))
+
+
+if __name__ == "__main__":
+    main()
