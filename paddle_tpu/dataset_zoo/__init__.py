@@ -1,0 +1,15 @@
+"""Dataset zoo (ref: python/paddle/dataset/ — mnist.py, uci_housing.py,
+imdb.py, wmt16.py reader creators).
+
+Same reader-creator API as the reference (``train()``/``test()`` return
+generator functions yielding per-example tuples).  Divergence, by design:
+the reference downloads real corpora; this environment has no egress, so
+each module generates a DETERMINISTIC synthetic stand-in with the same
+shapes, dtypes, and vocab conventions — enough for book tests, pipeline
+tests, and benchmarks to run unchanged.  Point the same API at real data
+by swapping these modules."""
+
+from . import mnist        # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb         # noqa: F401
+from . import wmt16        # noqa: F401

@@ -1,0 +1,39 @@
+"""Synthetic IMDB sentiment (ref: python/paddle/dataset/imdb.py —
+train(word_idx)/test(word_idx) yield (list-of-word-ids, 0/1 label);
+word_dict() returns the vocab).
+
+Synthetic rule: positive reviews oversample ids from the first half of the
+vocab, negative from the second half — linearly separable by bag-of-words,
+like the real task for a strong model."""
+
+import numpy as np
+
+VOCAB_SIZE = 5000
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(VOCAB_SIZE)}
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, 64))
+            half = VOCAB_SIZE // 2
+            lo, hi = (0, half) if label == 1 else (half, VOCAB_SIZE)
+            main = rng.randint(lo, hi, int(length * 0.8))
+            noise = rng.randint(0, VOCAB_SIZE, length - len(main))
+            ids = np.concatenate([main, noise])
+            rng.shuffle(ids)
+            yield ids.astype(np.int64).tolist(), label
+    return reader
+
+
+def train(word_idx=None, n=1024):
+    return _reader(n, seed=5)
+
+
+def test(word_idx=None, n=256):
+    return _reader(n, seed=6)

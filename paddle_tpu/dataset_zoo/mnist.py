@@ -1,0 +1,37 @@
+"""Synthetic MNIST (ref: python/paddle/dataset/mnist.py — train()/test()
+yield (784-float image in [-1, 1], int label)).
+
+Deterministic class-conditional blobs: each digit d gets a fixed template
+(seeded by d) plus small per-example noise, so simple models reach high
+accuracy and loss curves are reproducible."""
+
+import numpy as np
+
+_TEMPLATES = None
+
+
+def _templates():
+    global _TEMPLATES
+    if _TEMPLATES is None:
+        rng = np.random.RandomState(42)
+        _TEMPLATES = rng.uniform(-1, 1, (10, 784)).astype(np.float32)
+    return _TEMPLATES
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        t = _templates()
+        for _ in range(n):
+            label = int(rng.randint(0, 10))
+            img = t[label] + rng.normal(0, 0.3, 784).astype(np.float32)
+            yield np.clip(img, -1, 1).astype(np.float32), label
+    return reader
+
+
+def train(n=2048):
+    return _reader(n, seed=1)
+
+
+def test(n=512):
+    return _reader(n, seed=2)

@@ -1,0 +1,34 @@
+"""Synthetic UCI housing (ref: python/paddle/dataset/uci_housing.py —
+train()/test() yield (13-float features, 1-float price)).  A fixed linear
+ground truth + noise keeps regression book tests meaningful."""
+
+import numpy as np
+
+_W = None
+
+
+def _truth():
+    global _W
+    if _W is None:
+        rng = np.random.RandomState(7)
+        _W = rng.uniform(-1, 1, 13).astype(np.float32)
+    return _W
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        w = _truth()
+        for _ in range(n):
+            x = rng.normal(0, 1, 13).astype(np.float32)
+            y = float(x @ w + rng.normal(0, 0.1))
+            yield x, np.array([y], np.float32)
+    return reader
+
+
+def train(n=404):
+    return _reader(n, seed=3)
+
+
+def test(n=102):
+    return _reader(n, seed=4)

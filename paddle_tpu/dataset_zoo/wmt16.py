@@ -1,0 +1,36 @@
+"""Synthetic WMT16 translation pairs (ref: python/paddle/dataset/wmt16.py —
+train(src_dict_size, trg_dict_size) yields (src_ids, trg_ids, trg_next)).
+
+Synthetic rule: the "translation" of source token t is (t + 7) mod vocab,
+reversed — a deterministic bijection a seq2seq model can actually learn,
+giving meaningful loss curves without corpora.  BOS=0, EOS=1, UNK=2 as in
+the reference."""
+
+import numpy as np
+
+BOS, EOS, UNK = 0, 1, 2
+
+
+def _translate(src, trg_vocab):
+    return [(t + 7) % (trg_vocab - 3) + 3 for t in reversed(src)]
+
+
+def _reader(n, seed, src_vocab, trg_vocab):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = int(rng.randint(3, 12))
+            src = rng.randint(3, src_vocab, length).astype(int).tolist()
+            trg = _translate(src, trg_vocab)
+            trg_in = [BOS] + trg
+            trg_next = trg + [EOS]
+            yield src, trg_in, trg_next
+    return reader
+
+
+def train(src_dict_size=1000, trg_dict_size=1000, n=1024):
+    return _reader(n, 8, src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size=1000, trg_dict_size=1000, n=128):
+    return _reader(n, 9, src_dict_size, trg_dict_size)

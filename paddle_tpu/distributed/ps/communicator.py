@@ -38,10 +38,17 @@ class Communicator:
         Communicator._global = self
 
     def stop(self):
-        self._running = False
+        with self._lock:
+            self._running = False   # under the lock: a concurrent put()
+            #                         either landed before this (flushed
+            #                         below) or returns False (caller
+            #                         falls back to a direct push)
         if self._thread is not None:
             self._thread.join(timeout=5.0)
         self._flush()
+        if self.error is not None:
+            raise RuntimeError(
+                "async communicator lost gradients") from self.error
         if Communicator._global is self:
             Communicator._global = None
 
@@ -49,11 +56,16 @@ class Communicator:
         return self._running
 
     # -- producer side ----------------------------------------------------
-    def put(self, endpoint: str, grads: Dict[str, np.ndarray]):
+    def put(self, endpoint: str, grads: Dict[str, np.ndarray]) -> bool:
+        """Enqueue for background push; False once stopped (caller must
+        push directly)."""
         with self._lock:
+            if not self._running:
+                return False
             per_ep = self._pending.setdefault(endpoint, {})
             for n, g in grads.items():
                 per_ep.setdefault(n, []).append(np.asarray(g))
+            return True
 
     # -- background sender -------------------------------------------------
     def _loop(self):
@@ -63,7 +75,8 @@ class Communicator:
                 time.sleep(self._interval)
         except BaseException as e:  # noqa: BLE001 — surfaced via .error
             self.error = e
-            self._running = False
+            with self._lock:
+                self._running = False
 
     def _flush(self):
         from ...ops.ps_ops import _client
@@ -77,6 +90,11 @@ class Communicator:
             try:
                 _client(ep).call("push_dense", trainer_id=self.trainer_id,
                                  grads=merged)
-            except Exception:
-                if self._running:
-                    raise
+            except Exception as e:  # noqa: BLE001
+                # never drop gradients silently: re-queue and surface
+                with self._lock:
+                    per_ep = self._pending.setdefault(ep, {})
+                    for n, g in merged.items():
+                        per_ep.setdefault(n, []).append(g)
+                self.error = e
+                raise

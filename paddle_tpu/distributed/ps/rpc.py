@@ -63,7 +63,8 @@ class RPCServer:
                 t = threading.Thread(target=self._serve_conn, args=(conn,),
                                      daemon=True)
                 t.start()
-                self._threads.append(t)
+                self._threads = [th for th in self._threads
+                                 if th.is_alive()] + [t]
         finally:
             self.close()
 

@@ -65,22 +65,22 @@ class _DenseTable:
         self._accs: Dict[str, np.ndarray] = {}
         self._acc_spec = self._spec()
 
-    _SPECS = {
-        "sgd": [],
-        "momentum": [("velocity", "Velocity", "VelocityOut", 0.0, False)],
-        "adam": [("moment1", "Moment1", "Moment1Out", 0.0, False),
-                 ("moment2", "Moment2", "Moment2Out", 0.0, False),
-                 ("beta1_pow", "Beta1Pow", "Beta1PowOut", "beta1", True),
-                 ("beta2_pow", "Beta2Pow", "Beta2PowOut", "beta2", True)],
-        "adagrad": [("moment", "Moment", "MomentOut", 0.0, False)],
-    }
+    @staticmethod
+    def supported_optimizers():
+        """Optimizer op types the server can apply — the same accumulator
+        specs the dygraph eager path uses (optimizer.Optimizer._EAGER_ACCS),
+        so server-side updates cover every stock optimizer."""
+        from ... import optimizer as opt_mod
+        return set(opt_mod.Optimizer._EAGER_ACCS)
 
     def _spec(self):
-        if self.opt_type not in self._SPECS:
+        from ... import optimizer as opt_mod
+        specs = opt_mod.Optimizer._EAGER_ACCS
+        if self.opt_type not in specs:
             raise NotImplementedError(
                 f"pserver optimizer {self.opt_type!r} (supported: "
-                f"{sorted(self._SPECS)})")
-        return self._SPECS[self.opt_type]
+                f"{sorted(specs)})")
+        return specs[self.opt_type]
 
     def apply(self, grad: np.ndarray):
         from ...ops.registry import get_op, LoweringContext
@@ -89,8 +89,11 @@ class _DenseTable:
                "LearningRate": [np.asarray([self.lr], np.float32)]}
         for key, in_slot, _, fill, scalar in self._acc_spec:
             if key not in self._accs:
-                fill_v = self.attrs.get(fill, 0.9) if isinstance(fill, str) \
-                    else fill
+                # fill attr names come from the eager spec as optimizer
+                # attributes ("_beta1"); the shipped desc attrs use the op
+                # attr name ("beta1")
+                fill_v = self.attrs.get(fill.lstrip("_"), 0.9) \
+                    if isinstance(fill, str) else (fill or 0.0)
                 shape = (1,) if scalar else self.value.shape
                 self._accs[key] = np.full(shape, fill_v, np.float32)
             ins[in_slot] = [self._accs[key]]

@@ -91,6 +91,25 @@ class DistributeTranspiler:
             raise ValueError(
                 "transpile must run after optimizer.minimize (no optimizer "
                 "ops found, ref: distribute_transpiler.py:560)")
+        # fail at transpile time, not at the first RPC, when the server
+        # cannot apply this optimizer or the LR could not be resolved
+        if self.mode != "geo":
+            from .server import _DenseTable
+            supported = _DenseTable.supported_optimizers()
+            for p, d in opt_descs.items():
+                if d["type"] not in supported:
+                    raise NotImplementedError(
+                        f"optimizer {d['type']!r} (param {p!r}) has no "
+                        f"server-side update rule; supported: "
+                        f"{sorted(supported)}")
+                if d["lr_name"] not in lr_values:
+                    import warnings
+                    warnings.warn(
+                        f"could not statically resolve the learning rate "
+                        f"for {p!r} (var {d['lr_name']!r}); the server "
+                        f"will use {d['lr']} unless init_worker() is "
+                        f"called after startup to read the live value",
+                        stacklevel=2)
 
         # 2) round-robin placement (ref: ps_dispatcher.py RoundRobin)
         self._opt_descs = opt_descs

@@ -269,3 +269,10 @@ def where(condition, x, y, name=None):
                      inputs={"Condition": [condition], "X": [x], "Y": [y]},
                      outputs={"Out": [out]})
     return out
+
+
+def assign_value(values, dtype="float32", name=None):
+    """Materialise a host numpy constant as a Variable — the ndarray
+    branch of assign() (ref: layers tensor.py assign)."""
+    import numpy as np
+    return assign(np.asarray(values, dtype), name=name)

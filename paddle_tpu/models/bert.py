@@ -102,8 +102,9 @@ def fused_attention(q, k, v, attn_bias, n_head, dropout_rate, is_test,
 
 
 def bert_encoder(src_ids, position_ids, sentence_ids, input_mask,
-                 cfg: BertConfig, is_test=False):
-    """Returns (sequence_output, next_sentence_feat)."""
+                 cfg: BertConfig, is_test=False, extra_emb=None):
+    """Returns (sequence_output, next_sentence_feat).  ``extra_emb`` joins
+    the input embedding sum (ERNIE's task-type embedding hook)."""
     emb = layers.embedding(src_ids, size=[cfg.vocab_size, cfg.hidden_size],
                            dtype=cfg.dtype,
                            param_attr=_attr("word_embedding", cfg))
@@ -116,6 +117,8 @@ def bert_encoder(src_ids, position_ids, sentence_ids, input_mask,
                             dtype=cfg.dtype,
                             param_attr=_attr("sent_embedding", cfg))
     emb = emb + pos + sent
+    if extra_emb is not None:
+        emb = emb + extra_emb
     emb = layers.layer_norm(emb, begin_norm_axis=2,
                             param_attr=ParamAttr(name="pre_encoder_ln_scale"),
                             bias_attr=ParamAttr(name="pre_encoder_ln_bias"))

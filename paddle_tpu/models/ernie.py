@@ -1,0 +1,69 @@
+"""ERNIE (ref recipe: the reference era's ERNIE 1.0 — BERT-style encoder
+with an extra task-type embedding; BASELINE config 5 "ERNIE finetune").
+
+Reuses the BERT encoder stack (fused Pallas attention) with the task
+embedding added, plus the standard classification finetune head over the
+pooled [CLS] feature."""
+
+from __future__ import annotations
+
+from .. import layers
+from ..framework.layer_helper import ParamAttr
+from .bert import BertConfig, bert_encoder, _attr
+
+
+class ErnieConfig(BertConfig):
+    def __init__(self, task_type_vocab_size=3, **kw):
+        super().__init__(**kw)
+        self.task_type_vocab_size = task_type_vocab_size
+
+    @staticmethod
+    def base():
+        cfg = ErnieConfig()
+        cfg.__dict__.update(BertConfig.base().__dict__)
+        cfg.task_type_vocab_size = 3
+        return cfg
+
+    @staticmethod
+    def tiny():
+        cfg = ErnieConfig()
+        cfg.__dict__.update(BertConfig.tiny().__dict__)
+        cfg.task_type_vocab_size = 3
+        return cfg
+
+
+def ernie_encoder(src_ids, position_ids, sentence_ids, task_ids,
+                  input_mask, cfg: ErnieConfig, is_test=False):
+    """BERT encoder + task-type embedding folded into the input sum."""
+    task_emb = layers.embedding(
+        task_ids, size=[cfg.task_type_vocab_size, cfg.hidden_size],
+        dtype=cfg.dtype, param_attr=_attr("task_embedding", cfg))
+    return bert_encoder(src_ids, position_ids, sentence_ids, input_mask,
+                        cfg, is_test=is_test, extra_emb=task_emb)
+
+
+def build_classification_network(cfg: ErnieConfig, num_labels: int,
+                                 is_test=False):
+    """ERNIE finetune head (ref recipe: ernie classify finetune)."""
+    S = cfg.max_position_embeddings
+    src = layers.data("src_ids", shape=[S], dtype="int64")
+    pos = layers.data("pos_ids", shape=[S], dtype="int64")
+    sent = layers.data("sent_ids", shape=[S], dtype="int64")
+    task = layers.data("task_ids", shape=[S], dtype="int64")
+    mask = layers.data("input_mask", shape=[S, 1], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+
+    _, pooled = ernie_encoder(src, pos, sent, task, mask, cfg,
+                              is_test=is_test)
+    pooled = layers.dropout(pooled, 0.1, is_test=is_test,
+                            dropout_implementation="upscale_in_train")
+    logits = layers.fc(pooled, num_labels,
+                       param_attr=_attr("cls_out_w", cfg),
+                       bias_attr=ParamAttr(name="cls_out_b"))
+    ce = layers.softmax_with_cross_entropy(logits, label)
+    loss = layers.mean(ce)
+    probs = layers.softmax(logits)
+    acc = layers.accuracy(probs, label)
+    feeds = ["src_ids", "pos_ids", "sent_ids", "task_ids", "input_mask",
+             "label"]
+    return feeds, loss, probs, acc

@@ -1,0 +1,82 @@
+"""SE-ResNeXt (ref recipe: the reference's dist_se_resnext.py test model —
+ResNeXt bottlenecks with grouped conv + squeeze-and-excitation gating)."""
+
+from __future__ import annotations
+
+from .. import layers
+from ..framework.layer_helper import ParamAttr
+from ..framework.initializer import MSRAInitializer
+from .resnet import conv_bn_layer
+
+_DEPTH_CFG = {
+    50: [3, 4, 6, 3],
+    101: [3, 4, 23, 3],
+    152: [3, 8, 36, 3],
+}
+
+
+def squeeze_excitation(input, num_channels, reduction_ratio, name):
+    pool = layers.pool2d(input, pool_type="avg", global_pooling=True)
+    pool = layers.reshape(pool, [-1, num_channels])
+    squeeze = layers.fc(pool, num_channels // reduction_ratio, act="relu",
+                        param_attr=ParamAttr(name=f"{name}_sqz_w"),
+                        bias_attr=ParamAttr(name=f"{name}_sqz_b"))
+    excite = layers.fc(squeeze, num_channels, act="sigmoid",
+                       param_attr=ParamAttr(name=f"{name}_exc_w"),
+                       bias_attr=ParamAttr(name=f"{name}_exc_b"))
+    excite = layers.reshape(excite, [-1, num_channels, 1, 1])
+    return input * excite
+
+
+def bottleneck_block(input, num_filters, stride, cardinality,
+                     reduction_ratio, name, is_test=False):
+    conv0 = conv_bn_layer(input, num_filters, 1, act="relu",
+                          name=f"{name}_conv0", is_test=is_test)
+    conv1 = conv_bn_layer(conv0, num_filters, 3, stride=stride,
+                          groups=cardinality, act="relu",
+                          name=f"{name}_conv1", is_test=is_test)
+    conv2 = conv_bn_layer(conv1, num_filters * 2, 1, act=None,
+                          name=f"{name}_conv2", is_test=is_test)
+    scale = squeeze_excitation(conv2, num_filters * 2, reduction_ratio,
+                               name)
+    if input.shape[1] != num_filters * 2 or stride != 1:
+        short = conv_bn_layer(input, num_filters * 2, 1, stride=stride,
+                              name=f"{name}_short", is_test=is_test)
+    else:
+        short = input
+    return layers.relu(short + scale)
+
+
+def se_resnext(input, class_dim=1000, depth=50, cardinality=32,
+               reduction_ratio=16, is_test=False):
+    stages = _DEPTH_CFG[depth]
+    x = conv_bn_layer(input, 64, 7, stride=2, act="relu", name="conv1",
+                      is_test=is_test)
+    x = layers.pool2d(x, pool_size=3, pool_stride=2, pool_padding=1,
+                      pool_type="max")
+    num_filters = [128, 256, 512, 1024]
+    for s, n_blocks in enumerate(stages):
+        for b in range(n_blocks):
+            x = bottleneck_block(
+                x, num_filters[s], stride=2 if b == 0 and s != 0 else 1,
+                cardinality=cardinality, reduction_ratio=reduction_ratio,
+                name=f"stage{s}_block{b}", is_test=is_test)
+    pool = layers.pool2d(x, pool_type="avg", global_pooling=True)
+    pool = layers.reshape(pool, [-1, pool.shape[1]])
+    out = layers.fc(pool, class_dim,
+                    param_attr=ParamAttr(name="fc_w",
+                                         initializer=MSRAInitializer()),
+                    bias_attr=ParamAttr(name="fc_b"))
+    return out
+
+
+def build_classifier(class_dim=10, depth=50, image_shape=(3, 32, 32),
+                     cardinality=8, is_test=False):
+    img = layers.data("image", shape=list(image_shape))
+    label = layers.data("label", shape=[1], dtype="int64")
+    logits = se_resnext(img, class_dim, depth, cardinality=cardinality,
+                        is_test=is_test)
+    ce = layers.softmax_with_cross_entropy(logits, label)
+    loss = layers.mean(ce)
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return ["image", "label"], loss, acc

@@ -1,0 +1,35 @@
+"""Word2vec skip-gram (ref recipe: tests/book test_word2vec.py — the
+reference book test trains an n-gram LM with shared embeddings; the fleet
+PS tests train skip-gram over the sparse table tier).
+
+Dense variant here: shared embedding + sampled-free full softmax at test
+scale; the 100B-feature scale path goes through the PS sparse tier
+(distributed/ps FleetWrapper)."""
+
+from __future__ import annotations
+
+from .. import layers
+from ..framework.layer_helper import ParamAttr
+from ..framework.initializer import NormalInitializer
+
+
+def build_ngram_lm(vocab_size=200, emb_dim=32, n_gram=4, hidden=64):
+    """N-gram language model with shared input embeddings (the book test's
+    word2vec formulation).  Feeds: w0..w{n-2} context ids + next_word."""
+    ctx_words = [layers.data(f"w{i}", shape=[1], dtype="int64")
+                 for i in range(n_gram - 1)]
+    next_word = layers.data("next_word", shape=[1], dtype="int64")
+    embs = []
+    for i, w in enumerate(ctx_words):
+        e = layers.embedding(
+            w, size=[vocab_size, emb_dim],
+            param_attr=ParamAttr(name="shared_w",
+                                 initializer=NormalInitializer(0.0, 0.02)))
+        embs.append(layers.reshape(e, [-1, emb_dim]))
+    concat = layers.concat(embs, axis=1)
+    h = layers.fc(concat, hidden, act="sigmoid")
+    logits = layers.fc(h, vocab_size)
+    ce = layers.softmax_with_cross_entropy(logits, next_word)
+    loss = layers.mean(ce)
+    feeds = [f"w{i}" for i in range(n_gram - 1)] + ["next_word"]
+    return feeds, loss, logits

@@ -98,10 +98,10 @@ def _ps_send(ctx, ins, attrs):
             if comm.error is not None:
                 raise RuntimeError(
                     "async communicator failed") from comm.error
-            if comm.is_running():
-                # non-blocking: background communicator merges and pushes
-                for ep, payload in by_ep.items():
-                    comm.put(ep, payload)
+            # non-blocking enqueue; put() returning False (stopped
+            # concurrently) falls through to the direct push below
+            if all(comm.put(ep, payload)
+                   for ep, payload in by_ep.items()):
                 return {}
     for ep, payload in by_ep.items():
         version = _client(ep).call("push_dense", trainer_id=trainer_id,
@@ -157,9 +157,13 @@ def _listen_and_serv(ctx, ins, attrs):
                              mode=attrs.get("mode", "sync"))
     for name, dim, lr in attrs.get("sparse_tables", []):
         server.init_sparse(name, dim, lr)
-    # expose for in-process tests / graceful shutdown
-    _running_servers[attrs["endpoint"]] = server
-    server.run()
+    # expose for in-process tests / graceful shutdown, keyed by the BOUND
+    # endpoint (port 0 resolves at bind) and dropped when serving ends
+    _running_servers[server.endpoint] = server
+    try:
+        server.run()
+    finally:
+        _running_servers.pop(server.endpoint, None)
     return {}
 
 

@@ -309,3 +309,24 @@ def test_sync_ps_without_init_worker_lazy_init():
     # lazy init can't resolve the live LR from the scope; equivalence holds
     # when the transpile-time static LR is correct (0.1 from startup scan)
     np.testing.assert_allclose(ps, base, rtol=1e-4)
+
+
+def test_sync_ps_rmsprop_and_transpile_validation():
+    """Server-side updates cover all eager-spec optimizers; unsupported
+    types fail loudly at transpile time."""
+    batches = _batches(6)
+    base = _local_losses(batches, fluid.optimizer.RMSProp(0.01))
+    server = ParameterServer("127.0.0.1:0", n_trainers=1, mode="sync")
+    server.start_background()
+    ps = _run_trainer(server.endpoint, batches,
+                      opt=fluid.optimizer.RMSProp(0.01))
+    server.stop()
+    np.testing.assert_allclose(ps, base, rtol=1e-3)
+    # unsupported server-side: dgc_momentum
+    main, startup, loss = _build(
+        fluid.optimizer.DGCMomentumOptimizer(0.1, 0.9,
+                                             rampup_begin_step=0))
+    t = DistributeTranspiler()
+    with pytest.raises(NotImplementedError, match="server-side"):
+        t.transpile(0, program=main, pservers="127.0.0.1:1", trainers=1,
+                    startup_program=startup)
