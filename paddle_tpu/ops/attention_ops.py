@@ -10,10 +10,15 @@ while the jnp composition remains the CPU/interpret fallback."""
 
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
 
 from .registry import register, x
+
+_log = logging.getLogger(__name__)
+_warned_fallback = False
 
 
 def _split_heads(t, n_head):
@@ -73,15 +78,33 @@ def _fused_attention(ctx, ins, attrs):
         if kv_mask is not None:        # [B, S] 0/1 valid-key mask → bias
             bias = (1.0 - kv_mask.astype(jnp.float32))[:, None, None, :] \
                 * -1e9
-    if use_pallas and not dropout_rate:
-        try:
-            from .pallas.flash_attention import flash_attention_bshd
-            d = q.shape[-1] // n_head
+    if use_pallas:
+        from .pallas.flash_attention import flash_attention_bshd, supported
+        b, s, hd = q.shape
+        sk = k.shape[1]
+        d = hd // n_head
+        if supported((b, n_head, s, d), k_seq=sk):
+            rate = 0.0 if is_test else float(dropout_rate)
+            seed = None
+            if rate:
+                # derive a per-step int32 seed from the program RNG so the
+                # in-kernel PRNG mask changes every step but fwd/bwd agree
+                seed = jax.random.randint(ctx.next_key(), (1,), 0,
+                                          jnp.iinfo(jnp.int32).max,
+                                          dtype=jnp.int32)
             out = flash_attention_bshd(
                 _split_heads(q, n_head), _split_heads(k, n_head),
-                _split_heads(v, n_head), bias)
+                _split_heads(v, n_head), bias, dropout_rate=rate,
+                seed=seed)
             return {"Out": _merge_heads(out)}
-        except Exception:
-            pass  # interpret/CPU or unsupported shape: jnp fallback
+        global _warned_fallback
+        if not _warned_fallback:
+            _warned_fallback = True
+            _log.warning(
+                "fused_attention: pallas flash kernel unavailable for "
+                "shape B=%d H=%d Sq=%d Sk=%d D=%d on backend %s — using "
+                "jnp composition (S must tile 128; D must be 64 or a "
+                "multiple of 128)", b, n_head, s, sk, d,
+                jax.default_backend())
     return {"Out": reference_attention(q, k, v, bias, n_head, dropout_rate,
                                        ctx, is_test)}

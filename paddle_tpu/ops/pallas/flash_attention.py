@@ -1,15 +1,31 @@
-"""Flash attention (online-softmax, blockwise) as a Pallas TPU kernel.
+"""Flash attention (online-softmax, blockwise) as Pallas TPU kernels.
 
 The reference's fastest attention is a monolithic fused CUDA kernel
 (ref: operators/fused/multihead_matmul_op.cu) that still materialises the
 full (S, S) score matrix.  This kernel is strictly stronger: O(S) memory via
-online softmax, MXU-shaped (128x128) blocks, f32 accumulation.
+online softmax, MXU-shaped (128x128) blocks, f32 accumulation, and in-kernel
+PRNG dropout (the reference's fused path has no dropout at all — its
+dropout runs as a separate elementwise kernel over the (S, S) probs,
+ref: operators/dropout_op.cu).
 
-Forward: Pallas kernel, grid (batch*heads, q_blocks), inner fori_loop over
-KV blocks keeping running max/denominator (the standard flash recurrence).
-Backward: custom_vjp that recomputes attention with the jnp reference
-composition (correct, O(S^2) transient in bwd only) — a full blockwise
-backward kernel is the planned upgrade.
+Forward: grid (batch*heads, q_blocks), inner fori_loop over KV blocks with
+the standard online-softmax recurrence; emits the per-row logsumexp as a
+residual.  Dropout draws uint32 bits from the per-core PRNG seeded
+deterministically per (head, q-block, k-block) so the backward kernels can
+regenerate the identical mask without storing it.
+
+Backward: two blockwise kernels (FlashAttention-2 style) —
+  * dq: grid over q blocks, loop over kv blocks;
+  * dk/dv: grid over kv blocks, loop over q blocks;
+both recompute the probabilities from q/k and the saved logsumexp
+(p = exp(s - lse)) in f32 and use the identity
+rowsum(p * dp) == rowsum(do * o) (valid with dropout too) so only O(S)
+residuals are ever materialised.
+
+Gradient w.r.t. the additive bias is defined as zero: every call site in
+this framework builds the bias from non-trainable padding masks, and the
+dispatch (ops/attention_ops.py) stop-gradients it.  A learned attention
+bias must use the jnp composition instead.
 """
 
 from __future__ import annotations
@@ -19,6 +35,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -27,83 +44,275 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, *, scale, num_k_blocks,
-                has_bias):
+def _dropout_mask(seed_ref, b, qi, kj, shape, rate):
+    """Regenerable keep-mask: seeded per (head, q-block, k-block)."""
+    pltpu.prng_seed(seed_ref[0], b, qi, kj)
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    threshold = np.uint32(min(int(rate * 2**32), 2**32 - 1))
+    return bits >= threshold           # P(keep) = 1 - rate
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *,
+                scale, num_k_blocks, has_bias, rate):
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)           # (BQ, D)
     acc = jnp.zeros((q.shape[0], v_ref.shape[-1]), jnp.float32)
     m = jnp.full((q.shape[0], 1), -jnp.inf, jnp.float32)
     l = jnp.zeros((q.shape[0], 1), jnp.float32)
 
-    def body(i, carry):
+    def body(j, carry):
         acc, m, l = carry
-        ks = k_ref[0, pl.ds(i * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        vs = v_ref[0, pl.ds(i * BLOCK_K, BLOCK_K), :]
-        s = jax.lax.dot_general(
+        ks = k_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        vs = v_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :]
+        s = lax.dot_general(
             q, ks, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale     # (BQ, BK)
         if has_bias:
-            s = s + b_ref[0, :, pl.ds(i * BLOCK_K, BLOCK_K)].astype(jnp.float32)
+            s = s + b_ref[0, :, pl.ds(j * BLOCK_K, BLOCK_K)].astype(
+                jnp.float32)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
+        # l accumulates the UNdropped probs (the softmax denominator);
+        # the mask applies to the numerator only, so acc/l == dropout(P)@V
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
+        if rate:
+            keep = _dropout_mask(seed_ref, b, qi, j, p.shape, rate)
+            p = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
+        acc_new = acc * alpha + lax.dot_general(
             p.astype(vs.dtype), vs, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
     acc, m, l = lax.fori_loop(0, num_k_blocks, body, (acc, m, l))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # rows with no unmasked keys (l == 0) store +inf so the backward's
+    # exp(s - lse) is exactly 0 there, not inf
+    lse_ref[0] = jnp.where(l[:, 0] > 0, m[:, 0] + jnp.log(l[:, 0]),
+                           jnp.inf)
 
 
-def _flash_fwd(q, k, v, bias):
-    """q,k,v: (BH, S, D) flattened batch*heads; bias: (BH, S, S) or None."""
-    bh, s, d = q.shape
-    num_q = s // BLOCK_Q
-    num_k = s // BLOCK_K
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, scale, num_k_blocks, has_bias,
+                   rate):
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)           # (BQ, D)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]                  # (BQ, 1)
+    delta = delta_ref[0][:, None]
+    acc = jnp.zeros(q.shape, jnp.float32)
+
+    def body(j, acc):
+        ks = k_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        vs = v_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        s = lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + b_ref[0, :, pl.ds(j * BLOCK_K, BLOCK_K)].astype(
+                jnp.float32)
+        p = jnp.exp(s - lse)                   # (BQ, BK)
+        dp = lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        if rate:
+            keep = _dropout_mask(seed_ref, b, qi, j, p.shape, rate)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - rate)), 0.0)
+        ds = p * (dp - delta)
+        return acc + lax.dot_general(ds, ks, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    acc = lax.fori_loop(0, num_k_blocks, body, acc)
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, scale, num_q_blocks,
+                    has_bias, rate):
+    b = pl.program_id(0)
+    kj = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)           # (BK, D)
+    v = v_ref[0].astype(jnp.float32)
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+
+    def body(i, carry):
+        dk, dv = carry
+        qs = q_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
+        dos = do_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
+        delta = delta_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
+        s = lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + b_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(
+                jnp.float32)
+        p = jnp.exp(s - lse)                   # (BQ, BK)
+        dp = lax.dot_general(dos, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        if rate:
+            keep = _dropout_mask(seed_ref, b, i, kj, p.shape, rate)
+            inv = 1.0 / (1.0 - rate)
+            pd = jnp.where(keep, p * inv, 0.0)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        else:
+            pd = p
+        dv = dv + lax.dot_general(pd, dos, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + lax.dot_general(ds, qs, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = lax.fori_loop(0, num_q_blocks, body, (dk, dv))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bias_specs(bh, sq, sk, bias, block_rows, transpose=False):
+    """BlockSpec + arg for the additive bias, folding a head-shared bias
+    ((B, Sq, Sk) with BH = B*H) without materialising the broadcast —
+    keeps HBM traffic at O(B*Sq*Sk), not O(B*H*Sq*Sk)."""
+    if bias is not None:
+        ratio = bh // bias.shape[0]
+        if transpose:  # (1, Sq, BK) blocks for the dkv kernel
+            spec = pl.BlockSpec((1, sq, block_rows),
+                                lambda b, i: (b // ratio, 0, i),
+                                memory_space=pltpu.VMEM)
+        else:          # (1, BQ, Sk) blocks for fwd / dq kernels
+            spec = pl.BlockSpec((1, block_rows, sk),
+                                lambda b, i: (b // ratio, i, 0),
+                                memory_space=pltpu.VMEM)
+        return spec, bias
+    spec = pl.BlockSpec((1, 1, 1), lambda b, i: (0, 0, 0),
+                        memory_space=pltpu.VMEM)
+    return spec, jnp.zeros((1, 1, 1), jnp.float32)
+
+
+def _flash_fwd(q, k, v, bias, seed, rate, interpret):
+    """q: (BH, Sq, D), k/v: (BH, Sk, D) flattened batch*heads;
+    bias: (B|BH, Sq, Sk) or None.  Returns (out, lse)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    num_q = sq // BLOCK_Q
+    num_k = sk // BLOCK_K
     scale = 1.0 / math.sqrt(d)
     has_bias = bias is not None
 
-    in_specs = [
-        pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0),
-                     memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0),
-                     memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0),
-                     memory_space=pltpu.VMEM),
-    ]
-    args = [q, k, v]
-    if has_bias:
-        # bias may be shared across heads: shape (B, S, S) with BH = B*H —
-        # the index map folds the head dim away instead of materialising
-        # a broadcast (keeps HBM traffic at O(B*S^2), not O(B*H*S^2))
-        ratio = bh // bias.shape[0]
-        in_specs.append(pl.BlockSpec(
-            (1, BLOCK_Q, s), lambda b, i: (b // ratio, i, 0),
-            memory_space=pltpu.VMEM))
-        args.append(bias)
-    else:
-        # dummy scalar so the kernel signature is static
-        in_specs.append(pl.BlockSpec((1, 1, 1), lambda b, i: (0, 0, 0),
-                                     memory_space=pltpu.VMEM))
-        args.append(jnp.zeros((1, 1, 1), q.dtype))
+    qspec = pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    kvspec = pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0),
+                          memory_space=pltpu.VMEM)
+    bspec, barg = _bias_specs(bh, sq, sk, bias, BLOCK_Q)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, num_k_blocks=num_k,
-                               has_bias=has_bias)
-    flops = 4 * bh * s * s * d
+                               has_bias=has_bias, rate=rate)
+    flops = 4 * bh * sq * sk * d
     return pl.pallas_call(
         kernel,
         grid=(bh, num_q),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  qspec, kvspec, kvspec, bspec],
+        out_specs=[qspec,
+                   pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i),
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, sq), jnp.float32)],
         cost_estimate=pl.CostEstimate(
-            flops=flops, bytes_accessed=q.size * 4 * 3, transcendentals=bh * s * s),
-    )(*args)
+            flops=flops, bytes_accessed=q.size * 4 * 3,
+            transcendentals=bh * sq * sk),
+        interpret=interpret,
+    )(seed, q, k, v, barg)
+
+
+def _flash_bwd(q, k, v, bias, seed, o, lse, g, rate, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    num_q = sq // BLOCK_Q
+    num_k = sk // BLOCK_K
+    scale = 1.0 / math.sqrt(d)
+    has_bias = bias is not None
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                        # (BH, Sq)
+
+    qblk = pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0),
+                        memory_space=pltpu.VMEM)
+    kblk = pl.BlockSpec((1, BLOCK_K, d), lambda b, j: (b, j, 0),
+                        memory_space=pltpu.VMEM)
+    kfull = pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM)
+    qfull = pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM)
+    rowq = pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i),
+                        memory_space=pltpu.VMEM)
+    rowfull = pl.BlockSpec((1, sq), lambda b, i: (b, 0),
+                           memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    bspec_q, barg = _bias_specs(bh, sq, sk, bias, BLOCK_Q)
+    flops = 4 * bh * sq * sk * d
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, num_k_blocks=num_k,
+                          has_bias=has_bias, rate=rate),
+        grid=(bh, num_q),
+        in_specs=[smem, qblk, kfull, kfull, bspec_q, qblk, rowq, rowq],
+        out_specs=qblk,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * flops, bytes_accessed=q.size * 4 * 4,
+            transcendentals=bh * sq * sk),
+        interpret=interpret,
+    )(seed, q, k, v, barg, g, lse, delta)
+
+    bspec_t, barg_t = _bias_specs(bh, sq, sk, bias, BLOCK_K, transpose=True)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, num_q_blocks=num_q,
+                          has_bias=has_bias, rate=rate),
+        grid=(bh, num_k),
+        in_specs=[smem, qfull, kblk, kblk, bspec_t, qfull, rowfull,
+                  rowfull],
+        out_specs=[kblk, kblk],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * flops, bytes_accessed=q.size * 4 * 4,
+            transcendentals=bh * sq * sk),
+        interpret=interpret,
+    )(seed, q, k, v, barg_t, g, lse, delta)
+    return dq, dk, dv
+
+
+@functools.lru_cache(maxsize=None)
+def _make_flash(rate, has_bias, interpret):
+    """custom_vjp'd flash attention specialised on (dropout rate, bias
+    presence, interpret mode) — all static, so each variant traces once."""
+
+    @jax.custom_vjp
+    def f(q, k, v, bias, seed):
+        o, _ = _flash_fwd(q, k, v, bias, seed, rate, interpret)
+        return o
+
+    def fwd(q, k, v, bias, seed):
+        o, lse = _flash_fwd(q, k, v, bias, seed, rate, interpret)
+        return o, (q, k, v, bias, seed, o, lse)
+
+    def bwd(res, g):
+        q, k, v, bias, seed, o, lse = res
+        dq, dk, dv = _flash_bwd(q, k, v, bias, seed, o, lse, g, rate,
+                                interpret)
+        # bias grad is zero by contract (mask bias, stop-gradiented at the
+        # dispatch); seed is integer → float0 cotangent
+        dbias = jnp.zeros_like(bias) if has_bias else None
+        dseed = np.zeros(seed.shape, jax.dtypes.float0)
+        return dq, dk, dv, dbias, dseed
+
+    f.defvjp(fwd, bwd)
+    return f
 
 
 def _reference(q, k, v, bias):
+    """jnp spec for the kernels (no dropout), used by tests."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bsd,btd->bst", q, k,
                    preferred_element_type=jnp.float32) * scale
@@ -117,43 +326,65 @@ def _reference(q, k, v, bias):
                       preferred_element_type=jnp.float32).astype(v.dtype)
 
 
-@jax.custom_vjp
-def _flash(q, k, v, bias):
-    return _flash_fwd(q, k, v, bias)
+# backends whose canonical lowering is the TPU Mosaic pipeline
+_TPU_BACKENDS = ("tpu", "axon")
 
 
-def _flash_vjp_fwd(q, k, v, bias):
-    return _flash_fwd(q, k, v, bias), (q, k, v, bias)
+def supported(shape_bhsd, k_seq=None, backend=None):
+    """Static gate: can the kernel tile this (B, H, Sq, D) problem (with
+    key/value sequence length ``k_seq``, defaulting to Sq)?  Mirrors
+    exactly what flash_attention_bshd would reject, so callers dispatch
+    without try/except."""
+    b, h, s, d = shape_bhsd
+    k_seq = s if k_seq is None else k_seq
+    if s % BLOCK_Q or k_seq % BLOCK_K:
+        return False
+    if d % 128 and d != 64:
+        # lane dim must tile; 64 still packs efficiently as (8, 128)
+        return False
+    backend = backend or jax.default_backend()
+    return backend in _TPU_BACKENDS
 
 
-def _flash_vjp_bwd(res, g):
-    q, k, v, bias = res
-    _, vjp = jax.vjp(_reference, q, k, v, bias)
-    return vjp(g)
-
-
-_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
-
-
-def flash_attention_bshd(q, k, v, bias=None):
-    """q,k,v: (B, H, S, D); bias: broadcastable (B, 1|H, S, S) or None.
-    Returns (B, H, S, D).  Raises ValueError for shapes the kernel does not
-    tile (caller falls back to the jnp composition)."""
+def flash_attention_bshd(q, k, v, bias=None, dropout_rate=0.0, seed=None,
+                         interpret=False):
+    """q: (B, H, Sq, D), k/v: (B, H, Sk, D); bias: broadcastable
+    (B, 1|H, 1|Sq, Sk) or None; seed: int32 scalar/1-vector driving the
+    in-kernel dropout PRNG (required when dropout_rate > 0).
+    Returns (B, H, Sq, D).  Raises ValueError for shapes the kernel does
+    not tile — call supported() first."""
     b, h, s, d = q.shape
-    if s % BLOCK_Q or s % BLOCK_K:
-        raise ValueError(f"seq len {s} not a multiple of {BLOCK_Q}")
-    if d % 128 and d not in (64,):
-        # lane dim must tile; 64 is still efficient via (8,128) packing
-        raise ValueError(f"head dim {d} not supported")
-    if jax.default_backend() == "cpu":
-        raise ValueError("pallas TPU kernel unavailable on cpu backend")
+    sk = k.shape[2]
+    if not supported((b, h, s, d), k_seq=sk,
+                     backend="tpu" if interpret else None):
+        raise ValueError(
+            f"flash_attention: unsupported shape/backend (Sq={s} must "
+            f"tile {BLOCK_Q}, Sk={sk} must tile {BLOCK_K}, D={d} must be "
+            f"64 or a multiple of 128, backend must be TPU)")
+    if dropout_rate:
+        if seed is None:
+            raise ValueError("dropout_rate > 0 requires a seed")
+        if interpret:
+            # the interpreter stubs prng_random_bits to zeros, which
+            # would silently drop every element
+            raise ValueError(
+                "dropout requires the hardware PRNG — unavailable in "
+                "interpret mode")
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    seed = jnp.reshape(seed, (1,)).astype(jnp.int32)
     qf = q.reshape(b * h, s, d)
-    kf = k.reshape(b * h, s, d)
-    vf = v.reshape(b * h, s, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
     bf = None
     if bias is not None:
+        if bias.shape[2] == 1:                  # e.g. (B, 1, 1, Sk) mask
+            bias = jnp.broadcast_to(bias, bias.shape[:2] + (s, sk))
         if bias.shape[1] == 1:
-            bf = bias.reshape(b, s, s)          # head-shared mask
+            bf = bias.reshape(b, s, sk)         # head-shared mask
         else:
-            bf = jnp.broadcast_to(bias, (b, h, s, s)).reshape(b * h, s, s)
-    return _flash(qf, kf, vf, bf).reshape(b, h, s, d)
+            bf = jnp.broadcast_to(bias, (b, h, s, sk)).reshape(
+                b * h, s, sk)
+        bf = lax.stop_gradient(bf)
+    fn = _make_flash(float(dropout_rate), bf is not None, interpret)
+    return fn(qf, kf, vf, bf, seed).reshape(b, h, s, d)
