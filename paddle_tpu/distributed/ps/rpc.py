@@ -21,10 +21,48 @@ def _authkey() -> bytes:
     pickle, so authentication is the security boundary: a non-loopback
     server REQUIRES an explicit secret via PADDLE_TPU_PS_AUTHKEY (a fixed
     public key would hand remote code execution to anyone who can reach
-    the port); the well-known default is accepted for localhost only."""
+    the port).  For loopback jobs with no explicit secret, a random key is
+    generated once per user and persisted 0600 — localhost is not a trust
+    boundary between users on a shared host, so a well-known default is
+    never used."""
     import os
     key = os.environ.get("PADDLE_TPU_PS_AUTHKEY")
-    return key.encode() if key else b"paddle_tpu_ps_localhost"
+    if key:
+        return key.encode()
+    import secrets
+    import time
+    path = os.environ.get("PADDLE_TPU_PS_AUTHKEY_FILE") or os.path.join(
+        os.path.expanduser("~"), ".paddle_tpu", "ps_authkey")
+    for _ in range(50):
+        try:
+            with open(path, "rb") as f:
+                key = f.read()
+            if key:
+                return key
+            # a concurrent creator's rename hasn't landed yet (should be
+            # impossible with the atomic rename below, but never hand out
+            # an empty key)
+            time.sleep(0.02)
+            continue
+        except FileNotFoundError:
+            pass
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # write-then-rename so concurrent readers see either nothing or
+        # the full 32 bytes, never a partial key
+        tmp = f"{path}.{os.getpid()}.tmp"
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+        with os.fdopen(fd, "wb") as f:
+            f.write(secrets.token_bytes(32))
+        try:
+            # keep the first creator's key if one landed concurrently
+            os.link(tmp, path)
+        except FileExistsError:
+            pass
+        finally:
+            os.unlink(tmp)
+    raise RuntimeError(f"could not obtain PS authkey from {path}")
 
 
 class RPCServer:

@@ -85,11 +85,15 @@ def _ffn(x, cfg, name, is_test):
                      bias_attr=ParamAttr(name=f"{name}_fc1_b"))
 
 
-def _qkv(x, cfg, name):
+def _proj(x, cfg, name, slots):
     return [layers.fc(x, cfg.d_model, num_flatten_dims=2,
                       param_attr=_attr(f"{name}_{s}_w"),
                       bias_attr=ParamAttr(name=f"{name}_{s}_b"))
-            for s in ("q", "k", "v")]
+            for s in slots]
+
+
+def _qkv(x, cfg, name):
+    return _proj(x, cfg, name, ("q", "k", "v"))
 
 
 def _post(x, residual, cfg, name, is_test):
@@ -103,9 +107,11 @@ def _post(x, residual, cfg, name, is_test):
 def _mha(q_in, kv_in, bias, cfg, name, is_test):
     # causality lives in the additive bias (see _attn_bias), so the fused
     # attention op needs no causal flag
-    q, k, v = _qkv(q_in, cfg, name)
     if kv_in is not q_in:   # cross attention reads encoder output
-        _, k, v = _qkv(kv_in, cfg, name + "_kv")
+        q, = _proj(q_in, cfg, name, ("q",))
+        k, v = _proj(kv_in, cfg, name + "_kv", ("k", "v"))
+    else:
+        q, k, v = _qkv(q_in, cfg, name)
     ctx = fused_attention(q, k, v, bias, cfg.n_head,
                           cfg.dropout, is_test, name=name)
     out = layers.fc(ctx, cfg.d_model, num_flatten_dims=2,
@@ -181,7 +187,7 @@ def build_train_network(cfg: TransformerConfig, is_test=False):
     return feeds, loss, logits
 
 
-def make_batch(src_seqs, trg_seqs, cfg, bos=1, pad=0):
+def make_batch(src_seqs, trg_seqs, cfg, bos=1, pad=0, eos=2):
     """Host-side ragged → padded feeds (the LoD→dense conversion)."""
     B, S = len(src_seqs), cfg.max_length
     f = {k: np.zeros((B, S), np.int64) for k in
@@ -197,7 +203,10 @@ def make_batch(src_seqs, trg_seqs, cfg, bos=1, pad=0):
         f["trg_ids"][i, :len(dec_in)] = dec_in
         f["trg_pos"][i, :len(dec_in)] = np.arange(len(dec_in))
         f["trg_mask"][i, :len(dec_in)] = 1.0
-        f["labels"][i, :len(t) + 1] = t + [pad]   # shifted; last = pad/eos
+        # shifted; the final supervised target is EOS (what greedy_decode
+        # stops on), never pad — pad==bos in wmt16, and training the model
+        # to emit it after every sequence would corrupt decoding
+        f["labels"][i, :len(t) + 1] = t + [eos]
     return f
 
 
@@ -209,7 +218,7 @@ def greedy_decode(exe, program, logits_var, cfg, src_seqs, max_out=16,
     outs = [[] for _ in src_seqs]
     for _ in range(max_out):
         feeds = make_batch(src_seqs, [o + [eos] for o in outs], cfg,
-                           bos=bos)
+                           bos=bos, eos=eos)
         lg, = exe.run(program, feed=feeds, fetch_list=[logits_var])
         for i, o in enumerate(outs):
             if o and o[-1] == eos:

@@ -186,6 +186,23 @@ def _local_sgd_sync(ctx, ins, attrs):
     step = x(ins, "Step").reshape(()).astype(jnp.float32)
     params = tuple(ins.get("Params", []))
     axis = _ring_axis(ctx, attrs)
+    if axis is None and ctx.axis_names:
+        # the configured axis name is not in this mesh (e.g. the mesh
+        # calls its data axis "data", not "dp") — replicas would silently
+        # never synchronize.  On a single-axis mesh that axis must be the
+        # data axis, so fall back to it (matching the grad-allreduce
+        # batch-axis fallback, compiler.py with_data_parallel).  On a
+        # multi-axis mesh guessing could average tensor-parallel SHARDS
+        # (different slices, not replicas) and destroy the model — refuse
+        # loudly instead.
+        if len(ctx.axis_names) == 1:
+            axis = ctx.axis_names[0]
+        else:
+            raise ValueError(
+                f"local_sgd_sync: configured axis "
+                f"{attrs.get('_axis_name')!r} is not in the mesh axes "
+                f"{ctx.axis_names}; pass axis_name=<your data axis> to "
+                f"LocalSGDOptimizer")
     if axis is None or not params:
         return {"Out": list(params)}
     k = float(attrs.get("k_steps", 1))

@@ -91,6 +91,7 @@ def _ps_send(ctx, ins, attrs):
                              opt_descs={n: opt_descs.get(n, {})
                                         for n in mine})
             _initialized.add(ep)
+    remaining = dict(by_ep)
     if attrs.get("mode") in ("async", "half_async"):
         from ..distributed.ps.communicator import Communicator
         comm = Communicator._global
@@ -99,11 +100,14 @@ def _ps_send(ctx, ins, attrs):
                 raise RuntimeError(
                     "async communicator failed") from comm.error
             # non-blocking enqueue; put() returning False (stopped
-            # concurrently) falls through to the direct push below
-            if all(comm.put(ep, payload)
-                   for ep, payload in by_ep.items()):
+            # concurrently) leaves that endpoint for the direct push
+            # below — endpoints already enqueued must NOT be re-pushed,
+            # Communicator.stop() flushes their queued copy
+            remaining = {ep: payload for ep, payload in by_ep.items()
+                         if not comm.put(ep, payload)}
+            if not remaining:
                 return {}
-    for ep, payload in by_ep.items():
+    for ep, payload in remaining.items():
         version = _client(ep).call("push_dense", trainer_id=trainer_id,
                                    grads=payload)
         _state().versions[ep] = version

@@ -109,6 +109,40 @@ def test_fleet_localsgd_on_mesh():
     assert losses[-1] < losses[0]
 
 
+def test_fleet_localsgd_foreign_axis_name_still_syncs():
+    """A mesh whose data axis is NOT named "dp" must still synchronize
+    replicas — local_sgd_sync falls back to the first mesh axis rather
+    than silently skipping the averaging (which would let replicas
+    diverge with no error)."""
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 8).astype(np.float32)
+    ys = (xs.sum(1) > 0).astype(np.int64).reshape(-1, 1)
+
+    def run(axis_name):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            loss = _model()
+            fleet.init(UserDefinedRoleMaker(0, 1))
+            strategy = DistributedStrategy()
+            strategy.localsgd = True
+            strategy.localsgd_configs = {"k_steps": 1}
+            strategy.mesh = Mesh(np.array(jax.devices()[:4]), (axis_name,))
+            opt = distributed_optimizer(fluid.optimizer.SGD(0.1), strategy)
+            opt.minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            out = []
+            for _ in range(6):
+                l, = exe.run(fleet.main_program,
+                             feed={"x": xs, "label": ys},
+                             fetch_list=[loss])
+                out.append(float(l))
+        return out
+
+    np.testing.assert_allclose(run("dp"), run("data"), rtol=1e-6)
+
+
 def test_fleet_dgc_swap():
     """strategy.use_dgc swaps Momentum for DGCMomentum
     (ref: incubate/fleet/collective/__init__.py:478)."""
