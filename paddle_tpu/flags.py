@@ -34,6 +34,10 @@ def _register(name: str, default, noop: bool = False):
 
 # live flags (consulted by the framework)
 _register("check_nan_inf", False)          # ref: platform/flags.cc:44
+# per-op localization: run ops eagerly and name the op that produced the
+# first NaN/Inf (ref: framework/details/nan_inf_utils.h pinpoints the op);
+# slower — debug only
+_register("check_nan_inf_per_op", False)
 _register("use_flash_attention", True)     # pallas kernel gate (TPU-new)
 _register("benchmark", False)              # ref: flags.cc benchmark
 _register("print_executor_cache_hits", False)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import contextlib
 import copy
+import itertools
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -337,11 +338,23 @@ class Program:
     (parameter initialisation) — see ``default_main_program()``.
     """
 
+    _uid_counter = itertools.count()
+
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self.current_block_idx = 0
         self.random_seed = 0
         self._version = 0          # bumped on mutation; keys executor caches
+        # monotonic identity for executor caches: id() can be reused by a
+        # new Program after this one is GC'd, which would serve a stale
+        # executable
+        self._uid = next(Program._uid_counter)
+
+    def __setstate__(self, state):
+        # unpickled programs get a fresh cache identity — the serialized
+        # uid may collide with a live program's
+        self.__dict__.update(state)
+        self._uid = next(Program._uid_counter)
         self._is_test = False
         # distributed annotations filled by parallel/ transforms
         self._mesh = None
@@ -385,6 +398,7 @@ class Program:
         p.current_block_idx = self.current_block_idx
         p.random_seed = self.random_seed
         p._version = 0
+        p._uid = next(Program._uid_counter)
         p._is_test = for_test or self._is_test
         p._mesh = self._mesh
         p._dist_attrs = dict(self._dist_attrs)

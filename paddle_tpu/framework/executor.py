@@ -258,6 +258,14 @@ def _fetch_names(fetch_list):
             for f in fetch_list]
 
 
+def _mesh_identity(mesh):
+    """Content-based mesh cache key — id(mesh) can be reused after GC."""
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names), mesh.devices.shape,
+            tuple(d.id for d in mesh.devices.flat))
+
+
 class Executor:
     """User-facing executor (ref: python executor.py:896 Executor.run)."""
 
@@ -309,7 +317,7 @@ class Executor:
                     oldest = next(iter(variants))
                     stale = variants.pop(oldest)
                     self._cache = {k: v for k, v in self._cache.items()
-                                   if k[0] != id(stale)}
+                                   if k[0] != stale._uid}
                 variants[vkey] = clone
             program = variants[vkey]
         feed = {k: np.asarray(v) if not hasattr(v, "dtype") else v
@@ -337,7 +345,13 @@ class Executor:
         feed_vals = {k: feed[k] for k in step.feed_names}
         from ..flags import flag
         with RecordEvent("executor::run"):
-            fetches, state_out, new_key = step.fn(feed_vals, state_in, key)
+            if flag("check_nan_inf") and flag("check_nan_inf_per_op") \
+                    and mesh is None:
+                fetches, state_out, new_key = self._run_per_op_debug(
+                    program, step, feed_vals, state_in, key, fetch_names)
+            else:
+                fetches, state_out, new_key = step.fn(feed_vals, state_in,
+                                                      key)
             if flag("benchmark"):
                 # ref: FLAGS_benchmark forces a device sync per run so
                 # wall-clock timing is accurate
@@ -357,6 +371,58 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    def _run_per_op_debug(self, program, step, feed_vals, state_in, key,
+                          fetch_names):
+        """Eager op-by-op execution that names the op producing the first
+        NaN/Inf (FLAGS_check_nan_inf_per_op) — the analog of the
+        reference's per-op scan (ref: framework/details/nan_inf_utils.h);
+        here the production step is one fused XLA program, so localization
+        runs the ops un-jitted instead.  Backward is one meta-op, so a
+        NaN born inside autodiff is attributed at backward granularity."""
+        block = program.global_block()
+        ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+        bw_idx = next((i for i, op in enumerate(ops)
+                       if op.type == "backward"), None)
+        ctx = LoweringContext(key, None, (), program._is_test)
+        env = dict(state_in)
+        env.update(feed_vals)
+
+        def check(op, names_vals):
+            for n, v in names_vals:
+                a = np.asarray(v)
+                if np.issubdtype(a.dtype, np.floating) and \
+                        not np.isfinite(a).all():
+                    raise RuntimeError(
+                        f"Operator {op.type!r} output {n!r} contains "
+                        f"NaN/Inf (FLAGS_check_nan_inf per-op mode; ref: "
+                        f"nan_inf_utils_detail PrintNanInf)")
+
+        def run_one(op):
+            impl = get_op(op.type)
+            outs = impl(ctx, _gather_inputs(op, env), op.attrs)
+            _scatter_outputs(op, outs, env)
+            check(op, [(n, env[n]) for n in op.output_names()
+                       if n in env])
+
+        fwd_end = bw_idx if bw_idx is not None else len(ops)
+        for op in ops[:fwd_end]:
+            run_one(op)
+        if bw_idx is not None:
+            bw_op = ops[bw_idx]
+            env2 = lower_block_with_backward(
+                ops[:bw_idx + 1], dict(env), ctx, bw_idx, fetch_names,
+                step.state_out_names)
+            grad_checks = [(grad_var_name(n), env2[grad_var_name(n)])
+                           for n in bw_op.attrs["param_names"]
+                           if grad_var_name(n) in env2]
+            check(bw_op, grad_checks)
+            env = env2
+            for op in ops[bw_idx + 1:]:
+                run_one(op)
+        fetches = [np.asarray(env[n]) for n in fetch_names]
+        state_out = {n: env[n] for n in step.state_out_names if n in env}
+        return fetches, state_out, ctx.key
 
     @staticmethod
     def _check_nan_inf(fetch_names, fetches, state_out):
@@ -426,8 +492,9 @@ class Executor:
                  batch_axis, seq_axis=None, feed_specs=None):
         from ..flags import flag
         # flags consulted at trace time are part of the executable identity
-        key = (id(program), program._version, self._feed_signature(feed),
-               tuple(fetch_names), id(mesh), flag("use_flash_attention"))
+        key = (program._uid, program._version, self._feed_signature(feed),
+               tuple(fetch_names), _mesh_identity(mesh),
+               flag("use_flash_attention"))
         if key in self._cache:
             if flag("print_executor_cache_hits"):
                 print(f"executor cache hit: program v{program._version}")

@@ -100,6 +100,52 @@ def test_check_nan_inf_raises_with_var_name():
         set_flags({"FLAGS_check_nan_inf": False})
 
 
+def test_check_nan_inf_per_op_pinpoints_op():
+    """Per-op debug mode names the producing op, like the reference's
+    per-op scan (framework/details/nan_inf_utils.h) — the coarse post-step
+    scan only names the observable output var."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2])
+        y = fluid.layers.log(x)        # log(-1) = nan, mid-graph
+        z = fluid.layers.exp(y)
+        out = fluid.layers.mean(z)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    set_flags({"FLAGS_check_nan_inf": True,
+               "FLAGS_check_nan_inf_per_op": True})
+    try:
+        with pytest.raises(RuntimeError, match="'log'"):
+            exe.run(main, feed={"x": -np.ones((2, 2), np.float32)},
+                    fetch_list=[out])
+        # healthy input passes and still computes the right thing
+        r, = exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                     fetch_list=[out])
+        np.testing.assert_allclose(r, 1.0, rtol=1e-6)
+    finally:
+        set_flags({"FLAGS_check_nan_inf": False,
+                   "FLAGS_check_nan_inf_per_op": False})
+
+
+def test_check_nan_inf_per_op_training_step():
+    """Per-op mode also runs full training steps (backward meta-op +
+    update ops) and matches the compiled path's results when healthy."""
+    main, startup, loss = _step_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xs = rng.rand(4, 4).astype(np.float32)
+    set_flags({"FLAGS_check_nan_inf": True,
+               "FLAGS_check_nan_inf_per_op": True})
+    try:
+        l1, = exe.run(main, feed={"x": xs}, fetch_list=[loss])
+        l2, = exe.run(main, feed={"x": xs}, fetch_list=[loss])
+        assert np.isfinite(l1).all() and float(l2) < float(l1)
+    finally:
+        set_flags({"FLAGS_check_nan_inf": False,
+                   "FLAGS_check_nan_inf_per_op": False})
+
+
 def test_monitor_counters():
     monitor.reset_all()
     main, startup, loss = _step_program()
