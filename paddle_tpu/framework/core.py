@@ -349,16 +349,20 @@ class Program:
         # new Program after this one is GC'd, which would serve a stale
         # executable
         self._uid = next(Program._uid_counter)
+        self._is_test = False
+        # distributed annotations filled by parallel/ transforms
+        self._mesh = None
+        self._dist_attrs: Dict[str, Any] = {}
 
     def __setstate__(self, state):
         # unpickled programs get a fresh cache identity — the serialized
         # uid may collide with a live program's
         self.__dict__.update(state)
         self._uid = next(Program._uid_counter)
-        self._is_test = False
-        # distributed annotations filled by parallel/ transforms
-        self._mesh = None
-        self._dist_attrs: Dict[str, Any] = {}
+        # programs pickled before these fields existed
+        self.__dict__.setdefault('_is_test', False)
+        self.__dict__.setdefault('_mesh', None)
+        self.__dict__.setdefault('_dist_attrs', {})
 
     # -- structure -------------------------------------------------------
     def global_block(self) -> Block:

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Pre-snapshot gate: run before EVERY end-of-round / milestone commit.
+# Aborts (non-zero exit) unless the full suite is green AND the multichip
+# dryrun compiles+executes. Usage:  bash tools/preflight.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== preflight: pytest =="
+python -m pytest tests/ -q -x
+
+echo "== preflight: dryrun_multichip(8) =="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "== preflight: entry() compile-check =="
+python - <<'EOF'
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+import __graft_entry__ as g
+fn, args = g.entry()
+out = jax.jit(fn).lower(*args).compile()
+print("entry() compiles OK")
+EOF
+
+echo "PREFLIGHT OK"
