@@ -25,6 +25,17 @@ from typing import Optional
 import numpy as np
 
 
+def _distributed_client_active():
+    """Whether jax.distributed.initialize has already run in this process
+    (e.g. by the launcher, which must call it before the framework import
+    touches the backend)."""
+    try:
+        from jax._src import distributed as _dist
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
 # ---------------------------------------------------------------------------
 # role makers (ref: incubate/fleet/base/role_maker.py)
 # ---------------------------------------------------------------------------
@@ -73,10 +84,18 @@ class TPURoleMaker(RoleMakerBase):
         if self._generated:
             return
         import jax
-        if self._coordinator:
-            jax.distributed.initialize(self._coordinator,
-                                       self._num_processes,
-                                       self._process_id)
+        if self._coordinator and not _distributed_client_active():
+            # must happen before any backend-initialising jax call; callers
+            # that import the framework first should initialize
+            # jax.distributed themselves (launcher contract)
+            try:
+                jax.distributed.initialize(self._coordinator,
+                                           self._num_processes,
+                                           self._process_id)
+            except RuntimeError:
+                # already initialized (the active-client probe uses a
+                # private jax API and may misreport across jax versions)
+                pass
         self._worker_index = jax.process_index()
         self._worker_num = jax.process_count()
         self._generated = True

@@ -244,13 +244,61 @@ def _replicated_var_names(ops, bw_idx):
 
 class _CompiledStep:
     def __init__(self, fn, state_in_names, state_out_names, feed_names,
-                 fetch_names, raw_fn=None):
+                 fetch_names, raw_fn=None, mesh=None, feed_spec_fn=None,
+                 state_in_specs=None):
         self.fn = fn                 # jitted, donating state buffers
         self.raw_fn = raw_fn or fn   # unjitted pure step (for export)
         self.state_in_names = state_in_names
         self.state_out_names = state_out_names
         self.feed_names = feed_names
         self.fetch_names = fetch_names
+        # multi-process metadata: sharding specs for lifting process-local
+        # feeds/state to global jax.Arrays when the mesh spans hosts
+        self.mesh = mesh
+        self.feed_spec_fn = feed_spec_fn
+        self.state_in_specs = state_in_specs or {}
+
+
+def _mesh_spans_processes(mesh):
+    """True when the mesh contains devices owned by other processes — the
+    multi-host (DCN) regime where inputs must be global jax.Arrays (the
+    analog of the reference's num_trainers>1 NCCL comm spanning processes,
+    ref: parallel_executor.cc:536)."""
+    if mesh is None:
+        return False
+    pi = jax.process_index()
+    return any(d.process_index != pi for d in mesh.devices.flat)
+
+
+def _to_global(mesh, spec, value, local_shard=False):
+    """Lift a value to a global array on a multi-process mesh.
+
+    ``local_shard=True`` (feeds): each process passes only ITS slice of
+    any sharded dim — the multi-host data-parallel input contract.
+    ``local_shard=False`` (state/rng): every process holds the FULL value
+    (the startup program runs replicated on each host), so the value is
+    placed with global semantics — XLA keeps only this host's shards.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if spec is None:
+        spec = P()
+    if isinstance(value, jax.Array) and \
+            isinstance(value.sharding, NamedSharding) and \
+            value.sharding.mesh == mesh:
+        return value
+    sh = NamedSharding(mesh, spec)
+    if local_shard:
+        return jax.make_array_from_process_local_data(sh, np.asarray(value))
+    return jax.device_put(np.asarray(value), sh)
+
+
+def _fetch_numpy(x):
+    """np.asarray for fetches that works on multi-process (not fully
+    addressable) arrays — fetches are replicated, so any local shard is
+    the full value."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        return np.asarray(x.addressable_data(0))
+    return np.asarray(x)
 
 
 def _fetch_names(fetch_list):
@@ -343,6 +391,17 @@ class Executor:
             key = jax.random.PRNGKey(program.random_seed)
 
         feed_vals = {k: feed[k] for k in step.feed_names}
+        if _mesh_spans_processes(mesh):
+            # multi-host regime (ref: num_trainers>1): each process feeds
+            # its LOCAL batch shard; lift everything to global jax.Arrays
+            from jax.sharding import PartitionSpec as P
+            feed_vals = {k: _to_global(mesh, step.feed_spec_fn(k), v,
+                                       local_shard=True)
+                         for k, v in feed_vals.items()}
+            state_in = {n: _to_global(mesh, step.state_in_specs.get(n, P()),
+                                      v)
+                        for n, v in state_in.items()}
+            key = _to_global(mesh, P(), key)
         from ..flags import flag
         with RecordEvent("executor::run"):
             if flag("check_nan_inf") and flag("check_nan_inf_per_op") \
@@ -369,7 +428,7 @@ class Executor:
             self._check_nan_inf(fetch_names, fetches, state_out)
 
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            return [_fetch_numpy(f) for f in fetches]
         return list(fetches)
 
     def _run_per_op_debug(self, program, step, feed_vals, state_in, key,
@@ -428,10 +487,18 @@ class Executor:
     def _check_nan_inf(fetch_names, fetches, state_out):
         bad = []
         for n, v in list(zip(fetch_names, fetches)) + list(state_out.items()):
-            a = np.asarray(v)
-            if np.issubdtype(a.dtype, np.floating) and \
-                    not np.isfinite(a).all():
-                bad.append(n)
+            if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                # multi-host array: scan the shards this process owns
+                # (every shard is owned by SOME process, so a NaN anywhere
+                # raises on its owner)
+                arrs = [np.asarray(s.data) for s in v.addressable_shards]
+            else:
+                arrs = [np.asarray(v)]
+            for a in arrs:
+                if np.issubdtype(a.dtype, np.floating) and \
+                        not np.isfinite(a).all():
+                    bad.append(n)
+                    break
         if bad:
             raise RuntimeError(
                 f"Operator output contains NaN/Inf (FLAGS_check_nan_inf): "
@@ -593,15 +660,20 @@ class Executor:
                     "PS host ops with a device mesh in one program are "
                     "unsupported; PS data-parallelism is multi-process")
             fn = step
-        elif mesh is not None:
-            fn = self._wrap_sharded(step, mesh, axis_names, batch_axis,
-                                    program, feed_names, state_in_names,
-                                    state_out_names, feed_specs or {})
-        else:
-            fn = jax.jit(step, donate_argnums=(1,))
+        feed_spec_fn = None
+        state_in_specs = None
+        if not host_idxs:
+            if mesh is not None:
+                fn, feed_spec_fn, state_in_specs = self._wrap_sharded(
+                    step, mesh, axis_names, batch_axis, program, feed_names,
+                    state_in_names, state_out_names, feed_specs or {})
+            else:
+                fn = jax.jit(step, donate_argnums=(1,))
 
         compiled = _CompiledStep(fn, state_in_names, state_out_names,
-                                 feed_names, fetch_names, raw_fn=step)
+                                 feed_names, fetch_names, raw_fn=step,
+                                 mesh=mesh, feed_spec_fn=feed_spec_fn,
+                                 state_in_specs=state_in_specs)
         self._cache[key] = compiled
         return compiled
 
@@ -648,7 +720,7 @@ class Executor:
                                check_vma=False)
             return fn(feed_vals, state_vals, rng_key)
 
-        return jax.jit(sharded, donate_argnums=(1,))
+        return jax.jit(sharded, donate_argnums=(1,)), feed_spec, state_in_specs
 
     def close(self):
         self._cache.clear()

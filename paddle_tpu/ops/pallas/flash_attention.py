@@ -44,16 +44,18 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
-def _dropout_mask(seed_ref, b, qi, kj, shape, rate):
-    """Regenerable keep-mask: seeded per (head, q-block, k-block)."""
-    pltpu.prng_seed(seed_ref[0], b, qi, kj)
+def _dropout_mask(seed_ref, block_idx, shape, rate):
+    """Regenerable keep-mask, seeded per (head, q-block, k-block).
+    ``block_idx`` is the injective linear index (b*num_q + qi)*num_k + kj —
+    hardware prng_seed takes at most 2 seed words."""
+    pltpu.prng_seed(seed_ref[0], block_idx)
     bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
     threshold = np.uint32(min(int(rate * 2**32), 2**32 - 1))
     return bits >= threshold           # P(keep) = 1 - rate
 
 
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *,
-                scale, num_k_blocks, has_bias, rate):
+                scale, num_q_blocks, num_k_blocks, has_bias, rate):
     b = pl.program_id(0)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)           # (BQ, D)
@@ -78,7 +80,8 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *,
         # the mask applies to the numerator only, so acc/l == dropout(P)@V
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         if rate:
-            keep = _dropout_mask(seed_ref, b, qi, j, p.shape, rate)
+            idx = (b * num_q_blocks + qi) * num_k_blocks + j
+            keep = _dropout_mask(seed_ref, idx, p.shape, rate)
             p = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
         acc_new = acc * alpha + lax.dot_general(
             p.astype(vs.dtype), vs, (((1,), (0,)), ((), ())),
@@ -88,20 +91,22 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *,
     acc, m, l = lax.fori_loop(0, num_k_blocks, body, (acc, m, l))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     # rows with no unmasked keys (l == 0) store +inf so the backward's
-    # exp(s - lse) is exactly 0 there, not inf
-    lse_ref[0] = jnp.where(l[:, 0] > 0, m[:, 0] + jnp.log(l[:, 0]),
+    # exp(s - lse) is exactly 0 there, not inf.  Row stats live as
+    # (rows, 1) columns: TPU tiling requires block dim -2 divisible by 8,
+    # so a (BQ, 1) block over a (Sq, 1) array is legal where (1, BQ) is not.
+    lse_ref[0] = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)),
                            jnp.inf)
 
 
 def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, *, scale, num_k_blocks, has_bias,
-                   rate):
+                   delta_ref, dq_ref, *, scale, num_q_blocks, num_k_blocks,
+                   has_bias, rate):
     b = pl.program_id(0)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)           # (BQ, D)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]                  # (BQ, 1)
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0]                           # (BQ, 1)
+    delta = delta_ref[0]
     acc = jnp.zeros(q.shape, jnp.float32)
 
     def body(j, acc):
@@ -116,7 +121,8 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
         dp = lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         if rate:
-            keep = _dropout_mask(seed_ref, b, qi, j, p.shape, rate)
+            idx = (b * num_q_blocks + qi) * num_k_blocks + j
+            keep = _dropout_mask(seed_ref, idx, p.shape, rate)
             dp = jnp.where(keep, dp * (1.0 / (1.0 - rate)), 0.0)
         ds = p * (dp - delta)
         return acc + lax.dot_general(ds, ks, (((1,), (0,)), ((), ())),
@@ -128,7 +134,7 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
 
 def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, *, scale, num_q_blocks,
-                    has_bias, rate):
+                    num_k_blocks, has_bias, rate):
     b = pl.program_id(0)
     kj = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)           # (BK, D)
@@ -140,8 +146,8 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
         dk, dv = carry
         qs = q_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
         dos = do_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
-        delta = delta_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
+        lse = lse_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :]     # (BQ, 1)
+        delta = delta_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :]
         s = lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         if has_bias:
@@ -151,7 +157,8 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
         dp = lax.dot_general(dos, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         if rate:
-            keep = _dropout_mask(seed_ref, b, i, kj, p.shape, rate)
+            idx = (b * num_q_blocks + i) * num_k_blocks + kj
+            keep = _dropout_mask(seed_ref, idx, p.shape, rate)
             inv = 1.0 / (1.0 - rate)
             pd = jnp.where(keep, p * inv, 0.0)
             dp = jnp.where(keep, dp * inv, 0.0)
@@ -205,8 +212,9 @@ def _flash_fwd(q, k, v, bias, seed, rate, interpret):
                           memory_space=pltpu.VMEM)
     bspec, barg = _bias_specs(bh, sq, sk, bias, BLOCK_Q)
 
-    kernel = functools.partial(_fwd_kernel, scale=scale, num_k_blocks=num_k,
-                               has_bias=has_bias, rate=rate)
+    kernel = functools.partial(_fwd_kernel, scale=scale, num_q_blocks=num_q,
+                               num_k_blocks=num_k, has_bias=has_bias,
+                               rate=rate)
     flops = 4 * bh * sq * sk * d
     return pl.pallas_call(
         kernel,
@@ -214,10 +222,10 @@ def _flash_fwd(q, k, v, bias, seed, rate, interpret):
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
                   qspec, kvspec, kvspec, bspec],
         out_specs=[qspec,
-                   pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i),
+                   pl.BlockSpec((1, BLOCK_Q, 1), lambda b, i: (b, i, 0),
                                 memory_space=pltpu.VMEM)],
         out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-                   jax.ShapeDtypeStruct((bh, sq), jnp.float32)],
+                   jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32)],
         cost_estimate=pl.CostEstimate(
             flops=flops, bytes_accessed=q.size * 4 * 3,
             transcendentals=bh * sq * sk),
@@ -233,7 +241,7 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, g, rate, interpret):
     scale = 1.0 / math.sqrt(d)
     has_bias = bias is not None
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                        # (BH, Sq)
+                    axis=-1, keepdims=True)         # (BH, Sq, 1)
 
     qblk = pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0),
                         memory_space=pltpu.VMEM)
@@ -243,9 +251,9 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, g, rate, interpret):
                          memory_space=pltpu.VMEM)
     qfull = pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0),
                          memory_space=pltpu.VMEM)
-    rowq = pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i),
+    rowq = pl.BlockSpec((1, BLOCK_Q, 1), lambda b, i: (b, i, 0),
                         memory_space=pltpu.VMEM)
-    rowfull = pl.BlockSpec((1, sq), lambda b, i: (b, 0),
+    rowfull = pl.BlockSpec((1, sq, 1), lambda b, i: (b, 0, 0),
                            memory_space=pltpu.VMEM)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
 
@@ -253,8 +261,8 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, g, rate, interpret):
     flops = 4 * bh * sq * sk * d
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, num_k_blocks=num_k,
-                          has_bias=has_bias, rate=rate),
+        functools.partial(_bwd_dq_kernel, scale=scale, num_q_blocks=num_q,
+                          num_k_blocks=num_k, has_bias=has_bias, rate=rate),
         grid=(bh, num_q),
         in_specs=[smem, qblk, kfull, kfull, bspec_q, qblk, rowq, rowq],
         out_specs=qblk,
@@ -268,7 +276,7 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, g, rate, interpret):
     bspec_t, barg_t = _bias_specs(bh, sq, sk, bias, BLOCK_K, transpose=True)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, num_q_blocks=num_q,
-                          has_bias=has_bias, rate=rate),
+                          num_k_blocks=num_k, has_bias=has_bias, rate=rate),
         grid=(bh, num_k),
         in_specs=[smem, qfull, kblk, kblk, bspec_t, qfull, rowfull,
                   rowfull],

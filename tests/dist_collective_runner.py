@@ -1,0 +1,104 @@
+"""Worker script for the multi-process collective DP test — the analog of
+the reference's dist_mnist.py trainer side (ref: test_dist_base.py:506,
+test_collective_base.py:34): each process owns a slice of the devices,
+feeds its LOCAL shard of a deterministic global batch, and trains through
+the fleet collective path (jax.distributed over the DCN tier).
+
+Launched by tests/test_dist_collective.py via
+paddle_tpu.distributed.launch with JAX_COORDINATOR_ADDRESS /
+JAX_NUM_PROCESSES / JAX_PROCESS_ID wired.
+"""
+
+import json
+import os
+import sys
+
+if __name__ == "__main__":
+    # each worker process owns 2 virtual CPU devices → dp4 over 2 processes
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    import jax
+
+    # launcher contract: jax.distributed BEFORE any backend-initialising
+    # call (importing the framework touches the backend)
+    jax.distributed.initialize(os.environ["JAX_COORDINATOR_ADDRESS"],
+                               int(os.environ["JAX_NUM_PROCESSES"]),
+                               int(os.environ["JAX_PROCESS_ID"]))
+
+import numpy as np  # noqa: E402
+
+
+def build_model():
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu",
+                            param_attr=fluid.ParamAttr(
+                                name="w1",
+                                initializer=fluid.initializer.Constant(0.05)),
+                            bias_attr=False)
+        pred = fluid.layers.fc(h, 4, act="softmax",
+                               param_attr=fluid.ParamAttr(
+                                   name="w2",
+                                   initializer=fluid.initializer.Constant(
+                                       0.05)),
+                               bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    return main, startup, loss
+
+
+def global_batches(steps=5, n=64):
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(steps):
+        xs = rng.randn(n, 16).astype(np.float32)
+        ys = (xs.sum(1) > 0).astype(np.int64).reshape(-1, 1) * 3
+        out.append((xs, ys))
+    return out
+
+
+def main():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed import fleet as fleet_mod
+    fleet = fleet_mod.fleet
+
+    rm = fleet_mod.TPURoleMaker(
+        coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+        num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+        process_id=int(os.environ["JAX_PROCESS_ID"]))
+    fleet.init(rm)
+    pid, nproc = fleet.worker_index(), fleet.worker_num()
+
+    import jax
+    ndev = jax.device_count()
+    assert jax.process_count() == nproc, (jax.process_count(), nproc)
+
+    main_prog, startup, loss = build_model()
+    with fluid.program_guard(main_prog, startup):
+        opt = fleet_mod.distributed_optimizer(
+            fluid.optimizer.SGD(0.2), fleet_mod.DistributedStrategy())
+        opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    losses = []
+    for xs, ys in global_batches():
+        # this process feeds its contiguous 1/nproc slice of the batch
+        shard = len(xs) // nproc
+        lo = pid * shard
+        l, = exe.run(fleet.main_program,
+                     feed={"x": xs[lo:lo + shard],
+                           "label": ys[lo:lo + shard]},
+                     fetch_list=[loss])
+        losses.append(float(l))
+    print(f"DIST_LOSSES {json.dumps({'pid': pid, 'ndev': ndev, 'losses': losses})}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,13 +26,31 @@ def main():
     mask = (rng.rand(B, 1, 1, S) > 0.2).astype(np.float32)
     bias = jnp.asarray((1 - mask) * -1e9) * jnp.ones((1, 1, S, 1))
 
-    # 1. forward vs jnp reference on-chip
+    def truth_f64(q, k, v, bias):
+        """numpy float64 ground truth (TPU matmuls multiply at bf16 by
+        default, so on-chip tensors are only trustworthy to ~2^-8 rel)."""
+        qn = np.asarray(q, np.float64).reshape(B * H, S, D)
+        kn = np.asarray(k, np.float64).reshape(B * H, S, D)
+        vn = np.asarray(v, np.float64).reshape(B * H, S, D)
+        bn = np.repeat(np.asarray(bias, np.float64).reshape(B, 1, S, S),
+                       H, 1).reshape(B * H, S, S)
+        s = np.einsum("bsd,btd->bst", qn, kn) / np.sqrt(D) + bn
+        s -= s.max(-1, keepdims=True)
+        p = np.exp(s)
+        p /= p.sum(-1, keepdims=True)
+        return np.einsum("bst,btd->bsd", p, vn)
+
+    # 1. forward: kernel must track f64 ground truth as well as XLA's own
+    # native (default-precision) computation does
     out = fa.flash_attention_bshd(q, k, v, bias)
     ref = fa._reference(q.reshape(B * H, S, D), k.reshape(B * H, S, D),
                         v.reshape(B * H, S, D), bias.reshape(B, S, S))
-    err = float(jnp.max(jnp.abs(out.reshape(B * H, S, D) - ref)))
-    print(f"fwd vs reference max err: {err:.2e}")
-    assert err < 2e-4, err
+    gold = truth_f64(q, k, v, bias)
+    err_k = float(np.max(np.abs(np.asarray(out.reshape(B * H, S, D),
+                                           np.float64) - gold)))
+    err_r = float(np.max(np.abs(np.asarray(ref, np.float64) - gold)))
+    print(f"fwd max err vs f64 truth: kernel {err_k:.2e}, jnp ref {err_r:.2e}")
+    assert err_k < max(5e-3, 4 * err_r), (err_k, err_r)
 
     # 2. backward kernels vs jax.grad of the reference
     def ref_loss(q, k, v):
@@ -47,9 +65,11 @@ def main():
     g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
     g_ker = jax.grad(ker_loss, argnums=(0, 1, 2))(q, k, v)
     for name, a, b in zip("qkv", g_ref, g_ker):
-        e = float(jnp.max(jnp.abs(a - b)))
-        print(f"d{name} max err: {e:.2e}")
-        assert e < 5e-4, (name, e)
+        # both sides run bf16 MXU passes, so compare at matmul precision:
+        # max abs err relative to the gradient's scale
+        e = float(jnp.max(jnp.abs(a - b)) / jnp.max(jnp.abs(a)))
+        print(f"d{name} max rel err: {e:.2e}")
+        assert e < 2e-2, (name, e)
 
     # 3. dropout: determinism, keep-rate, mean-preservation, and
     #    fwd/bwd mask agreement via directional finite difference
@@ -70,23 +90,60 @@ def main():
     print(f"E[dropout out] vs clean rel err: {rel:.3f}")
     assert rel < 0.15, rel
 
-    def dloss(q, k, v):
+    # 4. fwd/bwd mask agreement + dropout calculus, checked EXACTLY:
+    # regenerate the hardware PRNG keep-mask with a one-op Pallas kernel
+    # (same _dropout_mask, same linear block index), then compare the
+    # flash kernel against a jnp reference that applies that explicit
+    # mask — jax.grad of the reference gives ground-truth gradients.
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, nq, nk = B * H, S // fa.BLOCK_Q, S // fa.BLOCK_K
+
+    def mask_kernel(seed_ref, m_ref):
+        b, qi, kj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+        idx = (b * nq + qi) * nk + kj
+        keep = fa._dropout_mask(seed_ref, idx,
+                                (fa.BLOCK_Q, fa.BLOCK_K), rate)
+        m_ref[0] = keep.astype(jnp.float32)
+
+    keep = pl.pallas_call(
+        mask_kernel,
+        grid=(BH, nq, nk),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec((1, fa.BLOCK_Q, fa.BLOCK_K),
+                               lambda b, i, j: (b, i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, S, S), jnp.float32),
+    )(seed)
+    kr = float(jnp.mean(keep))
+    print(f"hardware keep-rate: {kr:.4f} (want {1 - rate})")
+    assert abs(kr - (1 - rate)) < 0.01, kr
+
+    def masked_ref_loss(q, k, v):
+        qf, kf, vf = (x.reshape(BH, S, D) for x in (q, k, v))
+        s = jnp.einsum("bsd,btd->bst", qf, kf,
+                       preferred_element_type=jnp.float32) / np.sqrt(D)
+        p = jax.nn.softmax(s, -1)
+        pd = keep * p * (1.0 / (1.0 - rate))
+        o = jnp.einsum("bst,btd->bsd", pd, vf,
+                       preferred_element_type=jnp.float32)
+        return jnp.sum(o * jnp.cos(o))
+
+    def ker_drop_loss(q, k, v):
         o = fa.flash_attention_bshd(q, k, v, dropout_rate=rate, seed=seed)
         return jnp.sum(o * jnp.cos(o))
 
-    g = jax.grad(dloss, argnums=(0, 1, 2))(q, k, v)
-    d = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
-    for i, name in enumerate("qkv"):
-        args = [q, k, v]
-        eps = 1e-2
-        ap = list(args); ap[i] = args[i] + eps * d
-        am = list(args); am[i] = args[i] - eps * d
-        num = float((dloss(*ap) - dloss(*am)) / (2 * eps))
-        ana = float(jnp.sum(g[i] * d))
-        rel = abs(num - ana) / max(abs(num), abs(ana), 1e-6)
-        print(f"dropout d{name}: numeric {num:.4f} analytic {ana:.4f} "
-              f"(rel {rel:.3f})")
-        assert rel < 0.05, (name, num, ana)
+    lr = float(masked_ref_loss(q, k, v))
+    lk = float(ker_drop_loss(q, k, v))
+    print(f"dropout loss: kernel {lk:.4f} masked-ref {lr:.4f}")
+    assert abs(lk - lr) / max(abs(lr), 1.0) < 2e-2, (lk, lr)
+    g_ref = jax.grad(masked_ref_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ker = jax.grad(ker_drop_loss, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b_ in zip("qkv", g_ref, g_ker):
+        e = float(jnp.max(jnp.abs(a - b_)) / jnp.max(jnp.abs(a)))
+        print(f"dropout d{name} max rel err vs masked-ref: {e:.2e}")
+        assert e < 2e-2, (name, e)
     print("tpu_smoke: ALL OK")
     return 0
 
