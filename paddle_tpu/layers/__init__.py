@@ -8,8 +8,13 @@ from .nn import data             # noqa: F401
 from .tensor_ops import *        # noqa: F401,F403
 from .loss import *              # noqa: F401,F403
 from .metric_op import accuracy  # noqa: F401
-from .control_flow import (while_loop, cond, case, switch_case,  # noqa: F401
-                           StaticRNN)
+from .control_flow import (while_loop, while_loop_collect,  # noqa: F401
+                           cond, case, switch_case, StaticRNN)
+from .rnn import (RNNCell, GRUCell, LSTMCell, rnn, birnn,  # noqa: F401
+                  Decoder, BeamSearchDecoder, dynamic_decode,
+                  DecodeHelper, TrainingHelper, GreedyEmbeddingHelper,
+                  SampleEmbeddingHelper, BasicDecoder, gather_tree,
+                  reverse)
 from ..lr_scheduler import (noam_decay, exponential_decay,  # noqa: F401
                             natural_exp_decay, inverse_time_decay,
                             polynomial_decay, piecewise_decay, cosine_decay,

@@ -60,8 +60,30 @@ def while_loop(cond: Callable, body: Callable, loop_vars: Sequence[Variable],
     analog of the reference's while_grad support, ref:
     operators/controlflow/while_op.cc); without it the loop lowers to
     `lax.while_loop` (forward/inference only)."""
+    outs, _ = _while_loop_impl(cond, body, loop_vars, is_test, name,
+                               maximum_trip_count, collect=False)
+    return outs
+
+
+def while_loop_collect(cond, body, loop_vars, maximum_trip_count,
+                       is_test=False, name=None):
+    """Bounded while loop that ALSO stacks per-step outputs (the scan ys)
+    — the TPU-native replacement for the reference's tensor-array
+    accumulation inside While (ref: layers/rnn.py:1352 array_write loop in
+    dynamic_decode).  ``body`` returns ``(next_loop_vars, collect_list)``;
+    returns ``(final_loop_vars, stacked)`` with each stacked value shaped
+    ``[maximum_trip_count, ...]``."""
+    return _while_loop_impl(cond, body, loop_vars, is_test, name,
+                            maximum_trip_count, collect=True)
+
+
+def _while_loop_impl(cond, body, loop_vars, is_test, name,
+                     maximum_trip_count, collect):
     if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
         raise TypeError("loop_vars must be a non-empty list of Variables")
+    if collect and maximum_trip_count is None:
+        raise ValueError("collection needs a bounded loop "
+                         "(maximum_trip_count)")
     loop_vars = list(loop_vars)
     main = default_main_program()
     parent = main.current_block()
@@ -74,6 +96,10 @@ def while_loop(cond: Callable, body: Callable, loop_vars: Sequence[Variable],
 
     body_block = main._create_block()
     body_out = body(*loop_vars)
+    collect_vars = []
+    if collect:
+        body_out, collected = body_out
+        collect_vars = _flatten_vars(collected)
     body_out_vars = _flatten_vars(body_out)
     main._rollback()
     if len(body_out_vars) != len(loop_vars):
@@ -86,17 +112,25 @@ def while_loop(cond: Callable, body: Callable, loop_vars: Sequence[Variable],
     outs = [parent.create_var(
         name=unique_name.generate(name or "while_loop"),
         shape=v.shape, dtype=v.dtype) for v in loop_vars]
+    stacked = [parent.create_var(
+        name=unique_name.generate(f"{name or 'while_loop'}.ys"),
+        shape=(maximum_trip_count,) + tuple(v.shape), dtype=v.dtype)
+        for v in collect_vars]
+    outputs = {"Out": outs}
+    if stacked:
+        outputs["Collected"] = stacked
     parent.append_op(
         type="while_loop",
         inputs={"X": loop_vars, "Closure": closure},
-        outputs={"Out": outs},
+        outputs=outputs,
         attrs={"x_names": x_names, "closure_names": closure,
                "cond_block": cond_block, "body_block": body_block,
                "cond_out": cond_out.name,
                "body_out_names": [v.name for v in body_out_vars],
+               "collect_names": [v.name for v in collect_vars],
                "maximum_trip_count": maximum_trip_count,
                "is_test": is_test})
-    return outs
+    return outs, stacked
 
 
 def cond(pred: Variable, true_fn: Optional[Callable] = None,
@@ -317,4 +351,5 @@ class StaticRNN:
         return self._outputs
 
 
-__all__ = ["while_loop", "cond", "case", "switch_case", "StaticRNN"]
+__all__ = ["while_loop", "while_loop_collect", "cond", "case",
+           "switch_case", "StaticRNN"]

@@ -243,6 +243,22 @@ def reduce_prod(x, dim=None, keep_dim=False, name=None):
     return _reduce("reduce_prod", x, dim, keep_dim, name)
 
 
+def reduce_all(x, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_all", x, dim, keep_dim, name)
+
+
+def reduce_any(x, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_any", x, dim, keep_dim, name)
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return _binary("elementwise_floordiv", x, y, axis, act, name)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return _binary("elementwise_mod", x, y, axis, act, name)
+
+
 def mean(x, name=None):
     helper = LayerHelper("mean", name=name)
     out = helper.create_variable_for_type_inference(x.dtype, ())

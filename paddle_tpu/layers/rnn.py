@@ -1,0 +1,828 @@
+"""RNN cells, the sequence recurrence, and decoding (greedy / sampling /
+beam search).
+
+Reference surface: python/paddle/fluid/layers/rnn.py — RNNCell:58,
+GRUCell:224 (math from contrib/layers/rnn_impl.py BasicGRUUnit:142),
+LSTMCell:322 (BasicLSTMUnit:811), rnn:432, Decoder:584,
+BeamSearchDecoder:697, dynamic_decode:1168, DecodeHelper:1398,
+TrainingHelper:1467, GreedyEmbeddingHelper:1620, SampleEmbeddingHelper:1751,
+BasicDecoder:1852.
+
+TPU-native design: the recurrence is ONE `lax.scan` (via the static_rnn
+structured op) and decoding is ONE bounded masked scan (via
+while_loop_collect) — reverse-differentiable, so scheduled-sampling
+training through the decoder works, which the reference's tensor-array
+While machinery only achieves with its array read/write bookkeeping.
+`dynamic_decode` therefore REQUIRES `max_step_num` (XLA needs a bound);
+beam bookkeeping (the reference's elementwise index arithmetic in
+_gather:896 and the gather_tree op) lowers to static advanced indexing
+in the beam_gather / gather_tree ops (ops/sequence_ops.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Variable
+from ..framework.layer_helper import LayerHelper, ParamAttr
+from ..framework import unique_name
+from . import math_ops as ops
+from . import tensor_ops as tensor
+from . import nn
+from .control_flow import StaticRNN, while_loop_collect
+from .sequence_lod import sequence_mask
+
+__all__ = [
+    "RNNCell", "GRUCell", "LSTMCell", "rnn", "birnn",
+    "Decoder", "BeamSearchDecoder", "dynamic_decode",
+    "DecodeHelper", "TrainingHelper", "GreedyEmbeddingHelper",
+    "SampleEmbeddingHelper", "BasicDecoder",
+    "gather_tree", "reverse",
+]
+
+
+# ---------------------------------------------------------------------------
+# nested-structure helpers (the reference uses layers/utils.py map_structure)
+# ---------------------------------------------------------------------------
+
+def flatten(structure):
+    if isinstance(structure, (list, tuple)):
+        out = []
+        for s in structure:
+            out.extend(flatten(s))
+        return out
+    return [structure]
+
+
+def pack_sequence_as(structure, flat):
+    flat = list(flat)
+
+    def _pack(s):
+        if isinstance(s, (list, tuple)):
+            items = [_pack(x) for x in s]
+        else:
+            return flat.pop(0)
+        if isinstance(s, tuple) and hasattr(s, "_fields"):  # namedtuple
+            return type(s)(*items)
+        return type(s)(items)
+
+    out = _pack(structure)
+    assert not flat, "structure/flat length mismatch"
+    return out
+
+
+def map_structure(fn, *structures):
+    flats = [flatten(s) for s in structures]
+    mapped = [fn(*vals) for vals in zip(*flats)]
+    return pack_sequence_as(structures[0], mapped)
+
+
+def _is_shape(s):
+    return isinstance(s, (list, tuple)) and all(
+        isinstance(i, (int, np.integer)) for i in s)
+
+
+def _named(attr, default_name):
+    """Give a param a deterministic name unless the user's ParamAttr
+    already carries one (cross-program weight sharing is by name)."""
+    attr = ParamAttr._to_attr(attr)
+    if attr is False or attr is None:
+        return attr
+    if attr.name is None:
+        import copy
+        attr = copy.copy(attr)
+        attr.name = default_name
+    return attr
+
+
+# ---------------------------------------------------------------------------
+# small layer utilities
+# ---------------------------------------------------------------------------
+
+def reverse(x, axis):
+    """Flip along the given axes (ref: layers/tensor.py reverse)."""
+    helper = LayerHelper("reverse")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    helper.append_op(type="flip", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"axis": list(axes)})
+    return out
+
+
+def gather_tree(ids, parents):
+    """Backtrace the beam-search tree (ref: layers/nn.py gather_tree →
+    operators/gather_tree_op.h)."""
+    helper = LayerHelper("gather_tree")
+    out = helper.create_variable_for_type_inference(ids.dtype, ids.shape)
+    helper.append_op(type="gather_tree",
+                     inputs={"Ids": [ids], "Parents": [parents]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def _beam_gather(x, indices):
+    """x [B, K, ...] + indices [B, K] → x[b, indices[b, k]]."""
+    helper = LayerHelper("beam_gather")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="beam_gather",
+                     inputs={"X": [x], "Ids": [indices]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def _transpose_batch_time(x):
+    return tensor.transpose(x, [1, 0] + list(range(2, len(x.shape))))
+
+
+def _maybe_copy(state, new_state, cond_keep_old):
+    """where(cond_keep_old, state, new_state) broadcasting the condition
+    over trailing state dims (the reference's elementwise mask arithmetic,
+    ref: layers/rnn.py:516)."""
+    c = cond_keep_old
+    if c.dtype != "bool":
+        c = tensor.cast(c, "bool")
+    while len(c.shape) < len(state.shape):
+        c = tensor.unsqueeze(c, [len(c.shape)])
+    if state.dtype == "bool":
+        s32 = tensor.cast(state, "int32")
+        n32 = tensor.cast(new_state, "int32")
+        return tensor.cast(tensor.where(c, s32, n32), "bool")
+    return tensor.where(c, state, new_state)
+
+
+# ---------------------------------------------------------------------------
+# cells (ref: layers/rnn.py:58,224,322)
+# ---------------------------------------------------------------------------
+
+class RNNCell:
+    """Abstract step function s', y = cell(x, s) (ref: layers/rnn.py:58)."""
+
+    def call(self, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, inputs, states, **kwargs):
+        return self.call(inputs, states, **kwargs)
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        """Zero (or constant) states batch-sized like ``batch_ref``
+        (ref: layers/rnn.py:92)."""
+        ref = flatten(batch_ref)[0]
+        shape = self.state_shape if shape is None else shape
+
+        def make(s):
+            return tensor.fill_constant_batch_size_like(
+                ref, [-1] + list(s), dtype, init_value,
+                input_dim_idx=batch_dim_idx)
+
+        if _is_shape(shape):
+            return make(shape)
+        return map_structure(lambda s: make(s),
+                             _ShapeTree(shape).tree)
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError(
+            f"{type(self).__name__} must define state_shape")
+
+    @property
+    def state_dtype(self):
+        return "float32"
+
+
+class _ShapeTree:
+    """Wrap nested shapes so map_structure treats each SHAPE (a list of
+    ints) as a leaf rather than recursing into it."""
+
+    class _Leaf:
+        def __init__(self, s):
+            self.s = s
+
+    def __init__(self, nested):
+        def conv(s):
+            if _is_shape(s):
+                return _ShapeTree._Leaf(s)
+            return type(s)(conv(x) for x in s)
+        wrapped = conv(nested)
+
+        def unwrap(s):
+            if isinstance(s, _ShapeTree._Leaf):
+                return s.s
+            return s
+        self.tree = map_structure(unwrap, wrapped)
+
+
+class GRUCell(RNNCell):
+    """GRU step (ref: layers/rnn.py:224; math: BasicGRUUnit,
+    contrib/layers/rnn_impl.py:142):
+        r, u = sigmoid([x, h] @ Wg + bg)       (gate order r then u)
+        c    = tanh([x, r*h] @ Wc + bc)
+        h'   = u*h + (1-u)*c
+    """
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, dtype="float32",
+                 name="GRUCell"):
+        self.hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_act = gate_activation or ops.sigmoid
+        self._act = activation or ops.tanh
+        self._dtype = dtype
+        # an EXPLICIT name is the cell's identity — deterministic param
+        # names let a decode program share trained weights by name (the
+        # reference's name_scope contract); the default is uniquified
+        self._name = name if name != "GRUCell" else unique_name.generate(name)
+        self._built = False
+
+    def _build(self, input_size):
+        helper = LayerHelper(self._name)
+        H = self.hidden_size
+        self._gate_w = helper.create_parameter(
+            _named(self._param_attr, f"{self._name}.gate_w"),
+            [input_size + H, 2 * H], self._dtype)
+        self._gate_b = helper.create_parameter(
+            _named(self._bias_attr, f"{self._name}.gate_b"),
+            [2 * H], self._dtype, is_bias=True)
+        self._cand_w = helper.create_parameter(
+            _named(self._param_attr, f"{self._name}.cand_w"),
+            [input_size + H, H], self._dtype)
+        self._cand_b = helper.create_parameter(
+            _named(self._bias_attr, f"{self._name}.cand_b"),
+            [H], self._dtype, is_bias=True)
+        self._built = True
+
+    def call(self, inputs, states):
+        if not self._built:
+            self._build(int(inputs.shape[-1]))
+        h = states
+        xh = tensor.concat([inputs, h], axis=1)
+        gates = ops.matmul(xh, self._gate_w)
+        if self._gate_b is not None:       # bias_attr=False skips biases
+            gates = ops.elementwise_add(gates, self._gate_b)
+        gates = self._gate_act(gates)
+        r, u = tensor.split(gates, 2, dim=1)
+        cand_in = tensor.concat([inputs, ops.elementwise_mul(r, h)], axis=1)
+        c = ops.matmul(cand_in, self._cand_w)
+        if self._cand_b is not None:
+            c = ops.elementwise_add(c, self._cand_b)
+        c = self._act(c)
+        new_h = ops.elementwise_add(
+            ops.elementwise_mul(u, h),
+            ops.elementwise_mul(ops.scale(u, -1.0, bias=1.0), c))
+        return new_h, new_h
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+
+class LSTMCell(RNNCell):
+    """LSTM step (ref: layers/rnn.py:322; math: BasicLSTMUnit,
+    contrib/layers/rnn_impl.py:811):
+        i, j, f, o = split([x, h] @ W + b, 4)
+        c' = c * sigmoid(f + forget_bias) + sigmoid(i) * tanh(j)
+        h' = tanh(c') * sigmoid(o)
+    """
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, forget_bias=1.0,
+                 dtype="float32", name="LSTMCell"):
+        self.hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_act = gate_activation or ops.sigmoid
+        self._act = activation or ops.tanh
+        self._forget_bias = float(forget_bias)
+        self._dtype = dtype
+        self._name = (name if name != "LSTMCell"
+                      else unique_name.generate(name))
+        self._built = False
+
+    def _build(self, input_size):
+        helper = LayerHelper(self._name)
+        H = self.hidden_size
+        self._w = helper.create_parameter(
+            _named(self._param_attr, f"{self._name}.w"),
+            [input_size + H, 4 * H], self._dtype)
+        self._b = helper.create_parameter(
+            _named(self._bias_attr, f"{self._name}.b"),
+            [4 * H], self._dtype, is_bias=True)
+        self._built = True
+
+    def call(self, inputs, states):
+        if not self._built:
+            self._build(int(inputs.shape[-1]))
+        h, c = states
+        xh = tensor.concat([inputs, h], axis=1)
+        gates = ops.matmul(xh, self._w)
+        if self._b is not None:            # bias_attr=False skips biases
+            gates = ops.elementwise_add(gates, self._b)
+        i, j, f, o = tensor.split(gates, 4, dim=-1)
+        new_c = ops.elementwise_add(
+            ops.elementwise_mul(
+                c, self._gate_act(ops.scale(f, 1.0,
+                                            bias=self._forget_bias))),
+            ops.elementwise_mul(self._gate_act(i), self._act(j)))
+        new_h = ops.elementwise_mul(self._act(new_c), self._gate_act(o))
+        return new_h, [new_h, new_c]
+
+    @property
+    def state_shape(self):
+        return [[self.hidden_size], [self.hidden_size]]
+
+
+# ---------------------------------------------------------------------------
+# the recurrence (ref: layers/rnn.py:432)
+# ---------------------------------------------------------------------------
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Run ``cell`` over the time dimension — ONE lax.scan via static_rnn
+    (ref: layers/rnn.py:432 builds a StaticRNN the same way; the
+    reference's per-step mask copy at :516 becomes a where here).
+
+    Returns (final_outputs, final_states): outputs stacked over time
+    ([B, T, ...] unless time_major), final_states the last (per-sequence,
+    when sequence_length is given) states.
+    """
+    if initial_states is None:
+        initial_states = cell.get_initial_states(
+            batch_ref=inputs, batch_dim_idx=1 if time_major else 0)
+
+    if not time_major:
+        inputs = map_structure(_transpose_batch_time, inputs)
+    T = int(flatten(inputs)[0].shape[0])
+
+    mask = None
+    if sequence_length is not None:
+        mask = sequence_mask(sequence_length, maxlen=T, dtype="float32")
+        mask = tensor.transpose(mask, [1, 0])          # [T, B]
+    if is_reverse:
+        inputs = map_structure(lambda x: reverse(x, [0]), inputs)
+        if mask is not None:
+            mask = reverse(mask, [0])
+
+    loop = StaticRNN()
+    with loop.step():
+        step_in = map_structure(loop.step_input, inputs)
+        states = map_structure(loop.memory, initial_states)
+        outputs, new_states = cell.call(step_in, states, **kwargs)
+        if mask is not None:
+            m = loop.step_input(mask)                  # [B]
+            keep_old = ops.equal(m, tensor.fill_constant(
+                [1], "float32", 0.0))
+            new_states = map_structure(
+                lambda s, ns: _maybe_copy(s, ns, keep_old), states,
+                new_states)
+        map_structure(loop.update_memory, states, new_states)
+        flat_out = flatten(outputs)
+        for o in flat_out:
+            loop.step_output(o)
+
+    rnn_out = loop()
+    rnn_list = rnn_out if isinstance(rnn_out, list) else [rnn_out]
+    final_outputs = pack_sequence_as(outputs, rnn_list)
+    final_states = pack_sequence_as(new_states, list(loop._final_mems))
+
+    if is_reverse:
+        final_outputs = map_structure(lambda x: reverse(x, [0]),
+                                      final_outputs)
+    if not time_major:
+        final_outputs = map_structure(_transpose_batch_time, final_outputs)
+    return final_outputs, final_states
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states_fw=None,
+          initial_states_bw=None, sequence_length=None, time_major=False,
+          **kwargs):
+    """Bidirectional recurrence: forward + reversed backward sweep, outputs
+    concatenated on the feature dim (the basic_gru/basic_lstm
+    bidirectional mode, ref: contrib/layers/rnn_impl.py:164)."""
+    out_fw, st_fw = rnn(cell_fw, inputs, initial_states_fw, sequence_length,
+                        time_major=time_major, **kwargs)
+    out_bw, st_bw = rnn(cell_bw, inputs, initial_states_bw, sequence_length,
+                        time_major=time_major, is_reverse=True, **kwargs)
+    out = map_structure(
+        lambda a, b: tensor.concat([a, b], axis=len(a.shape) - 1),
+        out_fw, out_bw)
+    return out, (st_fw, st_bw)
+
+
+# ---------------------------------------------------------------------------
+# decoding (ref: layers/rnn.py:584-1986)
+# ---------------------------------------------------------------------------
+
+class Decoder:
+    """ref: layers/rnn.py:584."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class _BeamOutput(tuple):
+    """namedtuple-alike (scores, predicted_ids, parent_ids)."""
+    _fields = ("scores", "predicted_ids", "parent_ids")
+
+    def __new__(cls, scores, predicted_ids, parent_ids):
+        return tuple.__new__(cls, (scores, predicted_ids, parent_ids))
+
+    scores = property(lambda self: self[0])
+    predicted_ids = property(lambda self: self[1])
+    parent_ids = property(lambda self: self[2])
+
+
+class _BeamState(tuple):
+    """namedtuple-alike (cell_states, log_probs, finished, lengths)."""
+    _fields = ("cell_states", "log_probs", "finished", "lengths")
+
+    def __new__(cls, cell_states, log_probs, finished, lengths):
+        return tuple.__new__(cls, (cell_states, log_probs, finished,
+                                   lengths))
+
+    cell_states = property(lambda self: self[0])
+    log_probs = property(lambda self: self[1])
+    finished = property(lambda self: self[2])
+    lengths = property(lambda self: self[3])
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over a cell (ref: layers/rnn.py:697).  State layout and
+    step algebra follow the reference exactly (:1004 _beam_search_step);
+    the within-batch beam gather is the beam_gather op."""
+
+    OutputWrapper = _BeamOutput
+    StateWrapper = _BeamState
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.kinf = 1e9
+
+    # -- beam shape plumbing (ref: :775-866) ----------------------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] → [B*K, ...] replicating each batch entry K times."""
+        x = tensor.unsqueeze(x, [1])
+        x = tensor.expand(x, [1, beam_size] + [1] * (len(x.shape) - 2))
+        return tensor.reshape(x, [-1] + list(x.shape[2:]))
+
+    def _expand_to_beam_size(self, x):
+        x = tensor.unsqueeze(x, [1])
+        return tensor.expand(x, [1, self.beam_size]
+                             + [1] * (len(x.shape) - 2))
+
+    def _merge_batch_beams(self, x):
+        return tensor.reshape(x, [-1] + list(x.shape[2:]))
+
+    def _split_batch_beams(self, x):
+        return tensor.reshape(x, [-1, self.beam_size] + list(x.shape[1:]))
+
+    def _mask_probs(self, probs, finished):
+        """Finished beams emit end_token with log-prob 0 (ref: :867)."""
+        vocab = int(probs.shape[-1])
+        noend = np.full([vocab], -self.kinf, np.float32)
+        noend[self.end_token] = 0.0
+        noend_t = tensor.assign_value(noend, "float32")
+        fin = tensor.unsqueeze(finished, [2])          # [B, K, 1] bool
+        return tensor.where(fin, ops.elementwise_sub(
+            noend_t, tensor.zeros_like(probs)), probs)
+
+    # -- protocol --------------------------------------------------------
+    def initialize(self, initial_cell_states):
+        state_leaf = flatten(initial_cell_states)[0]
+        init_cell_states = map_structure(self._expand_to_beam_size,
+                                         initial_cell_states)
+        init_ids = tensor.fill_constant_batch_size_like(
+            state_leaf, [-1, self.beam_size], "int64", self.start_token)
+        # beam 0 live, others -inf so step 1 fans out from one root
+        row = np.array([[0.0] + [-self.kinf] * (self.beam_size - 1)],
+                       np.float32)
+        log_probs = ops.elementwise_add(
+            tensor.fill_constant_batch_size_like(
+                state_leaf, [-1, self.beam_size], "float32", 0.0),
+            tensor.assign_value(row, "float32"))
+        init_finished = tensor.cast(
+            tensor.fill_constant_batch_size_like(
+                state_leaf, [-1, self.beam_size], "int32", 0), "bool")
+        init_lengths = tensor.fill_constant_batch_size_like(
+            state_leaf, [-1, self.beam_size], "int64", 0)
+        init_inputs = (self.embedding_fn(init_ids) if self.embedding_fn
+                       else init_ids)
+        return init_inputs, _BeamState(init_cell_states, log_probs,
+                                       init_finished, init_lengths), \
+            init_finished
+
+    def _beam_search_step(self, time, logits, next_cell_states, beam_state):
+        vocab = int(logits.shape[-1])
+        step_log_probs = nn.log_softmax(logits)          # [B, K, V]
+        step_log_probs = self._mask_probs(step_log_probs,
+                                          beam_state.finished)
+        log_probs = ops.elementwise_add(
+            step_log_probs, tensor.unsqueeze(beam_state.log_probs, [2]))
+        scores = tensor.reshape(log_probs,
+                                [-1, self.beam_size * vocab])
+        topk_scores, topk_idx = nn.topk(scores, k=self.beam_size)
+        vocab_t = tensor.fill_constant([1], "int64", vocab)
+        beam_idx = ops.elementwise_floordiv(topk_idx, vocab_t)
+        token_idx = ops.elementwise_mod(topk_idx, vocab_t)
+
+        next_cell_states = map_structure(
+            lambda s: _beam_gather(s, beam_idx), next_cell_states)
+        next_finished = _beam_gather(beam_state.finished, beam_idx)
+        next_lengths = _beam_gather(beam_state.lengths, beam_idx)
+        next_lengths = ops.elementwise_add(
+            next_lengths,
+            tensor.cast(ops.logical_not(next_finished), "int64"))
+        end_t = tensor.fill_constant([1], "int64", self.end_token)
+        next_finished = ops.logical_or(next_finished,
+                                       ops.equal(token_idx, end_t))
+
+        out = _BeamOutput(topk_scores, token_idx, beam_idx)
+        state = _BeamState(next_cell_states, topk_scores, next_finished,
+                           next_lengths)
+        return out, state
+
+    def step(self, time, inputs, states, **kwargs):
+        merged_in = map_structure(self._merge_batch_beams, inputs)
+        merged_states = map_structure(self._merge_batch_beams,
+                                      states.cell_states)
+        cell_out, next_cell_states = self.cell(merged_in, merged_states,
+                                               **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        cell_out = self._split_batch_beams(cell_out)
+        next_cell_states = map_structure(self._split_batch_beams,
+                                         next_cell_states)
+        out, state = self._beam_search_step(time, cell_out,
+                                            next_cell_states, states)
+        sample_ids = out.predicted_ids
+        next_inputs = (self.embedding_fn(sample_ids) if self.embedding_fn
+                       else sample_ids)
+        return out, state, next_inputs, state.finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        predicted_ids = gather_tree(outputs.predicted_ids,
+                                    outputs.parent_ids)
+        return predicted_ids, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run ``decoder`` until every sequence finishes or ``max_step_num``
+    steps (ref: layers/rnn.py:1168).
+
+    TPU-native: ``max_step_num`` is REQUIRED — the loop is a bounded
+    masked scan (reverse-differentiable; the carry freezes once all
+    finished, so compute after convergence is skipped-by-mask rather than
+    early-exited).  The reference's tensor-array accumulation becomes the
+    scan's stacked ys.
+    """
+    if max_step_num is None:
+        raise ValueError(
+            "dynamic_decode on TPU requires max_step_num: XLA compiles a "
+            "bounded loop (the reference's unbounded While has no static "
+            "shape for the stacked outputs)")
+    initial_inputs, initial_states, initial_finished = \
+        decoder.initialize(inits)
+
+    flat_inputs = flatten(initial_inputs)
+    flat_states = flatten(initial_states)
+    n_in = len(flat_inputs)
+    step_idx = tensor.fill_constant([1], "int64", 0)
+    seq_len = tensor.zeros_like(
+        tensor.cast(initial_finished, "int64"))
+    finished0 = initial_finished
+    if finished0.dtype != "bool":
+        finished0 = tensor.cast(finished0, "bool")
+
+    loop_vars = [step_idx, finished0, seq_len] + flat_inputs + flat_states
+    outputs_holder = []
+
+    def cond_fn(*vals):
+        return ops.logical_not(ops.reduce_all(vals[1]))
+
+    def body_fn(*vals):
+        t, fin, slen = vals[0], vals[1], vals[2]
+        cur_inputs = pack_sequence_as(initial_inputs,
+                                      list(vals[3:3 + n_in]))
+        cur_states = pack_sequence_as(initial_states,
+                                      list(vals[3 + n_in:]))
+        outputs, next_states, next_inputs, next_fin = decoder.step(
+            t, cur_inputs, cur_states, **kwargs)
+        if not decoder.tracks_own_finished:
+            next_fin = ops.logical_or(next_fin, fin)
+        if next_fin.dtype != "bool":
+            next_fin = tensor.cast(next_fin, "bool")
+        next_slen = ops.elementwise_add(
+            slen, tensor.cast(ops.logical_not(fin), "int64"))
+        if impute_finished:
+            next_states = map_structure(
+                lambda s, ns: _maybe_copy(s, ns, fin), cur_states,
+                next_states)
+        outputs_holder.append(outputs)
+        next_t = ops.elementwise_add(t, tensor.fill_constant(
+            [1], "int64", 1))
+        return ([next_t, next_fin, next_slen] + flatten(next_inputs)
+                + flatten(next_states), flatten(outputs))
+
+    final_vals, stacked = while_loop_collect(
+        cond_fn, body_fn, loop_vars, maximum_trip_count=int(max_step_num),
+        is_test=is_test, name="dynamic_decode")
+
+    outputs_struct = outputs_holder[0]
+    final_outputs = pack_sequence_as(outputs_struct, stacked)
+    final_states = pack_sequence_as(initial_states,
+                                    list(final_vals[3 + n_in:]))
+    sequence_lengths = final_vals[2]
+
+    try:
+        final_outputs, final_states = decoder.finalize(
+            final_outputs, final_states, sequence_lengths)
+    except NotImplementedError:
+        pass
+
+    if not output_time_major:
+        final_outputs = map_structure(_transpose_batch_time, final_outputs)
+
+    if return_length:
+        return final_outputs, final_states, sequence_lengths
+    return final_outputs, final_states
+
+
+# ---------------------------------------------------------------------------
+# helpers + BasicDecoder (ref: layers/rnn.py:1398-1986)
+# ---------------------------------------------------------------------------
+
+class DecodeHelper:
+    """ref: layers/rnn.py:1398."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher forcing: read the next step's input from the ground-truth
+    sequence (ref: layers/rnn.py:1467)."""
+
+    def __init__(self, inputs, sequence_length, time_major=False):
+        self.inputs = inputs
+        self.sequence_length = sequence_length
+        self.time_major = time_major
+        self._tm_inputs = (inputs if time_major
+                           else map_structure(_transpose_batch_time, inputs))
+        self._max_t = int(flatten(self._tm_inputs)[0].shape[0])
+
+    def initialize(self):
+        init_inputs = map_structure(lambda x: _time_slice(x, None, 0),
+                                    self._tm_inputs)
+        zero = tensor.fill_constant([1], "int64", 0)
+        init_finished = ops.less_equal(
+            self.sequence_length, zero)
+        return init_inputs, init_finished
+
+    def sample(self, time, outputs, states):
+        return nn.argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        next_t = ops.elementwise_add(
+            time, tensor.fill_constant([1], "int64", 1))
+        finished = ops.less_equal(
+            tensor.cast(self.sequence_length, "int64"), next_t)
+        nxt = map_structure(
+            lambda x: _time_slice(x, next_t, None, self._max_t),
+            self._tm_inputs)
+        return finished, nxt, states
+
+
+def _time_slice(x, t_var, t_const, max_t=None):
+    """x[t] for time-major x — static index or runtime index Variable."""
+    if t_var is None:
+        out = tensor.slice(x, axes=[0], starts=[t_const],
+                           ends=[t_const + 1])
+        return tensor.squeeze(out, [0])
+    helper = LayerHelper("time_slice")
+    # clamp so the final iteration (t == T) stays in range; its value is
+    # never used (finished masks it)
+    tmax = tensor.fill_constant([1], "int64", max_t - 1)
+    idx = ops.elementwise_min(t_var, tmax)
+    out = helper.create_variable_for_type_inference(x.dtype,
+                                                    tuple(x.shape[1:]))
+    helper.append_op(type="index_select",
+                     inputs={"X": [x], "Index": [idx]},
+                     outputs={"Out": [out]}, attrs={"dim": 0})
+    return tensor.squeeze(out, [0])
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """argmax sampling + embedding lookup (ref: layers/rnn.py:1620)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = start_tokens        # [B] int64 Variable
+        self.end_token = int(end_token)
+
+    def initialize(self):
+        init_inputs = self.embedding_fn(self.start_tokens)
+        init_finished = tensor.cast(tensor.fill_constant_batch_size_like(
+            self.start_tokens, [-1], "int32", 0), "bool")
+        return init_inputs, init_finished
+
+    def sample(self, time, outputs, states):
+        return nn.argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        finished = ops.equal(sample_ids, tensor.fill_constant(
+            [1], "int64", self.end_token))
+        return finished, self.embedding_fn(sample_ids), states
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Categorical sampling via Gumbel-max on the logits
+    (ref: layers/rnn.py:1751 uses the sampling_id op; Gumbel-max is the
+    XLA-native equivalent — argmax(logits/T + G), G ~ Gumbel(0,1))."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.temperature = softmax_temperature
+        self.seed = seed
+
+    def sample(self, time, outputs, states):
+        logits = (outputs if self.temperature is None
+                  else ops.scale(outputs, 1.0 / self.temperature))
+        helper = LayerHelper("gumbel")
+        u = helper.create_variable_for_type_inference("float32",
+                                                      logits.shape)
+        # ShapeLike resolves the symbolic batch dim at lowering
+        helper.append_op(type="uniform_random",
+                         inputs={"ShapeLike": [logits]},
+                         outputs={"Out": [u]},
+                         attrs={"min": 1e-6, "max": 1.0 - 1e-6,
+                                "seed": self.seed or 0})
+        g = ops.scale(ops.log(ops.scale(ops.log(u), -1.0)), -1.0)
+        return nn.argmax(ops.elementwise_add(logits, g), axis=-1)
+
+
+class _BasicDecoderOutput(tuple):
+    _fields = ("cell_outputs", "sample_ids")
+
+    def __new__(cls, cell_outputs, sample_ids):
+        return tuple.__new__(cls, (cell_outputs, sample_ids))
+
+    cell_outputs = property(lambda self: self[0])
+    sample_ids = property(lambda self: self[1])
+
+
+class BasicDecoder(Decoder):
+    """cell + helper composition (ref: layers/rnn.py:1852)."""
+
+    OutputWrapper = _BasicDecoderOutput
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        initial_inputs, initial_finished = self.helper.initialize()
+        return initial_inputs, initial_cell_states, initial_finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_outputs, cell_states = self.cell(inputs, states, **kwargs)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+        sample_ids = self.helper.sample(time, cell_outputs, states)
+        finished, next_inputs, next_states = self.helper.next_inputs(
+            time, cell_outputs, cell_states, sample_ids)
+        return (_BasicDecoderOutput(cell_outputs, sample_ids), next_states,
+                next_inputs, finished)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError  # keep raw stacked outputs

@@ -53,6 +53,7 @@ def _while_loop_op(ctx, ins, attrs):
     cond_out = attrs["cond_out"]
     body_out_names = list(attrs["body_out_names"])
     max_trip = attrs.get("maximum_trip_count")
+    collect_names = list(attrs.get("collect_names") or [])
 
     base_env = dict(zip(closure_names, closure))
 
@@ -67,10 +68,16 @@ def _while_loop_op(ctx, ins, attrs):
         env.update(zip(x_names, vals))
         sub = _sub_ctx(ctx, key)
         env = _run_block(body_block, env, sub)
-        return tuple(env[n] for n in body_out_names)
+        return (tuple(env[n] for n in body_out_names),
+                tuple(env[n] for n in collect_names))
 
     init = tuple(xs)
     if max_trip is None:
+        if collect_names:
+            raise ValueError(
+                "per-step output collection requires a bounded loop "
+                "(maximum_trip_count) — XLA cannot stack a dynamic number "
+                "of steps")
         # dynamic trip count → lax.while_loop (forward-only)
         def cond_fn(carry):
             vals, key = carry
@@ -79,25 +86,32 @@ def _while_loop_op(ctx, ins, attrs):
         def body_fn(carry):
             vals, key = carry
             k_step, k_next = jax.random.split(key)
-            return eval_body(vals, k_step), k_next
+            new_vals, _ = eval_body(vals, k_step)
+            return new_vals, k_next
 
         out_vals, _ = jax.lax.while_loop(cond_fn, body_fn,
                                          (init, ctx.next_key()))
-    else:
-        # bounded loop → masked scan: runs max_trip iterations, freezing the
-        # carry once the predicate goes false; reverse-differentiable.
-        def scan_fn(carry, key):
-            vals, done = carry
-            pred = jnp.logical_and(eval_cond(vals, key), ~done)
-            new_vals = eval_body(vals, key)
-            sel = tuple(jnp.where(pred, nv, v)
-                        for nv, v in zip(new_vals, vals))
-            return (sel, ~pred), None
+        return {"Out": list(out_vals)}
 
-        keys = jax.random.split(ctx.next_key(), int(max_trip))
-        (out_vals, _), _ = jax.lax.scan(
-            scan_fn, (init, jnp.asarray(False)), keys)
-    return {"Out": list(out_vals)}
+    # bounded loop → masked scan: runs max_trip iterations, freezing the
+    # carry once the predicate goes false; reverse-differentiable.  Per-step
+    # `collect_names` values are stacked into [max_trip, ...] outputs (the
+    # scan ys — dynamic_decode's token accumulator rides this).
+    def scan_fn(carry, key):
+        vals, done = carry
+        pred = jnp.logical_and(eval_cond(vals, key), ~done)
+        new_vals, collected = eval_body(vals, key)
+        sel = tuple(jnp.where(pred, nv, v)
+                    for nv, v in zip(new_vals, vals))
+        return (sel, ~pred), collected
+
+    keys = jax.random.split(ctx.next_key(), int(max_trip))
+    (out_vals, _), stacked = jax.lax.scan(
+        scan_fn, (init, jnp.asarray(False)), keys)
+    out = {"Out": list(out_vals)}
+    if collect_names:
+        out["Collected"] = list(stacked)
+    return out
 
 
 @register("conditional_block")

@@ -210,3 +210,36 @@ def _sequence_enumerate(ctx, ins, attrs):
         ids[:, :, None], jnp.broadcast_to(src_idx, (B, T, win)), axis=1)
     return {"Out": jnp.where(valid, gathered,
                              jnp.asarray(pad_value, ids.dtype))}
+
+
+@register("gather_tree")
+def _gather_tree(ctx, ins, attrs):
+    """Beam-search backtrace (ref: operators/gather_tree_op.h:30): walk
+    parent pointers backward from the last step so out[t, b, k] holds the
+    token on the full path ending at beam k.  TPU-natively one reversed
+    lax.scan over time instead of the reference's triple host loop."""
+    ids = x(ins, "Ids")            # [T, B, K] int
+    parents = x(ins, "Parents")    # [T, B, K] int
+    b_idx = jnp.arange(ids.shape[1])[:, None]          # [B, 1]
+
+    def step(beam, tp):
+        ids_t, par_t = tp           # each [B, K]
+        tok = ids_t[b_idx, beam]    # follow current beam pointers
+        return par_t[b_idx, beam], tok
+
+    _, toks = jax.lax.scan(
+        step, jnp.broadcast_to(jnp.arange(ids.shape[2]),
+                               ids.shape[1:]).astype(ids.dtype),
+        (ids, parents), reverse=True)
+    return {"Out": toks}
+
+
+@register("beam_gather")
+def _beam_gather(ctx, ins, attrs):
+    """Gather beams within each batch entry: X [B, K, ...] + Ids [B, K]
+    → X[b, Ids[b, k]].  The per-batch offset arithmetic the reference
+    does with elementwise ops (ref: layers/rnn.py:896 _gather) collapses
+    to one static advanced-index here."""
+    a, idx = x(ins, "X"), x(ins, "Ids")
+    b_idx = jnp.arange(a.shape[0])[:, None]
+    return {"Out": a[b_idx, idx.astype(jnp.int32)]}

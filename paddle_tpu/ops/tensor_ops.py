@@ -63,7 +63,13 @@ def _gaussian_random(ctx, ins, attrs):
 
 @register("uniform_random")
 def _uniform_random(ctx, ins, attrs):
-    shape = attrs.get("shape", [1])
+    ref = x(ins, "ShapeLike")
+    if ref is not None:
+        # builder-side shapes may carry -1 batch dims; a ShapeLike input
+        # resolves them to the runtime array's static shape
+        shape = ref.shape
+    else:
+        shape = attrs.get("shape", [1])
     lo = attrs.get("min", -1.0)
     hi = attrs.get("max", 1.0)
     out = jax.random.uniform(ctx.next_key(), shape, minval=lo, maxval=hi)
@@ -405,7 +411,9 @@ def _flip(ctx, ins, attrs):
 
 @register("increment")
 def _increment(ctx, ins, attrs):
-    return {"Out": x(ins, "X") + attrs.get("step", 1.0)}
+    a = x(ins, "X")
+    # dtype-preserving (ref: increment_op.h — int counters stay int)
+    return {"Out": a + jnp.asarray(attrs.get("step", 1.0), a.dtype)}
 
 
 @register("share_data")

@@ -1,0 +1,391 @@
+"""RNN cell / recurrence / decoding tests — numeric parity with numpy
+references (the reference's OpTest pattern, ref: tests/unittests/
+test_rnn_cell_api.py, test_dynamic_decode.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import Program, program_guard
+
+
+def _const_attr(v):
+    return fluid.ParamAttr(initializer=fluid.initializer.Constant(v))
+
+
+def _np_gru_step(x, h, gw, gb, cw, cb):
+    xh = np.concatenate([x, h], 1)
+    g = 1 / (1 + np.exp(-(xh @ gw + gb)))
+    r, u = np.split(g, 2, axis=1)
+    cand = np.tanh(np.concatenate([x, r * h], 1) @ cw + cb)
+    return u * h + (1 - u) * cand
+
+
+def _np_lstm_step(x, h, c, w, b, fb=1.0):
+    g = np.concatenate([x, h], 1) @ w + b
+    i, j, f, o = np.split(g, 4, axis=1)
+    sig = lambda a: 1 / (1 + np.exp(-a))
+    nc = c * sig(f + fb) + sig(i) * np.tanh(j)
+    nh = np.tanh(nc) * sig(o)
+    return nh, nc
+
+
+def test_gru_cell_numeric():
+    B, D, H = 4, 6, 5
+    rng = np.random.RandomState(0)
+    xv = rng.randn(B, D).astype(np.float32)
+    hv = rng.randn(B, H).astype(np.float32)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[D])
+        h = fluid.layers.data("h", shape=[H])
+        cell = fluid.layers.GRUCell(H, param_attr=_const_attr(0.1),
+                                    bias_attr=_const_attr(0.05))
+        out, new_h = cell(x, h)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r, = exe.run(main, feed={"x": xv, "h": hv}, fetch_list=[out])
+
+    gw = np.full((D + H, 2 * H), 0.1, np.float32)
+    gb = np.full((2 * H,), 0.05, np.float32)
+    cw = np.full((D + H, H), 0.1, np.float32)
+    cb = np.full((H,), 0.05, np.float32)
+    np.testing.assert_allclose(r, _np_gru_step(xv, hv, gw, gb, cw, cb),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_cell_numeric():
+    B, D, H = 3, 4, 6
+    rng = np.random.RandomState(1)
+    xv = rng.randn(B, D).astype(np.float32)
+    hv = rng.randn(B, H).astype(np.float32)
+    cv = rng.randn(B, H).astype(np.float32)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[D])
+        h = fluid.layers.data("h", shape=[H])
+        c = fluid.layers.data("c", shape=[H])
+        cell = fluid.layers.LSTMCell(H, param_attr=_const_attr(0.08),
+                                     bias_attr=_const_attr(0.0))
+        out, (nh, nc) = cell(x, [h, c])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rh, rc = exe.run(main, feed={"x": xv, "h": hv, "c": cv},
+                     fetch_list=[nh, nc])
+
+    w = np.full((D + H, 4 * H), 0.08, np.float32)
+    b = np.zeros((4 * H,), np.float32)
+    eh, ec = _np_lstm_step(xv, hv, cv, w, b)
+    np.testing.assert_allclose(rh, eh, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(rc, ec, rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_over_sequence_with_lengths():
+    """rnn() matches a per-step numpy loop incl. sequence_length state
+    freezing (ref rnn() semantics: layers/rnn.py:516 _maybe_copy)."""
+    B, T, D, H = 3, 5, 4, 4
+    rng = np.random.RandomState(2)
+    xv = rng.randn(B, T, D).astype(np.float32)
+    lens = np.array([5, 3, 1], np.int64)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, D])
+        sl = fluid.layers.data("sl", shape=[1], dtype="int64")
+        cell = fluid.layers.GRUCell(H, param_attr=_const_attr(0.1),
+                                    bias_attr=_const_attr(0.0))
+        outs, final = fluid.layers.rnn(cell, x, sequence_length=sl)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ro, rf = exe.run(main, feed={"x": xv, "sl": lens.reshape(-1, 1)},
+                     fetch_list=[outs, final])
+
+    gw = np.full((D + H, 2 * H), 0.1, np.float32)
+    gb = np.zeros((2 * H,), np.float32)
+    cw = np.full((D + H, H), 0.1, np.float32)
+    cb = np.zeros((H,), np.float32)
+    h = np.zeros((B, H), np.float32)
+    expect = np.zeros((B, T, H), np.float32)
+    for t in range(T):
+        nh = _np_gru_step(xv[:, t], h, gw, gb, cw, cb)
+        mask = (t < lens).astype(np.float32)[:, None]
+        h = mask * nh + (1 - mask) * h
+        expect[:, t] = nh          # outputs are the raw cell outputs
+    np.testing.assert_allclose(rf, h, rtol=1e-5, atol=1e-5)
+    assert ro.shape == (B, T, H)
+
+
+def test_rnn_reverse_matches_flipped():
+    B, T, D, H = 2, 4, 3, 3
+    rng = np.random.RandomState(3)
+    xv = rng.randn(B, T, D).astype(np.float32)
+
+    def run(is_reverse, xin):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[T, D])
+            cell = fluid.layers.GRUCell(H, param_attr=_const_attr(0.1),
+                                        bias_attr=_const_attr(0.0))
+            outs, _ = fluid.layers.rnn(cell, x, is_reverse=is_reverse)
+        exe = fluid.Executor(fluid.CPUPlace())
+        s = fluid.Scope()
+        with fluid.scope_guard(s):
+            exe.run(startup)
+            r, = exe.run(main, feed={"x": xin}, fetch_list=[outs])
+        return r
+
+    fwd_on_flipped = run(False, xv[:, ::-1].copy())
+    rev = run(True, xv)
+    np.testing.assert_allclose(rev, fwd_on_flipped[:, ::-1], rtol=1e-5,
+                               atol=1e-5)
+
+
+def _greedy_np(start, emb, gw, gb, cw, cb, ow, end_token, max_t):
+    """numpy greedy decode reference for the GRU+fc decoder used below."""
+    B = start.shape[0]
+    h = np.zeros((B, gw.shape[1] // 2), np.float32)
+    tok = start
+    out_ids = []
+    finished = np.zeros(B, bool)
+    for _ in range(max_t):
+        x = emb[tok]
+        h_new = _np_gru_step(x, h, gw, gb, cw, cb)
+        h = np.where(finished[:, None], h, h_new)  # frozen after finish
+        logits = h @ ow
+        nxt = logits.argmax(-1)
+        out_ids.append(nxt)
+        finished |= nxt == end_token
+        tok = nxt
+        if finished.all():
+            break
+    return np.stack(out_ids, 1)  # [B, T]
+
+
+def test_greedy_decode_produces_tokens():
+    B, H, V, E, MAX_T = 3, 8, 11, 6, 7
+    rng = np.random.RandomState(4)
+    emb_w = rng.randn(V, E).astype(np.float32) * 0.5
+    out_w = rng.randn(H, V).astype(np.float32) * 0.5
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        start = fluid.layers.data("start", shape=[1], dtype="int64")
+        start_sq = fluid.layers.squeeze(start, [1])
+        cell = fluid.layers.GRUCell(H, param_attr=_const_attr(0.1),
+                                    bias_attr=_const_attr(0.0))
+        embed = lambda ids: fluid.layers.embedding(
+            ids, size=[V, E],
+            param_attr=fluid.ParamAttr(
+                name="dec_emb",
+                initializer=fluid.initializer.NumpyArrayInitializer(emb_w)))
+        proj = lambda h: fluid.layers.fc(
+            h, V, num_flatten_dims=len(h.shape) - 1,
+            param_attr=fluid.ParamAttr(
+                name="dec_out_w",
+                initializer=fluid.initializer.NumpyArrayInitializer(out_w)),
+            bias_attr=False)
+        helper = fluid.layers.GreedyEmbeddingHelper(embed, start_sq,
+                                                    end_token=1)
+        decoder = fluid.layers.BasicDecoder(cell, helper, output_fn=proj)
+        outputs, _ = fluid.layers.dynamic_decode(
+            decoder,
+            inits=cell.get_initial_states(start_sq, shape=[H]),
+            max_step_num=MAX_T, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    startv = np.array([[2], [3], [4]], np.int64)
+    ids, = exe.run(main, feed={"start": startv},
+                   fetch_list=[outputs.sample_ids])
+
+    gw = np.full((E + H, 2 * H), 0.1, np.float32)
+    gb = np.zeros((2 * H,), np.float32)
+    cw = np.full((E + H, H), 0.1, np.float32)
+    cb = np.zeros((H,), np.float32)
+    expect = _greedy_np(startv[:, 0], emb_w, gw, gb, cw, cb, out_w,
+                        end_token=1, max_t=MAX_T)
+    t = expect.shape[1]
+    np.testing.assert_array_equal(ids[:, :t], expect)
+
+
+def test_beam_search_decode_runs_and_beats_greedy():
+    """Beam search must produce valid token paths whose model score is >=
+    the greedy path's (fundamental beam property, checked per batch)."""
+    B, H, V, E, K, MAX_T = 2, 8, 9, 5, 3, 6
+    rng = np.random.RandomState(5)
+    emb_w = rng.randn(V, E).astype(np.float32)
+    out_w = rng.randn(H, V).astype(np.float32)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        enc = fluid.layers.data("enc", shape=[H])
+        cell = fluid.layers.GRUCell(H, param_attr=_const_attr(0.1),
+                                    bias_attr=_const_attr(0.0))
+        embed = lambda ids: fluid.layers.embedding(
+            ids, size=[V, E],
+            param_attr=fluid.ParamAttr(
+                name="bs_emb",
+                initializer=fluid.initializer.NumpyArrayInitializer(emb_w)))
+        proj = lambda h: fluid.layers.fc(
+            h, V, num_flatten_dims=len(h.shape) - 1,
+            param_attr=fluid.ParamAttr(
+                name="bs_out_w",
+                initializer=fluid.initializer.NumpyArrayInitializer(out_w)),
+            bias_attr=False)
+        decoder = fluid.layers.BeamSearchDecoder(
+            cell, start_token=0, end_token=1, beam_size=K,
+            embedding_fn=embed, output_fn=proj)
+        outputs, _, lengths = fluid.layers.dynamic_decode(
+            decoder, inits=enc, max_step_num=MAX_T, is_test=True,
+            return_length=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    encv = rng.randn(B, H).astype(np.float32)
+    ids, lens = exe.run(main, feed={"enc": encv},
+                        fetch_list=[outputs, lengths])
+    assert ids.shape[0] == B and ids.shape[2] == K
+    assert np.issubdtype(ids.dtype, np.integer)
+    assert (ids >= 0).all() and (ids < V).all()
+
+    # score a token path under the model
+    def path_score(enc_h, toks):
+        h = enc_h[None]
+        gw = np.full((E + H, 2 * H), 0.1, np.float32)
+        gb = np.zeros((2 * H,), np.float32)
+        cw = np.full((E + H, H), 0.1, np.float32)
+        cb = np.zeros((H,), np.float32)
+        tok = np.array([0])
+        score = 0.0
+        for t in toks:
+            xh = emb_w[tok]
+            h = _np_gru_step(xh, h, gw, gb, cw, cb)
+            logits = (h @ out_w)[0]
+            logp = logits - np.log(np.exp(logits - logits.max()).sum()) \
+                - logits.max()
+            score += logp[t]
+            if t == 1:
+                break
+            tok = np.array([t])
+        return score
+
+    for b in range(B):
+        greedy = []
+        h = encv[b]
+        tok = 0
+        for _ in range(MAX_T):
+            gw = np.full((E + H, 2 * H), 0.1, np.float32)
+            gb = np.zeros((2 * H,), np.float32)
+            cw = np.full((E + H, H), 0.1, np.float32)
+            cb = np.zeros((H,), np.float32)
+            h = _np_gru_step(emb_w[tok][None], h[None], gw, gb, cw, cb)[0]
+            tok = int((h @ out_w).argmax())
+            greedy.append(tok)
+            if tok == 1:
+                break
+        gs = path_score(encv[b], greedy)
+        bs = path_score(encv[b], list(ids[b, :, 0]))
+        assert bs >= gs - 1e-4, (bs, gs)
+
+
+def test_training_helper_teacher_forcing_trains():
+    """BasicDecoder+TrainingHelper is differentiable end-to-end (the
+    bounded-scan decode loop supports training, which the reference gates
+    on is_test=False array bookkeeping)."""
+    B, T, V, E, H = 4, 5, 7, 6, 8
+    rng = np.random.RandomState(6)
+    xv = rng.randint(0, V, (B, T)).astype(np.int64)
+    lens = np.full((B, 1), T, np.int64)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        tgt = fluid.layers.data("tgt", shape=[T], dtype="int64")
+        sl = fluid.layers.data("sl", shape=[1], dtype="int64")
+        sl_sq = fluid.layers.squeeze(sl, [1])
+        emb = fluid.layers.embedding(
+            tgt, size=[V, E], param_attr=fluid.ParamAttr(name="th_emb"))
+        cell = fluid.layers.GRUCell(H)
+        proj = lambda h: fluid.layers.fc(
+            h, V, num_flatten_dims=len(h.shape) - 1,
+            param_attr=fluid.ParamAttr(name="th_proj"), bias_attr=False)
+        helper = fluid.layers.TrainingHelper(emb, sl_sq)
+        decoder = fluid.layers.BasicDecoder(cell, helper, output_fn=proj)
+        outputs, _ = fluid.layers.dynamic_decode(
+            decoder, inits=cell.get_initial_states(sl_sq, shape=[H]),
+            max_step_num=T)
+        logits = outputs.cell_outputs          # [B, T, V]
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(
+                logits, fluid.layers.unsqueeze(tgt, [2])))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for _ in range(15):
+        l, = exe.run(main, feed={"tgt": xv, "sl": lens},
+                     fetch_list=[loss])
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_gather_tree_numeric():
+    """gather_tree vs the reference's host backtrace
+    (ref: operators/gather_tree_op.h:30)."""
+    T, B, K = 4, 2, 2
+    rng = np.random.RandomState(7)
+    ids = rng.randint(0, 9, (T, B, K)).astype(np.int64)
+    parents = rng.randint(0, K, (T, B, K)).astype(np.int64)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        i = fluid.layers.data("i", shape=[B, K], dtype="int64")
+        p = fluid.layers.data("p", shape=[B, K], dtype="int64")
+        out = fluid.layers.gather_tree(i, p)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r, = exe.run(main, feed={"i": ids, "p": parents}, fetch_list=[out])
+
+    expect = np.zeros_like(ids)
+    for b in range(B):
+        for k in range(K):
+            expect[T - 1, b, k] = ids[T - 1, b, k]
+            parent = parents[T - 1, b, k]
+            for t in range(T - 2, -1, -1):
+                expect[t, b, k] = ids[t, b, parent]
+                parent = parents[t, b, parent]
+    np.testing.assert_array_equal(r, expect)
+
+
+def test_sample_embedding_helper_decodes():
+    """SampleEmbeddingHelper (Gumbel-max categorical sampling) produces
+    valid ids and respects the end token (ref: layers/rnn.py:1751)."""
+    B, H, V, E, MAX_T = 3, 6, 8, 5, 6
+    rng = np.random.RandomState(8)
+    emb_w = rng.randn(V, E).astype(np.float32)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        start = fluid.layers.data("start", shape=[1], dtype="int64")
+        start_sq = fluid.layers.squeeze(start, [1])
+        cell = fluid.layers.GRUCell(H)
+        embed = lambda ids: fluid.layers.embedding(
+            ids, size=[V, E],
+            param_attr=fluid.ParamAttr(
+                name="se_emb",
+                initializer=fluid.initializer.NumpyArrayInitializer(emb_w)))
+        proj = lambda h: fluid.layers.fc(
+            h, V, num_flatten_dims=len(h.shape) - 1,
+            param_attr=fluid.ParamAttr(name="se_proj"), bias_attr=False)
+        helper = fluid.layers.SampleEmbeddingHelper(embed, start_sq,
+                                                    end_token=1)
+        decoder = fluid.layers.BasicDecoder(cell, helper, output_fn=proj)
+        outputs, _ = fluid.layers.dynamic_decode(
+            decoder, inits=cell.get_initial_states(start_sq, shape=[H]),
+            max_step_num=MAX_T, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    startv = np.array([[2], [3], [4]], np.int64)
+    ids, = exe.run(main, feed={"start": startv},
+                   fetch_list=[outputs.sample_ids])
+    assert ids.shape[:2] == (B, MAX_T)
+    assert (ids >= 0).all() and (ids < V).all()
