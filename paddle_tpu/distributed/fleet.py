@@ -92,10 +92,19 @@ class TPURoleMaker(RoleMakerBase):
                 jax.distributed.initialize(self._coordinator,
                                            self._num_processes,
                                            self._process_id)
-            except RuntimeError:
-                # already initialized (the active-client probe uses a
-                # private jax API and may misreport across jax versions)
-                pass
+            except RuntimeError as e:
+                # tolerate ONLY double-init (the active-client probe uses a
+                # private jax API and may misreport across jax versions);
+                # a swallowed connection failure would silently degrade to
+                # independent single-process training
+                if "already initialized" not in str(e):
+                    raise
+        if self._num_processes is not None and \
+                jax.process_count() != self._num_processes:
+            raise RuntimeError(
+                f"jax.distributed topology mismatch: expected "
+                f"{self._num_processes} processes, runtime reports "
+                f"{jax.process_count()} — coordinator unreachable?")
         self._worker_index = jax.process_index()
         self._worker_num = jax.process_count()
         self._generated = True

@@ -257,6 +257,8 @@ class _CompiledStep:
         self.mesh = mesh
         self.feed_spec_fn = feed_spec_fn
         self.state_in_specs = state_in_specs or {}
+        # fixed per compiled step — don't walk mesh.devices every run()
+        self.spans_processes = _mesh_spans_processes(mesh)
 
 
 def _mesh_spans_processes(mesh):
@@ -391,7 +393,7 @@ class Executor:
             key = jax.random.PRNGKey(program.random_seed)
 
         feed_vals = {k: feed[k] for k in step.feed_names}
-        if _mesh_spans_processes(mesh):
+        if step.spans_processes:
             # multi-host regime (ref: num_trainers>1): each process feeds
             # its LOCAL batch shard; lift everything to global jax.Arrays
             from jax.sharding import PartitionSpec as P
@@ -486,11 +488,11 @@ class Executor:
     @staticmethod
     def _check_nan_inf(fetch_names, fetches, state_out):
         bad = []
+        multihost = False
         for n, v in list(zip(fetch_names, fetches)) + list(state_out.items()):
             if isinstance(v, jax.Array) and not v.is_fully_addressable:
                 # multi-host array: scan the shards this process owns
-                # (every shard is owned by SOME process, so a NaN anywhere
-                # raises on its owner)
+                multihost = True
                 arrs = [np.asarray(s.data) for s in v.addressable_shards]
             else:
                 arrs = [np.asarray(v)]
@@ -499,6 +501,15 @@ class Executor:
                         not np.isfinite(a).all():
                     bad.append(n)
                     break
+        if multihost:
+            # agree across processes so ALL ranks raise together — a
+            # one-sided raise would leave the healthy ranks blocked in the
+            # next step's collective
+            from jax.experimental import multihost_utils
+            all_bad = multihost_utils.process_allgather(
+                np.asarray(len(bad), np.int32))
+            if int(np.sum(all_bad)) and not bad:
+                bad = ["<on another host>"]
         if bad:
             raise RuntimeError(
                 f"Operator output contains NaN/Inf (FLAGS_check_nan_inf): "

@@ -25,6 +25,23 @@ from .framework.executor import Scope, global_scope
 _RNG_VAR = "@RNG_STATE@"
 
 
+def _host_value(v, name="<var>"):
+    """Scope value → numpy, handling multi-host global jax.Arrays (the
+    spans_processes executor path stores those).  Replicated arrays read
+    their local replica; sharded-across-hosts state needs sharded
+    checkpointing (orbax tier) and fails loudly for now."""
+    import jax
+    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+        if v.sharding.is_fully_replicated:
+            return np.asarray(v.addressable_data(0))
+        raise NotImplementedError(
+            f"persistable {name!r} is sharded across hosts — gather it "
+            f"(e.g. save on a replicated copy) or use sharded "
+            f"checkpointing; whole-array save would need non-addressable "
+            f"shards")
+    return np.asarray(v)
+
+
 def _persistable_names(program: Program) -> List[str]:
     # every persistable except the RNG key (saved separately by
     # save_checkpoint) — LR-scheduler step counters etc. MUST be included
@@ -45,7 +62,7 @@ def save_persistables(executor, dirname, main_program: Optional[Program] = None,
     for name in _persistable_names(main_program):
         v = scope.find_var(name)
         if v is not None:
-            arrays[name] = np.asarray(v)
+            arrays[name] = _host_value(v, name)
     np.savez(os.path.join(dirname, filename), **arrays)
 
 
@@ -92,9 +109,14 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         "fetch_names": [v.name if isinstance(v, Variable) else str(v)
                         for v in target_vars],
     }
+    # versioned desc schema, NOT pickled live objects — artifacts survive
+    # class-layout changes (ref contract: framework.proto:211 ProgramDesc
+    # with version field)
+    from .framework.serialization import program_to_desc
+    payload = {"program_desc": program_to_desc(pruned), "meta": meta}
     with open(os.path.join(dirname, model_filename or "__model__"),
-              "wb") as f:
-        pickle.dump({"program": pruned, "meta": meta}, f)
+              "w") as f:
+        json.dump(payload, f)
     save_persistables(executor, dirname, pruned,
                       params_filename or "params.npz", scope)
     return meta["fetch_names"]
@@ -106,10 +128,17 @@ def load_inference_model(dirname, executor,
                          scope: Optional[Scope] = None):
     """ref: io.py:1374 — returns (program, feed_names, fetch_vars)."""
     scope = scope or global_scope()
-    with open(os.path.join(dirname, model_filename or "__model__"),
-              "rb") as f:
-        payload = pickle.load(f)
-    program: Program = payload["program"]
+    path = os.path.join(dirname, model_filename or "__model__")
+    try:
+        with open(path, "r") as f:
+            payload = json.load(f)
+        from .framework.serialization import desc_to_program
+        program: Program = desc_to_program(payload["program_desc"])
+    except (UnicodeDecodeError, json.JSONDecodeError):
+        # round-1/2 artifacts were pickled live objects; keep reading them
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        program = payload["program"]
     meta = payload["meta"]
     load_persistables(executor, dirname, program,
                       params_filename or "params.npz", scope)
@@ -155,7 +184,7 @@ def save_checkpoint(executor, path, train_status: TrainStatus,
     save_persistables(executor, d, main_program, scope=scope)
     rng = scope.find_var(_RNG_VAR)
     if rng is not None:
-        np.save(os.path.join(d, "rng.npy"), np.asarray(rng))
+        np.save(os.path.join(d, "rng.npy"), _host_value(rng, _RNG_VAR))
     with open(os.path.join(d, "train_status.json"), "w") as f:
         json.dump(train_status.to_dict(), f)
     if not remain_all_checkpoint:

@@ -54,8 +54,16 @@ def _allreduce(reducer):
 register("c_allreduce_sum")(_allreduce(lambda a, ax: lax.psum(a, ax)))
 register("c_allreduce_max")(_allreduce(lambda a, ax: lax.pmax(a, ax)))
 register("c_allreduce_min")(_allreduce(lambda a, ax: lax.pmin(a, ax)))
-register("c_allreduce_prod")(_allreduce(
-    lambda a, ax: jnp.exp(lax.psum(jnp.log(a), ax))))
+def _psum_prod(a, ax):
+    """Exact product-allreduce (ref semantics: ncclProd) — all_gather the
+    shards and multiply.  exp∘psum∘log would break on zeros/negatives and
+    rounds integers; a prod-allreduce is rare enough that the n× gather
+    bandwidth is irrelevant."""
+    gathered = lax.all_gather(a, ax)          # [n, ...] leading axis
+    return jnp.prod(gathered, axis=0).astype(a.dtype)
+
+
+register("c_allreduce_prod")(_allreduce(_psum_prod))
 
 
 @register("c_broadcast")

@@ -98,3 +98,39 @@ def test_collective_ops_single_rank_identity():
     xv = np.ones((2, 4), np.float32)
     r, = exe.run(main, feed={"x": xv}, fetch_list=[out])
     np.testing.assert_array_equal(r, xv)
+
+
+def test_c_allreduce_prod_zeros_and_negatives():
+    """Product-allreduce must be exact on zeros and negative factors
+    (ref semantics: ncclProd, collective/c_allreduce_op.h:33)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import get_op
+    from paddle_tpu.framework.executor import LoweringContext
+    from paddle_tpu.framework.compiler import make_mesh
+
+    mesh = make_mesh(8, "dp")
+    vals = np.array([2.0, -3.0, 1.0, -1.0, 0.5, 4.0, -2.0, 1.0],
+                    np.float32)
+
+    impl = get_op("c_allreduce_prod")
+
+    def shard_fn(v):
+        ctx = LoweringContext(jax.random.PRNGKey(0), mesh, ("dp",), False)
+        return impl(ctx, {"X": [v]}, {"ring_id": 0})["Out"]
+
+    out = jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=jax.sharding.PartitionSpec("dp"),
+        out_specs=jax.sharding.PartitionSpec("dp")))(vals)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, np.prod(vals)),
+                               rtol=1e-5)
+
+    # one rank contributes a zero → exact 0, not NaN
+    vals0 = vals.copy()
+    vals0[3] = 0.0
+    out0 = jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=jax.sharding.PartitionSpec("dp"),
+        out_specs=jax.sharding.PartitionSpec("dp")))(vals0)
+    np.testing.assert_array_equal(np.asarray(out0), np.zeros(8, np.float32))

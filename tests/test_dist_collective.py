@@ -48,14 +48,19 @@ def _run_cluster(nproc=2, timeout=420):
         procs.append(subprocess.Popen(
             [sys.executable, runner], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-    results, errs = [], []
-    for p in procs:
+    # drain all workers CONCURRENTLY — collectively-coupled processes can
+    # deadlock on a full pipe if drained one at a time
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(len(procs)) as pool:
+        futs = [pool.submit(p.communicate, timeout=timeout) for p in procs]
         try:
-            out, err = p.communicate(timeout=timeout)
+            outs = [f.result() for f in futs]
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             raise
+    results, errs = [], []
+    for p, (out, err) in zip(procs, outs):
         errs.append(err)
         for line in out.splitlines():
             if line.startswith("DIST_LOSSES "):
