@@ -115,11 +115,14 @@ class DataLoader:
                  drop_last: bool = False, collate_fn=None,
                  num_workers: int = 0, capacity: int = 8,
                  batch_sampler: Optional[BatchSampler] = None,
-                 num_replicas: int = 1, rank: int = 0, seed=None):
+                 num_replicas: int = 1, rank: int = 0, seed=None,
+                 use_multiprocess: bool = False):
         self.dataset = dataset
         self.feed_list = feed_list
         self.capacity = capacity
         self.collate_fn = collate_fn or default_collate
+        self.num_workers = num_workers
+        self.use_multiprocess = use_multiprocess or num_workers > 0
         self._generator = None
         self._feed_names = [getattr(v, "name", v) for v in (feed_list or [])]
         if dataset is not None and not isinstance(dataset, IterableDataset):
@@ -135,7 +138,8 @@ class DataLoader:
     def from_generator(feed_list=None, capacity=8, use_double_buffer=True,
                        iterable=True, return_list=False,
                        use_multiprocess=False, drop_last=True):
-        return DataLoader(feed_list=feed_list, capacity=capacity)
+        return DataLoader(feed_list=feed_list, capacity=capacity,
+                          use_multiprocess=use_multiprocess)
 
     def set_sample_generator(self, reader, batch_size, drop_last=True,
                              places=None):
@@ -184,6 +188,24 @@ class DataLoader:
         return batch
 
     def __iter__(self):
+        if self.use_multiprocess:
+            # worker PROCESSES + shared-memory transport (ref:
+            # reader.py:113 multiprocess mode + mmap_allocator.h) — the
+            # GIL-free path for Python-heavy sample pipelines
+            from .worker import MultiprocessIterator
+            n = self.num_workers or 2
+            if self._generator is not None:
+                return MultiprocessIterator(
+                    generator=self._generator, num_workers=n,
+                    capacity=self.capacity, to_feed=self._to_feed)
+            if self.batch_sampler is not None:
+                return MultiprocessIterator(
+                    dataset=self.dataset,
+                    index_batches=list(self.batch_sampler),
+                    collate_fn=self.collate_fn, num_workers=n,
+                    capacity=self.capacity, to_feed=self._to_feed)
+            # IterableDataset can't be split safely — fall through to the
+            # thread path rather than silently duplicating samples
         return _PrefetchIterator(self._produce, self.capacity)
 
     def __len__(self):

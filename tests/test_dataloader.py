@@ -74,3 +74,81 @@ def test_sample_generator_batches():
     batches = list(dl)
     assert len(batches) == 2
     assert batches[0][0].shape == (4,)
+
+
+class _PyHeavyDataset:
+    """BERT-shaped samples with deliberately Python-heavy tokenize-ish
+    work — the case the GIL serializes on the thread loader."""
+
+    def __init__(self, n=256, seq=128):
+        self.n = n
+        self.seq = seq
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        ids = [0] * self.seq
+        acc = i
+        for t in range(self.seq):          # pure-python token munging
+            acc = (acc * 1103515245 + 12345) % (2 ** 31)
+            ids[t] = acc % 30522
+        mask = [1 if t < self.seq - (i % 7) else 0 for t in range(self.seq)]
+        return (np.asarray(ids, np.int64), np.asarray(mask, np.int64),
+                rng.randint(0, 2, (1,)).astype(np.int64))
+
+
+def test_multiprocess_loader_order_and_content():
+    """mp workers must reproduce EXACTLY the thread loader's batches,
+    in order (ref contract: reader.py multiprocess mode is transparent)."""
+    from paddle_tpu.dataloader.reader import DataLoader
+    ds = _PyHeavyDataset(n=32, seq=16)
+    ref = list(DataLoader(ds, batch_size=8, num_workers=0))
+    mp_ = list(DataLoader(ds, batch_size=8, num_workers=3))
+    assert len(ref) == len(mp_) == 4
+    for rb, mb in zip(ref, mp_):
+        for ra, ma in zip(rb, mb):
+            np.testing.assert_array_equal(ra, ma)
+
+
+def test_multiprocess_generator_path():
+    from paddle_tpu.dataloader.reader import DataLoader
+
+    def gen():
+        for i in range(6):
+            yield {"x": np.full((4, 3), i, np.float32)}
+
+    dl = DataLoader.from_generator(capacity=4, use_multiprocess=True)
+    dl.set_batch_generator(gen)
+    seen = [float(b["x"][0, 0]) for b in dl]
+    assert seen == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+
+def test_multiprocess_loader_outruns_threads():
+    """Throughput: worker processes must beat the GIL-bound thread loader
+    on Python-heavy samples (the VERDICT #6 'use_multiprocess is real'
+    criterion)."""
+    import multiprocessing
+    import os
+    import time
+
+    import pytest
+    if not os.environ.get("PADDLE_TPU_PERF_TESTS"):
+        pytest.skip("wall-clock perf assertion; set PADDLE_TPU_PERF_TESTS=1")
+    if multiprocessing.cpu_count() < 4:
+        pytest.skip("needs >= 4 cpus")
+    from paddle_tpu.dataloader.reader import DataLoader
+    ds = _PyHeavyDataset(n=192, seq=128)
+
+    def consume(loader):
+        t0 = time.perf_counter()
+        n = 0
+        for batch in loader:
+            n += batch[0].shape[0]
+        return time.perf_counter() - t0, n
+
+    t_thread, n1 = consume(DataLoader(ds, batch_size=16, num_workers=0))
+    t_mp, n2 = consume(DataLoader(ds, batch_size=16, num_workers=4))
+    assert n1 == n2 == 192
+    assert t_mp < t_thread * 0.8, (t_mp, t_thread)
