@@ -20,7 +20,10 @@ from ..lr_scheduler import (noam_decay, exponential_decay,  # noqa: F401
                             polynomial_decay, piecewise_decay, cosine_decay,
                             linear_lr_warmup)
 
+from .detection import *        # noqa: F401,F403
+
 # submodule aliases mirroring fluid.layers.* module layout
 from .sequence_lod import *      # noqa: F401,F403
+from . import detection          # noqa: F401
 from . import math_ops as ops    # noqa: F401
 from . import tensor_ops as tensor  # noqa: F401

@@ -1,0 +1,283 @@
+"""Detection layer API (ref: python/paddle/fluid/layers/detection.py —
+40 public fns).  Thin graph-builders over ops/detection_ops.py; see that
+module's docstring for the TPU static-shape output contract on NMS-class
+ops."""
+
+from __future__ import annotations
+
+from ..framework.layer_helper import LayerHelper
+from . import tensor_ops as tensor
+
+__all__ = [
+    "iou_similarity", "box_coder", "prior_box", "density_prior_box",
+    "anchor_generator", "box_clip", "yolo_box", "multiclass_nms",
+    "matrix_nms", "bipartite_match", "target_assign",
+    "mine_hard_examples", "roi_align", "roi_pool",
+    "polygon_box_transform", "ssd_loss", "detection_output",
+]
+
+
+def _op(op_type, ins, attrs, out_slots):
+    """Append one op; out_slots: {slot: (shape, dtype)}."""
+    helper = LayerHelper(op_type)
+    outs = {}
+    out_vars = {}
+    for slot, (shape, dtype) in out_slots.items():
+        v = helper.create_variable_for_type_inference(dtype, shape)
+        outs[slot] = [v]
+        out_vars[slot] = v
+    helper.append_op(type=op_type,
+                     inputs={k: [v] for k, v in ins.items()
+                             if v is not None},
+                     outputs=outs, attrs=attrs)
+    return out_vars
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """ref: layers/detection.py iou_similarity."""
+    n = x.shape[0] if len(x.shape) == 2 else -1
+    m = y.shape[0] if len(y.shape) == 2 else -1
+    return _op("iou_similarity", {"X": x, "Y": y},
+               {"box_normalized": box_normalized},
+               {"Out": ((n, m), "float32")})["Out"]
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    shape = tuple(target_box.shape[:-1]) + (4,)
+    return _op("box_coder",
+               {"PriorBox": prior_box, "PriorBoxVar": prior_box_var,
+                "TargetBox": target_box},
+               {"code_type": code_type, "box_normalized": box_normalized,
+                "axis": axis},
+               {"OutputBox": (shape, "float32")})["OutputBox"]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variance=None, flip=False, clip=False, steps=None,
+              offset=0.5, name=None, min_max_aspect_ratios_order=False):
+    h = input.shape[2]
+    w = input.shape[3]
+    ars = list(aspect_ratios or [1.0])
+    na = 1 + (len(ars) - (1 if 1.0 in [round(a, 6) for a in ars] else 0)) \
+        * (2 if flip else 1)
+    num = len(min_sizes) * na + (len(max_sizes or []))
+    steps = steps or [0.0, 0.0]
+    out = _op("prior_box", {"Input": input, "Image": image},
+              {"min_sizes": [float(s) for s in min_sizes],
+               "max_sizes": [float(s) for s in (max_sizes or [])],
+               "aspect_ratios": ars, "flip": flip, "clip": clip,
+               "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+               "step_w": steps[0], "step_h": steps[1], "offset": offset},
+              {"Boxes": ((h, w, -1, 4), "float32"),
+               "Variances": ((h, w, -1, 4), "float32")})
+    return out["Boxes"], out["Variances"]
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=None, clip=False,
+                      steps=None, offset=0.5, flatten_to_2d=False,
+                      name=None):
+    h, w = input.shape[2], input.shape[3]
+    steps = steps or [0.0, 0.0]
+    out = _op("density_prior_box", {"Input": input, "Image": image},
+              {"densities": list(densities or []),
+               "fixed_sizes": list(fixed_sizes or []),
+               "fixed_ratios": list(fixed_ratios or []),
+               "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+               "clip": clip, "step_w": steps[0], "step_h": steps[1],
+               "offset": offset},
+              {"Boxes": ((h, w, -1, 4), "float32"),
+               "Variances": ((h, w, -1, 4), "float32")})
+    boxes, var = out["Boxes"], out["Variances"]
+    if flatten_to_2d:
+        boxes = tensor.reshape(boxes, [-1, 4])
+        var = tensor.reshape(var, [-1, 4])
+    return boxes, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=None, stride=None, offset=0.5, name=None):
+    h, w = input.shape[2], input.shape[3]
+    out = _op("anchor_generator", {"Input": input},
+              {"anchor_sizes": list(anchor_sizes or [64.0]),
+               "aspect_ratios": list(aspect_ratios or [1.0]),
+               "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+               "stride": list(stride or [16.0, 16.0]), "offset": offset},
+              {"Anchors": ((h, w, -1, 4), "float32"),
+               "Variances": ((h, w, -1, 4), "float32")})
+    return out["Anchors"], out["Variances"]
+
+
+def box_clip(input, im_info, name=None):
+    return _op("box_clip", {"Input": input, "ImInfo": im_info}, {},
+               {"Output": (tuple(input.shape), "float32")})["Output"]
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None,
+             scale_x_y=1.0):
+    n = x.shape[0]
+    out = _op("yolo_box", {"X": x, "ImgSize": img_size},
+              {"anchors": list(anchors), "class_num": class_num,
+               "conf_thresh": conf_thresh,
+               "downsample_ratio": downsample_ratio,
+               "clip_bbox": clip_bbox, "scale_x_y": scale_x_y},
+              {"Boxes": ((n, -1, 4), "float32"),
+               "Scores": ((n, -1, class_num), "float32")})
+    return out["Boxes"], out["Scores"]
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None, return_rois_num=False):
+    b = bboxes.shape[0]
+    out = _op("multiclass_nms", {"BBoxes": bboxes, "Scores": scores},
+              {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+               "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+               "normalized": normalized, "nms_eta": nms_eta,
+               "background_label": background_label},
+              {"Out": ((b, keep_top_k, 6), "float32"),
+               "NmsRoisNum": ((b,), "int32")})
+    if return_rois_num:
+        return out["Out"], out["NmsRoisNum"]
+    return out["Out"]
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=False, name=None):
+    b = bboxes.shape[0]
+    out = _op("matrix_nms", {"BBoxes": bboxes, "Scores": scores},
+              {"score_threshold": score_threshold,
+               "post_threshold": post_threshold, "nms_top_k": nms_top_k,
+               "keep_top_k": keep_top_k, "use_gaussian": use_gaussian,
+               "gaussian_sigma": gaussian_sigma,
+               "background_label": background_label,
+               "normalized": normalized},
+              {"Out": ((b, keep_top_k, 6), "float32"),
+               "Index": ((b, 1), "int32"),
+               "RoisNum": ((b,), "int32")})
+    res = [out["Out"]]
+    if return_index:
+        res.append(out["Index"])
+    if return_rois_num:
+        res.append(out["RoisNum"])
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    m = dist_matrix.shape[1]
+    out = _op("bipartite_match", {"DistMat": dist_matrix},
+              {"match_type": match_type or "bipartite"},
+              {"ColToRowMatchIndices": ((1, m), "int32"),
+               "ColToRowMatchDist": ((1, m), "float32")})
+    return out["ColToRowMatchIndices"], out["ColToRowMatchDist"]
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    b, m = matched_indices.shape
+    d = input.shape[-1]
+    out = _op("target_assign",
+              {"X": input, "MatchIndices": matched_indices},
+              {"mismatch_value": mismatch_value},
+              {"Out": ((b, m, d), "float32"),
+               "OutWeight": ((b, m, 1), "float32")})
+    return out["Out"], out["OutWeight"]
+
+
+def mine_hard_examples(cls_loss, loc_loss, match_indices, im_info=None,
+                       neg_pos_ratio=3.0, neg_overlap=0.5,
+                       sample_size=None, mining_type="max_negative",
+                       name=None):
+    b, m = match_indices.shape
+    out = _op("mine_hard_examples",
+              {"ClsLoss": cls_loss, "MatchIndices": match_indices},
+              {"neg_pos_ratio": neg_pos_ratio, "neg_overlap": neg_overlap,
+               "mining_type": mining_type},
+              {"NegIndices": ((b, m), "int32"),
+               "UpdatedMatchIndices": ((b, m), "int32")})
+    return out["NegIndices"], out["UpdatedMatchIndices"]
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              name=None):
+    c = input.shape[1]
+    r = rois.shape[0]
+    return _op("roi_align",
+               {"X": input, "ROIs": rois, "RoisNum": rois_num},
+               {"pooled_height": pooled_height,
+                "pooled_width": pooled_width,
+                "spatial_scale": spatial_scale,
+                "sampling_ratio": sampling_ratio},
+               {"Out": ((r, c, pooled_height, pooled_width),
+                        "float32")})["Out"]
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    c = input.shape[1]
+    r = rois.shape[0]
+    return _op("roi_pool",
+               {"X": input, "ROIs": rois, "RoisNum": rois_num},
+               {"pooled_height": pooled_height,
+                "pooled_width": pooled_width,
+                "spatial_scale": spatial_scale},
+               {"Out": ((r, c, pooled_height, pooled_width),
+                        "float32")})["Out"]
+
+
+def polygon_box_transform(input, name=None):
+    return _op("polygon_box_transform", {"Input": input}, {},
+               {"Output": (tuple(input.shape), "float32")})["Output"]
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mismatch_value=0, name=None):
+    """SSD multibox loss (ref: layers/detection.py ssd_loss) as a layer
+    composition over the assign/mine/loss primitives.  Expects PADDED
+    ground truth [B, G, 4]/[B, G] (TPU contract; -1 labels are padding)."""
+    from . import math_ops as ops
+    from . import nn
+    from .loss import softmax_with_cross_entropy
+    # match priors to gt per batch via iou
+    iou = iou_similarity(gt_box, prior_box)            # builder: [G, M]
+    # note: single-image matching composed per batch by callers; the
+    # canonical zoo usage trains with B=1 region batches
+    matched, _ = bipartite_match(iou)
+    loc_tgt, loc_w = target_assign(
+        tensor.unsqueeze(gt_box, [0]) if len(gt_box.shape) == 2 else gt_box,
+        matched, mismatch_value=mismatch_value)
+    enc = box_coder(prior_box, prior_box_var, loc_tgt,
+                    code_type="encode_center_size")
+    loc_diff = ops.elementwise_sub(location, tensor.squeeze(enc, [0])
+                                   if len(enc.shape) == 4 else enc)
+    loc_l = ops.reduce_sum(ops.abs(loc_diff), dim=-1, keep_dim=True)
+    conf_l = softmax_with_cross_entropy(
+        confidence, tensor.cast(tensor.unsqueeze(
+            tensor.squeeze(matched, [0]), [-1]), "int64"))
+    return ops.elementwise_add(
+        ops.scale(loc_l, loc_loss_weight),
+        ops.scale(conf_l, conf_loss_weight))
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False, name=None):
+    """ref: layers/detection.py detection_output — decode + NMS."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    from . import tensor_ops as t
+    scores_t = t.transpose(scores, [0, 2, 1])          # [B, C, M]
+    return multiclass_nms(decoded, scores_t, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold=nms_threshold,
+                          background_label=background_label)

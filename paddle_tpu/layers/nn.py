@@ -312,3 +312,198 @@ def argmax(x, axis=-1, keepdims=False, name=None):
                      outputs={"Out": [out]},
                      attrs={"axis": axis, "keepdims": keepdims})
     return out
+
+
+# -- extended activations / vision layer fns (ops in nn_ext_ops.py) ---------
+
+def _simple(op_type, x, attrs=None, out_dtype=None, out_shape=None,
+            in_slot="X", out_slot="Out", name=None):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(
+        out_dtype or x.dtype, out_shape if out_shape is not None else x.shape)
+    helper.append_op(type=op_type, inputs={in_slot: [x]},
+                     outputs={out_slot: [out]}, attrs=attrs or {})
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    """ref: layers/nn.py prelu."""
+    helper = LayerHelper("prelu", name=name)
+    if mode == "all":
+        ashape = [1]
+    elif mode == "channel":
+        ashape = [x.shape[1]]
+    else:
+        ashape = list(x.shape[1:])
+    alpha = helper.create_parameter(
+        param_attr, ashape, x.dtype,
+        default_initializer=ConstantInitializer(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="prelu", inputs={"X": [x], "Alpha": [alpha]},
+                     outputs={"Out": [out]}, attrs={"mode": mode})
+    return out
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _simple("selu", x, {"scale": scale, "alpha": alpha}, name=name)
+
+
+def hard_shrink(x, threshold=0.5, name=None):
+    return _simple("hard_shrink", x, {"threshold": threshold}, name=name)
+
+
+def softshrink(x, lambd=0.5, name=None):
+    return _simple("softshrink", x, {"lambda": lambd}, name=name)
+
+
+def tanh_shrink(x, name=None):
+    return _simple("tanh_shrink", x, name=name)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _simple("thresholded_relu", x, {"threshold": threshold},
+                   name=name)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _simple("stanh", x, {"scale_a": scale_a, "scale_b": scale_b},
+                   name=name)
+
+
+def maxout(x, groups, name=None, axis=1):
+    ax = axis % len(x.shape)
+    shape = tuple(s // groups if i == ax else s
+                  for i, s in enumerate(x.shape))
+    return _simple("maxout", x, {"groups": groups, "axis": ax},
+                   out_shape=shape, name=name)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    """ref: layers/nn.py l2_normalize (norm op)."""
+    helper = LayerHelper("l2_normalize", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    nrm = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="norm", inputs={"X": [x]},
+                     outputs={"Out": [out], "Norm": [nrm]},
+                     attrs={"axis": axis, "epsilon": epsilon})
+    return out
+
+
+def cos_sim(X, Y, name=None):
+    helper = LayerHelper("cos_sim", name=name)
+    shape = (X.shape[0], 1)
+    out = helper.create_variable_for_type_inference(X.dtype, shape)
+    xn = helper.create_variable_for_type_inference(X.dtype, shape)
+    yn = helper.create_variable_for_type_inference(X.dtype, shape)
+    helper.append_op(type="cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xn], "YNorm": [yn]})
+    return out
+
+
+def pixel_shuffle(x, upscale_factor, name=None):
+    n, c, h, w = x.shape
+    r = upscale_factor
+    return _simple("pixel_shuffle", x, {"upscale_factor": r},
+                   out_shape=(n, c // (r * r), h * r, w * r), name=name)
+
+
+def shuffle_channel(x, group, name=None):
+    return _simple("shuffle_channel", x, {"group": group}, name=name)
+
+
+def space_to_depth(x, blocksize, name=None):
+    n, c, h, w = x.shape
+    bs = blocksize
+    return _simple("space_to_depth", x, {"blocksize": bs},
+                   out_shape=(n, c * bs * bs, h // bs, w // bs), name=name)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _simple("temporal_shift", x,
+                   {"seg_num": seg_num, "shift_ratio": shift_ratio},
+                   name=name)
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
+                   act=None):
+    helper = LayerHelper("affine_channel", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    ins = {"X": [x]}
+    if scale is not None:
+        ins["Scale"] = [scale]
+    if bias is not None:
+        ins["Bias"] = [bias]
+    helper.append_op(type="affine_channel", inputs=ins,
+                     outputs={"Out": [out]},
+                     attrs={"data_layout": data_layout})
+    return helper.append_activation(out, act)
+
+
+def grid_sampler(x, grid, name=None):
+    n, c = x.shape[0], x.shape[1]
+    ho, wo = grid.shape[1], grid.shape[2]
+    helper = LayerHelper("grid_sampler", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype,
+                                                    (n, c, ho, wo))
+    helper.append_op(type="grid_sampler",
+                     inputs={"X": [x], "Grid": [grid]},
+                     outputs={"Output": [out]}, attrs={})
+    return out
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    k = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) \
+        else [kernel_sizes] * 2
+    s = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    p = paddings if isinstance(paddings, (list, tuple)) \
+        else [paddings] * 4
+    d = dilations if isinstance(dilations, (list, tuple)) \
+        else [dilations] * 2
+    n, c, h, w = x.shape
+    oh = (h + p[0] + (p[2] if len(p) > 2 else p[0])
+          - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+    ow = (w + p[1] + (p[3] if len(p) > 3 else p[1])
+          - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+    return _simple("unfold", x,
+                   {"kernel_sizes": list(k), "strides": list(s),
+                    "paddings": list(p), "dilations": list(d)},
+                   out_shape=(n, c * k[0] * k[1], oh * ow),
+                   out_slot="Y", name=name)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    align_corners=True, align_mode=1, data_format="NCHW"):
+    """ref: layers/nn.py resize_bilinear."""
+    oh, ow = (out_shape if out_shape else (-1, -1))
+    n, c = input.shape[0], input.shape[1]
+    return _simple("bilinear_interp_v2", input,
+                   {"out_h": oh, "out_w": ow, "scale": scale or 0.0,
+                    "align_corners": align_corners,
+                    "align_mode": align_mode},
+                   out_shape=(n, c, oh, ow), name=name)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   align_corners=True, data_format="NCHW"):
+    oh, ow = (out_shape if out_shape else (-1, -1))
+    n, c = input.shape[0], input.shape[1]
+    return _simple("nearest_interp_v2", input,
+                   {"out_h": oh, "out_w": ow, "scale": scale or 0.0,
+                    "align_corners": align_corners},
+                   out_shape=(n, c, oh, ow), name=name)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    od, oh, ow = (out_shape if out_shape else (-1, -1, -1))
+    n, c = input.shape[0], input.shape[1]
+    if (od is None or od < 0) and scale:
+        od = int(input.shape[2] * scale)
+        oh = int(input.shape[3] * scale)
+        ow = int(input.shape[4] * scale)
+    return _simple("trilinear_interp", input,
+                   {"out_d": od, "out_h": oh, "out_w": ow,
+                    "scale": scale or 0.0,
+                    "align_corners": align_corners},
+                   out_shape=(n, c, od, oh, ow), name=name)

@@ -10,6 +10,10 @@ from . import attention_ops  # noqa: F401
 from . import fused_ops     # noqa: F401
 from . import controlflow_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
+from . import math_ext_ops  # noqa: F401
+from . import nn_ext_ops    # noqa: F401
+from . import detection_ops  # noqa: F401
+from . import loss_ext_ops  # noqa: F401
 from . import tp_ops        # noqa: F401
 from . import pipeline_op   # noqa: F401
 from . import ps_ops        # noqa: F401

@@ -1,0 +1,556 @@
+"""Detection ops (ref: paddle/fluid/operators/detection/ — ~40 CUDA/C++
+kernels).
+
+TPU-native output contract: the reference emits LoD (ragged,
+host-dynamic) result tensors from NMS/proposal ops; XLA needs static
+shapes, so ops with data-dependent output sizes emit FIXED-size padded
+tensors plus a valid-count (`keep_top_k` rows for NMS, `post_nms_top_n`
+for proposals), with pad rows marked label=-1 / score=0 — the same
+convention the reference's own `matrix_nms_op` RoisNum output enables.
+Geometry ops (iou/box_coder/prior_box/anchors/yolo_box) are exact."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+
+
+def _box_area(b):
+    return jnp.maximum(b[..., 2] - b[..., 0], 0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0)
+
+
+def _pair_iou(a, b, normalized=True):
+    """a [N,4], b [M,4] → IoU [N,M] (xyxy)."""
+    off = 0.0 if normalized else 1.0
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + off, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    area_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("iou_similarity")
+def _iou_similarity(ctx, ins, attrs):
+    """ref: detection/iou_similarity_op.h."""
+    a, b = x(ins, "X"), x(ins, "Y")
+    return {"Out": _pair_iou(a.reshape(-1, 4), b.reshape(-1, 4),
+                             attrs.get("box_normalized", True))}
+
+
+@register("box_coder")
+def _box_coder(ctx, ins, attrs):
+    """ref: detection/box_coder_op.h — encode/decode vs prior boxes."""
+    prior = x(ins, "PriorBox").reshape(-1, 4)
+    prior_var = x(ins, "PriorBoxVar")
+    tb = x(ins, "TargetBox")
+    code_type = attrs.get("code_type", "encode_center_size")
+    norm = attrs.get("box_normalized", True)
+    off = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if prior_var is None:
+        var = jnp.ones((prior.shape[0], 4), prior.dtype)
+    else:
+        var = jnp.broadcast_to(prior_var.reshape(-1, 4),
+                               (prior.shape[0], 4))
+    if code_type.startswith("encode"):
+        t = tb.reshape(-1, 1, 4)
+        tw = t[..., 2] - t[..., 0] + off
+        th = t[..., 3] - t[..., 1] + off
+        tcx = t[..., 0] + tw * 0.5
+        tcy = t[..., 1] + th * 0.5
+        ox = (tcx - pcx[None, :]) / pw[None, :] / var[None, :, 0]
+        oy = (tcy - pcy[None, :]) / ph[None, :] / var[None, :, 1]
+        ow = jnp.log(tw / pw[None, :]) / var[None, :, 2]
+        oh = jnp.log(th / ph[None, :]) / var[None, :, 3]
+        return {"OutputBox": jnp.stack([ox, oy, ow, oh], -1)}
+    # decode: tb [N, M, 4]
+    t = tb.reshape(tb.shape[0], -1, 4) if tb.ndim == 3 else tb.reshape(
+        -1, prior.shape[0], 4)
+    dcx = var[None, :, 0] * t[..., 0] * pw[None, :] + pcx[None, :]
+    dcy = var[None, :, 1] * t[..., 1] * ph[None, :] + pcy[None, :]
+    dw = jnp.exp(var[None, :, 2] * t[..., 2]) * pw[None, :]
+    dh = jnp.exp(var[None, :, 3] * t[..., 3]) * ph[None, :]
+    return {"OutputBox": jnp.stack(
+        [dcx - dw * 0.5, dcy - dh * 0.5,
+         dcx + dw * 0.5 - off, dcy + dh * 0.5 - off], -1)}
+
+
+@register("prior_box")
+def _prior_box(ctx, ins, attrs):
+    """ref: detection/prior_box_op.h — SSD anchor grid."""
+    feat, img = x(ins, "Input"), x(ins, "Image")
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", [1.0]):
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if attrs.get("flip", False):
+                ars.append(1.0 / float(ar))
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    clip = attrs.get("clip", False)
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_w = attrs.get("step_w", 0.0) or iw / w
+    step_h = attrs.get("step_h", 0.0) or ih / h
+    offset = attrs.get("offset", 0.5)
+
+    boxes = []
+    for ms in min_sizes:
+        for ar in ars:
+            boxes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            boxes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    num_priors = len(boxes)
+    cx = (jnp.arange(w) + offset) * step_w
+    cy = (jnp.arange(h) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)          # [h, w]
+    bw = jnp.asarray([b[0] / 2 for b in boxes])
+    bh = jnp.asarray([b[1] / 2 for b in boxes])
+    out = jnp.stack([
+        (cxg[..., None] - bw) / iw, (cyg[..., None] - bh) / ih,
+        (cxg[..., None] + bw) / iw, (cyg[..., None] + bh) / ih], -1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances),
+                           (h, w, num_priors, 4))
+    return {"Boxes": out, "Variances": var}
+
+
+@register("density_prior_box")
+def _density_prior_box(ctx, ins, attrs):
+    """ref: detection/density_prior_box_op.h."""
+    feat, img = x(ins, "Input"), x(ins, "Image")
+    fixed_sizes = attrs.get("fixed_sizes", [])
+    fixed_ratios = attrs.get("fixed_ratios", [])
+    densities = attrs.get("densities", [])
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    clip = attrs.get("clip", False)
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_w = attrs.get("step_w", 0.0) or iw / w
+    step_h = attrs.get("step_h", 0.0) or ih / h
+    offset = attrs.get("offset", 0.5)
+    centers = []
+    sizes = []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            step = size / density
+            for di in range(density):
+                for dj in range(density):
+                    centers.append((
+                        (dj + 0.5) * step - size / 2,
+                        (di + 0.5) * step - size / 2))
+                    sizes.append((bw, bh))
+    num = len(sizes)
+    cx = (jnp.arange(w) + offset) * step_w
+    cy = (jnp.arange(h) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    dx = jnp.asarray([c[0] for c in centers])
+    dy = jnp.asarray([c[1] for c in centers])
+    bw = jnp.asarray([s[0] / 2 for s in sizes])
+    bh = jnp.asarray([s[1] / 2 for s in sizes])
+    ccx = cxg[..., None] + dx
+    ccy = cyg[..., None] + dy
+    out = jnp.stack([(ccx - bw) / iw, (ccy - bh) / ih,
+                     (ccx + bw) / iw, (ccy + bh) / ih], -1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), (h, w, num, 4))
+    return {"Boxes": out, "Variances": var}
+
+
+@register("anchor_generator")
+def _anchor_generator(ctx, ins, attrs):
+    """ref: detection/anchor_generator_op.h — RPN anchors."""
+    feat = x(ins, "Input")
+    sizes = attrs["anchor_sizes"]
+    ratios = attrs["aspect_ratios"]
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    stride = attrs["stride"]
+    offset = attrs.get("offset", 0.5)
+    h, w = feat.shape[2], feat.shape[3]
+    anchors = []
+    for r in ratios:
+        for s in sizes:
+            aw = s * np.sqrt(1.0 / r)
+            ah = s * np.sqrt(r)
+            anchors.append((aw, ah))
+    na = len(anchors)
+    cx = (jnp.arange(w) + offset) * stride[0]
+    cy = (jnp.arange(h) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    aw = jnp.asarray([a[0] / 2 for a in anchors])
+    ah = jnp.asarray([a[1] / 2 for a in anchors])
+    out = jnp.stack([cxg[..., None] - aw, cyg[..., None] - ah,
+                     cxg[..., None] + aw, cyg[..., None] + ah], -1)
+    var = jnp.broadcast_to(jnp.asarray(variances), (h, w, na, 4))
+    return {"Anchors": out, "Variances": var}
+
+
+@register("box_clip")
+def _box_clip(ctx, ins, attrs):
+    """ref: detection/box_clip_op.h — clip to image (per batch row)."""
+    boxes, im_info = x(ins, "Input"), x(ins, "ImInfo")
+    b = boxes if boxes.ndim == 3 else boxes[None]
+    im_h = im_info[:, 0][:, None, None]
+    im_w = im_info[:, 1][:, None, None]
+    xs = jnp.clip(b[..., 0::2], 0, im_w - 1)
+    ys = jnp.clip(b[..., 1::2], 0, im_h - 1)
+    out = jnp.stack([xs[..., 0], ys[..., 0], xs[..., 1], ys[..., 1]], -1)
+    return {"Output": out if boxes.ndim == 3 else out[0]}
+
+
+@register("yolo_box")
+def _yolo_box(ctx, ins, attrs):
+    """ref: detection/yolo_box_op.h — decode YOLOv3 head."""
+    a, img_size = x(ins, "X"), x(ins, "ImgSize")
+    anchors = attrs["anchors"]
+    class_num = attrs["class_num"]
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    downsample = attrs.get("downsample_ratio", 32)
+    clip_bbox = attrs.get("clip_bbox", True)
+    n, c, h, w = a.shape
+    na = len(anchors) // 2
+    v = a.reshape(n, na, 5 + class_num, h, w)
+    grid_x = jnp.arange(w).reshape(1, 1, 1, w)
+    grid_y = jnp.arange(h).reshape(1, 1, h, 1)
+    bx = (jax.nn.sigmoid(v[:, :, 0]) + grid_x) / w
+    by = (jax.nn.sigmoid(v[:, :, 1]) + grid_y) / h
+    aw = jnp.asarray(anchors[0::2], jnp.float32).reshape(1, na, 1, 1)
+    ah = jnp.asarray(anchors[1::2], jnp.float32).reshape(1, na, 1, 1)
+    input_h = downsample * h
+    input_w = downsample * w
+    bw = jnp.exp(v[:, :, 2]) * aw / input_w
+    bh = jnp.exp(v[:, :, 3]) * ah / input_h
+    conf = jax.nn.sigmoid(v[:, :, 4])
+    probs = jax.nn.sigmoid(v[:, :, 5:]) * conf[:, :, None]
+    mask = conf > conf_thresh
+    im_h = img_size[:, 0].reshape(n, 1, 1, 1)
+    im_w = img_size[:, 1].reshape(n, 1, 1, 1)
+    x0 = (bx - bw / 2) * im_w
+    y0 = (by - bh / 2) * im_h
+    x1 = (bx + bw / 2) * im_w
+    y1 = (by + bh / 2) * im_h
+    if clip_bbox:
+        x0 = jnp.clip(x0, 0, im_w - 1)
+        y0 = jnp.clip(y0, 0, im_h - 1)
+        x1 = jnp.clip(x1, 0, im_w - 1)
+        y1 = jnp.clip(y1, 0, im_h - 1)
+    boxes = jnp.stack([x0, y0, x1, y1], -1) * mask[..., None]
+    scores = probs * mask[:, :, None]
+    return {"Boxes": boxes.reshape(n, -1, 4),
+            "Scores": scores.transpose(0, 1, 3, 4, 2).reshape(
+                n, -1, class_num)}
+
+
+def _nms_class(boxes, scores, iou_thresh, top_k, normalized=True):
+    """Greedy NMS for one class: returns (keep_mask, order) over top_k
+    candidates.  Static shapes: selects the top_k by score first."""
+    k = min(top_k, scores.shape[0])
+    top_scores, order = lax.top_k(scores, k)
+    cand = boxes[order]                      # [k, 4]
+    iou = _pair_iou(cand, cand, normalized)
+    keep0 = (top_scores > -jnp.inf).astype(jnp.int32)
+
+    def loop(i, keep):
+        prior = jnp.where(jnp.arange(k) < i, keep, 0)
+        sup = jnp.any((prior > 0) & (iou[i] > iou_thresh))
+        return keep.at[i].set(jnp.where(sup, 0, keep[i]))
+
+    keep = lax.fori_loop(0, k, loop, keep0)
+    return keep, order, top_scores
+
+
+@register("multiclass_nms")
+def _multiclass_nms(ctx, ins, attrs):
+    """ref: detection/multiclass_nms_op.cc.  TPU contract: fixed
+    [B, keep_top_k, 6] output (label, score, x1, y1, x2, y2), pad rows
+    label=-1; valid count in NmsRoisNum."""
+    boxes, scores = x(ins, "BBoxes"), x(ins, "Scores")
+    # boxes [B, M, 4], scores [B, C, M]
+    score_thr = attrs.get("score_threshold", 0.0)
+    nms_thr = attrs.get("nms_threshold", 0.3)
+    nms_top_k = attrs.get("nms_top_k", 100)
+    keep_top_k = attrs.get("keep_top_k", 100)
+    background = attrs.get("background_label", 0)
+    normalized = attrs.get("normalized", True)
+    B, C, M = scores.shape
+    k = min(nms_top_k if nms_top_k > 0 else M, M)
+
+    def per_image(bx, sc):
+        outs = []
+        for c in range(C):
+            if c == background:
+                continue
+            s = jnp.where(sc[c] >= score_thr, sc[c], -jnp.inf)
+            keep, order, top_scores = _nms_class(bx, s, nms_thr, k,
+                                                 normalized)
+            kept_boxes = bx[order]
+            valid = (keep > 0) & jnp.isfinite(top_scores)
+            row = jnp.concatenate([
+                jnp.where(valid, float(c), -1.0)[:, None],
+                jnp.where(valid, top_scores, 0.0)[:, None],
+                kept_boxes * valid[:, None]], -1)
+            outs.append(row)
+        allr = jnp.concatenate(outs, 0)      # [(C-1)*k, 6]
+        kk = min(keep_top_k if keep_top_k > 0 else allr.shape[0],
+                 allr.shape[0])
+        sel_scores, sel = lax.top_k(
+            jnp.where(allr[:, 0] >= 0, allr[:, 1], -jnp.inf), kk)
+        picked = allr[sel]
+        picked = jnp.where(jnp.isfinite(sel_scores)[:, None], picked,
+                           jnp.asarray([-1., 0, 0, 0, 0, 0]))
+        count = jnp.sum(picked[:, 0] >= 0).astype(jnp.int32)
+        return picked, count
+
+    picked, counts = jax.vmap(per_image)(boxes, scores)
+    return {"Out": picked, "NmsRoisNum": counts}
+
+
+@register("matrix_nms")
+def _matrix_nms(ctx, ins, attrs):
+    """ref: detection/matrix_nms_op.cc — soft decay instead of hard
+    suppression; naturally static-shaped."""
+    boxes, scores = x(ins, "BBoxes"), x(ins, "Scores")
+    score_thr = attrs.get("score_threshold", 0.0)
+    post_thr = attrs.get("post_threshold", 0.0)
+    nms_top_k = attrs.get("nms_top_k", 100)
+    keep_top_k = attrs.get("keep_top_k", 100)
+    use_gaussian = attrs.get("use_gaussian", False)
+    sigma = attrs.get("gaussian_sigma", 2.0)
+    background = attrs.get("background_label", 0)
+    normalized = attrs.get("normalized", True)
+    B, C, M = scores.shape
+    k = min(nms_top_k if nms_top_k > 0 else M, M)
+
+    def per_class(bx, s):
+        s = jnp.where(s >= score_thr, s, 0.0)
+        top_s, order = lax.top_k(s, k)
+        cand = bx[order]
+        iou = _pair_iou(cand, cand, normalized)
+        upper = jnp.triu(iou, 1)             # iou with higher-scored
+        max_iou = jnp.max(upper, axis=0)     # per candidate
+        col_max = jnp.max(upper, axis=1)
+        if use_gaussian:
+            decay = jnp.min(jnp.where(
+                jnp.triu(jnp.ones_like(iou), 1) > 0,
+                jnp.exp((col_max[:, None] ** 2 - iou ** 2) / sigma),
+                jnp.inf), axis=0)
+        else:
+            decay = jnp.min(jnp.where(
+                jnp.triu(jnp.ones_like(iou), 1) > 0,
+                (1 - iou) / (1 - col_max[:, None]), jnp.inf), axis=0)
+        decay = jnp.where(jnp.isfinite(decay), decay, 1.0)
+        return top_s * decay, cand
+
+    def per_image(bx, sc):
+        rows = []
+        for c in range(C):
+            if c == background:
+                continue
+            dec_s, cand = per_class(bx, sc[c])
+            valid = dec_s > post_thr
+            rows.append(jnp.concatenate([
+                jnp.where(valid, float(c), -1.0)[:, None],
+                jnp.where(valid, dec_s, 0.0)[:, None],
+                cand * valid[:, None]], -1))
+        allr = jnp.concatenate(rows, 0)
+        kk = min(keep_top_k if keep_top_k > 0 else allr.shape[0],
+                 allr.shape[0])
+        sel_scores, sel = lax.top_k(
+            jnp.where(allr[:, 0] >= 0, allr[:, 1], -jnp.inf), kk)
+        picked = allr[sel]
+        picked = jnp.where(jnp.isfinite(sel_scores)[:, None], picked,
+                           jnp.asarray([-1., 0, 0, 0, 0, 0]))
+        return picked, jnp.sum(picked[:, 0] >= 0).astype(jnp.int32)
+
+    picked, counts = jax.vmap(per_image)(boxes, scores)
+    return {"Out": picked, "Index": counts[:, None].astype(jnp.int32),
+            "RoisNum": counts}
+
+
+@register("bipartite_match")
+def _bipartite_match(ctx, ins, attrs):
+    """ref: detection/bipartite_match_op.cc greedy mode — iteratively pick
+    the globally-largest remaining entry."""
+    dist = x(ins, "DistMat")                 # [N, M] (row: gt, col: prior)
+    n, m = dist.shape
+
+    def body(_, carry):
+        d, row_match, col_match = carry
+        flat = jnp.argmax(d)
+        i, j = flat // m, flat % m
+        ok = d[i, j] > 0
+        row_match = row_match.at[j].set(
+            jnp.where(ok, i, row_match[j]).astype(row_match.dtype))
+        col_match = col_match.at[j].set(
+            jnp.where(ok, d[i, j], col_match[j]))
+        d = jnp.where(ok, d.at[i, :].set(-1).at[:, j].set(-1), d)
+        return d, row_match, col_match
+
+    row_match = jnp.full((m,), -1, jnp.int32)
+    col_dist = jnp.zeros((m,), dist.dtype)
+    _, row_match, col_dist = lax.fori_loop(
+        0, min(n, m), body, (dist, row_match, col_dist))
+    return {"ColToRowMatchIndices": row_match[None, :],
+            "ColToRowMatchDist": col_dist[None, :]}
+
+
+@register("roi_align")
+def _roi_align(ctx, ins, attrs):
+    """ref: detection ROIAlign (operators/roi_align_op.h), sampling_ratio
+    grid-averaged bilinear pooling."""
+    a, rois = jnp.asarray(x(ins, "X")), jnp.asarray(x(ins, "ROIs"))
+    roi_batch = x(ins, "RoisNum")
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    ratio = attrs.get("sampling_ratio", -1)
+    ratio = 2 if ratio <= 0 else ratio
+    n, c, h, w = a.shape
+    if roi_batch is None:
+        batch_idx = jnp.zeros((rois.shape[0],), jnp.int32)
+    else:
+        # RoisNum: boxes per image → repeat image index
+        counts = roi_batch.reshape(-1).astype(jnp.int32)
+        batch_idx = jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                               total_repeat_length=rois.shape[0])
+
+    def one_roi(roi, bi):
+        x0, y0, x1, y1 = roi * scale
+        rw = jnp.maximum(x1 - x0, 1.0)
+        rh = jnp.maximum(y1 - y0, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        gy = y0 + (jnp.arange(ph)[:, None, None, None] + 0.0) * bin_h + \
+            (jnp.arange(ratio)[None, None, :, None] + 0.5) * bin_h / ratio
+        gx = x0 + (jnp.arange(pw)[None, :, None, None] + 0.0) * bin_w + \
+            (jnp.arange(ratio)[None, None, None, :] + 0.5) * bin_w / ratio
+        gy = jnp.broadcast_to(gy, (ph, pw, ratio, ratio)).reshape(-1)
+        gx = jnp.broadcast_to(gx, (ph, pw, ratio, ratio)).reshape(-1)
+        y0i = jnp.clip(jnp.floor(gy).astype(jnp.int32), 0, h - 1)
+        x0i = jnp.clip(jnp.floor(gx).astype(jnp.int32), 0, w - 1)
+        y1i = jnp.clip(y0i + 1, 0, h - 1)
+        x1i = jnp.clip(x0i + 1, 0, w - 1)
+        wy = jnp.clip(gy - y0i, 0, 1)
+        wx = jnp.clip(gx - x0i, 0, 1)
+        img = a[bi]                          # [C, H, W]
+        v = (img[:, y0i, x0i] * (1 - wy) * (1 - wx)
+             + img[:, y0i, x1i] * (1 - wy) * wx
+             + img[:, y1i, x0i] * wy * (1 - wx)
+             + img[:, y1i, x1i] * wy * wx)   # [C, ph*pw*r*r]
+        return v.reshape(c, ph, pw, ratio * ratio).mean(-1)
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": out}
+
+
+@register("roi_pool")
+def _roi_pool(ctx, ins, attrs):
+    """ref: operators/roi_pool_op.h — max pooling over roi bins."""
+    a, rois = jnp.asarray(x(ins, "X")), jnp.asarray(x(ins, "ROIs"))
+    roi_batch = x(ins, "RoisNum")
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = a.shape
+    if roi_batch is None:
+        batch_idx = jnp.zeros((rois.shape[0],), jnp.int32)
+    else:
+        counts = roi_batch.reshape(-1).astype(jnp.int32)
+        batch_idx = jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                               total_repeat_length=rois.shape[0])
+
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+
+    def one_roi(roi, bi):
+        x0 = jnp.round(roi[0] * scale)
+        y0 = jnp.round(roi[1] * scale)
+        x1 = jnp.round(roi[2] * scale)
+        y1 = jnp.round(roi[3] * scale)
+        rw = jnp.maximum(x1 - x0 + 1, 1.0)
+        rh = jnp.maximum(y1 - y0 + 1, 1.0)
+        img = a[bi]
+
+        def bin_val(i, j):
+            by0 = jnp.floor(y0 + i * rh / ph)
+            by1 = jnp.ceil(y0 + (i + 1) * rh / ph)
+            bx0 = jnp.floor(x0 + j * rw / pw)
+            bx1 = jnp.ceil(x0 + (j + 1) * rw / pw)
+            inside = ((ys >= by0) & (ys < by1))[:, None] & \
+                ((xs >= bx0) & (xs < bx1))[None, :]
+            masked = jnp.where(inside[None], img, -jnp.inf)
+            v = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.isfinite(v), v, 0.0)
+
+        rows = jnp.stack([
+            jnp.stack([bin_val(i, j) for j in range(pw)], -1)
+            for i in range(ph)], -2)         # [C, ph, pw]
+        return rows
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": out}
+
+
+@register("polygon_box_transform")
+def _polygon_box_transform(ctx, ins, attrs):
+    """ref: detection/polygon_box_transform_op.cc."""
+    a = x(ins, "Input")                      # [N, G, H, W], G = 2*vertices
+    n, g, h, w = a.shape
+    gx = jnp.arange(w).reshape(1, 1, 1, w) * 4.0
+    gy = jnp.arange(h).reshape(1, 1, h, 1) * 4.0
+    idx = jnp.arange(g).reshape(1, g, 1, 1)
+    base = jnp.where(idx % 2 == 0, gx, gy)
+    return {"Output": base - a}
+
+
+@register("mine_hard_examples")
+def _mine_hard_examples(ctx, ins, attrs):
+    """ref: detection/mine_hard_examples_op.cc (max_negative mode) —
+    static variant: returns a 0/1 selection mask over priors instead of
+    the reference's ragged index LoD."""
+    cls_loss = x(ins, "ClsLoss")             # [B, M]
+    match = x(ins, "MatchIndices")           # [B, M] (-1 = negative)
+    neg_pos_ratio = attrs.get("neg_pos_ratio", 3.0)
+    neg = match < 0
+    num_pos = jnp.sum(match >= 0, -1, keepdims=True)
+    num_neg = jnp.minimum(num_pos * neg_pos_ratio,
+                          jnp.sum(neg, -1, keepdims=True)).astype(jnp.int32)
+    loss = jnp.where(neg, cls_loss, -jnp.inf)
+    order = jnp.argsort(-loss, -1)
+    rank = jnp.argsort(order, -1)
+    sel = (rank < num_neg) & neg
+    return {"NegIndices": sel.astype(jnp.int32),
+            "UpdatedMatchIndices": jnp.where(sel, -1, match)}
+
+
+@register("target_assign")
+def _target_assign(ctx, ins, attrs):
+    """ref: detection/target_assign_op.h — scatter gt boxes/labels onto
+    priors by match indices."""
+    gt, match = x(ins, "X"), x(ins, "MatchIndices")
+    mismatch_value = attrs.get("mismatch_value", 0)
+    # gt: [B, G, D] padded; match: [B, M]
+    b_idx = jnp.arange(match.shape[0])[:, None]
+    safe = jnp.clip(match, 0, gt.shape[1] - 1)
+    picked = gt[b_idx, safe]                 # [B, M, D]
+    valid = (match >= 0)[..., None]
+    out = jnp.where(valid, picked, mismatch_value)
+    w_ = jnp.where(match >= 0, 1.0, 0.0)
+    return {"Out": out, "OutWeight": w_[..., None]}

@@ -1,0 +1,152 @@
+"""Extended loss ops (ref: operators/bpr_loss_op.h, rank_loss_op.h,
+margin_rank_loss_op.h, center_loss_op.h, npair loss in layers/loss.py,
+teacher_student_sigmoid_loss_op.cc, log_loss_op.h, dice_loss in
+layers/nn.py, hinge_loss_op.h)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, x
+
+
+@register("log_loss")
+def _log_loss(ctx, ins, attrs):
+    p, y = x(ins, "Predicted"), x(ins, "Labels")
+    eps = attrs.get("epsilon", 1e-4)
+    return {"Loss": -y * jnp.log(p + eps) - (1 - y) * jnp.log(1 - p + eps)}
+
+
+@register("hinge_loss")
+def _hinge_loss(ctx, ins, attrs):
+    logits, y = x(ins, "Logits"), x(ins, "Labels")
+    return {"Loss": jnp.maximum(1.0 - (2.0 * y - 1.0) * logits, 0.0)}
+
+
+@register("rank_loss")
+def _rank_loss(ctx, ins, attrs):
+    """ref: operators/rank_loss_op.h — RankNet pairwise loss."""
+    label = x(ins, "Label")
+    left, right = x(ins, "Left"), x(ins, "Right")
+    d = left - right
+    return {"Out": jnp.logaddexp(0.0, d) - label * d}
+
+
+@register("margin_rank_loss")
+def _margin_rank_loss(ctx, ins, attrs):
+    label = x(ins, "Label")
+    left, right = x(ins, "X1"), x(ins, "X2")
+    margin = attrs.get("margin", 0.0)
+    out = jnp.maximum(-label * (left - right) + margin, 0.0)
+    return {"Out": out, "Activated": (out > 0).astype(left.dtype)}
+
+
+@register("bpr_loss")
+def _bpr_loss(ctx, ins, attrs):
+    """ref: operators/bpr_loss_op.h — Bayesian personalized ranking."""
+    logits, label = x(ins, "X"), x(ins, "Label")
+    n, c = logits.shape
+    pos = jnp.take_along_axis(logits, label.reshape(-1, 1).astype(
+        jnp.int32), 1)                       # [N, 1]
+    diff = pos - logits                      # [N, C]
+    lse = jnp.log1p(jnp.exp(-diff))
+    mask = jnp.ones((n, c), bool).at[
+        jnp.arange(n), label.reshape(-1).astype(jnp.int32)].set(False)
+    loss = jnp.sum(jnp.where(mask, lse, 0.0), -1, keepdims=True) / (c - 1)
+    return {"Loss": loss}
+
+
+@register("center_loss")
+def _center_loss(ctx, ins, attrs):
+    """ref: operators/center_loss_op.h — distance to class centers, with
+    the center-update side effect emitted as CentersOut."""
+    feat, label = x(ins, "X"), x(ins, "Label")
+    centers = x(ins, "Centers")
+    lr = x(ins, "CenterUpdateRate")
+    alpha = lr.reshape(())
+    lab = label.reshape(-1).astype(jnp.int32)
+    picked = centers[lab]                    # [N, D]
+    diff = picked - feat
+    loss = 0.5 * jnp.sum(diff * diff, -1, keepdims=True)
+    if attrs.get("need_update", True):
+        counts = jnp.zeros((centers.shape[0],), feat.dtype).at[lab].add(1.0)
+        upd = jnp.zeros_like(centers).at[lab].add(diff)
+        new_centers = centers - alpha * upd / (counts[:, None] + 1.0)
+    else:
+        new_centers = centers
+    return {"Loss": loss, "SampleCenterDiff": diff,
+            "CentersOut": new_centers}
+
+
+@register("teacher_student_sigmoid_loss")
+def _ts_sigmoid_loss(ctx, ins, attrs):
+    """ref: operators/teacher_student_sigmoid_loss_op.cc."""
+    z, label = x(ins, "X"), x(ins, "Label")
+    soft_max_up = attrs.get("soft_max_up_bound", 15.0)
+    soft_max_lo = attrs.get("soft_max_lower_bound", -15.0)
+    z = jnp.clip(z, soft_max_lo, soft_max_up)
+    # teacher (label < -1 or in (0,1)): sigmoid ce with soft label;
+    # student: standard sigmoid ce on the hard 0/1 part
+    hard = (label > -1.0).astype(z.dtype) * jnp.ceil(label)
+    ce = jnp.maximum(z, 0) - z * hard + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    soft = jnp.where((label > 0) & (label < 1),
+                     jnp.maximum(z, 0) - z * label
+                     + jnp.log1p(jnp.exp(-jnp.abs(z))), 0.0)
+    return {"Y": jnp.where((label > 0) & (label < 1), soft, ce)}
+
+
+@register("dice_loss")
+def _dice_loss(ctx, ins, attrs):
+    p, y = x(ins, "X"), x(ins, "Label")
+    eps = attrs.get("epsilon", 1e-5)
+    y = y.astype(p.dtype)
+    red = tuple(range(1, p.ndim))
+    inter = jnp.sum(p * y, red)
+    union = jnp.sum(p, red) + jnp.sum(y, red)
+    return {"Out": 1.0 - (2 * inter + eps) / (union + eps)}
+
+
+@register("npair_loss")
+def _npair_loss(ctx, ins, attrs):
+    """ref: python/paddle/fluid/layers/loss.py npair_loss composition."""
+    anchor, positive = x(ins, "Anchor"), x(ins, "Positive")
+    labels = x(ins, "Labels").reshape(-1)
+    l2_reg = attrs.get("l2_reg", 0.002)
+    batch = anchor.shape[0]
+    sim = anchor @ positive.T                # [B, B]
+    same = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    tgt = same / jnp.sum(same, -1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, -1)
+    ce = -jnp.sum(tgt * logp, -1).mean()
+    reg = l2_reg * (jnp.sum(anchor * anchor)
+                    + jnp.sum(positive * positive)) / (2 * batch)
+    return {"Out": ce + reg}
+
+
+@register("mse_loss")
+def _mse_loss(ctx, ins, attrs):
+    a, b = x(ins, "X"), x(ins, "Y")
+    return {"Out": (a - b) ** 2}
+
+
+@register("l1_loss")
+def _l1_loss(ctx, ins, attrs):
+    a, b = x(ins, "X"), x(ins, "Y")
+    return {"Out": jnp.abs(a - b)}
+
+
+@register("sampled_softmax_with_cross_entropy")
+def _sampled_softmax_ce(ctx, ins, attrs):
+    """ref: operators/sample_logits_op.h — uniform negative sampling of
+    the softmax denominator (deterministic per ctx key)."""
+    logits, label = x(ins, "Logits"), x(ins, "Label")
+    num_samples = attrs.get("num_samples", 5)
+    n, c = logits.shape
+    lab = label.reshape(-1).astype(jnp.int32)
+    neg = jax.random.randint(ctx.next_key(), (n, num_samples), 0, c)
+    pos_logit = jnp.take_along_axis(logits, lab[:, None], 1)
+    neg_logit = jnp.take_along_axis(logits, neg, 1)
+    all_logit = jnp.concatenate([pos_logit, neg_logit], 1)
+    logp = jax.nn.log_softmax(all_logit, -1)
+    return {"Loss": -logp[:, :1]}

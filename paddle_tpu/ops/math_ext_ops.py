@@ -1,0 +1,398 @@
+"""Extended math / linalg / indexing ops — the long tail of the
+reference's ~550-op surface (ref: paddle/fluid/operators/activation_op.cc,
+math ops in operators/*.cc).  Each is a direct jnp/lax composition: XLA
+fuses them, so there is no per-op kernel to tune."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+
+
+def _unary(name, fn):
+    @register(name)
+    def impl(ctx, ins, attrs, _fn=fn):
+        return {"Out": _fn(x(ins, "X"))}
+    return impl
+
+
+# trig / hyperbolic (ref: activation_op.cc)
+_unary("tan", jnp.tan)
+_unary("asin", jnp.arcsin)
+_unary("acos", jnp.arccos)
+_unary("atan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("asinh", jnp.arcsinh)
+_unary("acosh", jnp.arccosh)
+_unary("atanh", jnp.arctanh)
+# rounding / parts
+_unary("sign", jnp.sign)
+_unary("trunc", jnp.trunc)
+_unary("frac", lambda a: a - jnp.trunc(a))
+_unary("expm1", jnp.expm1)
+_unary("log1p", jnp.log1p)
+_unary("log2", jnp.log2)
+_unary("log10", jnp.log10)
+_unary("digamma", jax.scipy.special.digamma)
+_unary("lgamma", jax.scipy.special.gammaln)
+_unary("erfinv", jax.scipy.special.erfinv)
+_unary("conj", jnp.conj)
+_unary("angle", jnp.angle)
+_unary("real", jnp.real)
+_unary("imag", jnp.imag)
+
+
+@register("atan2")
+def _atan2(ctx, ins, attrs):
+    return {"Out": jnp.arctan2(x(ins, "X1"), x(ins, "X2"))}
+
+
+@register("isclose")
+def _isclose(ctx, ins, attrs):
+    return {"Out": jnp.isclose(x(ins, "Input"), x(ins, "Other"),
+                               rtol=attrs.get("rtol", 1e-5),
+                               atol=attrs.get("atol", 1e-8),
+                               equal_nan=attrs.get("equal_nan", False))}
+
+
+# -- linalg (ref: operators/math/, matmul_op.cc family) ---------------------
+
+@register("bmm")
+def _bmm(ctx, ins, attrs):
+    return {"Out": jnp.matmul(x(ins, "X"), x(ins, "Y"))}
+
+
+@register("addmm")
+def _addmm(ctx, ins, attrs):
+    inp, a, b = x(ins, "Input"), x(ins, "X"), x(ins, "Y")
+    return {"Out": attrs.get("Beta", 1.0) * inp
+            + attrs.get("Alpha", 1.0) * (a @ b)}
+
+
+@register("trace")
+def _trace(ctx, ins, attrs):
+    return {"Out": jnp.trace(x(ins, "Input"),
+                             offset=attrs.get("offset", 0),
+                             axis1=attrs.get("axis1", 0),
+                             axis2=attrs.get("axis2", 1))}
+
+
+@register("kron")
+def _kron(ctx, ins, attrs):
+    return {"Out": jnp.kron(x(ins, "X"), x(ins, "Y"))}
+
+
+@register("cross")
+def _cross(ctx, ins, attrs):
+    axis = attrs.get("dim")
+    a, b = x(ins, "X"), x(ins, "Y")
+    if axis is None:
+        axis = next((i for i, s in enumerate(a.shape) if s == 3), -1)
+    return {"Out": jnp.cross(a, b, axis=axis)}
+
+
+@register("dist")
+def _dist(ctx, ins, attrs):
+    d = x(ins, "X") - x(ins, "Y")
+    p = attrs.get("p", 2.0)
+    if p == float("inf"):
+        return {"Out": jnp.max(jnp.abs(d))}
+    if p == 0:
+        return {"Out": jnp.sum(d != 0).astype(d.dtype)}
+    return {"Out": jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)}
+
+
+@register("cholesky")
+def _cholesky(ctx, ins, attrs):
+    out = jnp.linalg.cholesky(x(ins, "X"))
+    if not attrs.get("upper", False):
+        return {"Out": out}
+    return {"Out": jnp.swapaxes(out, -1, -2)}
+
+
+@register("matrix_power")
+def _matrix_power(ctx, ins, attrs):
+    return {"Out": jnp.linalg.matrix_power(x(ins, "X"), attrs["n"])}
+
+
+@register("inverse")
+def _inverse(ctx, ins, attrs):
+    return {"Out": jnp.linalg.inv(x(ins, "Input"))}
+
+
+@register("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    """ref: operators/cos_sim_op.h — row-wise cosine similarity with
+    Y broadcast over the batch when it has one row."""
+    a, b = x(ins, "X"), x(ins, "Y")
+    an = jnp.sqrt(jnp.sum(a * a, -1, keepdims=True))
+    bn = jnp.sqrt(jnp.sum(b * b, -1, keepdims=True))
+    num = jnp.sum(a * b, -1, keepdims=True)
+    return {"Out": num / jnp.maximum(an * bn, 1e-12),
+            "XNorm": an, "YNorm": bn}
+
+
+# -- diag family ------------------------------------------------------------
+
+@register("diag")
+def _diag(ctx, ins, attrs):
+    return {"Out": jnp.diag(x(ins, "Diagonal"))}
+
+
+@register("diag_v2")
+def _diag_v2(ctx, ins, attrs):
+    a = x(ins, "X")
+    off = attrs.get("offset", 0)
+    pad = attrs.get("padding_value", 0.0)
+    out = jnp.diag(a, k=off)
+    if a.ndim == 1 and pad:
+        out = jnp.where(jnp.eye(*out.shape, k=off, dtype=bool), out, pad)
+    return {"Out": out}
+
+
+@register("diag_embed")
+def _diag_embed(ctx, ins, attrs):
+    a = x(ins, "Input")
+    off = attrs.get("offset", 0)
+    n = a.shape[-1] + abs(off)
+    eye = jnp.eye(n, k=off, dtype=bool)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    idx = jnp.nonzero(eye, size=a.shape[-1])
+    return {"Out": out.at[..., idx[0], idx[1]].set(a)}
+
+
+@register("diagonal")
+def _diagonal(ctx, ins, attrs):
+    return {"Out": jnp.diagonal(x(ins, "Input"),
+                                offset=attrs.get("offset", 0),
+                                axis1=attrs.get("axis1", 0),
+                                axis2=attrs.get("axis2", 1))}
+
+
+# -- stats ------------------------------------------------------------------
+
+@register("histogram")
+def _histogram(ctx, ins, attrs):
+    a = x(ins, "X").reshape(-1)
+    bins = attrs.get("bins", 100)
+    lo, hi = attrs.get("min", 0), attrs.get("max", 0)
+    if lo == 0 and hi == 0:
+        lo, hi = jnp.min(a), jnp.max(a)
+    h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+    return {"Out": h.astype(jnp.int64)}
+
+
+@register("bincount")
+def _bincount(ctx, ins, attrs):
+    a = x(ins, "X").reshape(-1).astype(jnp.int32)
+    w = x(ins, "Weights")
+    minlength = attrs.get("minlength", 0)
+    # static length: bincount needs a bound on TPU
+    length = max(minlength, 1)
+    length = attrs.get("_static_length", length)
+    return {"Out": jnp.bincount(a, weights=w, length=length)}
+
+
+@register("reduce_var")
+def _reduce_var(ctx, ins, attrs):
+    a = x(ins, "X")
+    dim = attrs.get("dim")
+    dim = tuple(dim) if dim else None
+    return {"Out": jnp.var(a, axis=dim,
+                           keepdims=attrs.get("keep_dim", False))}
+
+
+@register("std")
+def _std(ctx, ins, attrs):
+    a = x(ins, "X")
+    dim = attrs.get("dim")
+    dim = tuple(dim) if dim else None
+    ddof = 1 if attrs.get("unbiased", True) else 0
+    return {"Out": jnp.std(a, axis=dim, ddof=ddof,
+                           keepdims=attrs.get("keep_dim", False))}
+
+
+@register("median")
+def _median(ctx, ins, attrs):
+    a = x(ins, "X")
+    ax = attrs.get("axis")
+    return {"Out": jnp.median(a, axis=ax,
+                              keepdims=attrs.get("keepdim", False))}
+
+
+@register("kthvalue")
+def _kthvalue(ctx, ins, attrs):
+    a = x(ins, "X")
+    k = attrs["k"]
+    ax = attrs.get("axis", -1)
+    srt = jnp.sort(a, axis=ax)
+    idx = jnp.argsort(a, axis=ax)
+    vals = jnp.take(srt, k - 1, axis=ax)
+    inds = jnp.take(idx, k - 1, axis=ax)
+    if attrs.get("keepdim", False):
+        vals = jnp.expand_dims(vals, ax)
+        inds = jnp.expand_dims(inds, ax)
+    return {"Out": vals, "Indices": inds.astype(jnp.int64)}
+
+
+@register("mode")
+def _mode(ctx, ins, attrs):
+    a = x(ins, "X")
+    ax = attrs.get("axis", -1) % a.ndim
+    srt = jnp.sort(a, axis=ax)
+    same = jnp.concatenate(
+        [jnp.ones(srt.shape[:ax] + (1,) + srt.shape[ax + 1:], bool),
+         jnp.take(srt, np.arange(1, srt.shape[ax]), axis=ax)
+         == jnp.take(srt, np.arange(srt.shape[ax] - 1), axis=ax)], axis=ax)
+    runs = jnp.cumsum(same, axis=ax) * same
+    # longest run's value is the mode
+    best = jnp.argmax(runs, axis=ax)
+    vals = jnp.take_along_axis(srt, jnp.expand_dims(best, ax), axis=ax)
+    vals = jnp.squeeze(vals, ax)
+    idx = jnp.argmax(
+        jnp.equal(a, jnp.expand_dims(vals, ax)).astype(jnp.int32), axis=ax)
+    if attrs.get("keepdim", False):
+        vals = jnp.expand_dims(vals, ax)
+        idx = jnp.expand_dims(idx, ax)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+# -- indexing / reshuffling -------------------------------------------------
+
+@register("take_along_axis")
+def _take_along_axis(ctx, ins, attrs):
+    return {"Result": jnp.take_along_axis(
+        x(ins, "Input"), x(ins, "Index").astype(jnp.int32),
+        axis=attrs.get("Axis", 0))}
+
+
+@register("put_along_axis")
+def _put_along_axis(ctx, ins, attrs):
+    a = jnp.asarray(x(ins, "Input"))
+    idx, v = x(ins, "Index"), jnp.asarray(x(ins, "Value"))
+    ax = attrs.get("Axis", 0)
+    reduce = attrs.get("Reduce", "assign")
+    idx = idx.astype(jnp.int32)
+    if reduce == "add":
+        return {"Result": _scatter_along(a, idx, v, ax, "add")}
+    if reduce == "multiply" or reduce == "mul":
+        return {"Result": _scatter_along(a, idx, v, ax, "mul")}
+    return {"Result": _scatter_along(a, idx, v, ax, "set")}
+
+
+def _scatter_along(a, idx, v, ax, mode):
+    grids = []
+    for d in range(a.ndim):
+        if d == ax:
+            grids.append(idx)
+        else:
+            r = jnp.arange(idx.shape[d]).reshape(
+                [idx.shape[d] if i == d else 1 for i in range(idx.ndim)])
+            grids.append(jnp.broadcast_to(r, idx.shape))
+    v = jnp.broadcast_to(v, idx.shape)
+    at = a.at[tuple(grids)]
+    return {"add": at.add, "mul": at.multiply, "set": at.set}[mode](v)
+
+
+@register("index_sample")
+def _index_sample(ctx, ins, attrs):
+    """ref: operators/index_sample_op.h — per-row gather."""
+    a, idx = x(ins, "X"), x(ins, "Index")
+    return {"Out": jnp.take_along_axis(a, idx.astype(jnp.int32), axis=1)}
+
+
+@register("meshgrid")
+def _meshgrid(ctx, ins, attrs):
+    xs = ins["X"]
+    outs = jnp.meshgrid(*xs, indexing="ij")
+    return {"Out": list(outs)}
+
+
+@register("broadcast_to")
+def _broadcast_to(ctx, ins, attrs):
+    return {"Out": jnp.broadcast_to(x(ins, "X"), attrs["shape"])}
+
+
+@register("unbind")
+def _unbind(ctx, ins, attrs):
+    a = x(ins, "X")
+    ax = attrs.get("axis", 0)
+    return {"Out": [jnp.squeeze(s, ax)
+                    for s in jnp.split(a, a.shape[ax], axis=ax)]}
+
+
+@register("unique_with_counts")
+def _unique_with_counts(ctx, ins, attrs):
+    """Static-size unique (TPU contract: padded to input length, ref
+    semantics: unique_with_counts_op.cc is host-dynamic)."""
+    a = x(ins, "X").reshape(-1)
+    n = a.shape[0]
+    vals, idx, counts = jnp.unique(a, size=n, fill_value=0,
+                                   return_inverse=True, return_counts=True)
+    return {"Out": vals, "Index": idx.astype(jnp.int64).reshape(-1),
+            "Count": counts.astype(jnp.int64)}
+
+
+@register("shard_index")
+def _shard_index(ctx, ins, attrs):
+    """ref: operators/shard_index_op.h — map global ids to shard-local."""
+    a = x(ins, "X")
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore = attrs.get("ignore_value", -1)
+    size = (index_num + nshards - 1) // nshards
+    in_shard = (a // size) == shard_id
+    return {"Out": jnp.where(in_shard, a % size, ignore)}
+
+
+@register("masked_select")
+def _masked_select(ctx, ins, attrs):
+    """Padded masked_select: selected values packed to the front, zeros
+    after (TPU static-shape contract; true count = sum(mask))."""
+    a, m = x(ins, "X"), x(ins, "Mask")
+    flat = a.reshape(-1)
+    mf = m.reshape(-1).astype(bool)
+    order = jnp.argsort(~mf, stable=True)
+    return {"Y": jnp.where(jnp.sort(~mf, stable=True), 0,
+                           flat[order]).astype(a.dtype)}
+
+
+@register("tril_indices")
+def _tril_indices(ctx, ins, attrs):
+    r, c = attrs["rows"], attrs["cols"]
+    out = jnp.stack(jnp.tril_indices(r, attrs.get("offset", 0), c))
+    return {"Out": out.astype(jnp.int64)}
+
+
+@register("logcumsumexp")
+def _logcumsumexp(ctx, ins, attrs):
+    a = x(ins, "X")
+    ax = attrs.get("axis", -1)
+    return {"Out": lax.associative_scan(jnp.logaddexp, a, axis=ax)}
+
+
+@register("cumprod")
+def _cumprod(ctx, ins, attrs):
+    return {"Out": jnp.cumprod(x(ins, "X"), axis=attrs.get("dim", -1))}
+
+
+@register("logit")
+def _logit(ctx, ins, attrs):
+    a = x(ins, "X")
+    eps = attrs.get("eps", 1e-6)
+    a = jnp.clip(a, eps, 1 - eps)
+    return {"Out": jnp.log(a / (1 - a))}
+
+
+@register("multiplex")
+def _multiplex(ctx, ins, attrs):
+    """ref: operators/multiplex_op.cc — per-row select among candidates."""
+    ids = x(ins, "Ids").reshape(-1).astype(jnp.int32)
+    cands = jnp.stack(ins["X"])              # [K, B, ...]
+    return {"Out": cands[ids, jnp.arange(ids.shape[0])]}

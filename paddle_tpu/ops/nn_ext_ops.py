@@ -1,0 +1,354 @@
+"""Extended NN / vision ops (ref: operators/activation_op.cc long tail,
+interpolate_op.cc, grid_sampler_op.cc, pixel_shuffle_op.cc, unfold_op.cc,
+prelu_op.cc, norm_op.cc, affine_channel_op.cc, conv3d via conv_op.cc)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+
+
+# -- activations (ref: activation_op.cc) ------------------------------------
+
+@register("prelu")
+def _prelu(ctx, ins, attrs):
+    a, alpha = x(ins, "X"), x(ins, "Alpha")
+    mode = attrs.get("mode", "all")
+    if mode == "channel" and alpha.size > 1:
+        alpha = alpha.reshape((1, -1) + (1,) * (a.ndim - 2))
+    else:
+        alpha = alpha.reshape((1,) * a.ndim) if alpha.size == 1 else alpha
+    return {"Out": jnp.where(a > 0, a, a * alpha)}
+
+
+@register("selu")
+def _selu(ctx, ins, attrs):
+    scale = attrs.get("scale", 1.0507009873554805)
+    alpha = attrs.get("alpha", 1.6732632423543772)
+    a = x(ins, "X")
+    return {"Out": scale * jnp.where(a > 0, a, alpha * jnp.expm1(a))}
+
+
+@register("hard_shrink")
+def _hard_shrink(ctx, ins, attrs):
+    t = attrs.get("threshold", 0.5)
+    a = x(ins, "X")
+    return {"Out": jnp.where(jnp.abs(a) > t, a, 0.0)}
+
+
+@register("softshrink")
+def _softshrink(ctx, ins, attrs):
+    lam = attrs.get("lambda", 0.5)
+    a = x(ins, "X")
+    return {"Out": jnp.where(a > lam, a - lam,
+                             jnp.where(a < -lam, a + lam, 0.0))}
+
+
+@register("tanh_shrink")
+def _tanh_shrink(ctx, ins, attrs):
+    a = x(ins, "X")
+    return {"Out": a - jnp.tanh(a)}
+
+
+@register("thresholded_relu")
+def _thresholded_relu(ctx, ins, attrs):
+    t = attrs.get("threshold", 1.0)
+    a = x(ins, "X")
+    return {"Out": jnp.where(a > t, a, 0.0)}
+
+
+@register("stanh")
+def _stanh(ctx, ins, attrs):
+    a = x(ins, "X")
+    return {"Out": attrs.get("scale_b", 1.7159)
+            * jnp.tanh(attrs.get("scale_a", 0.67) * a)}
+
+
+@register("maxout")
+def _maxout(ctx, ins, attrs):
+    """ref: operators/math/maxouting.cc — channel groups on any axis."""
+    a = x(ins, "X")
+    groups = attrs["groups"]
+    ax = attrs.get("axis", 1) % a.ndim
+    shape = (a.shape[:ax] + (a.shape[ax] // groups, groups)
+             + a.shape[ax + 1:])
+    return {"Out": a.reshape(shape).max(ax + 1)}
+
+
+@register("norm")
+def _norm(ctx, ins, attrs):
+    """l2-normalize along axis (ref: operators/norm_op.h)."""
+    a = x(ins, "X")
+    ax = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=True) + eps)
+    return {"Out": a / n, "Norm": n}
+
+
+@register("npu_identity")
+def _identity(ctx, ins, attrs):
+    return {"Out": x(ins, "X")}
+
+
+# -- vision: resize family (ref: interpolate_op.cc) -------------------------
+
+def _resize(a, out_hw, method, align_corners):
+    n, c, h, w = a.shape
+    oh, ow = out_hw
+    img = jnp.moveaxis(a, 1, -1)             # NHWC for jax.image
+    if method == "nearest" and not align_corners:
+        out = jax.image.resize(img, (n, oh, ow, c), method="nearest")
+    elif align_corners:
+        # gather with align_corners index math (jax.image has no flag)
+        ys = (jnp.arange(oh) * ((h - 1) / max(oh - 1, 1)))
+        xs = (jnp.arange(ow) * ((w - 1) / max(ow - 1, 1)))
+        if method == "nearest":
+            yi = jnp.round(ys).astype(jnp.int32)
+            xi = jnp.round(xs).astype(jnp.int32)
+            out = img[:, yi][:, :, xi]
+        else:
+            y0 = jnp.floor(ys).astype(jnp.int32)
+            x0 = jnp.floor(xs).astype(jnp.int32)
+            y1 = jnp.clip(y0 + 1, 0, h - 1)
+            x1 = jnp.clip(x0 + 1, 0, w - 1)
+            wy = (ys - y0)[None, :, None, None]
+            wx = (xs - x0)[None, None, :, None]
+            v00 = img[:, y0][:, :, x0]
+            v01 = img[:, y0][:, :, x1]
+            v10 = img[:, y1][:, :, x0]
+            v11 = img[:, y1][:, :, x1]
+            out = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                   + v10 * wy * (1 - wx) + v11 * wy * wx)
+    else:
+        meth = {"bilinear": "linear", "bicubic": "cubic"}.get(method, method)
+        out = jax.image.resize(img, (n, oh, ow, c), method=meth)
+    return jnp.moveaxis(out, -1, 1).astype(a.dtype)
+
+
+def _interp_out_hw(a, ins, attrs):
+    os = x(ins, "OutSize")
+    if os is not None:
+        raise NotImplementedError(
+            "runtime OutSize tensor is dynamic-shape; pass static out_h/"
+            "out_w attrs (XLA needs static shapes)")
+    oh, ow = attrs.get("out_h", -1), attrs.get("out_w", -1)
+    scale = attrs.get("scale", 0.0)
+    if (oh is None or oh < 0) and scale:
+        oh = int(a.shape[2] * scale)
+        ow = int(a.shape[3] * scale)
+    return oh, ow
+
+
+def _make_interp(name, method):
+    @register(name)
+    def impl(ctx, ins, attrs, _m=method):
+        a = x(ins, "X")
+        oh, ow = _interp_out_hw(a, ins, attrs)
+        return {"Out": _resize(a, (oh, ow), _m,
+                               attrs.get("align_corners", True))}
+    return impl
+
+
+_make_interp("bilinear_interp_v2", "bilinear")
+_make_interp("nearest_interp_v2", "nearest")
+_make_interp("bicubic_interp", "bicubic")
+_make_interp("bicubic_interp_v2", "bicubic")
+
+
+@register("trilinear_interp")
+def _trilinear_interp(ctx, ins, attrs):
+    a = x(ins, "X")                          # NCDHW
+    od = attrs.get("out_d")
+    oh = attrs.get("out_h")
+    ow = attrs.get("out_w")
+    n, c, d, h, w = a.shape
+    scale = attrs.get("scale", 0.0)
+    if (od is None or od < 0) and scale:
+        od, oh, ow = int(d * scale), int(h * scale), int(w * scale)
+    img = jnp.moveaxis(a, 1, -1)
+    out = jax.image.resize(img, (n, od, oh, ow, c), method="linear")
+    return {"Out": jnp.moveaxis(out, -1, 1).astype(a.dtype)}
+
+
+# -- vision: layout ops -----------------------------------------------------
+
+@register("pixel_shuffle")
+def _pixel_shuffle(ctx, ins, attrs):
+    a = x(ins, "X")                          # [N, C*r^2, H, W]
+    r = attrs.get("upscale_factor", 1)
+    n, c, h, w = a.shape
+    oc = c // (r * r)
+    out = a.reshape(n, oc, r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3)
+    return {"Out": out.reshape(n, oc, h * r, w * r)}
+
+
+@register("shuffle_channel")
+def _shuffle_channel(ctx, ins, attrs):
+    a = x(ins, "X")
+    g = attrs.get("group", 1)
+    n, c, h, w = a.shape
+    return {"Out": a.reshape(n, g, c // g, h, w).swapaxes(1, 2)
+            .reshape(n, c, h, w)}
+
+
+@register("space_to_depth")
+def _space_to_depth(ctx, ins, attrs):
+    a = x(ins, "X")
+    bs = attrs.get("blocksize", 1)
+    n, c, h, w = a.shape
+    out = a.reshape(n, c, h // bs, bs, w // bs, bs)
+    out = out.transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": out.reshape(n, c * bs * bs, h // bs, w // bs)}
+
+
+@register("temporal_shift")
+def _temporal_shift(ctx, ins, attrs):
+    """ref: operators/temporal_shift_op.h — shift channel slices in time."""
+    a = x(ins, "X")                          # [N*T, C, H, W]
+    t = attrs["seg_num"]
+    ratio = attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = a.shape
+    n = nt // t
+    v = a.reshape(n, t, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    fwd = jnp.concatenate([v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], 1)
+    bwd = jnp.concatenate([jnp.zeros_like(v[:, :1, c1:c2]),
+                           v[:, :-1, c1:c2]], 1)
+    keep = v[:, :, c2:]
+    out = jnp.concatenate([fwd, bwd, keep], 2)
+    return {"Out": out.reshape(nt, c, h, w)}
+
+
+@register("affine_channel")
+def _affine_channel(ctx, ins, attrs):
+    a, scale, bias = x(ins, "X"), x(ins, "Scale"), x(ins, "Bias")
+    shape = (1, -1) + (1,) * (a.ndim - 2)
+    return {"Out": a * scale.reshape(shape) + bias.reshape(shape)}
+
+
+@register("pad3d")
+def _pad3d(ctx, ins, attrs):
+    a = x(ins, "X")                          # NCDHW
+    p = attrs["paddings"]                    # [front,back,top,bottom,l,r]
+    mode = attrs.get("mode", "constant")
+    value = attrs.get("value", 0.0)
+    pads = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    if mode == "constant":
+        return {"Out": jnp.pad(a, pads, constant_values=value)}
+    jmode = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    return {"Out": jnp.pad(a, pads, mode=jmode)}
+
+
+@register("unfold")
+def _unfold(ctx, ins, attrs):
+    """im2col (ref: operators/unfold_op.h)."""
+    a = x(ins, "X")                          # NCHW
+    k = attrs["kernel_sizes"]
+    s = attrs.get("strides", [1, 1])
+    p = attrs.get("paddings", [0, 0, 0, 0])
+    d = attrs.get("dilations", [1, 1])
+    n, c, h, w = a.shape
+    pt, pl = p[0], p[1]
+    pb = p[2] if len(p) > 2 else p[0]
+    pr = p[3] if len(p) > 3 else p[1]
+    a = jnp.pad(a, [(0, 0), (0, 0), (pt, pb), (pl, pr)])
+    oh = (h + pt + pb - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+    ow = (w + pl + pr - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+    patches = []
+    for i in range(k[0]):
+        for j in range(k[1]):
+            sl = a[:, :, i * d[0]: i * d[0] + (oh - 1) * s[0] + 1: s[0],
+                   j * d[1]: j * d[1] + (ow - 1) * s[1] + 1: s[1]]
+            patches.append(sl)
+    out = jnp.stack(patches, 2)              # [N, C, k*k, oh, ow]
+    return {"Y": out.reshape(n, c * k[0] * k[1], oh * ow)}
+
+
+@register("grid_sampler")
+def _grid_sampler(ctx, ins, attrs):
+    """Bilinear grid sample, zero padding, align_corners (ref:
+    operators/grid_sampler_op.h)."""
+    a, grid = x(ins, "X"), x(ins, "Grid")    # NCHW, [N, Ho, Wo, 2]
+    n, c, h, w = a.shape
+    gx = (grid[..., 0] + 1) * (w - 1) / 2
+    gy = (grid[..., 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+
+    def pick(yy, xx):
+        valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yy = jnp.clip(yy, 0, h - 1)
+        xx = jnp.clip(xx, 0, w - 1)
+        b = jnp.arange(n)[:, None, None]
+        vals = a[b, :, yy, xx]               # [N, Ho, Wo, C]
+        return jnp.where(valid[..., None], vals, 0.0)
+
+    wx = gx - x0
+    wy = gy - y0
+    out = (pick(y0, x0) * ((1 - wy) * (1 - wx))[..., None]
+           + pick(y0, x1) * ((1 - wy) * wx)[..., None]
+           + pick(y1, x0) * (wy * (1 - wx))[..., None]
+           + pick(y1, x1) * (wy * wx)[..., None])
+    return {"Output": jnp.moveaxis(out, -1, 1).astype(a.dtype)}
+
+
+# -- 3d conv/pool (ref: conv_op.cc, pool_op.cc) -----------------------------
+
+@register("conv3d")
+def _conv3d(ctx, ins, attrs):
+    a, w_ = x(ins, "Input"), x(ins, "Filter")    # NCDHW, OIDHW
+    s = attrs.get("strides", [1, 1, 1])
+    p = attrs.get("paddings", [0, 0, 0])
+    d = attrs.get("dilations", [1, 1, 1])
+    groups = attrs.get("groups", 1)
+    out = lax.conv_general_dilated(
+        a, w_, window_strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1]), (p[2], p[2])],
+        rhs_dilation=d, feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": out}
+
+
+@register("pool3d")
+def _pool3d(ctx, ins, attrs):
+    a = x(ins, "X")
+    ksize = attrs["ksize"]
+    stride = attrs.get("strides", ksize)
+    p = attrs.get("paddings", [0, 0, 0])
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        if ptype == "max":
+            return {"Out": jnp.max(a, axis=(2, 3, 4), keepdims=True)}
+        return {"Out": jnp.mean(a, axis=(2, 3, 4), keepdims=True)}
+    dims = (1, 1) + tuple(ksize)
+    strides = (1, 1) + tuple(stride)
+    pads = [(0, 0), (0, 0)] + [(pp, pp) for pp in p]
+    if ptype == "max":
+        init = -jnp.inf
+        out = lax.reduce_window(a, init, lax.max, dims, strides, pads)
+    else:
+        out = lax.reduce_window(a, 0.0, lax.add, dims, strides, pads)
+        out = out / np.prod(ksize)
+    return {"Out": out.astype(a.dtype)}
+
+
+@register("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (ref: operators/row_conv_op.cc), padded
+    [B, T, D] layout."""
+    a, w_ = x(ins, "X"), x(ins, "Filter")    # [B,T,D], [ctx_len, D]
+    k = w_.shape[0]
+    b, t, dd = a.shape
+    pad = jnp.pad(a, [(0, 0), (0, k - 1), (0, 0)])
+    out = jnp.zeros_like(a)
+    for i in range(k):
+        out = out + pad[:, i:i + t, :] * w_[i][None, None, :]
+    return {"Out": out}

@@ -1,0 +1,351 @@
+"""Numeric tests for the extended op sweep (math/nn/detection/loss) —
+the reference's OpTest pattern (ref: tests/unittests/op_test.py:170,
+test_multiclass_nms_op.py, test_box_coder_op.py, test_roi_align_op.py,
+test_yolo_box_op.py, test_unfold_op.py)."""
+
+import numpy as np
+import pytest
+
+from tests.op_test import OpTest
+
+
+class TestTrig(OpTest):
+    op_type = "atan2"
+
+    def test(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(3, 4).astype(np.float32)
+        self.check_output({"X1": a, "X2": b}, {},
+                          {"Out": np.arctan2(a, b)})
+
+
+def test_unary_ext_batch():
+    """Spot-check the unary math extensions against numpy."""
+    rng = np.random.RandomState(1)
+    a = (rng.rand(4, 5).astype(np.float32) * 0.8 + 0.1)
+    cases = {
+        "tan": np.tan, "asin": np.arcsin, "acos": np.arccos,
+        "atan": np.arctan, "sinh": np.sinh, "cosh": np.cosh,
+        "asinh": np.arcsinh, "atanh": np.arctanh,
+        "sign": np.sign, "trunc": np.trunc,
+        "expm1": np.expm1, "log1p": np.log1p, "log2": np.log2,
+        "log10": np.log10,
+    }
+    for op, ref in cases.items():
+        t = OpTest()
+        t.op_type = op
+        t.check_output({"X": a}, {}, {"Out": ref(a)}, atol=1e-5)
+
+
+class TestBmm(OpTest):
+    op_type = "bmm"
+
+    def test(self):
+        rng = np.random.RandomState(2)
+        a = rng.randn(3, 4, 5).astype(np.float32)
+        b = rng.randn(3, 5, 6).astype(np.float32)
+        self.check_output({"X": a, "Y": b}, {}, {"Out": a @ b})
+        self.check_grad({"X": a, "Y": b}, {}, "Out", ["X", "Y"],
+                        atol=5e-3, rtol=5e-3)
+
+
+class TestTrace(OpTest):
+    op_type = "trace"
+
+    def test(self):
+        a = np.random.RandomState(3).randn(4, 4).astype(np.float32)
+        self.check_output({"Input": a}, {}, {"Out": np.trace(a)})
+
+
+class TestKthvalue(OpTest):
+    op_type = "kthvalue"
+
+    def test(self):
+        a = np.random.RandomState(4).randn(3, 7).astype(np.float32)
+        k = 3
+        srt = np.sort(a, -1)
+        self.check_output({"X": a}, {"k": k, "axis": -1},
+                          {"Out": srt[:, k - 1]})
+
+
+class TestTakeAlongAxis(OpTest):
+    op_type = "take_along_axis"
+
+    def test(self):
+        rng = np.random.RandomState(5)
+        a = rng.randn(4, 6).astype(np.float32)
+        idx = rng.randint(0, 6, (4, 3)).astype(np.int64)
+        self.check_output({"Input": a, "Index": idx}, {"Axis": 1},
+                          {"Result": np.take_along_axis(a, idx, 1)})
+
+
+class TestIndexSample(OpTest):
+    op_type = "index_sample"
+
+    def test(self):
+        rng = np.random.RandomState(6)
+        a = rng.randn(3, 8).astype(np.float32)
+        idx = rng.randint(0, 8, (3, 4)).astype(np.int64)
+        self.check_output({"X": a, "Index": idx}, {},
+                          {"Out": np.take_along_axis(a, idx, 1)})
+
+
+class TestPixelShuffle(OpTest):
+    op_type = "pixel_shuffle"
+
+    def test(self):
+        rng = np.random.RandomState(7)
+        a = rng.randn(2, 8, 3, 3).astype(np.float32)
+        r = 2
+        n, c, h, w = a.shape
+        oc = c // (r * r)
+        ref = a.reshape(n, oc, r, r, h, w).transpose(
+            0, 1, 4, 2, 5, 3).reshape(n, oc, h * r, w * r)
+        self.check_output({"X": a}, {"upscale_factor": r}, {"Out": ref})
+
+
+class TestUnfold(OpTest):
+    op_type = "unfold"
+
+    def test(self):
+        rng = np.random.RandomState(8)
+        a = rng.randn(2, 3, 6, 6).astype(np.float32)
+        k, s, p = [2, 2], [2, 2], [0, 0, 0, 0]
+        # numpy im2col reference
+        n, c, h, w = a.shape
+        oh = (h - 2) // 2 + 1
+        ow = (w - 2) // 2 + 1
+        cols = np.zeros((n, c, 4, oh, ow), np.float32)
+        for i in range(2):
+            for j in range(2):
+                cols[:, :, i * 2 + j] = a[:, :, i:i + (oh - 1) * 2 + 1:2,
+                                          j:j + (ow - 1) * 2 + 1:2]
+        self.check_output(
+            {"X": a}, {"kernel_sizes": k, "strides": s, "paddings": p},
+            {"Y": cols.reshape(n, c * 4, oh * ow)})
+
+
+def _np_iou(a, b):
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    aa = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    ab = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = aa[:, None] + ab[None, :] - inter
+    return np.where(union > 0, inter / union, 0)
+
+
+class TestIouSimilarity(OpTest):
+    op_type = "iou_similarity"
+
+    def test(self):
+        rng = np.random.RandomState(9)
+        a = np.sort(rng.rand(5, 4).astype(np.float32), -1)
+        b = np.sort(rng.rand(7, 4).astype(np.float32), -1)
+        a = a[:, [0, 1, 2, 3]]
+        self.check_output({"X": a, "Y": b}, {}, {"Out": _np_iou(a, b)},
+                          atol=1e-5)
+
+
+class TestBoxCoderDecode(OpTest):
+    op_type = "box_coder"
+
+    def test(self):
+        rng = np.random.RandomState(10)
+        M = 6
+        prior = np.sort(rng.rand(M, 4).astype(np.float32), -1)
+        var = np.full((M, 4), 0.1, np.float32)
+        t = rng.randn(2, M, 4).astype(np.float32) * 0.1
+        pw = prior[:, 2] - prior[:, 0]
+        ph = prior[:, 3] - prior[:, 1]
+        pcx = prior[:, 0] + pw / 2
+        pcy = prior[:, 1] + ph / 2
+        dcx = var[:, 0] * t[..., 0] * pw + pcx
+        dcy = var[:, 1] * t[..., 1] * ph + pcy
+        dw = np.exp(var[:, 2] * t[..., 2]) * pw
+        dh = np.exp(var[:, 3] * t[..., 3]) * ph
+        ref = np.stack([dcx - dw / 2, dcy - dh / 2,
+                        dcx + dw / 2, dcy + dh / 2], -1)
+        self.check_output(
+            {"PriorBox": prior, "PriorBoxVar": var, "TargetBox": t},
+            {"code_type": "decode_center_size"}, {"OutputBox": ref},
+            atol=1e-5)
+
+
+def test_multiclass_nms_suppresses():
+    """NMS keeps the top box and drops heavy overlaps, padded contract."""
+    from paddle_tpu.ops.registry import get_op
+    import jax
+    impl = get_op("multiclass_nms")
+
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [20, 20, 30, 30]]], np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)  # one fg class=0?
+    # use background_label=-1 so class 0 is foreground
+    out = impl(None, {"BBoxes": [boxes], "Scores": [scores]},
+               {"score_threshold": 0.01, "nms_threshold": 0.3,
+                "nms_top_k": 3, "keep_top_k": 3,
+                "background_label": -1})
+    picked = np.asarray(out["Out"])[0]
+    count = int(np.asarray(out["NmsRoisNum"])[0])
+    assert count == 2                       # overlapping box suppressed
+    kept = picked[picked[:, 0] >= 0]
+    np.testing.assert_allclose(sorted(kept[:, 1], reverse=True),
+                               [0.9, 0.7], rtol=1e-5)
+
+
+def test_yolo_box_decodes():
+    from paddle_tpu.ops.registry import get_op
+    impl = get_op("yolo_box")
+    rng = np.random.RandomState(11)
+    n, na, cls, h, w = 1, 2, 3, 2, 2
+    a = rng.randn(n, na * (5 + cls), h, w).astype(np.float32)
+    img = np.array([[64, 64]], np.int32)
+    out = impl(None, {"X": [a], "ImgSize": [img]},
+               {"anchors": [10, 13, 16, 30], "class_num": cls,
+                "conf_thresh": 0.005, "downsample_ratio": 32})
+    boxes = np.asarray(out["Boxes"])
+    scores = np.asarray(out["Scores"])
+    assert boxes.shape == (1, na * h * w, 4)
+    assert scores.shape == (1, na * h * w, cls)
+    assert np.isfinite(boxes).all()
+    # clipped to image
+    assert (boxes >= 0).all() and (boxes <= 64).all()
+
+
+def test_bipartite_match_greedy():
+    from paddle_tpu.ops.registry import get_op
+    impl = get_op("bipartite_match")
+    dist = np.array([[0.6, 0.9, 0.1],
+                     [0.8, 0.2, 0.3]], np.float32)
+    out = impl(None, {"DistMat": [dist]}, {})
+    m = np.asarray(out["ColToRowMatchIndices"])[0]
+    # greedy: (0,1)=0.9 first, then (1,0)=0.8; col 2 unmatched
+    assert m[1] == 0 and m[0] == 1 and m[2] == -1
+
+
+def test_roi_align_shape_and_uniform_case():
+    from paddle_tpu.ops.registry import get_op
+    impl = get_op("roi_align")
+    a = np.ones((1, 3, 8, 8), np.float32) * 5.0
+    rois = np.array([[0, 0, 4, 4], [2, 2, 6, 6]], np.float32)
+    out = impl(None, {"X": [a], "ROIs": [rois]},
+               {"pooled_height": 2, "pooled_width": 2,
+                "spatial_scale": 1.0, "sampling_ratio": 2})
+    r = np.asarray(out["Out"])
+    assert r.shape == (2, 3, 2, 2)
+    np.testing.assert_allclose(r, 5.0, rtol=1e-5)  # constant image
+
+
+def test_grid_sampler_identity():
+    from paddle_tpu.ops.registry import get_op
+    impl = get_op("grid_sampler")
+    rng = np.random.RandomState(12)
+    a = rng.randn(1, 2, 4, 4).astype(np.float32)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    grid = np.stack([xs, ys], -1)[None].astype(np.float32)
+    out = impl(None, {"X": [a], "Grid": [grid]}, {})
+    np.testing.assert_allclose(np.asarray(out["Output"]), a, atol=1e-5)
+
+
+def test_prior_box_count_and_range():
+    from paddle_tpu.ops.registry import get_op
+    impl = get_op("prior_box")
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    img = np.zeros((1, 3, 64, 64), np.float32)
+    out = impl(None, {"Input": [feat], "Image": [img]},
+               {"min_sizes": [16.0], "max_sizes": [32.0],
+                "aspect_ratios": [2.0], "flip": True, "clip": True,
+                "variances": [0.1, 0.1, 0.2, 0.2]})
+    boxes = np.asarray(out["Boxes"])
+    # 1 min + 2 ars + 1 max = 4 priors per cell
+    assert boxes.shape == (4, 4, 4, 4)
+    assert (boxes >= 0).all() and (boxes <= 1).all()
+
+
+class TestRankLoss(OpTest):
+    op_type = "rank_loss"
+
+    def test(self):
+        rng = np.random.RandomState(13)
+        l_ = rng.randn(6, 1).astype(np.float32)
+        r = rng.randn(6, 1).astype(np.float32)
+        y = rng.randint(0, 2, (6, 1)).astype(np.float32)
+        ref = np.logaddexp(0, l_ - r) - y * (l_ - r)
+        self.check_output({"Label": y, "Left": l_, "Right": r}, {},
+                          {"Out": ref}, atol=1e-5)
+
+
+class TestLogLoss(OpTest):
+    op_type = "log_loss"
+
+    def test(self):
+        rng = np.random.RandomState(14)
+        p = rng.rand(8, 1).astype(np.float32) * 0.9 + 0.05
+        y = rng.randint(0, 2, (8, 1)).astype(np.float32)
+        eps = 1e-4
+        ref = -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps)
+        self.check_output({"Predicted": p, "Labels": y},
+                          {"epsilon": eps}, {"Loss": ref}, atol=1e-5)
+
+
+class TestDiceLoss(OpTest):
+    op_type = "dice_loss"
+
+    def test(self):
+        rng = np.random.RandomState(15)
+        p = rng.rand(4, 10).astype(np.float32)
+        y = rng.randint(0, 2, (4, 10)).astype(np.float32)
+        eps = 1e-5
+        inter = (p * y).sum(1)
+        union = p.sum(1) + y.sum(1)
+        ref = 1 - (2 * inter + eps) / (union + eps)
+        self.check_output({"X": p, "Label": y}, {"epsilon": eps},
+                          {"Out": ref}, atol=1e-5)
+
+
+def test_put_along_axis_modes():
+    from paddle_tpu.ops.registry import get_op
+    impl = get_op("put_along_axis")
+    a = np.zeros((3, 4), np.float32)
+    idx = np.array([[0, 2], [1, 3], [0, 1]], np.int64)
+    v = np.ones((3, 2), np.float32)
+    out = np.asarray(impl(None, {"Input": [a], "Index": [idx],
+                                 "Value": [v]},
+                          {"Axis": 1, "Reduce": "add"})["Result"])
+    ref = a.copy()
+    np.put_along_axis(ref, idx, 1.0, 1)
+    np.testing.assert_allclose(out, ref)
+
+
+def test_interp_v2_align_corners_bilinear():
+    from paddle_tpu.ops.registry import get_op
+    impl = get_op("bilinear_interp_v2")
+    a = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = np.asarray(impl(None, {"X": [a]},
+                          {"out_h": 7, "out_w": 7,
+                           "align_corners": True})["Out"])
+    assert out.shape == (1, 1, 7, 7)
+    # corners preserved under align_corners
+    np.testing.assert_allclose(out[0, 0, 0, 0], 0.0, atol=1e-5)
+    np.testing.assert_allclose(out[0, 0, -1, -1], 15.0, atol=1e-5)
+    np.testing.assert_allclose(out[0, 0, 0, -1], 3.0, atol=1e-5)
+
+
+def test_temporal_shift_moves_channels():
+    from paddle_tpu.ops.registry import get_op
+    impl = get_op("temporal_shift")
+    nt, c, h, w = 4, 4, 2, 2
+    a = np.arange(nt * c * h * w, dtype=np.float32).reshape(nt, c, h, w)
+    out = np.asarray(impl(None, {"X": [a]},
+                          {"seg_num": 2, "shift_ratio": 0.25})["Out"])
+    v = a.reshape(2, 2, c, h, w)
+    # first c/4 channels shifted forward in time
+    np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[:, 0, 0],
+                               v[:, 1, 0])
+    # last half unchanged
+    np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[:, :, 2:],
+                               v[:, :, 2:])
