@@ -1,0 +1,3 @@
+"""Slim model-compression toolkit (ref: python/paddle/fluid/contrib/slim)."""
+
+from . import quantization  # noqa: F401

@@ -1,0 +1,7 @@
+"""Quantization (ref: contrib/slim/quantization/)."""
+
+from .quantization_pass import (QuantizationTransformPass,  # noqa: F401
+                                QuantizationFreezePass,
+                                QUANTIZABLE_OP_TYPES)
+from .post_training_quantization import (  # noqa: F401
+    PostTrainingQuantization)

@@ -1,0 +1,118 @@
+"""Post-training quantization (ref: contrib/slim/quantization/
+post_training_quantization.py:119 PostTrainingQuantization).
+
+Same contract as the reference: feed calibration batches through the
+FP32 program, collect per-activation abs-max thresholds, then emit an
+int8 program (weights stored int8 in the scope; activations quantized
+on the fly inside quantized_mul/quantized_conv2d).  ``algo``:
+``abs_max`` (max over batches) or ``avg`` (mean of per-batch maxes —
+the reference's 'avg' mode; KL calibration can layer on later)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .quantization_pass import (QUANTIZABLE_OP_TYPES, _ACT_SLOT,
+                                QuantizationFreezePass)
+
+
+class PostTrainingQuantization:
+    def __init__(self, executor=None, scope=None, program=None,
+                 feed_list: Optional[List[str]] = None,
+                 fetch_list: Optional[List] = None,
+                 model_dir: Optional[str] = None,
+                 model_filename: Optional[str] = None,
+                 params_filename: Optional[str] = None,
+                 batch_generator=None, sample_generator=None,
+                 data_loader=None, batch_size: int = 10,
+                 batch_nums: Optional[int] = None, algo: str = "abs_max",
+                 quantizable_op_type: Optional[List[str]] = None,
+                 weight_bits: int = 8, activation_bits: int = 8,
+                 weight_quantize_type: str = "channel_wise_abs_max"):
+        from ....framework.executor import global_scope
+        self._executor = executor
+        self._scope = scope or global_scope()
+        self._program = program
+        self._feed_list = list(feed_list or [])
+        self._fetch_list = fetch_list
+        self._model_dir = model_dir
+        self._model_filename = model_filename
+        self._params_filename = params_filename
+        self._data_loader = data_loader or batch_generator \
+            or sample_generator
+        self._batch_nums = batch_nums
+        if algo not in ("abs_max", "avg"):
+            raise ValueError(f"unsupported calibration algo {algo!r} "
+                             f"(abs_max | avg)")
+        self._algo = algo
+        self._op_types = list(quantizable_op_type or QUANTIZABLE_OP_TYPES)
+        self._weight_bits = weight_bits
+        self._act_bits = activation_bits
+        self._quantized_program = None
+
+    # -- calibration targets --------------------------------------------
+    def _activation_names(self):
+        names = []
+        for block in self._program.blocks:
+            for op in block.ops:
+                if op.type in self._op_types:
+                    aslot = _ACT_SLOT[op.type]
+                    a = op.inputs.get(aslot, [])
+                    if a and a[0] not in names:
+                        names.append(a[0])
+        return names
+
+    def quantize(self):
+        """Calibrate + freeze; returns the int8 program."""
+        if self._program is None:
+            if self._model_dir is None:
+                raise ValueError("pass `program` or `model_dir`")
+            from .... import io
+            self._program, self._feed_list, fetch_vars = \
+                io.load_inference_model(self._model_dir, self._executor,
+                                        self._model_filename,
+                                        self._params_filename,
+                                        scope=self._scope)
+            self._fetch_list = fetch_vars
+        act_names = self._activation_names()
+        maxes: Dict[str, List[float]] = {n: [] for n in act_names}
+        batch_id = 0
+        for data in self._data_loader():
+            vals = self._executor.run(self._program, feed=data,
+                                      fetch_list=list(act_names),
+                                      scope=self._scope)
+            for n, v in zip(act_names, vals):
+                maxes[n].append(float(np.max(np.abs(v))))
+            batch_id += 1
+            if self._batch_nums and batch_id >= self._batch_nums:
+                break
+        if batch_id == 0:
+            raise ValueError("calibration data loader yielded no batches")
+        if self._algo == "abs_max":
+            scales = {n: max(v) for n, v in maxes.items()}
+        else:
+            scales = {n: float(np.mean(v)) for n, v in maxes.items()}
+        scales = {n: max(s, 1e-9) for n, s in scales.items()}
+
+        quant = self._program.clone()
+        QuantizationFreezePass(
+            self._scope, weight_bits=self._weight_bits,
+            activation_bits=self._act_bits, act_scales=scales,
+            quantizable_op_type=self._op_types).apply(quant)
+        self._quantized_program = quant
+        self._act_scales = scales
+        return quant
+
+    def save_quantized_model(self, save_model_path,
+                             model_filename=None, params_filename=None):
+        """ref: post_training_quantization.py save_quantized_model."""
+        from .... import io
+        if self._quantized_program is None:
+            raise RuntimeError("call quantize() first")
+        fetch = self._fetch_list or []
+        return io.save_inference_model(
+            save_model_path, self._feed_list, fetch, self._executor,
+            self._quantized_program, model_filename, params_filename,
+            scope=self._scope)

@@ -1,0 +1,228 @@
+"""QAT program rewrites (ref: contrib/slim/quantization/
+quantization_pass.py — QuantizationTransformPass:121 inserts fake-quant
+ops on weights+activations of quantizable ops; QuantizationFreezePass
+converts the trained fake-quant program into a real int8 inference
+program).
+
+The reference rewrites an IrGraph; here the rewrite edits the Program's
+flat op list directly (same mechanics as framework/passes.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ....framework import unique_name
+from ....framework.core import Parameter, Program
+
+QUANTIZABLE_OP_TYPES = ["mul", "matmul", "matmul_v2", "conv2d",
+                        "depthwise_conv2d"]
+
+#: input slot holding the weight, per op type
+_WEIGHT_SLOT = {"mul": "Y", "matmul": "Y", "matmul_v2": "Y",
+                "conv2d": "Filter", "depthwise_conv2d": "Filter"}
+_ACT_SLOT = {"mul": "X", "matmul": "X", "matmul_v2": "X",
+             "conv2d": "Input", "depthwise_conv2d": "Input"}
+#: per-channel quant axis of the weight (mul weight [in, out] → 1;
+#: conv filter OIHW → 0)
+_CHANNEL_AXIS = {"mul": 1, "matmul": 1, "matmul_v2": 1, "conv2d": 0,
+                 "depthwise_conv2d": 0}
+
+
+def _weight_transposed(op):
+    return bool(op.attrs.get("transpose_Y", op.attrs.get("trans_y", False)))
+
+
+def _weight_channel_axis(op):
+    """Output-channel axis of the weight: [in, out] → 1, but a transposed
+    matmul weight is [out, in] → 0; conv OIHW → 0."""
+    if op.type in ("matmul", "matmul_v2") and _weight_transposed(op):
+        return 0
+    return _CHANNEL_AXIS[op.type]
+
+
+def _find_var(block, name):
+    return block._find_var_recursive(name)
+
+
+class QuantizationTransformPass:
+    """Insert fake quantize-dequantize on the weight and activation inputs
+    of every quantizable op (ref: quantization_pass.py:121).  Training
+    through the rewritten program is quantization-aware via the STE
+    gradient of the fake-quant ops."""
+
+    def __init__(self, scope=None, weight_bits: int = 8,
+                 activation_bits: int = 8,
+                 activation_quantize_type: str = "abs_max",
+                 weight_quantize_type: str = "channel_wise_abs_max",
+                 quantizable_op_type: Optional[List[str]] = None):
+        self._weight_bits = weight_bits
+        self._act_bits = activation_bits
+        self._w_type = weight_quantize_type
+        self._a_type = activation_quantize_type
+        self._op_types = list(quantizable_op_type or QUANTIZABLE_OP_TYPES)
+
+    def apply(self, program: Program) -> Program:
+        for block in program.blocks:
+            self._apply_block(block)
+        program._bump_version()
+        return program
+
+    def _fq(self, block, idx, var_name, bits, channel_axis):
+        """Insert a fake-quant op before op ``idx``; returns new var name
+        and the number of ops inserted."""
+        from ....framework.core import Operator
+        v = _find_var(block, var_name)
+        out_name = unique_name.generate(f"{var_name}.quantized")
+        block.create_var(name=out_name,
+                         shape=v.shape if v is not None else (),
+                         dtype=v.dtype if v is not None else "float32",
+                         stop_gradient=False)
+        scale_name = unique_name.generate(f"{var_name}.scale")
+        block.create_var(name=scale_name, shape=(-1,), dtype="float32")
+        if channel_axis is None:
+            op_type = "fake_quantize_dequantize_abs_max"
+            attrs = {"bit_length": bits}
+        else:
+            op_type = "fake_channel_wise_quantize_dequantize_abs_max"
+            attrs = {"bit_length": bits, "quant_axis": channel_axis}
+        op = Operator(block, op_type, {"X": [var_name]},
+                      {"Out": [out_name], "OutScale": [scale_name]}, attrs)
+        block.ops.insert(idx, op)
+        return out_name
+
+    def _apply_block(self, block):
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type in self._op_types and \
+                    not op.attrs.get("_quantized", False):
+                wslot = _WEIGHT_SLOT[op.type]
+                aslot = _ACT_SLOT[op.type]
+                wnames = op.inputs.get(wslot, [])
+                anames = op.inputs.get(aslot, [])
+                wv = _find_var(block, wnames[0]) if wnames else None
+                if wv is None or not isinstance(wv, Parameter):
+                    i += 1
+                    continue
+                axis = (_weight_channel_axis(op)
+                        if self._w_type.startswith("channel") else None)
+                new_w = self._fq(block, i, wnames[0], self._weight_bits,
+                                 axis)
+                i += 1
+                new_a = self._fq(block, i, anames[0], self._act_bits, None)
+                i += 1
+                op.inputs[wslot] = [new_w]
+                op.inputs[aslot] = [new_a]
+                op.attrs["_quantized"] = True
+            i += 1
+
+
+class QuantizationFreezePass:
+    """Convert a (QAT-trained or calibrated) program into a REAL int8
+    inference program (ref: quantization_pass.py QuantizationFreezePass):
+    weights become int8 scope tensors with per-channel scales; quantizable
+    ops become quantized_mul / quantized_conv2d with the activation scale
+    baked in as an attr."""
+
+    def __init__(self, scope, weight_bits: int = 8,
+                 activation_bits: int = 8,
+                 act_scales: Optional[Dict[str, float]] = None,
+                 quantizable_op_type: Optional[List[str]] = None):
+        self._scope = scope
+        self._weight_bits = weight_bits
+        self._act_bits = activation_bits
+        self._act_scales = dict(act_scales or {})
+        self._op_types = list(quantizable_op_type or QUANTIZABLE_OP_TYPES)
+
+    def apply(self, program: Program) -> Program:
+        for block in program.blocks:
+            self._strip_fake_quant(block)
+        for block in program.blocks:
+            self._freeze_block(block)
+        program._bump_version()
+        return program
+
+    def _strip_fake_quant(self, block):
+        """Remove QAT fake-quant ops, rewiring consumers to raw inputs."""
+        remap = {}
+        kept = []
+        for op in block.ops:
+            if op.type in ("fake_quantize_dequantize_abs_max",
+                           "fake_channel_wise_quantize_dequantize_abs_max"):
+                remap[op.outputs["Out"][0]] = op.inputs["X"][0]
+            else:
+                kept.append(op)
+        block.ops = kept
+        for op in block.ops:
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [remap.get(n, n) for n in names]
+            op.attrs.pop("_quantized", None)
+
+    def _freeze_block(self, block):
+        import jax.numpy as jnp
+        qmax = float(2 ** (self._weight_bits - 1) - 1)
+        for op in block.ops:
+            if op.type not in self._op_types:
+                continue
+            wslot = _WEIGHT_SLOT[op.type]
+            aslot = _ACT_SLOT[op.type]
+            wnames = op.inputs.get(wslot, [])
+            if not wnames:
+                continue
+            wname = wnames[0]
+            wvar = _find_var(block, wname)
+            if wvar is None or not isinstance(wvar, Parameter):
+                continue
+            wval = self._scope.find_var(wname)
+            if wval is None:
+                continue
+            wval = np.asarray(wval)
+            axis = _weight_channel_axis(op)
+            red = tuple(i for i in range(wval.ndim) if i != axis)
+            scale = np.maximum(np.abs(wval).max(axis=red), 1e-9)
+            shape = [1] * wval.ndim
+            shape[axis] = -1
+            q = np.clip(np.round(wval / scale.reshape(shape) * qmax),
+                        -qmax, qmax).astype(np.int8)
+            qname = wname + "@quantized.int8"
+            sname = wname + "@scale"
+            block.create_var(name=qname, shape=q.shape, dtype="int8",
+                             persistable=True)
+            block.create_var(name=sname, shape=scale.shape,
+                             dtype="float32", persistable=True)
+            self._scope.set_var(qname, jnp.asarray(q))
+            self._scope.set_var(sname, jnp.asarray(scale,
+                                                   dtype=jnp.float32))
+            in_scale = self._act_scales.get(op.inputs[aslot][0])
+            if in_scale is None:
+                raise ValueError(
+                    f"no activation scale collected for input "
+                    f"{op.inputs[aslot][0]!r} of op {op.type!r} — run "
+                    f"calibration (PostTrainingQuantization) first")
+            if op.type in ("mul", "matmul", "matmul_v2"):
+                new_attrs = {"in_scale": float(in_scale),
+                             "bit_length": self._weight_bits,
+                             "act_bit_length": self._act_bits,
+                             "transpose_y": _weight_transposed(op),
+                             "x_num_col_dims": op.attrs.get(
+                                 "x_num_col_dims", 1)}
+                if op.attrs.get("transpose_X", op.attrs.get("trans_x")):
+                    raise NotImplementedError(
+                        "quantized matmul with transpose_X is unsupported")
+                op.type = "quantized_mul"
+                op.inputs = {"X": op.inputs[aslot], "Y": [qname],
+                             "YScale": [sname]}
+                op.attrs = new_attrs
+            else:
+                op.type = "quantized_conv2d"
+                op.inputs = {"Input": op.inputs[aslot], "Filter": [qname],
+                             "FilterScale": [sname]}
+                op.attrs = {"in_scale": float(in_scale),
+                            "bit_length": self._weight_bits,
+                            "act_bit_length": self._act_bits,
+                            "strides": op.attrs.get("strides", [1, 1]),
+                            "paddings": op.attrs.get("paddings", [0, 0]),
+                            "dilations": op.attrs.get("dilations", [1, 1]),
+                            "groups": op.attrs.get("groups", 1)}

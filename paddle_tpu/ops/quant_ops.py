@@ -1,0 +1,152 @@
+"""Quantization ops (ref: operators/fake_quantize_op.cc — the
+fake_quantize_* family, and the int8 kernels behind
+contrib/slim/quantization).
+
+QAT fake-quant uses a straight-through estimator (gradient passes
+unchanged inside the clip range, zero outside — ref:
+fake_quantize_op.cc FakeQuantizeDequantizeGrad).  The frozen int8 ops
+run REAL int8 dot/conv on the MXU (lax dot_general with int8 operands,
+int32 accumulation) — the TPU-native analog of the reference's mkldnn
+int8 kernels."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+
+
+def _qmax(bits):
+    return float(2 ** (bits - 1) - 1)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_fake_quant(bits, per_channel_axis):
+    """STE fake quantize-dequantize specialised on (bits, channel axis)."""
+    qmax = _qmax(bits)
+
+    @jax.custom_vjp
+    def fq(a, scale):
+        s = jnp.maximum(scale, 1e-9)
+        q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax)
+        return q * s / qmax
+
+    def fwd(a, scale):
+        return fq(a, scale), (a, scale)
+
+    def bwd(res, g):
+        a, scale = res
+        s = jnp.maximum(scale, 1e-9)
+        inside = (jnp.abs(a) <= s).astype(g.dtype)
+        return g * inside, None
+
+    fq.defvjp(fwd, bwd)
+    return fq
+
+
+def _abs_max(a, channel_axis=None):
+    if channel_axis is None:
+        return jnp.max(jnp.abs(a))
+    red = tuple(i for i in range(a.ndim) if i != channel_axis)
+    m = jnp.max(jnp.abs(a), axis=red, keepdims=True)
+    return m
+
+
+@register("fake_quantize_dequantize_abs_max")
+def _fake_qdq_abs_max(ctx, ins, attrs):
+    a = x(ins, "X")
+    bits = attrs.get("bit_length", 8)
+    scale = _abs_max(a)
+    out = _make_fake_quant(bits, None)(a, scale)
+    return {"Out": out, "OutScale": scale.reshape(1)}
+
+
+@register("fake_channel_wise_quantize_dequantize_abs_max")
+def _fake_qdq_channel(ctx, ins, attrs):
+    a = x(ins, "X")
+    bits = attrs.get("bit_length", 8)
+    axis = attrs.get("quant_axis", 0)
+    scale = _abs_max(a, axis)
+    out = _make_fake_quant(bits, axis)(a, scale)
+    return {"Out": out, "OutScale": scale.reshape(-1)}
+
+
+@register("quantize_abs_max")
+def _quantize_abs_max(ctx, ins, attrs):
+    """float → int8 + scale (used at freeze time)."""
+    a = x(ins, "X")
+    bits = attrs.get("bit_length", 8)
+    axis = attrs.get("quant_axis")
+    qmax = _qmax(bits)
+    scale = _abs_max(a, axis)
+    q = jnp.clip(jnp.round(a / jnp.maximum(scale, 1e-9) * qmax),
+                 -qmax, qmax).astype(jnp.int8)
+    return {"Out": q, "OutScale": scale}
+
+
+@register("dequantize_abs_max")
+def _dequantize_abs_max(ctx, ins, attrs):
+    q, scale = x(ins, "X"), x(ins, "Scale")
+    bits = attrs.get("bit_length", 8)
+    return {"Out": q.astype(jnp.float32) * scale / _qmax(bits)}
+
+
+def _quant_act(a, in_scale, bits):
+    qmax = _qmax(bits)
+    return jnp.clip(jnp.round(a / in_scale * qmax), -qmax,
+                    qmax).astype(jnp.int8)
+
+
+@register("quantized_mul")
+def _quantized_mul(ctx, ins, attrs):
+    """int8×int8→int32 GEMM with per-output-channel weight scales
+    (ref semantics: mkldnn int8 fc; MXU-native here)."""
+    a = x(ins, "X")
+    wq = x(ins, "Y")                  # int8 [in, out] ([out, in] if t_y)
+    ws = x(ins, "YScale").reshape(-1)        # f32 [out]
+    in_scale = attrs["in_scale"]
+    w_bits = attrs.get("bit_length", 8)
+    a_bits = attrs.get("act_bit_length", w_bits)
+    t_y = attrs.get("transpose_y", False)
+    xn = attrs.get("x_num_col_dims", 1)
+    out_dim = wq.shape[0] if t_y else wq.shape[1]
+    out_shape = a.shape[:xn] + (out_dim,)
+    a2 = a.reshape((-1,) + a.shape[xn:]) if a.ndim > 2 else a
+    a2 = a2.reshape(a2.shape[0], -1)
+    xq = _quant_act(a2, in_scale, a_bits)
+    contract = (((1,), (1,)), ((), ())) if t_y else (((1,), (0,)), ((), ()))
+    acc = lax.dot_general(xq, wq, contract,
+                          preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (
+        in_scale * ws / (_qmax(a_bits) * _qmax(w_bits)))
+    return {"Out": out.reshape(out_shape)}
+
+
+@register("quantized_conv2d")
+def _quantized_conv2d(ctx, ins, attrs):
+    """int8 conv, NCHW/OIHW, per-output-channel weight scales."""
+    a = x(ins, "Input")
+    wq = x(ins, "Filter")                    # int8 OIHW
+    ws = x(ins, "FilterScale").reshape(-1)   # f32 [O]
+    in_scale = attrs["in_scale"]
+    w_bits = attrs.get("bit_length", 8)
+    a_bits = attrs.get("act_bit_length", w_bits)
+    strides = attrs.get("strides", [1, 1])
+    p = attrs.get("paddings", [0, 0])
+    dil = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1) or 1
+    xq = _quant_act(a, in_scale, a_bits)
+    acc = lax.conv_general_dilated(
+        xq.astype(jnp.int8), wq, window_strides=strides,
+        padding=[(p[0], p[0]), (p[1], p[1])] if len(p) == 2
+        else [(p[0], p[1]), (p[2], p[3])],
+        rhs_dilation=dil, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.int32)
+    scale = (in_scale * ws
+             / (_qmax(a_bits) * _qmax(w_bits))).reshape(1, -1, 1, 1)
+    return {"Output": acc.astype(jnp.float32) * scale}

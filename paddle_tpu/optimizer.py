@@ -127,7 +127,11 @@ class Optimizer:
                                checkpoints=checkpoints)
 
     def apply_gradients(self, params_grads):
-        block = default_main_program().global_block()
+        prog = default_main_program()
+        # ops go to the CURRENT block so wrappers can gate the whole apply
+        # inside a conditional region (GradientMerge's exact skip);
+        # accumulators are persistable state and always live globally
+        block = prog.current_block()
         params_grads = append_regularization_ops(params_grads,
                                                  self.regularization)
         grad_clip = self._grad_clip
@@ -137,7 +141,8 @@ class Optimizer:
         if grad_clip is not None:
             params_grads = grad_clip(params_grads)
         self._create_global_learning_rate()
-        self._create_accumulators(block, [p for p, _ in params_grads])
+        self._create_accumulators(prog.global_block(),
+                                  [p for p, _ in params_grads])
         opt_ops = []
         for pg in params_grads:
             opt_ops.append(self._append_optimize_op(block, pg))
@@ -750,19 +755,45 @@ class GradientMergeOptimizer(Optimizer):
             scale = 1.0 / self.k_steps if self.avg else 1.0
             main.append_op(type="scale", inputs={"X": [acc]},
                            outputs={"Out": [eff]}, attrs={"scale": scale})
-            # grad used by the inner op = mask * merged (zero when skipping)
-            main.append_op(type="elementwise_mul",
-                           inputs={"X": [eff], "Y": [maskf]},
-                           outputs={"Out": [eff]}, attrs={"axis": -1})
             merged.append((p, eff))
             # reset acc when applied: acc *= (1 - mask)
             main.append_op(type="elementwise_mul",
                            inputs={"X": [acc], "Y": [inv_mask]},
                            outputs={"Out": [acc]}, attrs={"axis": -1})
-        # NOTE: masked-grad trick means optimizer state (e.g. momentum)
-        # decays slightly on skip steps for stateful optimizers; exact skip
-        # needs lax.cond lowering (future work).
-        opt_ops = self._inner.apply_gradients(merged)
+
+        # EXACT skip: the whole inner apply (params AND optimizer state —
+        # Adam moments etc. must not decay on skip steps) runs inside one
+        # lax.cond region, selected by step % k == 0 (ref: the reference
+        # gates apply with a conditional_block the same way,
+        # optimizer.py:4949 GradientMergeOptimizer._true_apply_gradients)
+        from .layers.control_flow import cond as cond_layer
+        from .layers import tensor_ops as T
+        prog = default_main_program()
+        gb = prog.global_block()
+        pred = T.cast(maskf, "bool")
+        written = []
+
+        def true_fn():
+            blk = prog.current_block()
+            start = len(blk.ops)
+            self._inner.apply_gradients(merged)
+            seen = []
+            for op in blk.ops[start:]:
+                for n in op.output_names():
+                    if n not in seen:
+                        seen.append(n)
+            written[:] = [n for n in seen
+                          if n in gb.vars and gb.vars[n].persistable]
+            return [gb.vars[n] for n in written]
+
+        def false_fn():
+            return [T.assign(gb.vars[n]) for n in written]
+
+        outs = cond_layer(pred, true_fn, false_fn, name="gm_apply")
+        opt_ops = []
+        for n, o in zip(written, outs):
+            opt_ops.append(main.append_op(
+                type="assign", inputs={"X": [o]}, outputs={"Out": [n]}))
         return opt_ops, merged
 
 

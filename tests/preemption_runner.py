@@ -1,0 +1,65 @@
+"""Trainer used by test_preemption.py: trains a deterministic MLP with a
+PreemptionHandler; SIGTERM mid-run → checkpoint + exit 42; relaunch
+resumes and finishes, printing the final weights hash + loss series."""
+
+import hashlib
+import json
+import os
+import sys
+
+if __name__ == "__main__":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+
+
+def main(ckpt_dir, max_steps, slow):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import time
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed.preemption import PreemptionHandler
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.data("y", shape=[1])
+        h = fluid.layers.fc(x, 16, act="tanh",
+                            param_attr=fluid.ParamAttr(name="pw1"))
+        p = fluid.layers.fc(h, 1, param_attr=fluid.ParamAttr(name="pw2"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    handler = PreemptionHandler(exe, ckpt_dir, main_p, save_interval=None)
+    status = handler.restore()
+
+    losses = []
+    for step in range(status.step + 1, max_steps):
+        rng = np.random.RandomState(step)          # per-step determinism
+        xs = rng.randn(32, 8).astype(np.float32)
+        ys = xs.sum(1, keepdims=True).astype(np.float32)
+        l, = exe.run(main_p, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(round(float(l), 10))
+        handler.step_done(step)
+        if slow:
+            print(f"STEP {step}", flush=True)
+            time.sleep(0.3)
+    handler.finish(max_steps - 1)
+
+    from paddle_tpu.framework.executor import global_scope
+    w1 = np.asarray(global_scope().find_var("pw1"))
+    w2 = np.asarray(global_scope().find_var("pw2"))
+    digest = hashlib.sha256(w1.tobytes() + w2.tobytes()).hexdigest()
+    print("RESULT " + json.dumps({"digest": digest,
+                                  "first_step": status.step + 1,
+                                  "losses_tail": losses[-5:]}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], int(sys.argv[2]),
+                  slow=len(sys.argv) > 3 and sys.argv[3] == "slow"))

@@ -157,3 +157,59 @@ def test_fleet_dgc_swap():
         opt.minimize(loss)
     types = [op.type for op in main.global_block().ops]
     assert "dgc_momentum" in types
+
+
+def test_fleet_full_bert_recipe_composition():
+    """AMP + recompute + gradient-merge composed in one strategy — the
+    BERT pretraining recipe (ref: fleet/base/strategy_compiler.py
+    composes meta-optimizers; VERDICT asks for the composed proof)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h1 = fluid.layers.fc(x, 16, act="relu", bias_attr=False)
+        h2 = fluid.layers.fc(h1, 16, act="relu", bias_attr=False)
+        logits = fluid.layers.fc(h2, 2, bias_attr=False)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fleet.init(UserDefinedRoleMaker(0, 1))
+        strategy = DistributedStrategy()
+        strategy.amp = True
+        strategy.recompute = True
+        strategy.recompute_configs = {"checkpoints": [h1.name]}
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        strategy.mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+        opt = distributed_optimizer(fluid.optimizer.Adam(5e-2), strategy)
+        opt.minimize(loss)
+
+    block = main.global_block()
+    types = [op.type for op in block.ops]
+    assert "cast" in types                       # amp rewrite ran
+    bw = next(op for op in block.ops if op.type == "backward")
+    assert bw.attrs.get("checkpoints"), "recompute checkpoints not wired"
+    assert "c_allreduce_sum" in types            # collective dp
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(3)
+    xs = rng.randn(16, 8).astype(np.float32)
+    ys = (xs.sum(1) > 0).astype(np.int64).reshape(-1, 1)
+
+    from paddle_tpu.framework.executor import global_scope
+    w_name = main.all_parameters()[0].name
+    losses = []
+    w_snapshots = []
+    for i in range(8):
+        l, = exe.run(fleet.main_program, feed={"x": xs, "label": ys},
+                     fetch_list=[loss])
+        losses.append(float(l))
+        w_snapshots.append(np.asarray(global_scope().find_var(w_name)))
+    assert all(np.isfinite(losses))
+    # gradient merge: params move exactly every k=2 steps (either phase)
+    changes = [not np.array_equal(a, b)
+               for a, b in zip(w_snapshots, w_snapshots[1:])]
+    assert changes in ([True, False] * 3 + [True],
+                       [False, True] * 3 + [False]), changes
+    # the composed stack actually learns
+    assert losses[-1] < losses[0], losses
