@@ -40,13 +40,16 @@ class PostTrainingQuantization:
         self._model_dir = model_dir
         self._model_filename = model_filename
         self._params_filename = params_filename
-        self._data_loader = data_loader or batch_generator \
-            or sample_generator
+        self._data_loader = data_loader
+        self._batch_generator = batch_generator
+        self._sample_generator = sample_generator
+        self._batch_size = batch_size
         self._batch_nums = batch_nums
         if algo not in ("abs_max", "avg"):
             raise ValueError(f"unsupported calibration algo {algo!r} "
                              f"(abs_max | avg)")
         self._algo = algo
+        self._weight_quantize_type = weight_quantize_type
         self._op_types = list(quantizable_op_type or QUANTIZABLE_OP_TYPES)
         self._weight_bits = weight_bits
         self._act_bits = activation_bits
@@ -64,6 +67,40 @@ class PostTrainingQuantization:
                         names.append(a[0])
         return names
 
+    def _iter_feed_dicts(self):
+        """Unify the three reference loader contracts into feed dicts:
+        data_loader yields dicts (or tuples zipped with feed_list),
+        batch_generator yields per-batch tuples of arrays (ref:
+        post_training_quantization.py batch_generator), sample_generator
+        yields per-sample tuples batched here by batch_size (ref
+        sample_generator contract)."""
+        def to_feed(batch):
+            if isinstance(batch, dict):
+                return batch
+            if not self._feed_list:
+                raise ValueError("tuple-yielding loaders need feed_list")
+            return dict(zip(self._feed_list,
+                            [np.asarray(a) for a in batch]))
+
+        if self._data_loader is not None:
+            for batch in self._data_loader():
+                yield to_feed(batch)
+        elif self._batch_generator is not None:
+            for batch in self._batch_generator():
+                yield to_feed(batch)
+        elif self._sample_generator is not None:
+            buf = []
+            for sample in self._sample_generator():
+                buf.append(sample)
+                if len(buf) == self._batch_size:
+                    yield to_feed(tuple(
+                        np.stack([np.asarray(s[i]) for s in buf])
+                        for i in range(len(buf[0]))))
+                    buf = []
+        else:
+            raise ValueError("pass data_loader, batch_generator, or "
+                             "sample_generator")
+
     def quantize(self):
         """Calibrate + freeze; returns the int8 program."""
         if self._program is None:
@@ -79,7 +116,7 @@ class PostTrainingQuantization:
         act_names = self._activation_names()
         maxes: Dict[str, List[float]] = {n: [] for n in act_names}
         batch_id = 0
-        for data in self._data_loader():
+        for data in self._iter_feed_dicts():
             vals = self._executor.run(self._program, feed=data,
                                       fetch_list=list(act_names),
                                       scope=self._scope)
@@ -100,7 +137,8 @@ class PostTrainingQuantization:
         QuantizationFreezePass(
             self._scope, weight_bits=self._weight_bits,
             activation_bits=self._act_bits, act_scales=scales,
-            quantizable_op_type=self._op_types).apply(quant)
+            quantizable_op_type=self._op_types,
+            weight_quantize_type=self._weight_quantize_type).apply(quant)
         self._quantized_program = quant
         self._act_scales = scales
         return quant

@@ -129,20 +129,45 @@ class QuantizationFreezePass:
     def __init__(self, scope, weight_bits: int = 8,
                  activation_bits: int = 8,
                  act_scales: Optional[Dict[str, float]] = None,
-                 quantizable_op_type: Optional[List[str]] = None):
+                 quantizable_op_type: Optional[List[str]] = None,
+                 weight_quantize_type: str = "channel_wise_abs_max"):
         self._scope = scope
         self._weight_bits = weight_bits
         self._act_bits = activation_bits
         self._act_scales = dict(act_scales or {})
         self._op_types = list(quantizable_op_type or QUANTIZABLE_OP_TYPES)
+        self._channel_wise = weight_quantize_type.startswith("channel")
 
     def apply(self, program: Program) -> Program:
+        # validate BEFORE any mutation — a partial freeze is unusable
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type in ("matmul", "matmul_v2") and                         op.type in self._op_types and                         op.attrs.get("transpose_X",
+                                     op.attrs.get("trans_x")):
+                    raise NotImplementedError(
+                        "quantized matmul with transpose_X is unsupported")
+        self._frozen_weights = []
         for block in program.blocks:
             self._strip_fake_quant(block)
         for block in program.blocks:
             self._freeze_block(block)
+        self._drop_fp32_weights(program)
         program._bump_version()
         return program
+
+    def _drop_fp32_weights(self, program):
+        """Remove replaced FP32 weight Parameters no op references any
+        more — the int8 artifact must not carry both copies (the
+        reference freeze pass deletes the FP32 nodes the same way)."""
+        still_used = set()
+        for block in program.blocks:
+            for op in block.ops:
+                still_used.update(op.input_names())
+        for name in self._frozen_weights:
+            if name in still_used:
+                continue
+            for block in program.blocks:
+                block.vars.pop(name, None)
 
     def _strip_fake_quant(self, block):
         """Remove QAT fake-quant ops, rewiring consumers to raw inputs."""
@@ -179,13 +204,18 @@ class QuantizationFreezePass:
             if wval is None:
                 continue
             wval = np.asarray(wval)
-            axis = _weight_channel_axis(op)
-            red = tuple(i for i in range(wval.ndim) if i != axis)
-            scale = np.maximum(np.abs(wval).max(axis=red), 1e-9)
-            shape = [1] * wval.ndim
-            shape[axis] = -1
-            q = np.clip(np.round(wval / scale.reshape(shape) * qmax),
-                        -qmax, qmax).astype(np.int8)
+            if self._channel_wise:
+                axis = _weight_channel_axis(op)
+                red = tuple(i for i in range(wval.ndim) if i != axis)
+                scale = np.maximum(np.abs(wval).max(axis=red), 1e-9)
+                shape = [1] * wval.ndim
+                shape[axis] = -1
+                scaled = wval / scale.reshape(shape)
+            else:
+                scale = np.maximum(np.abs(wval).max(), 1e-9).reshape(1)
+                scaled = wval / scale
+            q = np.clip(np.round(scaled * qmax), -qmax, qmax).astype(
+                np.int8)
             qname = wname + "@quantized.int8"
             sname = wname + "@scale"
             block.create_var(name=qname, shape=q.shape, dtype="int8",
@@ -195,6 +225,7 @@ class QuantizationFreezePass:
             self._scope.set_var(qname, jnp.asarray(q))
             self._scope.set_var(sname, jnp.asarray(scale,
                                                    dtype=jnp.float32))
+            self._frozen_weights.append(wname)
             in_scale = self._act_scales.get(op.inputs[aslot][0])
             if in_scale is None:
                 raise ValueError(
@@ -208,9 +239,6 @@ class QuantizationFreezePass:
                              "transpose_y": _weight_transposed(op),
                              "x_num_col_dims": op.attrs.get(
                                  "x_num_col_dims", 1)}
-                if op.attrs.get("transpose_X", op.attrs.get("trans_x")):
-                    raise NotImplementedError(
-                        "quantized matmul with transpose_X is unsupported")
                 op.type = "quantized_mul"
                 op.inputs = {"X": op.inputs[aslot], "Y": [qname],
                              "YScale": [sname]}

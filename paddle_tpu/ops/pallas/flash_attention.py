@@ -3,29 +3,39 @@
 The reference's fastest attention is a monolithic fused CUDA kernel
 (ref: operators/fused/multihead_matmul_op.cu) that still materialises the
 full (S, S) score matrix.  This kernel is strictly stronger: O(S) memory via
-online softmax, MXU-shaped (128x128) blocks, f32 accumulation, and in-kernel
+online softmax, MXU-shaped (128x128) blocks, f32 accumulation, in-kernel
 PRNG dropout (the reference's fused path has no dropout at all — its
 dropout runs as a separate elementwise kernel over the (S, S) probs,
-ref: operators/dropout_op.cu).
+ref: operators/dropout_op.cu), and causal masking with true block
+skipping (blocks above the diagonal never execute).
 
-Forward: grid (batch*heads, q_blocks), inner fori_loop over KV blocks with
-the standard online-softmax recurrence; emits the per-row logsumexp as a
-residual.  Dropout draws uint32 bits from the per-core PRNG seeded
-deterministically per (head, q-block, k-block) so the backward kernels can
-regenerate the identical mask without storing it.
+Layout: every kernel runs a 3-D grid with the KV (or Q, for dk/dv) axis
+innermost and carries the online-softmax state in VMEM scratch.  K/V
+arrive as (1, BLOCK, D) grid blocks, so VMEM holds O(BLOCK·D) regardless
+of sequence length — Pallas double-buffers the HBM fetches between grid
+steps, which is what makes long-context (ring-attention shard sizes)
+viable where staging full K/V per step would overflow VMEM.
+
+Forward: grid (batch*heads, q_blocks, kv_blocks); emits per-row
+logsumexp as a (BH, Sq, 1) residual (row stats live as (rows, 1)
+columns — TPU tiling requires block dim -2 divisible by 8, so a (BQ, 1)
+block is legal where (1, BQ) is not).  Dropout draws uint32 bits from
+the per-core PRNG seeded deterministically per (head, q-block, k-block)
+so the backward kernels regenerate the identical mask without storing
+it (hardware prng_seed takes at most 2 words → the grid coordinates
+fold into one injective linear index).
 
 Backward: two blockwise kernels (FlashAttention-2 style) —
-  * dq: grid over q blocks, loop over kv blocks;
-  * dk/dv: grid over kv blocks, loop over q blocks;
-both recompute the probabilities from q/k and the saved logsumexp
-(p = exp(s - lse)) in f32 and use the identity
+  * dq: grid (bh, q_blocks, kv_blocks), dq accumulated in scratch;
+  * dk/dv: grid (bh, kv_blocks, q_blocks), dk/dv accumulated in scratch;
+both recompute p = exp(s - lse) in f32 and use the identity
 rowsum(p * dp) == rowsum(do * o) (valid with dropout too) so only O(S)
 residuals are ever materialised.
 
 Gradient w.r.t. the additive bias is defined as zero: every call site in
-this framework builds the bias from non-trainable padding masks, and the
-dispatch (ops/attention_ops.py) stop-gradients it.  A learned attention
-bias must use the jnp composition instead.
+this framework builds the bias from non-trainable padding masks and the
+kernel wrapper stop-gradients it.  A learned attention bias must use the
+jnp composition instead.
 """
 
 from __future__ import annotations
@@ -42,6 +52,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 BLOCK_Q = 128
 BLOCK_K = 128
+NEG_INF = -1e30
 
 
 def _dropout_mask(seed_ref, block_idx, shape, rate):
@@ -54,70 +65,100 @@ def _dropout_mask(seed_ref, block_idx, shape, rate):
     return bits >= threshold           # P(keep) = 1 - rate
 
 
-def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *,
-                scale, num_q_blocks, num_k_blocks, has_bias, rate):
+def _causal_mask_block(qi, kj):
+    """(BQ, BK) bool: row position >= col position for the (qi, kj) tile."""
+    rows = qi * BLOCK_Q + lax.broadcasted_iota(jnp.int32,
+                                               (BLOCK_Q, BLOCK_K), 0)
+    cols = kj * BLOCK_K + lax.broadcasted_iota(jnp.int32,
+                                               (BLOCK_Q, BLOCK_K), 1)
+    return rows >= cols
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, num_q_blocks,
+                num_k_blocks, has_bias, rate, causal):
     b = pl.program_id(0)
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)           # (BQ, D)
-    acc = jnp.zeros((q.shape[0], v_ref.shape[-1]), jnp.float32)
-    m = jnp.full((q.shape[0], 1), -jnp.inf, jnp.float32)
-    l = jnp.zeros((q.shape[0], 1), jnp.float32)
+    j = pl.program_id(2)
+    last_j = (jnp.minimum((qi + 1) * BLOCK_Q // BLOCK_K, num_k_blocks) - 1
+              if causal else num_k_blocks - 1)
+    run = (j <= last_j) if causal else True
 
-    def body(j, carry):
-        acc, m, l = carry
-        ks = k_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        vs = v_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :]
-        s = lax.dot_general(
-            q, ks, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale     # (BQ, BK)
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)           # (BQ, D)
+        ks = k_ref[0].astype(jnp.float32)          # (BK, D)
+        vs = v_ref[0]
+        s = lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
         if has_bias:
-            s = s + b_ref[0, :, pl.ds(j * BLOCK_K, BLOCK_K)].astype(
-                jnp.float32)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
+            s = s + b_ref[0].astype(jnp.float32)
+        if causal:
+            s = jnp.where(_causal_mask_block(qi, j), s, NEG_INF)
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         # l accumulates the UNdropped probs (the softmax denominator);
         # the mask applies to the numerator only, so acc/l == dropout(P)@V
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         if rate:
             idx = (b * num_q_blocks + qi) * num_k_blocks + j
             keep = _dropout_mask(seed_ref, idx, p.shape, rate)
             p = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
-        acc_new = acc * alpha + lax.dot_general(
+        acc_ref[...] = acc_ref[...] * alpha + lax.dot_general(
             p.astype(vs.dtype), vs, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
+        m_ref[...] = m_new
 
-    acc, m, l = lax.fori_loop(0, num_k_blocks, body, (acc, m, l))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    # rows with no unmasked keys (l == 0) store +inf so the backward's
-    # exp(s - lse) is exactly 0 there, not inf.  Row stats live as
-    # (rows, 1) columns: TPU tiling requires block dim -2 divisible by 8,
-    # so a (BQ, 1) block over a (Sq, 1) array is legal where (1, BQ) is not.
-    lse_ref[0] = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)),
-                           jnp.inf)
+    @pl.when(j == last_j)
+    def _finalize():
+        l = l_ref[...]
+        m = m_ref[...]
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(
+            o_ref.dtype)
+        # rows with no unmasked keys (l == 0) store +inf so the backward's
+        # exp(s - lse) is exactly 0 there, not inf
+        lse_ref[0] = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)),
+                               jnp.inf)
 
 
 def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, *, scale, num_q_blocks, num_k_blocks,
-                   has_bias, rate):
+                   delta_ref, dq_ref, acc_ref, *, scale, num_q_blocks,
+                   num_k_blocks, has_bias, rate, causal):
     b = pl.program_id(0)
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)           # (BQ, D)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]                           # (BQ, 1)
-    delta = delta_ref[0]
-    acc = jnp.zeros(q.shape, jnp.float32)
+    j = pl.program_id(2)
+    last_j = (jnp.minimum((qi + 1) * BLOCK_Q // BLOCK_K, num_k_blocks) - 1
+              if causal else num_k_blocks - 1)
+    run = (j <= last_j) if causal else True
 
-    def body(j, acc):
-        ks = k_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        vs = v_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                           # (BQ, 1)
+        delta = delta_ref[0]
+        ks = k_ref[0].astype(jnp.float32)
+        vs = v_ref[0].astype(jnp.float32)
         s = lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         if has_bias:
-            s = s + b_ref[0, :, pl.ds(j * BLOCK_K, BLOCK_K)].astype(
-                jnp.float32)
-        p = jnp.exp(s - lse)                   # (BQ, BK)
+            s = s + b_ref[0].astype(jnp.float32)
+        if causal:
+            s = jnp.where(_causal_mask_block(qi, j), s, NEG_INF)
+        p = jnp.exp(s - lse)                       # (BQ, BK)
         dp = lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         if rate:
@@ -125,35 +166,43 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
             keep = _dropout_mask(seed_ref, idx, p.shape, rate)
             dp = jnp.where(keep, dp * (1.0 / (1.0 - rate)), 0.0)
         ds = p * (dp - delta)
-        return acc + lax.dot_general(ds, ks, (((1,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
+        acc_ref[...] += lax.dot_general(ds, ks, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
 
-    acc = lax.fori_loop(0, num_k_blocks, body, acc)
-    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+    @pl.when(j == last_j)
+    def _finalize():
+        dq_ref[0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
-                    delta_ref, dk_ref, dv_ref, *, scale, num_q_blocks,
-                    num_k_blocks, has_bias, rate):
+                    delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
+                    num_q_blocks, num_k_blocks, has_bias, rate, causal):
     b = pl.program_id(0)
     kj = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)           # (BK, D)
-    v = v_ref[0].astype(jnp.float32)
-    dk = jnp.zeros(k.shape, jnp.float32)
-    dv = jnp.zeros(v.shape, jnp.float32)
+    i = pl.program_id(2)
+    first_i = (kj * BLOCK_K) // BLOCK_Q if causal else 0
+    run = (i >= first_i) if causal else True
 
-    def body(i, carry):
-        dk, dv = carry
-        qs = q_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
-        dos = do_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :]     # (BQ, 1)
-        delta = delta_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :]
+    @pl.when(i == first_i)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    @pl.when(run)
+    def _body():
+        k = k_ref[0].astype(jnp.float32)           # (BK, D)
+        v = v_ref[0].astype(jnp.float32)
+        qs = q_ref[0].astype(jnp.float32)          # (BQ, D)
+        dos = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                           # (BQ, 1)
+        delta = delta_ref[0]
         s = lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         if has_bias:
-            s = s + b_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(
-                jnp.float32)
-        p = jnp.exp(s - lse)                   # (BQ, BK)
+            s = s + b_ref[0].astype(jnp.float32)
+        if causal:
+            s = jnp.where(_causal_mask_block(i, kj), s, NEG_INF)
+        p = jnp.exp(s - lse)                       # (BQ, BK)
         dp = lax.dot_general(dos, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         if rate:
@@ -164,39 +213,39 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
             dp = jnp.where(keep, dp * inv, 0.0)
         else:
             pd = p
-        dv = dv + lax.dot_general(pd, dos, (((0,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
+        dv_acc[...] += lax.dot_general(pd, dos, (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        dk = dk + lax.dot_general(ds, qs, (((0,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_acc[...] += lax.dot_general(ds, qs, (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
 
-    dk, dv = lax.fori_loop(0, num_q_blocks, body, (dk, dv))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(i == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bias_specs(bh, sq, sk, bias, block_rows, transpose=False):
+def _bias_spec(bh, bias, transpose=False):
     """BlockSpec + arg for the additive bias, folding a head-shared bias
     ((B, Sq, Sk) with BH = B*H) without materialising the broadcast —
     keeps HBM traffic at O(B*Sq*Sk), not O(B*H*Sq*Sk)."""
     if bias is not None:
         ratio = bh // bias.shape[0]
-        if transpose:  # (1, Sq, BK) blocks for the dkv kernel
-            spec = pl.BlockSpec((1, sq, block_rows),
-                                lambda b, i: (b // ratio, 0, i),
+        if transpose:   # dkv grid is (b, kj, i)
+            spec = pl.BlockSpec((1, BLOCK_Q, BLOCK_K),
+                                lambda b, j, i: (b // ratio, i, j),
                                 memory_space=pltpu.VMEM)
-        else:          # (1, BQ, Sk) blocks for fwd / dq kernels
-            spec = pl.BlockSpec((1, block_rows, sk),
-                                lambda b, i: (b // ratio, i, 0),
+        else:
+            spec = pl.BlockSpec((1, BLOCK_Q, BLOCK_K),
+                                lambda b, i, j: (b // ratio, i, j),
                                 memory_space=pltpu.VMEM)
         return spec, bias
-    spec = pl.BlockSpec((1, 1, 1), lambda b, i: (0, 0, 0),
+    spec = pl.BlockSpec((1, 1, 1), lambda b, i, j: (0, 0, 0),
                         memory_space=pltpu.VMEM)
     return spec, jnp.zeros((1, 1, 1), jnp.float32)
 
 
-def _flash_fwd(q, k, v, bias, seed, rate, interpret):
+def _flash_fwd(q, k, v, bias, seed, rate, causal, interpret):
     """q: (BH, Sq, D), k/v: (BH, Sk, D) flattened batch*heads;
     bias: (B|BH, Sq, Sk) or None.  Returns (out, lse)."""
     bh, sq, d = q.shape
@@ -206,26 +255,29 @@ def _flash_fwd(q, k, v, bias, seed, rate, interpret):
     scale = 1.0 / math.sqrt(d)
     has_bias = bias is not None
 
-    qspec = pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0),
+    qspec = pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM)
-    kvspec = pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0),
+    kvspec = pl.BlockSpec((1, BLOCK_K, d), lambda b, i, j: (b, j, 0),
                           memory_space=pltpu.VMEM)
-    bspec, barg = _bias_specs(bh, sq, sk, bias, BLOCK_Q)
+    bspec, barg = _bias_spec(bh, bias)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, num_q_blocks=num_q,
                                num_k_blocks=num_k, has_bias=has_bias,
-                               rate=rate)
-    flops = 4 * bh * sq * sk * d
+                               rate=rate, causal=causal)
+    flops = 4 * bh * sq * sk * d // (2 if causal else 1)
     return pl.pallas_call(
         kernel,
-        grid=(bh, num_q),
+        grid=(bh, num_q, num_k),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
                   qspec, kvspec, kvspec, bspec],
         out_specs=[qspec,
-                   pl.BlockSpec((1, BLOCK_Q, 1), lambda b, i: (b, i, 0),
+                   pl.BlockSpec((1, BLOCK_Q, 1), lambda b, i, j: (b, i, 0),
                                 memory_space=pltpu.VMEM)],
         out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
                    jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((BLOCK_Q, d), jnp.float32),
+                        pltpu.VMEM((BLOCK_Q, 1), jnp.float32),
+                        pltpu.VMEM((BLOCK_Q, 1), jnp.float32)],
         cost_estimate=pl.CostEstimate(
             flops=flops, bytes_accessed=q.size * 4 * 3,
             transcendentals=bh * sq * sk),
@@ -233,7 +285,7 @@ def _flash_fwd(q, k, v, bias, seed, rate, interpret):
     )(seed, q, k, v, barg)
 
 
-def _flash_bwd(q, k, v, bias, seed, o, lse, g, rate, interpret):
+def _flash_bwd(q, k, v, bias, seed, o, lse, g, rate, causal, interpret):
     bh, sq, d = q.shape
     sk = k.shape[1]
     num_q = sq // BLOCK_Q
@@ -243,46 +295,50 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, g, rate, interpret):
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)         # (BH, Sq, 1)
 
-    qblk = pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0),
+    qblk = pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0),
                         memory_space=pltpu.VMEM)
-    kblk = pl.BlockSpec((1, BLOCK_K, d), lambda b, j: (b, j, 0),
+    kblk = pl.BlockSpec((1, BLOCK_K, d), lambda b, i, j: (b, j, 0),
                         memory_space=pltpu.VMEM)
-    kfull = pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0),
-                         memory_space=pltpu.VMEM)
-    qfull = pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0),
-                         memory_space=pltpu.VMEM)
-    rowq = pl.BlockSpec((1, BLOCK_Q, 1), lambda b, i: (b, i, 0),
+    rowq = pl.BlockSpec((1, BLOCK_Q, 1), lambda b, i, j: (b, i, 0),
                         memory_space=pltpu.VMEM)
-    rowfull = pl.BlockSpec((1, sq, 1), lambda b, i: (b, 0, 0),
-                           memory_space=pltpu.VMEM)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    bspec_q, barg = _bias_spec(bh, bias)
 
-    bspec_q, barg = _bias_specs(bh, sq, sk, bias, BLOCK_Q)
-    flops = 4 * bh * sq * sk * d
+    flops = 4 * bh * sq * sk * d // (2 if causal else 1)
+    common = dict(scale=scale, num_q_blocks=num_q, num_k_blocks=num_k,
+                  has_bias=has_bias, rate=rate, causal=causal)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, num_q_blocks=num_q,
-                          num_k_blocks=num_k, has_bias=has_bias, rate=rate),
-        grid=(bh, num_q),
-        in_specs=[smem, qblk, kfull, kfull, bspec_q, qblk, rowq, rowq],
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(bh, num_q, num_k),
+        in_specs=[smem, qblk, kblk, kblk, bspec_q, qblk, rowq, rowq],
         out_specs=qblk,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((BLOCK_Q, d), jnp.float32)],
         cost_estimate=pl.CostEstimate(
             flops=2 * flops, bytes_accessed=q.size * 4 * 4,
             transcendentals=bh * sq * sk),
         interpret=interpret,
     )(seed, q, k, v, barg, g, lse, delta)
 
-    bspec_t, barg_t = _bias_specs(bh, sq, sk, bias, BLOCK_K, transpose=True)
+    # dkv grid: (b, kv block, q block) — q axis innermost for accumulation
+    qblk_t = pl.BlockSpec((1, BLOCK_Q, d), lambda b, j, i: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    kblk_t = pl.BlockSpec((1, BLOCK_K, d), lambda b, j, i: (b, j, 0),
+                          memory_space=pltpu.VMEM)
+    rowq_t = pl.BlockSpec((1, BLOCK_Q, 1), lambda b, j, i: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    bspec_t, barg_t = _bias_spec(bh, bias, transpose=True)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, num_q_blocks=num_q,
-                          num_k_blocks=num_k, has_bias=has_bias, rate=rate),
-        grid=(bh, num_k),
-        in_specs=[smem, qfull, kblk, kblk, bspec_t, qfull, rowfull,
-                  rowfull],
-        out_specs=[kblk, kblk],
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(bh, num_k, num_q),
+        in_specs=[smem, qblk_t, kblk_t, kblk_t, bspec_t, qblk_t, rowq_t,
+                  rowq_t],
+        out_specs=[kblk_t, kblk_t],
         out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((BLOCK_K, d), jnp.float32),
+                        pltpu.VMEM((BLOCK_K, d), jnp.float32)],
         cost_estimate=pl.CostEstimate(
             flops=2 * flops, bytes_accessed=q.size * 4 * 4,
             transcendentals=bh * sq * sk),
@@ -292,25 +348,26 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, g, rate, interpret):
 
 
 @functools.lru_cache(maxsize=None)
-def _make_flash(rate, has_bias, interpret):
+def _make_flash(rate, has_bias, causal, interpret):
     """custom_vjp'd flash attention specialised on (dropout rate, bias
-    presence, interpret mode) — all static, so each variant traces once."""
+    presence, causal, interpret mode) — all static, so each variant
+    traces once."""
 
     @jax.custom_vjp
     def f(q, k, v, bias, seed):
-        o, _ = _flash_fwd(q, k, v, bias, seed, rate, interpret)
+        o, _ = _flash_fwd(q, k, v, bias, seed, rate, causal, interpret)
         return o
 
     def fwd(q, k, v, bias, seed):
-        o, lse = _flash_fwd(q, k, v, bias, seed, rate, interpret)
+        o, lse = _flash_fwd(q, k, v, bias, seed, rate, causal, interpret)
         return o, (q, k, v, bias, seed, o, lse)
 
     def bwd(res, g):
         q, k, v, bias, seed, o, lse = res
         dq, dk, dv = _flash_bwd(q, k, v, bias, seed, o, lse, g, rate,
-                                interpret)
+                                causal, interpret)
         # bias grad is zero by contract (mask bias, stop-gradiented at the
-        # dispatch); seed is integer → float0 cotangent
+        # kernel wrapper); seed is integer → float0 cotangent
         dbias = jnp.zeros_like(bias) if has_bias else None
         dseed = np.zeros(seed.shape, jax.dtypes.float0)
         return dq, dk, dv, dbias, dseed
@@ -319,7 +376,7 @@ def _make_flash(rate, has_bias, interpret):
     return f
 
 
-def _reference(q, k, v, bias):
+def _reference(q, k, v, bias, causal=False):
     """jnp spec for the kernels (no dropout), used by tests."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bsd,btd->bst", q, k,
@@ -329,6 +386,10 @@ def _reference(q, k, v, bias):
         if b.shape[0] != q.shape[0]:            # head-shared mask
             b = jnp.repeat(b, q.shape[0] // b.shape[0], axis=0)
         s = s + b.astype(s.dtype)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bst,btd->bsd", p.astype(v.dtype), v,
                       preferred_element_type=jnp.float32).astype(v.dtype)
@@ -355,10 +416,11 @@ def supported(shape_bhsd, k_seq=None, backend=None):
 
 
 def flash_attention_bshd(q, k, v, bias=None, dropout_rate=0.0, seed=None,
-                         interpret=False):
+                         causal=False, interpret=False):
     """q: (B, H, Sq, D), k/v: (B, H, Sk, D); bias: broadcastable
     (B, 1|H, 1|Sq, Sk) or None; seed: int32 scalar/1-vector driving the
-    in-kernel dropout PRNG (required when dropout_rate > 0).
+    in-kernel dropout PRNG (required when dropout_rate > 0); causal masks
+    col > row WITH block skipping (above-diagonal tiles never run).
     Returns (B, H, Sq, D).  Raises ValueError for shapes the kernel does
     not tile — call supported() first."""
     b, h, s, d = q.shape
@@ -369,6 +431,8 @@ def flash_attention_bshd(q, k, v, bias=None, dropout_rate=0.0, seed=None,
             f"flash_attention: unsupported shape/backend (Sq={s} must "
             f"tile {BLOCK_Q}, Sk={sk} must tile {BLOCK_K}, D={d} must be "
             f"64 or a multiple of 128, backend must be TPU)")
+    if causal and s != sk:
+        raise ValueError("causal flash attention requires Sq == Sk")
     if dropout_rate:
         if seed is None:
             raise ValueError("dropout_rate > 0 requires a seed")
@@ -394,5 +458,6 @@ def flash_attention_bshd(q, k, v, bias=None, dropout_rate=0.0, seed=None,
             bf = jnp.broadcast_to(bias, (b, h, s, sk)).reshape(
                 b * h, s, sk)
         bf = lax.stop_gradient(bf)
-    fn = _make_flash(float(dropout_rate), bf is not None, interpret)
+    fn = _make_flash(float(dropout_rate), bf is not None, bool(causal),
+                     interpret)
     return fn(qf, kf, vf, bf, seed).reshape(b, h, s, d)

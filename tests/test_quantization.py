@@ -160,3 +160,38 @@ def test_qat_fake_quant_trains_and_freezes():
         t.startswith("fake_") for t in types)
     int8_acc = _accuracy(exe, infer, pred, xs, ys)
     assert int8_acc >= qat_acc - 0.03, (qat_acc, int8_acc)
+
+
+def test_ptq_sample_generator_and_per_tensor(tmp_path):
+    """Reference loader contracts: sample_generator of per-sample tuples
+    + per-tensor (abs_max) weight scales; frozen model drops the FP32
+    weight copies."""
+    xs, ys = _make_data(seed=3)
+    main, startup, x, label, pred, loss = _build_mlp()
+    test_prog = main.clone(for_test=True)
+    with program_guard(main, startup):
+        fluid.optimizer.Adam(5e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    for _ in range(10):
+        exe.run(main, feed={"x": xs, "label": ys}, fetch_list=[loss])
+
+    def samples():
+        for i in range(64):
+            yield (xs[i], ys[i])
+
+    ptq = PostTrainingQuantization(
+        executor=exe, program=test_prog, feed_list=["x", "label"],
+        fetch_list=[pred], sample_generator=samples, batch_size=16,
+        weight_quantize_type="abs_max")
+    quant = ptq.quantize()
+    from paddle_tpu.framework.executor import global_scope
+    s = np.asarray(global_scope().find_var("q_w1@scale"))
+    assert s.size == 1                       # per-tensor scale
+    # FP32 weights dropped from the frozen program
+    names = set()
+    for b in quant.blocks:
+        names |= set(b.vars)
+    assert "q_w1@quantized.int8" in names and "q_w1" not in names
+    p8, = exe.run(quant, feed={"x": xs, "label": ys}, fetch_list=[pred])
+    assert np.isfinite(p8).all()
