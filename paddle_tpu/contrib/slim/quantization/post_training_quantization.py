@@ -89,14 +89,19 @@ class PostTrainingQuantization:
             for batch in self._batch_generator():
                 yield to_feed(batch)
         elif self._sample_generator is not None:
+            def collate(buf):
+                return to_feed(tuple(
+                    np.stack([np.asarray(s[i]) for s in buf])
+                    for i in range(len(buf[0]))))
+
             buf = []
             for sample in self._sample_generator():
                 buf.append(sample)
                 if len(buf) == self._batch_size:
-                    yield to_feed(tuple(
-                        np.stack([np.asarray(s[i]) for s in buf])
-                        for i in range(len(buf[0]))))
+                    yield collate(buf)
                     buf = []
+            if buf:                 # trailing partial batch still counts
+                yield collate(buf)
         else:
             raise ValueError("pass data_loader, batch_generator, or "
                              "sample_generator")

@@ -1,21 +1,91 @@
-"""Synthetic IMDB sentiment (ref: python/paddle/dataset/imdb.py —
+"""IMDB sentiment (ref: python/paddle/dataset/imdb.py —
 train(word_idx)/test(word_idx) yield (list-of-word-ids, 0/1 label);
 word_dict() returns the vocab).
 
-Synthetic rule: positive reviews oversample ids from the first half of the
-vocab, negative from the second half — linearly separable by bag-of-words,
-like the real task for a strong model."""
+REAL loader: parses the aclImdb directory layout (``{train,test}/
+{pos,neg}/*.txt``, one review per file) with the reference's tokenizer —
+lowercase, punctuation stripped, whitespace split (ref: imdb.py
+tokenize) — and builds word_dict() by frequency over the train split
+exactly like imdb.py build_dict.  Root: ``$PADDLE_TPU_DATA_HOME/
+aclImdb``.  Absent that (zero-egress), a deterministic synthetic
+bag-of-words stand-in is served."""
+
+import os
+import string
 
 import numpy as np
 
 VOCAB_SIZE = 5000
 
 
-def word_dict():
+def data_home():
+    return os.environ.get(
+        "PADDLE_TPU_DATA_HOME",
+        os.path.expanduser("~/.cache/paddle_tpu/dataset"))
+
+
+def _root():
+    return os.path.join(data_home(), "aclImdb")
+
+
+def tokenize(text):
+    """ref: imdb.py tokenize — lowercase, strip punctuation, split."""
+    return text.lower().translate(
+        str.maketrans("", "", string.punctuation)).split()
+
+
+def _iter_files(split, label_dir):
+    d = os.path.join(_root(), split, label_dir)
+    for name in sorted(os.listdir(d)):
+        if name.endswith(".txt"):
+            with open(os.path.join(d, name), encoding="utf-8") as f:
+                yield tokenize(f.read())
+
+
+def build_dict(cutoff=150, max_words=VOCAB_SIZE):
+    """Frequency vocab over train pos+neg (ref: imdb.py build_dict);
+    <unk> gets the last id."""
+    freq = {}
+    for label_dir in ("pos", "neg"):
+        for toks in _iter_files("train", label_dir):
+            for t in toks:
+                freq[t] = freq.get(t, 0) + 1
+    words = [w for w, c in sorted(freq.items(),
+                                  key=lambda kv: (-kv[1], kv[0]))
+             if c >= cutoff][:max_words - 1]
+    d = {w: i for i, w in enumerate(words)}
+    d["<unk>"] = len(words)
+    return d
+
+
+def _real_reader(split, word_idx, n=None):
+    unk = word_idx.get("<unk>", len(word_idx))
+
+    def reader():
+        count = 0
+        # pos label 1, neg label 0 — iterate interleaved for balance
+        pos = _iter_files(split, "pos")
+        neg = _iter_files(split, "neg")
+        for p, ng in zip(pos, neg):
+            for toks, label in ((p, 1), (ng, 0)):
+                yield [word_idx.get(t, unk) for t in toks], label
+                count += 1
+                if n is not None and count >= n:
+                    return
+    return reader
+
+
+def _real_available():
+    return os.path.isdir(os.path.join(_root(), "train", "pos"))
+
+
+# -- synthetic fallback (no egress) -----------------------------------------
+
+def _synth_dict():
     return {f"w{i}": i for i in range(VOCAB_SIZE)}
 
 
-def _reader(n, seed):
+def _synth_reader(n, seed):
     def reader():
         rng = np.random.RandomState(seed)
         for _ in range(n):
@@ -31,9 +101,21 @@ def _reader(n, seed):
     return reader
 
 
+def word_dict():
+    if _real_available():
+        return build_dict()
+    return _synth_dict()
+
+
 def train(word_idx=None, n=1024):
-    return _reader(n, seed=5)
+    if _real_available():
+        return _real_reader(
+            "train", word_dict() if word_idx is None else word_idx, n)
+    return _synth_reader(n, seed=5)
 
 
 def test(word_idx=None, n=256):
-    return _reader(n, seed=6)
+    if _real_available():
+        return _real_reader(
+            "test", word_dict() if word_idx is None else word_idx, n)
+    return _synth_reader(n, seed=6)

@@ -1,11 +1,86 @@
-"""Synthetic MNIST (ref: python/paddle/dataset/mnist.py — train()/test()
-yield (784-float image in [-1, 1], int label)).
+"""MNIST (ref: python/paddle/dataset/mnist.py — train()/test() yield
+(784-float image in [-1, 1], int label)).
 
-Deterministic class-conditional blobs: each digit d gets a fixed template
-(seeded by d) plus small per-example noise, so simple models reach high
-accuracy and loss curves are reproducible."""
+REAL loader: parses the genuine IDX file format (gzip'd, magic 2051 for
+images / 2049 for labels — the same bytes the reference downloads from
+yann.lecun.com and parses in mnist.py reader_creator).  Files are looked
+up under ``$PADDLE_TPU_DATA_HOME/mnist`` (default ~/.cache/paddle_tpu/
+dataset/mnist, reference-compatible layout: train-images-idx3-ubyte.gz,
+train-labels-idx1-ubyte.gz, t10k-*).  This environment has no egress, so
+when the files are absent the loader falls back to a DETERMINISTIC
+synthetic stand-in with identical shapes/dtypes (documented divergence —
+drop the real files in place and the same API serves them)."""
+
+import gzip
+import os
+import struct
 
 import numpy as np
+
+TRAIN_IMAGES = "train-images-idx3-ubyte.gz"
+TRAIN_LABELS = "train-labels-idx1-ubyte.gz"
+TEST_IMAGES = "t10k-images-idx3-ubyte.gz"
+TEST_LABELS = "t10k-labels-idx1-ubyte.gz"
+
+
+def data_home():
+    return os.environ.get(
+        "PADDLE_TPU_DATA_HOME",
+        os.path.expanduser("~/.cache/paddle_tpu/dataset"))
+
+
+def _open_maybe_gz(path):
+    return gzip.open(path, "rb") if path.endswith(".gz") else \
+        open(path, "rb")
+
+
+def parse_idx_images(path):
+    """Parse an IDX3 image file → float32 [N, 784] scaled to [-1, 1]
+    (ref: mnist.py reader_creator normalises the same way)."""
+    with _open_maybe_gz(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{path}: bad IDX image magic {magic}")
+        buf = f.read(n * rows * cols)
+    imgs = np.frombuffer(buf, np.uint8).reshape(n, rows * cols)
+    return (imgs.astype(np.float32) / 255.0) * 2.0 - 1.0
+
+
+def parse_idx_labels(path):
+    with _open_maybe_gz(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{path}: bad IDX label magic {magic}")
+        buf = f.read(n)
+    return np.frombuffer(buf, np.uint8).astype(np.int64)
+
+
+def write_idx_images(path, images_u8):
+    """Inverse of parse_idx_images (fixture/export helper)."""
+    n = images_u8.shape[0]
+    side = int(np.sqrt(images_u8.shape[1]))
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, side, side))
+        f.write(np.ascontiguousarray(images_u8, np.uint8).tobytes())
+
+
+def write_idx_labels(path, labels):
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">II", 2049, len(labels)))
+        f.write(np.ascontiguousarray(labels, np.uint8).tobytes())
+
+
+def _real_reader(images_file, labels_file, n=None):
+    def reader():
+        imgs = parse_idx_images(images_file)
+        labels = parse_idx_labels(labels_file)
+        count = len(labels) if n is None else min(n, len(labels))
+        for i in range(count):
+            yield imgs[i], int(labels[i])
+    return reader
+
+
+# -- synthetic fallback (no egress) -----------------------------------------
 
 _TEMPLATES = None
 
@@ -18,7 +93,7 @@ def _templates():
     return _TEMPLATES
 
 
-def _reader(n, seed):
+def _synth_reader(n, seed):
     def reader():
         rng = np.random.RandomState(seed)
         t = _templates()
@@ -29,9 +104,17 @@ def _reader(n, seed):
     return reader
 
 
+def _maybe_real(images_name, labels_name, n, seed):
+    d = os.path.join(data_home(), "mnist")
+    ip, lp = os.path.join(d, images_name), os.path.join(d, labels_name)
+    if os.path.exists(ip) and os.path.exists(lp):
+        return _real_reader(ip, lp, n)
+    return _synth_reader(2048 if n is None else n, seed)
+
+
 def train(n=2048):
-    return _reader(n, seed=1)
+    return _maybe_real(TRAIN_IMAGES, TRAIN_LABELS, n, seed=1)
 
 
 def test(n=512):
-    return _reader(n, seed=2)
+    return _maybe_real(TEST_IMAGES, TEST_LABELS, n, seed=2)

@@ -1,8 +1,53 @@
-"""Synthetic UCI housing (ref: python/paddle/dataset/uci_housing.py —
-train()/test() yield (13-float features, 1-float price)).  A fixed linear
-ground truth + noise keeps regression book tests meaningful."""
+"""UCI housing (ref: python/paddle/dataset/uci_housing.py — train()/test()
+yield (13-float features, 1-float price)).
+
+REAL loader: parses the genuine ``housing.data`` format (whitespace-
+separated, 14 columns per record, possibly wrapped across lines) with the
+reference's exact preprocessing — per-feature min/max normalisation
+computed over the full set and the 80/20 train/test split
+(ref: uci_housing.py feature_range / load_data).  File:
+``$PADDLE_TPU_DATA_HOME/uci_housing/housing.data``.  Absent that
+(zero-egress), a fixed linear ground truth + noise stands in."""
+
+import os
 
 import numpy as np
+
+FEATURE_DIM = 13
+
+
+def data_home():
+    return os.environ.get(
+        "PADDLE_TPU_DATA_HOME",
+        os.path.expanduser("~/.cache/paddle_tpu/dataset"))
+
+
+def load_data(path):
+    """housing.data → normalised float32 [N, 14] (ref: load_data)."""
+    with open(path) as f:
+        tokens = f.read().split()     # records wrap across lines
+    data = np.asarray(tokens, dtype=np.float32).reshape(
+        -1, FEATURE_DIM + 1)
+    # min/max feature scaling over the features (not the price)
+    mins = data[:, :FEATURE_DIM].min(0)
+    maxs = data[:, :FEATURE_DIM].max(0)
+    span = np.where(maxs > mins, maxs - mins, 1.0)
+    data[:, :FEATURE_DIM] = (data[:, :FEATURE_DIM] - mins) / span
+    return data
+
+
+def _real_reader(path, split, n=None):
+    def reader():
+        data = load_data(path)
+        cut = int(len(data) * 0.8)
+        rows = data[:cut] if split == "train" else data[cut:]
+        count = len(rows) if n is None else min(n, len(rows))
+        for r in rows[:count]:
+            yield r[:FEATURE_DIM], r[FEATURE_DIM:FEATURE_DIM + 1]
+    return reader
+
+
+# -- synthetic fallback (no egress) -----------------------------------------
 
 _W = None
 
@@ -15,7 +60,7 @@ def _truth():
     return _W
 
 
-def _reader(n, seed):
+def _synth_reader(n, seed):
     def reader():
         rng = np.random.RandomState(seed)
         w = _truth()
@@ -26,9 +71,16 @@ def _reader(n, seed):
     return reader
 
 
+def _maybe_real(split, n, seed):
+    p = os.path.join(data_home(), "uci_housing", "housing.data")
+    if os.path.exists(p):
+        return _real_reader(p, split, n)
+    return _synth_reader(n, seed)
+
+
 def train(n=404):
-    return _reader(n, seed=3)
+    return _maybe_real("train", n, seed=3)
 
 
 def test(n=102):
-    return _reader(n, seed=4)
+    return _maybe_real("test", n, seed=4)

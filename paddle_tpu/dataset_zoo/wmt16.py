@@ -1,36 +1,94 @@
-"""Synthetic WMT16 translation pairs (ref: python/paddle/dataset/wmt16.py —
-train(src_dict_size, trg_dict_size) yields (src_ids, trg_ids, trg_next)).
+"""WMT16 translation pairs (ref: python/paddle/dataset/wmt16.py —
+train(src_dict_size, trg_dict_size) yields (src_ids, trg_in, trg_next)).
 
-Synthetic rule: the "translation" of source token t is (t + 7) mod vocab,
-reversed — a deterministic bijection a seq2seq model can actually learn,
-giving meaningful loss curves without corpora.  BOS=0, EOS=1, UNK=2 as in
-the reference."""
+REAL loader: parses tokenized parallel text + vocab files, the layout the
+reference extracts from its wmt16 tar (one sentence per line,
+space-separated tokens; vocab one token per line with <s>, <e>, <unk>
+reserved at the top — ref wmt16.py __load_dict / reader_creator).  Files
+live under ``$PADDLE_TPU_DATA_HOME/wmt16``: ``{train,test}.src``,
+``{train,test}.trg``, ``vocab.src``, ``vocab.trg``.  Without them
+(zero-egress environment) a deterministic synthetic bijection stands in
+(source token t ↦ (t+7) mod vocab, reversed) so seq2seq models have a
+learnable task.  BOS=0, EOS=1, UNK=2 as in the reference."""
+
+import os
 
 import numpy as np
 
 BOS, EOS, UNK = 0, 1, 2
 
 
+def data_home():
+    return os.environ.get(
+        "PADDLE_TPU_DATA_HOME",
+        os.path.expanduser("~/.cache/paddle_tpu/dataset"))
+
+
+def load_dict(path, dict_size):
+    """vocab file (one token per line, reserved ids first) → token→id
+    capped at dict_size (ref: wmt16.py __load_dict)."""
+    word2id = {}
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            if i >= dict_size:
+                break
+            word2id[line.rstrip("\n")] = i
+    return word2id
+
+
+def _ids(tokens, vocab):
+    return [vocab.get(t, UNK) for t in tokens]
+
+
+def _real_reader(src_path, trg_path, src_vocab, trg_vocab, n=None):
+    def reader():
+        count = 0
+        with open(src_path, encoding="utf-8") as fs, \
+                open(trg_path, encoding="utf-8") as ft:
+            for sline, tline in zip(fs, ft):
+                src = _ids(sline.split(), src_vocab)
+                trg = _ids(tline.split(), trg_vocab)
+                if not src or not trg:
+                    continue
+                yield src, [BOS] + trg, trg + [EOS]
+                count += 1
+                if n is not None and count >= n:
+                    return
+    return reader
+
+
+# -- synthetic fallback (no egress) -----------------------------------------
+
 def _translate(src, trg_vocab):
     return [(t + 7) % (trg_vocab - 3) + 3 for t in reversed(src)]
 
 
-def _reader(n, seed, src_vocab, trg_vocab):
+def _synth_reader(n, seed, src_vocab, trg_vocab):
     def reader():
         rng = np.random.RandomState(seed)
         for _ in range(n):
             length = int(rng.randint(3, 12))
             src = rng.randint(3, src_vocab, length).astype(int).tolist()
             trg = _translate(src, trg_vocab)
-            trg_in = [BOS] + trg
-            trg_next = trg + [EOS]
-            yield src, trg_in, trg_next
+            yield src, [BOS] + trg, trg + [EOS]
     return reader
 
 
+def _maybe_real(split, src_dict_size, trg_dict_size, n, seed):
+    d = os.path.join(data_home(), "wmt16")
+    paths = [os.path.join(d, f"{split}.src"),
+             os.path.join(d, f"{split}.trg"),
+             os.path.join(d, "vocab.src"), os.path.join(d, "vocab.trg")]
+    if all(os.path.exists(p) for p in paths):
+        sv = load_dict(paths[2], src_dict_size)
+        tv = load_dict(paths[3], trg_dict_size)
+        return _real_reader(paths[0], paths[1], sv, tv, n)
+    return _synth_reader(n, seed, src_dict_size, trg_dict_size)
+
+
 def train(src_dict_size=1000, trg_dict_size=1000, n=1024):
-    return _reader(n, 8, src_dict_size, trg_dict_size)
+    return _maybe_real("train", src_dict_size, trg_dict_size, n, seed=8)
 
 
 def test(src_dict_size=1000, trg_dict_size=1000, n=128):
-    return _reader(n, 9, src_dict_size, trg_dict_size)
+    return _maybe_real("test", src_dict_size, trg_dict_size, n, seed=9)

@@ -183,6 +183,10 @@ class Operator:
         self.inputs = {k: _to_name_list(v) for k, v in (inputs or {}).items()}
         self.outputs = {k: _to_name_list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
+        # user creation site, attached to runtime errors (ref:
+        # framework/op_call_stack.cc InsertCallStackInfo)
+        from .errors import capture_user_callstack
+        self.callstack = capture_user_callstack()
 
     def input(self, slot):
         return self.inputs.get(slot, [])

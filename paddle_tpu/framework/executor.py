@@ -106,12 +106,25 @@ def _scatter_outputs(op, outs, env):
 
 def run_ops(ops, env, ctx):
     """Interpret a straight-line op list symbolically (the trace loop — the
-    analog of the reference's hot loop at executor.cc:465, but traced once)."""
+    analog of the reference's hot loop at executor.cc:465, but traced once).
+
+    A failing op raises EnforceNotMet carrying the op type and the USER
+    call site that created it (ref: op_call_stack.cc — the reference
+    attaches the Python stack to op errors the same way)."""
+    from .errors import EnforceNotMet
     for op in ops:
         if op.type in ("feed", "fetch"):
             continue
-        impl = get_op(op.type)
-        outs = impl(ctx, _gather_inputs(op, env), op.attrs)
+        try:
+            impl = get_op(op.type)
+            outs = impl(ctx, _gather_inputs(op, env), op.attrs)
+        except EnforceNotMet:
+            raise
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            raise EnforceNotMet(op.type, e,
+                                getattr(op, "callstack", None)) from e
         _scatter_outputs(op, outs, env)
     return env
 

@@ -14,7 +14,7 @@ from __future__ import annotations
 import numpy as np
 
 from .. import layers
-from ..framework.layer_helper import ParamAttr
+from ..framework.layer_helper import LayerHelper, ParamAttr
 from ..framework.initializer import NormalInitializer
 from .bert import fused_attention
 
@@ -225,3 +225,85 @@ def greedy_decode(exe, program, logits_var, cfg, src_seqs, max_out=16,
                 continue
             o.append(int(lg[i, len(o)].argmax()))
     return outs
+
+
+class _PrefixDecodeCell(layers.RNNCell):
+    """Transformer decoder as an RNNCell for dynamic_decode: the state is
+    (token buffer [B', S], position [B', 1]); each step writes the new
+    token, re-runs the decoder over the prefix with the causal bias, and
+    emits the logits at the current position.  O(S^2) per step — the
+    KV-cache incremental decoder is the perf path; this is the
+    correctness/search path (ref: the reference decodes WMT with exactly
+    this re-scoring shape in its dynamic_decode examples,
+    layers/rnn.py:1230)."""
+
+    def __init__(self, cfg, enc_out_tiled, src_mask_tiled, is_test=True):
+        self.cfg = cfg
+        self.enc_out = enc_out_tiled            # [B*K, S, D]
+        self.src_mask = src_mask_tiled          # [B*K, S]
+        self.is_test = is_test
+
+    def call(self, token_ids, states):
+        cfg = self.cfg
+        S = cfg.max_length
+        buf, pos = states                        # [B', S] i64, [B', 1] i64
+        helper = LayerHelper("prefix_write")
+        new_buf = helper.create_variable_for_type_inference(
+            buf.dtype, buf.shape)
+        tok = layers.reshape(token_ids, [-1, 1])
+        helper.append_op(type="put_along_axis",
+                         inputs={"Input": [buf], "Index": [pos],
+                                 "Value": [tok]},
+                         outputs={"Result": [new_buf]},
+                         attrs={"Axis": 1, "Reduce": "assign"})
+        arange_row = layers.unsqueeze(
+            layers.assign_value(np.arange(S, dtype=np.int64), "int64"),
+            [0])                                 # [1, S]
+        positions = layers.elementwise_add(
+            layers.zeros_like(new_buf), arange_row)
+        valid = layers.cast(
+            layers.less_equal(positions, pos), "float32")  # [B', S]
+        self_bias = _attn_bias(valid, cfg.n_head, causal=True)
+        cross_bias = _attn_bias(self.src_mask, cfg.n_head, seq_q=S)
+        dec = decoder(_embed(new_buf, positions, cfg.trg_vocab_size, cfg,
+                             "trg", self.is_test),
+                      self.enc_out, self_bias, cross_bias, cfg,
+                      self.is_test)
+        logits = layers.fc(dec, cfg.trg_vocab_size, num_flatten_dims=2,
+                           param_attr=_attr("trg_proj_w"),
+                           bias_attr=ParamAttr(name="trg_proj_b"))
+        onehot = layers.reshape(
+            layers.one_hot(pos, S), [-1, S, 1])  # [B', S, 1]
+        step_logits = layers.reduce_sum(
+            layers.elementwise_mul(logits, onehot), dim=1)  # [B', V]
+        new_pos = layers.elementwise_add(
+            pos, layers.fill_constant([1], "int64", 1))
+        return step_logits, [new_buf, new_pos]
+
+
+def build_beam_decode_network(cfg: TransformerConfig, beam_size=4,
+                              max_out=16, bos=1, eos=2):
+    """Beam-search decode program over the trained transformer weights
+    (shared by name).  Feeds: src_ids/src_pos/src_mask; returns the
+    [B, T, beam] predicted ids variable (BASELINE config 4's decode
+    path, via BeamSearchDecoder + dynamic_decode)."""
+    S = cfg.max_length
+    src = layers.data("src_ids", shape=[S], dtype="int64")
+    src_pos = layers.data("src_pos", shape=[S], dtype="int64")
+    src_mask = layers.data("src_mask", shape=[S], dtype="float32")
+    enc_bias = _attn_bias(src_mask, cfg.n_head)
+    enc_out = encoder(_embed(src, src_pos, cfg.src_vocab_size, cfg,
+                             "src", True), enc_bias, cfg, True)
+
+    enc_tiled = layers.BeamSearchDecoder.tile_beam_merge_with_batch(
+        enc_out, beam_size)
+    mask_tiled = layers.BeamSearchDecoder.tile_beam_merge_with_batch(
+        src_mask, beam_size)
+    cell = _PrefixDecodeCell(cfg, enc_tiled, mask_tiled)
+    decoder_ = layers.BeamSearchDecoder(
+        cell, start_token=bos, end_token=eos, beam_size=beam_size)
+    buf0 = layers.fill_constant_batch_size_like(src, [-1, S], "int64", 0)
+    pos0 = layers.fill_constant_batch_size_like(src, [-1, 1], "int64", 0)
+    out_ids, _ = layers.dynamic_decode(decoder_, inits=[buf0, pos0],
+                                       max_step_num=max_out, is_test=True)
+    return ["src_ids", "src_pos", "src_mask"], out_ids

@@ -1,0 +1,123 @@
+"""Real-format dataset loader tests: write format-compliant fixtures
+(genuine IDX bytes, housing.data text, aclImdb layout, parallel corpus +
+vocab), point PADDLE_TPU_DATA_HOME at them, and verify the REAL parsers
+serve them — the loaders parse true MNIST/UCI/IMDB/WMT files when
+present (ref parsers: python/paddle/dataset/{mnist,uci_housing,imdb,
+wmt16}.py)."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def data_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    return tmp_path
+
+
+def test_mnist_idx_round_trip(data_home):
+    from paddle_tpu.dataset_zoo import mnist
+    d = data_home / "mnist"
+    d.mkdir()
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (20, 784)).astype(np.uint8)
+    labels = rng.randint(0, 10, 20).astype(np.uint8)
+    mnist.write_idx_images(str(d / mnist.TRAIN_IMAGES), imgs)
+    mnist.write_idx_labels(str(d / mnist.TRAIN_LABELS), labels)
+
+    got = list(mnist.train()())
+    assert len(got) == 20
+    for i, (img, lab) in enumerate(got):
+        assert lab == int(labels[i])
+        expect = (imgs[i].astype(np.float32) / 255.0) * 2.0 - 1.0
+        np.testing.assert_allclose(img, expect, rtol=1e-6)
+    # header validation is real
+    with gzip.open(d / mnist.TRAIN_LABELS, "wb") as f:
+        f.write(struct.pack(">II", 1234, 1))
+        f.write(b"\x00")
+    with pytest.raises(ValueError, match="magic"):
+        mnist.parse_idx_labels(str(d / mnist.TRAIN_LABELS))
+
+
+def test_uci_housing_real_format(data_home):
+    from paddle_tpu.dataset_zoo import uci_housing
+    d = data_home / "uci_housing"
+    d.mkdir()
+    rng = np.random.RandomState(1)
+    raw = rng.rand(10, 14) * 100
+    # the genuine file wraps records across lines; emulate that
+    flat = raw.ravel()
+    with open(d / "housing.data", "w") as f:
+        for i in range(0, len(flat), 8):
+            f.write(" ".join(f"{v:9.4f}" for v in flat[i:i + 8]) + "\n")
+
+    train = list(uci_housing.train()())
+    test = list(uci_housing.test()())
+    assert len(train) == 8 and len(test) == 2      # 80/20 split
+    x0, y0 = train[0]
+    assert x0.shape == (13,) and y0.shape == (1,)
+    # min/max normalised features ∈ [0, 1]; price untouched
+    allx = np.stack([x for x, _ in train + test])
+    assert allx.min() >= 0.0 and allx.max() <= 1.0
+    np.testing.assert_allclose(float(y0[0]), raw[0, 13], rtol=1e-4)
+
+
+def test_imdb_acl_layout(data_home):
+    from paddle_tpu.dataset_zoo import imdb
+    for split in ("train", "test"):
+        for lab in ("pos", "neg"):
+            (data_home / "aclImdb" / split / lab).mkdir(parents=True)
+    reviews = {
+        ("train", "pos", "0_10.txt"): "A great great movie, truly great!",
+        ("train", "pos", "1_9.txt"): "great fun and great acting.",
+        ("train", "neg", "0_1.txt"): "terrible terrible terrible film",
+        ("train", "neg", "1_2.txt"): "just terrible, avoid.",
+        ("test", "pos", "0_8.txt"): "great!",
+        ("test", "neg", "0_2.txt"): "terrible...",
+    }
+    for (split, lab, name), text in reviews.items():
+        (data_home / "aclImdb" / split / lab / name).write_text(text)
+
+    wd = imdb.build_dict(cutoff=2)
+    assert "great" in wd and "terrible" in wd and "<unk>" in wd
+    got = list(imdb.train(wd)())
+    assert len(got) == 4
+    labels = [lab for _, lab in got]
+    assert sorted(labels) == [0, 0, 1, 1]
+    ids, lab = got[0]
+    assert lab == 1                                # pos first (interleaved)
+    assert ids.count(wd["great"]) == 3             # tokenizer + vocab real
+
+
+def test_wmt16_parallel_corpus(data_home):
+    from paddle_tpu.dataset_zoo import wmt16
+    d = data_home / "wmt16"
+    d.mkdir()
+    (d / "vocab.src").write_text("<s>\n<e>\n<unk>\nhello\nworld\n")
+    (d / "vocab.trg").write_text("<s>\n<e>\n<unk>\nhallo\nwelt\n")
+    (d / "train.src").write_text("hello world\nworld unknowntoken\n")
+    (d / "train.trg").write_text("hallo welt\nwelt welt\n")
+
+    got = list(wmt16.train(src_dict_size=5, trg_dict_size=5)())
+    assert len(got) == 2
+    src, trg_in, trg_next = got[0]
+    assert src == [3, 4]
+    assert trg_in == [wmt16.BOS, 3, 4]
+    assert trg_next == [3, 4, wmt16.EOS]
+    # OOV maps to UNK
+    assert got[1][0] == [4, wmt16.UNK]
+
+
+def test_synthetic_fallback_without_files(data_home):
+    """No files under the (empty) data home → deterministic synthetic."""
+    from paddle_tpu.dataset_zoo import mnist, wmt16
+    a = list(mnist.train(n=4)())
+    b = list(mnist.train(n=4)())
+    for (xa, la), (xb, lb) in zip(a, b):
+        np.testing.assert_array_equal(xa, xb)
+        assert la == lb
+    assert len(list(wmt16.train(n=3)())) == 3

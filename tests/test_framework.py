@@ -90,3 +90,35 @@ def test_fetch_parameter_value():
     w, = exe.run(p, feed={"x": np.zeros((1, 4), np.float32)},
                  fetch_list=["fcw"])
     assert w.shape == (4, 2)
+
+
+def test_op_error_carries_user_callstack():
+    """A failing traced op must surface EnforceNotMet naming the op AND
+    the user line that created it (ref: platform/enforce.h +
+    framework/op_call_stack.cc) — not a bare jax traceback."""
+    from paddle_tpu.framework.errors import EnforceNotMet
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[4])
+        b = fluid.layers.data("b", shape=[5])
+        bad = fluid.layers.matmul(a, b)    # 4x5 inner-dim mismatch
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    import numpy as np
+    with pytest.raises(EnforceNotMet) as ei:
+        exe.run(main, feed={"a": np.zeros((2, 4), np.float32),
+                            "b": np.zeros((2, 5), np.float32)},
+                fetch_list=[bad])
+    msg = str(ei.value)
+    assert "[operator < matmul > error]" in msg
+    assert "test_framework.py" in msg      # the user creation site
+    assert "matmul(a, b)" in msg           # the offending source line
+
+
+def test_enforce_helper_and_error_taxonomy():
+    from paddle_tpu.framework import errors
+    with pytest.raises(errors.InvalidArgumentError):
+        errors.enforce(False, "bad arg")
+    errors.enforce(True, "fine")
+    assert errors.NotFoundError.code == "NOT_FOUND"
+    assert issubclass(errors.EnforceNotMet, errors.Error)
