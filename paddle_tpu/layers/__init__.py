@@ -14,7 +14,8 @@ from .rnn import (RNNCell, GRUCell, LSTMCell, rnn, birnn,  # noqa: F401
                   Decoder, BeamSearchDecoder, dynamic_decode,
                   DecodeHelper, TrainingHelper, GreedyEmbeddingHelper,
                   SampleEmbeddingHelper, BasicDecoder, gather_tree,
-                  reverse)
+                  reverse, gru_unit, dynamic_gru, lstm_unit,
+                  dynamic_lstm, dynamic_lstmp, lstm)
 from ..lr_scheduler import (noam_decay, exponential_decay,  # noqa: F401
                             natural_exp_decay, inverse_time_decay,
                             polynomial_decay, piecewise_decay, cosine_decay,

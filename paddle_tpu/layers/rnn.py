@@ -38,6 +38,8 @@ __all__ = [
     "DecodeHelper", "TrainingHelper", "GreedyEmbeddingHelper",
     "SampleEmbeddingHelper", "BasicDecoder",
     "gather_tree", "reverse",
+    "gru_unit", "dynamic_gru", "lstm_unit", "dynamic_lstm",
+    "dynamic_lstmp", "lstm",
 ]
 
 
@@ -177,8 +179,14 @@ class RNNCell:
 
         if _is_shape(shape):
             return make(shape)
-        return map_structure(lambda s: make(s),
-                             _ShapeTree(shape).tree)
+        # wrap each SHAPE (list of ints) as a leaf so map_structure does
+        # not recurse into it
+        def conv(s):
+            if _is_shape(s):
+                return _ShapeTree._Leaf(s)
+            return type(s)(conv(x) for x in s)
+
+        return map_structure(lambda leaf: make(leaf.s), conv(shape))
 
     @property
     def state_shape(self):
@@ -191,25 +199,12 @@ class RNNCell:
 
 
 class _ShapeTree:
-    """Wrap nested shapes so map_structure treats each SHAPE (a list of
-    ints) as a leaf rather than recursing into it."""
+    """Namespace for the shape-leaf wrapper: map_structure must treat
+    each SHAPE (a list of ints) as one leaf, not recurse into it."""
 
     class _Leaf:
         def __init__(self, s):
             self.s = s
-
-    def __init__(self, nested):
-        def conv(s):
-            if _is_shape(s):
-                return _ShapeTree._Leaf(s)
-            return type(s)(conv(x) for x in s)
-        wrapped = conv(nested)
-
-        def unwrap(s):
-            if isinstance(s, _ShapeTree._Leaf):
-                return s.s
-            return s
-        self.tree = map_structure(unwrap, wrapped)
 
 
 class GRUCell(RNNCell):
@@ -826,3 +821,293 @@ class BasicDecoder(Decoder):
 
     def finalize(self, outputs, final_states, sequence_lengths):
         raise NotImplementedError  # keep raw stacked outputs
+
+
+# ---------------------------------------------------------------------------
+# legacy fluid RNN API (ref: layers/rnn.py:1987 dynamic_lstm, :2160 lstm,
+# :2342 dynamic_lstmp, :2561 dynamic_gru, :2724 gru_unit, :3120 lstm_unit)
+#
+# LoD-free deviation: the reference consumes LoD sequence tensors
+# [sum(T_i), D]; here sequence inputs are PADDED [B, T, D] plus optional
+# lengths (the host-side ragged→dense contract used framework-wide).
+# ---------------------------------------------------------------------------
+
+def _act_fn(name):
+    """Activation lookup incl. 'identity' (valid in the reference API)."""
+    if name in ("identity", "linear", None):
+        return lambda v: v
+    return getattr(ops, name)
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """One GRU step on a pre-projected input [B, 3D] (ref: rnn.py:2724;
+    weight [D, 3D] = [W_uh | W_rh | W_ch], gate order u, r, c).
+    Returns (new_hidden, reset_hidden_prev, gate) with gate [B, 3D]
+    holding the ACTIVATED u, r, candidate (the reference Gate output)."""
+    D = size // 3
+    helper = LayerHelper("gru_unit")
+    w = helper.create_parameter(_named(param_attr, f"{helper.name}.w"),
+                                [D, 3 * D], input.dtype)
+    b = helper.create_parameter(
+        _named(bias_attr, f"{helper.name}.b"), [3 * D], input.dtype,
+        is_bias=True) if bias_attr is not False else None
+    act = _act_fn(activation)
+    gact = _act_fn(gate_activation)
+
+    hW = ops.matmul(hidden, tensor.slice(w, axes=[1], starts=[0],
+                                         ends=[2 * D]))
+    xg = tensor.slice(input, axes=[1], starts=[0], ends=[2 * D])
+    g = ops.elementwise_add(xg, hW)
+    if b is not None:
+        g = ops.elementwise_add(g, tensor.slice(b, axes=[0], starts=[0],
+                                                ends=[2 * D]))
+    g = gact(g)
+    u = tensor.slice(g, axes=[1], starts=[0], ends=[D])
+    r = tensor.slice(g, axes=[1], starts=[D], ends=[2 * D])
+    r_h = ops.elementwise_mul(r, hidden)
+    c = ops.elementwise_add(
+        tensor.slice(input, axes=[1], starts=[2 * D], ends=[3 * D]),
+        ops.matmul(r_h, tensor.slice(w, axes=[1], starts=[2 * D],
+                                     ends=[3 * D])))
+    if b is not None:
+        c = ops.elementwise_add(c, tensor.slice(b, axes=[0],
+                                                starts=[2 * D],
+                                                ends=[3 * D]))
+    c = act(c)
+    if origin_mode:
+        nh = ops.elementwise_add(
+            ops.elementwise_mul(u, hidden),
+            ops.elementwise_mul(ops.scale(u, -1.0, bias=1.0), c))
+    else:
+        nh = ops.elementwise_add(
+            ops.elementwise_mul(ops.scale(u, -1.0, bias=1.0), hidden),
+            ops.elementwise_mul(u, c))
+    gate = tensor.concat([g, c], axis=1)      # [B, 3D]: u, r, candidate
+    return nh, r_h, gate
+
+
+class _GruOpCell(RNNCell):
+    """dynamic_gru's per-step cell sharing gru_unit's params by name."""
+
+    def __init__(self, size, param_attr, bias_attr, activation,
+                 gate_activation, origin_mode, name):
+        self.size = size
+        self._args = (param_attr, bias_attr, activation, gate_activation,
+                      origin_mode)
+        self._name = name
+
+    def call(self, inputs, states):
+        pa, ba, act, gact, om = self._args
+        nh, _, _ = gru_unit(inputs, states, 3 * self.size,
+                            param_attr=_named(pa, f"{self._name}.w"),
+                            bias_attr=(ba if ba is False else
+                                       _named(ba, f"{self._name}.b")),
+                            activation=act, gate_activation=gact,
+                            origin_mode=om)
+        return nh, nh
+
+    @property
+    def state_shape(self):
+        return [self.size]
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False,
+                sequence_length=None, name=None):
+    """GRU over a padded pre-projected sequence [B, T, 3D]
+    (ref: rnn.py:2561 — the reference takes LoD [sum(T), 3D]).
+    Returns hidden states [B, T, D]."""
+    name = name or unique_name.generate("dynamic_gru")
+    cell = _GruOpCell(size, param_attr, bias_attr, candidate_activation,
+                      gate_activation, origin_mode, name)
+    init = h_0 if h_0 is not None else cell.get_initial_states(
+        input, shape=[size])
+    out, _ = rnn(cell, input, initial_states=init,
+                 sequence_length=sequence_length, is_reverse=is_reverse)
+    return out
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One LSTM step (ref: rnn.py:3120 — fc over [x, h] then the LSTM
+    calculus; gate column order i, f, o, candidate, matching
+    lstm_unit_op.h:63-66).  Returns (hidden, cell)."""
+    D = int(hidden_t_prev.shape[-1])
+    helper = LayerHelper(name or "lstm_unit")
+    w = helper.create_parameter(_named(param_attr, f"{helper.name}.w"),
+                                [int(x_t.shape[-1]) + D, 4 * D], x_t.dtype)
+    b = helper.create_parameter(_named(bias_attr, f"{helper.name}.b"),
+                                [4 * D], x_t.dtype, is_bias=True)
+    xh = tensor.concat([x_t, hidden_t_prev], axis=1)
+    g = ops.elementwise_add(ops.matmul(xh, w), b)
+    i, f, o, c = tensor.split(g, 4, dim=1)
+    new_c = ops.elementwise_add(
+        ops.elementwise_mul(
+            cell_t_prev,
+            ops.sigmoid(ops.scale(f, 1.0, bias=forget_bias))),
+        ops.elementwise_mul(ops.sigmoid(i), ops.tanh(c)))
+    new_h = ops.elementwise_mul(ops.tanh(new_c), ops.sigmoid(o))
+    return new_h, new_c
+
+
+class _LstmOpCell(RNNCell):
+    """dynamic_lstm's cell: pre-projected input [B, 4D] + recurrent
+    weight [D, 4D], optional peepholes, optional projection
+    (dynamic_lstmp)."""
+
+    def __init__(self, size, proj_size, param_attr, bias_attr,
+                 use_peepholes, gate_activation, cell_activation,
+                 candidate_activation, proj_activation, name):
+        self.size = size
+        self.proj_size = proj_size
+        self._pa, self._ba = param_attr, bias_attr
+        self._peep = use_peepholes
+        self._gact = _act_fn(gate_activation)
+        self._cact = _act_fn(cell_activation)
+        self._cand = _act_fn(candidate_activation)
+        self._pact = _act_fn(proj_activation)
+        self._name = name
+        self._built = False
+
+    def _build(self, dtype):
+        D, P = self.size, (self.proj_size or self.size)
+        helper = LayerHelper(self._name)
+        self._w = helper.create_parameter(
+            _named(self._pa, f"{self._name}.w"), [P, 4 * D], dtype)
+        nb = 7 * D if self._peep else 4 * D
+        self._b = helper.create_parameter(
+            _named(self._ba, f"{self._name}.b"), [nb], dtype, is_bias=True)
+        if self.proj_size:
+            self._w_proj = helper.create_parameter(
+                _named(self._pa, f"{self._name}.w_proj"),
+                [D, self.proj_size], dtype)
+        self._built = True
+
+    def call(self, inputs, states):
+        if not self._built:
+            self._build(inputs.dtype)
+        D = self.size
+        h, c = states
+        g = ops.elementwise_add(ops.matmul(h, self._w), inputs)
+        b4 = tensor.slice(self._b, axes=[0], starts=[0], ends=[4 * D])
+        g = ops.elementwise_add(g, b4)
+        # reference column order {W_cr, W_ir, W_fr, W_or}: c, i, f, o
+        gc, gi, gf, go = tensor.split(g, 4, dim=1)
+        if self._peep:
+            w_ic = tensor.slice(self._b, axes=[0], starts=[4 * D],
+                                ends=[5 * D])
+            w_fc = tensor.slice(self._b, axes=[0], starts=[5 * D],
+                                ends=[6 * D])
+            gi = ops.elementwise_add(gi, ops.elementwise_mul(c, w_ic))
+            gf = ops.elementwise_add(gf, ops.elementwise_mul(c, w_fc))
+        i = self._gact(gi)
+        f = self._gact(gf)
+        new_c = ops.elementwise_add(ops.elementwise_mul(f, c),
+                                    ops.elementwise_mul(i, self._cand(gc)))
+        if self._peep:
+            w_oc = tensor.slice(self._b, axes=[0], starts=[6 * D],
+                                ends=[7 * D])
+            go = ops.elementwise_add(go, ops.elementwise_mul(new_c, w_oc))
+        o = self._gact(go)
+        new_h = ops.elementwise_mul(o, self._cact(new_c))
+        if self.proj_size:
+            new_h = self._pact(ops.matmul(new_h, self._w_proj))
+        return new_h, [new_h, new_c]
+
+    @property
+    def state_shape(self):
+        return [[self.proj_size or self.size], [self.size]]
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None,
+                 sequence_length=None):
+    """LSTM over a padded pre-projected sequence [B, T, 4D]
+    (ref: rnn.py:1987; weight/bias column order c, i, f, o — the
+    reference's {W_cr, W_ir, W_fr, W_or} / {b_c, b_i, b_f, b_o} layout,
+    peephole weights appended when use_peepholes).  Returns
+    (hidden [B, T, D], final_cell [B, D])."""
+    D = size // 4
+    name = name or unique_name.generate("dynamic_lstm")
+    cell = _LstmOpCell(D, None, param_attr, bias_attr, use_peepholes,
+                       gate_activation, cell_activation,
+                       candidate_activation, "tanh", name)
+    init = [h_0, c_0] if h_0 is not None else cell.get_initial_states(
+        input, shape=[[D], [D]])
+    out, (fh, fc) = rnn(cell, input, initial_states=init,
+                        sequence_length=sequence_length,
+                        is_reverse=is_reverse)
+    return out, fc
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None, h_0=None, c_0=None,
+                  sequence_length=None):
+    """Projected LSTM (ref: rnn.py:2342 — LSTMP, recurrent projection to
+    proj_size)."""
+    D = size // 4
+    name = name or unique_name.generate("dynamic_lstmp")
+    cell = _LstmOpCell(D, proj_size, param_attr, bias_attr, use_peepholes,
+                       gate_activation, cell_activation,
+                       candidate_activation, proj_activation, name)
+    init = [h_0, c_0] if h_0 is not None else cell.get_initial_states(
+        input, shape=[[proj_size], [D]])
+    out, (fh, fc) = rnn(cell, input, initial_states=init,
+                        sequence_length=sequence_length,
+                        is_reverse=is_reverse)
+    return out, fc
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """Multi-layer (optionally bidirectional) LSTM over [B, T, D] — the
+    cudnn_lstm analog (ref: rnn.py:2160).  init_h/init_c:
+    [num_layers*dir, B, H] (or None for zeros).  Dropout applies only
+    BETWEEN layers (reference contract).  Returns (out, last_h, last_c)
+    with out [B, T, H*dir] and last_h/last_c [num_layers*dir, B, H]."""
+    ndir = 2 if is_bidirec else 1
+    base = name if name is not None else unique_name.generate("lstm")
+
+    def layer_init(layer, direction):
+        if init_h is None:
+            return None
+        idx = layer * ndir + direction
+        h = tensor.squeeze(tensor.slice(init_h, axes=[0], starts=[idx],
+                                        ends=[idx + 1]), [0])
+        c = tensor.squeeze(tensor.slice(init_c, axes=[0], starts=[idx],
+                                        ends=[idx + 1]), [0])
+        return [h, c]
+
+    x = input
+    last_hs, last_cs = [], []
+    for layer in range(num_layers):
+        nm = f"{base}_l{layer}"
+        if dropout_prob and not is_test and layer > 0:
+            x = nn.dropout(x, dropout_prob)     # between layers only
+        fw_cell = LSTMCell(hidden_size, forget_bias=0.0, name=f"{nm}_fw")
+        if is_bidirec:
+            bw_cell = LSTMCell(hidden_size, forget_bias=0.0,
+                               name=f"{nm}_bw")
+            out, (st_fw, st_bw) = birnn(
+                fw_cell, bw_cell, x,
+                initial_states_fw=layer_init(layer, 0),
+                initial_states_bw=layer_init(layer, 1))
+            last_hs.extend([st_fw[0], st_bw[0]])
+            last_cs.extend([st_fw[1], st_bw[1]])
+        else:
+            out, st = rnn(fw_cell, x, initial_states=layer_init(layer, 0))
+            last_hs.append(st[0])
+            last_cs.append(st[1])
+        x = out
+    last_h = tensor.stack(last_hs, axis=0)      # [L*dir, B, H]
+    last_c = tensor.stack(last_cs, axis=0)
+    return x, last_h, last_c

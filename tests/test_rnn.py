@@ -389,3 +389,89 @@ def test_sample_embedding_helper_decodes():
                    fetch_list=[outputs.sample_ids])
     assert ids.shape[:2] == (B, MAX_T)
     assert (ids >= 0).all() and (ids < V).all()
+
+
+def _np_gru_op_step(x3d, h, w, b, origin_mode=False):
+    """numpy gru_unit semantics: x3d [B, 3D] pre-projected, w [D, 3D]."""
+    D = h.shape[1]
+    sig = lambda a: 1 / (1 + np.exp(-a))
+    g = x3d[:, :2 * D] + h @ w[:, :2 * D] + b[:2 * D]
+    u, r = sig(g[:, :D]), sig(g[:, D:2 * D])
+    c = np.tanh(x3d[:, 2 * D:] + (r * h) @ w[:, 2 * D:] + b[2 * D:])
+    if origin_mode:
+        return u * h + (1 - u) * c
+    return (1 - u) * h + u * c
+
+
+def test_dynamic_gru_numeric():
+    """dynamic_gru over a padded sequence matches numpy per-step math
+    (ref: layers/rnn.py:2561; gate order u, r, c, weight [D, 3D])."""
+    B, T, D = 3, 4, 5
+    rng = np.random.RandomState(20)
+    xv = rng.randn(B, T, 3 * D).astype(np.float32)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, 3 * D])
+        out = fluid.layers.dynamic_gru(
+            x, D, param_attr=_const_attr(0.1), bias_attr=_const_attr(0.05))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+
+    w = np.full((D, 3 * D), 0.1, np.float32)
+    b = np.full((3 * D,), 0.05, np.float32)
+    h = np.zeros((B, D), np.float32)
+    expect = np.zeros((B, T, D), np.float32)
+    for t in range(T):
+        h = _np_gru_op_step(xv[:, t], h, w, b)
+        expect[:, t] = h
+    np.testing.assert_allclose(r, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_dynamic_lstm_numeric_no_peepholes():
+    """dynamic_lstm (i,f,c,o gate order, pre-projected input [B,T,4D])
+    matches numpy (ref: layers/rnn.py:1987)."""
+    B, T, D = 2, 3, 4
+    rng = np.random.RandomState(21)
+    xv = rng.randn(B, T, 4 * D).astype(np.float32)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, 4 * D])
+        out, last_c = fluid.layers.dynamic_lstm(
+            x, 4 * D, use_peepholes=False,
+            param_attr=_const_attr(0.07), bias_attr=_const_attr(0.0))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r, rc = exe.run(main, feed={"x": xv}, fetch_list=[out, last_c])
+
+    w = np.full((D, 4 * D), 0.07, np.float32)
+    sig = lambda a: 1 / (1 + np.exp(-a))
+    h = np.zeros((B, D), np.float32)
+    c = np.zeros((B, D), np.float32)
+    expect = np.zeros((B, T, D), np.float32)
+    for t in range(T):
+        g = h @ w + xv[:, t]
+        gc, gi, gf, go = np.split(g, 4, axis=1)   # ref order c, i, f, o
+        c = sig(gf) * c + sig(gi) * np.tanh(gc)
+        h = sig(go) * np.tanh(c)
+        expect[:, t] = h
+    np.testing.assert_allclose(r, expect, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(rc, c, rtol=1e-5, atol=1e-5)
+
+
+def test_multilayer_bidirectional_lstm_shapes():
+    B, T, D, H = 2, 5, 6, 8
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, D])
+        out, lh, lc = fluid.layers.lstm(x, None, None, T, H, num_layers=2,
+                                        is_bidirec=True, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.random.RandomState(22).randn(B, T, D).astype(np.float32)
+    r, rh, rc = exe.run(main, feed={"x": xv}, fetch_list=[out, lh, lc])
+    assert r.shape == (B, T, 2 * H)
+    assert rh.shape == (4, B, H) and rc.shape == (4, B, H)  # L*dir
+    assert np.isfinite(r).all()
