@@ -32,7 +32,36 @@ def bert_flops_per_step(cfg, batch, seq, num_masks):
     return 3 * fwd
 
 
+def tpu_alive(timeout=180):
+    """Probe TPU backend init in a SUBPROCESS with a hard timeout — a
+    hung tunnel (observed in rounds 2 and 3: jax.devices() blocks
+    forever) must produce a recorded infra error, not a silent driver
+    timeout with no artifact."""
+    import subprocess
+    probe = "import jax; assert jax.devices(); print('ok')"
+    try:
+        r = subprocess.run([sys.executable, "-c", probe],
+                           capture_output=True, text=True,
+                           timeout=timeout)
+        return r.returncode == 0 and "ok" in r.stdout, \
+            (r.stderr or r.stdout)[-500:]
+    except subprocess.TimeoutExpired:
+        return False, f"jax.devices() hung for {timeout}s (tunnel down)"
+
+
 def main():
+    alive, detail = tpu_alive()
+    if not alive:
+        # explicit infra marker beats an empty artifact (VERDICT r02 #2)
+        print(json.dumps({
+            "metric": "bert_base_pretrain_samples_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "samples/s",
+            "vs_baseline": 0.0,
+            "infra_error": f"TPU backend unreachable: {detail}",
+        }))
+        return
+
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models import bert
 

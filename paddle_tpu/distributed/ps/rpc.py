@@ -145,37 +145,97 @@ class RPCServer:
 
 
 class RPCClient:
-    """Per-endpoint connection with retry (ref: grpc_client.h retries and
-    deadlines via FLAGS_communicator_send_wait_times)."""
+    """Per-endpoint connection with connect retry, per-call DEADLINES,
+    and in-call reconnect retry (ref: grpc_client.h:247 — the reference
+    client arms a gRPC deadline per request from FLAGS_rpc_deadline and
+    retries FLAGS_rpc_retry_times before failing the trainer)."""
 
     def __init__(self, endpoint: str, retries: int = 50,
-                 retry_wait: float = 0.1):
-        import time
+                 retry_wait: float = 0.1, deadline: float = None):
         host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
         self.endpoint = endpoint
-        last = None
-        for _ in range(retries):
-            try:
-                self._conn = Client((host, int(port)), authkey=_authkey())
-                break
-            except (ConnectionRefusedError, OSError) as e:
-                last = e
-                time.sleep(retry_wait)
-        else:
-            raise ConnectionError(
-                f"cannot reach pserver {endpoint}: {last}")
+        self._connect_retries = retries
+        self._retry_wait = retry_wait
+        self._deadline = deadline
+        self._conn = self._connect()
         self._lock = threading.Lock()
 
-    def call(self, method: str, **payload) -> Any:
-        with self._lock:
-            self._conn.send((method, payload))
-            status, result = self._conn.recv()
-        if status != "ok":
-            raise RuntimeError(f"pserver {self.endpoint} {method}: {result}")
-        return result
+    def _connect(self):
+        import time
+        last = None
+        for _ in range(self._connect_retries):
+            try:
+                return Client(self._addr, authkey=_authkey())
+            except (ConnectionRefusedError, OSError) as e:
+                last = e
+                time.sleep(self._retry_wait)
+        raise ConnectionError(
+            f"cannot reach pserver {self.endpoint}: {last}")
+
+    def _teardown_locked(self):
+        """Drop the connection (caller holds self._lock) — a late or
+        half-delivered reply on a reused socket would desync every
+        subsequent call by one response."""
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def call(self, method: str, _timeout: float = None,
+             _idempotent: bool = False, **payload) -> Any:
+        """One request with a deadline (FLAGS_rpc_deadline unless
+        ``_timeout``).  On a dropped connection, IDEMPOTENT calls
+        (reads: pull_*, heartbeat, ...) reconnect and re-send up to
+        FLAGS_rpc_retry_times; non-idempotent calls (push_*) surface
+        UnavailableError instead — the server may already have applied
+        the request, and re-sending would double-apply it (the gRPC
+        reference retries reads the same way)."""
+        from ...flags import flag
+        from ...framework.errors import (ExecutionTimeoutError,
+                                         UnavailableError)
+        deadline = (_timeout if _timeout is not None
+                    else self._deadline
+                    if self._deadline is not None
+                    else float(flag("rpc_deadline")))
+        attempts = (max(1, int(flag("rpc_retry_times")))
+                    if _idempotent else 1)
+        last = None
+        for attempt in range(attempts):
+            try:
+                with self._lock:
+                    if self._conn is None:
+                        self._conn = self._connect()
+                    try:
+                        self._conn.send((method, payload))
+                        if not self._conn.poll(deadline):
+                            self._teardown_locked()
+                            raise ExecutionTimeoutError(
+                                f"pserver {self.endpoint} {method}: no "
+                                f"reply within {deadline}s "
+                                f"(FLAGS_rpc_deadline)")
+                        status, result = self._conn.recv()
+                    except (EOFError, BrokenPipeError,
+                            ConnectionResetError, OSError):
+                        self._teardown_locked()
+                        raise
+                if status != "ok":
+                    raise RuntimeError(
+                        f"pserver {self.endpoint} {method}: {result}")
+                return result
+            except ExecutionTimeoutError:
+                raise        # deadline exceeded is NOT retried (ref: gRPC)
+            except (EOFError, BrokenPipeError, ConnectionResetError,
+                    OSError) as e:
+                last = e
+        what = ("after {} attempts".format(attempts) if _idempotent
+                else f"(not retrying non-idempotent {method!r})")
+        raise UnavailableError(
+            f"pserver {self.endpoint} {method}: connection lost "
+            f"{what}: {last}")
 
     def close(self):
-        try:
-            self._conn.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._teardown_locked()

@@ -34,6 +34,11 @@ def _register(name: str, default, noop: bool = False):
 
 # live flags (consulted by the framework)
 _register("check_nan_inf", False)          # ref: platform/flags.cc:44
+# PS RPC call deadline in seconds (ref: grpc_client.h:247 deadlines via
+# FLAGS_rpc_deadline, default 180000ms) and in-call reconnect retries
+# (ref: FLAGS_rpc_retry_times)
+_register("rpc_deadline", 180.0)
+_register("rpc_retry_times", 3)
 # per-op localization: run ops eagerly and name the op that produced the
 # first NaN/Inf (ref: framework/details/nan_inf_utils.h pinpoints the op);
 # slower — debug only

@@ -140,7 +140,7 @@ def _ps_recv(ctx, ins, attrs):
                      opt_descs={n: opt_descs.get(n, {}) for n, _ in items})
             _initialized.add(ep)
         wait = _versions_get(ep) if mode == "sync" else -1
-        vals, version = cli.call("pull_dense",
+        vals, version = cli.call("pull_dense", _idempotent=True,
                                  names=[n for n, _ in items],
                                  wait_version=wait)
         _state().versions[ep] = version
@@ -186,7 +186,7 @@ def _distributed_lookup_table(ctx, ins, attrs):
     ids = np.asarray(x(ins, "Ids"))
     ep = attrs["endpoint"]
     table = attrs["table_name"]
-    rows = _client(ep).call("pull_sparse", name=table,
+    rows = _client(ep).call("pull_sparse", _idempotent=True, name=table,
                             ids=ids.reshape(-1))
     dim = rows.shape[-1]
     return {"Out": rows.reshape(ids.shape + (dim,))}
@@ -234,7 +234,7 @@ def _geo_sgd_sync(ctx, ins, attrs):
         cli = _client(ep)
         cli.call("push_dense", trainer_id=trainer_id,
                  grads={n: cur[n] - st["shadow"][n] for n in ns})
-        vals, _ = cli.call("pull_dense", names=ns, wait_version=-1)
+        vals, _ = cli.call("pull_dense", _idempotent=True, names=ns, wait_version=-1)
         out.update(vals)
     st["shadow"] = dict(out)
     return {"Out": [out[n] for n in names]}
@@ -268,11 +268,11 @@ class FleetWrapper:
             grads=np.asarray(grads, np.float32))
 
     def heartbeat(self, trainer_id: int = 0):
-        return _client(self.endpoint).call("heartbeat",
+        return _client(self.endpoint).call("heartbeat", _idempotent=True,
                                            trainer_id=trainer_id)
 
     def worker_status(self):
-        return _client(self.endpoint).call("worker_status")
+        return _client(self.endpoint).call("worker_status", _idempotent=True)
 
     def stop_server(self):
         try:

@@ -330,3 +330,44 @@ def test_sync_ps_rmsprop_and_transpile_validation():
     with pytest.raises(NotImplementedError, match="server-side"):
         t.transpile(0, program=main, pservers="127.0.0.1:1", trainers=1,
                     startup_program=startup)
+
+
+def test_rpc_deadline_and_reconnect_retry():
+    """Deadlines + in-call retry (ref: grpc_client.h:247): a hung handler
+    trips ExecutionTimeoutError at the deadline; a server that drops the
+    connection mid-call is retried via reconnect."""
+    import time
+
+    from paddle_tpu.distributed.ps.rpc import RPCClient, RPCServer
+    from paddle_tpu.framework.errors import ExecutionTimeoutError
+
+    srv = RPCServer("127.0.0.1:0")
+    srv.register("slow", lambda: time.sleep(5) or "late")
+    srv.register("fast", lambda: "ok")
+    srv.start_background()
+    ep = srv.endpoint
+
+    c = RPCClient(ep)
+    assert c.call("fast") == "ok"
+    t0 = time.time()
+    with pytest.raises(ExecutionTimeoutError, match="rpc_deadline"):
+        c.call("slow", _timeout=0.3)
+    assert time.time() - t0 < 3.0          # returned at the deadline
+    c.close()
+
+    # REAL reconnect-retry: kill the client's socket, then an idempotent
+    # call must transparently reconnect to the live server and succeed
+    c3 = RPCClient(ep)
+    assert c3.call("fast", _idempotent=True) == "ok"
+    c3._conn.close()                      # simulate a dropped connection
+    assert c3.call("fast", _idempotent=True) == "ok"   # reconnected
+
+    # non-idempotent calls do NOT auto-retry: surface UnavailableError
+    from paddle_tpu.framework.errors import UnavailableError
+    c3._conn.close()
+    with pytest.raises(UnavailableError, match="non-idempotent"):
+        c3.call("fast")                   # default _idempotent=False
+    # ...but the client recovers on the next call (fresh connection)
+    assert c3.call("fast", _idempotent=True) == "ok"
+    c3.close()
+    srv.close()
