@@ -37,11 +37,11 @@ def _authkey() -> bytes:
         try:
             with open(path, "rb") as f:
                 key = f.read()
-            if key:
+            if len(key) >= 32:
                 return key
-            # a concurrent creator's rename hasn't landed yet (should be
-            # impossible with the atomic rename below, but never hand out
-            # an empty key)
+            # short read: a no-hardlink-fallback creator is mid-write (its
+            # O_EXCL create landed but the 32 bytes haven't) — wait for the
+            # full key rather than handing out a prefix
             time.sleep(0.02)
             continue
         except FileNotFoundError:
@@ -60,9 +60,27 @@ def _authkey() -> bytes:
             os.link(tmp, path)
         except FileExistsError:
             pass
+        except OSError:
+            # filesystem without hard links (overlay/network mounts).
+            # O_EXCL on the FINAL path preserves first-creator-wins (a
+            # rename would clobber a key another process already serves
+            # with); readers tolerate the non-atomic write because they
+            # require the full 32 bytes before accepting a key.
+            try:
+                fd2 = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL,
+                              0o600)
+                with os.fdopen(fd2, "wb") as f2, open(tmp, "rb") as src:
+                    f2.write(src.read())
+                    f2.flush()
+                    os.fsync(f2.fileno())
+            except FileExistsError:
+                pass
         finally:
             os.unlink(tmp)
-    raise RuntimeError(f"could not obtain PS authkey from {path}")
+    raise RuntimeError(
+        f"could not obtain PS authkey from {path} within 1s — if the file "
+        f"is shorter than 32 bytes, a previous creator died mid-write; "
+        f"delete it and retry")
 
 
 class RPCServer:
@@ -163,15 +181,52 @@ class RPCClient:
 
     def _connect(self):
         import time
+        from multiprocessing import AuthenticationError
         last = None
         for _ in range(self._connect_retries):
             try:
-                return Client(self._addr, authkey=_authkey())
+                conn = Client(self._addr, authkey=_authkey())
+                self._arm_send_deadline(conn)
+                return conn
+            except AuthenticationError as e:
+                # transient during concurrent key creation; persistent
+                # mismatch surfaces with a pointed message below
+                last = e
+                time.sleep(self._retry_wait)
             except (ConnectionRefusedError, OSError) as e:
                 last = e
                 time.sleep(self._retry_wait)
+        hint = ""
+        from multiprocessing import AuthenticationError as AErr
+        if isinstance(last, AErr):
+            hint = (" (authkey mismatch — ensure all processes share "
+                    "PADDLE_TPU_PS_AUTHKEY or the same authkey file)")
         raise ConnectionError(
-            f"cannot reach pserver {self.endpoint}: {last}")
+            f"cannot reach pserver {self.endpoint}{hint}: {last}")
+
+    def _arm_send_deadline(self, conn):
+        """SO_SNDTIMEO on the underlying socket: the per-call deadline
+        (poll) only covers WAITING for the reply — a push to a stalled
+        server whose TCP window is full would block inside send() forever
+        otherwise.  A timed-out send raises OSError and is handled by the
+        normal teardown/retry path."""
+        import os
+        import socket
+        import struct
+        from ...flags import flag
+        t = float(self._deadline if self._deadline is not None
+                  else flag("rpc_deadline"))
+        try:
+            # dup shares the socket description, so the option sticks to
+            # conn's socket; closing the dup fd releases only our handle
+            s = socket.socket(fileno=os.dup(conn.fileno()))
+            try:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                             struct.pack("ll", int(t), int((t % 1) * 1e6)))
+            finally:
+                s.close()
+        except OSError:
+            pass  # non-socket transports (tests with pipes) have no fd opts
 
     def _teardown_locked(self):
         """Drop the connection (caller holds self._lock) — a late or

@@ -152,22 +152,31 @@ class CompiledProgram:
         bw.attrs["_allreduce_inserted"] = True
         scale_strategy = strategy.gradient_scale_strategy
         insert_at = bw_idx + 1
+        all_axes = axis_name if isinstance(axis_name, (tuple, list)) else \
+            (axis_name or self._batch_axis or "dp",)
         for pname in bw.attrs["param_names"]:
             pvar = block._find_var_recursive(pname)
             if pvar is not None and getattr(pvar, "is_distributed", False):
                 continue  # ref: collective.py:226 skips distributed params
+            # a param sharded over a reduce axis (e.g. MoE experts over the
+            # batch axis) already receives its full gradient through the
+            # transposed collective — reduce only over the OTHER axes, but
+            # keep the mean-loss 1/n scale, which is per-token not per-axis
+            da = tuple(getattr(pvar, "dist_attr", None) or ())
+            p_axes = tuple(a for a in all_axes if a not in da)
             g = grad_var_name(pname)
             if scale_strategy == BuildStrategy.GradientScaleStrategy.CoeffNumDevice:
                 block._insert_op(insert_at, type="scale",
                                  inputs={"X": [g]}, outputs={"Out": [g]},
                                  attrs={"scale": 1.0 / nranks})
                 insert_at += 1
-            block._insert_op(insert_at, type="c_allreduce_sum",
-                             inputs={"X": [g]}, outputs={"Out": [g]},
-                             attrs={"ring_id": 0,
-                                    "_axis_name": axis_name or
-                                    self._batch_axis or "dp"})
-            insert_at += 1
+            if p_axes:
+                block._insert_op(insert_at, type="c_allreduce_sum",
+                                 inputs={"X": [g]}, outputs={"Out": [g]},
+                                 attrs={"ring_id": 0,
+                                        "_axis_name": tuple(p_axes)
+                                        if len(p_axes) > 1 else p_axes[0]})
+                insert_at += 1
 
     # pass-through conveniences so CompiledProgram quacks like Program
     def __getattr__(self, item):

@@ -14,6 +14,7 @@ from __future__ import annotations
 import numpy as np
 
 from .. import layers
+from ..framework.core import default_main_program
 from ..framework.layer_helper import LayerHelper, ParamAttr
 from ..framework.initializer import NormalInitializer
 from .bert import fused_attention
@@ -22,7 +23,9 @@ from .bert import fused_attention
 class TransformerConfig:
     def __init__(self, src_vocab_size=1000, trg_vocab_size=1000,
                  max_length=64, d_model=64, d_inner=256, n_head=4,
-                 n_layer=2, dropout=0.1):
+                 n_layer=2, dropout=0.1, moe_experts=0, moe_top_k=2,
+                 moe_capacity_factor=1.25, moe_ep_degree=None,
+                 moe_aux_weight=0.01):
         self.src_vocab_size = src_vocab_size
         self.trg_vocab_size = trg_vocab_size
         self.max_length = max_length
@@ -31,6 +34,14 @@ class TransformerConfig:
         self.n_head = n_head
         self.n_layer = n_layer
         self.dropout = dropout
+        # moe_experts > 0 replaces every FFN with a top-k routed MoE block
+        # (GShard layout, parallel/moe.py); aux losses accumulate into the
+        # training loss with moe_aux_weight
+        self.moe_experts = moe_experts
+        self.moe_top_k = moe_top_k
+        self.moe_capacity_factor = moe_capacity_factor
+        self.moe_ep_degree = moe_ep_degree
+        self.moe_aux_weight = moe_aux_weight
 
     @staticmethod
     def big():
@@ -75,6 +86,21 @@ def _embed(ids, pos_ids, vocab, cfg, name, is_test):
 
 
 def _ffn(x, cfg, name, is_test):
+    if getattr(cfg, "moe_experts", 0):
+        from ..parallel import moe_ffn
+        out, aux = moe_ffn(
+            x, num_experts=cfg.moe_experts, ffn_hidden=cfg.d_inner,
+            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+            ep_degree=cfg.moe_ep_degree, act="relu",
+            param_attr=_attr(f"{name}_moe_w"), name=f"{name}_moe")
+        # aux is recorded on the program by moe_ffn; loss builders drain
+        # it via parallel.collect_aux_losses
+        # the dense path regularises between its two projections; the
+        # routed block applies the same rate on its output instead (the
+        # expert matmuls are batched, an inner mask would break routing)
+        if cfg.dropout:
+            out = layers.dropout(out, cfg.dropout, is_test=is_test)
+        return out
     h = layers.fc(x, cfg.d_inner, act="relu", num_flatten_dims=2,
                   param_attr=_attr(f"{name}_fc0_w"),
                   bias_attr=ParamAttr(name=f"{name}_fc0_b"))
@@ -182,6 +208,13 @@ def build_train_network(cfg: TransformerConfig, is_test=False):
     ce = layers.softmax_with_cross_entropy(flat_logits, flat_labels)
     w = layers.reshape(trg_mask, [-1, 1])
     loss = layers.reduce_sum(ce * w) / (layers.reduce_sum(w) + 1e-9)
+    from ..parallel import collect_aux_losses
+    aux_terms = collect_aux_losses(default_main_program())
+    if aux_terms:
+        # MoE load-balance terms from every routed FFN in this build
+        aux = layers.sum(aux_terms) if len(aux_terms) > 1 else aux_terms[0]
+        loss = layers.elementwise_add(
+            loss, layers.scale(aux, scale=cfg.moe_aux_weight))
     feeds = ["src_ids", "src_pos", "src_mask", "trg_ids", "trg_pos",
              "trg_mask", "labels"]
     return feeds, loss, logits

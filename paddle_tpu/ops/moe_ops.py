@@ -1,0 +1,182 @@
+"""Mixture-of-Experts ops — expert-parallel FFN (SURVEY §2.3 "Expert
+parallel / MoE"; the reference has no MoE — this supersedes it with the
+GShard/Switch formulation, which is the TPU-native design: routing is
+expressed as dense one-hot einsums that land on the MXU, and expert
+exchange is a single ``lax.all_to_all`` over the expert mesh axis).
+
+Layout contract (enforced by parallel/moe.py):
+
+- gate weight ``[M, E]`` is replicated;
+- expert weights ``[E, M, H]`` / ``[E, H, M]`` carry
+  ``dist_attr = (ep_axis, None, None)`` so shard_map hands each device its
+  ``E/ep`` local experts;
+- the expert axis is the BATCH axis (every device contributes tokens and
+  owns experts — the GShard layout), so expert-weight grads arrive fully
+  summed through the transposed all_to_all and must NOT be allreduced
+  again (compiler._insert_grad_allreduce skips axes present in a param's
+  dist_attr, but still applies the 1/n mean-loss scale).
+
+Tokens are routed within fixed-size GROUPS (the GShard G dim): the
+dispatch/combine one-hots are ``[G, S_g, E, C]`` with capacity
+``C ∝ S_g/E``, so routing memory is linear in token count
+(``N·cf·k·S_g``) instead of the quadratic ``N·cf·k·N`` a flat layout
+would cost.  Routing math per group (top-k with capacity, GShard paper
+§3.2 semantics, re-derived — no reference analog):
+
+    gates   = softmax(x @ Wg)                         [G, S, E]
+    k picks = iterated argmax with chosen column masked out
+    pos     = running per-(group, expert) cumsum → slot within capacity
+    disp    = Σ_k  keep_k ⊗ one_hot(pos_k, C)         [G, S, E, C]
+    combine = Σ_k  gate_k · that                      [G, S, E, C]
+    xe      = einsum('gsec,gsm->egcm', disp, x)  (dispatch — MXU)
+    ye      = W2·act(W1·xe)  per expert          (batched matmul — MXU)
+    out     = einsum('gsec,egcm->gsm', combine, ye)   (combine — MXU)
+
+Tokens overflowing an expert's per-group capacity are dropped (their
+combine weight is zero → they pass through the residual connection of
+the surrounding block, Switch-Transformer semantics).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+
+_ACTS = {
+    "gelu": jax.nn.gelu,
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+    "tanh": jnp.tanh,
+    None: lambda a: a,
+}
+
+
+def _group_size(n: int, target: int = 256) -> int:
+    """Largest divisor of n that is ≤ target (GShard group dim).  Keeps
+    the [G, S_g, E, C] routing tensors ~n·cf·k·S_g elements."""
+    for d in range(min(n, target), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def _route(gates, top_k, capacity):
+    """Top-k routing with per-(group, expert) capacity.
+
+    gates [G, S, E] f32 → (dispatch [G, S, E, C], combine [G, S, E, C],
+    me [E], ce [E]) where me/ce feed the load-balance aux loss."""
+    g, s, e = gates.shape
+    remaining = gates
+    masks, gvals = [], []
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)                # [G, S]
+        m = jax.nn.one_hot(idx, e, dtype=gates.dtype)        # [G, S, E]
+        gvals.append(jnp.sum(remaining * m, axis=-1))        # [G, S]
+        remaining = remaining * (1.0 - m)
+        masks.append(m)
+
+    # slot position of each token within its (group, expert): running
+    # cumsum over the group's tokens, earlier-k choices take priority
+    # (GShard §3.2)
+    dispatch = jnp.zeros((g, s, e, capacity), gates.dtype)
+    combine = jnp.zeros((g, s, e, capacity), gates.dtype)
+    offset = jnp.zeros((g, 1, e), gates.dtype)
+    for m, gv in zip(masks, gvals):
+        pos = jnp.cumsum(m, axis=1) - m + offset             # [G, S, E]
+        offset = offset + jnp.sum(m, axis=1, keepdims=True)
+        keep = m * (pos < capacity)                          # [G, S, E]
+        slot = jax.nn.one_hot(
+            jnp.sum(pos * m, axis=-1).astype(jnp.int32), capacity,
+            dtype=gates.dtype)                               # [G, S, C]
+        hot = keep[..., None] * slot[:, :, None, :]          # [G, S, E, C]
+        dispatch = dispatch + lax.stop_gradient(hot)
+        combine = combine + gv[..., None, None] * lax.stop_gradient(hot)
+
+    me = jnp.mean(gates, axis=(0, 1))                        # softmax mass
+    ce = jnp.mean(masks[0], axis=(0, 1))                     # top-1 traffic
+    return dispatch, combine, me, ce
+
+
+def moe_ffn_fn(xf, gate_w, w1, w2, b1=None, b2=None, *, top_k=2,
+               capacity_factor=1.25, act="gelu", ep_axis=None, ep_size=1,
+               group_size=0):
+    """Functional MoE FFN on flattened tokens xf [N, M].
+
+    w1/w2 hold the LOCAL expert shard [E_local, ...]; global expert count
+    is E_local * ep_size.  Returns (out [N, M], aux_loss scalar)."""
+    n, m = xf.shape
+    e_local = w1.shape[0]
+    e = e_local * ep_size
+    sg = int(group_size) or _group_size(n)
+    if n % sg:
+        raise ValueError(f"group_size {sg} does not divide token count {n}")
+    g = n // sg
+    capacity = max(1, int(math.ceil(capacity_factor * top_k * sg / e)))
+
+    xg = xf.reshape(g, sg, m)
+    gates = jax.nn.softmax(
+        jnp.einsum("gsm,me->gse", xg.astype(jnp.float32),
+                   gate_w.astype(jnp.float32)), axis=-1)
+    dispatch, combine, me, ce = _route(gates, top_k, capacity)
+    aux = e * jnp.sum(me * ce)
+
+    xe = jnp.einsum("gsec,gsm->egcm", dispatch.astype(xf.dtype), xg)
+    if ep_axis is not None:
+        # route each expert block to its owner; received leading dim
+        # indexes the SOURCE shard
+        xe = xe.reshape(ep_size, e_local, g, capacity, m)
+        xe = lax.all_to_all(xe, ep_axis, split_axis=0, concat_axis=0,
+                            tiled=False)
+        xe = xe.transpose(1, 0, 2, 3, 4)          # [E_local, ep, G, C, M]
+        xe = xe.reshape(e_local, ep_size * g * capacity, m)
+    else:
+        xe = xe.reshape(e, g * capacity, m)
+    h = jnp.einsum("esm,emh->esh", xe, w1)
+    if b1 is not None:
+        h = h + b1[:, None, :]
+    h = _ACTS[act](h)
+    ye = jnp.einsum("esh,ehm->esm", h, w2)
+    if b2 is not None:
+        ye = ye + b2[:, None, :]
+    if ep_axis is not None:
+        # per-source blocks back out front, exchange, leading dim becomes
+        # the expert-OWNER shard → global expert order
+        ye = ye.reshape(e_local, ep_size, g, capacity, m)
+        ye = ye.transpose(1, 0, 2, 3, 4)
+        ye = lax.all_to_all(ye, ep_axis, split_axis=0, concat_axis=0,
+                            tiled=False)
+        ye = ye.reshape(e, g, capacity, m)
+    else:
+        ye = ye.reshape(e, g, capacity, m)
+    out = jnp.einsum("gsec,egcm->gsm", combine.astype(ye.dtype), ye)
+    return out.reshape(n, m).astype(xf.dtype), aux.astype(jnp.float32)
+
+
+@register("moe_ffn")
+def _moe_ffn(ctx, ins, attrs):
+    a = x(ins, "X")
+    gate_w = x(ins, "GateW")
+    w1, w2 = x(ins, "W1"), x(ins, "W2")
+    b1, b2 = x(ins, "B1"), x(ins, "B2")
+    ep_axis = attrs.get("_axis_name")
+    ep_size = 1
+    if ep_axis and ctx.mesh is not None and ep_axis in ctx.axis_names:
+        ep_size = dict(zip(ctx.mesh.axis_names,
+                           ctx.mesh.devices.shape))[ep_axis]
+    else:
+        ep_axis = None
+    shape = a.shape
+    xf = a.reshape(-1, shape[-1])
+    out, aux = moe_ffn_fn(
+        xf, gate_w, w1, w2, b1, b2,
+        top_k=int(attrs.get("top_k", 2)),
+        capacity_factor=float(attrs.get("capacity_factor", 1.25)),
+        act=attrs.get("act", "gelu"),
+        ep_axis=ep_axis, ep_size=ep_size,
+        group_size=int(attrs.get("group_size", 0)))
+    return {"Out": out.reshape(shape), "AuxLoss": aux}

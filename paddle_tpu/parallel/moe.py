@@ -1,0 +1,105 @@
+"""Mixture-of-Experts layer API — expert parallelism over a mesh axis.
+
+The reference framework has no MoE (SURVEY §2.3 lists expert parallelism
+as the one strategy it lacks); this is a new TPU-native capability built
+on the GShard layout: experts are sharded over the same mesh axis that
+shards the batch (every device contributes tokens AND owns E/ep experts),
+token exchange is one ``lax.all_to_all`` each way riding ICI, and all
+routing math is dense einsums on the MXU (ops/moe_ops.py).
+
+Usage::
+
+    out, aux = parallel.moe_ffn(x, num_experts=8, ffn_hidden=256,
+                                ep_degree=4, axis_name="dp")
+    loss = task_loss + 0.01 * aux
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..framework.layer_helper import LayerHelper
+from ..framework.core import Variable
+
+
+def moe_ffn(x: Variable, num_experts: int, ffn_hidden: int,
+            top_k: int = 2, capacity_factor: float = 1.25,
+            ep_degree: Optional[int] = None, axis_name: str = "dp",
+            act: str = "gelu", group_size: int = 0, param_attr=None,
+            bias_attr=None,
+            name: Optional[str] = None) -> Tuple[Variable, Variable]:
+    """MoE feed-forward block: route each token to its top-k of
+    ``num_experts`` expert FFNs (M → ffn_hidden → M).
+
+    With ``ep_degree`` > 1 the expert dim of both weights is sharded over
+    ``axis_name`` (dist_attr consumed by the executor's shard_map) and the
+    op all_to_alls token blocks to their owners.  Returns
+    ``(out, aux_loss)`` — add ``aux_weight * aux_loss`` to the training
+    loss (Switch-Transformer load-balance term)."""
+    ep = int(ep_degree or 1)
+    if num_experts % ep:
+        raise ValueError(
+            f"num_experts {num_experts} not divisible by ep degree {ep}")
+    helper = LayerHelper(name or "moe_ffn", name=name)
+    m = int(x.shape[-1])
+
+    def _sub(attr, suffix):
+        """One shared param_attr names three params — suffix each."""
+        from ..framework.layer_helper import ParamAttr
+        a = ParamAttr._to_attr(attr)
+        if a and getattr(a, "name", None):
+            import copy
+            a = copy.copy(a)
+            a.name = f"{a.name}_{suffix}"
+        return a
+
+    gate_w = helper.create_parameter(_sub(param_attr, "gate"),
+                                     [m, num_experts], x.dtype)
+    w1 = helper.create_parameter(_sub(param_attr, "w1"),
+                                 [num_experts, m, ffn_hidden], x.dtype)
+    w2 = helper.create_parameter(_sub(param_attr, "w2"),
+                                 [num_experts, ffn_hidden, m], x.dtype)
+    if ep > 1:
+        # expert dim sharded; grads arrive pre-summed through the
+        # transposed all_to_all (compiler skips the allreduce over this
+        # axis but keeps the 1/n mean-loss scale)
+        w1.dist_attr = (axis_name, None, None)
+        w2.dist_attr = (axis_name, None, None)
+    inputs = {"X": [x], "GateW": [gate_w], "W1": [w1], "W2": [w2]}
+    if bias_attr is not False:
+        b1 = helper.create_parameter(_sub(bias_attr, "b1"),
+                                     [num_experts, ffn_hidden], x.dtype,
+                                     is_bias=True)
+        b2 = helper.create_parameter(_sub(bias_attr, "b2"),
+                                     [num_experts, m], x.dtype, is_bias=True)
+        if ep > 1:
+            b1.dist_attr = (axis_name, None)
+            b2.dist_attr = (axis_name, None)
+        inputs["B1"], inputs["B2"] = [b1], [b2]
+
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    aux = helper.create_variable_for_type_inference("float32", ())
+    helper.append_op(
+        type="moe_ffn", inputs=inputs,
+        outputs={"Out": [out], "AuxLoss": [aux]},
+        attrs={"top_k": top_k, "capacity_factor": capacity_factor,
+               "act": act, "group_size": group_size,
+               "_axis_name": axis_name if ep > 1 else None})
+    # record on the program being built (same lifetime as the graph) so
+    # model builders can fold every routed block's balance term into the
+    # loss without threading lists through their call stacks
+    collect_aux_losses(helper.main_program, peek=True).append(aux)
+    return out, aux
+
+
+def collect_aux_losses(program, peek: bool = False):
+    """All MoE aux-loss Variables recorded while building ``program``.
+
+    By default DRAINS the list (a loss builder consumes the terms once);
+    ``peek=True`` returns the live list without clearing."""
+    lst = program.__dict__.setdefault("_moe_aux_losses", [])
+    if peek:
+        return lst
+    out = list(lst)
+    lst.clear()
+    return out
