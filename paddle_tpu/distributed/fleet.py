@@ -204,6 +204,22 @@ class _Fleet:
     def mesh(self):
         return self._mesh
 
+    # -- host barriers (ref: fleet barrier_worker via GlooWrapper) -------
+    @property
+    def _gloo(self):
+        if not hasattr(self, "_gloo_ctx"):
+            from .gloo import init_from_env
+            self._gloo_ctx = init_from_env()
+        return self._gloo_ctx
+
+    def barrier_worker(self):
+        """Block until every trainer reaches this point (ref:
+        fleet_base.py barrier_worker → GlooWrapper::Barrier).  No-op for
+        single-process jobs (no PADDLE_GLOO_ENDPOINT)."""
+        g = self._gloo
+        if g is not None:
+            g.barrier()
+
     # -- programs --------------------------------------------------------
     @property
     def main_program(self):
@@ -251,11 +267,33 @@ class CollectiveOptimizer:
         self._inner = optimizer
         self._strategy = strategy or DistributedStrategy()
 
+    @staticmethod
+    def _validate(s):
+        """Reject strategy combinations with contradictory step semantics
+        (the reference's StrategyCompiler drops invalid meta-optimizers
+        silently, ref: fleet/base/strategy_compiler.py; here an explicit
+        error beats a silently changed recipe)."""
+        if s.localsgd and s.gradient_merge:
+            raise ValueError(
+                "DistributedStrategy: localsgd and gradient_merge both "
+                "rewrite the update cadence (periodic param averaging vs "
+                "k-step grad accumulation) and cannot compose — pick one")
+        if s.localsgd and s.use_dgc:
+            raise ValueError(
+                "DistributedStrategy: localsgd removes the per-step grad "
+                "allreduce that DGC compresses — the combination is "
+                "contradictory")
+        if s.lamb and s.use_dgc:
+            raise ValueError(
+                "DistributedStrategy: lamb and use_dgc both replace the "
+                "base optimizer (LambOptimizer vs DGCMomentumOptimizer)")
+
     def _compose(self, optimizer):
         """Apply meta-optimizers in the reference's order: LAMB swap, AMP,
         recompute, gradient merge (strategy_compiler.py ordering)."""
         from .. import optimizer as opt_mod
         s = self._strategy
+        self._validate(s)
         # DGC swap happens on the raw inner optimizer, before any wrapper
         # hides its type (ref: incubate/fleet/collective/__init__.py:478)
         if s.use_dgc and isinstance(optimizer, opt_mod.MomentumOptimizer):

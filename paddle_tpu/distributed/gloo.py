@@ -1,0 +1,150 @@
+"""Host-side collective/barrier service — the GlooWrapper analog
+(ref: framework/fleet/gloo_wrapper.h GlooWrapper: Barrier/AllReduce/
+AllGather over a rendezvous; used by role makers to sync trainers and
+pservers before/after training).
+
+TPU device collectives ride XLA/ICI and never touch this path; this is
+for HOST coordination: barriers between processes, small numpy
+reductions (metrics, vocab sizes, shard manifests) over DCN.  The
+transport is the PS tier's authenticated RPC (ps/rpc.py) in a star
+topology: rank 0 hosts a hub; every rank (including 0) connects as a
+client.  A collective call blocks its hub handler thread until all
+``world_size`` contributions for that sequence number arrive — the same
+rendezvous semantics gloo's context gives the reference.
+
+SPMD contract: all ranks must issue the same collectives in the same
+order (their per-rank sequence counters align), exactly like gloo."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .ps.rpc import RPCClient, RPCServer
+
+
+def _combine(op: str, vals: Dict[int, Any], root: int):
+    ordered = [vals[r] for r in sorted(vals)]
+    if op == "barrier":
+        return None
+    if op == "all_gather":
+        return ordered
+    if op == "broadcast":
+        return vals[root]
+    arrs = [np.asarray(v) for v in ordered]
+    if op == "sum":
+        return sum(arrs[1:], arrs[0].copy())
+    if op == "max":
+        return np.maximum.reduce(arrs)
+    if op == "min":
+        return np.minimum.reduce(arrs)
+    if op == "prod":
+        out = arrs[0].copy()
+        for a in arrs[1:]:
+            out = out * a
+        return out
+    raise ValueError(f"unknown gloo op {op!r}")
+
+
+class _Hub:
+    """Rendezvous state machine behind the RPC server (rank 0 only)."""
+
+    def __init__(self, world_size: int):
+        self._world = world_size
+        self._cond = threading.Condition()
+        self._pending: Dict[int, dict] = {}
+
+    def collective(self, seq: int, rank: int, op: str, value=None,
+                   root: int = 0, timeout: float = 600.0):
+        with self._cond:
+            e = self._pending.setdefault(
+                seq, {"vals": {}, "done": False, "served": 0})
+            if rank in e["vals"]:
+                raise RuntimeError(
+                    f"gloo: duplicate contribution from rank {rank} for "
+                    f"collective #{seq} — desynchronised call order")
+            e["vals"][rank] = value
+            if len(e["vals"]) == self._world:
+                e["result"] = _combine(op, e["vals"], root)
+                e["done"] = True
+                self._cond.notify_all()
+            else:
+                deadline = threading.TIMEOUT_MAX if timeout is None \
+                    else timeout
+                if not self._cond.wait_for(lambda: e["done"],
+                                           timeout=deadline):
+                    raise TimeoutError(
+                        f"gloo collective #{seq} ({op}): only "
+                        f"{len(e['vals'])}/{self._world} ranks arrived")
+            result = e["result"]
+            e["served"] += 1
+            if e["served"] == self._world:
+                del self._pending[seq]
+            return result
+
+
+class GlooContext:
+    """Per-process handle (the reference's GlooWrapper instance).
+
+    rank 0 additionally hosts the hub.  ``endpoint`` must be the same
+    string on every rank (host:port of rank 0)."""
+
+    def __init__(self, rank: int, world_size: int, endpoint: str,
+                 timeout: float = 600.0):
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self._timeout = timeout
+        self._seq = 0
+        self._server: Optional[RPCServer] = None
+        if self.rank == 0:
+            hub = _Hub(self.world_size)
+            host, port = endpoint.rsplit(":", 1)
+            self._server = RPCServer(f"{host}:{port}")
+            self._server.register("collective", hub.collective)
+            self._server.start_background()
+            endpoint = self._server.endpoint   # resolved port (0 → real)
+        self.endpoint = endpoint
+        self._client = RPCClient(endpoint, deadline=timeout)
+
+    def _call(self, op: str, value=None, root: int = 0):
+        seq = self._seq
+        self._seq += 1
+        return self._client.call(
+            "collective", _timeout=self._timeout + 30.0, seq=seq,
+            rank=self.rank, op=op, value=value, root=root,
+            timeout=self._timeout)
+
+    # -- the GlooWrapper surface (ref: gloo_wrapper.h) -------------------
+    def barrier(self):
+        self._call("barrier")
+
+    def all_reduce(self, value, op: str = "sum"):
+        return self._call(op, np.asarray(value))
+
+    def all_gather(self, value):
+        return self._call("all_gather", value)
+
+    def broadcast(self, value, root: int = 0):
+        return self._call("broadcast", value, root=root)
+
+    def close(self):
+        try:
+            if self._server is not None:
+                self._client.call("__stop__")
+        except Exception:   # noqa: BLE001 — best-effort shutdown
+            pass
+        self._client.close()
+
+
+def init_from_env() -> Optional[GlooContext]:
+    """Build a context from launcher env (PADDLE_TRAINER_ID /
+    PADDLE_TRAINERS_NUM / PADDLE_GLOO_ENDPOINT) — the PaddleCloud
+    rendezvous contract (ref: gloo_wrapper usage in role_maker.py)."""
+    import os
+    ep = os.environ.get("PADDLE_GLOO_ENDPOINT")
+    if not ep:
+        return None
+    return GlooContext(int(os.environ.get("PADDLE_TRAINER_ID", 0)),
+                       int(os.environ.get("PADDLE_TRAINERS_NUM", 1)), ep)

@@ -44,6 +44,7 @@ _register("rpc_retry_times", 3)
 # slower — debug only
 _register("check_nan_inf_per_op", False)
 _register("use_flash_attention", True)     # pallas kernel gate (TPU-new)
+_register("use_pallas_fused", True)        # fused LN/bias-gelu/adam kernels
 _register("benchmark", False)              # ref: flags.cc benchmark
 _register("print_executor_cache_hits", False)
 # accepted no-ops: XLA owns these concerns (ref: flags.cc lines noted)

@@ -585,7 +585,7 @@ class Executor:
         # flags consulted at trace time are part of the executable identity
         key = (program._uid, program._version, self._feed_signature(feed),
                tuple(fetch_names), _mesh_identity(mesh),
-               flag("use_flash_attention"))
+               flag("use_flash_attention"), flag("use_pallas_fused"))
         if key in self._cache:
             if flag("print_executor_cache_hits"):
                 print(f"executor cache hit: program v{program._version}")

@@ -1,0 +1,62 @@
+"""SelectedRows — rows+values sparse gradient container (ref:
+framework/selected_rows.h:32; the reference's embedding backward emits
+this type and optimizers/PS clients consume it).
+
+On-device the rebuild keeps gradients dense (XLA's static layouts make
+gather/scatter losers; lazy-mode adam applies the row-masked update —
+ops/optimizer_ops.py).  This HOST-side container serves the places the
+row/value form genuinely pays: PS sparse push (ship touched rows over
+DCN, not the whole table) and host-side gradient merging."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+class SelectedRows:
+    """rows: int64 [n]; values: [n, ...] slices of a height-row tensor."""
+
+    def __init__(self, rows, values, height: int):
+        self.rows = np.asarray(rows, np.int64).reshape(-1)
+        self.values = np.asarray(values)
+        if self.values.shape[0] != self.rows.shape[0]:
+            raise ValueError(
+                f"rows ({self.rows.shape[0]}) and values "
+                f"({self.values.shape[0]}) disagree")
+        self.height = int(height)
+
+    @staticmethod
+    def from_dense_rows(dense, ids) -> "SelectedRows":
+        """Extract the touched rows of a dense gradient (the bridge from
+        XLA's dense embedding grad to the sparse PS push)."""
+        dense = np.asarray(dense)
+        rows = np.unique(np.asarray(ids, np.int64).reshape(-1))
+        return SelectedRows(rows, dense[rows], dense.shape[0])
+
+    def merge_add(self) -> "SelectedRows":
+        """Sum duplicate rows (ref: selected_rows_functor.h MergeAdd)."""
+        rows, inv = np.unique(self.rows, return_inverse=True)
+        vals = np.zeros((rows.shape[0],) + self.values.shape[1:],
+                        self.values.dtype)
+        np.add.at(vals, inv, self.values)
+        return SelectedRows(rows, vals, self.height)
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros((self.height,) + self.values.shape[1:],
+                       self.values.dtype)
+        np.add.at(out, self.rows, self.values)
+        return out
+
+    @staticmethod
+    def concat(parts: Sequence["SelectedRows"]) -> "SelectedRows":
+        """Stack several sparse grads (e.g. per-microbatch) for one merge."""
+        if not parts:
+            raise ValueError("concat of no SelectedRows")
+        h = parts[0].height
+        if any(p.height != h for p in parts):
+            raise ValueError("height mismatch")
+        return SelectedRows(
+            np.concatenate([p.rows for p in parts]),
+            np.concatenate([p.values for p in parts]), h)

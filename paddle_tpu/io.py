@@ -174,14 +174,19 @@ class TrainStatus:
 def save_checkpoint(executor, path, train_status: TrainStatus,
                     main_program: Optional[Program] = None,
                     scope: Optional[Scope] = None, remain_all_checkpoint=False,
-                    max_checkpoints: int = 3):
+                    max_checkpoints: int = 3, sharded: bool = False):
     """Checkpoint = persistables + rng state + TrainStatus; keeps the last
-    ``max_checkpoints`` dirs (ref auto-cleanup: collective/__init__.py:206)."""
+    ``max_checkpoints`` dirs (ref auto-cleanup: collective/__init__.py:206).
+    ``sharded=True`` writes per-process shard files (required once state is
+    sharded across hosts)."""
     scope = scope or global_scope()
     ckpt_id = train_status.epoch_no
     d = os.path.join(path, f"checkpoint_{ckpt_id}")
     os.makedirs(d, exist_ok=True)
-    save_persistables(executor, d, main_program, scope=scope)
+    if sharded:
+        save_persistables_sharded(executor, d, main_program, scope=scope)
+    else:
+        save_persistables(executor, d, main_program, scope=scope)
     rng = scope.find_var(_RNG_VAR)
     if rng is not None:
         np.save(os.path.join(d, "rng.npy"), _host_value(rng, _RNG_VAR))
@@ -221,7 +226,10 @@ def load_checkpoint(executor, path, trainer_id=0,
     if not cks:
         return TrainStatus(-1)
     _, d = cks[-1]
-    load_persistables(executor, d, main_program, scope=scope)
+    if os.path.exists(os.path.join(d, "shard_manifest_0.json")):
+        load_persistables_sharded(executor, d, main_program, scope=scope)
+    else:
+        load_persistables(executor, d, main_program, scope=scope)
     rng_path = os.path.join(d, "rng.npy")
     if os.path.exists(rng_path):
         import jax
@@ -230,3 +238,176 @@ def load_checkpoint(executor, path, trainer_id=0,
         scope.set_var(_RNG_VAR, key)
     with open(os.path.join(d, "train_status.json")) as f:
         return TrainStatus.from_dict(json.load(f))
+
+
+# ---------------------------------------------------------------------------
+# sharded + async checkpointing (orbax-style tier; ref gap: the reference
+# saves whole tensors from trainer 0 — save_combine — which cannot scale
+# to model-parallel state that exists only as per-host shards)
+# ---------------------------------------------------------------------------
+
+
+def _index_sig(idx, shape):
+    """jax shard index (tuple of slices) → JSON-able [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(idx, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def save_persistables_sharded(executor, dirname,
+                              main_program: Optional[Program] = None,
+                              scope: Optional[Scope] = None):
+    """Each process writes ONLY its addressable shards plus a manifest of
+    their global offsets — no host ever materialises a tensor it does not
+    own (the multi-host/model-parallel save path the whole-array writer
+    refuses).  Layout: shard_data_{p}.npz + shard_manifest_{p}.json."""
+    import jax
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    p = jax.process_index()
+    arrays = {}
+    manifest = {}
+    for name in _persistable_names(main_program):
+        v = scope.find_var(name)
+        if v is None:
+            continue
+        if isinstance(v, jax.Array) and len(v.sharding.device_set) > 1 \
+                and not v.sharding.is_fully_replicated:
+            entries = []
+            seen = set()
+            for k, sh in enumerate(v.addressable_shards):
+                sig = tuple(map(tuple, _index_sig(sh.index, v.shape)))
+                if sig in seen:      # replicated sub-shards: write once
+                    continue
+                seen.add(sig)
+                key = f"{name}@{k}"
+                arrays[key] = np.asarray(sh.data)
+                entries.append({"key": key,
+                                "index": _index_sig(sh.index, v.shape)})
+            manifest[name] = {"shape": list(v.shape),
+                              "dtype": str(np.dtype(v.dtype)),
+                              "shards": entries}
+        else:
+            arrays[f"{name}@full"] = _host_value(v, name)
+            manifest[name] = {"shape": list(np.shape(arrays[f"{name}@full"])),
+                              "dtype": str(arrays[f"{name}@full"].dtype),
+                              "shards": [{"key": f"{name}@full",
+                                          "index": None}]}
+    np.savez(os.path.join(dirname, f"shard_data_{p}.npz"), **arrays)
+    with open(os.path.join(dirname, f"shard_manifest_{p}.json"), "w") as f:
+        json.dump(manifest, f)
+
+
+def load_persistables_sharded(executor, dirname,
+                              main_program: Optional[Program] = None,
+                              scope: Optional[Scope] = None):
+    """Reassemble from every process's shard files (a restarted job may
+    have a different host count — reassembly is by global offsets, not by
+    writer rank)."""
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    wanted = set(_persistable_names(main_program))
+    full = {}
+    for fn in sorted(os.listdir(dirname)):
+        if not fn.startswith("shard_manifest_"):
+            continue
+        pid = fn[len("shard_manifest_"):-len(".json")]
+        with open(os.path.join(dirname, fn)) as f:
+            manifest = json.load(f)
+        with np.load(os.path.join(dirname, f"shard_data_{pid}.npz")) as data:
+            for name, rec in manifest.items():
+                if name not in wanted:
+                    continue
+                dst = full.setdefault(name, np.zeros(
+                    rec["shape"], np.dtype(rec["dtype"])))
+                for e in rec["shards"]:
+                    if e["key"] not in data:
+                        continue
+                    if e["index"] is None:
+                        dst[...] = data[e["key"]]
+                    else:
+                        sel = tuple(slice(a, b) for a, b in e["index"])
+                        dst[sel] = data[e["key"]]
+    for name, arr in full.items():
+        scope.set_var(name, arr)
+
+
+class AsyncCheckpointer:
+    """Background-thread checkpoint writer: ``save()`` snapshots state to
+    host synchronously (cheap vs the serialisation) and returns while the
+    write happens off the training thread; the NEXT save (or ``wait()``)
+    joins the previous write first, so at most one write is in flight and
+    a crash can lose at most one checkpoint — never corrupt one (writes
+    land in the final directory only via os.replace of a temp dir)."""
+
+    def __init__(self, max_checkpoints: int = 3):
+        import atexit
+        import threading
+        self._threading = threading
+        self._thread = None
+        self._error = None
+        self._max = max_checkpoints
+        # a failed FINAL write must not vanish when the loop exits without
+        # wait(): drain at interpreter shutdown and shout if it failed
+        atexit.register(self._drain_at_exit)
+
+    def _drain_at_exit(self):
+        try:
+            self.wait()
+        except Exception as e:   # noqa: BLE001 — cannot raise at shutdown
+            import sys
+            print(f"paddle_tpu.AsyncCheckpointer: FINAL checkpoint write "
+                  f"FAILED: {e!r} — the newest checkpoint is missing; "
+                  f"resume will use an older one", file=sys.stderr)
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise RuntimeError("async checkpoint write failed") from e
+
+    def save(self, executor, path, train_status: TrainStatus,
+             main_program: Optional[Program] = None,
+             scope: Optional[Scope] = None):
+        self.wait()
+        main_program = main_program or default_main_program()
+        scope = scope or global_scope()
+        # synchronous device→host snapshot: values at THIS step
+        snap = {}
+        for name in _persistable_names(main_program):
+            v = scope.find_var(name)
+            if v is not None:
+                snap[name] = _host_value(v, name)
+        rng = scope.find_var(_RNG_VAR)
+        rng_snap = _host_value(rng, _RNG_VAR) if rng is not None else None
+        status = dict(train_status.to_dict())
+        ckpt_id = train_status.epoch_no
+        final = os.path.join(path, f"checkpoint_{ckpt_id}")
+        tmp = os.path.join(path, f".tmp_checkpoint_{ckpt_id}_{os.getpid()}")
+        keep = self._max
+
+        def write():
+            try:
+                os.makedirs(tmp, exist_ok=True)
+                np.savez(os.path.join(tmp, "params.npz"), **snap)
+                if rng_snap is not None:
+                    np.save(os.path.join(tmp, "rng.npy"), rng_snap)
+                with open(os.path.join(tmp, "train_status.json"), "w") as f:
+                    json.dump(status, f)
+                if os.path.isdir(final):
+                    shutil.rmtree(final)
+                os.replace(tmp, final)
+                _cleanup_stale(path, keep)
+            except BaseException as e:   # noqa: BLE001 — re-raised on wait
+                self._error = e
+
+        os.makedirs(path, exist_ok=True)
+        self._thread = self._threading.Thread(target=write, daemon=False)
+        self._thread.start()
+        return final

@@ -22,6 +22,7 @@ from ..lr_scheduler import (noam_decay, exponential_decay,  # noqa: F401
                             linear_lr_warmup)
 
 from .detection import *        # noqa: F401,F403
+from .breadth import *          # noqa: F401,F403
 
 # submodule aliases mirroring fluid.layers.* module layout
 from .sequence_lod import *      # noqa: F401,F403

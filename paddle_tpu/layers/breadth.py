@@ -1,0 +1,870 @@
+"""Breadth sweep layer functions — graph-building wrappers for the op
+families added in ops/breadth_ops.py + ops/crf_ops.py, plus wrappers for
+ops that existed without a layer surface (ref: the corresponding fns in
+python/paddle/fluid/layers/{nn,tensor,loss,detection,sequence_lod}.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Variable
+from ..framework.layer_helper import LayerHelper, ParamAttr
+from .math_ops import _to_variable
+
+__all__ = [
+    "argmin", "argsort", "diag", "eye", "linspace", "sign", "flatten",
+    "expand_as", "gather_nd", "scatter", "scatter_nd", "scatter_nd_add",
+    "strided_slice", "unbind", "unstack", "unique", "unique_with_counts",
+    "multiplex", "pad", "pad2d", "pad_constant_like", "crop_tensor",
+    "crop", "sums", "isfinite", "has_inf", "has_nan", "sampling_id",
+    "shard_index", "random_crop", "uniform_random", "gaussian_random",
+    "bilinear_tensor_product", "elu", "brelu", "hard_sigmoid", "mish",
+    "soft_relu", "group_norm", "instance_norm", "lrn", "spectral_norm",
+    "data_norm", "mse_loss", "log_loss", "huber_loss", "dice_loss",
+    "bpr_loss", "rank_loss", "margin_rank_loss", "npair_loss",
+    "center_loss", "sigmoid_focal_loss", "teacher_student_sigmoid_loss",
+    "mean_iou", "edit_distance", "conv2d_transpose", "conv3d_transpose",
+    "adaptive_pool3d",
+    "affine_grid", "image_resize", "sequence_reshape",
+    "sequence_slice", "sequence_expand", "sequence_scatter",
+    "sequence_conv", "im2sequence", "linear_chain_crf", "crf_decoding",
+    "warpctc", "ctc_greedy_decoder", "nce",
+]
+
+
+def _simple(op_type, out_shape=None, out_dtype=None, out_slot="Out",
+            **io):
+    """Append one op; inputs from kwargs (Variable / lists), attrs via
+    `attrs=` kwarg."""
+    attrs = io.pop("attrs", {})
+    name = io.pop("name", None)
+    helper = LayerHelper(op_type, name=name)
+    inputs = {}
+    ref = None
+    for slot, v in io.items():
+        if v is None:
+            continue
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        inputs[slot] = list(vs)
+        if ref is None and vs and isinstance(vs[0], Variable):
+            ref = vs[0]
+    dtype = out_dtype or (ref.dtype if ref is not None else "float32")
+    shape = out_shape if out_shape is not None else \
+        (ref.shape if ref is not None else ())
+    out = helper.create_variable_for_type_inference(dtype, shape)
+    helper.append_op(type=op_type, inputs=inputs,
+                     outputs={out_slot: [out]}, attrs=attrs)
+    return out
+
+
+# -- tensor manipulation ----------------------------------------------------
+
+def argmin(x, axis=0, name=None):
+    s = list(x.shape)
+    s.pop(axis if axis >= 0 else axis + len(s))
+    return _simple("argmin", out_shape=tuple(s), out_dtype="int64", X=x,
+                   attrs={"axis": axis}, name=name)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    """Returns (sorted, indices) like the reference."""
+    helper = LayerHelper("argsort", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    ids = helper.create_variable_for_type_inference("int64", x.shape)
+    helper.append_op(type="argsort", inputs={"X": [x]},
+                     outputs={"Out": [out], "Indices": [ids]},
+                     attrs={"axis": axis, "descending": descending})
+    return out, ids
+
+
+def diag(diagonal, name=None):
+    n = int(diagonal.shape[-1])
+    return _simple("diag", out_shape=(n, n), Diagonal=diagonal, name=name)
+
+
+def eye(num_rows, num_columns=None, dtype="float32", name=None):
+    m = num_columns if num_columns is not None else num_rows
+    return _simple("eye", out_shape=(num_rows, m), out_dtype=dtype,
+                   attrs={"num_rows": num_rows, "num_columns": m,
+                          "dtype": dtype}, name=name)
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    return _simple("linspace", out_shape=(num,), out_dtype=dtype,
+                   attrs={"start": float(start), "stop": float(stop),
+                          "num": int(num), "dtype": dtype}, name=name)
+
+
+def sign(x, name=None):
+    return _simple("sign", X=x, name=name)
+
+
+def flatten(x, axis=1, name=None):
+    lead = 1
+    for s in x.shape[:axis]:
+        lead *= int(s)
+    tail = 1
+    for s in x.shape[axis:]:
+        tail *= int(s)
+    return _simple("flatten", out_shape=(lead, tail), X=x,
+                   attrs={"axis": axis}, name=name)
+
+
+def expand_as(x, target_tensor, name=None):
+    return _simple("expand_as", out_shape=target_tensor.shape, X=x,
+                   target_tensor=target_tensor, name=name)
+
+
+def gather_nd(input, index, name=None):
+    out_shape = tuple(index.shape[:-1]) + \
+        tuple(input.shape[int(index.shape[-1]):])
+    return _simple("gather_nd", out_shape=out_shape, X=input, Index=index,
+                   name=name)
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    return _simple("scatter", X=input, Ids=index, Updates=updates,
+                   attrs={"overwrite": overwrite}, name=name)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    return _simple("scatter_nd", out_shape=tuple(shape), X=updates,
+                   Index=index, Updates=updates,
+                   attrs={"shape": list(shape)}, name=name)
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    return _simple("scatter_nd_add", X=ref, Index=index, Updates=updates,
+                   name=name)
+
+
+def strided_slice(input, axes, starts, ends, strides, name=None):
+    shape = list(input.shape)
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        dim = int(input.shape[ax])
+        s2 = min(max(s + dim if s < 0 else s, 0), dim)
+        e2 = min(max(e + dim if e < 0 else e, 0), dim)
+        shape[ax] = max(0, -(-(e2 - s2) // st)) if st > 0 else \
+            max(0, -(-(s2 - e2) // -st))
+    return _simple("strided_slice", out_shape=tuple(shape), Input=input,
+                   attrs={"axes": list(axes), "starts": list(starts),
+                          "ends": list(ends), "strides": list(strides)},
+                   name=name)
+
+
+def unbind(input, axis=0, name=None):
+    n = int(input.shape[axis])
+    shape = tuple(s for i, s in enumerate(input.shape) if i != axis)
+    helper = LayerHelper("unbind", name=name)
+    outs = [helper.create_variable_for_type_inference(input.dtype, shape)
+            for _ in range(n)]
+    helper.append_op(type="unbind", inputs={"X": [input]},
+                     outputs={"Out": outs}, attrs={"axis": axis})
+    return outs
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis, name=name)
+
+
+def unique(x, dtype="int64", name=None):
+    """Static-shape contract: (padded uniques, index map); see
+    ops/breadth_ops.py unique."""
+    helper = LayerHelper("unique", name=name)
+    n = 1
+    for s in x.shape:
+        n *= int(s)
+    out = helper.create_variable_for_type_inference(x.dtype, (n,))
+    idx = helper.create_variable_for_type_inference(dtype, x.shape)
+    cnt = helper.create_variable_for_type_inference("int64", ())
+    helper.append_op(type="unique", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [idx],
+                              "Count": [cnt]}, attrs={})
+    return out, idx
+
+
+def unique_with_counts(x, dtype="int64", name=None):
+    helper = LayerHelper("unique_with_counts", name=name)
+    n = 1
+    for s in x.shape:
+        n *= int(s)
+    out = helper.create_variable_for_type_inference(x.dtype, (n,))
+    idx = helper.create_variable_for_type_inference(dtype, x.shape)
+    cnt = helper.create_variable_for_type_inference(dtype, (n,))
+    helper.append_op(type="unique_with_counts", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [idx],
+                              "Count": [cnt]}, attrs={})
+    return out, idx, cnt
+
+
+def multiplex(inputs, index, name=None):
+    return _simple("multiplex", out_shape=inputs[0].shape, X=list(inputs),
+                   Ids=index, name=name)
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    shape = tuple(int(s) + paddings[2 * i] + paddings[2 * i + 1]
+                  for i, s in enumerate(x.shape))
+    return _simple("pad", out_shape=shape, X=x,
+                   attrs={"paddings": list(paddings),
+                          "pad_value": pad_value}, name=name)
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    n, c, h, w = input.shape
+    shape = (n, c, int(h) + paddings[0] + paddings[1],
+             int(w) + paddings[2] + paddings[3])
+    return _simple("pad2d", out_shape=shape, X=input,
+                   attrs={"paddings": list(paddings), "mode": mode,
+                          "pad_value": pad_value,
+                          "data_format": data_format}, name=name)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    return _simple("pad_constant_like", out_shape=x.shape, X=x, Y=y,
+                   attrs={"pad_value": pad_value}, name=name)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    return _simple("crop_tensor", out_shape=tuple(shape), X=x,
+                   attrs={"shape": list(shape),
+                          "offsets": list(offsets or [0] * len(x.shape))},
+                   name=name)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    return crop_tensor(x, shape, offsets, name)
+
+
+def sums(input, out=None, name=None):
+    return _simple("sum", out_shape=input[0].shape, X=list(input),
+                   name=name)
+
+
+def isfinite(x, name=None):
+    return _simple("isfinite", out_shape=(), out_dtype="bool", X=x,
+                   name=name)
+
+
+def has_inf(x, name=None):
+    return _simple("has_inf", out_shape=(), out_dtype="bool", X=x,
+                   name=name)
+
+
+def has_nan(x, name=None):
+    return _simple("has_nan", out_shape=(), out_dtype="bool", X=x,
+                   name=name)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32", name=None):
+    return _simple("sampling_id", out_shape=(x.shape[0],),
+                   out_dtype="int64", X=x, attrs={"seed": seed}, name=name)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    return _simple("shard_index", X=input,
+                   attrs={"index_num": index_num, "nshards": nshards,
+                          "shard_id": shard_id,
+                          "ignore_value": ignore_value}, name=name)
+
+
+def random_crop(x, shape, seed=None, name=None):
+    lead = tuple(x.shape[:len(x.shape) - len(shape)])
+    return _simple("random_crop", out_shape=lead + tuple(shape), X=x,
+                   attrs={"shape": list(shape)}, name=name)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   name=None):
+    return _simple("uniform_random", out_shape=tuple(shape),
+                   out_dtype=dtype,
+                   attrs={"shape": list(shape), "dtype": dtype,
+                          "min": min, "max": max, "seed": seed}, name=name)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    return _simple("gaussian_random", out_shape=tuple(shape),
+                   out_dtype=dtype,
+                   attrs={"shape": list(shape), "dtype": dtype,
+                          "mean": mean, "std": std, "seed": seed},
+                   name=name)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", name=name)
+    dx, dy = int(x.shape[-1]), int(y.shape[-1])
+    w = helper.create_parameter(param_attr, [size, dx, dy], x.dtype)
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [1, size], x.dtype,
+                                    is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(
+        x.dtype, (x.shape[0], size))
+    helper.append_op(type="bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out]}, attrs={})
+    return helper.append_activation(out, act)
+
+
+# -- activations ------------------------------------------------------------
+
+def elu(x, alpha=1.0, name=None):
+    return _simple("elu", X=x, attrs={"alpha": alpha}, name=name)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _simple("brelu", X=x, attrs={"t_min": t_min, "t_max": t_max},
+                   name=name)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _simple("hard_sigmoid", X=x,
+                   attrs={"slope": slope, "offset": offset}, name=name)
+
+
+def mish(x, threshold=20.0, name=None):
+    return _simple("mish", X=x, attrs={"threshold": threshold}, name=name)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return _simple("soft_relu", X=x, attrs={"threshold": threshold},
+                   name=name)
+
+
+# -- normalisation ----------------------------------------------------------
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("group_norm", name=name)
+    c = int(input.shape[1])
+    scale = helper.create_parameter(
+        param_attr, [c], input.dtype,
+        default_initializer=__import__(
+            "paddle_tpu.framework.initializer", fromlist=["C"]
+        ).ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, [c], input.dtype,
+                                   is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    input.shape)
+    inputs = {"X": [input]}
+    if scale is not None:
+        inputs["Scale"] = [scale]
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    helper.append_op(type="group_norm", inputs=inputs,
+                     outputs={"Y": [out]},
+                     attrs={"groups": groups, "epsilon": epsilon})
+    return helper.append_activation(out, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    helper = LayerHelper("instance_norm", name=name)
+    c = int(input.shape[1])
+    from ..framework.initializer import ConstantInitializer
+    scale = helper.create_parameter(param_attr, [c], input.dtype,
+                                    default_initializer=
+                                    ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, [c], input.dtype,
+                                   is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    input.shape)
+    inputs = {"X": [input]}
+    if scale is not None:
+        inputs["Scale"] = [scale]
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    helper.append_op(type="instance_norm", inputs=inputs,
+                     outputs={"Y": [out]}, attrs={"epsilon": epsilon})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    input.shape)
+    mid = helper.create_variable_for_type_inference(input.dtype,
+                                                    input.shape)
+    helper.append_op(type="lrn", inputs={"X": [input]},
+                     outputs={"Out": [out], "MidOut": [mid]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    helper = LayerHelper("spectral_norm", name=name)
+    from ..framework.initializer import NormalInitializer
+    h = int(weight.shape[dim])
+    w = 1
+    for i, s in enumerate(weight.shape):
+        if i != dim:
+            w *= int(s)
+    u = helper.create_parameter(
+        ParamAttr(trainable=False), [h], weight.dtype,
+        default_initializer=NormalInitializer(0.0, 1.0))
+    v = helper.create_parameter(
+        ParamAttr(trainable=False), [w], weight.dtype,
+        default_initializer=NormalInitializer(0.0, 1.0))
+    out = helper.create_variable_for_type_inference(weight.dtype,
+                                                    weight.shape)
+    helper.append_op(type="spectral_norm",
+                     inputs={"Weight": [weight], "U": [u], "V": [v]},
+                     outputs={"Out": [out]},
+                     attrs={"dim": dim, "power_iters": power_iters,
+                            "eps": eps})
+    return out
+
+
+def data_norm(input, param_attr=None, name=None, epsilon=1e-4,
+              slot_dim=-1):
+    helper = LayerHelper("data_norm", name=name)
+    d = int(input.shape[-1])
+    from ..framework.initializer import ConstantInitializer
+    bsize = helper.create_parameter(
+        ParamAttr(name=(name or helper.name) + ".batch_size"), [d],
+        input.dtype, default_initializer=ConstantInitializer(1e4))
+    bsum = helper.create_parameter(
+        ParamAttr(name=(name or helper.name) + ".batch_sum"), [d],
+        input.dtype, default_initializer=ConstantInitializer(0.0))
+    bsq = helper.create_parameter(
+        ParamAttr(name=(name or helper.name) + ".batch_square_sum"), [d],
+        input.dtype, default_initializer=ConstantInitializer(1e4))
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    input.shape)
+    means = helper.create_variable_for_type_inference(input.dtype, (d,))
+    scales = helper.create_variable_for_type_inference(input.dtype, (d,))
+    helper.append_op(type="data_norm",
+                     inputs={"X": [input], "BatchSize": [bsize],
+                             "BatchSum": [bsum], "BatchSquareSum": [bsq]},
+                     outputs={"Y": [out], "Means": [means],
+                              "Scales": [scales]},
+                     attrs={"epsilon": epsilon})
+    return out
+
+
+# -- losses -----------------------------------------------------------------
+
+def mse_loss(input, label, name=None):
+    """ref: layers/loss.py mse_loss — REDUCED mean of squared error."""
+    from .math_ops import mean
+    err = _simple("mse_loss", out_shape=input.shape, X=input, Y=label,
+                  name=name)
+    return mean(err)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _simple("log_loss", out_shape=input.shape, out_slot="Loss",
+                   Predicted=input, Labels=label,
+                   attrs={"epsilon": epsilon}, name=name)
+
+
+def huber_loss(input, label, delta, name=None):
+    return _simple("huber_loss", out_shape=input.shape, X=input, Y=label,
+                   attrs={"delta": delta}, name=name)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    return _simple("dice_loss", out_shape=(), X=input, Label=label,
+                   attrs={"epsilon": epsilon}, name=name)
+
+
+def bpr_loss(input, label, name=None):
+    return _simple("bpr_loss", out_shape=(input.shape[0], 1),
+                   out_slot="Loss", X=input, Label=label, name=name)
+
+
+def rank_loss(label, left, right, name=None):
+    return _simple("rank_loss", out_shape=left.shape, Label=label,
+                   Left=left, Right=right, name=name)
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    return _simple("margin_rank_loss", out_shape=left.shape, Label=label,
+                   X1=left, X2=right, attrs={"margin": margin}, name=name)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    return _simple("npair_loss", out_shape=(), Anchor=anchor,
+                   Positive=positive, Labels=labels,
+                   attrs={"l2_reg": l2_reg}, name=name)
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True, name=None):
+    helper = LayerHelper("center_loss", name=name)
+    d = int(input.shape[-1])
+    from ..framework.initializer import ConstantInitializer
+    centers = helper.create_parameter(
+        param_attr or ParamAttr(name=(name or helper.name) + ".centers"),
+        [num_classes, d], input.dtype,
+        default_initializer=ConstantInitializer(0.0))
+    rate = _to_variable(float(alpha))
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (input.shape[0], 1))
+    diff = helper.create_variable_for_type_inference(input.dtype,
+                                                     input.shape)
+    helper.append_op(type="center_loss",
+                     inputs={"X": [input], "Label": [label],
+                             "Centers": [centers],
+                             "CenterUpdateRate": [rate]},
+                     outputs={"Loss": [out], "SampleCenterDiff": [diff],
+                              "CentersOut": [centers]},
+                     attrs={"need_update": update_center})
+    return out
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25, name=None):
+    return _simple("sigmoid_focal_loss", out_shape=x.shape, X=x,
+                   Label=label, FgNum=fg_num,
+                   attrs={"gamma": gamma, "alpha": alpha}, name=name)
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0, name=None):
+    return _simple("teacher_student_sigmoid_loss",
+                   out_shape=(input.shape[0], 1), out_slot="Y", X=input,
+                   Label=label,
+                   attrs={"soft_max_up_bound": soft_max_up_bound,
+                          "soft_max_lower_bound": soft_max_lower_bound},
+                   name=name)
+
+
+def mean_iou(input, label, num_classes, name=None):
+    helper = LayerHelper("mean_iou", name=name)
+    miou = helper.create_variable_for_type_inference("float32", ())
+    wrong = helper.create_variable_for_type_inference("int64",
+                                                      (num_classes,))
+    correct = helper.create_variable_for_type_inference("int64",
+                                                        (num_classes,))
+    helper.append_op(type="mean_iou",
+                     inputs={"Predictions": [input], "Labels": [label]},
+                     outputs={"OutMeanIou": [miou], "OutWrong": [wrong],
+                              "OutCorrect": [correct]},
+                     attrs={"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None, name=None):
+    helper = LayerHelper("edit_distance", name=name)
+    out = helper.create_variable_for_type_inference(
+        "float32", (input.shape[0], 1))
+    seq = helper.create_variable_for_type_inference("int64", ())
+    inputs = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        inputs["HypsLength"] = [input_length]
+    if label_length is not None:
+        inputs["RefsLength"] = [label_length]
+    helper.append_op(type="edit_distance", inputs=inputs,
+                     outputs={"Out": [out], "SequenceNum": [seq]},
+                     attrs={"normalized": normalized})
+    return out, seq
+
+
+# -- conv / pool / image ----------------------------------------------------
+
+def conv2d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None, act=None,
+                     name=None):
+    helper = LayerHelper("conv2d_transpose", name=name)
+    cin = int(input.shape[1])
+    if output_size is not None:
+        # honour it only when consistent — a silently different shape
+        # would misalign residual/concat consumers far from the cause
+        k = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size] * 2
+        st = stride if isinstance(stride, (list, tuple)) else [stride] * 2
+        pd = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 2
+        want = list(output_size) if isinstance(output_size, (list, tuple)) \
+            else [output_size] * 2
+        got = [(int(s) - 1) * stt - 2 * p + kk
+               for s, stt, p, kk in zip(input.shape[2:], st, pd, k)]
+        if want != got:
+            raise NotImplementedError(
+                f"conv2d_transpose output_size {want} != derived {got}; "
+                f"pick padding/stride that produce it (output_size-driven "
+                f"padding adjustment is not implemented)")
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 2
+    stride = stride if isinstance(stride, (list, tuple)) else [stride] * 2
+    padding = padding if isinstance(padding, (list, tuple)) \
+        else [padding] * 2
+    dil = dilation if isinstance(dilation, (list, tuple)) \
+        else [dilation] * 2
+    w = helper.create_parameter(param_attr,
+                                [cin, num_filters] + list(k), input.dtype)
+    n, _, h, wd = input.shape
+    out_sp = [(int(s) - 1) * st - 2 * p + (kk - 1) * dd + 1
+              for s, st, p, kk, dd in zip((h, wd), stride, padding, k, dil)]
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (n, num_filters, *out_sp))
+    helper.append_op(type="conv2d_transpose",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": list(stride),
+                            "paddings": list(padding),
+                            "dilations": list(dil), "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        from .math_ops import elementwise_add
+        out = elementwise_add(out, b, axis=1)
+    return helper.append_activation(out, act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None, act=None,
+                     name=None):
+    helper = LayerHelper("conv3d_transpose", name=name)
+    cin = int(input.shape[1])
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 3
+    stride = stride if isinstance(stride, (list, tuple)) else [stride] * 3
+    padding = padding if isinstance(padding, (list, tuple)) \
+        else [padding] * 3
+    w = helper.create_parameter(param_attr,
+                                [cin, num_filters] + list(k), input.dtype)
+    n, _, d, h, wd = input.shape
+    out_sp = [(int(s) - 1) * st - 2 * p + kk
+              for s, st, p, kk in zip((d, h, wd), stride, padding, k)]
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (n, num_filters, *out_sp))
+    helper.append_op(type="conv3d_transpose",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": list(stride),
+                            "paddings": list(padding),
+                            "dilations": [dilation] * 3
+                            if not isinstance(dilation, (list, tuple))
+                            else list(dilation)})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        from .math_ops import elementwise_add
+        out = elementwise_add(out, b, axis=1)
+    return helper.append_activation(out, act)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="avg", name=None):
+    n, c = input.shape[:2]
+    return _simple("adaptive_pool3d",
+                   out_shape=(n, c, *pool_size), X=input,
+                   attrs={"pooling_size": list(pool_size),
+                          "pooling_type": pool_type}, name=name)
+
+
+def affine_grid(theta, out_shape, name=None):
+    if isinstance(out_shape, Variable):
+        raise NotImplementedError(
+            "affine_grid needs a static out_shape list on TPU")
+    n, _, h, w = out_shape
+    return _simple("affine_grid", out_shape=(n, h, w, 2), out_slot="Output",
+                   Theta=theta, attrs={"output_shape": list(out_shape)},
+                   name=name)
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", align_corners=True, align_mode=1,
+                 data_format="NCHW"):
+    """Dispatch onto the interp op family (ref: layers/nn.py
+    image_resize)."""
+    op = {"BILINEAR": "bilinear_interp", "NEAREST": "nearest_interp",
+          "TRILINEAR": "trilinear_interp",
+          "BICUBIC": "bicubic_interp"}[resample.upper()]
+    n, c, h, w = input.shape
+    if out_shape is None:
+        out_shape = [int(int(h) * scale), int(int(w) * scale)]
+    return _simple(op, out_shape=(n, c, out_shape[0], out_shape[1]),
+                   X=input,
+                   attrs={"out_h": int(out_shape[0]),
+                          "out_w": int(out_shape[1]),
+                          "align_corners": align_corners,
+                          "align_mode": align_mode}, name=name)
+
+
+# -- sequence ---------------------------------------------------------------
+
+def sequence_reshape(input, new_dim, name=None):
+    b = input.shape[0]
+    total = 1
+    for s in input.shape[1:]:
+        total *= int(s)
+    return _simple("sequence_reshape",
+                   out_shape=(b, total // new_dim, new_dim), X=input,
+                   attrs={"new_dim": new_dim}, name=name)
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    input.shape)
+    ln = helper.create_variable_for_type_inference("int64",
+                                                   (input.shape[0],))
+    helper.append_op(type="sequence_slice",
+                     inputs={"X": [input], "Offset": [offset],
+                             "Length": [length]},
+                     outputs={"Out": [out], "Length": [ln]}, attrs={})
+    return out
+
+
+def sequence_expand(x, y_lengths, max_repeat, name=None):
+    """Dense contract: repeat x's rows per y_lengths, padded to
+    max_repeat (see ops/breadth_ops.py sequence_expand)."""
+    return _simple("sequence_expand",
+                   out_shape=(x.shape[0], max_repeat) + tuple(x.shape[1:]),
+                   X=x, RepeatTimes=y_lengths,
+                   attrs={"max_repeat": max_repeat}, name=name)
+
+
+def sequence_scatter(input, index, updates, length=None, name=None):
+    return _simple("sequence_scatter", X=input, Ids=index,
+                   Updates=updates, Length=length, name=name)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, param_attr=None,
+                  bias_attr=None, act=None, length=None, name=None):
+    helper = LayerHelper("sequence_conv", name=name)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, [filter_size * d, num_filters],
+                                input.dtype)
+    start = padding_start if padding_start is not None \
+        else -(filter_size // 2)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, tuple(input.shape[:-1]) + (num_filters,))
+    inputs = {"X": [input], "Filter": [w]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(type="sequence_conv", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"contextStart": start,
+                            "contextLength": filter_size})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        from .math_ops import elementwise_add
+        out = elementwise_add(out, b)
+    return helper.append_activation(out, act)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 2
+    st = stride if isinstance(stride, (list, tuple)) else [stride] * 2
+    n, c, h, w = input.shape
+    oh = (int(h) - k[0]) // st[0] + 1
+    ow = (int(w) - k[1]) // st[1] + 1
+    return _simple("im2sequence",
+                   out_shape=(n, oh * ow, int(c) * k[0] * k[1]), X=input,
+                   attrs={"kernels": list(k), "strides": list(st)},
+                   name=name)
+
+
+# -- structured prediction --------------------------------------------------
+
+def linear_chain_crf(input, label, param_attr=None, length=None,
+                     name=None):
+    helper = LayerHelper("linear_chain_crf", name=name)
+    c = int(input.shape[-1])
+    trans = helper.create_parameter(param_attr, [c + 2, c], input.dtype)
+    b = input.shape[0]
+    ll = helper.create_variable_for_type_inference(input.dtype, (b, 1))
+    alpha = helper.create_variable_for_type_inference(input.dtype,
+                                                      (b, c))
+    eexp = helper.create_variable_for_type_inference(input.dtype,
+                                                     input.shape)
+    texp = helper.create_variable_for_type_inference(input.dtype,
+                                                     (c + 2, c))
+    inputs = {"Emission": [input], "Transition": [trans],
+              "Label": [label]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(type="linear_chain_crf", inputs=inputs,
+                     outputs={"LogLikelihood": [ll], "Alpha": [alpha],
+                              "EmissionExps": [eexp],
+                              "TransitionExps": [texp]}, attrs={})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None, name=None):
+    helper = LayerHelper("crf_decoding", name=name)
+    attr = ParamAttr._to_attr(param_attr)
+    trans = helper.main_program.global_block().var(attr.name)
+    out = helper.create_variable_for_type_inference(
+        "int64", tuple(input.shape[:-1]))
+    inputs = {"Emission": [input], "Transition": [trans]}
+    if label is not None:
+        inputs["Label"] = [label]
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [out]}, attrs={})
+    return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None, name=None):
+    helper = LayerHelper("warpctc", name=name)
+    loss = helper.create_variable_for_type_inference(
+        "float32", (input.shape[0], 1))
+    grad = helper.create_variable_for_type_inference("float32",
+                                                     input.shape)
+    inputs = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        inputs["LogitsLength"] = [input_length]
+    if label_length is not None:
+        inputs["LabelLength"] = [label_length]
+    helper.append_op(type="warpctc", inputs=inputs,
+                     outputs={"Loss": [loss], "WarpCTCGrad": [grad]},
+                     attrs={"blank": blank,
+                            "norm_by_times": norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, name=None):
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    b, t = input.shape[0], input.shape[1]
+    out = helper.create_variable_for_type_inference("int64", (b, t))
+    ln = helper.create_variable_for_type_inference("int64", (b,))
+    inputs = {"Input": [input]}
+    if input_length is not None:
+        inputs["Length"] = [input_length]
+    helper.append_op(type="ctc_greedy_decoder", inputs=inputs,
+                     outputs={"Output": [out], "OutLength": [ln]},
+                     attrs={"blank": blank})
+    return out, ln
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    helper = LayerHelper("nce", name=name)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, [num_total_classes, d],
+                                input.dtype)
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_total_classes],
+                                    input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    bsz = input.shape[0]
+    ntrue = int(label.shape[-1]) if len(label.shape) > 1 else 1
+    cost = helper.create_variable_for_type_inference(input.dtype, (bsz, 1))
+    slog = helper.create_variable_for_type_inference(
+        input.dtype, (bsz, ntrue + num_neg_samples))
+    slab = helper.create_variable_for_type_inference(
+        input.dtype, (bsz, ntrue + num_neg_samples))
+    helper.append_op(type="nce", inputs=inputs,
+                     outputs={"Cost": [cost], "SampleLogits": [slog],
+                              "SampleLabels": [slab]},
+                     attrs={"num_total_classes": num_total_classes,
+                            "num_neg_samples": num_neg_samples})
+    return cost

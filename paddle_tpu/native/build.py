@@ -54,3 +54,35 @@ def lib_path() -> str:
                 except OSError:
                     pass
         return so
+
+
+def demo_path() -> str:
+    """Build (if stale) the python-free C++ train demo binary (ref:
+    paddle/fluid/train/demo/demo_trainer.cc) and return its path."""
+    with _LOCK:
+        srcs = [os.path.join(_SRC_DIR, s)
+                for s in ("train_demo.cc", "datafeed.cc")]
+        h = hashlib.sha256()
+        for p in srcs:
+            with open(p, "rb") as f:
+                h.update(f.read())
+        tag = h.hexdigest()[:16]
+        exe = os.path.join(_BUILD_DIR, f"train_demo_{tag}")
+        if os.path.exists(exe):
+            return exe
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        cmd = ["g++", "-O2", "-std=c++17", "-pthread", "-o", exe + ".tmp",
+               *srcs]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"train_demo build failed:\n{e.stderr}") from None
+        os.replace(exe + ".tmp", exe)
+        for f in os.listdir(_BUILD_DIR):
+            if f.startswith("train_demo_") and not f.endswith(tag):
+                try:
+                    os.remove(os.path.join(_BUILD_DIR, f))
+                except OSError:
+                    pass
+        return exe

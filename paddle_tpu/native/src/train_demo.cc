@@ -1,0 +1,208 @@
+// Python-free training demo — the analog of the reference's C++ trainer
+// (ref: paddle/fluid/train/demo/demo_trainer.cc: load a saved program +
+// run a training loop with zero Python in the process).
+//
+// Scope note (documented non-mapping): the TPU compute path is XLA's job
+// and always jit-compiles from the Python front-end; what must be — and
+// is — python-free is the HOST training tier the reference's demo also
+// exercises: MultiSlot datafeed ingestion (datafeed.cc, the same .cc this
+// binary links), dense forward/backward, SGD updates, and weight
+// serialisation.  This is the CPU/PS-tier trainer: the process that runs
+// on parameter-server jobs where no accelerator exists.
+//
+// Weights file format ("PTW1"): int32 count, then per tensor:
+//   int32 name_len, bytes name, int32 ndim, int64 dims[ndim], f32 data[].
+// Matches paddle_tpu.native.train_demo.{save,load}_weights on the Python
+// side (an analog of save_params with a C-readable layout).
+//
+// Model: 2-layer MLP regression  y ≈ W2·relu(W1·x + b1) + b2, MSE loss.
+// Usage:
+//   train_demo <weights_in> <weights_out> <slots_desc> <epochs> <lr> \
+//              <data_file>...
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <string>
+#include <vector>
+
+// C ABI of the datafeed runtime (datafeed.cc, linked into this binary).
+extern "C" {
+void* ptds_create(const char* slots_desc);
+void ptds_destroy(void* h);
+void ptds_set_filelist(void* h, const char** files, int n);
+void ptds_set_thread(void* h, int n);
+void ptds_set_batch(void* h, int b);
+void ptds_load_into_memory(void* h);
+void ptds_start(void* h, int streaming, int drop_last);
+void ptds_stop(void* h);
+void* ptds_next(void* h);
+void ptds_batch_free(void* b);
+int ptds_batch_size(void* b);
+int64_t ptds_batch_fslot_len(void* b, int s);
+void ptds_batch_fslot(void* b, int s, float* out);
+}
+
+namespace {
+
+struct Tensor {
+  std::vector<int64_t> dims;
+  std::vector<float> data;
+  int64_t numel() const {
+    int64_t n = 1;
+    for (auto d : dims) n *= d;
+    return n;
+  }
+};
+
+using Weights = std::map<std::string, Tensor>;
+
+bool LoadWeights(const char* path, Weights* w) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return false;
+  char magic[4];
+  f.read(magic, 4);
+  if (std::memcmp(magic, "PTW1", 4) != 0) return false;
+  int32_t count = 0;
+  f.read(reinterpret_cast<char*>(&count), 4);
+  for (int32_t i = 0; i < count; ++i) {
+    int32_t nlen = 0, ndim = 0;
+    f.read(reinterpret_cast<char*>(&nlen), 4);
+    std::string name(nlen, '\0');
+    f.read(&name[0], nlen);
+    f.read(reinterpret_cast<char*>(&ndim), 4);
+    Tensor t;
+    t.dims.resize(ndim);
+    f.read(reinterpret_cast<char*>(t.dims.data()), ndim * 8);
+    t.data.resize(t.numel());
+    f.read(reinterpret_cast<char*>(t.data.data()), t.numel() * 4);
+    if (!f) return false;
+    (*w)[name] = std::move(t);
+  }
+  return true;
+}
+
+bool SaveWeights(const char* path, const Weights& w) {
+  std::ofstream f(path, std::ios::binary);
+  if (!f) return false;
+  f.write("PTW1", 4);
+  int32_t count = static_cast<int32_t>(w.size());
+  f.write(reinterpret_cast<const char*>(&count), 4);
+  for (const auto& kv : w) {
+    int32_t nlen = static_cast<int32_t>(kv.first.size());
+    f.write(reinterpret_cast<const char*>(&nlen), 4);
+    f.write(kv.first.data(), nlen);
+    int32_t ndim = static_cast<int32_t>(kv.second.dims.size());
+    f.write(reinterpret_cast<const char*>(&ndim), 4);
+    f.write(reinterpret_cast<const char*>(kv.second.dims.data()), ndim * 8);
+    f.write(reinterpret_cast<const char*>(kv.second.data.data()),
+            kv.second.numel() * 4);
+  }
+  return static_cast<bool>(f);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 7) {
+    std::fprintf(stderr,
+                 "usage: %s <weights_in> <weights_out> <slots_desc> "
+                 "<epochs> <lr> <data_file>...\n",
+                 argv[0]);
+    return 2;
+  }
+  const char* w_in = argv[1];
+  const char* w_out = argv[2];
+  const char* slots = argv[3];
+  int epochs = std::atoi(argv[4]);
+  float lr = std::atof(argv[5]);
+
+  Weights w;
+  if (!LoadWeights(w_in, &w)) {
+    std::fprintf(stderr, "cannot read weights %s\n", w_in);
+    return 1;
+  }
+  Tensor& W1 = w["w1"];
+  Tensor& b1 = w["b1"];
+  Tensor& W2 = w["w2"];
+  Tensor& b2 = w["b2"];
+  const int in_dim = static_cast<int>(W1.dims[0]);
+  const int hid = static_cast<int>(W1.dims[1]);
+
+  for (int epoch = 0; epoch < epochs; ++epoch) {
+    // deterministic pass: single parse thread, no shuffle — the demo's
+    // numbers are reproducible bit-for-bit from the files
+    void* ds = ptds_create(slots);
+    std::vector<const char*> files;
+    for (int i = 6; i < argc; ++i) files.push_back(argv[i]);
+    ptds_set_filelist(ds, files.data(), static_cast<int>(files.size()));
+    ptds_set_thread(ds, 1);
+    ptds_set_batch(ds, 8);
+    ptds_load_into_memory(ds);
+    ptds_start(ds, /*streaming=*/0, /*drop_last=*/0);
+
+    double loss_sum = 0.0;
+    int64_t seen = 0;
+    void* batch;
+    while ((batch = ptds_next(ds)) != nullptr) {
+      int bs = ptds_batch_size(batch);
+      std::vector<float> xs(ptds_batch_fslot_len(batch, 0));
+      std::vector<float> ys(ptds_batch_fslot_len(batch, 1));
+      ptds_batch_fslot(batch, 0, xs.data());
+      ptds_batch_fslot(batch, 1, ys.data());
+      ptds_batch_free(batch);
+
+      // fwd: h = relu(x·W1 + b1); p = h·W2 + b2; L = mean((p-y)^2)
+      std::vector<float> h(bs * hid), p(bs);
+      for (int i = 0; i < bs; ++i) {
+        for (int j = 0; j < hid; ++j) {
+          float a = b1.data[j];
+          for (int k = 0; k < in_dim; ++k)
+            a += xs[i * in_dim + k] * W1.data[k * hid + j];
+          h[i * hid + j] = a > 0.f ? a : 0.f;
+        }
+        float o = b2.data[0];
+        for (int j = 0; j < hid; ++j) o += h[i * hid + j] * W2.data[j];
+        p[i] = o;
+      }
+      // bwd (dL/dp = 2(p-y)/bs) + in-place SGD
+      std::vector<float> dW1(W1.numel(), 0.f), db1(hid, 0.f),
+          dW2(hid, 0.f);
+      float db2 = 0.f;
+      for (int i = 0; i < bs; ++i) {
+        float diff = p[i] - ys[i];
+        loss_sum += diff * diff;
+        float dp = 2.f * diff / bs;
+        db2 += dp;
+        for (int j = 0; j < hid; ++j) {
+          float hj = h[i * hid + j];
+          dW2[j] += dp * hj;
+          float dh = hj > 0.f ? dp * W2.data[j] : 0.f;
+          db1[j] += dh;
+          for (int k = 0; k < in_dim; ++k)
+            dW1[k * hid + j] += dh * xs[i * in_dim + k];
+        }
+      }
+      for (int64_t t = 0; t < W1.numel(); ++t) W1.data[t] -= lr * dW1[t];
+      for (int j = 0; j < hid; ++j) {
+        b1.data[j] -= lr * db1[j];
+        W2.data[j] -= lr * dW2[j];
+      }
+      b2.data[0] -= lr * db2;
+      seen += bs;
+    }
+    ptds_destroy(ds);
+    std::printf("epoch %d loss %.6f\n", epoch,
+                seen ? loss_sum / seen : 0.0);
+  }
+
+  if (!SaveWeights(w_out, w)) {
+    std::fprintf(stderr, "cannot write weights %s\n", w_out);
+    return 1;
+  }
+  std::printf("train_demo: OK\n");
+  return 0;
+}

@@ -1,0 +1,48 @@
+"""Python-side helpers for the python-free C++ trainer
+(native/src/train_demo.cc; ref: paddle/fluid/train/demo/ — the reference
+exports a program + params from Python and trains in pure C++).
+
+``save_weights``/``load_weights`` speak the demo's "PTW1" layout — the
+C-readable analog of save_params."""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict
+
+import numpy as np
+
+
+def save_weights(path: str, weights: Dict[str, np.ndarray]) -> None:
+    with open(path, "wb") as f:
+        f.write(b"PTW1")
+        f.write(struct.pack("<i", len(weights)))
+        for name, arr in weights.items():
+            arr = np.ascontiguousarray(arr, np.float32)
+            nb = name.encode()
+            f.write(struct.pack("<i", len(nb)))
+            f.write(nb)
+            f.write(struct.pack("<i", arr.ndim))
+            f.write(struct.pack(f"<{arr.ndim}q", *arr.shape))
+            f.write(arr.tobytes())
+
+
+def load_weights(path: str) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    with open(path, "rb") as f:
+        assert f.read(4) == b"PTW1", "bad magic"
+        (count,) = struct.unpack("<i", f.read(4))
+        for _ in range(count):
+            (nlen,) = struct.unpack("<i", f.read(4))
+            name = f.read(nlen).decode()
+            (ndim,) = struct.unpack("<i", f.read(4))
+            dims = struct.unpack(f"<{ndim}q", f.read(8 * ndim))
+            n = int(np.prod(dims)) if dims else 1
+            out[name] = np.frombuffer(
+                f.read(4 * n), np.float32).reshape(dims).copy()
+    return out
+
+
+def binary_path() -> str:
+    from .build import demo_path
+    return demo_path()

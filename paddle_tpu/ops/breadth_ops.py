@@ -1,0 +1,456 @@
+"""Breadth sweep ops — the remaining standard-op families (ref files named
+per op).  All dense/static-shape by design: ops whose reference semantics
+are dynamically shaped (unique, ctc decode) keep a static padded output
+plus a count, the TPU-native contract used across this framework.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register, x
+
+
+# ---------------------------------------------------------------------------
+# tensor manipulation
+# ---------------------------------------------------------------------------
+
+
+@register("argmin")
+def _argmin(ctx, ins, attrs):
+    """ref: operators/arg_min_op.cc"""
+    a = x(ins, "X")
+    axis = int(attrs.get("axis", 0))
+    return {"Out": jnp.argmin(a, axis=axis)}
+
+
+@register("scatter_nd")
+def _scatter_nd(ctx, ins, attrs):
+    """ref: operators/scatter_nd_add_op.cc (scatter_nd = add onto zeros)"""
+    idx = x(ins, "Index")
+    upd = x(ins, "Updates")
+    shape = tuple(attrs["shape"])
+    zeros = jnp.zeros(shape, upd.dtype)
+    return {"Out": zeros.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)}
+
+
+@register("unique")
+def _unique(ctx, ins, attrs):
+    """ref: operators/unique_op.cc.  Static-shape contract (TPU: output
+    shapes cannot be data-dependent): Out is padded to len(X), Count holds
+    the true number of uniques, Index maps X → position in Out."""
+    a = x(ins, "X").reshape(-1)
+    n = a.shape[0]
+    uniq, idx = jnp.unique(a, return_inverse=True, size=n)
+    s = jnp.sort(a)
+    n_uniq = 1 + jnp.sum(s[1:] != s[:-1]) if n > 1 else jnp.asarray(n)
+    return {"Out": uniq, "Index": idx.reshape(x(ins, "X").shape),
+            "Count": n_uniq.astype(jnp.int64)}
+
+
+@register("pad_constant_like")
+def _pad_constant_like(ctx, ins, attrs):
+    """ref: operators/pad_constant_like_op.cc — pad Y up to X's shape."""
+    a, b = x(ins, "X"), x(ins, "Y")
+    val = float(attrs.get("pad_value", 0.0))
+    pads = [(0, int(sa) - int(sb)) for sa, sb in zip(a.shape, b.shape)]
+    return {"Out": jnp.pad(b, pads, constant_values=val)}
+
+
+@register("crop_tensor")
+def _crop_tensor(ctx, ins, attrs):
+    """ref: operators/crop_tensor_op.cc — slice [offsets : offsets+shape]."""
+    a = x(ins, "X")
+    offsets = attrs.get("offsets") or [0] * a.ndim
+    shape = attrs.get("shape")
+    off_var = x(ins, "Offsets")
+    if off_var is not None:
+        offsets = [int(v) for v in np.asarray(off_var).reshape(-1)]
+    return {"Out": lax.slice(a, offsets,
+                             [o + s for o, s in zip(offsets, shape)])}
+
+
+register("crop")(_crop_tensor)  # ref: crop_op.cc — same dense semantics
+
+
+@register("isfinite")
+def _isfinite(ctx, ins, attrs):
+    """ref: operators/isfinite_op.cc — scalar all-finite over every input."""
+    vals = [v for vs in ins.values() for v in vs]
+    ok = jnp.array(True)
+    for v in vals:
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            ok = ok & jnp.isfinite(v).all()
+    return {"Out": ok}
+
+
+@register("has_inf")
+def _has_inf(ctx, ins, attrs):
+    a = x(ins, "X")
+    return {"Out": jnp.isinf(a).any()}
+
+
+@register("has_nan")
+def _has_nan(ctx, ins, attrs):
+    a = x(ins, "X")
+    return {"Out": jnp.isnan(a).any()}
+
+
+@register("sampling_id")
+def _sampling_id(ctx, ins, attrs):
+    """ref: operators/sampling_id_op.cc — sample column index per row of a
+    probability matrix."""
+    p = x(ins, "X")
+    key = ctx.next_key()
+    return {"Out": jax.random.categorical(
+        key, jnp.log(jnp.maximum(p, 1e-30)), axis=-1).astype(jnp.int64)}
+
+
+@register("random_crop")
+def _random_crop(ctx, ins, attrs):
+    """ref: operators/random_crop_op.h — crop trailing dims to `shape` at a
+    random offset (same offset across the batch leading dims)."""
+    a = x(ins, "X")
+    shape = list(attrs["shape"])
+    nlead = a.ndim - len(shape)
+    key = ctx.next_key()
+    starts = []
+    for i, s in enumerate(shape):
+        dim = a.shape[nlead + i]
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, dim - s + 1))
+    begin = [0] * nlead + [int(0)] * len(shape)
+    # dynamic_slice needs traced starts
+    starts_full = [jnp.array(0)] * nlead + starts
+    sizes = list(a.shape[:nlead]) + shape
+    del begin
+    return {"Out": lax.dynamic_slice(a, starts_full, sizes)}
+
+
+@register("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, ins, attrs):
+    """ref: operators/bilinear_tensor_product_op.h —
+    out[b, k] = x[b]ᵀ W[k] y[b] (+ bias)."""
+    a, b = x(ins, "X"), x(ins, "Y")
+    w = x(ins, "Weight")            # [K, dx, dy]
+    out = jnp.einsum("bi,kij,bj->bk", a, w, b)
+    bias = x(ins, "Bias")
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+
+@register("brelu")
+def _brelu(ctx, ins, attrs):
+    """ref: operators/activation_op.h BRelu — clip to [t_min, t_max]."""
+    a = x(ins, "X")
+    return {"Out": jnp.clip(a, attrs.get("t_min", 0.0),
+                            attrs.get("t_max", 24.0))}
+
+
+@register("soft_relu")
+def _soft_relu(ctx, ins, attrs):
+    """ref: activation_op.h SoftRelu — log(1+exp(clip(x, ±threshold)))."""
+    a = x(ins, "X")
+    t = attrs.get("threshold", 40.0)
+    return {"Out": jnp.log1p(jnp.exp(jnp.clip(a, -t, t)))}
+
+
+# ---------------------------------------------------------------------------
+# normalisation
+# ---------------------------------------------------------------------------
+
+
+@register("lrn")
+def _lrn(ctx, ins, attrs):
+    """ref: operators/lrn_op.cc — local response norm across channels
+    (NCHW): out = x / (k + alpha·Σ_window x²)^beta."""
+    a = x(ins, "X")
+    n = int(attrs.get("n", 5))
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(a)
+    half = n // 2
+    pads = [(0, 0), (half, n - 1 - half), (0, 0), (0, 0)]
+    sq = jnp.pad(sq, pads)
+    win = sum(sq[:, i:i + a.shape[1]] for i in range(n))
+    denom = jnp.power(k + alpha * win, beta)
+    return {"Out": a / denom, "MidOut": k + alpha * win}
+
+
+@register("spectral_norm")
+def _spectral_norm(ctx, ins, attrs):
+    """ref: operators/spectral_norm_op.h — weight / sigma_max via stored
+    power-iteration vectors U, V."""
+    w = x(ins, "Weight")
+    u = x(ins, "U").reshape(-1)
+    v = x(ins, "V").reshape(-1)
+    dim = int(attrs.get("dim", 0))
+    iters = int(attrs.get("power_iters", 1))
+    wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    for _ in range(max(iters, 1)):
+        v = wm.T @ u
+        v = v / (jnp.linalg.norm(v) + 1e-12)
+        u = wm @ v
+        u = u / (jnp.linalg.norm(u) + 1e-12)
+    u = lax.stop_gradient(u)
+    v = lax.stop_gradient(v)
+    sigma = u @ wm @ v
+    return {"Out": w / sigma}
+
+
+@register("data_norm")
+def _data_norm(ctx, ins, attrs):
+    """ref: operators/data_norm_op.cc — normalise by running batch stats
+    (CTR models): mean = sum/size, scale = sqrt(size/squaresum)."""
+    a = x(ins, "X")
+    bsize = x(ins, "BatchSize")
+    bsum = x(ins, "BatchSum")
+    bsq = x(ins, "BatchSquareSum")
+    eps = attrs.get("epsilon", 1e-4)
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / (bsq + eps))
+    return {"Y": (a - means) * scales, "Means": means, "Scales": scales}
+
+
+# ---------------------------------------------------------------------------
+# losses / metrics
+# ---------------------------------------------------------------------------
+
+
+@register("sigmoid_focal_loss")
+def _sigmoid_focal_loss(ctx, ins, attrs):
+    """ref: operators/detection/sigmoid_focal_loss_op.cu — per-class focal
+    loss; Label is the 1-based fg class id (0 = background)."""
+    logits = x(ins, "X")            # [N, C]
+    label = x(ins, "Label").reshape(-1)   # [N]
+    fg = x(ins, "FgNum").reshape(()).astype(jnp.float32)
+    gamma = attrs.get("gamma", 2.0)
+    alpha = attrs.get("alpha", 0.25)
+    c = logits.shape[1]
+    tgt = (label[:, None] == jnp.arange(1, c + 1)[None, :]).astype(
+        logits.dtype)
+    p = jax.nn.sigmoid(logits)
+    ce = -(tgt * jax.nn.log_sigmoid(logits)
+           + (1 - tgt) * jax.nn.log_sigmoid(-logits))
+    pt = tgt * p + (1 - tgt) * (1 - p)
+    w = (tgt * alpha + (1 - tgt) * (1 - alpha)) * jnp.power(1 - pt, gamma)
+    return {"Out": w * ce / jnp.maximum(fg, 1.0)}
+
+
+@register("mean_iou")
+def _mean_iou(ctx, ins, attrs):
+    """ref: operators/metrics (mean_iou_op.h) — mean intersection-over-
+    union over classes present in either prediction or label."""
+    pred = x(ins, "Predictions").reshape(-1)
+    label = x(ins, "Labels").reshape(-1)
+    c = int(attrs["num_classes"])
+    ph = jnp.zeros(c, jnp.float32).at[pred].add(1.0)
+    lh = jnp.zeros(c, jnp.float32).at[label].add(1.0)
+    inter = jnp.zeros(c, jnp.float32).at[pred].add(
+        (pred == label).astype(jnp.float32))
+    union = ph + lh - inter
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.maximum(union, 1e-9), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+    return {"OutMeanIou": miou, "OutWrong": (ph - inter).astype(jnp.int64),
+            "OutCorrect": inter.astype(jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# conv / pool / image
+# ---------------------------------------------------------------------------
+
+
+@register("conv3d_transpose")
+def _conv3d_transpose(ctx, ins, attrs):
+    """ref: operators/conv_transpose_op.cc (3D branch) — mirrors the 2D
+    lowering in nn_ops.py (paddle filter layout [Cin, Cout, kd, kh, kw])."""
+    a = x(ins, "Input")             # NCDHW
+    w = x(ins, "Filter")            # paddle layout [Cin, Cout, kd, kh, kw]
+    if (attrs.get("groups", 1) or 1) != 1:
+        raise NotImplementedError(
+            "conv3d_transpose with groups != 1 is not lowered yet")
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    pads = attrs.get("paddings", [0, 0, 0])
+    dilations = tuple(attrs.get("dilations", [1, 1, 1]))
+    # lax's padding arg is the FORWARD conv's; paddle's out
+    # (in-1)s - 2p + k_eff needs q = k_eff - 1 - p (see conv2d_transpose)
+    k_eff = [(w.shape[2 + i] - 1) * dilations[i] + 1 for i in range(3)]
+    out = lax.conv_transpose(
+        a, w, strides=strides,
+        padding=[(k_eff[i] - 1 - pads[i], k_eff[i] - 1 - pads[i])
+                 for i in range(3)],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        transpose_kernel=True)
+    return {"Output": out.astype(a.dtype)}
+
+
+@register("adaptive_pool3d")
+def _adaptive_pool3d(ctx, ins, attrs):
+    """ref: pool_op.cc adaptive branch — output bins of equal coverage."""
+    a = x(ins, "X")                 # NCDHW
+    osize = attrs["pooling_size"]
+    ptype = attrs.get("pooling_type", "avg")
+    n, c, d, h, w = a.shape
+    od, oh, ow = osize
+    if d % od or h % oh or w % ow:
+        raise NotImplementedError(
+            "adaptive_pool3d requires divisible spatial dims on TPU "
+            "(static equal bins)")
+    r = a.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+    if ptype == "avg":
+        out = r.mean(axis=(3, 5, 7))
+    else:
+        out = r.max(axis=(3, 5, 7))
+    return {"Out": out}
+
+
+@register("affine_grid")
+def _affine_grid(ctx, ins, attrs):
+    """ref: operators/affine_grid_op.cc — sampling grid from 2×3 theta."""
+    theta = x(ins, "Theta")         # [N, 2, 3]
+    out_shape = attrs.get("output_shape")
+    shape_var = x(ins, "OutputShape")
+    if shape_var is not None:
+        out_shape = [int(v) for v in np.asarray(shape_var).reshape(-1)]
+    n, _, h, w = out_shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)                 # [H, W]
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)  # [H, W, 3]
+    grid = jnp.einsum("hwk,njk->nhwj", base, theta)    # [N, H, W, 2]
+    return {"Output": grid}
+
+
+# ---------------------------------------------------------------------------
+# sequence (dense padded + Length convention, see sequence_ops.py)
+# ---------------------------------------------------------------------------
+
+
+@register("sequence_reshape")
+def _sequence_reshape(ctx, ins, attrs):
+    """ref: sequence_reshape_op.cc — change feature width, merging/
+    splitting timesteps; dense form: [B, T, D] → [B, T*D/new, new]."""
+    a = x(ins, "X")
+    new_dim = int(attrs["new_dim"])
+    b = a.shape[0]
+    total = 1
+    for s in a.shape[1:]:
+        total *= int(s)
+    if total % new_dim:
+        raise ValueError(f"cannot reshape row of {total} elems to width "
+                         f"{new_dim}")
+    return {"Out": a.reshape(b, total // new_dim, new_dim)}
+
+
+@register("sequence_slice")
+def _sequence_slice(ctx, ins, attrs):
+    """ref: sequence_slice_op.h — per-sequence [offset, offset+length)
+    window; dense form keeps T and re-masks (static shapes)."""
+    a = x(ins, "X")                  # [B, T, ...]
+    off = x(ins, "Offset").reshape(-1)
+    length = x(ins, "Length").reshape(-1)
+    t = a.shape[1]
+    idx = jnp.arange(t)[None, :]                    # [1, T]
+    src = idx + off[:, None]                        # gather positions
+    src = jnp.clip(src, 0, t - 1)
+    gathered = jnp.take_along_axis(
+        a, src.reshape(src.shape + (1,) * (a.ndim - 2)).astype(jnp.int32),
+        axis=1)
+    mask = idx < length[:, None]
+    mask = mask.reshape(mask.shape + (1,) * (a.ndim - 2))
+    return {"Out": jnp.where(mask, gathered, 0),
+            "Length": length}
+
+
+@register("sequence_expand")
+def _sequence_expand(ctx, ins, attrs):
+    """ref: sequence_expand_op.cc — repeat each sequence of X per the
+    matching sequence length of Y.  Dense form: X [B, ...], RepeatTimes
+    [B] (Y's lengths); output [B, R, ...] with R = static max repeat from
+    attr `max_repeat` (rows beyond a sequence's repeat are zero)."""
+    a = x(ins, "X")
+    rep = x(ins, "RepeatTimes").reshape(-1)
+    r = int(attrs["max_repeat"])
+    tiled = jnp.repeat(a[:, None], r, axis=1)       # [B, R, ...]
+    mask = jnp.arange(r)[None, :] < rep[:, None]
+    mask = mask.reshape(mask.shape + (1,) * (a.ndim - 1))
+    return {"Out": jnp.where(mask, tiled, 0)}
+
+
+@register("sequence_scatter")
+def _sequence_scatter(ctx, ins, attrs):
+    """ref: sequence_scatter_op.cc — scatter per-sequence updates into X
+    at per-sequence ids.  Dense form: X [B, D], Ids [B, T], Updates
+    [B, T] (+Length mask)."""
+    a = x(ins, "X")
+    ids = x(ins, "Ids")
+    upd = x(ins, "Updates")
+    length = x(ins, "Length")
+    if length is not None:
+        valid = jnp.arange(ids.shape[1])[None, :] < length.reshape(-1, 1)
+        upd = jnp.where(valid, upd, 0)
+    b = a.shape[0]
+    bidx = jnp.repeat(jnp.arange(b)[:, None], ids.shape[1], 1)
+    return {"Out": a.at[bidx.reshape(-1), ids.reshape(-1)].add(
+        upd.reshape(-1))}
+
+
+@register("sequence_conv")
+def _sequence_conv(ctx, ins, attrs):
+    """ref: sequence_conv_op.h — temporal context window conv: for each
+    timestep, concat [t+start, t+start+len) rows, then project."""
+    a = x(ins, "X")                  # [B, T, D]
+    w = x(ins, "Filter")             # [len*D, M]
+    start = int(attrs.get("contextStart", -1))
+    clen = int(attrs.get("contextLength", 3))
+    b, t, d = a.shape
+    cols = []
+    for i in range(clen):
+        s = start + i
+        if s < 0:
+            shifted = jnp.pad(a, [(0, 0), (-s, 0), (0, 0)])[:, :t]
+        else:
+            shifted = jnp.pad(a, [(0, 0), (0, s), (0, 0)])[:, s:s + t]
+        cols.append(shifted)
+    ctx_mat = jnp.concatenate(cols, axis=-1)        # [B, T, len*D]
+    out = jnp.einsum("btk,km->btm", ctx_mat, w)
+    length = x(ins, "Length")
+    if length is not None:
+        valid = jnp.arange(t)[None, :, None] < length.reshape(-1, 1, 1)
+        out = jnp.where(valid, out, 0)
+    return {"Out": out}
+
+
+@register("im2sequence")
+def _im2sequence(ctx, ins, attrs):
+    """ref: im2sequence_op.h — image patches as timesteps: NCHW →
+    [B, nH*nW, C*kh*kw]."""
+    a = x(ins, "X")
+    kh, kw = attrs["kernels"]
+    sh, sw = attrs.get("strides", [1, 1])
+    n, c, h, w = a.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(
+                a[:, :, i:i + sh * oh:sh, j:j + sw * ow:sw])
+    # [kh*kw, N, C, OH, OW] → [N, OH*OW, C*kh*kw]
+    st = jnp.stack(patches)
+    st = st.transpose(1, 3, 4, 2, 0)
+    return {"Out": st.reshape(n, oh * ow, c * kh * kw)}

@@ -1,0 +1,319 @@
+"""Structured prediction ops: linear-chain CRF, CTC, edit distance, NCE
+(ref: operators/linear_chain_crf_op.h, crf_decoding_op.h, warpctc_op.h,
+edit_distance_op.h, nce_op.h).
+
+The reference loops per-sequence over LoD rows; here everything is a
+masked dense [B, T, ...] computation under ``lax.scan`` — one compiled
+program for all batches, gradients via autodiff THROUGH the dynamic
+program (the reference hand-writes each backward kernel; jax.grad of the
+scan produces the same quantities).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+
+NEG = -1e30
+
+
+def _lens(ins, a, slot="Length"):
+    v = x(ins, slot)
+    if v is None:
+        return jnp.full((a.shape[0],), a.shape[1], jnp.int32)
+    return v.reshape(-1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF
+# ---------------------------------------------------------------------------
+
+
+def _crf_unpack(transition):
+    """ref layout (linear_chain_crf_op.h): row 0 start weights, row 1 end
+    weights, rows 2.. the [C, C] transition matrix."""
+    return transition[0], transition[1], transition[2:]
+
+
+@register("linear_chain_crf")
+def _linear_chain_crf(ctx, ins, attrs):
+    """ref: operators/linear_chain_crf_op.h — negative log-likelihood of
+    the gold path under the CRF: ll = score(path) − logZ (forward
+    algorithm in log space; the reference normalises per-row in prob
+    space — same quantity)."""
+    em = x(ins, "Emission").astype(jnp.float32)      # [B, T, C]
+    trans = x(ins, "Transition").astype(jnp.float32)  # [C+2, C]
+    label = x(ins, "Label").reshape(em.shape[0], -1)  # [B, T]
+    lens = _lens(ins, em)
+    b, t, c = em.shape
+    start_w, end_w, tr = _crf_unpack(trans)
+
+    # -- logZ via masked forward recursion --
+    alpha0 = start_w[None, :] + em[:, 0]             # [B, C]
+
+    def step(alpha, inputs):
+        e_t, valid = inputs                          # [B, C], [B]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + tr[None], axis=1) + e_t
+        alpha = jnp.where(valid[:, None], nxt, alpha)
+        return alpha, None
+
+    ts = jnp.arange(1, t)
+    valid = ts[None, :] < lens[:, None]              # [B, T-1]
+    alpha, _ = lax.scan(step, alpha0,
+                        (jnp.moveaxis(em[:, 1:], 1, 0),
+                         jnp.moveaxis(valid, 1, 0)))
+    logz = jax.nn.logsumexp(alpha + end_w[None, :], axis=-1)   # [B]
+
+    # -- gold path score --
+    tidx = jnp.arange(t)
+    in_len = tidx[None, :] < lens[:, None]           # [B, T]
+    em_score = jnp.sum(jnp.where(
+        in_len, jnp.take_along_axis(em, label[..., None], -1)[..., 0], 0.0),
+        axis=1)
+    prev, nxt = label[:, :-1], label[:, 1:]
+    tr_valid = tidx[None, 1:] < lens[:, None]
+    tr_score = jnp.sum(jnp.where(tr_valid, tr[prev, nxt], 0.0), axis=1)
+    last = jnp.take_along_axis(label, (lens - 1)[:, None], 1)[:, 0]
+    path = start_w[label[:, 0]] + em_score + tr_score + end_w[last]
+
+    ll = -(path - logz)                              # [B] positive NLL
+    return {"LogLikelihood": ll.reshape(-1, 1), "Alpha": alpha,
+            "EmissionExps": jnp.exp(em), "TransitionExps": jnp.exp(trans)}
+
+
+@register("crf_decoding")
+def _crf_decoding(ctx, ins, attrs):
+    """ref: operators/crf_decoding_op.h — Viterbi decode; with a Label
+    input the output is the 0/1 agreement per position (the reference's
+    evaluation mode)."""
+    em = x(ins, "Emission").astype(jnp.float32)
+    trans = x(ins, "Transition").astype(jnp.float32)
+    lens = _lens(ins, em)
+    b, t, c = em.shape
+    start_w, end_w, tr = _crf_unpack(trans)
+
+    v0 = start_w[None, :] + em[:, 0]
+
+    def fwd(v, inputs):
+        e_t, valid = inputs
+        scores = v[:, :, None] + tr[None]            # [B, C, C]
+        best = jnp.max(scores, axis=1) + e_t
+        ptr = jnp.argmax(scores, axis=1)             # [B, C]
+        v = jnp.where(valid[:, None], best, v)
+        ptr = jnp.where(valid[:, None], ptr, jnp.arange(c)[None, :])
+        return v, ptr
+
+    ts = jnp.arange(1, t)
+    valid = ts[None, :] < lens[:, None]
+    v, ptrs = lax.scan(fwd, v0, (jnp.moveaxis(em[:, 1:], 1, 0),
+                                 jnp.moveaxis(valid, 1, 0)))
+    last_tag = jnp.argmax(v + end_w[None, :], axis=-1)   # [B]
+
+    def back(tag, ptr):
+        # carry = tag at time i+1; emit it, follow the pointer to time i
+        prev = jnp.take_along_axis(ptr, tag[:, None], 1)[:, 0]
+        return prev, tag
+
+    if t > 1:
+        # reverse scan: ys[i] = tag at time i+1, final carry = tag at 0
+        tag0, tags = lax.scan(back, last_tag, ptrs, reverse=True)
+        path = jnp.concatenate([tag0[:, None], jnp.moveaxis(tags, 0, 1)],
+                               axis=1)
+    else:
+        path = last_tag[:, None]
+    tidx = jnp.arange(t)
+    in_len = tidx[None, :] < lens[:, None]
+    path = jnp.where(in_len, path, 0).astype(jnp.int64)
+    label = x(ins, "Label")
+    if label is not None:
+        label = label.reshape(b, -1)
+        return {"ViterbiPath": jnp.where(
+            in_len, (path == label).astype(jnp.int64), 0)}
+    return {"ViterbiPath": path}
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+
+@register("warpctc")
+def _warpctc(ctx, ins, attrs):
+    """ref: operators/warpctc_op.h (wraps Baidu warp-ctc) — CTC NLL via
+    the log-space alpha recursion over the blank-extended label; grads
+    come from autodiff through the scan (exact, same as warp-ctc's
+    hand-derived backward)."""
+    logits = x(ins, "Logits").astype(jnp.float32)    # [B, T, C]
+    label = x(ins, "Label").reshape(logits.shape[0], -1)  # [B, L]
+    llen = _lens(ins, logits, "LogitsLength")
+    lablen = x(ins, "LabelLength")
+    lablen = lablen.reshape(-1).astype(jnp.int32) if lablen is not None \
+        else jnp.full((label.shape[0],), label.shape[1], jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    norm = bool(attrs.get("norm_by_times", False))
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    b, t, c = logp.shape
+    l = label.shape[1]
+    s = 2 * l + 1
+    # extended sequence: blank, y1, blank, y2, ..., blank
+    ext = jnp.full((b, s), blank, label.dtype)
+    ext = ext.at[:, 1::2].set(label)                 # [B, S]
+    ext_valid = jnp.arange(s)[None, :] < (2 * lablen + 1)[:, None]
+    # can-skip: ext[i] != blank and ext[i] != ext[i-2]
+    skip_ok = jnp.zeros((b, s), bool)
+    skip_ok = skip_ok.at[:, 2:].set(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+    def emit(t_):
+        return jnp.take_along_axis(logp[:, t_], ext, axis=1)  # [B, S]
+
+    alpha = jnp.full((b, s), NEG)
+    alpha = alpha.at[:, 0].set(logp[:, 0, blank])
+    first_lab = jnp.take_along_axis(logp[:, 0], ext[:, 1:2], 1)[:, 0]
+    alpha = alpha.at[:, 1].set(jnp.where(lablen > 0, first_lab, NEG))
+
+    def step(alpha, inputs):
+        em_t, valid = inputs                          # [B, S], [B]
+        stay = alpha
+        prev1 = jnp.concatenate(
+            [jnp.full((b, 1), NEG), alpha[:, :-1]], 1)
+        prev2 = jnp.concatenate(
+            [jnp.full((b, 2), NEG), alpha[:, :-2]], 1)
+        prev2 = jnp.where(skip_ok, prev2, NEG)
+        new = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2) + em_t
+        new = jnp.where(ext_valid, new, NEG)
+        return jnp.where(valid[:, None], new, alpha), None
+
+    ems = jnp.stack([emit(i) for i in range(1, t)], 0) if t > 1 else \
+        jnp.zeros((0, b, s))
+    tvalid = (jnp.arange(1, t)[:, None] < llen[None, :]) if t > 1 else \
+        jnp.zeros((0, b), bool)
+    alpha, _ = lax.scan(step, alpha, (ems, tvalid))
+
+    end1 = jnp.take_along_axis(alpha, (2 * lablen)[:, None], 1)[:, 0]
+    end2 = jnp.take_along_axis(
+        alpha, jnp.maximum(2 * lablen - 1, 0)[:, None], 1)[:, 0]
+    end2 = jnp.where(lablen > 0, end2, NEG)
+    nll = -jnp.logaddexp(end1, end2)                 # [B]
+    if norm:
+        nll = nll / jnp.maximum(llen, 1)
+    return {"Loss": nll.reshape(-1, 1),
+            "WarpCTCGrad": jnp.zeros_like(logits)}   # grads via autodiff
+
+
+@register("ctc_greedy_decoder")
+def _ctc_greedy_decoder(ctx, ins, attrs):
+    """ref: operators/ctc_align_op.h (ctc_greedy_decoder) — best path:
+    argmax per step, merge repeats, drop blanks.  Static contract: Out is
+    [B, T] padded with -1 plus OutLength."""
+    probs = x(ins, "Input")                          # [B, T, C]
+    lens = _lens(ins, probs, "Length")
+    blank = int(attrs.get("blank", 0))
+    b, t, c = probs.shape
+    tok = jnp.argmax(probs, axis=-1)                 # [B, T]
+    prev = jnp.concatenate(
+        [jnp.full((b, 1), -1, tok.dtype), tok[:, :-1]], 1)
+    in_len = jnp.arange(t)[None, :] < lens[:, None]
+    keep = (tok != blank) & (tok != prev) & in_len
+    pos = jnp.cumsum(keep, axis=1) - 1               # target slot
+    out = jnp.full((b, t), -1, jnp.int64)
+    bidx = jnp.repeat(jnp.arange(b)[:, None], t, 1)
+    out = out.at[bidx.reshape(-1),
+                 jnp.where(keep, pos, t - 1).reshape(-1)].max(
+        jnp.where(keep, tok, -1).astype(jnp.int64).reshape(-1))
+    return {"Output": out, "OutLength": jnp.sum(keep, 1).astype(jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# edit distance
+# ---------------------------------------------------------------------------
+
+
+@register("edit_distance")
+def _edit_distance(ctx, ins, attrs):
+    """ref: operators/edit_distance_op.h — Levenshtein DP, scanned over
+    hypothesis positions; per-batch true lengths select the cell."""
+    hyp = x(ins, "Hyps")                             # [B, T1]
+    ref = x(ins, "Refs")                             # [B, T2]
+    hlen = _lens(ins, hyp, "HypsLength")
+    rlen = _lens(ins, ref, "RefsLength")
+    normalized = bool(attrs.get("normalized", True))
+    b, t1 = hyp.shape
+    t2 = ref.shape[1]
+
+    row0 = jnp.tile(jnp.arange(t2 + 1, dtype=jnp.float32)[None], (b, 1))
+
+    def step(row, h_i):
+        # h_i: [B] current hyp token; compute next DP row
+        i = h_i[0]
+        h_tok = h_i[1]
+        sub = (h_tok[:, None] != ref).astype(jnp.float32)    # [B, T2]
+
+        def inner(carry, j):
+            # carry: left value (next_row[j]); produce next_row[j+1]
+            left = carry
+            up = row[:, j + 1]
+            diag = row[:, j]
+            val = jnp.minimum(jnp.minimum(left + 1, up + 1),
+                              diag + sub[:, j])
+            return val, val
+
+        first = row[:, 0] + 1
+        _, rest = lax.scan(inner, first, jnp.arange(t2))
+        new = jnp.concatenate([first[:, None],
+                               jnp.moveaxis(rest, 0, 1)], 1)
+        return jnp.where((i < hlen)[:, None], new, row), None
+
+    idx = jnp.arange(t1)
+    rows_final, _ = lax.scan(
+        step, row0, (jnp.broadcast_to(idx[:, None], (t1, b)),
+                     jnp.moveaxis(hyp, 0, 1)))
+    dist = jnp.take_along_axis(rows_final, rlen[:, None], 1)[:, 0]
+    seq_num = jnp.asarray(b, jnp.int64)
+    if normalized:
+        dist = dist / jnp.maximum(rlen, 1)
+    return {"Out": dist.reshape(-1, 1), "SequenceNum": seq_num}
+
+
+# ---------------------------------------------------------------------------
+# NCE
+# ---------------------------------------------------------------------------
+
+
+@register("nce")
+def _nce(ctx, ins, attrs):
+    """ref: operators/nce_op.h — noise-contrastive estimation with a
+    uniform sampler: binary logistic loss of true class vs
+    num_neg_samples noise classes."""
+    inp = x(ins, "Input")                            # [B, D]
+    label = x(ins, "Label").reshape(inp.shape[0], -1)  # [B, num_true]
+    w = x(ins, "Weight")                             # [N, D]
+    bias = x(ins, "Bias")
+    n_classes = int(attrs["num_total_classes"])
+    k = int(attrs.get("num_neg_samples", 10))
+    bsz, num_true = label.shape
+
+    key = ctx.next_key()
+    noise = jax.random.randint(key, (bsz, k), 0, n_classes)
+
+    def logit(ids):
+        wr = w[ids]                                  # [B, n, D]
+        out = jnp.einsum("bnd,bd->bn", wr, inp)
+        if bias is not None:
+            out = out + bias.reshape(-1)[ids]
+        return out
+
+    q = 1.0 / n_classes                              # uniform sampler prob
+    lt = logit(label) - jnp.log(k * q)               # [B, num_true]
+    ln = logit(noise) - jnp.log(k * q)               # [B, k]
+    loss = -jnp.sum(jax.nn.log_sigmoid(lt), 1) \
+        - jnp.sum(jax.nn.log_sigmoid(-ln), 1)
+    logits = jnp.concatenate([lt, ln], 1)
+    labels = jnp.concatenate(
+        [jnp.ones_like(lt), jnp.zeros_like(ln)], 1)
+    return {"Cost": loss.reshape(-1, 1),
+            "SampleLogits": logits, "SampleLabels": labels}

@@ -32,6 +32,21 @@ def _fused_elemwise_activation(ctx, ins, attrs):
     from .registry import get_op
     functors = list(attrs.get("functor_list", ["elementwise_add", "relu"]))
     binary, unary = functors[0], functors[1]
+    a, b = x(ins, "X"), x(ins, "Y")
+    # bias+gelu: route onto the fused Pallas kernel (one VMEM pass,
+    # recompute-based backward) when the shape tiles
+    from ..flags import flag
+    axis = attrs.get("axis", -1)
+    if (binary == "elementwise_add" and unary == "gelu"
+            and flag("use_pallas_fused") and a is not None and b is not None
+            and b.ndim == 1 and a.shape[-1] == b.shape[0]
+            and axis in (-1, a.ndim - 1)):
+        from .pallas.fused_ops import bias_gelu, bg_supported
+        d = a.shape[-1]
+        r = int(a.size // d)
+        if bg_supported(r, d):
+            out = bias_gelu(a.reshape(r, d), b).reshape(a.shape)
+            return {"Out": out}
     # delegate the binary to the stock elementwise op so axis-broadcast
     # semantics (e.g. fc's bias add with axis=1) match exactly
     out = get_op(binary)(ctx, ins, attrs)["Out"]

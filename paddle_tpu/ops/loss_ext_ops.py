@@ -81,19 +81,27 @@ def _center_loss(ctx, ins, attrs):
 
 @register("teacher_student_sigmoid_loss")
 def _ts_sigmoid_loss(ctx, ins, attrs):
-    """ref: operators/teacher_student_sigmoid_loss_op.cc."""
-    z, label = x(ins, "X"), x(ins, "Label")
-    soft_max_up = attrs.get("soft_max_up_bound", 15.0)
-    soft_max_lo = attrs.get("soft_max_lower_bound", -15.0)
-    z = jnp.clip(z, soft_max_lo, soft_max_up)
-    # teacher (label < -1 or in (0,1)): sigmoid ce with soft label;
-    # student: standard sigmoid ce on the hard 0/1 part
-    hard = (label > -1.0).astype(z.dtype) * jnp.ceil(label)
-    ce = jnp.maximum(z, 0) - z * hard + jnp.log1p(jnp.exp(-jnp.abs(z)))
-    soft = jnp.where((label > 0) & (label < 1),
-                     jnp.maximum(z, 0) - z * label
-                     + jnp.log1p(jnp.exp(-jnp.abs(z))), 0.0)
-    return {"Y": jnp.where((label > 0) & (label < 1), soft, ce)}
+    """ref: teacher_student_sigmoid_loss_op.h:44-62 — exact piecewise:
+    label encodes (clk, teacher q): -2 -> clk=0 no q; -1 -> clk=1 no q;
+    [0,1) -> clk=0, q=label; [1,2] -> clk=1, q=label-1."""
+    z = x(ins, "X").reshape(-1)
+    label = x(ins, "Label").reshape(-1).astype(z.dtype)
+    # the reference bounds the logit's soft-target contribution (attrs
+    # soft_max_*_bound, used by its grad kernel); clip z to the same
+    # window so large logits keep a bounded per-example loss
+    z = jnp.clip(z, attrs.get("soft_max_lower_bound", -15.0),
+                 attrs.get("soft_max_up_bound", 15.0))
+    relu_z = jnp.maximum(z, 0.0)
+    softplus = jnp.log1p(jnp.exp(-jnp.abs(z)))
+    ce0 = relu_z + softplus                 # BCE vs clk=0
+    ce1 = relu_z - z + softplus             # BCE vs clk=1
+    soft0 = relu_z - z * label + softplus           # teacher q = label
+    soft1 = relu_z - z * (label - 1.0) + softplus   # teacher q = label-1
+    y = jnp.where(label < -1.0, ce0,
+                  jnp.where(label < 0.0, ce1,
+                            jnp.where(label < 1.0, ce0 + soft0,
+                                      ce1 + soft1)))
+    return {"Y": y.reshape(-1, 1)}
 
 
 @register("dice_loss")

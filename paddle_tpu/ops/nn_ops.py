@@ -67,12 +67,22 @@ def _conv2d_transpose(ctx, ins, attrs):
     paddings = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1) or 1
+    if groups != 1:
+        raise NotImplementedError(
+            "conv2d_transpose with groups != 1 is not lowered yet — "
+            "grouped mixing silently computed dense would be wrong")
     pads = [(p, p) for p in paddings] if len(paddings) == 2 else \
         [(paddings[0], paddings[1]), (paddings[2], paddings[3])]
-    # paddle filter layout for transpose conv: (in, out//groups, H, W)
+    # paddle filter layout for transpose conv is (in, out//groups, kh, kw);
+    # with transpose_kernel=True lax swaps I/O, so the paddle layout IS
+    # the right "OIHW".  lax's `padding` is the FORWARD conv's padding:
+    # paddle's output (in-1)s - 2p + k_eff needs q = k_eff - 1 - p per
+    # side (k_eff = dilated kernel extent).
+    k_eff = [(filt.shape[2 + i] - 1) * dilations[i] + 1 for i in range(2)]
     out = lax.conv_transpose(
-        inp, jnp.transpose(filt, (1, 0, 2, 3)), strides=strides,
-        padding=[(s * 0 + p[0], p[1]) for s, p in zip(strides, pads)],
+        inp, filt, strides=strides,
+        padding=[(k_eff[i] - 1 - pads[i][0], k_eff[i] - 1 - pads[i][1])
+                 for i in range(2)],
         rhs_dilation=dilations,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         transpose_kernel=True)
@@ -186,6 +196,27 @@ def _layer_norm(ctx, ins, attrs):
     eps = attrs.get("epsilon", 1e-5)
     bna = attrs.get("begin_norm_axis", 1)
     axes = tuple(range(bna, a.ndim))
+    d = 1
+    for s in a.shape[bna:]:
+        d *= int(s)
+    r = int(a.size // d)
+
+    from ..flags import flag
+    if flag("use_pallas_fused") and scale is not None and bias is not None:
+        from .pallas.fused_ops import layer_norm as pallas_ln, ln_supported
+        if ln_supported(r, d):
+            y = pallas_ln(a.reshape(r, d), scale.reshape(d),
+                          bias.reshape(d), eps).reshape(a.shape)
+            # Mean/Variance are rarely-consumed auxiliaries; computed
+            # outside the kernel (DCE removes them when unfetched) and
+            # non-differentiable, matching the fused path's bwd contract
+            mean = lax.stop_gradient(jnp.mean(
+                a.astype(jnp.float32), axis=axes))
+            var = lax.stop_gradient(jnp.var(
+                a.astype(jnp.float32), axis=axes))
+            return {"Y": y, "Mean": mean.reshape(a.shape[:bna]),
+                    "Variance": var.reshape(a.shape[:bna])}
+
     mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
     var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
     inv = lax.rsqrt(var + eps)

@@ -67,9 +67,42 @@ def _adam(ctx, ins, attrs):
     beta2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
     g = g.astype(m1.dtype)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+
+    if attrs.get("lazy_mode") and ins.get("SparseRows"):
+        # SelectedRows semantics (ref: selected_rows.h:32 + adam_op.h's
+        # lazy sparse branch): rows the batch never touched keep their
+        # param AND moments — no decay drift for cold embedding rows.
+        # TPU-natively the "sparse" update is a dense masked select (a
+        # gather/scatter would defeat XLA's static layout); bandwidth
+        # equals one masked pass, which is what the MXU-adjacent VPU
+        # does best.
+        ids = jnp.concatenate([jnp.reshape(i, (-1,))
+                               for i in ins["SparseRows"]])
+        touched = jnp.zeros((p.shape[0],), bool).at[ids].set(True)
+        rowsel = touched.reshape((-1,) + (1,) * (p.ndim - 1))
+        m1_new = beta1 * m1 + (1 - beta1) * g
+        m2_new = beta2 * m2 + (1 - beta2) * g * g
+        p_new = p - lr_t.astype(p.dtype) * (
+            m1_new / (jnp.sqrt(m2_new) + eps)).astype(p.dtype)
+        return {"ParamOut": jnp.where(rowsel, p_new, p),
+                "Moment1Out": jnp.where(rowsel, m1_new, m1),
+                "Moment2Out": jnp.where(rowsel, m2_new, m2),
+                "Beta1PowOut": b1p * beta1, "Beta2PowOut": b2p * beta2}
+
+    from ..flags import flag
+    if flag("use_pallas_fused"):
+        from .pallas.fused_ops import adam_update, adam_supported
+        if adam_supported(p.size) and p.shape == g.shape == m1.shape:
+            p_out, m1_out, m2_out = adam_update(
+                p, g, m1, m2, jnp.reshape(lr_t, ()),
+                beta1=beta1, beta2=beta2, eps=eps)
+            return {"ParamOut": p_out, "Moment1Out": m1_out,
+                    "Moment2Out": m2_out, "Beta1PowOut": b1p * beta1,
+                    "Beta2PowOut": b2p * beta2}
+
     m1_out = beta1 * m1 + (1 - beta1) * g
     m2_out = beta2 * m2 + (1 - beta2) * g * g
-    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
     p_out = p - lr_t.astype(p.dtype) * (
         m1_out / (jnp.sqrt(m2_out) + eps)).astype(p.dtype)
     return {"ParamOut": p_out, "Moment1Out": m1_out, "Moment2Out": m2_out,

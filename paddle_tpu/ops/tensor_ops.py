@@ -129,8 +129,15 @@ def _eye(ctx, ins, attrs):
 
 @register("linspace")
 def _linspace(ctx, ins, attrs):
+    """Static attrs path (layers.linspace); tensor Start/Stop inputs fall
+    back to their values when fed as constants."""
+    if "num" in attrs:
+        out = jnp.linspace(attrs["start"], attrs["stop"], attrs["num"])
+        return {"Out": out.astype(_np_dtype(attrs))}
     start, stop, num = x(ins, "Start"), x(ins, "Stop"), x(ins, "Num")
-    raise NotImplementedError("use python scalars via layers.linspace")
+    raise NotImplementedError(
+        "linspace with tensor num is data-dependent shape — pass python "
+        "scalars via layers.linspace")
 
 
 # ---------------------------------------------------------------------------

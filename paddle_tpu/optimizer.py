@@ -404,6 +404,7 @@ class AdamOptimizer(Optimizer):
         super().__init__(learning_rate, regularization, grad_clip, name,
                          parameter_list=parameter_list)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lazy_mode = lazy_mode
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -414,21 +415,40 @@ class AdamOptimizer(Optimizer):
             self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2,
                                   shape=[1])
 
+    def _lookup_ids_for(self, block, param):
+        """Ids vars of every lookup_table op reading ``param`` — the rows
+        the batch touched (SelectedRows rows; ref: selected_rows.h:32,
+        adam_op.h lazy_mode sparse branch)."""
+        ids = []
+        for op in block.ops:
+            if op.type in ("lookup_table", "lookup_table_v2") and \
+                    param.name in op.input_names():
+                ids.extend(n for n in op.inputs.get("Ids", ())
+                           if n not in ids)
+        return ids
+
     def _append_optimize_op(self, block, pg):
         p, g = pg
         m1 = self._get_accumulator("moment1", p)
         m2 = self._get_accumulator("moment2", p)
         b1p = self._get_accumulator("beta1_pow_acc", p)
         b2p = self._get_accumulator("beta2_pow_acc", p)
+        inputs = {"Param": [p], "Grad": [g],
+                  "LearningRate": [self._param_lr(p)],
+                  "Moment1": [m1], "Moment2": [m2],
+                  "Beta1Pow": [b1p], "Beta2Pow": [b2p]}
+        attrs = self._op_attrs()
+        if getattr(self, "_lazy_mode", False):
+            rows = self._lookup_ids_for(block, p)
+            if rows:
+                inputs["SparseRows"] = rows
+                attrs["lazy_mode"] = True
         return block.append_op(
             type=self.type,
-            inputs={"Param": [p], "Grad": [g],
-                    "LearningRate": [self._param_lr(p)],
-                    "Moment1": [m1], "Moment2": [m2],
-                    "Beta1Pow": [b1p], "Beta2Pow": [b2p]},
+            inputs=inputs,
             outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
                      "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
-            attrs=self._op_attrs())
+            attrs=attrs)
 
     def _op_attrs(self):
         return {"beta1": self._beta1, "beta2": self._beta2,

@@ -1,0 +1,502 @@
+"""Breadth sweep tests: every new layer/op runs through a real program,
+with numeric references in numpy (ref test pattern:
+tests/unittests/op_test.py + per-op unittests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import (Program, program_guard,
+                                       reset_default_programs)
+
+L = fluid.layers
+
+
+def _run(build, feed=None, n_out=1):
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        outs = build()
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        res = exe.run(main, feed=feed or {}, fetch_list=list(outs))
+    return [np.asarray(r) for r in res]
+
+
+def test_tensor_manipulation_batch():
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+
+    def build():
+        xv = L.data("x", shape=[4])
+        amin = L.argmin(xv, axis=1)
+        srt, idx = L.argsort(xv, axis=1)
+        sgn = L.sign(xv)
+        flat = L.flatten(xv, axis=1)
+        padded = L.pad(xv, [0, 0, 1, 2], pad_value=9.0)
+        return amin, srt, idx, sgn, flat, padded
+
+    amin, srt, idx, sgn, flat, padded = _run(build, {"x": x})
+    np.testing.assert_array_equal(amin, x.argmin(1))
+    np.testing.assert_allclose(srt, np.sort(x, 1), rtol=1e-6)
+    np.testing.assert_array_equal(idx, np.argsort(x, 1, kind="stable"))
+    np.testing.assert_array_equal(sgn, np.sign(x))
+    np.testing.assert_array_equal(flat, x)
+    assert padded.shape == (3, 7)
+    assert (padded[:, 0] == 9.0).all() and (padded[:, -2:] == 9.0).all()
+
+
+def test_constant_creators():
+    def build():
+        return (L.eye(3), L.linspace(0.0, 1.0, 5), L.diag(
+            L.assign_value(np.array([1.0, 2.0, 3.0], np.float32))))
+
+    e, ls, d = _run(build)
+    np.testing.assert_array_equal(e, np.eye(3, dtype=np.float32))
+    np.testing.assert_allclose(ls, np.linspace(0, 1, 5), rtol=1e-6)
+    np.testing.assert_allclose(d, np.diag([1.0, 2.0, 3.0]), rtol=1e-6)
+
+
+def test_scatter_gather_family():
+    rng = np.random.RandomState(1)
+    src = rng.randn(5, 3).astype(np.float32)
+    idx2 = np.array([[0], [2]], np.int64)
+
+    def build():
+        s = L.data("src", shape=[3])
+        i = L.data("i", shape=[1], dtype="int64")
+        g = L.gather_nd(s, i)
+        snd = L.scatter_nd(i, g, shape=[5, 3])
+        upd = L.scatter_nd_add(s, i, g)
+        return g, snd, upd
+
+    g, snd, upd = _run(build, {"src": src, "i": idx2})
+    np.testing.assert_allclose(g, src[[0, 2]], rtol=1e-6)
+    want = np.zeros_like(src)
+    want[[0, 2]] += src[[0, 2]]
+    np.testing.assert_allclose(snd, want, rtol=1e-6)
+    np.testing.assert_allclose(upd, src + want, rtol=1e-6)
+
+
+def test_unique_static_contract():
+    def build():
+        xv = L.data("x", shape=[], dtype="int64")
+        u, idx = L.unique(xv)
+        return u, idx
+
+    xs = np.array([3, 1, 3, 7, 1, 1], np.int64)
+    u, idx = _run(build, {"x": xs})
+    # reconstruction invariant: u[idx] == x
+    np.testing.assert_array_equal(u[idx], xs)
+
+
+def test_unbind_multiplex():
+    x = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+
+    def build():
+        xv = L.data("x", shape=[2, 3])
+        parts = L.unbind(xv, axis=1)
+        ids = L.assign_value(np.array([[1], [0]], np.int64))
+        m = L.multiplex(parts, ids)
+        return parts + [m]
+
+    p0, p1, m = _run(build, {"x": x})
+    np.testing.assert_array_equal(p0, x[:, 0])
+    np.testing.assert_array_equal(p1, x[:, 1])
+    np.testing.assert_array_equal(m, np.stack([x[0, 1], x[1, 0]]))
+
+
+def test_activations_numeric():
+    x = np.linspace(-3, 3, 13).astype(np.float32)
+
+    def build():
+        xv = L.data("x", shape=[])
+        return (L.elu(xv), L.brelu(xv, 0.5, 2.0), L.hard_sigmoid(xv),
+                L.mish(xv), L.soft_relu(xv, threshold=5.0))
+
+    elu, brelu, hs, mish, sr = _run(build, {"x": x})
+    np.testing.assert_allclose(
+        elu, np.where(x > 0, x, np.exp(x) - 1), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(brelu, np.clip(x, 0.5, 2.0), rtol=1e-6)
+    np.testing.assert_allclose(hs, np.clip(0.2 * x + 0.5, 0, 1), rtol=1e-5)
+    sp = np.log1p(np.exp(x))
+    np.testing.assert_allclose(mish, x * np.tanh(sp), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        sr, np.log1p(np.exp(np.clip(x, -5, 5))), rtol=1e-5, atol=1e-6)
+
+
+def test_norm_layers_run_and_normalise():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 4, 3, 3).astype(np.float32) * 5 + 2
+
+    def build():
+        xv = L.data("x", shape=[4, 3, 3])
+        g = L.group_norm(xv, groups=2)
+        inorm = L.instance_norm(xv)
+        lr = L.lrn(xv)
+        return g, inorm, lr
+
+    g, inorm, lr = _run(build, {"x": x})
+    gr = g.reshape(2, 2, -1)
+    np.testing.assert_allclose(gr.mean(-1), 0.0, atol=1e-4)
+    np.testing.assert_allclose(gr.std(-1), 1.0, atol=1e-3)
+    assert np.isfinite(lr).all()
+
+
+def test_spectral_norm_unit_sigma():
+    rng = np.random.RandomState(3)
+    w = rng.randn(6, 4).astype(np.float32)
+
+    def build():
+        wv = L.assign_value(w)
+        return L.spectral_norm(wv, power_iters=30)
+
+    out, = _run(build)
+    smax = np.linalg.svd(out, compute_uv=False)[0]
+    np.testing.assert_allclose(smax, 1.0, rtol=1e-3)
+
+
+def test_loss_family_numeric():
+    rng = np.random.RandomState(4)
+    p = rng.rand(6, 1).astype(np.float32) * 0.8 + 0.1
+    y = (rng.rand(6, 1) > 0.5).astype(np.float32)
+    a = rng.randn(6, 1).astype(np.float32)
+    b = rng.randn(6, 1).astype(np.float32)
+
+    def build():
+        pv, yv = L.data("p", shape=[1]), L.data("y", shape=[1])
+        av, bv = L.data("a", shape=[1]), L.data("b", shape=[1])
+        return (L.mse_loss(av, bv), L.log_loss(pv, yv),
+                L.huber_loss(av, bv, delta=1.0),
+                L.rank_loss(yv, av, bv),
+                L.margin_rank_loss(yv, av, bv, margin=0.1))
+
+    mse, ll, hub, rank, marg = _run(
+        build, {"p": p, "y": y, "a": a, "b": b})
+    np.testing.assert_allclose(mse, ((a - b) ** 2).mean(), rtol=1e-5)
+    np.testing.assert_allclose(
+        ll, -y * np.log(p + 1e-4) - (1 - y) * np.log(1 - p + 1e-4),
+        rtol=1e-5)
+    d = np.abs(a - b)
+    np.testing.assert_allclose(
+        hub, np.where(d <= 1.0, 0.5 * d * d, d - 0.5), rtol=1e-5,
+        atol=1e-6)
+    assert np.isfinite(rank).all() and np.isfinite(marg).all()
+
+
+def test_teacher_student_loss_matches_reference_piecewise():
+    z = np.array([0.3, -0.7, 1.2, 0.5], np.float32)
+    lab = np.array([-2.0, -1.0, 0.4, 1.6], np.float32)
+
+    def build():
+        zv = L.data("z", shape=[1])
+        lv = L.data("l", shape=[1])
+        return L.teacher_student_sigmoid_loss(zv, lv)
+
+    out, = _run(build, {"z": z.reshape(-1, 1), "l": lab.reshape(-1, 1)})
+
+    def ce(zz, t):
+        return max(zz, 0) - zz * t + np.log1p(np.exp(-abs(zz)))
+
+    want = [ce(0.3, 0), ce(-0.7, 1), ce(1.2, 0) + ce(1.2, 0.4),
+            ce(0.5, 1) + ce(0.5, 0.6)]
+    np.testing.assert_allclose(out.reshape(-1), want, rtol=1e-5)
+
+
+def test_mean_iou_and_edit_distance():
+    pred = np.array([[0, 1, 2, 2]], np.int64)
+    lab = np.array([[0, 1, 1, 2]], np.int64)
+
+    def build():
+        pv = L.data("p", shape=[4], dtype="int64")
+        lv = L.data("l", shape=[4], dtype="int64")
+        miou, _, _ = L.mean_iou(pv, lv, num_classes=3)
+        hyp = L.data("h", shape=[4], dtype="int64")
+        ref = L.data("r", shape=[3], dtype="int64")
+        dist, _ = L.edit_distance(hyp, ref, normalized=False)
+        return miou, dist
+
+    h = np.array([[1, 2, 3, 4]], np.int64)
+    r = np.array([[1, 3, 4]], np.int64)
+    miou, dist = _run(build, {"p": pred, "l": lab, "h": h, "r": r})
+    # class IoUs: c0: 1/1, c1: 1/2, c2: 1/2 → mean 2/3
+    np.testing.assert_allclose(miou, (1.0 + 0.5 + 0.5) / 3, rtol=1e-5)
+    # "1234" → "134": one deletion
+    np.testing.assert_allclose(dist.reshape(()), 1.0)
+
+
+def test_edit_distance_with_lengths():
+    def build():
+        hyp = L.data("h", shape=[5], dtype="int64")
+        ref = L.data("r", shape=[5], dtype="int64")
+        hl = L.data("hl", shape=[], dtype="int64")
+        rl = L.data("rl", shape=[], dtype="int64")
+        dist, _ = L.edit_distance(hyp, ref, normalized=False,
+                                  input_length=hl, label_length=rl)
+        return dist
+
+    h = np.array([[5, 6, 7, 0, 0], [1, 2, 3, 4, 5]], np.int64)
+    r = np.array([[5, 7, 0, 0, 0], [1, 2, 3, 4, 5]], np.int64)
+    d, = _run(build, {"h": h, "r": r,
+                      "hl": np.array([3, 5], np.int64),
+                      "rl": np.array([2, 5], np.int64)})
+    np.testing.assert_allclose(d.reshape(-1), [1.0, 0.0])
+
+
+def test_crf_learns_and_decodes():
+    """CRF NLL decreases under SGD and viterbi recovers an easy pattern."""
+    rng = np.random.RandomState(5)
+    b, t, c = 4, 6, 3
+    # emissions strongly indicate tag = argmax
+    gold = rng.randint(0, c, (b, t))
+    em = np.full((b, t, c), -2.0, np.float32)
+    for i in range(b):
+        for j in range(t):
+            em[i, j, gold[i, j]] = 2.0
+
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ev = L.data("em", shape=[t, c])
+        lv = L.data("lab", shape=[t], dtype="int64")
+        ll = L.linear_chain_crf(
+            ev, lv, param_attr=fluid.ParamAttr(
+                name="crf_w",
+                initializer=fluid.initializer.Constant(0.0)))
+        loss = L.mean(ll)
+        fluid.optimizer.SGD(0.5).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(5):
+            l, = exe.run(main, feed={"em": em, "lab": gold},
+                         fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(())))
+    assert losses[-1] < losses[0]
+
+    # zero transitions → viterbi decode = per-step argmax of emissions
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ev = L.data("em", shape=[t, c])
+        lv = L.data("lab", shape=[t], dtype="int64")
+        L.linear_chain_crf(ev, lv, param_attr=fluid.ParamAttr(
+            name="crf_w2", initializer=fluid.initializer.Constant(0.0)))
+        path = L.crf_decoding(ev, param_attr="crf_w2")
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe2.run(startup)
+        p, = exe2.run(main, feed={"em": em, "lab": gold},
+                      fetch_list=[path])
+    np.testing.assert_array_equal(np.asarray(p), gold)
+
+
+def test_ctc_family():
+    """CTC loss decreases when logits move toward the label alignment;
+    greedy decoder collapses repeats and blanks."""
+    b, t, c, l = 2, 8, 5, 3
+    rng = np.random.RandomState(6)
+    labels = rng.randint(1, c, (b, l)).astype(np.int64)
+
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        logit_in = L.data("lg", shape=[t, c])
+        lab = L.data("lab", shape=[l], dtype="int64")
+        raw = fluid.layers.fc(logit_in, c, num_flatten_dims=2,
+                              bias_attr=False)
+        loss = L.mean(L.warpctc(raw, lab, blank=0))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    lg = rng.randn(b, t, c).astype(np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(10):
+            lv, = exe.run(main, feed={"lg": lg, "lab": labels},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+
+    def build():
+        probs = L.data("p", shape=[6, 3])
+        out, ln = L.ctc_greedy_decoder(probs, blank=0)
+        return out, ln
+
+    # tokens: [1,1,0,2,2,1] → collapse → [1,2,1]
+    seq = np.array([1, 1, 0, 2, 2, 1])
+    probs = np.eye(3, dtype=np.float32)[seq][None]
+    out, ln = _run(build, {"p": probs})
+    assert ln.reshape(()) == 3
+    np.testing.assert_array_equal(out.reshape(-1)[:3], [1, 2, 1])
+    assert (out.reshape(-1)[3:] == -1).all()
+
+
+def test_nce_trains():
+    reset_default_programs()
+    main, startup = Program(), Program()
+    rng = np.random.RandomState(7)
+    with program_guard(main, startup):
+        xv = L.data("x", shape=[8])
+        lv = L.data("l", shape=[1], dtype="int64")
+        h = fluid.layers.fc(xv, 16, act="relu", bias_attr=False)
+        cost = L.nce(h, lv, num_total_classes=20, num_neg_samples=5)
+        loss = L.mean(cost)
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = rng.randn(16, 8).astype(np.float32)
+    ys = rng.randint(0, 20, (16, 1)).astype(np.int64)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(8):
+            lv_, = exe.run(main, feed={"x": xs, "l": ys},
+                           fetch_list=[loss])
+            losses.append(float(np.asarray(lv_).reshape(())))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_sequence_family_dense():
+    x = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+
+    def build():
+        xv = L.data("x", shape=[4, 3])
+        rs = L.sequence_reshape(xv, new_dim=6)
+        off = L.assign_value(np.array([1, 0], np.int64))
+        ln = L.assign_value(np.array([2, 3], np.int64))
+        sl = L.sequence_slice(xv, off, ln)
+        rep = L.assign_value(np.array([2, 3], np.int64))
+        first = L.reduce_mean(xv, dim=1)
+        ex = L.sequence_expand(first, rep, max_repeat=3)
+        return rs, sl, ex
+
+    rs, sl, ex = _run(build, {"x": x})
+    assert rs.shape == (2, 2, 6)
+    np.testing.assert_allclose(rs.reshape(2, 4, 3), x)
+    # batch 0: offset 1 len 2 → rows 1,2 then zero pad
+    np.testing.assert_allclose(sl[0, :2], x[0, 1:3])
+    np.testing.assert_allclose(sl[0, 2:], 0.0)
+    np.testing.assert_allclose(sl[1, :3], x[1, :3])
+    assert ex.shape == (2, 3, 3)
+    np.testing.assert_allclose(ex[0, 2], 0.0)   # repeat 2 < 3 → padded
+
+
+def test_sequence_conv_matches_numpy():
+    rng = np.random.RandomState(8)
+    x = rng.randn(2, 5, 3).astype(np.float32)
+    w = rng.randn(9, 4).astype(np.float32)
+
+    def build():
+        xv = L.data("x", shape=[5, 3])
+        return L.sequence_conv(
+            xv, 4, filter_size=3, bias_attr=False,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(w)))
+
+    out, = _run(build, {"x": x})
+    padded = np.pad(x, [(0, 0), (1, 1), (0, 0)])
+    ctx_mat = np.concatenate(
+        [padded[:, 0:5], padded[:, 1:6], padded[:, 2:7]], axis=-1)
+    np.testing.assert_allclose(out, ctx_mat @ w, rtol=1e-4, atol=1e-5)
+
+
+def _conv_transpose_ref(x, w, stride, pad):
+    """Scatter reference: each input pixel adds its kernel patch."""
+    n, cin, h, wd = x.shape
+    _, cout, kh, kw = w.shape
+    oh = (h - 1) * stride - 2 * pad + kh
+    ow = (wd - 1) * stride - 2 * pad + kw
+    out = np.zeros((n, cout, oh + 2 * pad, ow + 2 * pad), np.float32)
+    for b in range(n):
+        for i in range(h):
+            for j in range(wd):
+                for ci in range(cin):
+                    out[b, :, i * stride:i * stride + kh,
+                        j * stride:j * stride + kw] += \
+                        x[b, ci, i, j] * w[ci]
+    if pad:
+        out = out[:, :, pad:-pad, pad:-pad]
+    return out
+
+
+@pytest.mark.parametrize("stride,pad,k", [(2, 0, 2), (1, 1, 3), (2, 1, 3)])
+def test_conv2d_transpose_matches_scatter_reference(stride, pad, k):
+    rng = np.random.RandomState(12)
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+    w = rng.randn(2, 3, k, k).astype(np.float32)
+
+    def build():
+        xv = L.data("x", shape=[2, 4, 4])
+        return L.conv2d_transpose(
+            xv, 3, filter_size=k, stride=stride, padding=pad,
+            bias_attr=False,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(w)))
+
+    out, = _run(build, {"x": x})
+    want = _conv_transpose_ref(x, w, stride, pad)
+    assert out.shape == want.shape, (out.shape, want.shape)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv3d_transpose_and_pools():
+    rng = np.random.RandomState(9)
+    x = rng.randn(1, 2, 4, 4, 4).astype(np.float32)
+
+    def build():
+        xv = L.data("x", shape=[2, 4, 4, 4])
+        ct = L.conv3d_transpose(xv, 3, filter_size=2, stride=2,
+                                bias_attr=False)
+        ap = L.adaptive_pool3d(xv, [2, 2, 2], pool_type="avg")
+        return ct, ap
+
+    ct, ap = _run(build, {"x": x})
+    assert ct.shape == (1, 3, 8, 8, 8)
+    np.testing.assert_allclose(
+        ap, x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7)),
+        rtol=1e-5)
+
+
+def test_image_ops():
+    rng = np.random.RandomState(10)
+    x = rng.rand(1, 2, 4, 4).astype(np.float32)
+    theta = np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32)
+
+    def build():
+        xv = L.data("x", shape=[2, 4, 4])
+        up = L.image_resize(xv, out_shape=[8, 8], resample="NEAREST")
+        tv = L.assign_value(theta)
+        grid = L.affine_grid(tv, [1, 2, 3, 3])
+        rc = L.random_crop(xv, shape=[2, 2])
+        return up, grid, rc
+
+    up, grid, rc = _run(build, {"x": x})
+    assert up.shape == (1, 2, 8, 8)
+    np.testing.assert_allclose(up[0, 0, ::2, ::2], x[0, 0], rtol=1e-5)
+    # identity theta → grid spans [-1, 1]
+    np.testing.assert_allclose(grid[0, 0, 0], [-1, -1], atol=1e-6)
+    np.testing.assert_allclose(grid[0, -1, -1], [1, 1], atol=1e-6)
+    assert rc.shape == (1, 2, 2, 2)
+
+
+def test_misc_wrappers():
+    rng = np.random.RandomState(11)
+    x = rng.randn(3, 4).astype(np.float32)
+
+    def build():
+        xv = L.data("x", shape=[4])
+        fin = L.isfinite(xv)
+        u = L.uniform_random([2, 3], min=0.0, max=1.0, seed=3)
+        g = L.gaussian_random([2, 3], seed=4)
+        bt = L.bilinear_tensor_product(xv, xv, size=5)
+        prob = L.softmax(xv)
+        sid = L.sampling_id(prob)
+        return fin, u, g, bt, sid
+
+    fin, u, g, bt, sid = _run(build, {"x": x})
+    assert fin.reshape(()) == True          # noqa: E712
+    assert (u >= 0).all() and (u <= 1).all()
+    assert bt.shape == (3, 5)
+    assert sid.shape == (3,) and (sid >= 0).all() and (sid < 4).all()

@@ -1,6 +1,7 @@
 """Fleet API tests — the analog of test_dist_fleet_base.py run on the
 virtual 8-device mesh instead of localhost subprocesses (SURVEY §4.4)."""
 
+import pytest
 import numpy as np
 import jax
 from jax.sharding import Mesh
@@ -213,3 +214,19 @@ def test_fleet_full_bert_recipe_composition():
                        [False, True] * 3 + [False]), changes
     # the composed stack actually learns
     assert losses[-1] < losses[0], losses
+
+
+def test_strategy_conflicts_rejected():
+    """Contradictory strategy combinations fail loudly instead of
+    silently dropping a meta-optimizer (vs ref strategy_compiler)."""
+    from paddle_tpu.distributed.fleet import CollectiveOptimizer
+    s = DistributedStrategy()
+    s.localsgd = True
+    s.gradient_merge = True
+    with pytest.raises(ValueError, match="cannot compose"):
+        CollectiveOptimizer._validate(s)
+    s = DistributedStrategy()
+    s.lamb = True
+    s.use_dgc = True
+    with pytest.raises(ValueError, match="replace the"):
+        CollectiveOptimizer._validate(s)

@@ -1,0 +1,120 @@
+"""Sharded + async checkpointing (the orbax-style tier layered over
+io.py's TrainStatus contract; ref gap: the reference's save_combine
+writes whole tensors from trainer 0 only)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import io, parallel
+from paddle_tpu.framework.core import (Program, program_guard,
+                                       reset_default_programs)
+from paddle_tpu.framework.executor import global_scope
+from paddle_tpu.parallel import build_mesh
+
+
+def _tp_model():
+    x = fluid.layers.data("x", shape=[8])
+    h = parallel.column_parallel_fc(x, 16, 4, act="relu", bias_attr=False)
+    y = parallel.row_parallel_fc(h, 4, 4, bias_attr=False)
+    return fluid.layers.mean(fluid.layers.square(y))
+
+
+def _train_one(mesh):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        loss = _tp_model()
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    compiled = fluid.CompiledProgram(main).with_mesh(
+        mesh, loss_name=loss.name, batch_axis="dp")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xb = np.random.RandomState(0).rand(8, 8).astype(np.float32)
+    exe.run(compiled, feed={"x": xb}, fetch_list=[loss])
+    return exe, main, compiled, xb, loss
+
+
+def test_sharded_roundtrip_tp_state(tmp_path):
+    """tp-sharded params survive a per-shard save + offset-based load."""
+    reset_default_programs()
+    mesh = build_mesh({"dp": 2, "tp": 4})
+    exe, main, compiled, xb, loss = _train_one(mesh)
+    scope = global_scope()
+    names = [v.name for v in main.list_vars() if v.persistable]
+    before = {n: np.asarray(scope.find_var(n)) for n in names
+              if scope.find_var(n) is not None}
+    # at least one var must actually be device-sharded for this test to
+    # prove anything
+    sharded_vars = [n for n in names
+                    if isinstance(scope.find_var(n), jax.Array)
+                    and not scope.find_var(n).sharding.is_fully_replicated]
+    assert sharded_vars, "expected tp-sharded state in scope"
+
+    io.save_persistables_sharded(exe, str(tmp_path), main)
+    files = os.listdir(tmp_path)
+    assert any(f.startswith("shard_data_") for f in files)
+
+    for n in before:
+        scope.set_var(n, np.zeros_like(before[n]))
+    io.load_persistables_sharded(exe, str(tmp_path), main)
+    for n, want in before.items():
+        np.testing.assert_array_equal(np.asarray(scope.find_var(n)), want,
+                                      err_msg=n)
+
+
+def test_sharded_checkpoint_resume(tmp_path):
+    reset_default_programs()
+    mesh = build_mesh({"dp": 2, "tp": 4})
+    exe, main, compiled, xb, loss = _train_one(mesh)
+    ts = io.TrainStatus(epoch_no=5, step=17)
+    io.save_checkpoint(exe, str(tmp_path), ts, main, sharded=True)
+    got = io.load_checkpoint(exe, str(tmp_path), main_program=main)
+    assert got == ts
+
+
+def test_async_checkpointer_snapshots_at_save_time(tmp_path):
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        loss = fluid.layers.mean(fluid.layers.fc(x, 2, bias_attr=False))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = global_scope()
+    pname = main.all_parameters()[0].name
+    w0 = np.asarray(scope.find_var(pname)).copy()
+
+    ck = io.AsyncCheckpointer()
+    ck.save(exe, str(tmp_path), io.TrainStatus(0, 0), main)
+    # mutate AFTER save returns — the write must hold the snapshot
+    scope.set_var(pname, w0 + 100.0)
+    ck.wait()
+    scope.set_var(pname, np.zeros_like(w0))
+    ts = io.load_checkpoint(exe, str(tmp_path), main_program=main)
+    assert ts.epoch_no == 0
+    np.testing.assert_array_equal(np.asarray(scope.find_var(pname)), w0)
+
+
+def test_async_checkpointer_serialises_overlapping_saves(tmp_path):
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        loss = fluid.layers.mean(fluid.layers.fc(x, 2, bias_attr=False))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ck = io.AsyncCheckpointer(max_checkpoints=2)
+    for epoch in range(4):
+        ck.save(exe, str(tmp_path), io.TrainStatus(epoch, epoch), main)
+    ck.wait()
+    # newest survives; stale cleaned to max_checkpoints
+    kept = sorted(n for n in os.listdir(tmp_path)
+                  if n.startswith("checkpoint_"))
+    assert kept == ["checkpoint_2", "checkpoint_3"]
+    ts = io.load_checkpoint(exe, str(tmp_path), main_program=main)
+    assert ts.epoch_no == 3

@@ -1,0 +1,109 @@
+"""SelectedRows container + lazy-mode (sparse) Adam semantics
+(ref: framework/selected_rows.h:32, operators/optimizers/adam_op.h
+lazy_mode branch)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import (Program, program_guard,
+                                       reset_default_programs)
+from paddle_tpu.framework.executor import global_scope
+from paddle_tpu.framework.selected_rows import SelectedRows
+
+
+def test_selected_rows_merge_and_dense():
+    sr = SelectedRows([2, 0, 2], [[1., 1.], [2., 2.], [3., 3.]], height=4)
+    m = sr.merge_add()
+    assert m.rows.tolist() == [0, 2]
+    np.testing.assert_allclose(m.values, [[2., 2.], [4., 4.]])
+    d = sr.to_dense()
+    np.testing.assert_allclose(d, [[2., 2.], [0., 0.], [4., 4.], [0., 0.]])
+
+
+def test_selected_rows_from_dense_extracts_touched():
+    g = np.arange(20, dtype=np.float32).reshape(5, 4)
+    sr = SelectedRows.from_dense_rows(g, ids=[[3, 1], [1, 3]])
+    assert sr.rows.tolist() == [1, 3]
+    np.testing.assert_allclose(sr.values, g[[1, 3]])
+    cat = SelectedRows.concat([sr, sr]).merge_add()
+    np.testing.assert_allclose(cat.to_dense()[1], 2 * g[1])
+
+
+def _embed_net(vocab=16, dim=4):
+    ids = fluid.layers.data("ids", shape=[3], dtype="int64")
+    emb = fluid.layers.embedding(
+        ids, size=[vocab, dim],
+        param_attr=fluid.ParamAttr(
+            name="emb_w",
+            initializer=fluid.initializer.Constant(0.5)))
+    return fluid.layers.mean(fluid.layers.square(emb))
+
+
+def _run_adam(lazy, steps=3):
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        loss = _embed_net()
+        fluid.optimizer.Adam(0.1, lazy_mode=lazy).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    feed = {"ids": np.array([[1, 2, 3], [3, 5, 7]], np.int64)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        w = np.asarray(scope.find_var("emb_w")).copy()
+        m1 = np.asarray(scope.find_var(
+            [n for n in _moment_names(main)][0])).copy()
+    return w, m1
+
+
+def _moment_names(program):
+    return [v.name for v in program.list_vars()
+            if v.persistable and "moment1" in v.name]
+
+
+def test_lazy_adam_leaves_cold_rows_untouched():
+    w_lazy, m1_lazy = _run_adam(lazy=True)
+    w_dense, m1_dense = _run_adam(lazy=False)
+    touched = [1, 2, 3, 5, 7]
+    cold = [r for r in range(16) if r not in touched]
+    # cold rows: lazy keeps the init value exactly; zero moments
+    np.testing.assert_array_equal(w_lazy[cold], 0.5)
+    np.testing.assert_array_equal(m1_lazy[cold], 0.0)
+    # touched rows: lazy == dense (grads only flow to touched rows, so the
+    # dense update differs only through moment decay of cold rows)
+    np.testing.assert_allclose(w_lazy[touched], w_dense[touched],
+                               rtol=1e-6)
+    # dense adam moved cold rows too?  No: cold grads are 0 and moments
+    # start at 0, so dense also leaves them — the semantic difference
+    # appears once moments are warm; prove THAT path:
+    # run dense 1 step with rows [1], then 1 step with rows [2] — row 1
+    # keeps moving under dense (stale momentum), stays put under lazy.
+
+
+def test_lazy_adam_stale_momentum_does_not_leak():
+    def run(lazy):
+        reset_default_programs()
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            loss = _embed_net()
+            fluid.optimizer.Adam(0.1, lazy_mode=lazy).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(main, feed={"ids": np.array([[1, 1, 1]], np.int64)},
+                    fetch_list=[loss])
+            w_after1 = np.asarray(scope.find_var("emb_w")).copy()
+            exe.run(main, feed={"ids": np.array([[2, 2, 2]], np.int64)},
+                    fetch_list=[loss])
+            w_after2 = np.asarray(scope.find_var("emb_w")).copy()
+        return w_after1, w_after2
+
+    w1_lazy, w2_lazy = run(True)
+    w1_dense, w2_dense = run(False)
+    # step 2 touches only row 2; row 1 must NOT move under lazy …
+    np.testing.assert_array_equal(w2_lazy[1], w1_lazy[1])
+    # … but DOES drift under dense adam (stale momentum keeps pushing)
+    assert not np.array_equal(w2_dense[1], w1_dense[1])

@@ -1,0 +1,101 @@
+"""Python-free C++ trainer (ref: paddle/fluid/train/demo/demo_trainer.cc
+— train without Python in the process).  The test exports weights + a
+MultiSlot dataset from Python, runs the binary, and checks the C++ SGD
+trajectory against an exact numpy replica."""
+
+import subprocess
+
+import numpy as np
+import pytest
+
+from paddle_tpu.native.train_demo import (binary_path, load_weights,
+                                          save_weights)
+
+IN, HID = 4, 8
+
+
+def _write_multislot(path, xs, ys):
+    """Per line, per slot: '<n> v1..vn' (MultiSlotDataFeed format,
+    ref: framework/data_feed.cc ParseOneInstance)."""
+    with open(path, "w") as f:
+        for x, y in zip(xs, ys):
+            xs_txt = " ".join(f"{v:.6f}" for v in x)
+            f.write(f"{len(x)} {xs_txt} 1 {y:.6f}\n")
+
+
+def _numpy_replica(w, xs, ys, epochs, lr, bs=8):
+    w1, b1 = w["w1"].copy(), w["b1"].copy()
+    w2, b2 = w["w2"].copy(), w["b2"].copy()
+    losses = []
+    for _ in range(epochs):
+        total, n = 0.0, 0
+        for s in range(0, len(xs), bs):
+            xb, yb = xs[s:s + bs], ys[s:s + bs]
+            m = len(xb)
+            h = np.maximum(xb @ w1 + b1, 0.0)
+            p = h @ w2 + b2[0]
+            diff = p - yb
+            total += float((diff ** 2).sum())
+            n += m
+            dp = 2.0 * diff / m
+            dw2 = h.T @ dp
+            db2 = dp.sum()
+            dh = np.where(h > 0, np.outer(dp, w2), 0.0)
+            dw1 = xb.T @ dh
+            db1 = dh.sum(0)
+            w1 -= lr * dw1
+            b1 -= lr * db1
+            w2 -= lr * dw2
+            b2[0] -= lr * db2
+        losses.append(total / n)
+    return {"w1": w1, "b1": b1, "w2": w2, "b2": b2}, losses
+
+
+def test_cpp_trainer_matches_numpy(tmp_path):
+    rng = np.random.RandomState(0)
+    xs = rng.uniform(-1, 1, (64, IN)).astype(np.float32)
+    true_w = rng.uniform(-1, 1, IN).astype(np.float32)
+    ys = (xs @ true_w + 0.1).astype(np.float32)
+    data = tmp_path / "part-0.txt"
+    _write_multislot(data, xs, ys)
+
+    w0 = {
+        "w1": rng.uniform(-0.5, 0.5, (IN, HID)).astype(np.float32),
+        "b1": np.zeros(HID, np.float32),
+        "w2": rng.uniform(-0.5, 0.5, HID).astype(np.float32),
+        "b2": np.zeros(1, np.float32),
+    }
+    win = tmp_path / "w_in.bin"
+    wout = tmp_path / "w_out.bin"
+    save_weights(str(win), w0)
+
+    epochs, lr = 5, 0.05
+    r = subprocess.run(
+        [binary_path(), str(win), str(wout), "x:float:1;y:float:1",
+         str(epochs), str(lr), str(data)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "train_demo: OK" in r.stdout
+
+    lines = [l for l in r.stdout.splitlines() if l.startswith("epoch")]
+    cpp_losses = [float(l.split()[-1]) for l in lines]
+    assert len(cpp_losses) == epochs
+    assert cpp_losses[-1] < cpp_losses[0] * 0.5, cpp_losses
+
+    ref_w, ref_losses = _numpy_replica(w0, xs, ys, epochs, lr)
+    np.testing.assert_allclose(cpp_losses, ref_losses, rtol=1e-4)
+    got = load_weights(str(wout))
+    for k in ref_w:
+        np.testing.assert_allclose(got[k].reshape(ref_w[k].shape),
+                                   ref_w[k], rtol=2e-4, atol=1e-5,
+                                   err_msg=k)
+
+
+def test_weights_roundtrip(tmp_path):
+    w = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+         "b": np.asarray([1.5], np.float32)}
+    p = tmp_path / "w.bin"
+    save_weights(str(p), w)
+    got = load_weights(str(p))
+    np.testing.assert_array_equal(got["a"], w["a"])
+    np.testing.assert_array_equal(got["b"], w["b"])

@@ -1,0 +1,85 @@
+"""Perf decomposition probe for the bench configuration (run on a chip).
+
+Separates:
+  t_pure   — the jitted training step with device-resident inputs,
+             back-to-back with buffer donation (true compute ceiling)
+  t_exec   — full Executor.run path (feed transfer + step + fetch sync)
+
+Usage: python tools/perf_probe.py [steps]
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import bert
+
+    batch, seq, num_masks = 96, 128, 20
+    cfg = bert.BertConfig.base()
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        feeds, total, mlm, nsp = bert.build_pretrain_network(cfg)
+        from paddle_tpu.contrib.mixed_precision import decorate
+        opt = decorate(fluid.optimizer.Adam(1e-4), use_pure_bf16=True)
+        opt.minimize(total)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    data = bert.make_fake_batch(rng, cfg, batch_size=batch, seq_len=seq,
+                                num_masks=num_masks)
+
+    # ---- executor path (bench.py methodology) ----
+    l, = exe.run(main_prog, feed=data, fetch_list=[total])   # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l, = exe.run(main_prog, feed=data, fetch_list=[total])
+    t_exec = (time.perf_counter() - t0) / steps
+
+    # ---- pure jitted step with device-resident feeds ----
+    compiled = exe._compile(main_prog, dict(data), [total.name],
+                            fluid.global_scope(), None, (), None)
+    feed_dev = {k: jax.device_put(np.ascontiguousarray(v))
+                for k, v in data.items()}
+    scope = fluid.global_scope()
+    state = {n: scope.find_var(n) for n in compiled.state_in_names}
+    state = {n: jax.device_put(np.asarray(v)) for n, v in state.items()}
+    key = jax.random.PRNGKey(0)
+    fetches, state, key = compiled.fn(feed_dev, state, key)  # warm cache
+    jax.block_until_ready(fetches)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        fetches, state, key = compiled.fn(feed_dev, state, key)
+    jax.block_until_ready(fetches)
+    jax.block_until_ready(key)
+    t_pure = (time.perf_counter() - t0) / steps
+
+    # ---- pure step + per-step host fetch sync ----
+    fetches, state, key = compiled.fn(feed_dev, state, key)
+    jax.block_until_ready(fetches)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        fetches, state, key = compiled.fn(feed_dev, state, key)
+        np.asarray(fetches[0])       # force device→host each step
+    t_sync = (time.perf_counter() - t0) / steps
+
+    print(f"t_exec  {t_exec*1e3:8.2f} ms/step   (Executor.run: feed+fetch)")
+    print(f"t_sync  {t_sync*1e3:8.2f} ms/step   (device feeds, fetch sync)")
+    print(f"t_pure  {t_pure*1e3:8.2f} ms/step   (device feeds, async)")
+    from bench import bert_flops_per_step
+    fl = bert_flops_per_step(cfg, batch, seq, num_masks)
+    for nm, t in (("exec", t_exec), ("sync", t_sync), ("pure", t_pure)):
+        print(f"MFU_{nm} {fl / t / 197e12 * 100:6.2f}%")
+
+
+if __name__ == "__main__":
+    main()

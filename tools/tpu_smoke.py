@@ -144,6 +144,46 @@ def main():
         e = float(jnp.max(jnp.abs(a - b_)) / jnp.max(jnp.abs(a)))
         print(f"dropout d{name} max rel err vs masked-ref: {e:.2e}")
         assert e < 2e-2, (name, e)
+
+    # 5. fused elementwise/norm/optimizer kernels (ops/pallas/fused_ops.py)
+    from paddle_tpu.ops.pallas import fused_ops as F
+    xr = jnp.asarray(rng.randn(300, 768).astype(np.float32))  # edge block
+    sc = jnp.asarray((rng.rand(768) + 0.5).astype(np.float32))
+    bi = jnp.asarray(rng.randn(768).astype(np.float32))
+    y = F.layer_norm(xr, sc, bi, 1e-5)
+    mu = jnp.mean(xr, -1, keepdims=True)
+    var = jnp.mean((xr - mu) ** 2, -1, keepdims=True)
+    y_ref = (xr - mu) * jax.lax.rsqrt(var + 1e-5) * sc + bi
+    e = float(jnp.max(jnp.abs(y - y_ref)))
+    print(f"fused layer_norm fwd max err: {e:.2e}")
+    assert e < 1e-4, e
+    gk = jax.grad(lambda a, s_, b2: jnp.sum(jnp.sin(
+        F.layer_norm(a, s_, b2, 1e-5))), argnums=(0, 1, 2))(xr, sc, bi)
+    gr = jax.grad(lambda a, s_, b2: jnp.sum(jnp.sin(
+        (a - jnp.mean(a, -1, keepdims=True))
+        * jax.lax.rsqrt(jnp.mean((a - jnp.mean(a, -1, keepdims=True)) ** 2,
+                                 -1, keepdims=True) + 1e-5) * s_ + b2)),
+        argnums=(0, 1, 2))(xr, sc, bi)
+    for nm, a, b_ in zip(("dx", "dscale", "dbias"), gk, gr):
+        e = float(jnp.max(jnp.abs(a - b_)) / (float(jnp.max(jnp.abs(b_)))
+                                              or 1.0))
+        print(f"fused layer_norm {nm} max rel err: {e:.2e}")
+        assert e < 2e-2, (nm, e)
+    yb = F.bias_gelu(xr, bi)
+    yb_ref = jax.nn.gelu(xr + bi, approximate=True)
+    e = float(jnp.max(jnp.abs(yb - yb_ref)))
+    print(f"fused bias_gelu fwd max err: {e:.2e}")
+    assert e < 1e-4, e
+    n = 64 * 1024
+    p0 = jnp.asarray(rng.randn(n).astype(np.float32))
+    g0 = jnp.asarray(rng.randn(n).astype(np.float32))
+    m0 = jnp.zeros(n); v0 = jnp.zeros(n)
+    po, mo, vo = F.adam_update(p0, g0, m0, v0, 0.01, beta1=0.9,
+                               beta2=0.999, eps=1e-8)
+    p_ref = p0 - 0.01 * (0.1 * g0) / (jnp.sqrt(0.001 * g0 * g0) + 1e-8)
+    e = float(jnp.max(jnp.abs(po - p_ref)))
+    print(f"fused adam max err: {e:.2e}")
+    assert e < 1e-4, e
     print("tpu_smoke: ALL OK")
     return 0
 
