@@ -39,9 +39,11 @@ class PassBuilder:
     inference/analysis/ir_pass_manager.h)."""
 
     #: default inference pipeline, mirroring the reference's
-    #: GpuPassStrategy order: fusions first, DCE last
+    #: GpuPassStrategy order: fusions first, folds, DCE last
     INFERENCE_PASSES = ["fuse_elemwise_add_act", "fuse_bn_act",
-                       "multihead_matmul_fuse", "dead_code_elimination"]
+                        "fuse_add_layernorm", "multihead_matmul_fuse",
+                        "transpose_matmul_fold", "fold_identity_ops",
+                        "cast_elimination", "dead_code_elimination"]
 
     def __init__(self, passes: Optional[Sequence[str]] = None):
         self._passes: List[str] = list(
@@ -84,6 +86,19 @@ def _use_counts(block, keep_names=()):
     for n in keep_names:
         uses[n] = uses.get(n, 0) + 1
     return uses
+
+
+def _consumed_in_subblock(block, name):
+    """True when a control-flow op's sub-block closure reads ``name`` —
+    alias rewrites can only patch top-level consumers, so such vars must
+    keep their producer."""
+    for op in block.ops:
+        for attr in op.attrs.values():
+            if hasattr(attr, "ops"):
+                for sub in attr.ops:
+                    if name in sub.input_names():
+                        return True
+    return False
 
 
 def _single_use_chain(block, i, uses, next_types, out_name=None):
@@ -186,6 +201,174 @@ def fuse_bn_act(program: Program, fetch_names=(), **_):
             op.outputs = dict(op.outputs)
             op.outputs["Y"] = list(act.outputs.values())[0]
             drop.add(j)
+        block.ops[:] = [op for k, op in enumerate(block.ops)
+                        if k not in drop]
+
+
+@register_pass("fold_identity_ops")
+def fold_identity_ops(program: Program, fetch_names=(), **_):
+    """Remove no-op scales (scale=1, bias=0) and fold consecutive scale
+    ops into one (ref: the reference's constant-fold/identity cleanups in
+    framework/ir; AMP + grad-scale insertion produce these chains)."""
+    fetch = set(fetch_names)
+    for block in program.blocks:
+        # fold scale(scale(x)) chains
+        uses = _use_counts(block, keep_names=fetch_names)
+        drop = set()
+        for i, op in enumerate(block.ops):
+            if op.type != "scale" or i in drop:
+                continue
+            if op.attrs.get("bias", 0.0) != 0.0:
+                continue
+            hit = _single_use_chain(block, i, uses, ("scale",))
+            if hit is None:
+                continue
+            j, nxt = hit
+            # s2·(s1·x)+b2 folds to (s1·s2)·x+b2 only when nxt applies
+            # its bias AFTER scaling; bias_after_scale=False computes
+            # (x+b2)·s2 and the fold would move the bias inside
+            if nxt.attrs.get("bias_after_scale", True) is False and \
+                    float(nxt.attrs.get("bias", 0.0)) != 0.0:
+                continue
+            if nxt.output_names()[0] in fetch and \
+                    op.output_names()[0] in fetch:
+                continue
+            nxt.attrs["scale"] = float(nxt.attrs.get("scale", 1.0)) * \
+                float(op.attrs.get("scale", 1.0))
+            nxt.inputs = {"X": list(op.inputs["X"])}
+            drop.add(i)
+        block.ops[:] = [op for k, op in enumerate(block.ops)
+                        if k not in drop]
+        # rewrite identity scales to pass-through by aliasing consumers
+        drop = set()
+        for i, op in enumerate(block.ops):
+            if op.type != "scale":
+                continue
+            if float(op.attrs.get("scale", 1.0)) != 1.0 or \
+                    float(op.attrs.get("bias", 0.0)) != 0.0 or \
+                    op.attrs.get("bias_after_scale", True) is False:
+                continue
+            src = op.inputs["X"][0]
+            dst = op.output_names()[0]
+            if dst in fetch or _consumed_in_subblock(block, dst):
+                continue  # must stay produced (fetch / sub-block closure)
+            for later in block.ops[i + 1:]:
+                later.inputs = {k: [src if n == dst else n for n in v]
+                                for k, v in later.inputs.items()}
+            drop.add(i)
+        block.ops[:] = [op for k, op in enumerate(block.ops)
+                        if k not in drop]
+
+
+@register_pass("cast_elimination")
+def cast_elimination(program: Program, fetch_names=(), **_):
+    """Drop casts whose target dtype equals the source var's dtype (AMP
+    decoration inserts these at boundary ops; ref: the reference prunes
+    them in fuse-pass cleanups)."""
+    fetch = set(fetch_names)
+    for block in program.blocks:
+        drop = set()
+        for i, op in enumerate(block.ops):
+            if op.type != "cast":
+                continue
+            src = op.inputs.get("X", [None])[0]
+            dst = op.output_names()[0]
+            v = block._find_var_recursive(src)
+            if v is None or dst in fetch or \
+                    _consumed_in_subblock(block, dst):
+                continue
+            if str(v.dtype) != str(op.attrs.get("out_dtype", "")):
+                continue
+            for later in block.ops[i + 1:]:
+                later.inputs = {k: [src if n == dst else n for n in vs]
+                                for k, vs in later.inputs.items()}
+            drop.add(i)
+        block.ops[:] = [op for k, op in enumerate(block.ops)
+                        if k not in drop]
+
+
+@register_pass("transpose_matmul_fold")
+def transpose_matmul_fold(program: Program, fetch_names=(), **_):
+    """transpose2(last two dims) feeding a matmul operand folds into the
+    matmul's transpose_X/transpose_Y attr (ref:
+    framework/ir/ ...transpose_flatten_concat / map_matmul passes)."""
+    for block in program.blocks:
+        uses = _use_counts(block, keep_names=fetch_names)
+        drop = set()
+        for i, op in enumerate(block.ops):
+            if op.type != "transpose2" or i in drop:
+                continue
+            perm = list(op.attrs.get("axis", ()))
+            nd = len(perm)
+            if nd < 2 or perm[:-2] != list(range(nd - 2)) or \
+                    perm[-2:] != [nd - 1, nd - 2]:
+                continue   # only a last-two-dims swap folds into matmul
+            out = op.outputs.get("Out", [None])[0]
+            if uses.get(out, 0) != 1:
+                continue
+            hit = _single_use_chain(block, i, uses,
+                                    ("matmul", "matmul_v2"), out_name=out)
+            if hit is None:
+                continue
+            j, mm = hit
+            # matmul uses transpose_X/Y; matmul_v2 uses trans_x/y
+            tx, ty = ("transpose_X", "transpose_Y") \
+                if mm.type == "matmul" else ("trans_x", "trans_y")
+            src = op.inputs["X"][0]
+            if mm.inputs.get("X", [None])[0] == out:
+                if mm.attrs.get(tx, False):
+                    continue
+                mm.attrs[tx] = True
+                mm.inputs["X"] = [src]
+            elif mm.inputs.get("Y", [None])[0] == out:
+                if mm.attrs.get(ty, False):
+                    continue
+                mm.attrs[ty] = True
+                mm.inputs["Y"] = [src]
+            else:
+                continue
+            drop.add(i)
+        block.ops[:] = [op for k, op in enumerate(block.ops)
+                        if k not in drop]
+
+
+@register_pass("fuse_add_layernorm")
+def fuse_add_layernorm(program: Program, fetch_names=(), **_):
+    """elementwise_add (residual) → layer_norm  ⇒  fused_add_layernorm,
+    which routes onto the one-pass Pallas add+LN kernel (ref pattern:
+    operators/fused/fused_layernorm_residual_dropout_bias.h — the
+    transformer post-block residual+LN the reference hand-fuses)."""
+    for block in program.blocks:
+        uses = _use_counts(block, keep_names=fetch_names)
+        drop = set()
+        for i, op in enumerate(block.ops):
+            if op.type != "elementwise_add" or i in drop:
+                continue
+            if op.attrs.get("axis", -1) not in (-1, 0):
+                continue
+            hit = _single_use_chain(block, i, uses, ("layer_norm",))
+            if hit is None:
+                continue
+            j, ln = hit
+            # fused kernel produces Y only — Mean/Variance consumers
+            # would silently read zeros
+            aux = [n for slot in ("Mean", "Variance")
+                   for n in ln.outputs.get(slot, ())]
+            if any(uses.get(n, 0) > 0 for n in aux) or \
+                    any(n in set(fetch_names) for n in aux):
+                continue
+            a = op.inputs.get("X", [None])[0]
+            b = op.inputs.get("Y", [None])[0]
+            av = block._find_var_recursive(a)
+            bv = block._find_var_recursive(b)
+            if av is None or bv is None or \
+                    tuple(av.shape) != tuple(bv.shape):
+                continue  # residual adds are same-shape; skip broadcasts
+            ln.type = "fused_add_layernorm"
+            ln.inputs = dict(ln.inputs)
+            ln.inputs["X"] = [a]
+            ln.inputs["Residual"] = [b]
+            drop.add(i)
         block.ops[:] = [op for k, op in enumerate(block.ops)
                         if k not in drop]
 

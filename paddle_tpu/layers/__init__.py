@@ -23,6 +23,7 @@ from ..lr_scheduler import (noam_decay, exponential_decay,  # noqa: F401
 
 from .detection import *        # noqa: F401,F403
 from .breadth import *          # noqa: F401,F403
+from .breadth2 import *         # noqa: F401,F403
 
 # submodule aliases mirroring fluid.layers.* module layout
 from .sequence_lod import *      # noqa: F401,F403

@@ -1,0 +1,468 @@
+"""Breadth sweep layers, part 2 (ref: corresponding fns in
+python/paddle/fluid/layers/{nn,tensor,io,control_flow,detection}.py).
+
+Includes the build-time TensorArray (create_array/array_write/array_read
+— the LoDTensorArray analog with STATIC indices; dynamic time-step
+arrays are what ``layers.rnn``/``lax.scan`` are for and a dynamic index
+here raises with that pointer) and ``py_func`` via jax.pure_callback.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from ..framework.core import Variable
+from ..framework.layer_helper import LayerHelper, ParamAttr
+from .breadth import _simple
+from .math_ops import _to_variable
+
+__all__ = [
+    "add_position_encoding", "autoincreased_step_counter",
+    "continuous_value_model", "conv3d", "cross_entropy2", "fsp_matrix",
+    "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
+    "hash", "hsigmoid", "image_resize_short", "is_empty", "logical_xor",
+    "pool3d", "range", "rank", "size", "row_conv",
+    "sampled_softmax_with_cross_entropy", "py_func", "select_input",
+    "get_places", "create_tensor", "create_global_var",
+    "create_parameter", "create_array", "array_write", "array_read",
+    "array_length", "tensor_array_to_tensor", "max_sequence_len",
+    "lod_reset", "lod_append", "merge_selected_rows",
+    "get_tensor_from_selected_rows", "box_decoder_and_assign",
+    "auc",
+]
+
+from .metric_op import auc  # noqa: F401  (existed unexported)
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    return _simple("add_position_encoding", X=input,
+                   attrs={"alpha": alpha, "beta": beta}, name=name)
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """ref: layers/tensor.py autoincreased_step_counter — persistable
+    counter incremented once per executed step."""
+    helper = LayerHelper("step_counter")
+    name = counter_name or "@STEP_COUNTER@"
+    block = helper.main_program.global_block()
+    v = block.vars.get(name)
+    if v is None:
+        v = block.create_var(name=name, shape=(1,), dtype="int64",
+                             persistable=True)
+        sb = helper.startup_program.global_block()
+        sv = sb.create_var(name=name, shape=(1,), dtype="int64",
+                           persistable=True)
+        sb.append_op(type="fill_constant", outputs={"Out": [sv]},
+                     attrs={"shape": [1], "dtype": "int64",
+                            "value": float(begin - step)})
+        sv.persistable = True
+    helper.append_op(type="increment", inputs={"X": [v]},
+                     outputs={"Out": [v]}, attrs={"step": float(step)})
+    return v
+
+
+def continuous_value_model(input, cvm, use_cvm=True, name=None):
+    d = int(input.shape[-1])
+    out_d = d if use_cvm else d - 2
+    return _simple("continuous_value_model", out_slot="Y",
+                   out_shape=(input.shape[0], out_d), X=input, CVM=cvm,
+                   attrs={"use_cvm": use_cvm}, name=name)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None):
+    helper = LayerHelper("conv3d", name=name)
+    cin = int(input.shape[1])
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 3
+    st = stride if isinstance(stride, (list, tuple)) else [stride] * 3
+    pd = padding if isinstance(padding, (list, tuple)) else [padding] * 3
+    dl = dilation if isinstance(dilation, (list, tuple)) \
+        else [dilation] * 3
+    w = helper.create_parameter(
+        param_attr, [num_filters, cin // groups] + list(k), input.dtype)
+    out_sp = [(int(s) + 2 * p - ((kk - 1) * dd + 1)) // stt + 1
+              for s, stt, p, kk, dd in zip(input.shape[2:], st, pd, k, dl)]
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (input.shape[0], num_filters, *out_sp))
+    helper.append_op(type="conv3d",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": list(st), "paddings": list(pd),
+                            "dilations": list(dl), "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        from .math_ops import elementwise_add
+        out = elementwise_add(out, b, axis=1)
+    return helper.append_activation(out, act)
+
+
+def cross_entropy2(input, label, ignore_index=-100, name=None):
+    helper = LayerHelper("cross_entropy2", name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (input.shape[0], 1))
+    xshape = helper.create_variable_for_type_inference(input.dtype,
+                                                       input.shape)
+    match = helper.create_variable_for_type_inference(
+        input.dtype, (input.shape[0], 1))
+    helper.append_op(type="cross_entropy2",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out], "XShape": [xshape],
+                              "MatchX": [match]},
+                     attrs={"ignore_index": ignore_index})
+    return out
+
+
+def fsp_matrix(x, y, name=None):
+    return _simple("fsp_matrix",
+                   out_shape=(x.shape[0], x.shape[1], y.shape[1]), X=x,
+                   Y=y, name=name)
+
+
+def uniform_random_batch_size_like(input, shape, input_dim_idx=0,
+                                   output_dim_idx=0, min=-1.0, max=1.0,
+                                   seed=0, dtype="float32", name=None):
+    s = list(shape)
+    s[output_dim_idx] = int(input.shape[input_dim_idx])
+    return _simple("uniform_random_batch_size_like", out_shape=tuple(s),
+                   out_dtype=dtype, Input=input,
+                   attrs={"shape": list(shape),
+                          "input_dim_idx": input_dim_idx,
+                          "output_dim_idx": output_dim_idx, "min": min,
+                          "max": max, "seed": seed}, name=name)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32", name=None):
+    s = list(shape)
+    s[output_dim_idx] = int(input.shape[input_dim_idx])
+    return _simple("gaussian_random_batch_size_like", out_shape=tuple(s),
+                   out_dtype=dtype, Input=input,
+                   attrs={"shape": list(shape),
+                          "input_dim_idx": input_dim_idx,
+                          "output_dim_idx": output_dim_idx, "mean": mean,
+                          "std": std, "seed": seed}, name=name)
+
+
+def hash(input, hash_size, num_hash=1, name=None):  # noqa: A001
+    return _simple("hash",
+                   out_shape=tuple(input.shape[:-1]) +
+                   (num_hash, input.shape[-1]),
+                   out_dtype="int64", X=input,
+                   attrs={"num_hash": num_hash, "mod_by": hash_size},
+                   name=name)
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    helper = LayerHelper("hsigmoid", name=name)
+    d = int(input.shape[-1])
+    # ref param shapes: default tree has num_classes-1 internal nodes;
+    # custom trees pass num_classes = number of non-leaf nodes directly
+    num_nodes = num_classes if is_custom else num_classes - 1
+    w = helper.create_parameter(param_attr, [num_nodes, d], input.dtype)
+    inputs = {"X": [input], "Label": [label], "W": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_nodes], input.dtype,
+                                    is_bias=True)
+        inputs["Bias"] = [b]
+    if path_table is not None:
+        inputs["PathTable"] = [path_table]
+        inputs["PathCode"] = [path_code]
+    import math as _m
+    L = int(path_table.shape[-1]) if path_table is not None else \
+        max(1, int(_m.ceil(_m.log2(max(num_classes, 2)))) + 1)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (input.shape[0], 1))
+    pre = helper.create_variable_for_type_inference(
+        input.dtype, (input.shape[0], L))
+    helper.append_op(type="hsigmoid", inputs=inputs,
+                     outputs={"Out": [out], "PreOut": [pre]},
+                     attrs={"num_classes": num_classes})
+    return out
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    from .breadth import image_resize
+    n, c, h, w = input.shape
+    h, w = int(h), int(w)
+    short, is_h = (h, True) if h < w else (w, False)
+    scale = out_short_len / short
+    out_shape = [out_short_len, int(w * scale)] if is_h else \
+        [int(h * scale), out_short_len]
+    return image_resize(input, out_shape=out_shape, resample=resample)
+
+
+def is_empty(x, name=None):
+    return _simple("is_empty", out_shape=(), out_dtype="bool", X=x,
+                   name=name)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _simple("logical_xor", out_dtype="bool", X=x, Y=y, name=name)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           name=None):
+    k = pool_size if isinstance(pool_size, (list, tuple)) \
+        else [pool_size] * 3
+    st = pool_stride if isinstance(pool_stride, (list, tuple)) \
+        else [pool_stride] * 3
+    pd = pool_padding if isinstance(pool_padding, (list, tuple)) \
+        else [pool_padding] * 3
+    n, c = input.shape[:2]
+    if global_pooling:
+        out_sp = [1, 1, 1]
+    else:
+        out_sp = [(int(s) + 2 * p - kk) // stt + 1
+                  for s, stt, p, kk in zip(input.shape[2:], st, pd, k)]
+    return _simple("pool3d", out_shape=(n, c, *out_sp), X=input,
+                   attrs={"ksize": list(k), "pooling_type": pool_type,
+                          "strides": list(st), "paddings": list(pd),
+                          "global_pooling": global_pooling}, name=name)
+
+
+def range(start, end, step, dtype="float32", name=None):  # noqa: A001
+    import math as _m
+    n = max(0, int(_m.ceil((end - start) / step)))
+    return _simple("range", out_shape=(n,), out_dtype=dtype,
+                   attrs={"start": float(start), "end": float(end),
+                          "step": float(step), "dtype": dtype}, name=name)
+
+
+def rank(input, name=None):
+    return _to_variable(np.asarray(len(input.shape), np.int32))
+
+
+def size(input, name=None):
+    n = 1
+    for s in input.shape:
+        n *= int(s)
+    return _to_variable(np.asarray(n, np.int64))
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    helper = LayerHelper("row_conv", name=name)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(param_attr,
+                                [future_context_size + 1, d], input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    input.shape)
+    helper.append_op(type="row_conv",
+                     inputs={"X": [input], "Filter": [w]},
+                     outputs={"Out": [out]}, attrs={})
+    return helper.append_activation(out, act)
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, seed=0, name=None):
+    helper = LayerHelper("sampled_softmax_with_cross_entropy", name=name)
+    b = logits.shape[0]
+    loss = helper.create_variable_for_type_inference(logits.dtype, (b, 1))
+    samples = helper.create_variable_for_type_inference(
+        "int64", (b, num_samples + num_true))
+    slog = helper.create_variable_for_type_inference(
+        logits.dtype, (b, num_samples + num_true))
+    helper.append_op(type="sampled_softmax_with_cross_entropy",
+                     inputs={"Logits": [logits], "Label": [label]},
+                     outputs={"Loss": [loss], "Samples": [samples],
+                              "SampledLogits": [slog]},
+                     attrs={"num_samples": num_samples})
+    return loss
+
+
+# -- py_func ---------------------------------------------------------------
+
+_PYFUNC_REGISTRY = {}
+_pyfunc_ids = itertools.count()
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None,
+            name=None):
+    """ref: layers/nn.py py_func — host-python inside the graph, lowered
+    to jax.pure_callback (func must be PURE; the compiled step may elide
+    or reorder calls).  ``out`` declares the result Variables (shape/
+    dtype contract for the callback).  backward_func is not supported —
+    py_func outputs are non-differentiable here (stop-gradient), the
+    documented TPU contract."""
+    if backward_func is not None:
+        raise NotImplementedError(
+            "py_func backward_func is unsupported on the XLA path — "
+            "py_func outputs are stop-gradients")
+    helper = LayerHelper("py_func", name=name)
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    fid = next(_pyfunc_ids)
+    _PYFUNC_REGISTRY[fid] = (
+        func, [(tuple(int(s) for s in o.shape), o.dtype) for o in outs])
+    helper.append_op(type="py_func", inputs={"X": list(xs)},
+                     outputs={"Out": list(outs)}, attrs={"func_id": fid})
+    return outs if isinstance(out, (list, tuple)) else outs[0]
+
+
+def select_input(inputs, mask, name=None):
+    return _simple("select_input", out_shape=inputs[0].shape,
+                   X=list(inputs), Mask=mask, name=name)
+
+
+def get_places(device_count=None, device_type=None):
+    """ref: layers/device.py get_places."""
+    from ..framework.core import TPUPlace, CPUPlace
+    import jax
+    n = device_count or jax.device_count()
+    cls = CPUPlace if (device_type == "CPU"
+                       or jax.default_backend() == "cpu") else TPUPlace
+    try:
+        return [cls(i) for i in __import__("builtins").range(n)]
+    except TypeError:
+        return [cls() for _ in __import__("builtins").range(n)]
+
+
+# -- tensors / globals ------------------------------------------------------
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.block.create_var(
+        name=name or helper.name, dtype=dtype, shape=(),
+        persistable=persistable)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper("global_var", name=name)
+    block = helper.main_program.global_block()
+    v = block.create_var(name=name or helper.name, shape=tuple(shape),
+                         dtype=dtype, persistable=persistable)
+    sb = helper.startup_program.global_block()
+    sv = sb.create_var(name=v.name, shape=tuple(shape), dtype=dtype,
+                       persistable=persistable)
+    sb.append_op(type="fill_constant", outputs={"Out": [sv]},
+                 attrs={"shape": list(shape), "dtype": dtype,
+                        "value": float(value)})
+    return v
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    helper = LayerHelper("create_parameter", name=name)
+    attr = attr or ParamAttr(name=name)
+    return helper.create_parameter(attr, list(shape), dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+# -- build-time TensorArray (LoDTensorArray analog) -------------------------
+
+class _StaticTensorArray:
+    """Static-index TensorArray: a Python list of Variables recorded at
+    build time.  Matches the reference API shape for the common
+    build-loop usage; a traced (dynamic) index raises — use layers.rnn /
+    lax.scan for dynamic time loops (the TPU-native form)."""
+
+    def __init__(self):
+        self.vars = []
+
+    def _static_i(self, i):
+        if isinstance(i, Variable):
+            raise NotImplementedError(
+                "TensorArray with a traced index inside jit cannot keep "
+                "static shapes — use layers.rnn()/lax.scan for dynamic "
+                "time-step loops")
+        return int(i)
+
+
+def create_array(dtype="float32"):
+    return _StaticTensorArray()
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = _StaticTensorArray()
+    i = array._static_i(i)
+    if i == len(array.vars):
+        array.vars.append(x)
+    else:
+        array.vars[i] = x
+    return array
+
+
+def array_read(array, i):
+    return array.vars[array._static_i(i)]
+
+
+def array_length(array):
+    return _to_variable(np.asarray(len(array.vars), np.int64))
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    from .tensor_ops import concat, stack
+    if use_stack:
+        out = stack(input.vars, axis=axis)
+    else:
+        out = concat(input.vars, axis=axis)
+    return out, array_length(input)
+
+
+# -- LoD-compat shims -------------------------------------------------------
+
+def lod_reset(x, y=None, target_lod=None):
+    """Dense-representation shim: sequence structure lives in explicit
+    Length vectors, not attached LoD; resetting LoD is therefore the
+    identity on data (callers pass the new Length alongside)."""
+    return x
+
+
+def lod_append(x, level):
+    return x
+
+
+def max_sequence_len(rank_table, name=None):
+    return _simple("max_sequence_len", out_shape=(), out_dtype="int64",
+                   RankTable=rank_table, name=name)
+
+
+# -- SelectedRows host helpers ---------------------------------------------
+
+def merge_selected_rows(x, name=None):
+    """Host-side: SelectedRows values live as
+    framework.selected_rows.SelectedRows; merge duplicates."""
+    from ..framework.selected_rows import SelectedRows
+    if isinstance(x, SelectedRows):
+        return x.merge_add()
+    raise TypeError("merge_selected_rows expects a SelectedRows value")
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    from ..framework.selected_rows import SelectedRows
+    if isinstance(x, SelectedRows):
+        return x.to_dense()
+    raise TypeError(
+        "get_tensor_from_selected_rows expects a SelectedRows value")
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip, name=None):
+    helper = LayerHelper("box_decoder_and_assign", name=name)
+    n = prior_box.shape[0]
+    c4 = int(target_box.shape[-1])
+    dec = helper.create_variable_for_type_inference(
+        target_box.dtype, (n, c4))
+    assigned = helper.create_variable_for_type_inference(
+        target_box.dtype, (n, 4))
+    helper.append_op(type="box_decoder_and_assign",
+                     inputs={"PriorBox": [prior_box],
+                             "PriorBoxVar": [prior_box_var],
+                             "TargetBox": [target_box],
+                             "BoxScore": [box_score]},
+                     outputs={"DecodeBox": [dec],
+                              "OutputAssignBox": [assigned]},
+                     attrs={"box_clip": box_clip})
+    return dec, assigned

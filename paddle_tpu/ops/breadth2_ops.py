@@ -1,0 +1,264 @@
+"""Breadth sweep, part 2: position encoding, counters, CTR ops, hashing,
+hierarchical sigmoid, sampled softmax, host-callback (py_func), misc
+(ref files named per op)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register, x
+
+
+@register("add_position_encoding")
+def _add_position_encoding(ctx, ins, attrs):
+    """ref: operators/add_position_encoding_op.h — sinusoidal PE scaled
+    into the input: out = alpha·x + beta·pe."""
+    a = x(ins, "X")                  # [B, T, D]
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    b, t, d = a.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    i = jnp.arange(d, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2 * (i // 2) / d)
+    pe = jnp.where((jnp.arange(d) % 2) == 0, jnp.sin(angle),
+                   jnp.cos(angle))
+    return {"Out": alpha * a + beta * pe[None].astype(a.dtype)}
+
+
+@register("continuous_value_model")
+def _cvm(ctx, ins, attrs):
+    """ref: operators/cvm_op.h — CTR show/click statistics prepended to
+    each embedding; use_cvm=False strips the two stat columns."""
+    a = x(ins, "X")                  # [B, D] with cols 0,1 = show, click
+    cvm = x(ins, "CVM")              # [B, 2]
+    if attrs.get("use_cvm", True):
+        show = jnp.log(cvm[:, 0:1] + 1.0)
+        click = jnp.log(cvm[:, 1:2] + 1.0) - show
+        return {"Y": jnp.concatenate([show, click, a[:, 2:]], axis=1)}
+    return {"Y": a[:, 2:]}
+
+
+@register("fsp_matrix")
+def _fsp_matrix(ctx, ins, attrs):
+    """ref: operators/fsp_op.h — flow-of-solution-procedure matrix
+    (distillation): channel-wise Gram between two feature maps."""
+    a, b = x(ins, "X"), x(ins, "Y")  # [N, C1, H, W], [N, C2, H, W]
+    n, c1, h, w = a.shape
+    c2 = b.shape[1]
+    af = a.reshape(n, c1, h * w)
+    bf = b.reshape(n, c2, h * w)
+    return {"Out": jnp.einsum("nik,njk->nij", af, bf) / (h * w)}
+
+
+def _bsl_shape(a, attrs):
+    """batch_size_like contract: copy the batch dim from Input's
+    input_dim_idx into the output's output_dim_idx."""
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        a.shape[attrs.get("input_dim_idx", 0)]
+    return tuple(shape)
+
+
+@register("uniform_random_batch_size_like")
+def _uniform_bsl(ctx, ins, attrs):
+    a = x(ins, "Input")
+    key = ctx.next_key()
+    out = jax.random.uniform(key, _bsl_shape(a, attrs),
+                             minval=attrs.get("min", -1.0),
+                             maxval=attrs.get("max", 1.0))
+    return {"Out": out}
+
+
+@register("gaussian_random_batch_size_like")
+def _gaussian_bsl(ctx, ins, attrs):
+    a = x(ins, "Input")
+    key = ctx.next_key()
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * \
+        jax.random.normal(key, _bsl_shape(a, attrs))
+    return {"Out": out}
+
+
+@register("hash")
+def _hash(ctx, ins, attrs):
+    """ref: operators/hash_op.h (xxHash mod space).  A splittable integer
+    mix (SplitMix64 finalizer) replaces xxHash — same contract: a
+    deterministic spread of ids into `mod_by` buckets, num_hash probes."""
+    a = x(ins, "X").astype(jnp.uint32)
+    num_hash = int(attrs.get("num_hash", 1))
+    mod_by = int(attrs.get("mod_by", 1))
+
+    def mix(v, seed):
+        v = (v ^ (v >> 16)) * jnp.uint32(0x85ebca6b)
+        v = (v ^ (v >> 13)) * jnp.uint32(0xc2b2ae35 + seed)
+        return v ^ (v >> 16)
+
+    outs = [mix(a, 0x9e37 * (i + 1)).astype(jnp.int64) % mod_by
+            for i in range(num_hash)]
+    return {"Out": jnp.stack(outs, axis=-2)}   # [..., num_hash, last]
+
+
+@register("is_empty")
+def _is_empty(ctx, ins, attrs):
+    a = x(ins, "X")
+    return {"Out": jnp.asarray(a.size == 0)}
+
+
+@register("hsigmoid")
+def _hsigmoid(ctx, ins, attrs):
+    """ref: operators/hierarchical_sigmoid_op.h — sum over the label's
+    root-to-leaf path of BCE(wᵀx + b, branch bit).
+
+    Default tree: perfect binary tree over the label id's bits (our
+    numbering — the factorisation semantics match the reference; exact
+    node numbering parity requires the custom PathTable/PathCode inputs,
+    which ARE supported and take precedence)."""
+    feat = x(ins, "X")               # [B, D]
+    label = x(ins, "Label").reshape(-1)          # [B]
+    w = x(ins, "W")                  # [num_nodes, D]
+    bias = x(ins, "Bias")
+    path_table = x(ins, "PathTable")             # [B, L] node ids or -1
+    path_code = x(ins, "PathCode")               # [B, L] bits or -1
+    c = int(attrs["num_classes"])
+    if path_table is None:
+        # default complete binary tree in heap numbering: nodes 0..2C-2,
+        # internal 0..C-2, leaf for class k = C-1+k; walk leaf→root.
+        # Exactly C-1 internal nodes → W rows match the reference's
+        # [num_classes - 1, D] parameter shape.
+        L = max(1, int(math.ceil(math.log2(max(c, 2)))) + 1)
+        node = label.astype(jnp.int32) + (c - 1)
+        tables, codes = [], []
+        for _ in range(L):
+            parent = (node - 1) // 2
+            bit = (node % 2 == 0).astype(jnp.int32)  # right child
+            alive = node > 0
+            tables.append(jnp.where(alive, parent, -1))
+            codes.append(jnp.where(alive, bit, -1))
+            node = jnp.maximum(parent, 0)
+        path_table = jnp.stack(tables, 1)        # [B, L]
+        path_code = jnp.stack(codes, 1)
+    valid = path_table >= 0
+    node = jnp.maximum(path_table, 0).astype(jnp.int32)
+    wn = w[node]                                  # [B, L, D]
+    logit = jnp.einsum("bld,bd->bl", wn, feat)
+    if bias is not None:
+        logit = logit + bias.reshape(-1)[node]
+    bit = path_code.astype(logit.dtype)
+    bce = jnp.maximum(logit, 0) - logit * bit + \
+        jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    loss = jnp.sum(jnp.where(valid, bce, 0.0), axis=1, keepdims=True)
+    return {"Out": loss, "PreOut": logit}
+
+
+@register("sampled_softmax_with_cross_entropy")
+def _sampled_softmax_ce(ctx, ins, attrs):
+    """ref: operators/sampled_softmax_with_cross_entropy_op.h — softmax
+    CE over {true class} ∪ {S uniform samples} with logQ correction;
+    accidental hits of the true class are masked out."""
+    logits = x(ins, "Logits")        # [B, C]
+    label = x(ins, "Label").reshape(-1)          # [B]
+    s = int(attrs.get("num_samples", 5))
+    c = logits.shape[1]
+    key = ctx.next_key()
+    samples = jax.random.randint(key, (logits.shape[0], s), 0, c)
+    lab32 = label.astype(jnp.int32)[:, None]
+    true_logit = jnp.take_along_axis(logits, lab32, 1)      # [B, 1]
+    samp_logit = jnp.take_along_axis(logits, samples, 1)    # [B, S]
+    # logQ correction (uniform q = 1/C cancels between terms but kept for
+    # parity with non-uniform samplers); mask accidental true hits
+    hit = samples == lab32
+    samp_logit = jnp.where(hit, -1e30, samp_logit)
+    all_logits = jnp.concatenate([true_logit, samp_logit], 1)
+    logp = jax.nn.log_softmax(all_logits, axis=-1)
+    return {"Loss": -logp[:, :1],
+            "Samples": jnp.concatenate([lab32, samples], 1),
+            "SampledLogits": all_logits}
+
+
+@register("py_func")
+def _py_func(ctx, ins, attrs):
+    """ref: operators/py_func_op.cc — host-python callback inside the
+    graph.  TPU-natively this is jax.pure_callback: the host fn runs on
+    CPU per execution, the result is shipped back to the device; the fn
+    must be pure (the compiled step may elide or reorder calls)."""
+    from ..layers.breadth2 import _PYFUNC_REGISTRY
+    fid = attrs["func_id"]
+    fn, out_specs = _PYFUNC_REGISTRY[fid]
+    xs = ins.get("X", [])
+    result_shapes = [jax.ShapeDtypeStruct(tuple(sh), np.dtype(dt))
+                     for sh, dt in out_specs]
+
+    def host(*arrays):
+        out = fn(*arrays)
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return [np.asarray(o, dtype=rs.dtype).reshape(rs.shape)
+                for o, rs in zip(out, result_shapes)]
+
+    outs = jax.pure_callback(host, result_shapes, *xs)
+    return {"Out": list(outs)}
+
+
+@register("max_sequence_len")
+def _max_sequence_len(ctx, ins, attrs):
+    lens = x(ins, "RankTable")
+    return {"Out": jnp.max(lens).astype(jnp.int64)}
+
+
+@register("select_input")
+def _select_input(ctx, ins, attrs):
+    """ref: operators/select_input_op.cc — route one of N inputs by a
+    scalar mask (static shapes → lax.switch semantics via stack+take)."""
+    xs = ins.get("X", [])
+    mask = x(ins, "Mask").reshape(()).astype(jnp.int32)
+    stacked = jnp.stack(xs, 0)
+    return {"Out": jnp.take(stacked, mask, axis=0)}
+
+
+@register("select_output")
+def _select_output(ctx, ins, attrs):
+    """ref: select_output_op.cc — inverse of select_input: write X to the
+    mask-selected output, zeros elsewhere (dense static form)."""
+    a = x(ins, "X")
+    mask = x(ins, "Mask").reshape(()).astype(jnp.int32)
+    n = int(attrs.get("n_out", 2))
+    outs = [jnp.where(mask == i, a, jnp.zeros_like(a)) for i in range(n)]
+    return {"Out": outs}
+
+
+@register("box_decoder_and_assign")
+def _box_decoder_and_assign(ctx, ins, attrs):
+    """ref: operators/detection/box_decoder_and_assign_op.cc — decode
+    per-class box deltas against priors, then pick each ROI's best-score
+    class box."""
+    prior = x(ins, "PriorBox")           # [N, 4] (x1 y1 x2 y2)
+    pvar = x(ins, "PriorBoxVar")         # [N, 4] variances (or None → 1)
+    deltas = x(ins, "TargetBox")         # [N, 4*C]
+    scores = x(ins, "BoxScore")          # [N, C]
+    clip = attrs.get("box_clip", 4.135)
+    n = prior.shape[0]
+    c = scores.shape[1]
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    px = prior[:, 0] + 0.5 * pw
+    py = prior[:, 1] + 0.5 * ph
+    d = deltas.reshape(n, c, 4)
+    if pvar is not None:
+        # ref: box_decoder_and_assign_op.h multiplies each delta by its
+        # prior variance before decoding
+        d = d * pvar.reshape(n, 1, 4)
+    dx, dy, dw, dh = d[..., 0], d[..., 1], d[..., 2], d[..., 3]
+    gx = dx * pw[:, None] + px[:, None]
+    gy = dy * ph[:, None] + py[:, None]
+    gw = jnp.exp(jnp.minimum(dw, clip)) * pw[:, None]
+    gh = jnp.exp(jnp.minimum(dh, clip)) * ph[:, None]
+    boxes = jnp.stack([gx - 0.5 * gw, gy - 0.5 * gh,
+                       gx + 0.5 * gw - 1, gy + 0.5 * gh - 1], -1)
+    best = jnp.argmax(scores, axis=1)
+    assigned = jnp.take_along_axis(
+        boxes, best[:, None, None].repeat(4, -1), 1)[:, 0]
+    return {"DecodeBox": boxes.reshape(n, c * 4),
+            "OutputAssignBox": assigned}

@@ -53,6 +53,38 @@ def _fused_elemwise_activation(ctx, ins, attrs):
     return {"Out": _ACTS[unary](out)}
 
 
+@register("fused_add_layernorm")
+def _fused_add_layernorm(ctx, ins, attrs):
+    """Residual add + LayerNorm in one pass (emitted by the
+    fuse_add_layernorm pass; ref CUDA analog:
+    operators/fused/fused_layernorm_residual_dropout_bias.h).  Routes to
+    the Pallas add+LN kernel when shapes tile; falls back to the
+    composition (XLA fuses it anyway — the kernel saves the HBM round
+    trip of the sum)."""
+    a = x(ins, "X")
+    res = x(ins, "Residual")
+    scale, bias = x(ins, "Scale"), x(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    bna = attrs.get("begin_norm_axis", 1)
+    d = 1
+    for s in a.shape[bna:]:
+        d *= int(s)
+    r = int(a.size // d)
+    from ..flags import flag
+    if flag("use_pallas_fused") and scale is not None and bias is not None:
+        from .pallas.fused_ops import add_layer_norm, ln_supported
+        if ln_supported(r, d):
+            y = add_layer_norm(a.reshape(r, d), res.reshape(r, d),
+                               scale.reshape(d), bias.reshape(d),
+                               eps).reshape(a.shape)
+            zeros = jnp.zeros(a.shape[:bna], jnp.float32)
+            return {"Y": y, "Mean": zeros, "Variance": zeros}
+    from .registry import get_op
+    summed = a + res
+    return get_op("layer_norm")(ctx, {"X": [summed], "Scale": ins.get(
+        "Scale", []), "Bias": ins.get("Bias", [])}, attrs)
+
+
 @register("fused_bn_activation")
 def _fused_bn_activation(ctx, ins, attrs):
     """ref: operators/fused/fused_bn_activation_op.cu — batch_norm + act

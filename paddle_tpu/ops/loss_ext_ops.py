@@ -144,17 +144,3 @@ def _l1_loss(ctx, ins, attrs):
     return {"Out": jnp.abs(a - b)}
 
 
-@register("sampled_softmax_with_cross_entropy")
-def _sampled_softmax_ce(ctx, ins, attrs):
-    """ref: operators/sample_logits_op.h — uniform negative sampling of
-    the softmax denominator (deterministic per ctx key)."""
-    logits, label = x(ins, "Logits"), x(ins, "Label")
-    num_samples = attrs.get("num_samples", 5)
-    n, c = logits.shape
-    lab = label.reshape(-1).astype(jnp.int32)
-    neg = jax.random.randint(ctx.next_key(), (n, num_samples), 0, c)
-    pos_logit = jnp.take_along_axis(logits, lab[:, None], 1)
-    neg_logit = jnp.take_along_axis(logits, neg, 1)
-    all_logit = jnp.concatenate([pos_logit, neg_logit], 1)
-    logp = jax.nn.log_softmax(all_logit, -1)
-    return {"Loss": -logp[:, :1]}

@@ -146,6 +146,101 @@ layer_norm.defvjp(lambda x2, s, b, eps, interp: _ln_fwd(x2, s, b, eps,
 
 
 # ---------------------------------------------------------------------------
+# residual add + layer_norm (one pass; ref CUDA analog:
+# operators/fused/fused_layernorm_residual_dropout_bias.h)
+# ---------------------------------------------------------------------------
+
+
+def _aln_fwd_kernel(a_ref, b_ref, s_ref, bias_ref, y_ref, *, eps):
+    u = a_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    mu = jnp.mean(u, axis=-1, keepdims=True)
+    uc = u - mu
+    rstd = lax.rsqrt(jnp.mean(uc * uc, axis=-1, keepdims=True) + eps)
+    y = uc * rstd * s_ref[...].astype(jnp.float32) \
+        + bias_ref[...].astype(jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def _aln_bwd_kernel(a_ref, b_ref, s_ref, dy_ref, dx_ref, ds_ref, db_ref,
+                    *, eps, r_total):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        ds_ref[...] = jnp.zeros_like(ds_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    valid = _row_mask(i, r_total, a_ref.shape[0])
+    u = jnp.where(valid, a_ref[...].astype(jnp.float32)
+                  + b_ref[...].astype(jnp.float32), 0.0)
+    dy = jnp.where(valid, dy_ref[...].astype(jnp.float32), 0.0)
+    mu = jnp.mean(u, axis=-1, keepdims=True)
+    uc = u - mu
+    rstd = lax.rsqrt(jnp.mean(uc * uc, axis=-1, keepdims=True) + eps)
+    uhat = uc * rstd
+    s = s_ref[...].astype(jnp.float32)
+    dys = dy * s
+    m1 = jnp.mean(dys, axis=-1, keepdims=True)
+    m2 = jnp.mean(dys * uhat, axis=-1, keepdims=True)
+    # du is shared by BOTH addends (d/da = d/db)
+    dx_ref[...] = (rstd * (dys - m1 - uhat * m2)).astype(dx_ref.dtype)
+    ds_ref[...] += jnp.sum(dy * uhat, axis=0, keepdims=True).astype(
+        ds_ref.dtype)
+    db_ref[...] += jnp.sum(dy, axis=0, keepdims=True).astype(db_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def add_layer_norm(a2, b2, scale, bias, eps=1e-5, interpret=False):
+    """Fused LN(a2 + b2) over the last dim; a2/b2 [R, D], scale/bias [D].
+    The residual never materialises in HBM."""
+    y, _ = _aln_fwd(a2, b2, scale, bias, eps, interpret)
+    return y
+
+
+def _aln_fwd(a2, b2, scale, bias, eps, interpret):
+    r, d = a2.shape
+    y = pl.pallas_call(
+        functools.partial(_aln_fwd_kernel, eps=eps),
+        grid=(pl.cdiv(r, BLOCK_R),),
+        in_specs=[pl.BlockSpec((BLOCK_R, d), lambda i: (i, 0)),
+                  pl.BlockSpec((BLOCK_R, d), lambda i: (i, 0)),
+                  pl.BlockSpec((1, d), lambda i: (0, 0)),
+                  pl.BlockSpec((1, d), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((BLOCK_R, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((r, d), a2.dtype),
+        interpret=interpret,
+    )(a2, b2, scale.reshape(1, d), bias.reshape(1, d))
+    return y, (a2, b2, scale)
+
+
+def _aln_bwd(eps, interpret, res, dy):
+    a2, b2, scale = res
+    r, d = a2.shape
+    dx, ds, db = pl.pallas_call(
+        functools.partial(_aln_bwd_kernel, eps=eps, r_total=r),
+        grid=(pl.cdiv(r, BLOCK_R),),
+        in_specs=[pl.BlockSpec((BLOCK_R, d), lambda i: (i, 0)),
+                  pl.BlockSpec((BLOCK_R, d), lambda i: (i, 0)),
+                  pl.BlockSpec((1, d), lambda i: (0, 0)),
+                  pl.BlockSpec((BLOCK_R, d), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((BLOCK_R, d), lambda i: (i, 0)),
+                   pl.BlockSpec((1, d), lambda i: (0, 0)),
+                   pl.BlockSpec((1, d), lambda i: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((r, d), a2.dtype),
+                   jax.ShapeDtypeStruct((1, d), jnp.float32),
+                   jax.ShapeDtypeStruct((1, d), jnp.float32)],
+        interpret=interpret,
+    )(a2, b2, scale.reshape(1, d), dy)
+    return dx, dx, ds.reshape(d).astype(scale.dtype), \
+        db.reshape(d).astype(scale.dtype)
+
+
+add_layer_norm.defvjp(
+    lambda a2, b2, s, b, eps, interp: _aln_fwd(a2, b2, s, b, eps, interp),
+    _aln_bwd)
+
+
+# ---------------------------------------------------------------------------
 # bias + gelu
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,243 @@
+"""Breadth sweep part-2 tests (py_func host callback, hsigmoid, sampled
+softmax, TensorArray, CTR ops, misc)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import (Program, program_guard,
+                                       reset_default_programs)
+
+L = fluid.layers
+
+
+def _run(build, feed=None):
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        outs = build()
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        res = exe.run(main, feed=feed or {}, fetch_list=list(outs))
+    return [np.asarray(r) for r in res]
+
+
+def test_add_position_encoding_matches_sinusoid():
+    x = np.zeros((1, 4, 6), np.float32)
+
+    def build():
+        xv = L.data("x", shape=[4, 6])
+        return L.add_position_encoding(xv)
+
+    out, = _run(build, {"x": x})
+    pos = np.arange(4)[:, None]
+    i = np.arange(6)[None, :]
+    angle = pos / np.power(10000.0, 2 * (i // 2) / 6)
+    pe = np.where(np.arange(6) % 2 == 0, np.sin(angle), np.cos(angle))
+    np.testing.assert_allclose(out[0], pe, rtol=1e-5, atol=1e-6)
+
+
+def test_step_counter_increments_across_runs():
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        c = L.autoincreased_step_counter()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        vals = [int(np.asarray(exe.run(main, fetch_list=[c])[0]).reshape(()))
+                for _ in range(3)]
+    assert vals == [1, 2, 3], vals
+
+
+def test_cvm_and_cross_entropy2():
+    x = np.random.RandomState(0).rand(3, 6).astype(np.float32)
+    cvm = np.abs(np.random.RandomState(1).rand(3, 2).astype(np.float32))
+    p = np.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]], np.float32)
+    lab = np.array([[0], [1]], np.int64)
+
+    def build():
+        xv = L.data("x", shape=[6])
+        cv = L.data("cvm", shape=[2])
+        y = L.continuous_value_model(xv, cv)
+        pv = L.data("p", shape=[3])
+        lv = L.data("l", shape=[1], dtype="int64")
+        ce = L.cross_entropy2(pv, lv)
+        return y, ce
+
+    y, ce = _run(build, {"x": x, "cvm": cvm, "p": p, "l": lab})
+    np.testing.assert_allclose(y[:, 0], np.log(cvm[:, 0] + 1), rtol=1e-5)
+    np.testing.assert_allclose(
+        ce.reshape(-1), -np.log([0.7, 0.8]), rtol=1e-5)
+
+
+def test_fsp_and_hash_and_random_bsl():
+    a = np.random.RandomState(2).rand(2, 3, 4, 4).astype(np.float32)
+    b = np.random.RandomState(3).rand(2, 5, 4, 4).astype(np.float32)
+    ids = np.array([[7], [7], [13]], np.int64)
+
+    def build():
+        av = L.data("a", shape=[3, 4, 4])
+        bv = L.data("b", shape=[5, 4, 4])
+        f = L.fsp_matrix(av, bv)
+        iv = L.data("ids", shape=[1], dtype="int64")
+        h = L.hash(iv, hash_size=1000, num_hash=2)
+        u = L.uniform_random_batch_size_like(av, [8, 6], min=0.0, max=1.0)
+        return f, h, u
+
+    f, h, u = _run(build, {"a": a, "b": b, "ids": ids})
+    want = np.einsum("nik,njk->nij", a.reshape(2, 3, 16),
+                     b.reshape(2, 5, 16)) / 16
+    np.testing.assert_allclose(f, want, rtol=1e-4)
+    assert h.shape == (3, 2, 1)
+    assert (h >= 0).all() and (h < 1000).all()
+    np.testing.assert_array_equal(h[0], h[1])     # deterministic
+    assert (h[0] != h[2]).any()                   # spreads ids
+    assert u.shape == (2, 6)
+
+
+def test_hsigmoid_trains_and_beats_chance():
+    """Hierarchical sigmoid learns a 4-class toy problem."""
+    rng = np.random.RandomState(4)
+    C = 4
+    xs = rng.randn(64, 8).astype(np.float32)
+    ys = (np.abs(xs).argmax(1) % C).astype(np.int64).reshape(-1, 1)
+
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = L.data("x", shape=[8])
+        lv = L.data("l", shape=[1], dtype="int64")
+        h = L.fc(xv, 16, act="relu", bias_attr=False)
+        cost = L.hsigmoid(h, lv, num_classes=C)
+        loss = L.mean(cost)
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(25):
+            lv_, = exe.run(main, feed={"x": xs, "l": ys},
+                           fetch_list=[loss])
+            losses.append(float(np.asarray(lv_).reshape(())))
+    assert all(np.isfinite(losses))
+    # ln(4)=1.386 is the chance-level NLL for 4 classes
+    assert losses[-1] < 0.9, losses[-1]
+
+
+def test_sampled_softmax_trains():
+    rng = np.random.RandomState(5)
+    xs = rng.randn(32, 8).astype(np.float32)
+    ys = rng.randint(0, 50, (32, 1)).astype(np.int64)
+
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = L.data("x", shape=[8])
+        lv = L.data("l", shape=[1], dtype="int64")
+        logits = L.fc(xv, 50, bias_attr=False)
+        loss = L.mean(L.sampled_softmax_with_cross_entropy(
+            logits, lv, num_samples=8))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(10):
+            v, = exe.run(main, feed={"x": xs, "l": ys}, fetch_list=[loss])
+            losses.append(float(np.asarray(v).reshape(())))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_py_func_host_callback():
+    def host_fn(a):
+        return np.asarray(a) * 2.0 + 1.0
+
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = L.data("x", shape=[3])
+        out_var = main.global_block().create_var(
+            name="pyfunc_out", shape=(2, 3), dtype="float32")
+        res = L.py_func(host_fn, xv, out_var)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": x}, fetch_list=[res])
+    np.testing.assert_allclose(np.asarray(got), x * 2 + 1, rtol=1e-6)
+
+
+def test_tensor_array_static():
+    def build():
+        a = L.create_array()
+        x0 = L.assign_value(np.array([[1.0, 2.0]], np.float32))
+        x1 = L.assign_value(np.array([[3.0, 4.0]], np.float32))
+        L.array_write(x0, 0, a)
+        L.array_write(x1, 1, a)
+        back = L.array_read(a, 1)
+        stacked, n = L.tensor_array_to_tensor(a, axis=0, use_stack=True)
+        return back, stacked, n
+
+    back, stacked, n = _run(build)
+    np.testing.assert_allclose(back, [[3.0, 4.0]])
+    assert stacked.shape == (2, 1, 2)
+    assert n.reshape(()) == 2
+
+
+def test_select_input_and_misc():
+    def build():
+        a = L.assign_value(np.array([1.0, 1.0], np.float32))
+        b = L.assign_value(np.array([2.0, 2.0], np.float32))
+        m = L.assign_value(np.array([1], np.int64))
+        sel = L.select_input([a, b], m)
+        xor = L.logical_xor(L.assign_value(np.array([True, False])),
+                            L.assign_value(np.array([True, True])))
+        r = L.range(0, 5, 1, "int64")
+        return sel, xor, r
+
+    sel, xor, r = _run(build)
+    np.testing.assert_allclose(sel, [2.0, 2.0])
+    np.testing.assert_array_equal(xor, [False, True])
+    np.testing.assert_array_equal(r, np.arange(5))
+
+
+def test_conv3d_pool3d_row_conv_layers():
+    rng = np.random.RandomState(6)
+    x = rng.randn(1, 2, 4, 4, 4).astype(np.float32)
+    seq = rng.randn(2, 6, 3).astype(np.float32)
+
+    def build():
+        xv = L.data("x", shape=[2, 4, 4, 4])
+        c = L.conv3d(xv, 3, filter_size=3, padding=1, bias_attr=False)
+        p = L.pool3d(xv, pool_size=2, pool_type="avg", pool_stride=2)
+        sv = L.data("s", shape=[6, 3])
+        rc = L.row_conv(sv, future_context_size=2)
+        return c, p, rc
+
+    c, p, rc = _run(build, {"x": x, "s": seq})
+    assert c.shape == (1, 3, 4, 4, 4)
+    np.testing.assert_allclose(
+        p, x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7)),
+        rtol=1e-5)
+    assert rc.shape == (2, 6, 3)
+
+
+def test_create_global_var_and_parameter():
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        g = L.create_global_var([2, 2], 3.5, "float32", persistable=True)
+        w = L.create_parameter([3], "float32", attr=fluid.ParamAttr(
+            name="cp_w",
+            initializer=fluid.initializer.Constant(1.25)))
+        out = L.scale(g, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, wv = exe.run(main, fetch_list=[out, w])
+    np.testing.assert_allclose(np.asarray(o), np.full((2, 2), 7.0))
+    np.testing.assert_allclose(np.asarray(wv), [1.25] * 3)

@@ -1,0 +1,151 @@
+"""New optimization passes: identity/scale folds, cast elimination,
+transpose→matmul folding, residual add+LN fusion (ref:
+framework/ir fuse passes; the fused_add_layernorm analog is
+operators/fused/fused_layernorm_residual_dropout_bias.h)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import (Program, program_guard,
+                                       reset_default_programs)
+from paddle_tpu.framework.passes import apply_pass
+
+L = fluid.layers
+
+
+def _types(program):
+    return [op.type for op in program.global_block().ops]
+
+
+def _run_prog(main, startup, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return [np.asarray(v) for v in
+                exe.run(main, feed=feed, fetch_list=fetch)]
+
+
+def test_fold_identity_and_scale_chain():
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = L.data("x", shape=[4])
+        a = L.scale(x, scale=1.0)          # identity
+        b = L.scale(a, scale=2.0)
+        c = L.scale(b, scale=3.0)          # chain → one scale(6)
+        out = L.mean(c)
+    before = _types(main).count("scale")
+    apply_pass(main, "fold_identity_ops", fetch_names=[out.name])
+    after = _types(main).count("scale")
+    assert before == 3 and after == 1, (_types(main))
+    xb = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+    got, = _run_prog(main, startup, {"x": xb}, [out])
+    np.testing.assert_allclose(got, (xb * 6).mean(), rtol=1e-6)
+
+
+def test_cast_elimination_same_dtype():
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = L.data("x", shape=[4])
+        c = L.cast(x, "float32")           # no-op cast
+        out = L.mean(c)
+    assert "cast" in _types(main)
+    apply_pass(main, "cast_elimination", fetch_names=[out.name])
+    assert "cast" not in _types(main)
+    xb = np.random.RandomState(1).rand(2, 4).astype(np.float32)
+    got, = _run_prog(main, startup, {"x": xb}, [out])
+    np.testing.assert_allclose(got, xb.mean(), rtol=1e-6)
+
+
+def test_transpose_matmul_fold():
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        a = L.data("a", shape=[3, 4])
+        b = L.data("b", shape=[5, 4])
+        bt = L.transpose(b, perm=[0, 2, 1])
+        out = L.matmul(a, bt)
+    assert "transpose2" in _types(main)
+    apply_pass(main, "transpose_matmul_fold", fetch_names=[out.name])
+    types = _types(main)
+    assert "transpose2" not in types, types
+    mm = next(op for op in main.global_block().ops if op.type == "matmul")
+    assert mm.attrs.get("transpose_Y") is True
+    rng = np.random.RandomState(2)
+    av = rng.rand(2, 3, 4).astype(np.float32)
+    bv = rng.rand(2, 5, 4).astype(np.float32)
+    got, = _run_prog(main, startup, {"a": av, "b": bv}, [out])
+    np.testing.assert_allclose(got, av @ bv.transpose(0, 2, 1), rtol=1e-5)
+
+
+def test_fuse_add_layernorm_pass_and_numerics():
+    def build():
+        x = L.data("x", shape=[8])
+        r = L.data("r", shape=[8])
+        h = L.layer_norm(L.elementwise_add(x, r))
+        return L.mean(h), h
+
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        out, h = build()
+    rng = np.random.RandomState(3)
+    xb = rng.rand(4, 8).astype(np.float32)
+    rb = rng.rand(4, 8).astype(np.float32)
+    ref, = _run_prog(main, startup, {"x": xb, "r": rb}, [out])
+
+    apply_pass(main, "fuse_add_layernorm", fetch_names=[out.name])
+    types = _types(main)
+    assert "fused_add_layernorm" in types, types
+    assert "elementwise_add" not in types
+    got, = _run_prog(main, startup, {"x": xb, "r": rb}, [out])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fuse_add_layernorm_skips_consumed_mean():
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = L.data("x", shape=[8])
+        r = L.data("r", shape=[8])
+        s = L.elementwise_add(x, r)
+        block = main.global_block()
+        h = L.layer_norm(s)
+        # find the layer_norm op's Mean output and fetch it
+        ln_op = next(op for op in block.ops if op.type == "layer_norm")
+        mean_name = ln_op.outputs["Mean"][0]
+    apply_pass(main, "fuse_add_layernorm",
+               fetch_names=[h.name, mean_name])
+    assert "fused_add_layernorm" not in _types(main)
+
+
+def test_add_layer_norm_kernel_grads():
+    from paddle_tpu.ops.pallas import fused_ops as F
+    rng = np.random.RandomState(4)
+    a = rng.randn(24, 128).astype(np.float32)
+    b = rng.randn(24, 128).astype(np.float32)
+    s = rng.rand(128).astype(np.float32) + 0.5
+    bb = rng.randn(128).astype(np.float32)
+
+    def f_kernel(a, b, s, bb):
+        return jnp.sum(jnp.sin(F.add_layer_norm(a, b, s, bb, 1e-5, True)))
+
+    def f_ref(a, b, s, bb):
+        u = a + b
+        mu = jnp.mean(u, -1, keepdims=True)
+        var = jnp.mean((u - mu) ** 2, -1, keepdims=True)
+        return jnp.sum(jnp.sin(
+            (u - mu) * jax.lax.rsqrt(var + 1e-5) * s + bb))
+
+    args = tuple(jnp.asarray(v) for v in (a, b, s, bb))
+    yk = F.add_layer_norm(*args, 1e-5, True)
+    gk = jax.grad(f_kernel, argnums=(0, 1, 2, 3))(*args)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2, 3))(*args)
+    for x_, y_ in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(x_), np.asarray(y_),
+                                   rtol=2e-4, atol=2e-5)
