@@ -13,7 +13,9 @@ from .tracer import tracer, Tracer  # noqa: F401
 from .layers import Layer, seed_parameters  # noqa: F401
 from .nn import (Linear, Conv2D, Conv2DTranspose, Pool2D, BatchNorm,  # noqa
                  Embedding, LayerNorm, GroupNorm, InstanceNorm, Dropout,
-                 PRelu, Sequential, LayerList, ParameterList)
+                 PRelu, Sequential, LayerList, ParameterList,
+                 BilinearTensorProduct, Conv3D, Conv3DTranspose, GRUUnit,
+                 NCE, RowConv, SequenceConv, SpectralNorm)
 from .checkpoint import save_dygraph, load_dygraph  # noqa: F401
 from .parallel import (ParallelEnv, Env, prepare_context,  # noqa: F401
                        DataParallel)

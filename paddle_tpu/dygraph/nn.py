@@ -298,6 +298,230 @@ class PRelu(Layer):
                                  op_type="prelu")[0]
 
 
+class BilinearTensorProduct(Layer):
+    """ref: dygraph/nn.py BilinearTensorProduct."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], attr=param_attr)
+        self.bias = self.create_parameter([1, output_dim], attr=bias_attr,
+                                          is_bias=True)
+        self._act = act
+
+    def forward(self, x, y):
+        ins = {"X": [x], "Y": [y], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        out = _op("bilinear_tensor_product", ins, {})["Out"]
+        return _maybe_act(out, self._act)
+
+
+class Conv3D(Layer):
+    """ref: dygraph/nn.py Conv3D (NCDHW, filters OIDHW)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = list(filter_size) if isinstance(filter_size, (list, tuple)) \
+            else [filter_size] * 3
+        three = lambda v: list(v) if isinstance(v, (list, tuple)) \
+            else [v] * 3
+        self._attrs = {"strides": three(stride),
+                       "paddings": three(padding),
+                       "dilations": three(dilation), "groups": groups}
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups] + fs, attr=param_attr)
+        self.bias = self.create_parameter([num_filters], attr=bias_attr,
+                                          is_bias=True)
+        self._act = act
+
+    def forward(self, input):
+        out = _op("conv3d", {"Input": [input], "Filter": [self.weight]},
+                  self._attrs)["Output"]
+        if self.bias is not None:
+            out = out + self.bias.reshape([1, -1, 1, 1, 1])
+        return _maybe_act(out, self._act)
+
+
+class Conv3DTranspose(Layer):
+    """ref: dygraph/nn.py Conv3DTranspose (filters [Cin, Cout, k...])."""
+
+    def __init__(self, num_channels, num_filters, filter_size, padding=0,
+                 stride=1, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = list(filter_size) if isinstance(filter_size, (list, tuple)) \
+            else [filter_size] * 3
+        three = lambda v: list(v) if isinstance(v, (list, tuple)) \
+            else [v] * 3
+        self._attrs = {"strides": three(stride),
+                       "paddings": three(padding),
+                       "dilations": three(dilation), "groups": groups}
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // groups] + fs, attr=param_attr)
+        self.bias = self.create_parameter([num_filters], attr=bias_attr,
+                                          is_bias=True)
+        self._act = act
+
+    def forward(self, input):
+        out = _op("conv3d_transpose",
+                  {"Input": [input], "Filter": [self.weight]},
+                  self._attrs)["Output"]
+        if self.bias is not None:
+            out = out + self.bias.reshape([1, -1, 1, 1, 1])
+        return _maybe_act(out, self._act)
+
+
+class GRUUnit(Layer):
+    """ref: dygraph/nn.py GRUUnit — one GRU step over [B, 3D] gate input."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__(dtype=dtype)
+        d = size // 3
+        self._d = d
+        self.weight = self.create_parameter([d, 3 * d], attr=param_attr)
+        self.bias = self.create_parameter([1, 3 * d], attr=bias_attr,
+                                          is_bias=True)
+        self._attrs = {"activation": activation,
+                       "gate_activation": gate_activation,
+                       "origin_mode": origin_mode}
+
+    def forward(self, input, hidden):
+        import jax.numpy as jnp
+        d = self._d
+        acts = {"tanh": jnp.tanh,
+                "sigmoid": lambda v: 1.0 / (1.0 + jnp.exp(-v)),
+                "relu": lambda v: jnp.maximum(v, 0.0),
+                "identity": lambda v: v}
+        act = acts[self._attrs["activation"]]
+        gact = acts[self._attrs["gate_activation"]]
+        origin = self._attrs["origin_mode"]
+
+        def fn(xg, h, w, b):
+            g = xg[:, :2 * d] + h @ w[:, :2 * d]
+            if b is not None:
+                g = g + b.reshape(-1)[:2 * d]
+            g = gact(g)
+            u, r = g[:, :d], g[:, d:2 * d]
+            c = xg[:, 2 * d:] + (r * h) @ w[:, 2 * d:]
+            if b is not None:
+                c = c + b.reshape(-1)[2 * d:]
+            c = act(c)
+            nh = u * h + (1 - u) * c if origin else \
+                (1 - u) * h + u * c
+            return nh, r * h, jnp.concatenate([u, r, c], 1)
+
+        args = [input, hidden, self.weight]
+        if self.bias is not None:
+            outs = tracer().trace_fn(
+                lambda xg, h, w, b: fn(xg, h, w, b),
+                [input, hidden, self.weight, self.bias],
+                op_type="gru_unit")
+        else:
+            outs = tracer().trace_fn(
+                lambda xg, h, w: fn(xg, h, w, None), args,
+                op_type="gru_unit")
+        return outs[0], outs[1], outs[2]
+
+
+class NCE(Layer):
+    """ref: dygraph/nn.py NCE — noise-contrastive estimation head."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter([num_total_classes, dim],
+                                            attr=param_attr)
+        self.bias = self.create_parameter([num_total_classes],
+                                          attr=bias_attr, is_bias=True)
+        self._attrs = {"num_total_classes": num_total_classes,
+                       "num_neg_samples": num_neg_samples}
+
+    def forward(self, input, label, sample_weight=None):
+        ins = {"Input": [input], "Label": [label],
+               "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        return _op("nce", ins, self._attrs)["Cost"]
+
+
+class RowConv(Layer):
+    """ref: dygraph/nn.py RowConv — lookahead row convolution."""
+
+    def __init__(self, input_shape, future_context_size, param_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        d = int(input_shape[-1])
+        self.weight = self.create_parameter(
+            [future_context_size + 1, d], attr=param_attr)
+        self._act = act
+
+    def forward(self, input):
+        out = _op("row_conv", {"X": [input], "Filter": [self.weight]},
+                  {})["Out"]
+        return _maybe_act(out, self._act)
+
+
+class SequenceConv(Layer):
+    """ref: dygraph/nn.py SequenceConv — temporal context window conv
+    over dense padded [B, T, D] (+ optional Length)."""
+
+    def __init__(self, input_dim, num_filters, filter_size=3,
+                 padding_start=None, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [filter_size * input_dim, num_filters], attr=param_attr)
+        self.bias = self.create_parameter([num_filters], attr=bias_attr,
+                                          is_bias=True)
+        self._attrs = {"contextStart": padding_start
+                       if padding_start is not None
+                       else -(filter_size // 2),
+                       "contextLength": filter_size}
+        self._act = act
+
+    def forward(self, input, length=None):
+        ins = {"X": [input], "Filter": [self.weight]}
+        if length is not None:
+            ins["Length"] = [length]
+        out = _op("sequence_conv", ins, self._attrs)["Out"]
+        if self.bias is not None:
+            out = out + self.bias
+        return _maybe_act(out, self._act)
+
+
+class SpectralNorm(Layer):
+    """ref: dygraph/nn.py SpectralNorm — power-iteration weight norm."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        from ..framework.initializer import NormalInitializer
+        h = int(weight_shape[dim])
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= int(s)
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=NormalInitializer(0.0, 1.0))
+        self.weight_v = self.create_parameter(
+            [w], default_initializer=NormalInitializer(0.0, 1.0))
+        self._attrs = {"dim": dim, "power_iters": power_iters, "eps": eps}
+
+    def forward(self, weight):
+        return _op("spectral_norm",
+                   {"Weight": [weight], "U": [self.weight_u],
+                    "V": [self.weight_v]}, self._attrs)["Out"]
+
+
 class Sequential(Layer):
     """ref: dygraph/container.py Sequential."""
 

@@ -19,6 +19,7 @@ from ..framework.layer_helper import ParamAttr  # noqa: F401
 from ..framework import initializer  # noqa: F401
 from ..framework import unique_name  # noqa: F401
 from .. import layers        # noqa: F401
+from .. import nets          # noqa: F401
 from .. import dygraph       # noqa: F401
 from .. import dataset       # noqa: F401
 from ..dataset import (DatasetFactory, InMemoryDataset,  # noqa: F401

@@ -230,9 +230,8 @@ def fold_identity_ops(program: Program, fetch_names=(), **_):
             if nxt.attrs.get("bias_after_scale", True) is False and \
                     float(nxt.attrs.get("bias", 0.0)) != 0.0:
                 continue
-            if nxt.output_names()[0] in fetch and \
-                    op.output_names()[0] in fetch:
-                continue
+            # (a fetched intermediate is already excluded: keep_names
+            # bumps its use count past the single-use check above)
             nxt.attrs["scale"] = float(nxt.attrs.get("scale", 1.0)) * \
                 float(op.attrs.get("scale", 1.0))
             nxt.inputs = {"X": list(op.inputs["X"])}

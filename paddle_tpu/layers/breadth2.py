@@ -58,8 +58,10 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
                      attrs={"shape": [1], "dtype": "int64",
                             "value": float(begin - step)})
         sv.persistable = True
-    helper.append_op(type="increment", inputs={"X": [v]},
-                     outputs={"Out": [v]}, attrs={"step": float(step)})
+        # increment appended ONLY on creation (ref nn.py:5978 is_new_var
+        # guard) — shared counters advance once per step, not per caller
+        helper.append_op(type="increment", inputs={"X": [v]},
+                         outputs={"Out": [v]}, attrs={"step": float(step)})
     return v
 
 
@@ -194,8 +196,9 @@ def image_resize_short(input, out_short_len, resample="BILINEAR"):
     h, w = int(h), int(w)
     short, is_h = (h, True) if h < w else (w, False)
     scale = out_short_len / short
-    out_shape = [out_short_len, int(w * scale)] if is_h else \
-        [int(h * scale), out_short_len]
+    # reference rounds the long edge half-up (nn.py image_resize_short)
+    out_shape = [out_short_len, int(w * scale + 0.5)] if is_h else \
+        [int(h * scale + 0.5), out_short_len]
     return image_resize(input, out_shape=out_shape, resample=resample)
 
 

@@ -14,6 +14,7 @@ __all__ = [
     "matrix_nms", "bipartite_match", "target_assign",
     "mine_hard_examples", "roi_align", "roi_pool",
     "polygon_box_transform", "ssd_loss", "detection_output",
+    "yolov3_loss",
 ]
 
 
@@ -281,3 +282,26 @@ def detection_output(loc, scores, prior_box, prior_box_var,
     return multiclass_nms(decoded, scores_t, score_threshold, nms_top_k,
                           keep_top_k, nms_threshold=nms_threshold,
                           background_label=background_label)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    """ref: layers/detection.py yolov3_loss → yolov3_loss_op.h; dense
+    lowering in ops/yolo_loss_op.py."""
+    n = x.shape[0]
+    b = gt_box.shape[1]
+    a = len(anchor_mask)
+    h = x.shape[2]
+    ins = {"X": x, "GTBox": gt_box, "GTLabel": gt_label}
+    if gt_score is not None:
+        ins["GTScore"] = gt_score
+    out = _op("yolov3_loss", ins,
+              {"anchors": list(anchors), "anchor_mask": list(anchor_mask),
+               "class_num": class_num, "ignore_thresh": ignore_thresh,
+               "downsample_ratio": downsample_ratio,
+               "use_label_smooth": use_label_smooth},
+              {"Loss": ((n,), "float32"),
+               "ObjectnessMask": ((n, a, h, x.shape[3]), "float32"),
+               "GTMatchMask": ((n, b), "int64")})
+    return out["Loss"]

@@ -1,0 +1,92 @@
+"""High-level network compositions (ref: python/paddle/fluid/nets.py —
+simple_img_conv_pool, img_conv_group, sequence_conv_pool, glu,
+scaled_dot_product_attention).  Pure compositions of the layers API; the
+attention helper routes through the fused_attention op so it picks up
+the Pallas flash kernel like every other attention in this framework."""
+
+from __future__ import annotations
+
+from . import layers
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None,
+                         use_cudnn=True):
+    """ref: nets.py:29."""
+    conv_out = layers.conv2d(input, num_filters, filter_size,
+                             stride=conv_stride, padding=conv_padding,
+                             dilation=conv_dilation, groups=conv_groups,
+                             param_attr=param_attr, bias_attr=bias_attr,
+                             act=act)
+    return layers.pool2d(conv_out, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride,
+                         pool_padding=pool_padding,
+                         global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   param_attr=None, conv_with_batchnorm=False,
+                   conv_batchnorm_drop_rate=0.0, pool_stride=1,
+                   pool_type="max", use_cudnn=True):
+    """ref: nets.py:141 — VGG-style conv(+bn+dropout)* then pool."""
+    tmp = input
+    assert isinstance(conv_num_filter, (list, tuple))
+
+    def _ext(obj):
+        if hasattr(obj, "__len__"):
+            return list(obj)
+        return [obj] * len(conv_num_filter)
+
+    conv_padding = _ext(conv_padding)
+    conv_filter_size = _ext(conv_filter_size)
+    param_attr = _ext(param_attr)
+    conv_with_batchnorm = _ext(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = _ext(conv_batchnorm_drop_rate)
+
+    for i, nf in enumerate(conv_num_filter):
+        local_act = conv_act if not conv_with_batchnorm[i] else None
+        tmp = layers.conv2d(tmp, nf, conv_filter_size[i],
+                            padding=conv_padding[i],
+                            param_attr=param_attr[i], act=local_act)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(tmp, act=conv_act)
+            if conv_batchnorm_drop_rate[i]:
+                tmp = layers.dropout(tmp, conv_batchnorm_drop_rate[i])
+    return layers.pool2d(tmp, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None,
+                       length=None):
+    """ref: nets.py:256 — sequence conv then sequence pool (dense padded
+    + Length convention, see ops/sequence_ops.py)."""
+    conv_out = layers.sequence_conv(input, num_filters, filter_size,
+                                    param_attr=param_attr, act=act,
+                                    bias_attr=bias_attr, length=length)
+    return layers.sequence_pool(conv_out, pool_type=pool_type,
+                                length=length)
+
+
+def glu(input, dim=-1):
+    """ref: nets.py:328 — gated linear unit: a ⊙ σ(b)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """ref: nets.py:372 — multi-head scaled dot-product attention over
+    [B, S, D] q/k/v; lowers onto fused_attention (Pallas flash path)."""
+    if queries.shape[-1] % num_heads:
+        raise ValueError(
+            f"hidden size {queries.shape[-1]} not divisible by num_heads "
+            f"{num_heads}")
+    from .models.bert import fused_attention
+    return fused_attention(queries, keys, values, None, num_heads,
+                           dropout_rate, is_test=False,
+                           name="sdp_attention")

@@ -44,6 +44,10 @@ def test_step_counter_increments_across_runs():
     main, startup = Program(), Program()
     with program_guard(main, startup):
         c = L.autoincreased_step_counter()
+        # a second caller sharing the counter must NOT double the step
+        # (ref nn.py:5978 is_new_var guard)
+        c2 = L.autoincreased_step_counter()
+    assert c2 is c
     exe = fluid.Executor(fluid.CPUPlace())
     with fluid.scope_guard(fluid.Scope()):
         exe.run(startup)

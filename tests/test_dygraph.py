@@ -273,3 +273,47 @@ def test_named_parameters_and_buffers():
         names = dict(M().named_parameters()).keys()
         assert any(n.startswith("fc.") for n in names)
         assert any(n.startswith("bn.") for n in names)
+
+
+def test_dygraph_new_layer_classes():
+    """Conv3D(+Transpose), BilinearTensorProduct, GRUUnit, NCE, RowConv,
+    SequenceConv, SpectralNorm (ref: dygraph/nn.py classes)."""
+    import numpy as np
+    from paddle_tpu import dygraph
+    from paddle_tpu.dygraph import to_variable
+    rng = np.random.RandomState(0)
+    with dygraph.guard():
+        x5 = to_variable(rng.rand(1, 2, 4, 4, 4).astype(np.float32))
+        c3 = dygraph.Conv3D(2, 3, filter_size=3, padding=1)
+        assert tuple(c3(x5).shape) == (1, 3, 4, 4, 4)
+        ct3 = dygraph.Conv3DTranspose(2, 3, filter_size=2, stride=2)
+        assert tuple(ct3(x5).shape) == (1, 3, 8, 8, 8)
+
+        a = to_variable(rng.rand(4, 3).astype(np.float32))
+        b = to_variable(rng.rand(4, 5).astype(np.float32))
+        btp = dygraph.BilinearTensorProduct(3, 5, 7)
+        assert tuple(btp(a, b).shape) == (4, 7)
+
+        xg = to_variable(rng.rand(4, 12).astype(np.float32))
+        h0 = to_variable(rng.rand(4, 4).astype(np.float32))
+        gru = dygraph.GRUUnit(12)
+        nh, rh, gate = gru(xg, h0)
+        assert tuple(nh.shape) == (4, 4) and tuple(gate.shape) == (4, 12)
+
+        feat = to_variable(rng.rand(4, 6).astype(np.float32))
+        lab = to_variable(rng.randint(0, 9, (4, 1)).astype(np.int64))
+        nce = dygraph.NCE(num_total_classes=9, dim=6, num_neg_samples=3)
+        cost = nce(feat, lab)
+        assert tuple(cost.shape) == (4, 1)
+        assert np.isfinite(np.asarray(cost.numpy())).all()
+
+        seq = to_variable(rng.rand(2, 5, 3).astype(np.float32))
+        rc = dygraph.RowConv([2, 5, 3], future_context_size=2)
+        assert tuple(rc(seq).shape) == (2, 5, 3)
+        sc = dygraph.SequenceConv(3, 6, filter_size=3)
+        assert tuple(sc(seq).shape) == (2, 5, 6)
+
+        w = to_variable(rng.rand(6, 4).astype(np.float32))
+        sn = dygraph.SpectralNorm([6, 4], power_iters=20)
+        normed = np.asarray(sn(w).numpy())
+        assert abs(np.linalg.svd(normed, compute_uv=False)[0] - 1.0) < 1e-2

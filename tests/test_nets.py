@@ -1,0 +1,74 @@
+"""nets.py compositions (ref: python/paddle/fluid/nets.py)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import (Program, program_guard,
+                                       reset_default_programs)
+
+
+def _run(build, feed):
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        outs = build()
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return [np.asarray(v) for v in
+                exe.run(main, feed=feed, fetch_list=list(outs))]
+
+
+def test_simple_img_conv_pool_and_group():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[3, 8, 8])
+        a = fluid.nets.simple_img_conv_pool(
+            xv, num_filters=4, filter_size=3, pool_size=2, pool_stride=2,
+            conv_padding=1, act="relu")
+        g = fluid.nets.img_conv_group(
+            xv, conv_num_filter=[4, 4], pool_size=2,
+            conv_with_batchnorm=[True, False], conv_act="relu",
+            pool_stride=2)
+        return a, g
+
+    a, g = _run(build, {"x": x})
+    assert a.shape == (2, 4, 4, 4)
+    assert g.shape == (2, 4, 4, 4)
+    assert (a >= 0).all()
+
+
+def test_glu_and_seq_conv_pool():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 8).astype(np.float32)
+    seq = rng.randn(2, 5, 4).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[8])
+        gl = fluid.nets.glu(xv, dim=-1)
+        sv = fluid.layers.data("s", shape=[5, 4])
+        sp = fluid.nets.sequence_conv_pool(sv, 6, 3, act="relu")
+        return gl, sp
+
+    gl, sp = _run(build, {"x": x, "s": seq})
+    a, b = x[:, :4], x[:, 4:]
+    np.testing.assert_allclose(gl, a / (1 + np.exp(-b)), rtol=1e-5,
+                               atol=1e-6)
+    assert sp.shape == (2, 6)
+
+
+def test_scaled_dot_product_attention():
+    rng = np.random.RandomState(2)
+    q = rng.randn(2, 6, 8).astype(np.float32)
+
+    def build():
+        qv = fluid.layers.data("q", shape=[6, 8])
+        return fluid.nets.scaled_dot_product_attention(qv, qv, qv,
+                                                       num_heads=2)
+
+    out, = _run(build, {"q": q})
+    assert out.shape == (2, 6, 8)
+    assert np.isfinite(out).all()
