@@ -14,7 +14,8 @@ __all__ = [
     "matrix_nms", "bipartite_match", "target_assign",
     "mine_hard_examples", "roi_align", "roi_pool",
     "polygon_box_transform", "ssd_loss", "detection_output",
-    "yolov3_loss",
+    "yolov3_loss", "generate_proposals", "distribute_fpn_proposals",
+    "collect_fpn_proposals", "rpn_target_assign", "psroi_pool", "prroi_pool",
 ]
 
 
@@ -305,3 +306,179 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                "ObjectnessMask": ((n, a, h, x.shape[3]), "float32"),
                "GTMatchMask": ((n, b), "int64")})
     return out["Loss"]
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=False):
+    """ref: layers/detection.py generate_proposals → generate_proposals_op.cc.
+    Static contract: RpnRois [N, post_nms_top_n, 4] padded + RpnRoisNum."""
+    n = scores.shape[0]
+    out = _op("generate_proposals",
+              {"Scores": scores, "BboxDeltas": bbox_deltas,
+               "ImInfo": im_info, "Anchors": anchors,
+               "Variances": variances},
+              {"pre_nms_topN": pre_nms_top_n,
+               "post_nms_topN": post_nms_top_n, "nms_thresh": nms_thresh,
+               "min_size": min_size, "eta": eta},
+              {"RpnRois": ((n, post_nms_top_n, 4), "float32"),
+               "RpnRoiProbs": ((n, post_nms_top_n, 1), "float32"),
+               "RpnRoisNum": ((n,), "int32")})
+    if return_rois_num:
+        return out["RpnRois"], out["RpnRoiProbs"], out["RpnRoisNum"]
+    return out["RpnRois"], out["RpnRoiProbs"]
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=True, name=None):
+    """ref: layers/detection.py distribute_fpn_proposals.  Static: each
+    level tensor is [R, 4] front-compacted; counts in MultiLevelRoIsNum."""
+    helper = LayerHelper("distribute_fpn_proposals")
+    r = fpn_rois.shape[0]
+    num_lvl = max_level - min_level + 1
+    multi = [helper.create_variable_for_type_inference("float32", (r, 4))
+             for _ in range(num_lvl)]
+    nums = [helper.create_variable_for_type_inference("int32", ())
+            for _ in range(num_lvl)]
+    restore = helper.create_variable_for_type_inference("int32", (r, 1))
+    helper.append_op(type="distribute_fpn_proposals",
+                     inputs={"FpnRois": [fpn_rois]},
+                     outputs={"MultiFpnRois": multi,
+                              "MultiLevelRoIsNum": nums,
+                              "RestoreIndex": [restore]},
+                     attrs={"min_level": min_level, "max_level": max_level,
+                            "refer_level": refer_level,
+                            "refer_scale": refer_scale,
+                            "pixel_offset": pixel_offset})
+    return multi, restore, nums
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """ref: layers/detection.py collect_fpn_proposals."""
+    helper = LayerHelper("collect_fpn_proposals")
+    out = helper.create_variable_for_type_inference(
+        "float32", (post_nms_top_n, 4))
+    num = helper.create_variable_for_type_inference("int32", ())
+    ins = {"MultiLevelRois": list(multi_rois),
+           "MultiLevelScores": list(multi_scores)}
+    if rois_num_per_level is not None:
+        ins["MultiLevelRoIsNum"] = list(rois_num_per_level)
+    helper.append_op(type="collect_fpn_proposals", inputs=ins,
+                     outputs={"FpnRois": [out], "RoisNum": [num]},
+                     attrs={"post_nms_topN": post_nms_top_n})
+    return out, num
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """ref: layers/detection.py rpn_target_assign — returns the
+    reference 5-tuple (score_pred, loc_pred, score_target, loc_target,
+    bbox_inside_weight) gathered at the sampled anchors.
+
+    Static contract: the gathered tensors are padded to the sampling
+    caps; pad rows carry score_target = -1 and zero inside weights so
+    the standard masked RPN losses ignore them (the reference's LoD
+    outputs are dynamically sized instead).  When bbox_pred/cls_logits
+    are None the raw per-anchor outputs are returned."""
+    helper = LayerHelper("rpn_target_assign")
+    a = anchor_box.shape[0]
+    batch = rpn_batch_size_per_im
+    fg_cap = int(batch * rpn_fg_fraction)
+    outs = {
+        "ScoreIndex": helper.create_variable_for_type_inference(
+            "int32", (batch,)),
+        "ScoreIndexNum": helper.create_variable_for_type_inference(
+            "int32", ()),
+        "LocationIndex": helper.create_variable_for_type_inference(
+            "int32", (fg_cap,)),
+        "LocationIndexNum": helper.create_variable_for_type_inference(
+            "int32", ()),
+        "TargetLabel": helper.create_variable_for_type_inference(
+            "int32", (a,)),
+        "TargetBBox": helper.create_variable_for_type_inference(
+            "float32", (a, 4)),
+        "BBoxInsideWeight": helper.create_variable_for_type_inference(
+            "float32", (a, 4)),
+    }
+    ins = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes]}
+    if im_info is not None:
+        ins["ImInfo"] = [im_info]
+    helper.append_op(type="rpn_target_assign", inputs=ins,
+                     outputs={k: [v] for k, v in outs.items()},
+                     attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+                            "rpn_fg_fraction": rpn_fg_fraction,
+                            "rpn_straddle_thresh": rpn_straddle_thresh,
+                            "rpn_positive_overlap": rpn_positive_overlap,
+                            "rpn_negative_overlap": rpn_negative_overlap,
+                            "use_random": use_random})
+    if bbox_pred is None or cls_logits is None:
+        return (outs["ScoreIndex"], outs["LocationIndex"],
+                outs["TargetLabel"], outs["TargetBBox"],
+                outs["BBoxInsideWeight"])
+
+    from . import tensor_ops as tensor
+    from . import math_ops as ops
+    from .breadth import gather_nd
+    si = tensor.reshape(outs["ScoreIndex"], [-1, 1])
+    li = tensor.reshape(outs["LocationIndex"], [-1, 1])
+    cls_flat = tensor.reshape(cls_logits, [-1, 1])
+    box_flat = tensor.reshape(bbox_pred, [-1, 4])
+    score_pred = gather_nd(cls_flat, si)
+    loc_pred = gather_nd(box_flat, li)
+    score_tgt = gather_nd(tensor.reshape(outs["TargetLabel"], [-1, 1]), si)
+    # mask pad rows of the sampled-score batch with -1
+    valid = ops.less_than(
+        _range_like(batch), tensor.reshape(outs["ScoreIndexNum"], [1]))
+    score_tgt = tensor.reshape(score_tgt, [-1])
+    score_tgt = ops.elementwise_add(
+        ops.elementwise_mul(tensor.cast(score_tgt, "float32"),
+                            tensor.cast(valid, "float32")),
+        ops.scale(tensor.cast(ops.logical_not(valid), "float32"),
+                  scale=-1.0))
+    loc_tgt = gather_nd(outs["TargetBBox"], li)
+    inw = gather_nd(outs["BBoxInsideWeight"], li)
+    return score_pred, loc_pred, score_tgt, loc_tgt, inw
+
+
+def _range_like(n):
+    import numpy as np
+    from .math_ops import _to_variable
+    return _to_variable(np.arange(n, dtype=np.int32))
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    """ref: layers/detection.py psroi_pool."""
+    r = rois.shape[0]
+    ins = {"X": input, "ROIs": rois}
+    if rois_num is not None:
+        ins["RoisNum"] = rois_num
+    return _op("psroi_pool", ins,
+               {"output_channels": output_channels,
+                "spatial_scale": spatial_scale,
+                "pooled_height": pooled_height,
+                "pooled_width": pooled_width},
+               {"Out": ((r, output_channels, pooled_height, pooled_width),
+                        "float32")})["Out"]
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    """ref: layers/detection.py prroi_pool."""
+    r = rois.shape[0]
+    c = input.shape[1]
+    ins = {"X": input, "ROIs": rois}
+    if batch_roi_nums is not None:
+        ins["BatchRoINums"] = batch_roi_nums
+    return _op("prroi_pool", ins,
+               {"spatial_scale": spatial_scale,
+                "pooled_height": pooled_height,
+                "pooled_width": pooled_width},
+               {"Out": ((r, c, pooled_height, pooled_width),
+                        "float32")})["Out"]

@@ -21,5 +21,6 @@ from . import breadth_ops   # noqa: F401
 from . import breadth2_ops  # noqa: F401
 from . import crf_ops       # noqa: F401
 from . import yolo_loss_op  # noqa: F401
+from . import proposal_ops  # noqa: F401
 from . import pipeline_op   # noqa: F401
 from . import ps_ops        # noqa: F401

@@ -411,6 +411,16 @@ def _bipartite_match(ctx, ins, attrs):
             "ColToRowMatchDist": col_dist[None, :]}
 
 
+def _roi_batch_idx(roi_batch, n_rois):
+    """RoisNum [N] (boxes per image) -> per-roi image index [R]; all
+    rois belong to image 0 when absent."""
+    if roi_batch is None:
+        return jnp.zeros((n_rois,), jnp.int32)
+    counts = roi_batch.reshape(-1).astype(jnp.int32)
+    return jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                      total_repeat_length=n_rois)
+
+
 @register("roi_align")
 def _roi_align(ctx, ins, attrs):
     """ref: detection ROIAlign (operators/roi_align_op.h), sampling_ratio
@@ -423,13 +433,7 @@ def _roi_align(ctx, ins, attrs):
     ratio = attrs.get("sampling_ratio", -1)
     ratio = 2 if ratio <= 0 else ratio
     n, c, h, w = a.shape
-    if roi_batch is None:
-        batch_idx = jnp.zeros((rois.shape[0],), jnp.int32)
-    else:
-        # RoisNum: boxes per image → repeat image index
-        counts = roi_batch.reshape(-1).astype(jnp.int32)
-        batch_idx = jnp.repeat(jnp.arange(counts.shape[0]), counts,
-                               total_repeat_length=rois.shape[0])
+    batch_idx = _roi_batch_idx(roi_batch, rois.shape[0])
 
     def one_roi(roi, bi):
         x0, y0, x1, y1 = roi * scale
@@ -469,12 +473,7 @@ def _roi_pool(ctx, ins, attrs):
     pw = attrs.get("pooled_width", 1)
     scale = attrs.get("spatial_scale", 1.0)
     n, c, h, w = a.shape
-    if roi_batch is None:
-        batch_idx = jnp.zeros((rois.shape[0],), jnp.int32)
-    else:
-        counts = roi_batch.reshape(-1).astype(jnp.int32)
-        batch_idx = jnp.repeat(jnp.arange(counts.shape[0]), counts,
-                               total_repeat_length=rois.shape[0])
+    batch_idx = _roi_batch_idx(roi_batch, rois.shape[0])
 
     ys = jnp.arange(h)
     xs = jnp.arange(w)
@@ -554,3 +553,93 @@ def _target_assign(ctx, ins, attrs):
     out = jnp.where(valid, picked, mismatch_value)
     w_ = jnp.where(match >= 0, 1.0, 0.0)
     return {"Out": out, "OutWeight": w_[..., None]}
+
+
+@register("psroi_pool")
+def _psroi_pool(ctx, ins, attrs):
+    """ref: operators/psroi_pool_op.h — position-sensitive ROI pooling:
+    bin (i, j) of output channel c averages input channel
+    c*ph*pw + i*pw + j over the bin region."""
+    a, rois = jnp.asarray(x(ins, "X")), jnp.asarray(x(ins, "ROIs"))
+    roi_batch = x(ins, "RoisNum")
+    oc = attrs["output_channels"]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = a.shape
+    batch_idx = _roi_batch_idx(roi_batch, rois.shape[0])
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+
+    def one_roi(roi, bi):
+        x0 = jnp.round(roi[0]) * scale
+        y0 = jnp.round(roi[1]) * scale
+        x1 = jnp.round(roi[2] + 1.0) * scale
+        y1 = jnp.round(roi[3] + 1.0) * scale
+        rw = jnp.maximum(x1 - x0, 0.1)
+        rh = jnp.maximum(y1 - y0, 0.1)
+        img = a[bi].reshape(oc, ph * pw, h, w)
+
+        def bin_val(i, j):
+            by0 = jnp.floor(y0 + i * rh / ph)
+            by1 = jnp.ceil(y0 + (i + 1) * rh / ph)
+            bx0 = jnp.floor(x0 + j * rw / pw)
+            bx1 = jnp.ceil(x0 + (j + 1) * rw / pw)
+            inside = ((ys >= by0) & (ys < by1))[:, None] & \
+                ((xs >= bx0) & (xs < bx1))[None, :]
+            grp = img[:, i * pw + j]              # [oc, H, W]
+            s = jnp.sum(jnp.where(inside[None], grp, 0.0), axis=(1, 2))
+            cnt = jnp.maximum(jnp.sum(inside), 1)
+            return s / cnt
+
+        vals = jnp.stack([jnp.stack([bin_val(i, j) for j in range(pw)], -1)
+                          for i in range(ph)], -2)      # [oc, ph, pw]
+        return vals
+
+    return {"Out": jax.vmap(one_roi)(rois, batch_idx)}
+
+
+@register("prroi_pool")
+def _prroi_pool(ctx, ins, attrs):
+    """ref: operators/prroi_pool_op.h (Precise RoI Pooling) — continuous
+    average of the bilinearly-interpolated feature over each bin.  The
+    closed-form integral is approximated by an 8×8 quadrature per bin
+    (converges to the integral; fully differentiable like the original)."""
+    a, rois = jnp.asarray(x(ins, "X")), jnp.asarray(x(ins, "ROIs"))
+    roi_batch = x(ins, "BatchRoINums")
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    q = 8
+    n, c, h, w = a.shape
+    batch_idx = _roi_batch_idx(roi_batch, rois.shape[0])
+
+    def one_roi(roi, bi):
+        x0, y0, x1, y1 = roi * scale
+        rw = jnp.maximum(x1 - x0, 1e-3)
+        rh = jnp.maximum(y1 - y0, 1e-3)
+        gy = y0 + (jnp.arange(ph)[:, None, None, None]
+                   + 0.0) * rh / ph + \
+            (jnp.arange(q)[None, None, :, None] + 0.5) * rh / (ph * q)
+        gx = x0 + (jnp.arange(pw)[None, :, None, None]
+                   + 0.0) * rw / pw + \
+            (jnp.arange(q)[None, None, None, :] + 0.5) * rw / (pw * q)
+        gy = jnp.broadcast_to(gy, (ph, pw, q, q)).reshape(-1)
+        gx = jnp.broadcast_to(gx, (ph, pw, q, q)).reshape(-1)
+        # the PrRoI integrand is bilinear INSIDE the map and zero outside
+        # (ref prroi_pool_op.h) — read through a zero-padded map so
+        # out-of-bounds corners contribute zeros, not replicated borders
+        img = jnp.pad(a[bi], [(0, 0), (1, 1), (1, 1)])
+        y0i = jnp.clip(jnp.floor(gy).astype(jnp.int32) + 1, 0, h + 1)
+        x0i = jnp.clip(jnp.floor(gx).astype(jnp.int32) + 1, 0, w + 1)
+        y1i = jnp.clip(y0i + 1, 0, h + 1)
+        x1i = jnp.clip(x0i + 1, 0, w + 1)
+        wy = jnp.clip(gy - jnp.floor(gy), 0, 1)
+        wx = jnp.clip(gx - jnp.floor(gx), 0, 1)
+        v = (img[:, y0i, x0i] * (1 - wy) * (1 - wx)
+             + img[:, y0i, x1i] * (1 - wy) * wx
+             + img[:, y1i, x0i] * wy * (1 - wx)
+             + img[:, y1i, x1i] * wy * wx)
+        return v.reshape(c, ph, pw, q * q).mean(-1)
+
+    return {"Out": jax.vmap(one_roi)(rois, batch_idx)}

@@ -1,0 +1,269 @@
+"""RPN/FPN proposal ops (ref: operators/detection/generate_proposals_op.cc,
+distribute_fpn_proposals_op.h, collect_fpn_proposals_op.h,
+rpn_target_assign_op.cc).
+
+The reference emits LoD tensors whose row counts depend on the data;
+TPU-natively every output is fixed-shape: padded to the configured cap
+with an explicit valid count (same contract as multiclass_nms in
+detection_ops.py), and "compaction" is a stable scatter by cumsum
+position — shapes never depend on values."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+from .detection_ops import _nms_class
+
+NEG = -1e30
+
+
+def _decode(anchors, deltas, variances):
+    """Anchor-relative delta decoding, xyxy anchors (+1 extents — the
+    reference's pixel convention)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + 0.5 * aw
+    ay = anchors[:, 1] + 0.5 * ah
+    dx, dy, dw, dh = (deltas[:, i] * variances[:, i] for i in range(4))
+    cx = dx * aw + ax
+    cy = dy * ah + ay
+    w = jnp.exp(jnp.minimum(dw, 10.0)) * aw
+    h = jnp.exp(jnp.minimum(dh, 10.0)) * ah
+    return jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                      cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0], -1)
+
+
+@register("generate_proposals")
+def _generate_proposals(ctx, ins, attrs):
+    """ref: generate_proposals_op.cc — decode RPN deltas against anchors,
+    clip, drop tiny boxes, NMS, keep post_nms_topN per image."""
+    scores = x(ins, "Scores")          # [N, A, H, W]
+    deltas = x(ins, "BboxDeltas")      # [N, 4A, H, W]
+    im_info = x(ins, "ImInfo")         # [N, 3] h, w, scale
+    anchors = x(ins, "Anchors").reshape(-1, 4)     # [HWA, 4]
+    variances = x(ins, "Variances").reshape(-1, 4)
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.7))
+    min_size = float(attrs.get("min_size", 0.1))
+    if float(attrs.get("eta", 1.0)) < 1.0:
+        raise NotImplementedError(
+            "generate_proposals adaptive NMS (eta < 1) is not lowered — "
+            "silently running plain NMS would change the proposal set")
+
+    n, a, h, w = scores.shape
+    total = a * h * w
+    # [N, A, H, W] → [N, HWA] matching Anchors' [H, W, A] layout
+    sc = scores.transpose(0, 2, 3, 1).reshape(n, total)
+    dl = deltas.reshape(n, a, 4, h, w).transpose(0, 3, 4, 1, 2).reshape(
+        n, total, 4)
+
+    def per_image(sc_i, dl_i, info):
+        k = min(pre_n, total)
+        top_sc, order = lax.top_k(sc_i, k)
+        boxes = _decode(anchors[order], dl_i[order], variances[order])
+        # clip to image
+        imh, imw = info[0], info[1]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, imw - 1),
+                           jnp.clip(boxes[:, 1], 0, imh - 1),
+                           jnp.clip(boxes[:, 2], 0, imw - 1),
+                           jnp.clip(boxes[:, 3], 0, imh - 1)], -1)
+        ws = boxes[:, 2] - boxes[:, 0] + 1.0
+        hs = boxes[:, 3] - boxes[:, 1] + 1.0
+        ok = (ws >= min_size * info[2]) & (hs >= min_size * info[2])
+        top_sc = jnp.where(ok, top_sc, NEG)
+        keep, order2, kept_sc = _nms_class(boxes, top_sc, nms_thresh,
+                                           min(post_n, k),
+                                           normalized=False)
+        kept_boxes = boxes[order2]
+        valid = (keep > 0) & (kept_sc > NEG / 2)
+        # stable compaction to the front
+        pos = jnp.cumsum(valid) - 1
+        out_b = jnp.zeros((post_n, 4), boxes.dtype)
+        out_s = jnp.full((post_n,), 0.0, sc_i.dtype)
+        tgt = jnp.where(valid, pos, post_n - 1)
+        out_b = out_b.at[tgt].set(jnp.where(valid[:, None], kept_boxes,
+                                            out_b[tgt]))
+        out_s = out_s.at[tgt].set(jnp.where(valid, kept_sc, out_s[tgt]))
+        return out_b, out_s, jnp.sum(valid)
+
+    rois, probs, counts = jax.vmap(per_image)(sc, dl, im_info)
+    return {"RpnRois": rois, "RpnRoiProbs": probs[..., None],
+            "RpnRoisNum": counts.astype(jnp.int32)}
+
+
+@register("distribute_fpn_proposals")
+def _distribute_fpn_proposals(ctx, ins, attrs):
+    """ref: distribute_fpn_proposals_op.h — route each roi to its FPN
+    level by sqrt(area): level = floor(log2(sqrt(wh)/refer_scale) +
+    refer_level), clamped.  Outputs: per-level padded roi tensors +
+    per-level counts + RestoreIndex."""
+    rois = x(ins, "FpnRois")           # [R, 4]
+    min_level = int(attrs["min_level"])
+    max_level = int(attrs["max_level"])
+    refer_level = int(attrs["refer_level"])
+    refer_scale = int(attrs["refer_scale"])
+    pixel_offset = bool(attrs.get("pixel_offset", True))
+    r = rois.shape[0]
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = jnp.sqrt(jnp.maximum(ws * hs, 1e-12))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+
+    num_levels = max_level - min_level + 1
+    outs = {}
+    counts = []
+    multi = []
+    for li in range(num_levels):
+        sel = lvl == (min_level + li)
+        pos = jnp.cumsum(sel) - 1
+        out = jnp.zeros((r, 4), rois.dtype)
+        tgt = jnp.where(sel, pos, r - 1)
+        out = out.at[tgt].set(jnp.where(sel[:, None], rois, out[tgt]))
+        multi.append(out)
+        counts.append(jnp.sum(sel).astype(jnp.int32))
+        # restore index: original position of the i-th row of this level
+        # is scattered later via the inverse permutation below
+    # RestoreIndex is addressed against the PADDED level concatenation
+    # (the only concat constructible under static shapes): roi i lives at
+    # row level_idx*R + within-level rank, so
+    # gather(concat(MultiFpnRois), RestoreIndex) restores original order
+    # even though each level tensor is front-compacted with padding.
+    lvl_idx = lvl - min_level
+    within = jnp.zeros((r,), jnp.int32)
+    for li in range(num_levels):
+        sel = lvl_idx == li
+        within = jnp.where(sel, jnp.cumsum(sel) - 1 + li * r, within)
+    restore = within.astype(jnp.int32)
+    outs["MultiFpnRois"] = multi
+    outs["MultiLevelRoIsNum"] = counts
+    outs["RestoreIndex"] = restore[:, None]
+    return outs
+
+
+@register("collect_fpn_proposals")
+def _collect_fpn_proposals(ctx, ins, attrs):
+    """ref: collect_fpn_proposals_op.h — merge per-level rois, keep the
+    global top post_nms_topN by score."""
+    rois = ins.get("MultiLevelRois", [])
+    scores = ins.get("MultiLevelScores", [])
+    counts = ins.get("MultiLevelRoIsNum", [])
+    post_n = int(attrs["post_nms_topN"])
+    all_rois = jnp.concatenate(rois, 0)
+    all_scores = jnp.concatenate([s.reshape(-1) for s in scores], 0)
+    if counts:
+        valids = []
+        for lv, s in zip(counts, scores):
+            m = s.reshape(-1).shape[0]
+            valids.append(jnp.arange(m) < lv.reshape(()))
+        valid = jnp.concatenate(valids, 0)
+        all_scores = jnp.where(valid, all_scores, NEG)
+    k = min(post_n, all_scores.shape[0])
+    top, order = lax.top_k(all_scores, k)
+    out = jnp.zeros((post_n, 4), all_rois.dtype)
+    out = out.at[jnp.arange(k)].set(
+        jnp.where((top > NEG / 2)[:, None], all_rois[order], 0.0))
+    return {"FpnRois": out,
+            "RoisNum": jnp.sum(top > NEG / 2).astype(jnp.int32)}
+
+
+@register("rpn_target_assign")
+def _rpn_target_assign(ctx, ins, attrs):
+    """ref: rpn_target_assign_op.cc — label anchors against gt boxes and
+    subsample a fixed training batch.  Static contract: per-anchor label
+    (1 fg / 0 bg / -1 ignore), regression targets + inside weights;
+    sampling keeps at most fg_num = batch*fg_fraction foregrounds and
+    batch-fg_num backgrounds, chosen by shuffled priority (the
+    reference's random subsample, driven by the program PRNG)."""
+    anchors = x(ins, "Anchor")         # [A, 4]
+    gt = x(ins, "GtBoxes")             # [G, 4]
+    im_info = x(ins, "ImInfo")
+    batch = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    pos_thr = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_thr = float(attrs.get("rpn_negative_overlap", 0.3))
+    straddle = float(attrs.get("rpn_straddle_thresh", 0.0))
+    use_random = bool(attrs.get("use_random", True))
+
+    a = anchors.shape[0]
+    g = gt.shape[0]
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gt_valid = (gw > 1e-3) & (gh > 1e-3)
+    ix1 = jnp.maximum(anchors[:, None, 0], gt[None, :, 0])
+    iy1 = jnp.maximum(anchors[:, None, 1], gt[None, :, 1])
+    ix2 = jnp.minimum(anchors[:, None, 2], gt[None, :, 2])
+    iy2 = jnp.minimum(anchors[:, None, 3], gt[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1 + 1.0, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + 1.0, 0.0)
+    inter = iw * ih
+    union = aw[:, None] * ah[:, None] + (gw * gh)[None, :] - inter
+    iou = jnp.where(gt_valid[None, :], inter / jnp.maximum(union, 1e-10),
+                    0.0)                                 # [A, G]
+
+    # straddle filter (ref rpn_target_assign_op.cc: anchors overhanging
+    # the image beyond the threshold never enter labeling/sampling)
+    inside = jnp.ones((a,), bool)
+    if im_info is not None and straddle >= 0:
+        imh = im_info.reshape(-1)[0]
+        imw = im_info.reshape(-1)[1]
+        inside = (anchors[:, 0] >= -straddle) & \
+            (anchors[:, 1] >= -straddle) & \
+            (anchors[:, 2] < imw + straddle) & \
+            (anchors[:, 3] < imh + straddle)
+
+    best_gt = jnp.argmax(iou, 1)
+    best_iou = jnp.max(iou, 1)
+    fg = best_iou >= pos_thr
+    # anchors that are the best for some gt are fg too (ref rule)
+    best_per_gt = jnp.max(iou, 0)                         # [G]
+    is_best = jnp.any((iou == best_per_gt[None, :])
+                      & gt_valid[None, :] & (iou > 1e-5), 1)
+    fg = (fg | is_best) & inside
+    bg = (~fg) & (best_iou < neg_thr) & inside
+
+    fg_cap = int(batch * fg_frac)
+    if use_random:
+        key = ctx.next_key()
+        pri = jax.random.uniform(key, (a,))
+    else:
+        pri = jnp.arange(a, dtype=jnp.float32) / a
+    # subsample: order candidates by (random) priority, keep the prefix
+    order = jnp.argsort(jnp.where(fg, pri, 2.0))
+    fg_sorted = fg[order]
+    keep_sorted = jnp.cumsum(fg_sorted) <= fg_cap
+    fg_keep = jnp.zeros((a,), bool).at[order].set(fg_sorted & keep_sorted)
+    n_fg = jnp.sum(fg_keep)
+    bg_cap = batch - n_fg
+    order_b = jnp.argsort(jnp.where(bg, pri, 2.0))
+    bg_sorted = bg[order_b]
+    keep_b = jnp.cumsum(bg_sorted) <= bg_cap
+    bg_keep = jnp.zeros((a,), bool).at[order_b].set(bg_sorted & keep_b)
+
+    label = jnp.where(fg_keep, 1, jnp.where(bg_keep, 0, -1))
+    # regression targets for fg anchors vs their best gt
+    mg = gt[best_gt]
+    mgw = mg[:, 2] - mg[:, 0] + 1.0
+    mgh = mg[:, 3] - mg[:, 1] + 1.0
+    tx = (mg[:, 0] + 0.5 * mgw - (anchors[:, 0] + 0.5 * aw)) / aw
+    ty = (mg[:, 1] + 0.5 * mgh - (anchors[:, 1] + 0.5 * ah)) / ah
+    tw = jnp.log(mgw / aw)
+    th = jnp.log(mgh / ah)
+    tgt = jnp.stack([tx, ty, tw, th], -1)
+    inside_w = jnp.where(fg_keep[:, None], 1.0, 0.0) * jnp.ones((a, 4))
+    return {"ScoreIndex": jnp.nonzero(
+                label >= 0, size=batch, fill_value=0)[0].astype(jnp.int32),
+            "ScoreIndexNum": jnp.sum(label >= 0).astype(jnp.int32),
+            "LocationIndex": jnp.nonzero(
+                fg_keep, size=fg_cap, fill_value=0)[0].astype(jnp.int32),
+            "LocationIndexNum": n_fg.astype(jnp.int32),
+            "TargetLabel": label.astype(jnp.int32),
+            "TargetBBox": jnp.where(fg_keep[:, None], tgt, 0.0),
+            "BBoxInsideWeight": inside_w}

@@ -1,0 +1,240 @@
+"""RPN/FPN proposal op tests (ref: generate_proposals_op.cc,
+distribute_fpn_proposals_op.h, collect_fpn_proposals_op.h,
+rpn_target_assign_op.cc) — static padded-output contracts."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import (Program, program_guard,
+                                       reset_default_programs)
+
+L = fluid.layers
+
+
+def _run(build, feed):
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        outs = build()
+    flat = []
+    spec = []
+    for o in outs:
+        if isinstance(o, (list, tuple)):
+            flat.extend(o)
+            spec.append(len(o))
+        else:
+            flat.append(o)
+            spec.append(None)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        res = [np.asarray(v) for v in
+               exe.run(main, feed=feed, fetch_list=flat)]
+    out = []
+    i = 0
+    for s in spec:
+        if s is None:
+            out.append(res[i])
+            i += 1
+        else:
+            out.append(res[i:i + s])
+            i += s
+    return out
+
+
+def test_generate_proposals_basic():
+    """Two strong anchors far apart survive NMS; weak/tiny ones drop."""
+    h = w = 4
+    a = 2
+    n = 1
+    # anchors laid out [H, W, A, 4]
+    anchors = np.zeros((h, w, a, 2 * 2), np.float32)
+    for i in range(h):
+        for j in range(w):
+            for k in range(a):
+                cx, cy = j * 8 + 4, i * 8 + 4
+                s = 6 + 4 * k
+                anchors[i, j, k] = [cx - s / 2, cy - s / 2,
+                                    cx + s / 2, cy + s / 2]
+    variances = np.ones_like(anchors)
+    scores = np.full((n, a, h, w), -5.0, np.float32)
+    scores[0, 0, 0, 0] = 5.0          # strong box top-left
+    scores[0, 1, 3, 3] = 4.0          # strong box bottom-right
+    deltas = np.zeros((n, 4 * a, h, w), np.float32)
+    im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+
+    def build():
+        sv = L.data("s", shape=[a, h, w])
+        dv = L.data("d", shape=[4 * a, h, w])
+        iv = L.data("i", shape=[3])
+        av = L.assign_value(anchors)
+        vv = L.assign_value(variances)
+        rois, probs, num = L.generate_proposals(
+            sv, dv, iv, av, vv, post_nms_top_n=8, nms_thresh=0.5,
+            min_size=1.0, return_rois_num=True)
+        return [rois, probs, num]
+
+    rois, probs, num = _run(build, {"s": scores, "d": deltas,
+                                    "i": im_info})
+    assert int(num[0]) >= 2
+    # the two top proposals are the two strong anchors (clipped)
+    got = rois[0, :2]
+    assert probs[0, 0, 0] >= probs[0, 1, 0]
+    assert got[0][0] <= 4 and got[0][1] <= 4          # top-left box
+    assert got[1][2] >= 24 and got[1][3] >= 24        # bottom-right box
+
+
+def test_distribute_and_collect_fpn():
+    rois = np.array([
+        [0, 0, 10, 10],        # small → low level
+        [0, 0, 220, 220],      # ~refer_scale → refer level
+        [0, 0, 500, 500],      # large → high level
+        [0, 0, 15, 15],
+    ], np.float32)
+
+    def build():
+        rv = L.data("r", shape=[4])
+        multi, restore, nums = L.distribute_fpn_proposals(
+            rv, min_level=2, max_level=5, refer_level=4, refer_scale=224)
+        return [multi, restore, nums]
+
+    multi, restore, nums = _run(build, {"r": rois})
+    counts = [int(c) for c in nums]
+    assert sum(counts) == 4
+    assert counts[0] == 2          # the two small boxes at level 2
+    np.testing.assert_allclose(multi[0][0], rois[0])
+    np.testing.assert_allclose(multi[0][1], rois[3])
+    # restore index addresses the PADDED level concat (the only concat a
+    # static-shape graph can build) and recovers original order
+    concat = np.concatenate(multi)
+    np.testing.assert_allclose(concat[restore.reshape(-1)], rois)
+
+    scores = [np.array([0.9, 0.1]), np.array([0.5]), np.array([0.7]),
+              np.array([0.0])]
+
+    def build2():
+        mr = [L.assign_value(m) for m in multi]
+        ms = [L.assign_value(np.pad(s, (0, 4 - len(s))).astype(
+            np.float32)) for s in scores]
+        out, num = L.collect_fpn_proposals(
+            mr, ms, 2, 5, post_nms_top_n=3)
+        return [out, num]
+
+    # feed per-level padded scores matching multi's padding
+    out, num = _run(build2, {})
+    assert out.shape == (3, 4)
+    assert int(num) == 3
+
+
+def test_rpn_target_assign_labels_and_sampling():
+    anchors = np.array([
+        [0, 0, 10, 10],         # iou with gt0 high
+        [0, 0, 9, 9],
+        [50, 50, 60, 60],       # background
+        [100, 100, 110, 110],   # background
+        [200, 200, 210, 210],   # background
+    ], np.float32)
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+
+    def build():
+        av = L.assign_value(anchors)
+        gv = L.data("g", shape=[4])
+        outs = L.rpn_target_assign(
+            None, None, av, None, gv,
+            rpn_batch_size_per_im=4, rpn_fg_fraction=0.5,
+            rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+            use_random=False)
+        return list(outs)
+
+    score_idx, loc_idx, label, tgt, inw = _run(
+        build, {"g": gt})[0:5]
+    label = np.asarray(label)
+    assert label[0] == 1                   # perfect-match anchor is fg
+    assert (label == 0).sum() >= 2         # backgrounds sampled
+    assert (label >= 0).sum() <= 4         # batch cap respected
+    # fg regression target for anchor 0 vs identical gt is ~zero
+    np.testing.assert_allclose(np.asarray(tgt)[0], 0.0, atol=1e-5)
+    assert np.asarray(inw)[0].sum() == 4.0
+
+
+def test_rpn_target_assign_gathered_reference_surface():
+    """With bbox_pred/cls_logits the layer returns the reference 5-tuple
+    (gathered preds + targets); pad rows carry target -1 / zero weights."""
+    anchors = np.array([[0, 0, 10, 10], [40, 40, 50, 50],
+                        [100, 100, 110, 110]], np.float32)
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    rng = np.random.RandomState(0)
+    logits = rng.randn(3, 1).astype(np.float32)
+    preds = rng.randn(3, 4).astype(np.float32)
+
+    def build():
+        av = L.assign_value(anchors)
+        gv = L.data("g", shape=[4])
+        cl = L.assign_value(logits)
+        bp = L.assign_value(preds)
+        outs = L.rpn_target_assign(
+            bp, cl, av, None, gv, rpn_batch_size_per_im=4,
+            rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+            rpn_negative_overlap=0.3, use_random=False)
+        return list(outs)
+
+    sp, lp, st, lt, inw = _run(build, {"g": gt})[0:5]
+    assert sp.shape == (4, 1) and lp.shape == (2, 4)
+    st = np.asarray(st).reshape(-1)
+    # 3 real samples (1 fg + 2 bg), 1 pad marked -1
+    assert (st >= 0).sum() == 3 and (st == -1).sum() == 1
+    # gathered loc target for the fg anchor is ~zero (identical gt)
+    np.testing.assert_allclose(np.asarray(lt)[0], 0.0, atol=1e-5)
+
+
+def test_rpn_target_assign_straddle_excludes_outside_anchors():
+    anchors = np.array([[0, 0, 10, 10],        # inside
+                        [-20, -20, -5, -5],    # fully outside
+                        [30, 30, 40, 40]], np.float32)
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    im_info = np.array([[48.0, 48.0, 1.0]], np.float32)
+
+    def build():
+        av = L.assign_value(anchors)
+        gv = L.data("g", shape=[4])
+        iv = L.data("i", shape=[3])
+        outs = L.rpn_target_assign(
+            None, None, av, None, gv, im_info=iv,
+            rpn_batch_size_per_im=3, rpn_straddle_thresh=0.0,
+            use_random=False)
+        return list(outs)
+
+    _, _, label, _, _ = _run(build, {"g": gt, "i": im_info})[0:5]
+    label = np.asarray(label)
+    assert label[1] == -1       # overhanging anchor excluded entirely
+    assert label[0] == 1
+
+
+def test_psroi_and_prroi_pool():
+    rng = np.random.RandomState(5)
+    # psroi: C = oc * ph * pw = 2*2*2 = 8
+    feat = rng.rand(1, 8, 6, 6).astype(np.float32)
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+
+    def build():
+        fv = L.data("f", shape=[8, 6, 6])
+        rv = L.assign_value(rois)
+        ps = L.psroi_pool(fv, rv, output_channels=2, spatial_scale=1.0,
+                          pooled_height=2, pooled_width=2)
+        fv2 = L.data("f2", shape=[3, 6, 6])
+        pr = L.prroi_pool(fv2, rv, spatial_scale=1.0, pooled_height=2,
+                          pooled_width=2)
+        return [ps, pr]
+
+    feat2 = rng.rand(1, 3, 6, 6).astype(np.float32)
+    ps, pr = _run(build, {"f": feat, "f2": feat2})
+    assert ps.shape == (1, 2, 2, 2)
+    assert pr.shape == (1, 3, 2, 2)
+    # psroi bin (0,0) of channel 0 averages input channel 0 over rows 0-1
+    want00 = feat[0, 0, 0:2, 0:2].mean()
+    np.testing.assert_allclose(ps[0, 0, 0, 0], want00, rtol=1e-5)
+    # psroi bin (0,1) of channel 0 uses input channel 1
+    want01 = feat[0, 1, 0:2, 2:4].mean()
+    np.testing.assert_allclose(ps[0, 0, 0, 1], want01, rtol=1e-5)
+    assert np.isfinite(pr).all()
