@@ -16,6 +16,8 @@ __all__ = [
     "polygon_box_transform", "ssd_loss", "detection_output",
     "yolov3_loss", "generate_proposals", "distribute_fpn_proposals",
     "collect_fpn_proposals", "rpn_target_assign", "psroi_pool", "prroi_pool",
+    "deformable_conv", "deformable_roi_pooling",
+    "retinanet_target_assign", "retinanet_detection_output",
 ]
 
 
@@ -331,7 +333,8 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
 
 
 def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
-                             refer_scale, pixel_offset=True, name=None):
+                             refer_scale, pixel_offset=True,
+                             rois_num=None, name=None):
     """ref: layers/detection.py distribute_fpn_proposals.  Static: each
     level tensor is [R, 4] front-compacted; counts in MultiLevelRoIsNum."""
     helper = LayerHelper("distribute_fpn_proposals")
@@ -342,8 +345,11 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     nums = [helper.create_variable_for_type_inference("int32", ())
             for _ in range(num_lvl)]
     restore = helper.create_variable_for_type_inference("int32", (r, 1))
+    d_ins = {"FpnRois": [fpn_rois]}
+    if rois_num is not None:
+        d_ins["RoisNum"] = [rois_num]
     helper.append_op(type="distribute_fpn_proposals",
-                     inputs={"FpnRois": [fpn_rois]},
+                     inputs=d_ins,
                      outputs={"MultiFpnRois": multi,
                               "MultiLevelRoIsNum": nums,
                               "RestoreIndex": [restore]},
@@ -407,6 +413,8 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
             "float32", (a, 4)),
     }
     ins = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
     if im_info is not None:
         ins["ImInfo"] = [im_info]
     helper.append_op(type="rpn_target_assign", inputs=ins,
@@ -482,3 +490,142 @@ def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
                 "pooled_width": pooled_width},
                {"Out": ((r, c, pooled_height, pooled_width),
                         "float32")})["Out"]
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=None,
+                    deformable_groups=None, im2col_step=None,
+                    param_attr=None, bias_attr=None, modulated=True,
+                    name=None):
+    """ref: layers/nn.py deformable_conv (v2 modulated / v1)."""
+    helper = LayerHelper("deformable_conv")
+    cin = int(input.shape[1])
+    g = groups or 1
+    dg = deformable_groups or 1
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 2
+    st = stride if isinstance(stride, (list, tuple)) else [stride] * 2
+    pd = padding if isinstance(padding, (list, tuple)) else [padding] * 2
+    dl = dilation if isinstance(dilation, (list, tuple)) \
+        else [dilation] * 2
+    w = helper.create_parameter(param_attr,
+                                [num_filters, cin // g] + list(k),
+                                input.dtype)
+    ho = offset.shape[2]
+    wo = offset.shape[3]
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (input.shape[0], num_filters, ho, wo))
+    ins = {"Input": [input], "Offset": [offset], "Filter": [w]}
+    op_type = "deformable_conv" if modulated else "deformable_conv_v1"
+    if modulated:
+        ins["Mask"] = [mask]
+    helper.append_op(type=op_type, inputs=ins,
+                     outputs={"Output": [out]},
+                     attrs={"strides": list(st), "paddings": list(pd),
+                            "dilations": list(dl), "groups": g,
+                            "deformable_groups": dg})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        from .math_ops import elementwise_add
+        out = elementwise_add(out, b, axis=1)
+    return out
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, name=None):
+    """ref: layers/nn.py deformable_roi_pooling →
+    deformable_psroi_pooling_op.cc."""
+    r = rois.shape[0]
+    c = int(input.shape[1])
+    oc = c // (pooled_height * pooled_width) if position_sensitive else c
+    if not position_sensitive:
+        raise NotImplementedError(
+            "deformable_roi_pooling currently requires "
+            "position_sensitive=True (PS-RoI form; C = out*ph*pw)")
+    ph, pw = pooled_height, pooled_width
+    part = part_size or (ph, pw)
+    ins = {"Input": input, "ROIs": rois}
+    if not no_trans:
+        ins["Trans"] = trans
+    return _op("deformable_psroi_pooling", ins,
+               {"no_trans": no_trans, "spatial_scale": spatial_scale,
+                "output_dim": oc, "pooled_height": ph, "pooled_width": pw,
+                "part_height": part[0], "part_width": part[1],
+                "sample_per_part": sample_per_part,
+                "trans_std": trans_std},
+               {"Output": ((r, oc, ph, pw), "float32"),
+                "TopCount": ((r, oc, ph, pw), "float32")})["Output"]
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None,
+                            im_info=None, num_classes=1,
+                            positive_overlap=0.5, negative_overlap=0.4):
+    """ref: layers/detection.py retinanet_target_assign.  Static
+    contract: per-anchor label (-1 ignore / 0 bg / 1-based class),
+    targets, inside weights, and the foreground count."""
+    helper = LayerHelper("retinanet_target_assign")
+    a = anchor_box.shape[0]
+    outs = {
+        "TargetLabel": helper.create_variable_for_type_inference(
+            "int32", (a,)),
+        "TargetBBox": helper.create_variable_for_type_inference(
+            "float32", (a, 4)),
+        "BBoxInsideWeight": helper.create_variable_for_type_inference(
+            "float32", (a, 4)),
+        "ForegroundNumber": helper.create_variable_for_type_inference(
+            "int32", ()),
+    }
+    ins = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+           "GtLabels": [gt_labels]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
+    helper.append_op(type="retinanet_target_assign", inputs=ins,
+                     outputs={k: [v] for k, v in outs.items()},
+                     attrs={"positive_overlap": positive_overlap,
+                            "negative_overlap": negative_overlap})
+    if bbox_pred is None or cls_logits is None:
+        return (outs["TargetLabel"], outs["TargetBBox"],
+                outs["BBoxInsideWeight"], outs["ForegroundNumber"])
+    # reference 6-tuple surface.  Focal loss consumes EVERY anchor, so
+    # the static form returns per-anchor tensors (no gather needed):
+    # label -1 rows are the ignores the reference's gather removed.
+    from . import tensor_ops as tensor
+    score_pred = tensor.reshape(cls_logits, [a, -1])
+    loc_pred = tensor.reshape(bbox_pred, [a, 4])
+    score_tgt = tensor.reshape(outs["TargetLabel"], [a, 1])
+    return (score_pred, loc_pred, score_tgt, outs["TargetBBox"],
+            outs["BBoxInsideWeight"], outs["ForegroundNumber"])
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """ref: layers/detection.py retinanet_detection_output.  Static
+    contract: [keep_top_k, 6] rows (label, score, x1, y1, x2, y2), pad
+    rows -1, plus the valid count."""
+    if nms_eta < 1.0:
+        raise NotImplementedError(
+            "retinanet_detection_output adaptive NMS (nms_eta < 1) is "
+            "not lowered — silently running plain NMS would change the "
+            "detection set")
+    helper = LayerHelper("retinanet_detection_output")
+    out = helper.create_variable_for_type_inference(
+        "float32", (keep_top_k, 6))
+    num = helper.create_variable_for_type_inference("int32", ())
+    helper.append_op(type="retinanet_detection_output",
+                     inputs={"BBoxes": list(bboxes),
+                             "Scores": list(scores),
+                             "Anchors": list(anchors),
+                             "ImInfo": [im_info]},
+                     outputs={"Out": [out], "NmsRoisNum": [num]},
+                     attrs={"score_threshold": score_threshold,
+                            "nms_top_k": nms_top_k,
+                            "keep_top_k": keep_top_k,
+                            "nms_threshold": nms_threshold})
+    return out, num

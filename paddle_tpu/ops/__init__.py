@@ -22,5 +22,8 @@ from . import breadth2_ops  # noqa: F401
 from . import crf_ops       # noqa: F401
 from . import yolo_loss_op  # noqa: F401
 from . import proposal_ops  # noqa: F401
+from . import deform_ops    # noqa: F401
+from . import breadth3_ops  # noqa: F401
+from . import recsys_ops    # noqa: F401
 from . import pipeline_op   # noqa: F401
 from . import ps_ops        # noqa: F401

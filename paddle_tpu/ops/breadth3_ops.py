@@ -1,0 +1,462 @@
+"""Breadth sweep, part 3: sync batch-norm, proximal optimizers, the
+remaining loss/metric ops, pooling variants, and tensor utilities
+(ref files named per op)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+
+
+# ---------------------------------------------------------------------------
+# sync_batch_norm — BN whose statistics are reduced across the data-
+# parallel axis (ref: operators/sync_batch_norm_op.cu synchronises via
+# NCCL; here the SAME op runs inside shard_map, so the reduction is one
+# psum over the dp axis)
+# ---------------------------------------------------------------------------
+
+
+@register("sync_batch_norm")
+def _sync_batch_norm(ctx, ins, attrs):
+    a = x(ins, "X")                   # NCHW (or NC...)
+    scale, bias = x(ins, "Scale"), x(ins, "Bias")
+    mean_in = x(ins, "Mean")
+    var_in = x(ins, "Variance")
+    momentum = attrs.get("momentum", 0.9)
+    eps = attrs.get("epsilon", 1e-5)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    if attrs.get("data_layout", "NCHW") == "NHWC":
+        axes = tuple(range(a.ndim - 1))
+        shape = (1,) * (a.ndim - 1) + (-1,)
+    else:
+        axes = (0,) + tuple(range(2, a.ndim))
+        shape = (1, -1) + (1,) * (a.ndim - 2)
+    if is_test:
+        mean = mean_in
+        var = var_in
+    else:
+        mean = jnp.mean(a.astype(jnp.float32), axes)
+        sq = jnp.mean(jnp.square(a.astype(jnp.float32)), axes)
+        # cross-replica statistics: average over every axis the batch is
+        # sharded on (the NCCL allreduce in the reference's CUDA kernel)
+        for ax in ctx.axis_names:
+            mean = lax.pmean(mean, ax)
+            sq = lax.pmean(sq, ax)
+        var = sq - mean * mean
+    inv = lax.rsqrt(var + eps)
+    out = (a - mean.reshape(shape)) * inv.reshape(shape)
+    if scale is not None:
+        out = out * scale.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    res = {"Y": out.astype(a.dtype),
+           "SavedMean": mean, "SavedVariance": inv}
+    if not is_test and mean_in is not None:
+        res["MeanOut"] = momentum * mean_in + (1 - momentum) * mean
+        res["VarianceOut"] = momentum * var_in + (1 - momentum) * var
+    return res
+
+
+# ---------------------------------------------------------------------------
+# optimizers
+# ---------------------------------------------------------------------------
+
+
+@register("proximal_gd")
+def _proximal_gd(ctx, ins, attrs):
+    """ref: optimizers/proximal_gd_op.h — GD with l1/l2 proximal step."""
+    p, g, lr = x(ins, "Param"), x(ins, "Grad"), x(ins, "LearningRate")
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr = lr.reshape(())
+    prox = p - lr * g
+    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / \
+        (1.0 + lr * l2)
+    return {"ParamOut": out}
+
+
+@register("proximal_adagrad")
+def _proximal_adagrad(ctx, ins, attrs):
+    """ref: optimizers/proximal_adagrad_op.h."""
+    p, g, m, lr = (x(ins, "Param"), x(ins, "Grad"), x(ins, "Moment"),
+                   x(ins, "LearningRate"))
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr = lr.reshape(())
+    m_out = m + g * g
+    lr_t = lr / jnp.sqrt(m_out)
+    prox = p - lr_t * g
+    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0) / \
+        (1.0 + lr_t * l2)
+    return {"ParamOut": out, "MomentOut": m_out}
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+@register("bce_loss")
+def _bce_loss(ctx, ins, attrs):
+    """ref: operators/bce_loss_op.cc — on probabilities (not logits)."""
+    p = x(ins, "X")
+    label = x(ins, "Label").astype(p.dtype)
+    p = jnp.clip(p, 1e-12, 1.0 - 1e-7)
+    return {"Out": -(label * jnp.log(p) + (1 - label) * jnp.log(1 - p))}
+
+
+@register("nll_loss")
+def _nll_loss(ctx, ins, attrs):
+    """ref: operators/nll_loss_op.cc — negative log-likelihood over
+    log-probability inputs."""
+    logp = x(ins, "X")                # [N, C]
+    label = x(ins, "Label").reshape(-1).astype(jnp.int32)
+    weight = x(ins, "Weight")
+    ignore = int(attrs.get("ignore_index", -100))
+    reduction = attrs.get("reduction", "mean")
+    picked = -jnp.take_along_axis(logp, label[:, None], 1)[:, 0]
+    wl = weight.reshape(-1)[label] if weight is not None else \
+        jnp.ones_like(picked)
+    valid = label != ignore
+    picked = jnp.where(valid, picked * wl, 0.0)
+    tw = jnp.sum(jnp.where(valid, wl, 0.0))
+    if reduction == "mean":
+        out = jnp.sum(picked) / jnp.maximum(tw, 1e-12)
+    elif reduction == "sum":
+        out = jnp.sum(picked)
+    else:
+        out = picked
+    return {"Out": out, "Total_weight": tw}
+
+
+@register("modified_huber_loss")
+def _modified_huber_loss(ctx, ins, attrs):
+    """ref: operators/modified_huber_loss_op.h — classification loss on
+    y ∈ {0,1}: z = 2y-1; loss = max(0,1-zx)^2 for zx >= -1 else -4zx."""
+    a = x(ins, "X").reshape(-1)
+    y = x(ins, "Y").reshape(-1).astype(a.dtype)
+    z = (2.0 * y - 1.0) * a
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.square(jnp.maximum(1.0 - z, 0.0)))
+    return {"Out": loss.reshape(-1, 1),
+            "IntermediateVal": z.reshape(-1, 1)}
+
+
+@register("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    a, b = x(ins, "X"), x(ins, "Y")
+    d = a - b
+    return {"Out": jnp.sum(jnp.square(d), -1, keepdims=True),
+            "sub_result": d}
+
+
+@register("l1_norm")
+def _l1_norm(ctx, ins, attrs):
+    return {"Out": jnp.sum(jnp.abs(x(ins, "X")))}
+
+
+@register("frobenius_norm")
+def _frobenius_norm(ctx, ins, attrs):
+    a = x(ins, "X")
+    axes = tuple(attrs.get("dim", range(a.ndim)))
+    keep = attrs.get("keep_dim", False)
+    return {"Out": jnp.sqrt(jnp.sum(jnp.square(a), axes, keepdims=keep))}
+
+
+@register("allclose")
+def _allclose(ctx, ins, attrs):
+    a, b = x(ins, "Input"), x(ins, "Other")
+    return {"Out": jnp.allclose(a, b, rtol=float(attrs.get("rtol", 1e-5)),
+                                atol=float(attrs.get("atol", 1e-8)),
+                                equal_nan=attrs.get("equal_nan", False))}
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+@register("auc")
+def _auc(ctx, ins, attrs):
+    """ref: operators/metrics/auc_op.h — thresholded-histogram AUC with
+    running stat buffers (StatPos/StatNeg)."""
+    probs = x(ins, "Predict")         # [N, 2] (binary) or [N, 1]
+    label = x(ins, "Label").reshape(-1)
+    stat_pos = x(ins, "StatPos")
+    stat_neg = x(ins, "StatNeg")
+    k = int(attrs.get("num_thresholds", 200))
+    p1 = probs[:, -1]
+    bucket = jnp.clip((p1 * k).astype(jnp.int32), 0, k)
+    pos = jnp.zeros((k + 1,), jnp.float32)
+    pos = pos.at[bucket].add((label > 0).astype(pos.dtype))
+    neg = jnp.zeros_like(pos).at[bucket].add((label <= 0).astype(pos.dtype))
+    if stat_pos is not None:
+        pos = pos + stat_pos.reshape(-1).astype(pos.dtype)
+        neg = neg + stat_neg.reshape(-1).astype(pos.dtype)
+    # trapezoid sweep from the highest-score bucket down: each bucket
+    # contributes its negatives × (positives above + half its own)
+    rp = pos[::-1]
+    rn = neg[::-1]
+    p_above = jnp.cumsum(rp) - rp
+    area = jnp.sum(rn * (p_above + 0.5 * rp))
+    denom = jnp.sum(pos) * jnp.sum(neg)
+    auc = jnp.where(denom > 0, area / jnp.maximum(denom, 1e-12), 0.0)
+    return {"AUC": auc.astype(jnp.float32),
+            "StatPosOut": pos, "StatNegOut": neg}
+
+
+@register("precision_recall")
+def _precision_recall(ctx, ins, attrs):
+    """ref: operators/metrics/precision_recall_op.h — micro/macro P/R/F1
+    from per-class tp/fp/fn state."""
+    pred = x(ins, "Indices").reshape(-1)     # predicted class ids
+    label = x(ins, "Labels").reshape(-1)
+    c = int(attrs["class_number"])
+    states = x(ins, "StatesInfo")
+    tp = jnp.zeros((c,), jnp.float32).at[pred].add(
+        (pred == label).astype(jnp.float32))
+    fp = jnp.zeros((c,), jnp.float32).at[pred].add(
+        (pred != label).astype(jnp.float32))
+    fn = jnp.zeros((c,), jnp.float32).at[label].add(
+        (pred != label).astype(jnp.float32))
+
+    def metrics(tp_, fp_, fn_):
+        prec = tp_ / jnp.maximum(tp_ + fp_, 1e-12)
+        rec = tp_ / jnp.maximum(tp_ + fn_, 1e-12)
+        f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-12)
+        macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+        mtp, mfp, mfn = tp_.sum(), fp_.sum(), fn_.sum()
+        mp = mtp / jnp.maximum(mtp + mfp, 1e-12)
+        mr = mtp / jnp.maximum(mtp + mfn, 1e-12)
+        micro = jnp.stack(
+            [mp, mr, 2 * mp * mr / jnp.maximum(mp + mr, 1e-12)])
+        return jnp.concatenate([macro, micro])
+
+    batch = metrics(tp, fp, fn)      # current batch ONLY (ref contract)
+    if states is not None:
+        tp = tp + states[:, 0]
+        fp = fp + states[:, 1]
+        fn = fn + states[:, 3]
+    states_out = jnp.stack([tp, fp, jnp.zeros_like(tp), fn], -1)
+    return {"BatchMetrics": batch,
+            "AccumMetrics": metrics(tp, fp, fn),
+            "AccumStatesInfo": states_out}
+
+
+@register("positive_negative_pair")
+def _positive_negative_pair(ctx, ins, attrs):
+    """ref: operators/positive_negative_pair_op.h — ranking pair counts
+    per query."""
+    score = x(ins, "Score").reshape(-1)
+    label = x(ins, "Label").reshape(-1)
+    qid = x(ins, "QueryID").reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    better = (label[:, None] > label[None, :])
+    pos = jnp.sum(same_q & better & (score[:, None] > score[None, :]))
+    neg = jnp.sum(same_q & better & (score[:, None] < score[None, :]))
+    neu = jnp.sum(same_q & better & (score[:, None] == score[None, :]))
+    f = jnp.float32
+    return {"PositivePair": pos.astype(f).reshape(1),
+            "NegativePair": neg.astype(f).reshape(1),
+            "NeutralPair": neu.astype(f).reshape(1)}
+
+
+# ---------------------------------------------------------------------------
+# pooling variants
+# ---------------------------------------------------------------------------
+
+
+@register("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx, ins, attrs):
+    """ref: operators/pool_with_index_op.cc — max pool + argmax indices
+    (flattened per feature map, the reference's Mask convention)."""
+    a = x(ins, "X")                   # NCHW
+    k = attrs["ksize"]
+    st = attrs.get("strides", k)
+    pd = attrs.get("paddings", [0, 0])
+    n, c, h, w = a.shape
+    oh = (h + 2 * pd[0] - k[0]) // st[0] + 1
+    ow = (w + 2 * pd[1] - k[1]) // st[1] + 1
+    neg = jnp.full((n, c, h + 2 * pd[0], w + 2 * pd[1]), -jnp.inf,
+                   a.dtype)
+    neg = neg.at[:, :, pd[0]:pd[0] + h, pd[1]:pd[1] + w].set(a)
+    patches = []
+    idxs = []
+    for i in range(k[0]):
+        for j in range(k[1]):
+            sl = neg[:, :, i:i + st[0] * oh:st[0], j:j + st[1] * ow:st[1]]
+            patches.append(sl)
+            yy = jnp.arange(oh) * st[0] + i - pd[0]
+            xx = jnp.arange(ow) * st[1] + j - pd[1]
+            idxs.append((yy[:, None] * w + xx[None, :]))
+    stack = jnp.stack(patches, -1)               # [N,C,oh,ow,kk]
+    which = jnp.argmax(stack, -1)
+    out = jnp.max(stack, -1)
+    flat_idx = jnp.stack([jnp.broadcast_to(ix, (oh, ow)) for ix in idxs],
+                         -1)                     # [oh,ow,kk]
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(flat_idx, (n, c, oh, ow, k[0] * k[1])),
+        which[..., None], -1)[..., 0]
+    return {"Out": out, "Mask": mask.astype(jnp.int32)}
+
+
+@register("unpool")
+def _unpool(ctx, ins, attrs):
+    """ref: operators/unpool_op.cc — max-unpool via stored indices."""
+    a = x(ins, "X")                   # [N, C, h, w]
+    idx = x(ins, "Indices")           # same shape, flat positions in out
+    oh, ow = attrs["unpooled_size"] if "unpooled_size" in attrs else (
+        a.shape[2] * attrs.get("strides", [2, 2])[0],
+        a.shape[3] * attrs.get("strides", [2, 2])[1])
+    n, c, h, w = a.shape
+    out = jnp.zeros((n, c, oh * ow), a.dtype)
+    flat = a.reshape(n, c, h * w)
+    fidx = idx.reshape(n, c, h * w).astype(jnp.int32)
+    ni = jnp.arange(n)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    out = out.at[ni, ci, fidx].add(flat)
+    return {"Out": out.reshape(n, c, oh, ow)}
+
+
+@register("spp")
+def _spp(ctx, ins, attrs):
+    """ref: operators/spp_op.cc — spatial pyramid pooling: concat of
+    adaptive pools at 1,2,4,… bins."""
+    a = x(ins, "X")
+    levels = int(attrs.get("pyramid_height", 3))
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = a.shape
+    levels_out = []
+    for l in range(levels):
+        bins = 2 ** l
+        ys = [i * h // bins for i in range(bins)] + [h]
+        xs = [i * w // bins for i in range(bins)] + [w]
+        cells = []
+        for i in range(bins):
+            for j in range(bins):
+                patch = a[:, :, ys[i]:max(ys[i + 1], ys[i] + 1),
+                          xs[j]:max(xs[j + 1], xs[j] + 1)]
+                v = patch.max((2, 3)) if ptype == "max" \
+                    else patch.mean((2, 3))
+                cells.append(v)
+        # reference layout: per level, [N, C*bins*bins] (channel-major
+        # within the level), levels concatenated
+        levels_out.append(jnp.stack(cells, -1).reshape(n, -1))
+    return {"Out": jnp.concatenate(levels_out, 1)}
+
+
+@register("conv_shift")
+def _conv_shift(ctx, ins, attrs):
+    """ref: operators/conv_shift_op.cc — circular correlation
+    (NTM-style): out[b, i] = Σ_j x[b, (i + j - M//2) mod N] * y[b, j]."""
+    a, b = x(ins, "X"), x(ins, "Y")   # [B, N], [B, M]
+    n = a.shape[1]
+    m = b.shape[1]
+    half = m // 2
+    cols = []
+    for j in range(m):
+        cols.append(jnp.roll(a, half - j, axis=1) * b[:, j:j + 1])
+    return {"Out": sum(cols)}
+
+
+# ---------------------------------------------------------------------------
+# tensor utilities
+# ---------------------------------------------------------------------------
+
+
+@register("randperm")
+def _randperm(ctx, ins, attrs):
+    n = int(attrs["n"])
+    return {"Out": jax.random.permutation(ctx.next_key(), n).astype(
+        jnp.int64)}
+
+
+@register("seed")
+def _seed(ctx, ins, attrs):
+    return {"Out": jnp.asarray([int(attrs.get("seed", 0))], jnp.int32)}
+
+
+@register("minus")
+def _minus(ctx, ins, attrs):
+    return {"Out": x(ins, "X") - x(ins, "Y")}
+
+
+@register("partial_concat")
+def _partial_concat(ctx, ins, attrs):
+    """ref: operators/partial_concat_op.cc — concat a column slice of
+    every input."""
+    xs = ins.get("X", [])
+    start = int(attrs.get("start_index", 0))
+    length = int(attrs.get("length", -1))
+    parts = []
+    for v in xs:
+        end = v.shape[1] if length < 0 else start + length
+        parts.append(v[:, start:end])
+    return {"Out": jnp.concatenate(parts, 1)}
+
+
+@register("partial_sum")
+def _partial_sum(ctx, ins, attrs):
+    xs = ins.get("X", [])
+    start = int(attrs.get("start_index", 0))
+    length = int(attrs.get("length", -1))
+    acc = None
+    for v in xs:
+        end = v.shape[1] if length < 0 else start + length
+        sl = v[:, start:end]
+        acc = sl if acc is None else acc + sl
+    return {"Out": acc}
+
+
+@register("shuffle_batch")
+def _shuffle_batch(ctx, ins, attrs):
+    a = x(ins, "X")
+    key = ctx.next_key()
+    perm = jax.random.permutation(key, a.shape[0])
+    return {"Out": a[perm], "ShuffleIdx": perm.astype(jnp.int64),
+            "SeedOut": jnp.zeros((1,), jnp.int64)}
+
+
+@register("sequence_erase")
+def _sequence_erase(ctx, ins, attrs):
+    """ref: sequence_erase_op.cc — drop listed tokens; dense contract:
+    erased positions compact to the front, pad with 0, new Length out."""
+    a = x(ins, "X")                   # [B, T] ids
+    tokens = jnp.asarray(attrs.get("tokens", []), a.dtype)
+    length = x(ins, "Length")
+    b, t = a.shape
+    keep = jnp.all(a[:, :, None] != tokens[None, None, :], -1) \
+        if tokens.size else jnp.ones((b, t), bool)
+    if length is not None:
+        keep = keep & (jnp.arange(t)[None, :] < length.reshape(-1, 1))
+    pos = jnp.cumsum(keep, 1) - 1
+    out = jnp.zeros_like(a)
+    bi = jnp.repeat(jnp.arange(b)[:, None], t, 1)
+    tgt = jnp.where(keep, pos, t - 1)
+    out = out.at[bi.reshape(-1), tgt.reshape(-1)].max(
+        jnp.where(keep, a, jnp.zeros_like(a)).reshape(-1))
+    return {"Out": out, "Length": jnp.sum(keep, 1).astype(jnp.int64)}
+
+
+@register("sequence_topk_avg_pooling")
+def _sequence_topk_avg_pooling(ctx, ins, attrs):
+    """ref: sequence_topk_avg_pooling_op.cc — average of the top-k
+    values per channel over time."""
+    a = x(ins, "X")                   # [B, T, C]
+    topks = list(attrs.get("topks", [1]))
+    length = x(ins, "Length")
+    if length is not None:
+        mask = jnp.arange(a.shape[1])[None, :, None] < \
+            length.reshape(-1, 1, 1)
+        a = jnp.where(mask, a, -jnp.inf)
+    srt = jnp.sort(a, axis=1)[:, ::-1]          # descending over T
+    outs = []
+    for k in topks:
+        k = min(k, a.shape[1])
+        top = srt[:, :k]
+        top = jnp.where(jnp.isfinite(top), top, 0.0)
+        outs.append(top.mean(1))
+    return {"Out": jnp.concatenate(outs, -1), "pos": jnp.zeros((1,))}

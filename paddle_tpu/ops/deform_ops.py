@@ -1,0 +1,149 @@
+"""Deformable convolution ops (ref: operators/deformable_conv_op.cc v2
+modulated, deformable_conv_v1_op.cc, deformable_psroi_pooling_op.cc).
+
+The reference im2col's at offset positions in CUDA; here the sampled
+patch tensor is built with one vectorised bilinear gather (zero outside
+the map, as the reference's deformable_im2col does) and contracted with
+the filter on the MXU — the natural XLA form of the same math."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+from .detection_ops import _bilinear_zero
+
+
+def _deform_conv(ctx, ins, attrs, modulated):
+    a = x(ins, "Input")               # [N, Cin, H, W]
+    offset = x(ins, "Offset")         # [N, 2*dg*kh*kw, Ho, Wo]
+    mask = x(ins, "Mask") if modulated else None
+    filt = x(ins, "Filter")           # [Cout, Cin/g, kh, kw]
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0])
+    dils = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1) or 1
+    dg = attrs.get("deformable_groups", 1) or 1
+    n, cin, h, w = a.shape
+    cout, cpg, kh, kw = filt.shape
+    ho = offset.shape[2]
+    wo = offset.shape[3]
+    if groups != 1:
+        raise NotImplementedError(
+            "deformable_conv with groups != 1 is not lowered yet")
+
+    base_y = (jnp.arange(ho)[:, None] * strides[0] - pads[0])
+    base_x = (jnp.arange(wo)[None, :] * strides[1] - pads[1])
+    ks_y = jnp.arange(kh)[:, None] * dils[0]
+    ks_x = jnp.arange(kw)[None, :] * dils[1]
+
+    def per_image(img, off, m):
+        # off [2*dg*kh*kw, Ho, Wo] — per (dg, k, {y,x}) channel layout
+        off = off.reshape(dg, kh * kw, 2, ho, wo)
+        if m is not None:
+            m = m.reshape(dg, kh * kw, ho, wo)
+        cols = []
+        cpd = cin // dg                  # channels per deformable group
+        for d in range(dg):
+            gcols = []
+            for ki in range(kh):
+                for kj in range(kw):
+                    kidx = ki * kw + kj
+                    gy = base_y + ks_y[ki, 0] + off[d, kidx, 0]
+                    gx = base_x + ks_x[0, kj] + off[d, kidx, 1]
+                    v = _bilinear_zero(img[d * cpd:(d + 1) * cpd],
+                                       gy, gx)      # [cpd, Ho, Wo]
+                    if m is not None:
+                        v = v * m[d, kidx]
+                    gcols.append(v)
+            cols.append(jnp.stack(gcols, 1))         # [cpd, khkw, Ho, Wo]
+        col = jnp.concatenate(cols, 0).reshape(dg, cpd, kh * kw, ho, wo)
+        col = col.reshape(cin, kh * kw, ho, wo)
+        return jnp.einsum("ckhw,ock->ohw",
+                          col, filt.reshape(cout, cin, kh * kw))
+
+    if mask is not None:
+        out = jax.vmap(per_image)(a, offset, mask)
+    else:
+        out = jax.vmap(lambda i, o: per_image(i, o, None))(a, offset)
+    return {"Output": out}
+
+
+@register("deformable_conv")
+def _deformable_conv(ctx, ins, attrs):
+    """ref: deformable_conv_op.cc — modulated (v2) deformable conv."""
+    return _deform_conv(ctx, ins, attrs, modulated=True)
+
+
+@register("deformable_conv_v1")
+def _deformable_conv_v1(ctx, ins, attrs):
+    """ref: deformable_conv_v1_op.cc — offsets only, no modulation."""
+    return _deform_conv(ctx, ins, attrs, modulated=False)
+
+
+@register("deformable_psroi_pooling")
+def _deformable_psroi_pooling(ctx, ins, attrs):
+    """ref: deformable_psroi_pooling_op.cc — PS-RoI pooling with learned
+    per-bin offsets (trans input), trans_std-scaled."""
+    a = x(ins, "Input")
+    rois = x(ins, "ROIs")
+    trans = x(ins, "Trans")           # [R, 2, ph, pw] bin offsets
+    no_trans = bool(attrs.get("no_trans", False))
+    scale = attrs.get("spatial_scale", 1.0)
+    oc = attrs["output_dim"]
+    ph = attrs.get("pooled_height", attrs.get("pooled_size", 1))
+    pw = attrs.get("pooled_width", attrs.get("pooled_size", 1))
+    part_h = attrs.get("part_height", attrs.get("part_size", ph))
+    part_w = attrs.get("part_width", attrs.get("part_size", pw))
+    sample = int(attrs.get("sample_per_part", 4))
+    trans_std = attrs.get("trans_std", 0.1)
+    n, c, h, w = a.shape
+    if c != oc * ph * pw:
+        raise ValueError(
+            f"deformable_psroi_pooling expects position-sensitive input "
+            f"channels output_dim*ph*pw = {oc * ph * pw}, got {c}")
+    r = rois.shape[0]
+    roi_batch = x(ins, "RoisNum")
+    from .detection_ops import _roi_batch_idx
+    batch_idx = _roi_batch_idx(roi_batch, r)
+
+    def one_roi(roi, tr, bi):
+        x0 = roi[0] * scale - 0.5
+        y0 = roi[1] * scale - 0.5
+        x1 = (roi[2] + 1.0) * scale - 0.5
+        y1 = (roi[3] + 1.0) * scale - 0.5
+        rw = jnp.maximum(x1 - x0, 0.1)
+        rh = jnp.maximum(y1 - y0, 0.1)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        img = a[bi].reshape(oc, (c // oc), h, w)
+        vals = []
+        for i in range(ph):
+            row = []
+            for j in range(pw):
+                if no_trans:
+                    dy = dx = 0.0
+                else:
+                    dy = tr[0, i, j] * trans_std * rh
+                    dx = tr[1, i, j] * trans_std * rw
+                sy = y0 + i * bin_h + dy + \
+                    (jnp.arange(sample) + 0.5) * bin_h / sample
+                sx = x0 + j * bin_w + dx + \
+                    (jnp.arange(sample) + 0.5) * bin_w / sample
+                gy = jnp.repeat(sy, sample)
+                gx = jnp.tile(sx, sample)
+                grp = img[:, i * pw + j]                 # [oc, H, W]
+                # ref kernel averages over IN-MAP samples only — dividing
+                # by the full grid would bias border bins toward zero
+                supported = (gy > -1) & (gy < h) & (gx > -1) & (gx < w)
+                cnt = jnp.maximum(jnp.sum(supported), 1)
+                v = jnp.sum(_bilinear_zero(grp, gy, gx), -1) / cnt
+                row.append(v)
+            vals.append(jnp.stack(row, -1))
+        return jnp.stack(vals, -2)        # [oc, ph, pw]
+
+    out = jax.vmap(one_roi)(rois, trans if trans is not None
+                            else jnp.zeros((r, 2, ph, pw)), batch_idx)
+    return {"Output": out, "TopCount": jnp.zeros_like(out)}

@@ -411,6 +411,28 @@ def _bipartite_match(ctx, ins, attrs):
             "ColToRowMatchDist": col_dist[None, :]}
 
 
+def _bilinear_zero(img, gy, gx):
+    """Sample img [C, H, W] at float coords; identically ZERO outside the
+    map (the bilinear extension has support only on (-1, H)×(-1, W) —
+    clamping alone would leak border rows for far-outside coords)."""
+    c, h, w = img.shape
+    pad = jnp.pad(img, [(0, 0), (1, 1), (1, 1)])
+    y0 = jnp.floor(gy)
+    x0 = jnp.floor(gx)
+    y0i = jnp.clip(y0.astype(jnp.int32) + 1, 0, h + 1)
+    x0i = jnp.clip(x0.astype(jnp.int32) + 1, 0, w + 1)
+    y1i = jnp.clip(y0i + 1, 0, h + 1)
+    x1i = jnp.clip(x0i + 1, 0, w + 1)
+    wy = jnp.clip(gy - y0, 0, 1)
+    wx = jnp.clip(gx - x0, 0, 1)
+    v = (pad[:, y0i, x0i] * (1 - wy) * (1 - wx)
+         + pad[:, y0i, x1i] * (1 - wy) * wx
+         + pad[:, y1i, x0i] * wy * (1 - wx)
+         + pad[:, y1i, x1i] * wy * wx)
+    support = (gy > -1) & (gy < h) & (gx > -1) & (gx < w)
+    return v * support.astype(v.dtype)
+
+
 def _roi_batch_idx(roi_batch, n_rois):
     """RoisNum [N] (boxes per image) -> per-roi image index [R]; all
     rois belong to image 0 when absent."""
@@ -626,20 +648,10 @@ def _prroi_pool(ctx, ins, attrs):
             (jnp.arange(q)[None, None, None, :] + 0.5) * rw / (pw * q)
         gy = jnp.broadcast_to(gy, (ph, pw, q, q)).reshape(-1)
         gx = jnp.broadcast_to(gx, (ph, pw, q, q)).reshape(-1)
-        # the PrRoI integrand is bilinear INSIDE the map and zero outside
-        # (ref prroi_pool_op.h) — read through a zero-padded map so
-        # out-of-bounds corners contribute zeros, not replicated borders
-        img = jnp.pad(a[bi], [(0, 0), (1, 1), (1, 1)])
-        y0i = jnp.clip(jnp.floor(gy).astype(jnp.int32) + 1, 0, h + 1)
-        x0i = jnp.clip(jnp.floor(gx).astype(jnp.int32) + 1, 0, w + 1)
-        y1i = jnp.clip(y0i + 1, 0, h + 1)
-        x1i = jnp.clip(x0i + 1, 0, w + 1)
-        wy = jnp.clip(gy - jnp.floor(gy), 0, 1)
-        wx = jnp.clip(gx - jnp.floor(gx), 0, 1)
-        v = (img[:, y0i, x0i] * (1 - wy) * (1 - wx)
-             + img[:, y0i, x1i] * (1 - wy) * wx
-             + img[:, y1i, x0i] * wy * (1 - wx)
-             + img[:, y1i, x1i] * wy * wx)
+        # the PrRoI integrand is bilinear INSIDE the map and zero
+        # outside (ref prroi_pool_op.h) — _bilinear_zero implements
+        # exactly that boundary convention
+        v = _bilinear_zero(a[bi], gy, gx)
         return v.reshape(c, ph, pw, q * q).mean(-1)
 
     return {"Out": jax.vmap(one_roi)(rois, batch_idx)}

@@ -75,19 +75,23 @@ def _generate_proposals(ctx, ins, attrs):
         hs = boxes[:, 3] - boxes[:, 1] + 1.0
         ok = (ws >= min_size * info[2]) & (hs >= min_size * info[2])
         top_sc = jnp.where(ok, top_sc, NEG)
+        # NMS over the FULL pre_nms pool: suppressed high-rank boxes are
+        # replaced by lower-ranked distinct survivors (truncating to
+        # post_n first would make pre_nms_topN inert)
         keep, order2, kept_sc = _nms_class(boxes, top_sc, nms_thresh,
-                                           min(post_n, k),
-                                           normalized=False)
+                                           k, normalized=False)
         kept_boxes = boxes[order2]
         valid = (keep > 0) & (kept_sc > NEG / 2)
-        # stable compaction to the front
+        # stable compaction to the front, capped at post_n survivors;
+        # invalid rows target an out-of-bounds slot, which jax scatter
+        # DROPS — no duplicate-index write hazard on the last slot
         pos = jnp.cumsum(valid) - 1
-        out_b = jnp.zeros((post_n, 4), boxes.dtype)
-        out_s = jnp.full((post_n,), 0.0, sc_i.dtype)
-        tgt = jnp.where(valid, pos, post_n - 1)
-        out_b = out_b.at[tgt].set(jnp.where(valid[:, None], kept_boxes,
-                                            out_b[tgt]))
-        out_s = out_s.at[tgt].set(jnp.where(valid, kept_sc, out_s[tgt]))
+        valid = valid & (pos < post_n)
+        tgt = jnp.where(valid, pos, post_n)
+        out_b = jnp.zeros((post_n, 4), boxes.dtype).at[tgt].set(
+            kept_boxes, mode="drop")
+        out_s = jnp.zeros((post_n,), sc_i.dtype).at[tgt].set(
+            kept_sc, mode="drop")
         return out_b, out_s, jnp.sum(valid)
 
     rois, probs, counts = jax.vmap(per_image)(sc, dl, im_info)
@@ -102,6 +106,7 @@ def _distribute_fpn_proposals(ctx, ins, attrs):
     refer_level), clamped.  Outputs: per-level padded roi tensors +
     per-level counts + RestoreIndex."""
     rois = x(ins, "FpnRois")           # [R, 4]
+    rois_num = x(ins, "RoisNum")       # valid count (pad rows excluded)
     min_level = int(attrs["min_level"])
     max_level = int(attrs["max_level"])
     refer_level = int(attrs["refer_level"])
@@ -114,6 +119,11 @@ def _distribute_fpn_proposals(ctx, ins, attrs):
     scale = jnp.sqrt(jnp.maximum(ws * hs, 1e-12))
     lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
     lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    # generate_proposals-style padded inputs: rows past RoisNum are pads
+    # and must not land in ANY level (they'd all bucket to min_level)
+    if rois_num is not None:
+        lvl = jnp.where(jnp.arange(r) < rois_num.reshape(()).astype(
+            jnp.int32), lvl, -1)
 
     num_levels = max_level - min_level + 1
     outs = {}
@@ -122,9 +132,8 @@ def _distribute_fpn_proposals(ctx, ins, attrs):
     for li in range(num_levels):
         sel = lvl == (min_level + li)
         pos = jnp.cumsum(sel) - 1
-        out = jnp.zeros((r, 4), rois.dtype)
-        tgt = jnp.where(sel, pos, r - 1)
-        out = out.at[tgt].set(jnp.where(sel[:, None], rois, out[tgt]))
+        tgt = jnp.where(sel, pos, r)          # OOB → dropped by scatter
+        out = jnp.zeros((r, 4), rois.dtype).at[tgt].set(rois, mode="drop")
         multi.append(out)
         counts.append(jnp.sum(sel).astype(jnp.int32))
         # restore index: original position of the i-th row of this level
@@ -172,6 +181,43 @@ def _collect_fpn_proposals(ctx, ins, attrs):
             "RoisNum": jnp.sum(top > NEG / 2).astype(jnp.int32)}
 
 
+def _anchor_gt_iou(anchors, gt):
+    """Pairwise IoU [A, G] in the reference's +1-extent pixel convention,
+    with per-gt validity (w, h > eps)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    # validity on RAW extents: zero-padded gt rows ([0,0,0,0]) must not
+    # count as 1×1 boxes under the +1 convention, or the best-per-gt
+    # rule would force a spurious fg anchor per pad row
+    gt_valid = (gt[:, 2] - gt[:, 0] > 1e-3) & \
+        (gt[:, 3] - gt[:, 1] > 1e-3)
+    ix1 = jnp.maximum(anchors[:, None, 0], gt[None, :, 0])
+    iy1 = jnp.maximum(anchors[:, None, 1], gt[None, :, 1])
+    ix2 = jnp.minimum(anchors[:, None, 2], gt[None, :, 2])
+    iy2 = jnp.minimum(anchors[:, None, 3], gt[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1 + 1.0, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + 1.0, 0.0)
+    inter = iw * ih
+    union = aw[:, None] * ah[:, None] + (gw * gh)[None, :] - inter
+    iou = jnp.where(gt_valid[None, :], inter / jnp.maximum(union, 1e-10),
+                    0.0)
+    return iou, gt_valid, aw, ah
+
+
+def _encode_targets(anchors, gt, best_gt, aw, ah):
+    """Per-anchor regression deltas toward its best gt (ref encoding)."""
+    mg = gt[best_gt]
+    mgw = mg[:, 2] - mg[:, 0] + 1.0
+    mgh = mg[:, 3] - mg[:, 1] + 1.0
+    tx = (mg[:, 0] + 0.5 * mgw - (anchors[:, 0] + 0.5 * aw)) / aw
+    ty = (mg[:, 1] + 0.5 * mgh - (anchors[:, 1] + 0.5 * ah)) / ah
+    tw = jnp.log(mgw / aw)
+    th = jnp.log(mgh / ah)
+    return jnp.stack([tx, ty, tw, th], -1)
+
+
 @register("rpn_target_assign")
 def _rpn_target_assign(ctx, ins, attrs):
     """ref: rpn_target_assign_op.cc — label anchors against gt boxes and
@@ -190,26 +236,23 @@ def _rpn_target_assign(ctx, ins, attrs):
     straddle = float(attrs.get("rpn_straddle_thresh", 0.0))
     use_random = bool(attrs.get("use_random", True))
 
+    crowd = x(ins, "IsCrowd")
     a = anchors.shape[0]
-    g = gt.shape[0]
-    aw = anchors[:, 2] - anchors[:, 0] + 1.0
-    ah = anchors[:, 3] - anchors[:, 1] + 1.0
-    gw = gt[:, 2] - gt[:, 0] + 1.0
-    gh = gt[:, 3] - gt[:, 1] + 1.0
-    gt_valid = (gw > 1e-3) & (gh > 1e-3)
-    ix1 = jnp.maximum(anchors[:, None, 0], gt[None, :, 0])
-    iy1 = jnp.maximum(anchors[:, None, 1], gt[None, :, 1])
-    ix2 = jnp.minimum(anchors[:, None, 2], gt[None, :, 2])
-    iy2 = jnp.minimum(anchors[:, None, 3], gt[None, :, 3])
-    iw = jnp.maximum(ix2 - ix1 + 1.0, 0.0)
-    ih = jnp.maximum(iy2 - iy1 + 1.0, 0.0)
-    inter = iw * ih
-    union = aw[:, None] * ah[:, None] + (gw * gh)[None, :] - inter
-    iou = jnp.where(gt_valid[None, :], inter / jnp.maximum(union, 1e-10),
-                    0.0)                                 # [A, G]
+    iou, gt_valid, aw, ah = _anchor_gt_iou(anchors, gt)
+    if crowd is not None:
+        # crowd regions are not real targets: they never match as fg, and
+        # anchors overlapping them past neg_thr are ignored entirely
+        # (ref rpn_target_assign_op.cc filters crowd gts the same way)
+        crowd = crowd.reshape(-1).astype(bool)
+        crowd_iou = jnp.max(jnp.where(crowd[None, :], iou, 0.0), 1)
+        gt_valid = gt_valid & (~crowd)
+        iou = jnp.where(crowd[None, :], 0.0, iou)
+    else:
+        crowd_iou = jnp.zeros((a,))
 
-    # straddle filter (ref rpn_target_assign_op.cc: anchors overhanging
-    # the image beyond the threshold never enter labeling/sampling)
+    # straddle filter (ref: anchors overhanging the image beyond the
+    # threshold never enter labeling/sampling) — applied BEFORE the
+    # best-per-gt rule so a border gt still gets its best INSIDE anchor
     inside = jnp.ones((a,), bool)
     if im_info is not None and straddle >= 0:
         imh = im_info.reshape(-1)[0]
@@ -218,6 +261,7 @@ def _rpn_target_assign(ctx, ins, attrs):
             (anchors[:, 1] >= -straddle) & \
             (anchors[:, 2] < imw + straddle) & \
             (anchors[:, 3] < imh + straddle)
+    iou = jnp.where(inside[:, None], iou, 0.0)
 
     best_gt = jnp.argmax(iou, 1)
     best_iou = jnp.max(iou, 1)
@@ -227,7 +271,7 @@ def _rpn_target_assign(ctx, ins, attrs):
     is_best = jnp.any((iou == best_per_gt[None, :])
                       & gt_valid[None, :] & (iou > 1e-5), 1)
     fg = (fg | is_best) & inside
-    bg = (~fg) & (best_iou < neg_thr) & inside
+    bg = (~fg) & (best_iou < neg_thr) & inside & (crowd_iou < neg_thr)
 
     fg_cap = int(batch * fg_frac)
     if use_random:
@@ -248,15 +292,7 @@ def _rpn_target_assign(ctx, ins, attrs):
     bg_keep = jnp.zeros((a,), bool).at[order_b].set(bg_sorted & keep_b)
 
     label = jnp.where(fg_keep, 1, jnp.where(bg_keep, 0, -1))
-    # regression targets for fg anchors vs their best gt
-    mg = gt[best_gt]
-    mgw = mg[:, 2] - mg[:, 0] + 1.0
-    mgh = mg[:, 3] - mg[:, 1] + 1.0
-    tx = (mg[:, 0] + 0.5 * mgw - (anchors[:, 0] + 0.5 * aw)) / aw
-    ty = (mg[:, 1] + 0.5 * mgh - (anchors[:, 1] + 0.5 * ah)) / ah
-    tw = jnp.log(mgw / aw)
-    th = jnp.log(mgh / ah)
-    tgt = jnp.stack([tx, ty, tw, th], -1)
+    tgt = _encode_targets(anchors, gt, best_gt, aw, ah)
     inside_w = jnp.where(fg_keep[:, None], 1.0, 0.0) * jnp.ones((a, 4))
     return {"ScoreIndex": jnp.nonzero(
                 label >= 0, size=batch, fill_value=0)[0].astype(jnp.int32),
@@ -267,3 +303,99 @@ def _rpn_target_assign(ctx, ins, attrs):
             "TargetLabel": label.astype(jnp.int32),
             "TargetBBox": jnp.where(fg_keep[:, None], tgt, 0.0),
             "BBoxInsideWeight": inside_w}
+
+
+@register("retinanet_target_assign")
+def _retinanet_target_assign(ctx, ins, attrs):
+    """ref: retinanet_target_assign_op.cc — like rpn_target_assign but
+    WITHOUT subsampling (focal loss consumes every anchor): positives
+    are iou >= positive_overlap (plus best-per-gt), negatives
+    iou < negative_overlap, rest ignored; also emits fg_num for the
+    focal-loss normaliser."""
+    anchors = x(ins, "Anchor")
+    gt = x(ins, "GtBoxes")
+    gt_labels = x(ins, "GtLabels")
+    crowd = x(ins, "IsCrowd")
+    pos_thr = float(attrs.get("positive_overlap", 0.5))
+    neg_thr = float(attrs.get("negative_overlap", 0.4))
+    a = anchors.shape[0]
+    iou, gt_valid, aw, ah = _anchor_gt_iou(anchors, gt)
+    if crowd is not None:
+        crowd = crowd.reshape(-1).astype(bool)
+        crowd_iou = jnp.max(jnp.where(crowd[None, :], iou, 0.0), 1)
+        gt_valid = gt_valid & (~crowd)
+        iou = jnp.where(crowd[None, :], 0.0, iou)
+    else:
+        crowd_iou = jnp.zeros((a,))
+    best_gt = jnp.argmax(iou, 1)
+    best_iou = jnp.max(iou, 1)
+    best_per_gt = jnp.max(iou, 0)
+    is_best = jnp.any((iou == best_per_gt[None, :]) & gt_valid[None, :]
+                      & (iou > 1e-5), 1)
+    fg = (best_iou >= pos_thr) | is_best
+    bg = (~fg) & (best_iou < neg_thr) & (crowd_iou < neg_thr)
+    # label = 1-based gt class for fg (focal loss convention: 0 = bg),
+    # 0 for bg, -1 ignored
+    glab = gt_labels.reshape(-1)[best_gt].astype(jnp.int32)
+    label = jnp.where(fg, glab, jnp.where(bg, 0, -1))
+    tgt = _encode_targets(anchors, gt, best_gt, aw, ah)
+    return {"TargetLabel": label,
+            "TargetBBox": jnp.where(fg[:, None], tgt, 0.0),
+            "BBoxInsideWeight": jnp.where(fg[:, None], 1.0,
+                                          0.0) * jnp.ones((a, 4)),
+            "ForegroundNumber": jnp.maximum(
+                jnp.sum(fg), 1).astype(jnp.int32)}
+
+
+@register("retinanet_detection_output")
+def _retinanet_detection_output(ctx, ins, attrs):
+    """ref: retinanet_detection_output_op.cc — per-level score threshold
+    + top-k, decode against anchors, then class-wise NMS across levels.
+    Static contract: [keep_top_k, 6] padded rows label=-1 + count."""
+    from .detection_ops import _nms_class
+    bboxes = ins.get("BBoxes", [])     # per level [A_l, 4] deltas
+    scores = ins.get("Scores", [])     # per level [A_l, C] sigmoid scores
+    anchors = ins.get("Anchors", [])   # per level [A_l, 4]
+    im_info = x(ins, "ImInfo")
+    score_thr = float(attrs.get("score_threshold", 0.05))
+    nms_top_k = int(attrs.get("nms_top_k", 1000))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    nms_thr = float(attrs.get("nms_threshold", 0.3))
+
+    all_boxes, all_scores = [], []
+    imh = im_info.reshape(-1)[0]
+    imw = im_info.reshape(-1)[1]
+    for dl, sc, an in zip(bboxes, scores, anchors):
+        var = jnp.ones_like(an)
+        dec = _decode(an, dl, var)
+        dec = jnp.stack([jnp.clip(dec[:, 0], 0, imw - 1),
+                         jnp.clip(dec[:, 1], 0, imh - 1),
+                         jnp.clip(dec[:, 2], 0, imw - 1),
+                         jnp.clip(dec[:, 3], 0, imh - 1)], -1)
+        all_boxes.append(dec)
+        all_scores.append(sc)
+    boxes = jnp.concatenate(all_boxes, 0)        # [A, 4]
+    probs = jnp.concatenate(all_scores, 0)       # [A, C]
+    c = probs.shape[1]
+    outs, outscores, outlabels = [], [], []
+    for cls in range(c):
+        s = jnp.where(probs[:, cls] >= score_thr, probs[:, cls], NEG)
+        keep, order, kept_sc = _nms_class(boxes, s, nms_thr,
+                                          min(nms_top_k, s.shape[0]),
+                                          normalized=False)
+        valid = (keep > 0) & (kept_sc > NEG / 2)
+        outs.append(boxes[order])
+        outscores.append(jnp.where(valid, kept_sc, NEG))
+        outlabels.append(jnp.full(kept_sc.shape, cls, jnp.int32))
+    cat_boxes = jnp.concatenate(outs, 0)
+    cat_scores = jnp.concatenate(outscores, 0)
+    cat_labels = jnp.concatenate(outlabels, 0)
+    k = min(keep_top_k, cat_scores.shape[0])
+    top, order = lax.top_k(cat_scores, k)
+    valid = top > NEG / 2
+    out = jnp.full((keep_top_k, 6), -1.0)
+    rows = jnp.concatenate(
+        [cat_labels[order][:, None].astype(jnp.float32),
+         top[:, None], cat_boxes[order]], -1)
+    out = out.at[jnp.arange(k)].set(jnp.where(valid[:, None], rows, -1.0))
+    return {"Out": out, "NmsRoisNum": jnp.sum(valid).astype(jnp.int32)}

@@ -1,0 +1,124 @@
+"""Recommendation / text-matching ops (ref: operators/tdm_child_op.h,
+tdm_sampler_op.h, batch_fc_op.cc, match_matrix_tensor_op.cc).
+
+TDM (tree-based deep match) ops keep the reference's tree-info layout:
+``TreeInfo[node] = [item_id, layer_id, ancestor_id, child_0..child_n]``.
+Layer node lists are dense-padded with per-layer counts (the LoD analog
+used throughout this framework)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+
+
+@register("tdm_child")
+def _tdm_child(ctx, ins, attrs):
+    """ref: tdm_child_op.h — children of each input node from the tree
+    info table; mask marks children that are items (leaf payloads)."""
+    ids = x(ins, "X").astype(jnp.int32)          # [...], node ids
+    info = x(ins, "TreeInfo").astype(jnp.int32)  # [nodes, 3 + child_nums]
+    child_nums = int(attrs.get("child_nums", info.shape[1] - 3))
+    flat = ids.reshape(-1)
+    has_child = (flat != 0) & (info[flat, 3] != 0)
+    children = info[flat][:, 3:3 + child_nums]       # [N, C]
+    children = jnp.where(has_child[:, None], children, 0)
+    is_item = (info[children.reshape(-1), 0] != 0).reshape(children.shape)
+    mask = jnp.where(has_child[:, None], is_item.astype(jnp.int32), 0)
+    out_shape = tuple(ids.shape) + (child_nums,)
+    return {"Child": children.reshape(out_shape).astype(jnp.int64),
+            "LeafMask": mask.reshape(out_shape).astype(jnp.int64)}
+
+
+@register("tdm_sampler")
+def _tdm_sampler(ctx, ins, attrs):
+    """ref: tdm_sampler_op.h — per tree layer: the positive node from the
+    item's travel path plus ``neg_num`` negatives sampled uniformly from
+    that layer's nodes (excluding the positive, by re-draw rejection in
+    the reference; here by shifted modular sampling, which also never
+    returns the positive)."""
+    travel = x(ins, "Travel").astype(jnp.int32)    # [N, L] path node ids
+    layer = x(ins, "Layer").astype(jnp.int32)      # [L, maxN] padded
+    layer_counts = x(ins, "LayerCounts")
+    neg_list = list(attrs["neg_samples_num_list"])
+    output_positive = bool(attrs.get("output_positive", True))
+    n, l = travel.shape
+    if layer_counts is None:
+        counts = jnp.full((l,), layer.shape[1], jnp.int32)
+    else:
+        counts = layer_counts.reshape(-1).astype(jnp.int32)
+
+    outs, labels, masks = [], [], []
+    for li in range(l):
+        pos = travel[:, li]                         # [N]
+        cnt = counts[li]
+        valid_layer = pos > 0                       # pad paths excluded
+        row = []
+        lab = []
+        if output_positive:
+            row.append(pos)
+            lab.append(jnp.ones((n,), jnp.int32))
+        neg_num = neg_list[li] if li < len(neg_list) else neg_list[-1]
+        # position of the positive within the layer list
+        pos_idx = jnp.argmax(
+            (layer[li][None, :] == pos[:, None]).astype(jnp.int32), 1)
+        key = ctx.next_key()
+        draws = jax.random.randint(key, (n, neg_num), 0,
+                                   jnp.maximum(cnt - 1, 1))
+        # shift draws past the positive's slot → uniform over the other
+        # cnt-1 nodes, never the positive
+        draws = jnp.where(draws >= pos_idx[:, None], draws + 1, draws)
+        draws = jnp.clip(draws, 0, jnp.maximum(cnt - 1, 0))
+        negs = layer[li][draws]                     # [N, neg]
+        for k in range(neg_num):
+            row.append(negs[:, k])
+            lab.append(jnp.zeros((n,), jnp.int32))
+        stacked = jnp.stack(row, -1)                # [N, 1+neg]
+        outs.append(jnp.where(valid_layer[:, None], stacked, 0))
+        labels.append(jnp.where(valid_layer[:, None],
+                                jnp.stack(lab, -1), 0))
+        masks.append(jnp.where(valid_layer[:, None],
+                               jnp.ones_like(stacked), 0))
+    out = jnp.concatenate(outs, -1)
+    return {"Out": out.astype(jnp.int64)[..., None],
+            "Labels": jnp.concatenate(labels, -1).astype(
+                jnp.int64)[..., None],
+            "Mask": jnp.concatenate(masks, -1).astype(
+                jnp.int64)[..., None]}
+
+
+@register("batch_fc")
+def _batch_fc(ctx, ins, attrs):
+    """ref: batch_fc_op.cc — per-slot FC: Out[s] = X[s] @ W[s] + b[s]."""
+    a = x(ins, "Input")               # [slot, ins, in]
+    w = x(ins, "W")                   # [slot, in, out]
+    b = x(ins, "Bias")                # [slot, 1, out]
+    out = jnp.einsum("sni,sio->sno", a, w)
+    if b is not None:
+        out = out + b
+    return {"Out": out}
+
+
+@register("match_matrix_tensor")
+def _match_matrix_tensor(ctx, ins, attrs):
+    """ref: match_matrix_tensor_op.cc — bilinear interaction tensor for
+    text matching: out[b, t, i, j] = x_i ᵀ W_t y_j.  Dense contract:
+    X [B, Tx, D], Y [B, Ty, D] (+ optional LengthX/LengthY masks)."""
+    a = x(ins, "X")
+    b = x(ins, "Y")
+    w = x(ins, "W")                   # [D, dim_t, D]
+    lx = x(ins, "LengthX")
+    ly = x(ins, "LengthY")
+    out = jnp.einsum("bid,dte,bje->btij", a, w, b)
+    if lx is not None:
+        m = jnp.arange(a.shape[1])[None, None, :, None] < \
+            lx.reshape(-1, 1, 1, 1)
+        out = jnp.where(m, out, 0.0)
+    if ly is not None:
+        m = jnp.arange(b.shape[1])[None, None, None, :] < \
+            ly.reshape(-1, 1, 1, 1)
+        out = jnp.where(m, out, 0.0)
+    return {"Out": out, "Tmp": jnp.zeros_like(a)}

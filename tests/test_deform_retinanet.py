@@ -1,0 +1,149 @@
+"""Deformable conv (v1/v2) + retinanet target-assign/detection-output
+(ref: deformable_conv_op.cc, deformable_psroi_pooling_op.cc,
+retinanet_target_assign_op.cc, retinanet_detection_output_op.cc)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import (Program, program_guard,
+                                       reset_default_programs)
+
+L = fluid.layers
+
+
+def _run(build, feed):
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        outs = build()
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return [np.asarray(v) for v in
+                exe.run(main, feed=feed, fetch_list=list(outs))]
+
+
+def test_deformable_conv_zero_offsets_match_plain_conv():
+    """With zero offsets and unit mask, deformable conv == regular conv."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(1, 2, 6, 6).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+
+    def build():
+        xv = L.data("x", shape=[2, 6, 6])
+        off = L.data("off", shape=[2 * 9, 6, 6])
+        msk = L.data("msk", shape=[9, 6, 6])
+        init = fluid.ParamAttr(
+            initializer=fluid.initializer.NumpyArrayInitializer(w))
+        d = L.deformable_conv(xv, off, msk, 3, 3, padding=1,
+                              param_attr=init, bias_attr=False)
+        c = L.conv2d(xv, 3, 3, padding=1, param_attr=fluid.ParamAttr(
+            initializer=fluid.initializer.NumpyArrayInitializer(w)),
+            bias_attr=False)
+        d1 = L.deformable_conv(xv, off, None, 3, 3, padding=1,
+                               param_attr=fluid.ParamAttr(
+                                   initializer=fluid.initializer.
+                                   NumpyArrayInitializer(w)),
+                               bias_attr=False, modulated=False)
+        return d, c, d1
+
+    feed = {"x": x, "off": np.zeros((1, 18, 6, 6), np.float32),
+            "msk": np.ones((1, 9, 6, 6), np.float32)}
+    d, c, d1 = _run(build, feed)
+    np.testing.assert_allclose(d, c, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(d1, c, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_offsets_shift_sampling():
+    """An integer offset of (0, 1) everywhere equals conv of the
+    x-shifted image (interior columns)."""
+    rng = np.random.RandomState(1)
+    x = rng.rand(1, 1, 6, 6).astype(np.float32)
+    w = rng.randn(1, 1, 1, 1).astype(np.float32)   # 1x1 kernel
+
+    def build():
+        xv = L.data("x", shape=[1, 6, 6])
+        off = L.data("off", shape=[2, 6, 6])
+        msk = L.data("msk", shape=[1, 6, 6])
+        return L.deformable_conv(
+            xv, off, msk, 1, 1, param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(w)),
+            bias_attr=False)
+
+    off = np.zeros((1, 2, 6, 6), np.float32)
+    off[:, 1] = 1.0          # x-offset +1
+    out, = _run(build, {"x": x, "off": off,
+                        "msk": np.ones((1, 1, 6, 6), np.float32)})
+    np.testing.assert_allclose(out[0, 0, :, :-1], w[0, 0, 0, 0]
+                               * x[0, 0, :, 1:], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(out[0, 0, :, -1], 0.0, atol=1e-6)
+
+
+def test_deformable_roi_pooling_ps():
+    rng = np.random.RandomState(2)
+    feat = rng.rand(1, 8, 6, 6).astype(np.float32)   # oc=2, ph=pw=2
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    trans = np.zeros((1, 2, 2, 2), np.float32)
+
+    def build():
+        fv = L.data("f", shape=[8, 6, 6])
+        rv = L.assign_value(rois)
+        tv = L.assign_value(trans)
+        return L.deformable_roi_pooling(
+            fv, rv, tv, spatial_scale=1.0, pooled_height=2,
+            pooled_width=2, sample_per_part=4, position_sensitive=True)
+
+    out, = _run(build, {"f": feat})
+    assert out.shape == (1, 2, 2, 2)
+    assert np.isfinite(out).all()
+
+
+def test_retinanet_target_assign_no_sampling():
+    anchors = np.array([[0, 0, 10, 10], [0, 0, 9, 9],
+                        [50, 50, 60, 60], [100, 100, 110, 110]],
+                       np.float32)
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    gt_lab = np.array([[3]], np.int64)     # class id (1-based convention)
+
+    def build():
+        av = L.assign_value(anchors)
+        gv = L.data("g", shape=[4])
+        lv = L.data("l", shape=[1], dtype="int64")
+        outs = L.retinanet_target_assign(None, None, av, None, gv, lv,
+                                         positive_overlap=0.5,
+                                         negative_overlap=0.4)
+        return list(outs)
+
+    label, tgt, inw, fg_num = _run(build, {"g": gt, "l": gt_lab})
+    label = np.asarray(label)
+    assert label[0] == 3                  # fg carries the gt class
+    assert label[1] == 3 or label[1] in (0, -1)
+    assert (label == 0).sum() >= 2        # all far anchors are bg (no cap)
+    assert int(fg_num) >= 1
+    np.testing.assert_allclose(np.asarray(tgt)[0], 0.0, atol=1e-5)
+
+
+def test_retinanet_detection_output():
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+    deltas = np.zeros((2, 4), np.float32)
+    scores = np.array([[0.9, 0.02], [0.03, 0.8]], np.float32)
+    im_info = np.array([[40.0, 40.0, 1.0]], np.float32)
+
+    def build():
+        av = L.assign_value(anchors)
+        dv = L.assign_value(deltas)
+        sv = L.assign_value(scores)
+        iv = L.data("i", shape=[3])
+        out, num = L.retinanet_detection_output(
+            [dv], [sv], [av], iv, score_threshold=0.1, keep_top_k=5)
+        return [out, num]
+
+    out, num = _run(build, {"i": im_info})
+    assert int(num) == 2
+    assert out.shape == (5, 6)
+    # best detection: class 0 @ score .9 on the first anchor
+    assert out[0][0] == 0.0 and abs(out[0][1] - 0.9) < 1e-5
+    assert out[1][0] == 1.0 and abs(out[1][1] - 0.8) < 1e-5
+    assert (out[2:] == -1).all()

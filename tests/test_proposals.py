@@ -238,3 +238,19 @@ def test_psroi_and_prroi_pool():
     want01 = feat[0, 1, 0:2, 2:4].mean()
     np.testing.assert_allclose(ps[0, 0, 0, 1], want01, rtol=1e-5)
     assert np.isfinite(pr).all()
+
+
+def test_distribute_fpn_masks_pad_rows():
+    """Padded generate_proposals output + RoisNum: pads land in NO level."""
+    rois = np.array([[0, 0, 10, 10], [0, 0, 220, 220],
+                     [0, 0, 0, 0], [0, 0, 0, 0]], np.float32)  # 2 pads
+
+    def build():
+        rv = L.data("r", shape=[4])
+        nv = L.assign_value(np.array([2], np.int32))
+        multi, restore, nums = L.distribute_fpn_proposals(
+            rv, 2, 5, 4, 224, rois_num=nv)
+        return [multi, restore, nums]
+
+    multi, restore, nums = _run(build, {"r": rois})
+    assert sum(int(c) for c in nums) == 2       # pads excluded

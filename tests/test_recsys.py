@@ -1,0 +1,80 @@
+"""TDM / batch_fc / match_matrix_tensor ops (ref: tdm_child_op.h,
+tdm_sampler_op.h, batch_fc_op.cc, match_matrix_tensor_op.cc)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import OPS, LoweringContext
+
+
+def _op(name, ins, attrs=None):
+    ctx = LoweringContext(jax.random.PRNGKey(0))
+    return OPS[name](ctx, {k: [jnp.asarray(v)] for k, v in ins.items()},
+                     attrs or {})
+
+
+def test_tdm_child():
+    # TreeInfo: [item_id, layer, ancestor, child0, child1]
+    info = np.array([
+        [0, 0, 0, 0, 0],      # node 0: padding
+        [0, 0, 0, 2, 3],      # node 1: root, children 2,3
+        [5, 1, 1, 0, 0],      # node 2: leaf (item 5)
+        [0, 1, 1, 4, 0],      # node 3: internal, child 4
+        [9, 2, 3, 0, 0],      # node 4: leaf (item 9)
+    ], np.int64)
+    out = _op("tdm_child", {"X": np.array([[1], [2], [3]], np.int64),
+                            "TreeInfo": info}, {"child_nums": 2})
+    child = np.asarray(out["Child"]).reshape(3, 2)
+    mask = np.asarray(out["LeafMask"]).reshape(3, 2)
+    np.testing.assert_array_equal(child[0], [2, 3])   # root's children
+    np.testing.assert_array_equal(mask[0], [1, 0])    # 2 is item, 3 not
+    np.testing.assert_array_equal(child[1], [0, 0])   # leaf: no children
+    np.testing.assert_array_equal(child[2], [4, 0])
+
+
+def test_tdm_sampler_no_positive_collision():
+    travel = np.array([[1, 3], [2, 5]], np.int64)     # paths per item
+    layer = np.array([[1, 2, 0, 0], [3, 4, 5, 6]], np.int64)
+    counts = np.array([2, 4], np.int64)
+    out = _op("tdm_sampler",
+              {"Travel": travel, "Layer": layer, "LayerCounts": counts},
+              {"neg_samples_num_list": [1, 2], "output_positive": True})
+    o = np.asarray(out["Out"])[..., 0]
+    lab = np.asarray(out["Labels"])[..., 0]
+    # layout: [pos_l0, neg_l0, pos_l1, neg_l1 x2]
+    assert o.shape == (2, 5)
+    np.testing.assert_array_equal(o[:, 0], [1, 2])    # positives layer 0
+    np.testing.assert_array_equal(lab[:, 0], [1, 1])
+    np.testing.assert_array_equal(o[:, 2], [3, 5])    # positives layer 1
+    # negatives never equal the positive of their layer
+    assert o[0, 1] != 1 and o[1, 1] != 2
+    assert all(o[0, 3:] != 3) and all(o[1, 3:] != 5)
+    # negatives come from the right layer's node set
+    assert set(o[:, 1]) <= {1, 2} and set(o[0, 3:]) <= {3, 4, 5, 6}
+
+
+def test_batch_fc():
+    rng = np.random.RandomState(0)
+    a = rng.rand(3, 4, 5).astype(np.float32)
+    w = rng.rand(3, 5, 2).astype(np.float32)
+    b = rng.rand(3, 1, 2).astype(np.float32)
+    out = np.asarray(_op("batch_fc", {"Input": a, "W": w,
+                                      "Bias": b})["Out"])
+    want = np.einsum("sni,sio->sno", a, w) + b
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_match_matrix_tensor():
+    rng = np.random.RandomState(1)
+    a = rng.rand(2, 3, 4).astype(np.float32)
+    b = rng.rand(2, 5, 4).astype(np.float32)
+    w = rng.rand(4, 2, 4).astype(np.float32)
+    lx = np.array([2, 3], np.int64)
+    out = np.asarray(_op("match_matrix_tensor",
+                         {"X": a, "Y": b, "W": w, "LengthX": lx})["Out"])
+    want = np.einsum("bid,dte,bje->btij", a, w, b)
+    want[0, :, 2:] = 0.0          # masked past length 2
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-6)
