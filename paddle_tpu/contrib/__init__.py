@@ -1,2 +1,3 @@
 from . import mixed_precision  # noqa: F401
 from . import slim             # noqa: F401
+from . import layers           # noqa: F401

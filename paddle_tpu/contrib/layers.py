@@ -1,0 +1,215 @@
+"""contrib.layers (ref: python/paddle/fluid/contrib/layers/nn.py) — the
+incubating layer surface: CTR/recommendation ops (tdm family, batch_fc,
+rank-style attention inputs), text matching, and misc utilities.  Thin
+graph builders over the registered ops."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.layer_helper import LayerHelper, ParamAttr
+from .. import layers as L
+
+__all__ = [
+    "fused_elemwise_activation", "match_matrix_tensor",
+    "sequence_topk_avg_pooling", "multiclass_nms2", "shuffle_batch",
+    "partial_concat", "partial_sum", "sparse_embedding", "tdm_child",
+    "tdm_sampler", "batch_fc", "fused_embedding_seq_pool",
+]
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """ref: contrib/layers/nn.py:63."""
+    helper = LayerHelper("fused_elemwise_activation")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="fused_elemwise_activation",
+                     inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"functor_list": list(functor_list),
+                            "axis": axis})
+    return out
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None,
+                        x_length=None, y_length=None):
+    """ref: contrib/layers/nn.py:245 — dense [B, T, D] contract (+
+    explicit lengths instead of LoD)."""
+    helper = LayerHelper(name or "match_matrix_tensor")
+    d1 = int(x.shape[-1])
+    d2 = int(y.shape[-1])
+    w = helper.create_parameter(param_attr, [d1, channel_num, d2], dtype)
+    out = helper.create_variable_for_type_inference(
+        dtype, (x.shape[0], channel_num, x.shape[1], y.shape[1]))
+    tmp = helper.create_variable_for_type_inference(
+        dtype, (x.shape[0], x.shape[1], channel_num, d2))
+    ins = {"X": [x], "Y": [y], "W": [w]}
+    if x_length is not None:
+        ins["LengthX"] = [x_length]
+    if y_length is not None:
+        ins["LengthY"] = [y_length]
+    helper.append_op(type="match_matrix_tensor", inputs=ins,
+                     outputs={"Out": [out], "Tmp": [tmp]},
+                     attrs={"dim_t": channel_num})
+    return helper.append_activation(out, act), tmp
+
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
+    """ref: contrib/layers/nn.py:332 — dense [B, T, C] contract."""
+    helper = LayerHelper("sequence_topk_avg_pooling")
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (input.shape[0], len(topks) * channel_num))
+    pos = helper.create_variable_for_type_inference("float32", (1,))
+    helper.append_op(type="sequence_topk_avg_pooling",
+                     inputs={"X": [input]},
+                     outputs={"Out": [out], "pos": [pos]},
+                     attrs={"topks": list(topks),
+                            "channel_num": channel_num})
+    return out
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k,
+                    keep_top_k, nms_threshold=0.3, normalized=True,
+                    nms_eta=1.0, background_label=0, return_index=False,
+                    name=None):
+    """ref: contrib/layers/nn.py:538 — multiclass_nms that also returns
+    the kept-box index."""
+    if return_index:
+        raise NotImplementedError(
+            "multiclass_nms2 return_index is not lowered — fabricating "
+            "an index tensor would silently corrupt downstream gathers")
+    return L.multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
+                            keep_top_k, nms_threshold, normalized,
+                            nms_eta, background_label, name=name,
+                            return_rois_num=False)
+
+
+def shuffle_batch(x, seed=None):
+    """ref: contrib/layers/nn.py:783."""
+    helper = LayerHelper("shuffle_batch")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    idx = helper.create_variable_for_type_inference("int64",
+                                                    (x.shape[0],))
+    sd = helper.create_variable_for_type_inference("int64", (1,))
+    helper.append_op(type="shuffle_batch", inputs={"X": [x]},
+                     outputs={"Out": [out], "ShuffleIdx": [idx],
+                              "SeedOut": [sd]},
+                     attrs={"startup_seed": seed or 0})
+    return out
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """ref: contrib/layers/nn.py:847."""
+    helper = LayerHelper("partial_concat")
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    per = (int(xs[0].shape[1]) - start_index) if length < 0 else length
+    out = helper.create_variable_for_type_inference(
+        xs[0].dtype, (xs[0].shape[0], per * len(xs)))
+    helper.append_op(type="partial_concat", inputs={"X": list(xs)},
+                     outputs={"Out": [out]},
+                     attrs={"start_index": start_index, "length": length})
+    return out
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """ref: contrib/layers/nn.py:910."""
+    helper = LayerHelper("partial_sum")
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    per = (int(xs[0].shape[1]) - start_index) if length < 0 else length
+    out = helper.create_variable_for_type_inference(
+        xs[0].dtype, (xs[0].shape[0], per))
+    helper.append_op(type="partial_sum", inputs={"X": list(xs)},
+                     outputs={"Out": [out]},
+                     attrs={"start_index": start_index, "length": length})
+    return out
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, param_attr=None, dtype="float32"):
+    """ref: contrib/layers/nn.py:964 — large-scale sparse embedding.  On
+    the PS tier this is the distributed_lookup path; single-process it is
+    a plain embedding whose grads take the lazy/SelectedRows route."""
+    return L.embedding(input, size=size, is_sparse=True,
+                       padding_idx=padding_idx, param_attr=param_attr,
+                       dtype=dtype)
+
+
+def tdm_child(x, node_nums, child_nums, param_attr=None, dtype="int32"):
+    """ref: contrib/layers/nn.py:1017 — TreeInfo lives in a parameter."""
+    helper = LayerHelper("tdm_child")
+    info = helper.create_parameter(param_attr, [node_nums, 3 + child_nums],
+                                   "int32")
+    info.stop_gradient = True
+    child = helper.create_variable_for_type_inference(
+        "int64", tuple(x.shape) + (child_nums,))
+    mask = helper.create_variable_for_type_inference(
+        "int64", tuple(x.shape) + (child_nums,))
+    helper.append_op(type="tdm_child",
+                     inputs={"X": [x], "TreeInfo": [info]},
+                     outputs={"Child": [child], "LeafMask": [mask]},
+                     attrs={"child_nums": child_nums})
+    return child, mask
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list,
+                leaf_node_num, tree_travel_attr=None, tree_layer_attr=None,
+                output_positive=True, output_list=True, seed=0,
+                tree_dtype="int32", dtype="int32"):
+    """ref: contrib/layers/nn.py:1102 — travel/layer tables as params;
+    layer table dense-padded [L, max_nodes] with per-layer counts."""
+    helper = LayerHelper("tdm_sampler")
+    L_num = len(layer_node_num_list)
+    max_nodes = max(layer_node_num_list)
+    travel = helper.create_parameter(
+        tree_travel_attr, [leaf_node_num, L_num], "int32")
+    layer = helper.create_parameter(
+        tree_layer_attr, [L_num, max_nodes], "int32")
+    travel.stop_gradient = True
+    layer.stop_gradient = True
+    counts = L.assign_value(np.asarray(layer_node_num_list, np.int32))
+    total = sum((1 if output_positive else 0) + n
+                for n in neg_samples_num_list)
+    out = helper.create_variable_for_type_inference(
+        "int64", (x.shape[0], total, 1))
+    lab = helper.create_variable_for_type_inference(
+        "int64", (x.shape[0], total, 1))
+    mask = helper.create_variable_for_type_inference(
+        "int64", (x.shape[0], total, 1))
+    helper.append_op(type="tdm_sampler",
+                     inputs={"Travel": [travel], "Layer": [layer],
+                             "LayerCounts": [counts], "X": [x]},
+                     outputs={"Out": [out], "Labels": [lab],
+                              "Mask": [mask]},
+                     attrs={"neg_samples_num_list":
+                            list(neg_samples_num_list),
+                            "output_positive": output_positive})
+    return out, lab, mask
+
+
+def batch_fc(input, param_size, param_attr, bias_size, bias_attr,
+             act=None):
+    """ref: contrib/layers/nn.py:1379."""
+    helper = LayerHelper("batch_fc")
+    w = helper.create_parameter(param_attr, list(param_size),
+                                input.dtype)
+    b = helper.create_parameter(bias_attr, list(bias_size), input.dtype,
+                                is_bias=True)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (input.shape[0], input.shape[1], param_size[-1]))
+    helper.append_op(type="batch_fc",
+                     inputs={"Input": [input], "W": [w], "Bias": [b]},
+                     outputs={"Out": [out]}, attrs={})
+    return helper.append_activation(out, act)
+
+
+def fused_embedding_seq_pool(input, size, is_sparse=False,
+                             padding_idx=None, combiner="sum",
+                             param_attr=None, dtype="float32",
+                             length=None):
+    """ref: contrib/layers/nn.py:471 — embedding lookup + sequence pool
+    in one go (composition; XLA fuses it)."""
+    emb = L.embedding(input, size=size, is_sparse=is_sparse,
+                      padding_idx=padding_idx, param_attr=param_attr,
+                      dtype=dtype)
+    return L.sequence_pool(emb, pool_type=combiner, length=length)

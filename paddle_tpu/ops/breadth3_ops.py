@@ -40,11 +40,26 @@ def _sync_batch_norm(ctx, ins, attrs):
     else:
         mean = jnp.mean(a.astype(jnp.float32), axes)
         sq = jnp.mean(jnp.square(a.astype(jnp.float32)), axes)
-        # cross-replica statistics: average over every axis the batch is
-        # sharded on (the NCCL allreduce in the reference's CUDA kernel)
-        for ax in ctx.axis_names:
-            mean = lax.pmean(mean, ax)
-            sq = lax.pmean(sq, ax)
+        # cross-replica statistics (the NCCL allreduce in the reference's
+        # CUDA kernel).  Which axes shard the BATCH must be explicit on a
+        # multi-axis mesh — blindly averaging over a tensor-parallel axis
+        # would mix different channel shards (same policy as
+        # local_sgd_sync in collective_ops.py)
+        sync_axes = attrs.get("_axis_name")
+        if sync_axes is None:
+            if len(ctx.axis_names) > 1:
+                raise ValueError(
+                    "sync_batch_norm on a multi-axis mesh needs an "
+                    "explicit _axis_name attr naming the data-parallel "
+                    "axis/axes — guessing could average tensor-parallel "
+                    "shards")
+            sync_axes = ctx.axis_names
+        elif isinstance(sync_axes, str):
+            sync_axes = (sync_axes,)
+        for ax in sync_axes:
+            if ax in ctx.axis_names:
+                mean = lax.pmean(mean, ax)
+                sq = lax.pmean(sq, ax)
         var = sq - mean * mean
     inv = lax.rsqrt(var + eps)
     out = (a - mean.reshape(shape)) * inv.reshape(shape)

@@ -126,8 +126,12 @@ def _deformable_psroi_pooling(ctx, ins, attrs):
                 if no_trans:
                     dy = dx = 0.0
                 else:
-                    dy = tr[0, i, j] * trans_std * rh
-                    dx = tr[1, i, j] * trans_std * rw
+                    # bin → part-grid cell (ref deformable_psroi kernel:
+                    # part_size may differ from the pooled size)
+                    pi = min(int((i + 0.5) * part_h / ph), part_h - 1)
+                    pj = min(int((j + 0.5) * part_w / pw), part_w - 1)
+                    dy = tr[0, pi, pj] * trans_std * rh
+                    dx = tr[1, pi, pj] * trans_std * rw
                 sy = y0 + i * bin_h + dy + \
                     (jnp.arange(sample) + 0.5) * bin_h / sample
                 sx = x0 + j * bin_w + dx + \

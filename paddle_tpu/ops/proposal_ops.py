@@ -39,7 +39,13 @@ def _decode(anchors, deltas, variances):
 @register("generate_proposals")
 def _generate_proposals(ctx, ins, attrs):
     """ref: generate_proposals_op.cc — decode RPN deltas against anchors,
-    clip, drop tiny boxes, NMS, keep post_nms_topN per image."""
+    clip, drop tiny boxes, NMS, keep post_nms_topN per image.
+
+    Cost note: NMS runs over the full pre_nms_topN pool (reference
+    semantics — truncating first would make pre_nms_topN inert), which
+    on TPU materialises a [pre_n, pre_n] IoU matrix per image and a
+    pre_n-step suppression scan.  pre_nms_topN is the knob that bounds
+    this; lower it on memory-tight configurations."""
     scores = x(ins, "Scores")          # [N, A, H, W]
     deltas = x(ins, "BboxDeltas")      # [N, 4A, H, W]
     im_info = x(ins, "ImInfo")         # [N, 3] h, w, scale

@@ -40,9 +40,14 @@ def _tdm_sampler(ctx, ins, attrs):
     that layer's nodes (excluding the positive, by re-draw rejection in
     the reference; here by shifted modular sampling, which also never
     returns the positive)."""
-    travel = x(ins, "Travel").astype(jnp.int32)    # [N, L] path node ids
+    travel = x(ins, "Travel").astype(jnp.int32)    # [items, L] paths
     layer = x(ins, "Layer").astype(jnp.int32)      # [L, maxN] padded
     layer_counts = x(ins, "LayerCounts")
+    item_ids = x(ins, "X")
+    if item_ids is not None:
+        # X holds the batch's leaf/item ids — each row samples for ITS
+        # travel path, not table row order (ref tdm_sampler_op.h)
+        travel = travel[item_ids.reshape(-1).astype(jnp.int32)]
     neg_list = list(attrs["neg_samples_num_list"])
     output_positive = bool(attrs.get("output_positive", True))
     n, l = travel.shape
@@ -112,7 +117,8 @@ def _match_matrix_tensor(ctx, ins, attrs):
     w = x(ins, "W")                   # [D, dim_t, D]
     lx = x(ins, "LengthX")
     ly = x(ins, "LengthY")
-    out = jnp.einsum("bid,dte,bje->btij", a, w, b)
+    tmp = jnp.einsum("bid,dte->bite", a, w)   # the x·W intermediate the
+    out = jnp.einsum("bite,bje->btij", tmp, b)  # reference emits as Tmp
     if lx is not None:
         m = jnp.arange(a.shape[1])[None, None, :, None] < \
             lx.reshape(-1, 1, 1, 1)
@@ -121,4 +127,4 @@ def _match_matrix_tensor(ctx, ins, attrs):
         m = jnp.arange(b.shape[1])[None, None, None, :] < \
             ly.reshape(-1, 1, 1, 1)
         out = jnp.where(m, out, 0.0)
-    return {"Out": out, "Tmp": jnp.zeros_like(a)}
+    return {"Out": out, "Tmp": tmp}

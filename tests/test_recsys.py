@@ -78,3 +78,87 @@ def test_match_matrix_tensor():
     want = np.einsum("bid,dte,bje->btij", a, w, b)
     want[0, :, 2:] = 0.0          # masked past length 2
     np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-6)
+
+
+def test_contrib_layers_surface():
+    """contrib.layers wrappers build and run (ref contrib/layers/nn.py)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.contrib import layers as cl
+    from paddle_tpu.framework.core import (Program, program_guard,
+                                           reset_default_programs)
+    reset_default_programs()
+    main, startup = Program(), Program()
+    rng = np.random.RandomState(0)
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[4])
+        fe = cl.fused_elemwise_activation(x, y, ["elementwise_add",
+                                                "relu"])
+        pc = cl.partial_concat([x, y], start_index=1, length=2)
+        psum = cl.partial_sum([x, y], start_index=0, length=3)
+        sb = cl.shuffle_batch(x)
+        ids = fluid.layers.data("ids", shape=[3], dtype="int64")
+        emb = cl.fused_embedding_seq_pool(ids, [20, 8], combiner="sum")
+        bf_in = fluid.layers.data("bf", shape=[4, 5])
+        bf = cl.batch_fc(bf_in, [3, 5, 2],
+                         fluid.ParamAttr(name="bw"), [3, 1, 2],
+                         fluid.ParamAttr(name="bb"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": rng.rand(6, 4).astype(np.float32),
+            "y": rng.rand(6, 4).astype(np.float32),
+            "ids": rng.randint(0, 20, (6, 3)).astype(np.int64),
+            "bf": rng.rand(3, 4, 5).astype(np.float32)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        res = exe.run(main, feed=feed,
+                      fetch_list=[fe, pc, psum, sb, emb, bf])
+    fe_, pc_, ps_, sb_, emb_, bf_ = [np.asarray(v) for v in res]
+    np.testing.assert_allclose(
+        fe_, np.maximum(feed["x"] + feed["y"], 0), rtol=1e-6)
+    assert pc_.shape == (6, 4) and ps_.shape == (6, 3)
+    assert emb_.shape == (6, 8) and bf_.shape == (3, 4, 2)
+    assert sorted(sb_.sum(1).tolist()) == pytest.approx(
+        sorted(feed["x"].sum(1).tolist()), rel=1e-5)
+
+
+def test_contrib_tdm_layers():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.contrib import layers as cl
+    from paddle_tpu.framework.core import (Program, program_guard,
+                                           reset_default_programs)
+    info = np.zeros((5, 5), np.int32)
+    info[1] = [0, 0, 0, 2, 3]
+    info[2] = [5, 1, 1, 0, 0]
+    info[3] = [0, 1, 1, 4, 0]
+    info[4] = [9, 2, 3, 0, 0]
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1], dtype="int64")
+        child, mask = cl.tdm_child(
+            x, node_nums=5, child_nums=2,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(
+                    info.astype(np.float32))))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        c, m = exe.run(main, feed={"x": np.array([[1]], np.int64)},
+                       fetch_list=[child, mask])
+    np.testing.assert_array_equal(np.asarray(c).reshape(-1), [2, 3])
+    np.testing.assert_array_equal(np.asarray(m).reshape(-1), [1, 0])
+
+
+def test_tdm_sampler_indexes_travel_by_items():
+    """X selects WHICH items' paths are sampled (not table row order)."""
+    travel = np.array([[1, 3], [2, 5], [1, 4]], np.int64)  # 3 items
+    layer = np.array([[1, 2, 0, 0], [3, 4, 5, 6]], np.int64)
+    counts = np.array([2, 4], np.int64)
+    out = _op("tdm_sampler",
+              {"Travel": travel, "Layer": layer, "LayerCounts": counts,
+               "X": np.array([[2], [0]], np.int64)},
+              {"neg_samples_num_list": [1, 1], "output_positive": True})
+    o = np.asarray(out["Out"])[..., 0]
+    assert o.shape == (2, 4)
+    np.testing.assert_array_equal(o[:, 0], [1, 1])   # items 2,0 → pos l0
+    np.testing.assert_array_equal(o[:, 2], [4, 3])   # their l1 positives
