@@ -56,13 +56,18 @@ def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
 
 
 def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
-    """ref: contrib/layers/nn.py:332 — dense [B, T, C] contract."""
+    """ref: contrib/layers/nn.py:332 — dense [B, T, C] contract; ``row``
+    carries the per-instance valid length (the LoD the reference reads
+    from its row input) so padding never enters the top-k."""
     helper = LayerHelper("sequence_topk_avg_pooling")
     out = helper.create_variable_for_type_inference(
         input.dtype, (input.shape[0], len(topks) * channel_num))
     pos = helper.create_variable_for_type_inference("float32", (1,))
+    ins = {"X": [input]}
+    if row is not None:
+        ins["Length"] = [row]
     helper.append_op(type="sequence_topk_avg_pooling",
-                     inputs={"X": [input]},
+                     inputs=ins,
                      outputs={"Out": [out], "pos": [pos]},
                      attrs={"topks": list(topks),
                             "channel_num": channel_num})
@@ -184,7 +189,24 @@ def tdm_sampler(x, neg_samples_num_list, layer_node_num_list,
                      attrs={"neg_samples_num_list":
                             list(neg_samples_num_list),
                             "output_positive": output_positive})
-    return out, lab, mask
+    # seed note: sampling draws from the checkpointed program PRNG
+    # stream (reproducible per run); a per-call seed is not wired.
+    if not output_list:
+        return out, lab, mask
+    # reference default: per-layer tensor lists
+    widths = [(1 if output_positive else 0) + n
+              for n in neg_samples_num_list]
+    from ..layers import tensor_ops as tensor
+    outs3 = []
+    for t in (out, lab, mask):
+        parts = []
+        start = 0
+        for wd in widths:
+            parts.append(tensor.slice(t, axes=[1], starts=[start],
+                                      ends=[start + wd]))
+            start += wd
+        outs3.append(parts)
+    return tuple(outs3)
 
 
 def batch_fc(input, param_size, param_attr, bias_size, bias_attr,

@@ -18,6 +18,7 @@ __all__ = [
     "collect_fpn_proposals", "rpn_target_assign", "psroi_pool", "prroi_pool",
     "deformable_conv", "deformable_roi_pooling",
     "retinanet_target_assign", "retinanet_detection_output",
+    "locality_aware_nms",
 ]
 
 
@@ -629,3 +630,29 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
                             "keep_top_k": keep_top_k,
                             "nms_threshold": nms_threshold})
     return out, num
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """ref: layers/detection.py locality_aware_nms (EAST) — consecutive
+    overlapping boxes merge by score-weighted average before NMS.
+    Static contract: [keep_top_k, 6] padded rows + RoisNum."""
+    if nms_eta < 1.0:
+        raise NotImplementedError(
+            "locality_aware_nms adaptive NMS (nms_eta < 1) is not "
+            "lowered")
+    helper = LayerHelper("locality_aware_nms")
+    out = helper.create_variable_for_type_inference(
+        "float32", (keep_top_k, 6))
+    num = helper.create_variable_for_type_inference("int32", ())
+    helper.append_op(type="locality_aware_nms",
+                     inputs={"BBoxes": [bboxes], "Scores": [scores]},
+                     outputs={"Out": [out], "RoisNum": [num]},
+                     attrs={"score_threshold": score_threshold,
+                            "nms_top_k": nms_top_k,
+                            "keep_top_k": keep_top_k,
+                            "nms_threshold": nms_threshold,
+                            "normalized": normalized,
+                            "background_label": background_label})
+    return out

@@ -25,5 +25,6 @@ from . import proposal_ops  # noqa: F401
 from . import deform_ops    # noqa: F401
 from . import breadth3_ops  # noqa: F401
 from . import recsys_ops    # noqa: F401
+from . import ctr_text_ops  # noqa: F401
 from . import pipeline_op   # noqa: F401
 from . import ps_ops        # noqa: F401

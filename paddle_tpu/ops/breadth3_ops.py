@@ -57,9 +57,13 @@ def _sync_batch_norm(ctx, ins, attrs):
         elif isinstance(sync_axes, str):
             sync_axes = (sync_axes,)
         for ax in sync_axes:
-            if ax in ctx.axis_names:
-                mean = lax.pmean(mean, ax)
-                sq = lax.pmean(sq, ax)
+            if ax not in ctx.axis_names:
+                raise ValueError(
+                    f"sync_batch_norm: axis {ax!r} is not a mesh axis "
+                    f"{ctx.axis_names} — silently skipping it would "
+                    f"leave per-replica statistics unsynchronised")
+            mean = lax.pmean(mean, ax)
+            sq = lax.pmean(sq, ax)
         var = sq - mean * mean
     inv = lax.rsqrt(var + eps)
     out = (a - mean.reshape(shape)) * inv.reshape(shape)

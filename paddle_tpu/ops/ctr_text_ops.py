@@ -1,0 +1,160 @@
+"""Last CTR/text/OCR stragglers: rank_attention, var_conv_2d,
+locality_aware_nms (ref: operators/rank_attention.cu.h,
+var_conv_2d_op.cc, detection/locality_aware_nms_op.cc)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+
+
+@register("rank_attention")
+def _rank_attention(ctx, ins, attrs):
+    """ref: rank_attention.cu.h — CTR rank-aware attention.
+
+    RankOffset [ins, 2*max_rank+1] int: col 0 is the instance's own rank
+    (1-based, 0 = none); pair k = (rank_k, source_index_k).  Each
+    instance multiplies its gathered rank inputs with the parameter
+    block for (own_rank, rank_k):
+        Out[i] = concat_k(X[index_k]) @ RankParam[(lower·R + faster_k)·D:]
+    """
+    a = x(ins, "X")                        # [ins, d]
+    ro = x(ins, "RankOffset").astype(jnp.int32)   # [ins, 2R+1]
+    param = x(ins, "RankParam")            # [R*R*d, para_col]
+    max_rank = int(attrs.get("MaxRank", (ro.shape[1] - 1) // 2))
+    n, d = a.shape
+    pc = param.shape[1]
+
+    lower = ro[:, 0] - 1                   # [ins]
+    fasters = ro[:, 1::2] - 1              # [ins, R]
+    index = ro[:, 2::2]                    # [ins, R]
+    valid = (lower[:, None] >= 0) & (fasters >= 0)
+
+    xin = a[jnp.clip(index, 0, n - 1)]     # [ins, R, d]
+    xin = jnp.where(valid[..., None], xin, 0.0)
+    pair = jnp.clip(lower[:, None] * max_rank + fasters, 0,
+                    max_rank * max_rank - 1)
+    pview = param.reshape(max_rank * max_rank, d, pc)
+    pw = pview[pair]                       # [ins, R, d, pc]
+    pw = jnp.where(valid[..., None, None], pw, 0.0)
+    out = jnp.einsum("ird,irdc->ic", xin, pw)
+    return {"Out": out,
+            "InputHelp": xin.reshape(n, max_rank * d),
+            "InsRank": ro[:, 0:1].astype(a.dtype)}
+
+
+@register("var_conv_2d")
+def _var_conv_2d(ctx, ins, attrs):
+    """ref: var_conv_2d_op.cc — conv over per-instance variable-size 2D
+    maps (text-match grids).  Dense contract: X [B, Cin, maxR, maxC] +
+    RowLength/ColLength [B]; outputs masked past each instance's valid
+    (ceil(rows/stride), ceil(cols/stride)) region."""
+    a = x(ins, "X")
+    w = x(ins, "W")                        # [Cout, Cin*kh*kw]
+    rows = x(ins, "RowLength")
+    cols = x(ins, "ColLength")
+    cout = int(attrs["output_channel"])
+    cin = int(attrs.get("input_channel", a.shape[1]))
+    kh = int(attrs.get("kernel_h", 3))
+    kw = int(attrs.get("kernel_w", 3))
+    sh = int(attrs.get("stride_h", 1))
+    sw = int(attrs.get("stride_w", 1))
+    wk = w.reshape(cout, cin, kh, kw)
+    out = lax.conv_general_dilated(
+        a, wk, (sh, sw),
+        [((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    oh, ow = out.shape[2], out.shape[3]
+    if rows is not None:
+        vr = -(-rows.reshape(-1, 1).astype(jnp.int32) // sh)   # ceil div
+        m = jnp.arange(oh)[None, :] < vr
+        out = jnp.where(m[:, None, :, None], out, 0.0)
+    if cols is not None:
+        vc = -(-cols.reshape(-1, 1).astype(jnp.int32) // sw)
+        m = jnp.arange(ow)[None, :] < vc
+        out = jnp.where(m[:, None, None, :], out, 0.0)
+    return {"Out": out, "Col": jnp.zeros((1,), a.dtype)}
+
+
+@register("locality_aware_nms")
+def _locality_aware_nms(ctx, ins, attrs):
+    """ref: detection/locality_aware_nms_op.cc (EAST text detection) —
+    first merge CONSECUTIVE overlapping boxes by score-weighted average
+    (the locality pass over detector raster order), then standard
+    per-class NMS.  Static contract like multiclass_nms: [keep_top_k, 6]
+    rows, pads label=-1, plus RoisNum."""
+    from .detection_ops import _nms_class, _pair_iou
+    boxes = x(ins, "BBoxes")               # [1, M, 4] or [M, 4]
+    scores = x(ins, "Scores")              # [1, C, M] or [C, M]
+    if boxes.ndim == 3:
+        boxes = boxes[0]
+    if scores.ndim == 3:
+        scores = scores[0]
+    nms_thr = float(attrs.get("nms_threshold", 0.3))
+    score_thr = float(attrs.get("score_threshold", 0.0))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    nms_top_k = int(attrs.get("nms_top_k", 0))
+    background = int(attrs.get("background_label", -1))
+    normalized = bool(attrs.get("normalized", True))
+    c, m = scores.shape
+
+    def merge_pass(cls_scores):
+        def step(carry, inp):
+            cur_box, cur_sc = carry
+            b, s = inp
+            iou = _pair_iou(cur_box[None], b[None],
+                            normalized=normalized)[0, 0]
+            do_merge = (iou > nms_thr) & (s > 0) & (cur_sc > 0)
+            tot = jnp.maximum(cur_sc + s, 1e-12)
+            merged = (cur_box * cur_sc + b * s) / tot
+            # merge: extend current; else: emit current, start new
+            new_box = jnp.where(do_merge, merged,
+                                jnp.where(s > 0, b, cur_box))
+            new_sc = jnp.where(do_merge, cur_sc + s,
+                               jnp.where(s > 0, s, cur_sc))
+            emit_box = jnp.where(do_merge, jnp.zeros(4), cur_box)
+            emit_sc = jnp.where(do_merge, 0.0, cur_sc)
+            # when s == 0 (below threshold) nothing merges or replaces
+            emit_box = jnp.where(s > 0, emit_box, jnp.zeros(4))
+            emit_sc = jnp.where(s > 0, emit_sc, 0.0)
+            return (new_box, new_sc), (emit_box, emit_sc)
+
+        sc = jnp.where(cls_scores >= score_thr, cls_scores, 0.0)
+        if 0 < nms_top_k < m:
+            # reference pre-truncates each class to its top nms_top_k
+            # scores before the locality pass
+            kth = jnp.sort(sc)[m - nms_top_k]
+            sc = jnp.where(sc >= kth, sc, 0.0)
+        (last_b, last_s), (ebs, ess) = lax.scan(
+            step, (jnp.zeros(4), 0.0), (boxes, sc))
+        out_boxes = jnp.concatenate([ebs, last_b[None]], 0)
+        out_scores = jnp.concatenate([ess, last_s[None]], 0)
+        return out_boxes, out_scores
+
+    outs, outscores, outlabels = [], [], []
+    for cls in range(c):
+        if cls == background:
+            continue
+        mb, ms = merge_pass(scores[cls])
+        s = jnp.where(ms > 0, ms, -1e30)
+        keep, order, kept = _nms_class(mb, s, nms_thr,
+                                       min(keep_top_k, s.shape[0]),
+                                       normalized=normalized)
+        valid = (keep > 0) & (kept > -1e29)
+        outs.append(mb[order])
+        outscores.append(jnp.where(valid, kept, -1e30))
+        outlabels.append(jnp.full(kept.shape, cls, jnp.int32))
+    cat_b = jnp.concatenate(outs, 0)
+    cat_s = jnp.concatenate(outscores, 0)
+    cat_l = jnp.concatenate(outlabels, 0)
+    k = min(keep_top_k, cat_s.shape[0])
+    top, order = lax.top_k(cat_s, k)
+    valid = top > -1e29
+    rows = jnp.concatenate([cat_l[order][:, None].astype(jnp.float32),
+                            top[:, None], cat_b[order]], -1)
+    out = jnp.full((keep_top_k, 6), -1.0)
+    out = out.at[jnp.arange(k)].set(jnp.where(valid[:, None], rows, -1.0))
+    return {"Out": out, "RoisNum": jnp.sum(valid).astype(jnp.int32)}

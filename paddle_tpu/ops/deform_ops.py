@@ -126,10 +126,10 @@ def _deformable_psroi_pooling(ctx, ins, attrs):
                 if no_trans:
                     dy = dx = 0.0
                 else:
-                    # bin → part-grid cell (ref deformable_psroi kernel:
-                    # part_size may differ from the pooled size)
-                    pi = min(int((i + 0.5) * part_h / ph), part_h - 1)
-                    pj = min(int((j + 0.5) * part_w / pw), part_w - 1)
+                    # bin → part-grid cell, floor like the reference
+                    # kernel (part_size may differ from the pooled size)
+                    pi = min(i * part_h // ph, part_h - 1)
+                    pj = min(j * part_w // pw, part_w - 1)
                     dy = tr[0, pi, pj] * trans_std * rh
                     dx = tr[1, pi, pj] * trans_std * rw
                 sy = y0 + i * bin_h + dy + \

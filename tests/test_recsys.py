@@ -162,3 +162,97 @@ def test_tdm_sampler_indexes_travel_by_items():
     assert o.shape == (2, 4)
     np.testing.assert_array_equal(o[:, 0], [1, 1])   # items 2,0 → pos l0
     np.testing.assert_array_equal(o[:, 2], [4, 3])   # their l1 positives
+
+
+def test_rank_attention():
+    rng = np.random.RandomState(6)
+    n, d, pc, R = 3, 2, 2, 2
+    a = rng.rand(n, d).astype(np.float32)
+    param = rng.rand(R * R * d, pc).astype(np.float32)
+    # ins 0: rank 1, pairs (rank1, idx0), (rank2, idx1)
+    # ins 2: rank 0 -> no output
+    ro = np.array([[1, 1, 0, 2, 1],
+                   [2, 1, 0, 2, 2],
+                   [0, 0, 0, 0, 0]], np.int64)
+    out = _op("rank_attention", {"X": a, "RankOffset": ro,
+                                 "RankParam": param}, {"MaxRank": R})
+    o = np.asarray(out["Out"])
+    pv = param.reshape(R * R, d, pc)
+    # ins 0: lower=0: block k=0 -> pair 0*R+0=0 with X[0]; k=1 -> pair 1, X[1]
+    want0 = a[0] @ pv[0] + a[1] @ pv[1]
+    np.testing.assert_allclose(o[0], want0, rtol=1e-5)
+    # ins 1: lower=1: pairs 2 and 3, inputs X[0], X[2]
+    want1 = a[0] @ pv[2] + a[2] @ pv[3]
+    np.testing.assert_allclose(o[1], want1, rtol=1e-5)
+    np.testing.assert_allclose(o[2], 0.0, atol=1e-6)
+
+
+def test_var_conv_2d_masks_invalid_region():
+    rng = np.random.RandomState(7)
+    a = rng.rand(2, 1, 4, 6).astype(np.float32)
+    w = rng.rand(3, 1 * 3 * 3).astype(np.float32)
+    out = _op("var_conv_2d",
+              {"X": a, "W": w,
+               "RowLength": np.array([2, 4], np.int64),
+               "ColLength": np.array([3, 6], np.int64)},
+              {"output_channel": 3, "input_channel": 1,
+               "kernel_h": 3, "kernel_w": 3, "stride_h": 1,
+               "stride_w": 1})
+    o = np.asarray(out["Out"])
+    assert o.shape == (2, 3, 4, 6)
+    assert np.all(o[0, :, 2:, :] == 0)      # rows beyond 2 masked
+    assert np.all(o[0, :, :, 3:] == 0)
+    assert np.any(o[1, :, 3, 5] != 0)       # full-size instance intact
+
+
+def test_locality_aware_nms_merges_consecutive():
+    # two near-identical consecutive boxes merge into one detection
+    boxes = np.array([[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                      [50, 50, 60, 60]], np.float32)
+    scores = np.array([[0.8, 0.6, 0.9]], np.float32)   # one class
+    out = _op("locality_aware_nms", {"BBoxes": boxes, "Scores": scores},
+              {"nms_threshold": 0.5, "score_threshold": 0.1,
+               "keep_top_k": 5, "background_label": -1})
+    o = np.asarray(out["Out"])
+    n = int(np.asarray(out["RoisNum"]))
+    assert n == 2                           # merged pair + far box
+    top = o[0]
+    # merged detection carries the SUMMED score 1.4 (EAST convention)
+    assert abs(top[1] - 1.4) < 1e-5
+    # merged box is the score-weighted average
+    want = (boxes[0] * 0.8 + boxes[1] * 0.6) / 1.4
+    np.testing.assert_allclose(top[2:], want, rtol=1e-5)
+
+
+def test_contrib_tdm_sampler_output_list():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.contrib import layers as cl
+    from paddle_tpu.framework.core import (Program, program_guard,
+                                           reset_default_programs)
+    travel = np.array([[1, 3], [2, 5]], np.float32)
+    layer_tab = np.array([[1, 2, 0, 0], [3, 4, 5, 6]], np.float32)
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1], dtype="int64")
+        outs, labs, masks = cl.tdm_sampler(
+            x, neg_samples_num_list=[1, 2], layer_node_num_list=[2, 4],
+            leaf_node_num=2,
+            tree_travel_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(
+                    travel)),
+            tree_layer_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(
+                    layer_tab)))
+        assert isinstance(outs, list) and len(outs) == 2
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o0, o1, l0 = exe.run(
+            main, feed={"x": np.array([[0], [1]], np.int64)},
+            fetch_list=[outs[0], outs[1], labs[0]])
+    o0 = np.asarray(o0)[..., 0]
+    assert o0.shape == (2, 2)                 # pos + 1 neg for layer 0
+    np.testing.assert_array_equal(o0[:, 0], [1, 2])
+    assert np.asarray(o1)[..., 0].shape == (2, 3)
+    np.testing.assert_array_equal(np.asarray(l0)[..., 0][:, 0], [1, 1])
