@@ -18,7 +18,7 @@ __all__ = [
     "collect_fpn_proposals", "rpn_target_assign", "psroi_pool", "prroi_pool",
     "deformable_conv", "deformable_roi_pooling",
     "retinanet_target_assign", "retinanet_detection_output",
-    "locality_aware_nms",
+    "locality_aware_nms", "roi_perspective_transform",
 ]
 
 
@@ -656,3 +656,31 @@ def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
                             "normalized": normalized,
                             "background_label": background_label})
     return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None, rois_num=None):
+    """ref: layers/detection.py roi_perspective_transform (EAST) — quad
+    ROIs warped onto a fixed rectangle."""
+    helper = LayerHelper("roi_perspective_transform")
+    r = rois.shape[0]
+    c = input.shape[1]
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (r, c, transformed_height, transformed_width))
+    mask = helper.create_variable_for_type_inference(
+        "int32", (r, 1, transformed_height, transformed_width))
+    o2i = helper.create_variable_for_type_inference("int32", (r, 1))
+    o2w = helper.create_variable_for_type_inference("float32", (r, 1))
+    tm = helper.create_variable_for_type_inference("float32", (r, 9))
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    helper.append_op(type="roi_perspective_transform", inputs=ins,
+                     outputs={"Out": [out], "Mask": [mask],
+                              "Out2InIdx": [o2i], "Out2InWeights": [o2w],
+                              "TransformMatrix": [tm]},
+                     attrs={"transformed_height": transformed_height,
+                            "transformed_width": transformed_width,
+                            "spatial_scale": spatial_scale})
+    return out, mask, tm

@@ -63,6 +63,17 @@ def _var_conv_2d(ctx, ins, attrs):
     sh = int(attrs.get("stride_h", 1))
     sw = int(attrs.get("stride_w", 1))
     wk = w.reshape(cout, cin, kh, kw)
+    # zero the padding region BEFORE convolving — windows of valid
+    # outputs near the boundary must not absorb pad garbage (reference
+    # convolves only the valid sub-map)
+    if rows is not None:
+        m = jnp.arange(a.shape[2])[None, :] < \
+            rows.reshape(-1, 1).astype(jnp.int32)
+        a = jnp.where(m[:, None, :, None], a, 0.0)
+    if cols is not None:
+        m = jnp.arange(a.shape[3])[None, :] < \
+            cols.reshape(-1, 1).astype(jnp.int32)
+        a = jnp.where(m[:, None, None, :], a, 0.0)
     out = lax.conv_general_dilated(
         a, wk, (sh, sw),
         [((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)],
@@ -134,6 +145,10 @@ def _locality_aware_nms(ctx, ins, attrs):
         out_scores = jnp.concatenate([ess, last_s[None]], 0)
         return out_boxes, out_scores
 
+    if all(cls == background for cls in range(c)):
+        raise ValueError(
+            f"locality_aware_nms: background_label={background} removes "
+            f"every class (scores have {c}); nothing to detect")
     outs, outscores, outlabels = [], [], []
     for cls in range(c):
         if cls == background:
@@ -158,3 +173,69 @@ def _locality_aware_nms(ctx, ins, attrs):
     out = jnp.full((keep_top_k, 6), -1.0)
     out = out.at[jnp.arange(k)].set(jnp.where(valid[:, None], rows, -1.0))
     return {"Out": out, "RoisNum": jnp.sum(valid).astype(jnp.int32)}
+
+
+@register("roi_perspective_transform")
+def _roi_perspective_transform(ctx, ins, attrs):
+    """ref: detection/roi_perspective_transform_op.cc — warp each quad
+    ROI onto a fixed [th, tw] rectangle via the closed-form homography
+    the reference derives (same matrix construction, get_transform_matrix
+    at roi_perspective_transform_op.cc:110), bilinear-sampled with zero
+    outside the image."""
+    from .detection_ops import _bilinear_zero, _roi_batch_idx
+    a = x(ins, "X")                    # [N, C, H, W]
+    rois = x(ins, "ROIs")              # [R, 8] quad x0 y0 x1 y1 ...
+    th = int(attrs["transformed_height"])
+    tw = int(attrs["transformed_width"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = a.shape
+    r = rois.shape[0]
+    batch_idx = _roi_batch_idx(x(ins, "RoisNum"), r)
+
+    def one_roi(quad, bi):
+        xq = quad[0::2] * scale
+        yq = quad[1::2] * scale
+        x0, x1, x2, x3 = xq[0], xq[1], xq[2], xq[3]
+        y0, y1, y2, y3 = yq[0], yq[1], yq[2], yq[3]
+        len1 = jnp.sqrt((x0 - x1) ** 2 + (y0 - y1) ** 2)
+        len2 = jnp.sqrt((x1 - x2) ** 2 + (y1 - y2) ** 2)
+        len3 = jnp.sqrt((x2 - x3) ** 2 + (y2 - y3) ** 2)
+        len4 = jnp.sqrt((x3 - x0) ** 2 + (y3 - y0) ** 2)
+        est_h = (len2 + len4) / 2.0
+        est_w = (len1 + len3) / 2.0
+        nh = max(2, th)
+        nw_f = jnp.round(est_w * (nh - 1) / jnp.maximum(est_h, 1e-5)) + 1
+        nw = jnp.clip(nw_f, 2, tw)
+        dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+        dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+        den = dx1 * dy2 - dx2 * dy1 + 1e-5
+        m6 = (dx3 * dy2 - dx2 * dy3) / den / (nw - 1)
+        m7 = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+        m3 = (y1 - y0 + m6 * (nw - 1) * y1) / (nw - 1)
+        m4 = (y3 - y0 + m7 * (nh - 1) * y3) / (nh - 1)
+        m5 = y0
+        m0 = (x1 - x0 + m6 * (nw - 1) * x1) / (nw - 1)
+        m1 = (x3 - x0 + m7 * (nh - 1) * x3) / (nh - 1)
+        m2 = x0
+        gy, gx = jnp.meshgrid(jnp.arange(th, dtype=jnp.float32),
+                              jnp.arange(tw, dtype=jnp.float32),
+                              indexing="ij")
+        denom = m6 * gx + m7 * gy + 1.0
+        sx = (m0 * gx + m1 * gy + m2) / denom
+        sy = (m3 * gx + m4 * gy + m5) / denom
+        # points mapped past the normalized width, or landing outside the
+        # image, are invalid (the reference's mask semantics)
+        in_img = (sx > -1) & (sx < w) & (sy > -1) & (sy < h)
+        valid = (gx <= nw - 1) & in_img
+        v = _bilinear_zero(a[bi], sy.reshape(-1), sx.reshape(-1))
+        v = v.reshape(c, th, tw) * (gx <= nw - 1)[None].astype(v.dtype)
+        matrix = jnp.stack([m0, m1, m2, m3, m4, m5, m6, m7,
+                            jnp.ones_like(m0)])
+        return v, valid.astype(jnp.int32)[None], matrix
+
+    out, mask, tm = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": out,
+            "Out2InIdx": jnp.zeros((r, 1), jnp.int32),
+            "Out2InWeights": jnp.zeros((r, 1), jnp.float32),
+            "Mask": mask,
+            "TransformMatrix": tm.astype(a.dtype)}

@@ -147,3 +147,26 @@ def test_retinanet_detection_output():
     assert out[0][0] == 0.0 and abs(out[0][1] - 0.9) < 1e-5
     assert out[1][0] == 1.0 and abs(out[1][1] - 0.8) < 1e-5
     assert (out[2:] == -1).all()
+
+
+def test_roi_perspective_transform_identity_quad():
+    """An axis-aligned rectangular quad behaves like a plain crop+resize;
+    corner (0,0) of the output maps to the quad's first corner."""
+    rng = np.random.RandomState(8)
+    img = rng.rand(1, 2, 8, 8).astype(np.float32)
+    # rectangle 1..6 x 2..5 as quad: (x0,y0)=(1,2) tl, tr (6,2),
+    # br (6,5), bl (1,5)
+    rois = np.array([[1, 2, 6, 2, 6, 5, 1, 5]], np.float32)
+
+    def build():
+        xv = L.data("x", shape=[2, 8, 8])
+        rv = L.assign_value(rois)
+        out, mask, tm = L.roi_perspective_transform(xv, rv, 4, 8)
+        return [out, mask]
+
+    out, mask = _run(build, {"x": img})
+    assert out.shape == (1, 2, 4, 8)
+    # origin of the warp = the quad's top-left corner value
+    np.testing.assert_allclose(out[0, :, 0, 0], img[0, :, 2, 1],
+                               rtol=1e-4, atol=1e-5)
+    assert np.isfinite(out).all()
