@@ -9,12 +9,14 @@ import numpy as np
 
 from ..framework.layer_helper import LayerHelper, ParamAttr
 from .. import layers as L
+from ..layers.breadth2 import tree_conv  # noqa: F401 (ref home: contrib)
 
 __all__ = [
     "fused_elemwise_activation", "match_matrix_tensor",
     "sequence_topk_avg_pooling", "multiclass_nms2", "shuffle_batch",
     "partial_concat", "partial_sum", "sparse_embedding", "tdm_child",
     "tdm_sampler", "batch_fc", "fused_embedding_seq_pool",
+    "tree_conv",
 ]
 
 

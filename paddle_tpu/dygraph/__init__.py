@@ -15,7 +15,7 @@ from .nn import (Linear, Conv2D, Conv2DTranspose, Pool2D, BatchNorm,  # noqa
                  Embedding, LayerNorm, GroupNorm, InstanceNorm, Dropout,
                  PRelu, Sequential, LayerList, ParameterList,
                  BilinearTensorProduct, Conv3D, Conv3DTranspose, GRUUnit,
-                 NCE, RowConv, SequenceConv, SpectralNorm)
+                 NCE, RowConv, SequenceConv, SpectralNorm, TreeConv)
 from .checkpoint import save_dygraph, load_dygraph  # noqa: F401
 from .parallel import (ParallelEnv, Env, prepare_context,  # noqa: F401
                        DataParallel)

@@ -522,6 +522,33 @@ class SpectralNorm(Layer):
                     "V": [self.weight_v]}, self._attrs)["Out"]
 
 
+class TreeConv(Layer):
+    """ref: dygraph/nn.py TreeConv — tree-based convolution (tree2col
+    traversal runs host-side via pure_callback; see ops/recsys_ops.py)."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [feature_size, 3, output_size, num_filters], attr=param_attr)
+        # reference creates NO bias unless bias_attr is given; its shape
+        # is [num_filters], broadcast over the output_size dim
+        self.bias = self.create_parameter(
+            [num_filters], attr=bias_attr, is_bias=True) \
+            if bias_attr else None
+        self._attrs = {"max_depth": max_depth}
+        self._act = act
+
+    def forward(self, nodes_vector, edge_set):
+        out = _op("tree_conv",
+                  {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                   "Filter": [self.weight]}, self._attrs)["Out"]
+        if self.bias is not None:
+            out = out + self.bias
+        return _maybe_act(out, self._act)
+
+
 class Sequential(Layer):
     """ref: dygraph/container.py Sequential."""
 

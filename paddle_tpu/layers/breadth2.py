@@ -30,7 +30,7 @@ __all__ = [
     "array_length", "tensor_array_to_tensor", "max_sequence_len",
     "lod_reset", "lod_append", "merge_selected_rows",
     "get_tensor_from_selected_rows", "box_decoder_and_assign",
-    "auc",
+    "auc", "tree_conv",
 ]
 
 from .metric_op import auc  # noqa: F401  (existed unexported)
@@ -469,3 +469,29 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box,
                               "OutputAssignBox": [assigned]},
                      attrs={"box_clip": box_clip})
     return dec, assigned
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """ref: contrib/layers/nn.py:400 tree_conv — tree-based CNN over
+    [B, M, D] node features + [B, E, 2] edge sets (0-padded)."""
+    helper = LayerHelper("tree_conv", name=name)
+    d = int(nodes_vector.shape[-1])
+    w = helper.create_parameter(param_attr,
+                                [d, 3, output_size, num_filters],
+                                nodes_vector.dtype)
+    b, m = nodes_vector.shape[0], nodes_vector.shape[1]
+    out = helper.create_variable_for_type_inference(
+        nodes_vector.dtype, (b, m, output_size, num_filters))
+    helper.append_op(type="tree_conv",
+                     inputs={"NodesVector": [nodes_vector],
+                             "EdgeSet": [edge_set], "Filter": [w]},
+                     outputs={"Out": [out]},
+                     attrs={"max_depth": max_depth})
+    if bias_attr:            # reference: NO bias unless bias_attr is set
+        b_ = helper.create_parameter(bias_attr, [num_filters],
+                                     nodes_vector.dtype, is_bias=True)
+        from .math_ops import elementwise_add
+        out = elementwise_add(out, b_, axis=-1)
+    return helper.append_activation(out, act)

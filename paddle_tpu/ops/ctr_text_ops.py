@@ -223,12 +223,14 @@ def _roi_perspective_transform(ctx, ins, attrs):
         denom = m6 * gx + m7 * gy + 1.0
         sx = (m0 * gx + m1 * gy + m2) / denom
         sy = (m3 * gx + m4 * gy + m5) / denom
-        # points mapped past the normalized width, or landing outside the
-        # image, are invalid (the reference's mask semantics)
-        in_img = (sx > -1) & (sx < w) & (sy > -1) & (sy < h)
+        # points mapped past the normalized width, or outside the
+        # reference's half-pixel image band, are invalid — BOTH Out and
+        # Mask zero there (roi_perspective_transform_op.cc:190)
+        in_img = (sx > -0.5) & (sx < w - 0.5) & \
+            (sy > -0.5) & (sy < h - 0.5)
         valid = (gx <= nw - 1) & in_img
         v = _bilinear_zero(a[bi], sy.reshape(-1), sx.reshape(-1))
-        v = v.reshape(c, th, tw) * (gx <= nw - 1)[None].astype(v.dtype)
+        v = v.reshape(c, th, tw) * valid[None].astype(v.dtype)
         matrix = jnp.stack([m0, m1, m2, m3, m4, m5, m6, m7,
                             jnp.ones_like(m0)])
         return v, valid.astype(jnp.int32)[None], matrix

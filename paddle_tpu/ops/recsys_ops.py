@@ -128,3 +128,86 @@ def _match_matrix_tensor(ctx, ins, attrs):
             ly.reshape(-1, 1, 1, 1)
         out = jnp.where(m, out, 0.0)
     return {"Out": out, "Tmp": tmp}
+
+
+def _tree_eta_matrix(edges_np, max_nodes, max_depth):
+    """Host-side tree2col (ref: math/tree2col.cc construct_patch): for
+    each node u, DFS to max_depth collecting (v, index, pclen, depth),
+    accumulating the eta_t/l/r coefficients into a dense matrix
+    [M, 3, M] so the device side is one einsum."""
+    import numpy as np
+
+    b = edges_np.shape[0]
+    out = np.zeros((b, max_nodes, 3, max_nodes), np.float32)
+    fd = float(max_depth)
+    for bi in range(b):
+        adj = {}
+        node_count = 0
+        for u, v in edges_np[bi]:
+            u, v = int(u), int(v)
+            if u == 0 or v == 0:
+                break
+            adj.setdefault(u, []).append(v)
+            node_count += 1
+        node_count += 1
+        for root in range(1, node_count + 1):
+            # iterative DFS mirroring the reference's stack walk
+            patch = [(root, 1, 1, 0)]
+            stack = [(root, 1, 1, 0)]
+            visited = {root}
+            while stack:
+                node, idx, pclen, depth = stack[-1]
+                children = adj.get(node, [])
+                advanced = False
+                for i, v in enumerate(children):
+                    if v not in visited and depth + 1 < max_depth:
+                        visited.add(v)
+                        stack.append((v, i, len(children), depth + 1))
+                        patch.append((v, i + 1, len(children), depth + 1))
+                        advanced = True
+                        break
+                if not advanced:
+                    stack.pop()
+            for (v, idx, pclen, depth) in patch:
+                eta_t = (fd - depth) / fd
+                if pclen == 1:
+                    tmp = 0.5
+                else:
+                    tmp = (idx - 1.0) / (pclen - 1.0)
+                eta_l = (1.0 - eta_t) * tmp
+                eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+                if root - 1 < max_nodes and v - 1 < max_nodes:
+                    # reference column order (tree2col.cc): l, r, t
+                    out[bi, root - 1, 0, v - 1] += eta_l
+                    out[bi, root - 1, 1, v - 1] += eta_r
+                    out[bi, root - 1, 2, v - 1] += eta_t
+    return out
+
+
+@register("tree_conv")
+def _tree_conv(ctx, ins, attrs):
+    """ref: operators/tree_conv_op.h + math/tree2col.cc — tree-based
+    convolution: each node aggregates its depth-bounded subtree with
+    continuous-binary-tree weights (eta_t/l/r) and projects through
+    W [D, 3, O].  The graph traversal (data-dependent) runs host-side in
+    a pure_callback producing the eta matrix; the contraction stays on
+    device (differentiable w.r.t. NodesVector and Filter)."""
+    nodes = x(ins, "NodesVector")      # [B, M, D]
+    edges = x(ins, "EdgeSet")          # [B, E, 2] int, 0-padded
+    filt = x(ins, "Filter")            # [D, 3, O, F] or [D, 3, O]
+    max_depth = int(attrs.get("max_depth", 2))
+    b, m, d = nodes.shape
+
+    def host(e):
+        import numpy as np
+        return _tree_eta_matrix(np.asarray(e), m, max_depth)
+
+    eta = jax.pure_callback(
+        host, jax.ShapeDtypeStruct((b, m, 3, m), jnp.float32), edges)
+    eta = lax.stop_gradient(eta)
+    agg = jnp.einsum("bmkp,bpd->bmkd", eta, nodes)
+    if filt.ndim == 4:
+        # reference output layout: 4-D [B, M, output_size, num_filters]
+        return {"Out": jnp.einsum("bmkd,dkof->bmof", agg, filt)}
+    out = jnp.einsum("bmkd,dko->bmo", agg, filt)
+    return {"Out": out}

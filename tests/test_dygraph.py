@@ -317,3 +317,17 @@ def test_dygraph_new_layer_classes():
         sn = dygraph.SpectralNorm([6, 4], power_iters=20)
         normed = np.asarray(sn(w).numpy())
         assert abs(np.linalg.svd(normed, compute_uv=False)[0] - 1.0) < 1e-2
+
+
+def test_dygraph_tree_conv():
+    import numpy as np
+    from paddle_tpu import dygraph
+    from paddle_tpu.dygraph import to_variable
+    rng = np.random.RandomState(10)
+    with dygraph.guard():
+        tc = dygraph.TreeConv(feature_size=4, output_size=5)
+        nv = to_variable(rng.rand(1, 3, 4).astype(np.float32))
+        ev = to_variable(np.array([[[1, 2], [1, 3], [0, 0]]], np.int64))
+        out = tc(nv, ev)
+        assert tuple(out.shape) == (1, 3, 5, 1)   # reference 4-D layout
+        assert np.isfinite(np.asarray(out.numpy())).all()

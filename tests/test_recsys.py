@@ -256,3 +256,47 @@ def test_contrib_tdm_sampler_output_list():
     np.testing.assert_array_equal(o0[:, 0], [1, 2])
     assert np.asarray(o1)[..., 0].shape == (2, 3)
     np.testing.assert_array_equal(np.asarray(l0)[..., 0][:, 0], [1, 1])
+
+
+def test_tree_conv_eta_semantics():
+    """Tiny tree (1→2, 1→3), max_depth=2: node 1 aggregates itself with
+    eta_t=1 plus children with the reference's continuous-binary-tree
+    weights; leaves aggregate only themselves."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.core import (Program, program_guard,
+                                           reset_default_programs)
+    rng = np.random.RandomState(9)
+    feats = rng.rand(1, 3, 4).astype(np.float32)
+    edges = np.array([[[1, 2], [1, 3], [0, 0]]], np.int64)
+    W = rng.rand(4, 3, 5, 1).astype(np.float32)
+
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        nv = fluid.layers.data("nv", shape=[3, 4])
+        ev = fluid.layers.data("ev", shape=[3, 2], dtype="int64")
+        out = fluid.layers.tree_conv(
+            nv, ev, output_size=5, num_filters=1, max_depth=2, act=None,
+            bias_attr=False,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(W)))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, = exe.run(main, feed={"nv": feats, "ev": edges},
+                     fetch_list=[out])
+    o = np.asarray(o)
+    assert o.shape == (1, 3, 5, 1)     # reference 4-D [B, M, O, F]
+    x1, x2, x3 = feats[0]
+    # node 1 patch etas: self (t=1), child2 (t=.5, l=0, r=.5),
+    # child3 (t=.5, l=.5, r=.25); reference slot order is (l, r, t)
+    A = np.stack([0.5 * x3,
+                  0.5 * x2 + 0.25 * x3,
+                  x1 + 0.5 * x2 + 0.5 * x3])           # [3(l,r,t), 4]
+    want1 = np.einsum("kd,dko->o", A, W[..., 0])
+    np.testing.assert_allclose(o[0, 0, :, 0], want1, rtol=1e-4,
+                               atol=1e-5)
+    # leaf node 2: only itself with eta_t=1 (slot 2)
+    want2 = np.einsum("d,do->o", x2, W[:, 2, :, 0])
+    np.testing.assert_allclose(o[0, 1, :, 0], want2, rtol=1e-4,
+                               atol=1e-5)
