@@ -16,7 +16,7 @@ __all__ = [
     "sequence_topk_avg_pooling", "multiclass_nms2", "shuffle_batch",
     "partial_concat", "partial_sum", "sparse_embedding", "tdm_child",
     "tdm_sampler", "batch_fc", "fused_embedding_seq_pool",
-    "tree_conv",
+    "tree_conv", "search_pyramid_hash",
 ]
 
 
@@ -237,3 +237,38 @@ def fused_embedding_seq_pool(input, size, is_sparse=False,
                       padding_idx=padding_idx, param_attr=param_attr,
                       dtype=dtype)
     return L.sequence_pool(emb, pool_type=combiner, length=length)
+
+
+def search_pyramid_hash(input, num_emb, space_len, pyramid_layer, rand_len,
+                        drop_out_percent, is_training, use_filter,
+                        white_list_len, black_list_len, seed,
+                        lr=None, param_attr=None, param_attr_wl=None,
+                        param_attr_bl=None, name=None,
+                        distribute_update_vars=None, dtype="float32",
+                        length=None):
+    """ref: contrib/layers/nn.py:667 — hashed n-gram pyramid embedding.
+    Static contract: (Out [B, L-1, T, num_emb], DropPos keep mask); see
+    ops/ctr_text_ops.py pyramid_hash for the deviations (mix hash, no
+    bloom filters)."""
+    helper = LayerHelper(name or "search_pyramid_hash")
+    w = helper.create_parameter(param_attr, [space_len + rand_len, 1],
+                                dtype)
+    b, t = input.shape[0], input.shape[1]
+    out = helper.create_variable_for_type_inference(
+        dtype, (b, pyramid_layer - 1, t, num_emb))
+    dp = helper.create_variable_for_type_inference(
+        "int32", (b, pyramid_layer - 1, t))
+    xt = helper.create_variable_for_type_inference("float32", input.shape)
+    ins = {"X": [input], "W": [w]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="pyramid_hash", inputs=ins,
+                     outputs={"Out": [out], "DropPos": [dp],
+                              "X_Temp_Out": [xt]},
+                     attrs={"num_emb": num_emb, "space_len": space_len,
+                            "pyramid_layer": pyramid_layer,
+                            "rand_len": rand_len,
+                            "drop_out_percent": drop_out_percent,
+                            "is_training": is_training,
+                            "use_filter": use_filter, "seed": seed})
+    return out, dp

@@ -241,3 +241,75 @@ def _roi_perspective_transform(ctx, ins, attrs):
             "Out2InWeights": jnp.zeros((r, 1), jnp.float32),
             "Mask": mask,
             "TransformMatrix": tm.astype(a.dtype)}
+
+
+@register("pyramid_hash")
+def _pyramid_hash(ctx, ins, attrs):
+    """ref: operators/pyramid_hash_op.cc — hashed n-gram embeddings: for
+    every window of size 2..pyramid_layer over the id sequence, hash the
+    n-gram into a [space_len] table at num_emb/rand_len seeds and
+    concatenate the rand_len-wide weight slices.
+
+    Static contract (the reference emits one LoD row per kept n-gram):
+    Out [B, L-1, T, num_emb] — window size ℓ+1 at row ℓ-1, position t —
+    with DropPos [B, L-1, T] the keep mask (invalid positions, too-short
+    windows, and train-time dropout are 0 rows).  The hash is this
+    framework's SplitMix-style mix, not bitwise XXH32; bloom-filter
+    white/black lists are not supported (use_filter must be False)."""
+    ids = x(ins, "X")                      # [B, T] int ids
+    w = x(ins, "W").reshape(-1)            # [space_len + rand_len]
+    length = x(ins, "Length")
+    num_emb = int(attrs["num_emb"])
+    space_len = int(attrs["space_len"])
+    rand_len = int(attrs["rand_len"])
+    if num_emb % rand_len:
+        raise ValueError(
+            f"pyramid_hash: num_emb ({num_emb}) must be divisible by "
+            f"rand_len ({rand_len}) — the reference enforces this and a "
+            f"silent truncation would break the declared output width")
+    seed_base = int(attrs.get("seed", 0))
+    pyramid_layer = int(attrs.get("pyramid_layer", 2))
+    drop_out = float(attrs.get("drop_out_percent", 0.0))
+    is_training = bool(attrs.get("is_training", False)) and not ctx.is_test
+    if attrs.get("use_filter", False):
+        raise NotImplementedError(
+            "pyramid_hash bloom-filter white/black lists are a binary "
+            "format of the reference's filter library — load-time "
+            "filtering is not supported; pass use_filter=False")
+    b, t = ids.shape
+    nblocks = num_emb // rand_len
+    if length is None:
+        lens = jnp.full((b,), t, jnp.int32)
+    else:
+        lens = length.reshape(-1).astype(jnp.int32)
+
+    from .breadth2_ops import mix_hash as mix
+    layers_out = []
+    keeps = []
+    win_idx = jnp.arange(t)
+    for ell in range(1, pyramid_layer):
+        width = ell + 1
+        # order-dependent n-gram hash: fold ids through the mixer
+        h = jnp.zeros((b, t), jnp.uint32)
+        for k in range(width):
+            shifted = jnp.pad(ids, [(0, 0), (0, k)])[:, k:k + t]
+            h = mix(h ^ shifted.astype(jnp.uint32),
+                    0x9e37 + k + seed_base)
+        valid = (win_idx[None, :] + width) <= lens[:, None]   # [B, T]
+        if is_training and drop_out > 0:
+            keep_draw = jax.random.uniform(ctx.next_key(), (b, t))
+            valid = valid & (keep_draw >= drop_out)
+        pieces = []
+        for j in range(nblocks):
+            bucket = (mix(h, 0x51ed + j * rand_len + seed_base)
+                      % jnp.uint32(space_len)).astype(jnp.int32)
+            idx = bucket[..., None] + jnp.arange(rand_len)    # [B, T, r]
+            pieces.append(w[idx])
+        emb = jnp.concatenate(pieces, -1)                     # [B,T,num_emb]
+        emb = jnp.where(valid[..., None], emb, 0.0)
+        layers_out.append(emb)
+        keeps.append(valid)
+    out = jnp.stack(layers_out, 1)        # [B, L-1, T, num_emb]
+    drop_pos = jnp.stack(keeps, 1).astype(jnp.int32)
+    return {"Out": out, "DropPos": drop_pos,
+            "X_Temp_Out": ids.astype(jnp.float32)}

@@ -300,3 +300,38 @@ def test_tree_conv_eta_semantics():
     want2 = np.einsum("d,do->o", x2, W[:, 2, :, 0])
     np.testing.assert_allclose(o[0, 1, :, 0], want2, rtol=1e-4,
                                atol=1e-5)
+
+
+def test_pyramid_hash_static_contract():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.contrib import layers as cl
+    from paddle_tpu.framework.core import (Program, program_guard,
+                                           reset_default_programs)
+    rng = np.random.RandomState(11)
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[5], dtype="int64")
+        ln = fluid.layers.data("ln", shape=[], dtype="int64")
+        out, dp = cl.search_pyramid_hash(
+            x, num_emb=8, space_len=64, pyramid_layer=3, rand_len=4,
+            drop_out_percent=0.0, is_training=False, use_filter=False,
+            white_list_len=0, black_list_len=0, seed=0, length=ln)
+    exe = fluid.Executor(fluid.CPUPlace())
+    ids = np.array([[3, 7, 7, 2, 0], [5, 5, 5, 5, 5]], np.int64)
+    lens = np.array([4, 5], np.int64)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, d = exe.run(main, feed={"x": ids, "ln": lens},
+                       fetch_list=[out, dp])
+    o, d = np.asarray(o), np.asarray(d)
+    assert o.shape == (2, 2, 5, 8) and d.shape == (2, 2, 5)
+    # window size 2 valid at positions 0..len-2
+    np.testing.assert_array_equal(d[0, 0], [1, 1, 1, 0, 0])
+    np.testing.assert_array_equal(d[1, 1], [1, 1, 1, 0, 0])  # width 3
+    # identical n-grams hash to identical embeddings
+    np.testing.assert_allclose(o[1, 0, 0], o[1, 0, 1], rtol=1e-6)
+    # different n-grams (3,7) vs (7,7) differ
+    assert not np.allclose(o[0, 0, 0], o[0, 0, 1])
+    # invalid rows are zero
+    np.testing.assert_allclose(o[0, 0, 4], 0.0)
