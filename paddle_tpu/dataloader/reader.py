@@ -100,6 +100,66 @@ class _PrefetchIterator:
         return item
 
 
+class _DeviceFeedIterator:
+    """Keep one batch ahead resident on device (the double-buffer analog of
+    ref: operators/reader/buffered_reader.cc:92, which stages the next
+    batch's GPU copy on a side stream while the current batch computes).
+
+    ``jax.device_put`` is asynchronous: the H2D copy for batch N+1 is in
+    flight while the step consuming batch N runs, and the emitted feed
+    dicts hold device arrays the executor passes straight into the jitted
+    step with no further transfer or per-step host round trip."""
+
+    _STOP = object()
+
+    def __init__(self, it, device=None):
+        import jax
+        self._jax = jax
+        self._it = it
+        self._device = device
+        self._pending_exc = None
+        self._ahead = self._fetch()
+
+    def _place(self, item):
+        put = self._jax.device_put
+        if isinstance(item, dict):
+            return {k: put(np.asarray(v), self._device)
+                    for k, v in item.items()}
+        if isinstance(item, (tuple, list)):
+            return type(item)(put(np.asarray(v), self._device) for v in item)
+        return put(np.asarray(item), self._device)
+
+    def _fetch(self):
+        try:
+            return self._place(next(self._it))
+        except StopIteration:
+            return self._STOP
+        except BaseException as e:   # noqa: BLE001 — re-raised in turn
+            # an error while PREfetching batch N+1 must not swallow batch N
+            # (already staged): deliver N, raise when the consumer reaches
+            # the failed position
+            self._pending_exc = e
+            return self._STOP
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        cur = self._ahead
+        if cur is self._STOP:
+            if self._pending_exc is not None:
+                e, self._pending_exc = self._pending_exc, None
+                raise e
+            raise StopIteration
+        self._ahead = self._fetch()
+        return cur
+
+    def close(self):
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            close()
+
+
 class DataLoader:
     """Two construction paths, matching the reference:
 
@@ -116,10 +176,13 @@ class DataLoader:
                  num_workers: int = 0, capacity: int = 8,
                  batch_sampler: Optional[BatchSampler] = None,
                  num_replicas: int = 1, rank: int = 0, seed=None,
-                 use_multiprocess: bool = False):
+                 use_multiprocess: bool = False,
+                 use_double_buffer: bool = False, places=None):
         self.dataset = dataset
         self.feed_list = feed_list
         self.capacity = capacity
+        self._want_double_buffer = use_double_buffer
+        self.places = places
         self.collate_fn = collate_fn or default_collate
         self.num_workers = num_workers
         self.use_multiprocess = use_multiprocess or num_workers > 0
@@ -139,10 +202,24 @@ class DataLoader:
                        iterable=True, return_list=False,
                        use_multiprocess=False, drop_last=True):
         return DataLoader(feed_list=feed_list, capacity=capacity,
-                          use_multiprocess=use_multiprocess)
+                          use_multiprocess=use_multiprocess,
+                          use_double_buffer=use_double_buffer)
+
+    @property
+    def use_double_buffer(self):
+        # device prefetch: only meaningful for a single target device —
+        # multi-device programs shard feeds themselves inside the jitted
+        # step, so pre-committing to one device would force a reshard.
+        # Evaluated lazily so places passed to set_*_generator (the
+        # reference API path) are honoured.
+        return self._want_double_buffer and (
+            self.places is None or len(np.atleast_1d(self.places)) == 1)
 
     def set_sample_generator(self, reader, batch_size, drop_last=True,
                              places=None):
+        if places is not None:
+            self.places = places
+
         def gen():
             batch = []
             for sample in reader():
@@ -156,6 +233,9 @@ class DataLoader:
         return self
 
     def set_sample_list_generator(self, reader, places=None):
+        if places is not None:
+            self.places = places
+
         def gen():
             for batch in reader():
                 yield self.collate_fn(batch)
@@ -163,6 +243,8 @@ class DataLoader:
         return self
 
     def set_batch_generator(self, reader, places=None):
+        if places is not None:
+            self.places = places
         self._generator = reader
         return self
 
@@ -187,6 +269,17 @@ class DataLoader:
             return dict(zip(self._feed_names, arrays))
         return batch
 
+    def _wrap_device(self, it):
+        if not self.use_double_buffer:
+            return it
+        dev = None
+        if self.places is not None:
+            from ..framework.core import _jax_device_for
+            place = self.places if not isinstance(self.places, (list, tuple)) \
+                else self.places[0]
+            dev = _jax_device_for(place)
+        return _DeviceFeedIterator(it, dev)
+
     def __iter__(self):
         if self.use_multiprocess:
             # worker PROCESSES + shared-memory transport (ref:
@@ -195,18 +288,19 @@ class DataLoader:
             from .worker import MultiprocessIterator
             n = self.num_workers or 2
             if self._generator is not None:
-                return MultiprocessIterator(
+                return self._wrap_device(MultiprocessIterator(
                     generator=self._generator, num_workers=n,
-                    capacity=self.capacity, to_feed=self._to_feed)
+                    capacity=self.capacity, to_feed=self._to_feed))
             if self.batch_sampler is not None:
-                return MultiprocessIterator(
+                return self._wrap_device(MultiprocessIterator(
                     dataset=self.dataset,
                     index_batches=list(self.batch_sampler),
                     collate_fn=self.collate_fn, num_workers=n,
-                    capacity=self.capacity, to_feed=self._to_feed)
+                    capacity=self.capacity, to_feed=self._to_feed))
             # IterableDataset can't be split safely — fall through to the
             # thread path rather than silently duplicating samples
-        return _PrefetchIterator(self._produce, self.capacity)
+        return self._wrap_device(_PrefetchIterator(self._produce,
+                                                   self.capacity))
 
     def __len__(self):
         if self.batch_sampler is not None:

@@ -65,7 +65,18 @@ class DatasetBase:
         self._pipe_command = cmd
 
     def set_hdfs_config(self, fs_name, fs_ugi):
-        self._hdfs = (fs_name, fs_ugi)   # parity stub: local FS only
+        """Accepted for API parity but NOT implemented: filelists are
+        read from the local filesystem only (ref:
+        incubate/fleet/utils/hdfs.py pluggable fs client).  Warn loudly —
+        a user pointing at HDFS would otherwise silently read local
+        paths."""
+        import warnings
+        warnings.warn(
+            f"set_hdfs_config({fs_name!r}, ...): HDFS access is not "
+            f"implemented in paddle_tpu — filelist paths will be opened "
+            f"on the LOCAL filesystem. Stage files locally (or via a "
+            f"fuse mount) before training.", UserWarning, stacklevel=2)
+        self._hdfs = (fs_name, fs_ugi)
 
     # -- internals -------------------------------------------------------
     def _ensure_native(self):

@@ -45,6 +45,12 @@ _register("rpc_retry_times", 3)
 _register("check_nan_inf_per_op", False)
 _register("use_flash_attention", True)     # pallas kernel gate (TPU-new)
 _register("use_pallas_fused", True)        # fused LN/bias-gelu/adam kernels
+# reuse the device copy of a feed array fed repeatedly: sound only when the
+# caller promises not to mutate the buffer in place, signalled by freezing
+# it (arr.flags.writeable = False) — the analog of the reference's
+# buffered_reader keeping the staged GPU copy alive
+# (ref: operators/reader/buffered_reader.cc:92 double-buffer slots)
+_register("cache_feed_arrays", True)
 _register("benchmark", False)              # ref: flags.cc benchmark
 _register("print_executor_cache_hits", False)
 # accepted no-ops: XLA owns these concerns (ref: flags.cc lines noted)

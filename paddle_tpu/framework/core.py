@@ -519,6 +519,12 @@ def reset_default_programs():
 # ---------------------------------------------------------------------------
 
 
+class EOFException(Exception):
+    """Raised when a started py_reader's pass is exhausted
+    (ref: fluid.core.EOFException; paddle/fluid/framework/reader.h) —
+    catch it and call ``reader.reset()`` to begin the next pass."""
+
+
 class Place:
     _kind = "undefined"
 

@@ -25,6 +25,7 @@ map to ``jax.checkpoint`` over op segments (ref: backward.py:629).
 from __future__ import annotations
 
 import contextlib
+import weakref
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -321,12 +322,124 @@ def _fetch_names(fetch_list):
             for f in fetch_list]
 
 
+class _FeedDeviceCache:
+    """Host→device feed cache keyed by buffer identity.
+
+    Repeatedly feeding the same host array (fixed eval batches, constant
+    tables, a benchmark loop) re-transfers it every ``run()`` — over a
+    remote-chip link that is a full round trip per step.  The reference
+    avoids this with staged double-buffer slots that keep the device copy
+    alive across reads (ref: operators/reader/buffered_reader.cc:92);
+    here the staged copy is cached under the host buffer's identity.
+
+    Only arrays the caller has FROZEN (``arr.flags.writeable == False``)
+    are cached: freezing is the caller's promise the buffer will not be
+    mutated in place, which makes identity (object id + data pointer +
+    shape + dtype) a sound key.  Entries hold a weakref to the source so
+    a GC'd array (whose data pointer may be reused) drops its entry.
+    """
+
+    def __init__(self, device, maxsize=64):
+        self._device = device
+        self._maxsize = maxsize
+        self._entries: Dict[Any, Any] = {}   # key -> (weakref, device_array)
+
+    def lookup(self, arr):
+        """Return a device-resident copy of ``arr``, or None if uncacheable."""
+        if not isinstance(arr, np.ndarray) or arr.flags.writeable or \
+                not arr.flags.owndata:
+            # owndata guards against INCIDENTALLY read-only arrays
+            # (np.broadcast_to views, dlpack wrappers, memmaps) whose
+            # backing buffer can still change under the same pointer —
+            # only an owning array somebody froze is a deliberate promise
+            return None
+        key = (id(arr), arr.__array_interface__["data"][0], arr.shape,
+               str(arr.dtype))
+        hit = self._entries.get(key)
+        if hit is not None:
+            ref, buf = hit
+            if ref() is arr:
+                return buf
+            del self._entries[key]
+        buf = jax.device_put(arr, self._device)
+        if len(self._entries) >= self._maxsize:
+            self._entries.pop(next(iter(self._entries)))
+        self._entries[key] = (weakref.ref(arr), buf)
+        return buf
+
+
 def _mesh_identity(mesh):
     """Content-based mesh cache key — id(mesh) can be reused after GC."""
     if mesh is None:
         return None
     return (tuple(mesh.axis_names), mesh.devices.shape,
             tuple(d.id for d in mesh.devices.flat))
+
+
+class _FieldDumper:
+    """Per-worker training observability (ref: trainer_desc.proto:12-15
+    dump_fields/dump_fields_path/dump_param + device_worker.cc DumpField/
+    DumpParam): configured through ``program._fleet_opt`` exactly like the
+    reference's trainer factory (trainer_factory.py:65), writing one text
+    file per worker under dump_fields_path.
+
+    Formats mirror the reference: dump_fields emits one line per batch
+    instance ``lineid \\t name:len:v0:v1...`` (2-D [batch, D] vars only,
+    device_worker.cc CheckValidOutput); dump_param emits
+    ``(batch,name):v0:v1...`` after the step's update."""
+
+    def __init__(self, program, scope):
+        opt_info = getattr(program, "_fleet_opt", None) or {}
+        self.field_names = list(opt_info.get("dump_fields") or [])
+        self.param_names = list(opt_info.get("dump_param") or [])
+        self.path = opt_info.get("dump_fields_path")
+        self.scope = scope
+        self._f = None
+        self._lineid = 0
+        if (self.field_names or self.param_names) and not self.path:
+            raise ValueError(
+                "dump_fields/dump_param need dump_fields_path in "
+                "_fleet_opt (ref: trainer_desc.proto:12)")
+        if self.path and (self.field_names or self.param_names):
+            import os
+            os.makedirs(self.path, exist_ok=True)
+            rank = jax.process_index()
+            self._f = open(os.path.join(self.path, f"worker-{rank}"), "a")
+        # unknown fields fail loudly at the first fetch, like a bad
+        # fetch_list would
+
+    @staticmethod
+    def _fmt(vals):
+        return ":".join(f"{v:.9g}" if isinstance(v, float) else str(v)
+                        for v in vals)
+
+    def after_step(self, step, field_vals):
+        if self._f is None:
+            return
+        arrays = [np.asarray(_fetch_numpy(v)) for v in field_vals]
+        if arrays:
+            batch = arrays[0].shape[0] if arrays[0].ndim >= 1 else 1
+            for i in range(batch):
+                parts = [str(self._lineid)]
+                for name, a in zip(self.field_names, arrays):
+                    if a.ndim != 2 or a.shape[0] != batch:
+                        continue     # CheckValidOutput: 2-D batch vars only
+                    row = a[i].ravel().tolist()
+                    parts.append(f"{name}:{len(row)}:{self._fmt(row)}")
+                self._f.write("\t".join(parts) + "\n")
+                self._lineid += 1
+        for name in self.param_names:
+            v = self.scope.find_var(name)
+            if v is None:
+                continue
+            vals = np.asarray(_fetch_numpy(v)).ravel().tolist()
+            self._f.write(f"({step},{name}):{self._fmt(vals)}\n")
+        self._f.flush()
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
 
 
 class Executor:
@@ -336,11 +449,12 @@ class Executor:
         self.place = place if place is not None else TPUPlace(0)
         self._device = _jax_device_for(self.place)
         self._cache: Dict[Any, _CompiledStep] = {}
+        self._feed_cache = _FeedDeviceCache(self._device)
 
     # -- public API ------------------------------------------------------
     def run(self, program: Optional[Program] = None, feed=None,
             fetch_list=None, scope: Optional[Scope] = None,
-            return_numpy: bool = True):
+            return_numpy: bool = True, use_prune: bool = False):
         program = program or default_main_program()
         scope = scope or global_scope()
         feed = feed or {}
@@ -364,6 +478,39 @@ class Executor:
             program = program._program
 
         fetch_names = _fetch_names(fetch_list)
+
+        # py_reader-backed programs: drain one batch per run into the
+        # reader's data vars (the executor-side image of the reference's
+        # in-graph `read` op popping the LoDTensorBlockingQueue,
+        # ref: operators/reader/read_op.cc).  Default semantics match the
+        # reference's Executor.run(use_prune=False): EVERY run executes
+        # the whole program and consumes a batch.  ``use_prune=True``
+        # (the reference's opt-in, executor.py use_prune) prunes to the
+        # fetch targets, so an auxiliary fetch that doesn't depend on the
+        # reader slots consumes nothing.
+        readers = getattr(program, "_py_readers", ())
+        if readers:
+            slot_names = {v.name for r in readers for v in r.data_vars}
+            if use_prune and fetch_names and not self._fetches_depend_on(
+                    program, fetch_names, slot_names):
+                program = self._pruned_for(program, fetch_list,
+                                           fetch_names)
+            else:
+                for reader in readers:
+                    if reader._started:
+                        feed = dict(feed)   # don't mutate caller's dict
+                        for k, v in reader._next_feed().items():
+                            feed.setdefault(k, v)
+                    else:
+                        missing = [v.name for v in reader.data_vars
+                                   if v.name not in feed]
+                        if missing:
+                            raise RuntimeError(
+                                f"program reads py_reader "
+                                f"{reader.name!r} slots {missing} but "
+                                f"the reader is not started — call "
+                                f"reader.start() (or feed the slots; "
+                                f"ref: reader.py PyReader.start)")
         if compiled_wrapper is not None and compiled_wrapper._pending_passes:
             # strategy passes run against a clone per fetch list: fetched
             # intermediates are protected, and a later run with different
@@ -405,7 +552,13 @@ class Executor:
         if key is None:
             key = jax.random.PRNGKey(program.random_seed)
 
+        from ..flags import flag
         feed_vals = {k: feed[k] for k in step.feed_names}
+        if mesh is None and flag("cache_feed_arrays"):
+            for k, v in feed_vals.items():
+                buf = self._feed_cache.lookup(v)
+                if buf is not None:
+                    feed_vals[k] = buf
         if step.spans_processes:
             # multi-host regime (ref: num_trainers>1): each process feeds
             # its LOCAL batch shard; lift everything to global jax.Arrays
@@ -417,7 +570,6 @@ class Executor:
                                       v)
                         for n, v in state_in.items()}
             key = _to_global(mesh, P(), key)
-        from ..flags import flag
         with RecordEvent("executor::run"):
             if flag("check_nan_inf") and flag("check_nan_inf_per_op") \
                     and mesh is None:
@@ -561,18 +713,57 @@ class Executor:
         # declare — drop those (programs opt in by declaring them)
         prog = program or default_main_program()
         from .compiler import CompiledProgram
-        block = (prog._program if isinstance(prog, CompiledProgram)
-                 else prog).global_block()
+        raw_prog = (prog._program if isinstance(prog, CompiledProgram)
+                    else prog)
+        block = raw_prog.global_block()
+        dumper = _FieldDumper(raw_prog, scope or global_scope())
+        # dump fields are fetched in full, AFTER the user's fetch_list —
+        # a name in both is fetched twice (same traced value, no extra
+        # compute) so after_step's zip stays aligned with field_names
+        run_fetches = list(fetch_list) + dumper.field_names
         for feed in dataset._iter_feed_dicts(drop_last=drop_last):
             feed = {k: v for k, v in feed.items() if block.has_var(k)}
-            last = self.run(prog, feed=feed, fetch_list=fetch_list,
-                            scope=scope)
+            # fetches stay device-resident between print points so the
+            # loop pipelines (dispatch step N+1 while N computes) instead
+            # of forcing a device→host sync every step — the DeviceWorker
+            # only materialises fetch_vars at print_period too
+            # (ref: device_worker.cc PrintFetchVars cadence)
+            last = self.run(prog, feed=feed, fetch_list=run_fetches,
+                            scope=scope, return_numpy=False)
+            dumper.after_step(step, last[len(fetch_list):])
+            last = last[:len(fetch_list)]
             step += 1
             if fetch_list and (debug or step % print_period == 0):
-                vals = ", ".join(f"{n}={np.asarray(v).ravel()[:4]}"
+                vals = ", ".join(f"{n}={_fetch_numpy(v).ravel()[:4]}"
                                  for n, v in zip(fetch_info, last))
                 print(f"[train_from_dataset] step {step}: {vals}")
+        dumper.close()
+        if last is not None:
+            last = [_fetch_numpy(v) for v in last]
         return last
+
+    # -- py_reader support ----------------------------------------------
+    def _fetches_depend_on(self, program, fetch_names, slot_names):
+        """Do the fetch targets transitively read any reader slot?
+        Cached per (program uid, version, fetches)."""
+        key = (program._uid, program._version, tuple(fetch_names))
+        cache = self.__dict__.setdefault("_dep_cache", {})
+        if key not in cache:
+            needed = set(fetch_names)
+            for op in reversed(program.global_block().ops):
+                if set(op.output_names()) & needed:
+                    needed |= set(op.input_names())
+            cache[key] = bool(needed & slot_names)
+        return cache[key]
+
+    def _pruned_for(self, program, fetch_list, fetch_names):
+        """Program pruned to the fetch targets (reader-free auxiliary
+        runs), cached per (uid, version, fetches)."""
+        key = (program._uid, program._version, tuple(fetch_names))
+        cache = self.__dict__.setdefault("_prune_cache", {})
+        if key not in cache:
+            cache[key] = program._prune(list(fetch_list))
+        return cache[key]
 
     # -- compilation -----------------------------------------------------
     def _feed_signature(self, feed):

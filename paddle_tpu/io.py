@@ -200,20 +200,40 @@ def save_checkpoint(executor, path, train_status: TrainStatus,
 def _list_checkpoints(path):
     if not os.path.isdir(path):
         return []
-    out = []
+    out = {}
+    aside = {}
     for n in os.listdir(path):
-        if n.startswith("checkpoint_"):
+        if not n.startswith("checkpoint_"):
+            continue
+        tail = n.split("_")[1]
+        if tail.endswith(".old"):
+            # rename-aside staging dir from an interrupted same-id
+            # re-save (AsyncCheckpointer.write): loadable fallback when
+            # the crash hit between the two os.replace calls
             try:
-                out.append((int(n.split("_")[1]), os.path.join(path, n)))
+                aside[int(tail[:-4])] = os.path.join(path, n)
             except ValueError:
                 pass
-    return sorted(out)
+            continue
+        try:
+            out[int(tail)] = os.path.join(path, n)
+        except ValueError:
+            pass
+    for cid, d in aside.items():
+        out.setdefault(cid, d)
+    return sorted(out.items())
 
 
 def _cleanup_stale(path, keep):
     cks = _list_checkpoints(path)
     for _, d in cks[:-keep] if keep else []:
         shutil.rmtree(d, ignore_errors=True)
+    # orphaned rename-aside dirs whose final checkpoint landed (crash
+    # between os.replace and rmtree in AsyncCheckpointer.write)
+    for n in os.listdir(path) if os.path.isdir(path) else []:
+        if n.startswith("checkpoint_") and n.endswith(".old") and \
+                os.path.isdir(os.path.join(path, n[:-4])):
+            shutil.rmtree(os.path.join(path, n), ignore_errors=True)
 
 
 def load_checkpoint(executor, path, trainer_id=0,
@@ -401,8 +421,18 @@ class AsyncCheckpointer:
                 with open(os.path.join(tmp, "train_status.json"), "w") as f:
                     json.dump(status, f)
                 if os.path.isdir(final):
-                    shutil.rmtree(final)
-                os.replace(tmp, final)
+                    # rename aside, swap in, then delete: a crash between
+                    # any two steps leaves either the old or the new dir
+                    # under a loadable name (loaders ignore non-
+                    # 'checkpoint_' names), never a missing checkpoint_{id}
+                    old = final + ".old"
+                    if os.path.isdir(old):
+                        shutil.rmtree(old)
+                    os.replace(final, old)
+                    os.replace(tmp, final)
+                    shutil.rmtree(old)
+                else:
+                    os.replace(tmp, final)
                 _cleanup_stale(path, keep)
             except BaseException as e:   # noqa: BLE001 — re-raised on wait
                 self._error = e
@@ -411,3 +441,15 @@ class AsyncCheckpointer:
         self._thread = self._threading.Thread(target=write, daemon=False)
         self._thread.start()
         return final
+
+
+def save_compiled_inference_model(dirname, feeded_var_names, target_vars,
+                                  executor, example_feed,
+                                  main_program=None, scope=None,
+                                  platforms=None):
+    """Compiled (StableHLO) serving artifact next to save_inference_model
+    — see framework/export.py:save_compiled_inference_model."""
+    from .framework.export import save_compiled_inference_model as _impl
+    return _impl(dirname, feeded_var_names, target_vars, executor,
+                 example_feed, main_program=main_program, scope=scope,
+                 platforms=platforms)

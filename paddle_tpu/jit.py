@@ -72,7 +72,11 @@ class StaticFunction:
     (ref: program_translator.py StaticFunction)."""
 
     def __init__(self, fn: Callable, layer: Optional[Layer] = None):
-        self._fn = fn
+        # AST-convert data-dependent Python if/while into lax.cond /
+        # lax.while_loop dispatch (ref: program_translator.py AST path);
+        # unsupported function shapes keep the trace-based fallback
+        from .dygraph_to_static import convert_function
+        self._fn = convert_function(fn) or fn
         self._layer = layer
         self._cache: Dict[tuple, Callable] = {}
 

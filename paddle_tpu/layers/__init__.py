@@ -10,6 +10,14 @@ from .loss import *              # noqa: F401,F403
 from .metric_op import accuracy  # noqa: F401
 from .control_flow import (while_loop, while_loop_collect,  # noqa: F401
                            cond, case, switch_case, StaticRNN)
+from .legacy_control_flow import (While, Switch, IfElse,  # noqa: F401
+                                  DynamicRNN, Print, Assert)
+from .io_reader import (py_reader, create_py_reader_by_data,  # noqa: F401
+                        double_buffer, read_file, load)
+from . import io_reader as io    # fluid.layers.io.* module alias
+from .distributions import (Distribution, Uniform, Normal,  # noqa: F401
+                            Categorical, MultivariateNormalDiag)
+from . import distributions     # noqa: F401  (fluid.layers.distributions)
 from .rnn import (RNNCell, GRUCell, LSTMCell, rnn, birnn,  # noqa: F401
                   Decoder, BeamSearchDecoder, dynamic_decode,
                   DecodeHelper, TrainingHelper, GreedyEmbeddingHelper,
@@ -24,6 +32,7 @@ from ..lr_scheduler import (noam_decay, exponential_decay,  # noqa: F401
 from .detection import *        # noqa: F401,F403
 from .breadth import *          # noqa: F401,F403
 from .breadth2 import *         # noqa: F401,F403
+from .tail_r4 import *          # noqa: F401,F403
 
 # submodule aliases mirroring fluid.layers.* module layout
 from .sequence_lod import *      # noqa: F401,F403

@@ -19,6 +19,8 @@ __all__ = [
     "deformable_conv", "deformable_roi_pooling",
     "retinanet_target_assign", "retinanet_detection_output",
     "locality_aware_nms", "roi_perspective_transform",
+    "detection_map", "generate_proposal_labels", "generate_mask_labels",
+    "multi_box_head",
 ]
 
 
@@ -75,8 +77,8 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
                "aspect_ratios": ars, "flip": flip, "clip": clip,
                "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
                "step_w": steps[0], "step_h": steps[1], "offset": offset},
-              {"Boxes": ((h, w, -1, 4), "float32"),
-               "Variances": ((h, w, -1, 4), "float32")})
+              {"Boxes": ((h, w, num, 4), "float32"),
+               "Variances": ((h, w, num, 4), "float32")})
     return out["Boxes"], out["Variances"]
 
 
@@ -684,3 +686,199 @@ def roi_perspective_transform(input, rois, transformed_height,
                             "transformed_width": transformed_width,
                             "spatial_scale": spatial_scale})
     return out, mask, tm
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version='integral', detect_length=None,
+                  label_length=None, accum_cap=2048):
+    """ref: layers/detection.py:1223 detection_map → detection_map_op.h.
+    Dense contract: detect_res [B, M, 6] (+ detect_length), label
+    [B, G, 5|6] (+ label_length); accumulation state is fixed-cap
+    ([C,1] pos counts, [C, accum_cap, 2] + [C] lengths per tp/fp)."""
+    ins = {"DetectRes": detect_res, "Label": label}
+    if detect_length is not None:
+        ins["DetectLength"] = detect_length
+    if label_length is not None:
+        ins["LabelLength"] = label_length
+    if has_state is not None:
+        ins["HasState"] = has_state
+    if input_states is not None:
+        (ins["PosCount"], ins["TruePos"], ins["TruePosLength"],
+         ins["FalsePos"], ins["FalsePosLength"]) = input_states
+    c, k = class_num, accum_cap
+    helper = LayerHelper("detection_map")
+    if out_states is not None:
+        # caller-provided (persistable) state vars receive the
+        # accumulation — the reference binds the Accum* outputs onto the
+        # evaluator's state vars the same way (ref layers/detection.py
+        # detection_map out_states wiring)
+        pos_v, tp_v, tpl_v, fp_v, fpl_v = out_states
+    else:
+        pos_v = helper.create_variable_for_type_inference("int32", (c, 1))
+        tp_v = helper.create_variable_for_type_inference("float32",
+                                                         (c, k, 2))
+        tpl_v = helper.create_variable_for_type_inference("int32", (c,))
+        fp_v = helper.create_variable_for_type_inference("float32",
+                                                         (c, k, 2))
+        fpl_v = helper.create_variable_for_type_inference("int32", (c,))
+    map_v = helper.create_variable_for_type_inference("float32", (1,))
+    helper.append_op(
+        type="detection_map",
+        inputs={s: [v] for s, v in ins.items()},
+        outputs={"MAP": [map_v], "AccumPosCount": [pos_v],
+                 "AccumTruePos": [tp_v], "AccumTruePosLength": [tpl_v],
+                 "AccumFalsePos": [fp_v], "AccumFalsePosLength": [fpl_v]},
+        attrs={"overlap_threshold": overlap_threshold,
+               "evaluate_difficult": evaluate_difficult,
+               "ap_type": ap_version, "class_num": class_num,
+               "background_label": background_label,
+               "accum_cap": accum_cap})
+    return map_v
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=[0.1, 0.1, 0.2, 0.2],
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False,
+                             rpn_rois_num=None, gt_num=None):
+    """ref: layers/detection.py:2599 → generate_proposal_labels_op.cc.
+    Dense contract: rpn_rois [B, R, 4] (+ rpn_rois_num), gt_* [B, G, ...]
+    (+ gt_num); outputs are [B, batch_size_per_im, ...] + RoisNum."""
+    b = rpn_rois.shape[0]
+    p = batch_size_per_im
+    w = 4 * class_nums
+    ins = {"RpnRois": rpn_rois, "GtClasses": gt_classes,
+           "IsCrowd": is_crowd, "GtBoxes": gt_boxes, "ImInfo": im_info}
+    if rpn_rois_num is not None:
+        ins["RpnRoisNum"] = rpn_rois_num
+    if gt_num is not None:
+        ins["GtNum"] = gt_num
+    out = _op("generate_proposal_labels", ins,
+              {"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": list(bbox_reg_weights),
+               "class_nums": class_nums, "use_random": use_random,
+               "is_cls_agnostic": is_cls_agnostic,
+               "is_cascade_rcnn": is_cascade_rcnn},
+              {"Rois": ((b, p, 4), "float32"),
+               "LabelsInt32": ((b, p), "int32"),
+               "BboxTargets": ((b, p, w), "float32"),
+               "BboxInsideWeights": ((b, p, w), "float32"),
+               "BboxOutsideWeights": ((b, p, w), "float32"),
+               "RoisNum": ((b,), "int32")})
+    return (out["Rois"], out["LabelsInt32"], out["BboxTargets"],
+            out["BboxInsideWeights"], out["BboxOutsideWeights"],
+            out["RoisNum"])
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         poly_len=None, rois_num=None, gt_num=None):
+    """ref: layers/detection.py:2737 → generate_mask_labels_op.cc.
+    Dense polygon contract: gt_segms [B, G, PM, VM, 2] + poly_len
+    [B, G, PM] vertex counts (the 3-level LoD flattened to caps)."""
+    b, p = rois.shape[0], rois.shape[1]
+    mdim = num_classes * resolution * resolution
+    ins = {"ImInfo": im_info, "GtClasses": gt_classes, "IsCrowd": is_crowd,
+           "GtSegms": gt_segms, "Rois": rois, "LabelsInt32": labels_int32}
+    if poly_len is not None:
+        ins["PolyLen"] = poly_len
+    if rois_num is not None:
+        ins["RoisNum"] = rois_num
+    if gt_num is not None:
+        ins["GtNum"] = gt_num
+    out = _op("generate_mask_labels", ins,
+              {"num_classes": num_classes, "resolution": resolution},
+              {"MaskRois": ((b, p, 4), "float32"),
+               "RoiHasMaskInt32": ((b, p), "int32"),
+               "MaskInt32": ((b, p, mdim), "int32"),
+               "MaskRoisNum": ((b,), "int32")})
+    return (out["MaskRois"], out["RoiHasMaskInt32"], out["MaskInt32"],
+            out["MaskRoisNum"])
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=[0.1, 0.1, 0.2, 0.2], flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """ref: layers/detection.py:2111 multi_box_head — the SSD head: per
+    feature map, prior boxes + conv loc/conf branches, flattened and
+    concatenated.  Returns (mbox_locs [N, num_priors, 4], mbox_confs
+    [N, num_priors, C], boxes [num_priors, 4], variances)."""
+    import math as _math
+    from . import nn as _nn
+    from . import tensor_ops as _tensor
+    from .breadth import flatten as _flatten
+
+    if not isinstance(inputs, (list, tuple)):
+        raise ValueError("inputs should be a list or tuple.")
+    num_layer = len(inputs)
+    if num_layer <= 2:
+        assert min_sizes is not None and max_sizes is not None
+        assert len(min_sizes) == num_layer and len(max_sizes) == num_layer
+    elif min_sizes is None and max_sizes is None:
+        min_sizes, max_sizes = [], []
+        step = int(_math.floor((max_ratio - min_ratio) / (num_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+    if steps is not None:
+        step_w = step_h = steps
+
+    mbox_locs, mbox_confs, box_results, var_results = [], [], [], []
+    for i, inp in enumerate(inputs):
+        min_size = min_sizes[i]
+        max_size = max_sizes[i]
+        min_size = min_size if isinstance(min_size, (list, tuple)) \
+            else [min_size]
+        max_size = max_size if isinstance(max_size, (list, tuple)) \
+            else [max_size]
+        ar = aspect_ratios[i] if aspect_ratios is not None else []
+        ar = ar if isinstance(ar, (list, tuple)) else [ar]
+        step = [step_w[i] if step_w else 0.0, step_h[i] if step_h else 0.0]
+        box, var = prior_box(
+            inp, image, min_size, max_size, ar, variance, flip, clip,
+            step, offset, None, min_max_aspect_ratios_order)
+        box_results.append(box)
+        var_results.append(var)
+        # priors per location from prior_box's own output shape — one
+        # authoritative copy of the counting rule (ref multi_box_head
+        # reads box.shape[2] the same way, detection.py:2344)
+        num_boxes = box.shape[2]
+
+        mbox_loc = _nn.conv2d(inp, num_filters=num_boxes * 4,
+                              filter_size=kernel_size, padding=pad,
+                              stride=stride)
+        mbox_loc = _tensor.transpose(mbox_loc, perm=[0, 2, 3, 1])
+        mbox_locs.append(_flatten(mbox_loc, axis=1))
+        conf_loc = _nn.conv2d(inp, num_filters=num_boxes * num_classes,
+                              filter_size=kernel_size, padding=pad,
+                              stride=stride)
+        conf_loc = _tensor.transpose(conf_loc, perm=[0, 2, 3, 1])
+        mbox_confs.append(_flatten(conf_loc, axis=1))
+
+    if len(box_results) == 1:
+        box, var = box_results[0], var_results[0]
+        locs_concat, confs_concat = mbox_locs[0], mbox_confs[0]
+    else:
+        boxes2d = [_tensor.reshape(b_, (-1, 4)) for b_ in box_results]
+        vars2d = [_tensor.reshape(v_, (-1, 4)) for v_ in var_results]
+        box = _tensor.concat(boxes2d)
+        var = _tensor.concat(vars2d)
+        locs_concat = _tensor.concat(mbox_locs, axis=1)
+        confs_concat = _tensor.concat(mbox_confs, axis=1)
+    locs_concat = _tensor.reshape(locs_concat, (0, -1, 4))
+    confs_concat = _tensor.reshape(confs_concat, (0, -1, num_classes))
+    box = _tensor.reshape(box, (-1, 4))
+    var = _tensor.reshape(var, (-1, 4))
+    return locs_concat, confs_concat, box, var

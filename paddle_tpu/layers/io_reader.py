@@ -1,0 +1,165 @@
+"""Pre-DataLoader input surface (VERDICT r3 missing #3): ``py_reader`` /
+``create_py_reader_by_data`` / ``double_buffer`` / ``read_file`` /
+``load`` — the input API most published Paddle-1.x recipes call
+(ref: python/paddle/fluid/layers/io.py:554 py_reader, :725
+create_py_reader_by_data, :836 double_buffer, :867 read_file, :907 load;
+python/paddle/fluid/reader.py:476 the GeneratorLoader behind them).
+
+The reference backs py_reader with a C++ ``LoDTensorBlockingQueue`` read
+by a ``read`` op inside the graph.  Here the executor owns the step
+boundary, so the queue lives host-side (the DataLoader prefetch
+machinery) and `Executor.run` drains one batch per step into the reader's
+data vars — same contract: `start()` each pass, `EOFException` at
+exhaustion, `reset()`, data/compute overlap via the prefetch thread and
+(use_double_buffer) async H2D.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.core import default_main_program, EOFException
+from ..framework.layer_helper import LayerHelper
+from ..framework import unique_name
+
+__all__ = ["py_reader", "create_py_reader_by_data", "double_buffer",
+           "read_file", "load"]
+
+
+class PyReader:
+    """The reader 'Variable' py_reader returns: holds the declared slots,
+    a host queue, and the pass lifecycle (ref: reader.py PyReader)."""
+
+    def __init__(self, capacity: int, data_vars: List, name: str,
+                 use_double_buffer: bool = True):
+        self.capacity = capacity
+        self.data_vars = list(data_vars)
+        self.name = name
+        self.use_double_buffer = use_double_buffer
+        self._source = None          # () -> iterator of tuples of ndarrays
+        self._it = None
+        self._started = False
+
+    # -- data sources (ref: reader.py decorate_* methods) ----------------
+    def decorate_paddle_reader(self, reader, places=None):
+        """``reader()`` yields per-batch LISTS OF SAMPLE TUPLES (the
+        paddle.batch(...) contract); samples are stacked per slot."""
+        def gen():
+            for batch in reader():
+                yield tuple(np.stack([np.asarray(s[i]) for s in batch])
+                            for i in range(len(self.data_vars)))
+        self._source = gen
+        return self
+
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def decorate_tensor_provider(self, reader, places=None):
+        """``reader()`` yields tuples of ready batch ndarrays."""
+        def gen():
+            for batch in reader():
+                yield tuple(np.asarray(a) for a in batch)
+        self._source = gen
+        return self
+
+    decorate_batch_generator = decorate_tensor_provider
+
+    # -- pass lifecycle ---------------------------------------------------
+    def start(self):
+        if self._source is None:
+            raise RuntimeError(
+                "py_reader has no data source — call "
+                "decorate_paddle_reader/decorate_tensor_provider first")
+        from ..dataloader.reader import _PrefetchIterator, \
+            _DeviceFeedIterator
+        self.reset()
+        self._it = _PrefetchIterator(self._source, self.capacity)
+        if self.use_double_buffer:
+            self._it = _DeviceFeedIterator(self._it)
+        self._started = True
+
+    def reset(self):
+        if self._it is not None:
+            close = getattr(self._it, "close", None)
+            if close:
+                close()
+            self._it = None
+        self._started = False
+
+    # -- executor hook ----------------------------------------------------
+    def _next_feed(self):
+        """One batch as a feed dict; EOFException at pass end
+        (ref: fluid.core.EOFException contract)."""
+        if not self._started:
+            raise RuntimeError(
+                f"py_reader {self.name!r} not started — call "
+                f"reader.start() before Executor.run")
+        try:
+            batch = next(self._it)
+        except StopIteration:
+            self._started = False
+            raise EOFException(
+                f"py_reader {self.name!r} exhausted — catch "
+                f"fluid.core.EOFException and call reader.reset()") \
+                from None
+        if len(batch) != len(self.data_vars):
+            raise ValueError(
+                f"py_reader {self.name!r} source yielded {len(batch)} "
+                f"slots, declared {len(self.data_vars)}")
+        return {v.name: b for v, b in zip(self.data_vars, batch)}
+
+
+def py_reader(capacity: int, shapes: Sequence, dtypes: Sequence,
+              lod_levels=None, name: Optional[str] = None,
+              use_double_buffer: bool = True) -> PyReader:
+    """ref: layers/io.py:554 py_reader.  Shapes include the batch dim
+    (-1 allowed, as in the reference)."""
+    main = default_main_program()
+    block = main.current_block()
+    rname = name or unique_name.generate("py_reader")
+    data_vars = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        v = block.create_var(name=f"{rname}.slot{i}", shape=tuple(shape),
+                             dtype=dtype)
+        data_vars.append(v)
+    reader = PyReader(capacity, data_vars, rname, use_double_buffer)
+    main.__dict__.setdefault("_py_readers", []).append(reader)
+    return reader
+
+
+def create_py_reader_by_data(capacity: int, feed_list: Sequence,
+                             name: Optional[str] = None,
+                             use_double_buffer: bool = True) -> PyReader:
+    """ref: layers/io.py:725 — py_reader whose slots are existing data
+    vars (the recognize_digits recipe path)."""
+    main = default_main_program()
+    rname = name or unique_name.generate("py_reader")
+    reader = PyReader(capacity, list(feed_list), rname, use_double_buffer)
+    main.__dict__.setdefault("_py_readers", []).append(reader)
+    return reader
+
+
+def double_buffer(reader: PyReader, place=None, name=None) -> PyReader:
+    """ref: layers/io.py:836 — enable async device staging of the next
+    batch (the buffered_reader.cc analog; jax.device_put overlaps the
+    H2D with the current step)."""
+    reader.use_double_buffer = True
+    return reader
+
+
+def read_file(reader: PyReader):
+    """ref: layers/io.py:867 — the data vars the reader fills each step."""
+    vars_ = reader.data_vars
+    return vars_[0] if len(vars_) == 1 else list(vars_)
+
+
+def load(out, file_path: str, load_as_fp16: Optional[bool] = None):
+    """ref: layers/io.py:907 load → operators/load_op.cc — read a tensor
+    saved on disk (``.npy``) into ``out`` each run."""
+    helper = LayerHelper("load")
+    helper.append_op(type="load", inputs={},
+                     outputs={"Out": [out]},
+                     attrs={"file_path": file_path,
+                            "load_as_fp16": bool(load_as_fp16)})
+    return out

@@ -1,0 +1,419 @@
+"""v1.8 legacy control-flow CLASS forms (VERDICT r3 missing #2):
+While, Switch, IfElse, DynamicRNN, Print, Assert.
+
+These are the block-mutation APIs real v1.8 scripts use (ref:
+python/paddle/fluid/layers/control_flow.py:971 While, :2603 Switch,
+:2761 IfElse, :2939 DynamicRNN, :214 Print, :305 Assert).  The builders
+trace the user's `with` block into a sub-block, detect which OUTER vars
+the block writes (assign / increment / `cond=` comparisons — the
+reference's scope-mutation), and append one structured op whose inputs
+and outputs are those same vars, so mutation semantics survive while the
+lowering stays a pure lax region (ops/legacy_cf_ops.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..framework.core import Variable, default_main_program
+from ..framework.layer_helper import LayerHelper
+from ..framework import unique_name
+from .control_flow import _closure_names
+
+__all__ = ["While", "Switch", "IfElse", "DynamicRNN", "Print", "Assert"]
+
+
+def _written_outer_names(block, parent) -> List[str]:
+    """Outer vars mutated by ``block``: output names already defined in
+    the parent chain (assign into them, increment in_place, cond= writes)
+    rather than first created inside the block."""
+    created = set()
+    written: List[str] = []
+    for op in block.ops:
+        for n in op.output_names():
+            if n in created or n in written:
+                continue
+            if n in block.vars:       # declared locally → local temp
+                created.add(n)
+                continue
+            if parent._find_var_recursive(n) is not None:
+                written.append(n)
+            else:
+                created.add(n)
+    return written
+
+
+class While:
+    """ref: layers/control_flow.py:971 — `While(cond)` + `with
+    while_op.block():`; the body must update ``cond`` (e.g.
+    ``less_than(i, n, cond=cond)``).  Lowers to lax.while_loop
+    (forward-only, like the reference While without while_grad)."""
+
+    def __init__(self, cond: Variable, is_test: bool = False,
+                 name: Optional[str] = None):
+        if cond.dtype not in ("bool",):
+            raise TypeError("While cond must be a bool Variable")
+        self._cond = cond
+        self._is_test = is_test
+        self._name = name or "while"
+        self._main = default_main_program()
+        self._parent = self._main.current_block()
+
+    def block(self):
+        outer = self
+
+        class _Guard:
+            def __enter__(self):
+                outer._block = outer._main._create_block()
+                return self
+
+            def __exit__(self, exc_type, exc, tb):
+                outer._main._rollback()
+                if exc_type is None:
+                    outer._finalize()
+                return False
+
+        return _Guard()
+
+    def _finalize(self):
+        block, parent = self._block, self._parent
+        written = _written_outer_names(block, parent)
+        if self._cond.name not in written:
+            raise ValueError(
+                "While body never updates the cond var — write it with "
+                "e.g. less_than(i, n, cond=cond) or the loop cannot end "
+                "(ref: control_flow.py While example)")
+        carried_vars = [parent._find_var_recursive(n) for n in written]
+        closure = _closure_names([block], written)
+        parent.append_op(
+            type="legacy_while",
+            inputs={"X": carried_vars, "Closure": closure},
+            outputs={"Out": carried_vars},
+            attrs={"carried_names": written, "closure_names": closure,
+                   "body_block": block, "cond_name": self._cond.name,
+                   "is_test": self._is_test})
+
+
+class Switch:
+    """ref: layers/control_flow.py:2603 — `with Switch() as sw:` +
+    `with sw.case(pred):` / `with sw.default():`; first true case wins;
+    case bodies assign into outer vars."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._name = name or "switch"
+        self._main = default_main_program()
+        self._parent = self._main.current_block()
+        self._preds: List[Variable] = []
+        self._blocks = []
+        self._has_default = False
+        self._inside = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self._finalize()
+        return False
+
+    def _branch(self, pred):
+        sw = self
+
+        class _Guard:
+            def __enter__(self):
+                if sw._inside:
+                    raise RuntimeError("nested Switch case")
+                if pred is None and sw._has_default:
+                    raise RuntimeError("Switch already has a default")
+                if pred is None:
+                    sw._has_default = True
+                elif sw._has_default:
+                    raise RuntimeError("case() after default()")
+                sw._inside = True
+                sw._blocks.append(sw._main._create_block())
+                if pred is not None:
+                    sw._preds.append(pred)
+                return self
+
+            def __exit__(self, exc_type, exc, tb):
+                sw._main._rollback()
+                sw._inside = False
+                return False
+
+        return _Guard()
+
+    def case(self, condition: Variable):
+        return self._branch(condition)
+
+    def default(self):
+        return self._branch(None)
+
+    def _finalize(self):
+        if not self._blocks:
+            raise ValueError("Switch needs at least one case")
+        written: List[str] = []
+        for b in self._blocks:
+            for n in _written_outer_names(b, self._parent):
+                if n not in written:
+                    written.append(n)
+        if not written:
+            raise ValueError(
+                "Switch cases write no outer variables — assign into a "
+                "var defined before the switch (the reference's usage)")
+        carried_vars = [self._parent._find_var_recursive(n)
+                        for n in written]
+        closure = _closure_names(self._blocks, written)
+        self._parent.append_op(
+            type="legacy_switch",
+            inputs={"X": carried_vars, "Cond": self._preds,
+                    "Closure": closure},
+            outputs={"Out": carried_vars},
+            attrs={"carried_names": written, "closure_names": closure,
+                   "case_blocks": self._blocks,
+                   "has_default": self._has_default})
+
+
+class IfElse:
+    """ref: layers/control_flow.py:2761 — batch-level branch on a [N, 1]
+    bool mask.  The reference physically splits rows between branches;
+    densely BOTH branches compute on the full batch and outputs merge
+    row-wise by the mask (same contract as MIGRATION's padded semantics;
+    branch ops that mix rows — batch reductions — see full-batch rows)."""
+
+    def __init__(self, cond: Variable, name: Optional[str] = None):
+        self._cond = cond
+        self._name = name or "ifelse"
+        self._phase = None           # 'true' | 'false'
+        self._outs = {"true": [], "false": []}
+        self._built = False
+
+    def _block(self, phase):
+        ie = self
+
+        class _Guard:
+            def __enter__(self):
+                ie._phase = phase
+                return self
+
+            def __exit__(self, exc_type, exc, tb):
+                ie._phase = None
+                return False
+
+        return _Guard()
+
+    def true_block(self):
+        return self._block("true")
+
+    def false_block(self):
+        return self._block("false")
+
+    def input(self, x: Variable) -> Variable:
+        if self._phase is None:
+            raise RuntimeError("IfElse.input() outside a branch block")
+        return x                     # dense: branches see the full batch
+
+    def output(self, *outs):
+        if self._phase is None:
+            raise RuntimeError("IfElse.output() outside a branch block")
+        self._outs[self._phase].extend(outs)
+
+    def __call__(self):
+        t, f = self._outs["true"], self._outs["false"]
+        if len(t) != len(f):
+            raise ValueError(
+                f"IfElse branches must output the same count "
+                f"({len(t)} vs {len(f)})")
+        if not t:
+            raise ValueError("IfElse produced no outputs")
+        helper = LayerHelper(self._name)
+        merged = []
+        for tv, fv in zip(t, f):
+            out = helper.create_variable_for_type_inference(
+                tv.dtype, tv.shape)
+            helper.append_op(type="ifelse_merge",
+                             inputs={"Mask": [self._cond],
+                                     "TrueOut": [tv], "FalseOut": [fv]},
+                             outputs={"Out": [out]})
+            merged.append(out)
+        return merged
+
+
+class DynamicRNN:
+    """ref: layers/control_flow.py:2939 DynamicRNN — RNN over
+    variable-length sequences.  Dense contract: ``step_input(x,
+    length=...)`` takes [B, T, ...] + Length [B] instead of a LoD
+    tensor; outputs are [B, T, ...] zero-padded past each row's length
+    and memories freeze there (the dense image of LoD shrinking)."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._name = name or "dynamic_rnn"
+        self._main = default_main_program()
+        self._parent = self._main.current_block()
+        self._block_ = None
+        self._seq_inputs: List[Variable] = []
+        self._step_inputs: List[Variable] = []
+        self._statics: List[Variable] = []
+        self._static_inblock: List[Variable] = []
+        self._length: Optional[Variable] = None
+        self._mem_init: List[Variable] = []
+        self._mems: List[Variable] = []
+        self._mem_updates = {}
+        self._step_outputs: List[Variable] = []
+        self._outputs: List[Variable] = []
+        self._finalized = False
+
+    def block(self):
+        rnn = self
+
+        class _Guard:
+            def __enter__(self):
+                rnn._block_ = rnn._main._create_block()
+                return rnn
+
+            def __exit__(self, exc_type, exc, tb):
+                rnn._main._rollback()
+                if exc_type is None:
+                    rnn._finalize()
+                return False
+
+        return _Guard()
+
+    def _in_block(self):
+        if self._block_ is None or self._finalized:
+            raise RuntimeError("must be called inside `with drnn.block():`")
+
+    def step_input(self, x: Variable, level=0, length=None) -> Variable:
+        self._in_block()
+        if length is not None:
+            self._length = length
+        v = self._block_.create_var(
+            name=unique_name.generate(f"{self._name}.x"),
+            shape=(x.shape[0],) + tuple(x.shape[2:]), dtype=x.dtype)
+        self._seq_inputs.append(x)
+        self._step_inputs.append(v)
+        return v
+
+    def static_input(self, x: Variable) -> Variable:
+        self._in_block()
+        v = self._block_.create_var(
+            name=unique_name.generate(f"{self._name}.static"),
+            shape=x.shape, dtype=x.dtype)
+        self._statics.append(x)
+        self._static_inblock.append(v)
+        return v
+
+    def memory(self, init: Optional[Variable] = None, shape=None,
+               value=0.0, dtype="float32", need_reorder=False):
+        self._in_block()
+        if init is None:
+            if shape is None:
+                raise ValueError("memory() needs init or shape")
+            if not self._seq_inputs:
+                raise ValueError("call step_input before a shaped memory "
+                                 "(the batch dim comes from it)")
+            from .tensor_ops import fill_constant_batch_size_like
+            # the init is a loop INPUT — build its fill op in the parent
+            # block, not the step block
+            cur_idx = self._main.current_block_idx
+            self._main.current_block_idx = self._parent.idx
+            try:
+                init = fill_constant_batch_size_like(
+                    self._seq_inputs[0], [-1] + list(shape), dtype, value)
+            finally:
+                self._main.current_block_idx = cur_idx
+        mem = self._block_.create_var(
+            name=unique_name.generate(f"{self._name}.mem"),
+            shape=init.shape, dtype=init.dtype)
+        self._mem_init.append(init)
+        self._mems.append(mem)
+        return mem
+
+    def update_memory(self, mem: Variable, new: Variable):
+        self._in_block()
+        self._mem_updates[mem.name] = new
+
+    def output(self, *outputs):
+        self._in_block()
+        self._step_outputs.extend(outputs)
+
+    def _finalize(self):
+        self._finalized = True
+        if not self._seq_inputs:
+            raise ValueError("DynamicRNN needs at least one step_input")
+        if not self._step_outputs:
+            raise ValueError("DynamicRNN needs at least one output")
+        mem_update_names = []
+        for m in self._mems:
+            if m.name not in self._mem_updates:
+                raise ValueError(f"memory {m.name!r} never updated")
+            mem_update_names.append(self._mem_updates[m.name].name)
+        bound = [v.name for v in
+                 self._step_inputs + self._mems + self._static_inblock]
+        closure = _closure_names([self._block_], bound)
+        b = self._seq_inputs[0].shape[0]
+        t = self._seq_inputs[0].shape[1]
+        outs = [self._parent.create_var(
+            name=unique_name.generate(f"{self._name}.out"),
+            shape=(b, t) + tuple(o.shape[1:]), dtype=o.dtype)
+            for o in self._step_outputs]
+        finals = [self._parent.create_var(
+            name=unique_name.generate(f"{self._name}.final"),
+            shape=m.shape, dtype=m.dtype) for m in self._mems]
+        ins = {"X": self._seq_inputs, "MemInit": self._mem_init,
+               "Static": self._statics, "Closure": closure}
+        if self._length is not None:
+            ins["Length"] = [self._length]
+        self._parent.append_op(
+            type="dynamic_rnn", inputs=ins,
+            outputs={"Out": outs, "FinalMem": finals},
+            attrs={"closure_names": closure, "step_block": self._block_,
+                   "step_input_names": [v.name for v in self._step_inputs],
+                   "static_input_names":
+                       [v.name for v in self._static_inblock],
+                   "mem_names": [v.name for v in self._mems],
+                   "mem_update_names": mem_update_names,
+                   "step_output_names":
+                       [v.name for v in self._step_outputs]})
+        self._outputs = outs
+        self._final_mems = finals
+
+    def __call__(self):
+        if not self._finalized:
+            raise RuntimeError("DynamicRNN not finalized — exit the block")
+        return self._outputs[0] if len(self._outputs) == 1 \
+            else self._outputs
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """ref: layers/control_flow.py:214 Print → operators/print_op.cc."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    input.shape)
+    helper.append_op(type="print", inputs={"In": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"message": message or "",
+                            "summarize": summarize,
+                            "print_tensor_name": print_tensor_name,
+                            "var_name": input.name,
+                            "first_n": first_n,
+                            "print_phase": print_phase})
+    return out
+
+
+def Assert(cond, data=None, summarize=20, name=None):
+    """ref: layers/control_flow.py:305 Assert → operators/assert_op.cc.
+    The host-side check raises AssertionError when cond is false; the
+    error surfaces when the step's results are consumed."""
+    helper = LayerHelper(name or "assert")
+    out = helper.create_variable_for_type_inference("int32", ())
+    ins = {"Cond": [cond]}
+    if data:
+        ins["Data"] = list(data)
+    helper.append_op(type="assert", inputs=ins, outputs={"Out": [out]},
+                     attrs={"summarize": summarize})
+    return out

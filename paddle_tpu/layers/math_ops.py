@@ -275,39 +275,43 @@ def sum(x, name=None):
     return out
 
 
-def _compare(op_type, x, y, name=None):
+def _compare(op_type, x, y, name=None, cond=None):
     x = _to_variable(x)
     y = _to_variable(y, like=x)
     helper = LayerHelper(op_type, name=name)
-    out = helper.create_variable_for_type_inference(
-        "bool", _broadcast_shape(x.shape, y.shape))
+    # ``cond`` names an EXISTING bool var to write into — the v1.8 While
+    # pattern `less_than(i, n, cond=cond)` updates the loop condition
+    # in-place (ref: layers/control_flow.py less_than cond parameter)
+    out = cond if cond is not None else \
+        helper.create_variable_for_type_inference(
+            "bool", _broadcast_shape(x.shape, y.shape))
     helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
                      outputs={"Out": [out]})
     return out
 
 
-def equal(x, y, name=None):
-    return _compare("equal", x, y, name)
+def equal(x, y, cond=None, name=None):
+    return _compare("equal", x, y, name, cond)
 
 
-def not_equal(x, y, name=None):
-    return _compare("not_equal", x, y, name)
+def not_equal(x, y, cond=None, name=None):
+    return _compare("not_equal", x, y, name, cond)
 
 
-def less_than(x, y, name=None):
-    return _compare("less_than", x, y, name)
+def less_than(x, y, force_cpu=None, cond=None, name=None):
+    return _compare("less_than", x, y, name, cond)
 
 
-def less_equal(x, y, name=None):
-    return _compare("less_equal", x, y, name)
+def less_equal(x, y, cond=None, name=None):
+    return _compare("less_equal", x, y, name, cond)
 
 
-def greater_than(x, y, name=None):
-    return _compare("greater_than", x, y, name)
+def greater_than(x, y, cond=None, name=None):
+    return _compare("greater_than", x, y, name, cond)
 
 
-def greater_equal(x, y, name=None):
-    return _compare("greater_equal", x, y, name)
+def greater_equal(x, y, cond=None, name=None):
+    return _compare("greater_equal", x, y, name, cond)
 
 
 def logical_and(x, y, name=None):

@@ -1,0 +1,152 @@
+"""Round-4 layer tail (VERDICT r3 missing #1): chunk_eval, ctc_align,
+similarity_focus, sample_logits, filter_by_instag, inplace_abn.
+
+Reference surfaces: python/paddle/fluid/layers/nn.py:1037 chunk_eval,
+:12664 similarity_focus, :10028 filter_by_instag, :2881 inplace_abn;
+sample_logits is the op behind sampled softmax heads
+(operators/sample_logits_op.cc).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.layer_helper import LayerHelper
+from ..framework.initializer import ConstantInitializer
+from .detection import _op
+
+__all__ = [
+    "chunk_eval", "ctc_align", "similarity_focus", "sample_logits",
+    "filter_by_instag", "inplace_abn",
+]
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """ref: layers/nn.py:1037 — chunk-level precision/recall/F1 for
+    sequence labeling (IOB/IOE/IOBES/plain).  Dense contract: [B, T]
+    int64 tags + seq_length."""
+    ins = {"Inference": input, "Label": label}
+    if seq_length is not None:
+        ins["SeqLength"] = seq_length
+    out = _op("chunk_eval", ins,
+              {"num_chunk_types": num_chunk_types,
+               "chunk_scheme": chunk_scheme,
+               "excluded_chunk_types": list(excluded_chunk_types or [])},
+              {"Precision": ((1,), "float32"),
+               "Recall": ((1,), "float32"),
+               "F1-Score": ((1,), "float32"),
+               "NumInferChunks": ((1,), "int64"),
+               "NumLabelChunks": ((1,), "int64"),
+               "NumCorrectChunks": ((1,), "int64")})
+    return (out["Precision"], out["Recall"], out["F1-Score"],
+            out["NumInferChunks"], out["NumLabelChunks"],
+            out["NumCorrectChunks"])
+
+
+def ctc_align(input, input_length=None, blank=0, merge_repeated=True,
+              padding_value=0, name=None):
+    """ref: operators/ctc_align_op.cc — strip blanks / merge repeats from
+    a decoded token matrix [B, T] (+ lengths), left-packed and padded."""
+    ins = {"Input": input}
+    if input_length is not None:
+        ins["InputLength"] = input_length
+    b = input.shape[0]
+    out = _op("ctc_align", ins,
+              {"blank": blank, "merge_repeated": merge_repeated,
+               "padding_value": padding_value},
+              {"Output": (tuple(input.shape), input.dtype),
+               "OutputLength": ((b,), "int64")})
+    return out["Output"], out["OutputLength"]
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """ref: layers/nn.py:12664 similarity_focus."""
+    return _op("similarity_focus", {"X": input},
+               {"axis": axis, "indexes": list(indexes)},
+               {"Out": (tuple(input.shape), input.dtype)})["Out"]
+
+
+def sample_logits(logits, label, num_samples, num_true=1,
+                  remove_accidental_hits=True, use_customized_samples=False,
+                  customized_samples=None, customized_probabilities=None,
+                  seed=0, name=None):
+    """ref: operators/sample_logits_op.cc — sampled-softmax head inputs:
+    returns (SampledLogits [N, NT+S], SampledLabels [N, NT]); Samples and
+    Probabilities are also exposed for the full softmax recovery."""
+    n = logits.shape[0]
+    nt = label.shape[1]
+    s = num_samples
+    ins = {"Logits": logits, "Labels": label}
+    if use_customized_samples:
+        ins["CustomizedSamples"] = customized_samples
+        ins["CustomizedProbabilities"] = customized_probabilities
+    out = _op("sample_logits", ins,
+              {"num_samples": s, "seed": seed,
+               "use_customized_samples": use_customized_samples,
+               "remove_accidental_hits": remove_accidental_hits},
+              {"Samples": ((n, nt + s), "int64"),
+               "Probabilities": ((n, nt + s), "float32"),
+               "SampledLogits": ((n, nt + s), logits.dtype),
+               "SampledLabels": ((n, nt), "int64")})
+    return (out["SampledLogits"], out["SampledLabels"], out["Samples"],
+            out["Probabilities"])
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod, out_val_if_empty=0):
+    """ref: layers/nn.py:10028 filter_by_instag — keep instances whose tag
+    set intersects filter_tag.  Dense contract: Ins rows (or [T, ...]
+    blocks when is_lod) are instances; Ins_tag is [N, K] padded with -1.
+    Returns (Out, LossWeight, IndexMap)."""
+    n = ins.shape[0]
+    out = _op("filter_by_instag",
+              {"Ins": ins, "Ins_tag": ins_tag, "Filter_tag": filter_tag},
+              {"is_lod": is_lod, "out_val_if_empty": out_val_if_empty},
+              {"Out": (tuple(ins.shape), ins.dtype),
+               "LossWeight": ((n, 1), "float32"),
+               "IndexMap": ((n, 3), "int64")})
+    return out["Out"], out["LossWeight"], out["IndexMap"]
+
+
+def inplace_abn(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+                param_attr=None, bias_attr=None, data_layout="NCHW",
+                moving_mean_name=None, moving_variance_name=None,
+                use_global_stats=False, act_alpha=1.0, name=None):
+    """ref: layers/nn.py:2881 inplace_abn — batch norm + activation with
+    in-place buffer reuse; XLA owns the reuse, the semantics are BN
+    followed by identity/leaky_relu/elu (act_alpha)."""
+    helper = LayerHelper("inplace_abn", name=name)
+    ch_axis = 1 if data_layout == "NCHW" else len(input.shape) - 1
+    c = input.shape[ch_axis]
+    scale = helper.create_parameter(
+        param_attr, [c], input.dtype,
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, [c], input.dtype, is_bias=True)
+    block = helper.block
+    sb = helper.startup_program.global_block()
+    mean_name = moving_mean_name or f"{helper.name}.mean"
+    var_name = moving_variance_name or f"{helper.name}.variance"
+    mean = block.create_var(name=mean_name, shape=(c,), dtype=input.dtype,
+                            persistable=True)
+    variance = block.create_var(name=var_name, shape=(c,),
+                                dtype=input.dtype, persistable=True)
+    smean = sb.create_var(name=mean_name, shape=(c,), dtype=input.dtype,
+                          persistable=True)
+    svar = sb.create_var(name=var_name, shape=(c,), dtype=input.dtype,
+                         persistable=True)
+    ConstantInitializer(0.0)(smean, sb)
+    ConstantInitializer(1.0)(svar, sb)
+    saved_mean = helper.create_variable_for_type_inference(input.dtype, (c,))
+    saved_var = helper.create_variable_for_type_inference(input.dtype, (c,))
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op(
+        type="inplace_abn",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [variance]},
+        outputs={"Y": [out], "MeanOut": [mean], "VarianceOut": [variance],
+                 "SavedMean": [saved_mean], "SavedVariance": [saved_var]},
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+               "data_layout": data_layout,
+               "use_global_stats": use_global_stats,
+               "activation": act or "identity", "alpha": act_alpha})
+    return out

@@ -28,3 +28,6 @@ from . import recsys_ops    # noqa: F401
 from . import ctr_text_ops  # noqa: F401
 from . import pipeline_op   # noqa: F401
 from . import ps_ops        # noqa: F401
+from . import eval_tail_ops  # noqa: F401
+from . import label_gen_ops  # noqa: F401
+from . import legacy_cf_ops  # noqa: F401

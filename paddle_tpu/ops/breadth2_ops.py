@@ -30,17 +30,59 @@ def _add_position_encoding(ctx, ins, attrs):
     return {"Out": alpha * a + beta * pe[None].astype(a.dtype)}
 
 
+@jax.custom_vjp
+def _cvm_fwd_use(a, cvm):
+    show = jnp.log(a[:, 0:1] + 1.0)
+    click = jnp.log(a[:, 1:2] + 1.0) - show
+    return jnp.concatenate([show, click, a[:, 2:]], axis=1)
+
+
+def _cvm_fwd_use_f(a, cvm):
+    return _cvm_fwd_use(a, cvm), cvm
+
+
+def _cvm_fwd_use_b(cvm, dy):
+    # ref grad kernel: dX = dY with the first two columns REPLACED by the
+    # CVM input's show/click values (cvm_op.h CvmGradComputeKernel)
+    return (jnp.concatenate([cvm[:, 0:2].astype(dy.dtype), dy[:, 2:]],
+                            axis=1), jnp.zeros_like(cvm))
+
+
+_cvm_fwd_use.defvjp(_cvm_fwd_use_f, _cvm_fwd_use_b)
+
+
+@jax.custom_vjp
+def _cvm_fwd_strip(a, cvm):
+    return a[:, 2:]
+
+
+def _cvm_fwd_strip_f(a, cvm):
+    return _cvm_fwd_strip(a, cvm), cvm
+
+
+def _cvm_fwd_strip_b(cvm, dy):
+    return (jnp.concatenate([cvm[:, 0:2].astype(dy.dtype), dy], axis=1),
+            jnp.zeros_like(cvm))
+
+
+_cvm_fwd_strip.defvjp(_cvm_fwd_strip_f, _cvm_fwd_strip_b)
+
+
 @register("continuous_value_model")
 def _cvm(ctx, ins, attrs):
-    """ref: operators/cvm_op.h — CTR show/click statistics prepended to
-    each embedding; use_cvm=False strips the two stat columns."""
+    """ref: operators/cvm_op.h — CTR show/click statistics: X's own first
+    two columns become log(show+1) and log(click+1)-log(show+1)
+    (use_cvm=True) or are stripped (use_cvm=False).  The grad kernel is
+    custom: dX's first two columns are the CVM input's values, the rest
+    passes dY through — mirrored here with custom_vjp."""
     a = x(ins, "X")                  # [B, D] with cols 0,1 = show, click
     cvm = x(ins, "CVM")              # [B, 2]
     if attrs.get("use_cvm", True):
-        show = jnp.log(cvm[:, 0:1] + 1.0)
-        click = jnp.log(cvm[:, 1:2] + 1.0) - show
-        return {"Y": jnp.concatenate([show, click, a[:, 2:]], axis=1)}
-    return {"Y": a[:, 2:]}
+        return {"Y": _cvm_fwd_use(a, cvm)}
+    return {"Y": _cvm_fwd_strip(a, cvm)}
+
+
+register("cvm")(_cvm)     # registry-diff alias: REGISTER_OPERATOR(cvm, ...)
 
 
 @register("fsp_matrix")

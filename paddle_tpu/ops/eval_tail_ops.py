@@ -1,0 +1,594 @@
+"""Round-4 op tail: evaluation / sampling / filtering ops from the
+registry diff (VERDICT r3 missing #1).
+
+- chunk_eval        ref: operators/chunk_eval_op.h (NER chunk F1)
+- ctc_align         ref: operators/ctc_align_op.h (dense padded branch)
+- similarity_focus  ref: operators/similarity_focus_op.h
+- sample_logits     ref: operators/sample_logits_op.h + math/sample_prob.h
+- filter_by_instag  ref: operators/filter_by_instag_op.h
+- inplace_abn       ref: operators/inplace_abn_op.cc (BN+act, memory reuse
+                    is XLA's job so this is batch_norm ∘ activation)
+- detection_map     ref: operators/detection_map_op.h (host mAP evaluator
+                    via pure_callback — CPU-only kernel in the reference)
+
+All follow the dense-padded contract from MIGRATION.md: LoD inputs become
+[B, T, ...] plus explicit lengths; dynamic-size outputs are fixed-cap with
+valid counts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x, get_op
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval
+# ---------------------------------------------------------------------------
+
+_CHUNK_SCHEMES = {
+    # scheme: (num_tag_types, tag_begin, tag_inside, tag_end, tag_single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_begin_mask(pt, pty, t, ty, other, tb, ti, te, ts):
+    """Vectorised ChunkBegin (ref: chunk_eval_op.h ChunkBegin): does a new
+    chunk start at the (prev, cur) transition?"""
+    tag_rule = (t == tb) | (t == ts) | \
+        (((t == ti) | (t == te)) & ((pt == te) | (pt == ts)))
+    return jnp.where(pty == other, ty != other,
+                     jnp.where(ty == other, False,
+                               jnp.where(ty != pty, True, tag_rule)))
+
+
+def _chunk_end_mask(pt, pty, t, ty, other, tb, ti, te, ts):
+    """Vectorised ChunkEnd: does the chunk containing prev end at prev?"""
+    tag_rule = (((pt == tb) | (pt == ti)) & ((t == tb) | (t == ts))) | \
+        (pt == te) | (pt == ts)
+    return jnp.where(pty == other, False,
+                     jnp.where(ty == other, True,
+                               jnp.where(ty != pty, True, tag_rule)))
+
+
+def _segments(labels, valid, num_chunk_types, scheme):
+    """Per-position (begin mask, end-of-my-chunk index, type) — the dense
+    equivalent of the reference's sequential GetSegments: a chunk is keyed
+    by its begin position; its end is the first end-mask position >= it."""
+    ntag, tb, ti, te, ts = _CHUNK_SCHEMES[scheme]
+    other = num_chunk_types
+    tag = labels % ntag
+    typ = labels // ntag
+    # invalid (padding) positions behave as the 'other' type, which both
+    # blocks begins there and forces an end at the last valid position —
+    # same effect as the reference's per-sequence flush
+    typ = jnp.where(valid, typ, other)
+    b, t_len = labels.shape
+    pt = jnp.concatenate([jnp.full((b, 1), -1, tag.dtype), tag[:, :-1]], 1)
+    pty = jnp.concatenate([jnp.full((b, 1), other, typ.dtype),
+                           typ[:, :-1]], 1)
+    nt = jnp.concatenate([tag[:, 1:], jnp.full((b, 1), -1, tag.dtype)], 1)
+    nty = jnp.concatenate([typ[:, 1:], jnp.full((b, 1), other, typ.dtype)], 1)
+    begin = _chunk_begin_mask(pt, pty, tag, typ, other, tb, ti, te, ts)
+    end = _chunk_end_mask(tag, typ, nt, nty, other, tb, ti, te, ts)
+    idx = jnp.arange(t_len)[None, :]
+    end_pos = jnp.where(end, idx, t_len)
+    # first end at-or-after each position
+    my_end = lax.cummin(end_pos, axis=1, reverse=True)
+    return begin, my_end, typ
+
+
+@register("chunk_eval")
+def _chunk_eval(ctx, ins, attrs):
+    """ref: operators/chunk_eval_op.h — chunk-level precision/recall/F1
+    over IOB/IOE/IOBES/plain tagging, dense-padded branch (SeqLength)."""
+    inference = x(ins, "Inference").reshape(x(ins, "Inference").shape[0], -1)
+    label = x(ins, "Label").reshape(inference.shape)
+    seq_len = x(ins, "SeqLength")
+    num_chunk_types = int(attrs["num_chunk_types"])
+    scheme = attrs.get("chunk_scheme", "IOB")
+    excluded = list(attrs.get("excluded_chunk_types", []) or [])
+
+    b, t_len = label.shape
+    if seq_len is None:
+        valid = jnp.ones((b, t_len), bool)
+    else:
+        valid = jnp.arange(t_len)[None, :] < seq_len.reshape(b, 1)
+
+    lb, le, lty = _segments(label.astype(jnp.int32), valid,
+                            num_chunk_types, scheme)
+    ib, ie, ity = _segments(inference.astype(jnp.int32), valid,
+                            num_chunk_types, scheme)
+
+    def not_excluded(ty):
+        keep = jnp.ones_like(ty, bool)
+        for e in excluded:
+            keep &= ty != e
+        return keep
+
+    n_label = jnp.sum(lb & not_excluded(lty))
+    n_infer = jnp.sum(ib & not_excluded(ity))
+    correct = lb & ib & (le == ie) & (lty == ity) & not_excluded(lty)
+    n_correct = jnp.sum(correct)
+
+    nl = n_label.astype(jnp.float32)
+    ni = n_infer.astype(jnp.float32)
+    nc = n_correct.astype(jnp.float32)
+    precision = jnp.where(ni > 0, nc / jnp.maximum(ni, 1), 0.0)
+    recall = jnp.where(nl > 0, nc / jnp.maximum(nl, 1), 0.0)
+    f1 = jnp.where(precision + recall > 0,
+                   2 * precision * recall /
+                   jnp.maximum(precision + recall, 1e-12), 0.0)
+    return {"Precision": precision.reshape(1),
+            "Recall": recall.reshape(1),
+            "F1-Score": f1.reshape(1),
+            "NumInferChunks": n_infer.astype(jnp.int64).reshape(1),
+            "NumLabelChunks": n_label.astype(jnp.int64).reshape(1),
+            "NumCorrectChunks": n_correct.astype(jnp.int64).reshape(1)}
+
+
+# ---------------------------------------------------------------------------
+# ctc_align
+# ---------------------------------------------------------------------------
+
+
+@register("ctc_align")
+def _ctc_align(ctx, ins, attrs):
+    """ref: operators/ctc_align_op.h dense branch — remove blanks, merge
+    repeats, left-pack, pad with padding_value; emits OutputLength."""
+    tok = x(ins, "Input")
+    length = x(ins, "InputLength")
+    blank = int(attrs.get("blank", 0))
+    merge = bool(attrs.get("merge_repeated", True))
+    pad_val = int(attrs.get("padding_value", 0))
+
+    b, t_len = tok.shape[0], tok.shape[1]
+    tok2 = tok.reshape(b, t_len)
+    if length is None:
+        valid = jnp.ones((b, t_len), bool)
+    else:
+        valid = jnp.arange(t_len)[None, :] < length.reshape(b, 1)
+    prev = jnp.concatenate(
+        [jnp.full((b, 1), -1, tok2.dtype), tok2[:, :-1]], 1)
+    keep = (tok2 != blank) & valid
+    if merge:
+        keep &= tok2 != prev
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out = jnp.full((b, t_len), pad_val, tok2.dtype)
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t_len))
+    out = out.at[rows, jnp.where(keep, pos, t_len)].set(tok2, mode="drop")
+    out_len = jnp.sum(keep, axis=1).astype(
+        length.dtype if length is not None else jnp.int64)
+    return {"Output": out.reshape(tok.shape), "OutputLength": out_len}
+
+
+# ---------------------------------------------------------------------------
+# similarity_focus
+# ---------------------------------------------------------------------------
+
+
+def _focus_mask(m):
+    """Greedy row/col-unique cell selection in score-descending order
+    (ref: similarity_focus_op.h per-index loop): returns the [d2, d3]
+    0/1 mask of selected cells."""
+    d2, d3 = m.shape
+    order = jnp.argsort(-m.ravel(), stable=True)
+
+    def step(carry, flat_idx):
+        tag2, tag3, sel = carry
+        r, c = flat_idx // d3, flat_idx % d3
+        ok = jnp.logical_not(tag2[r] | tag3[c])
+        tag2 = tag2.at[r].set(tag2[r] | ok)
+        tag3 = tag3.at[c].set(tag3[c] | ok)
+        sel = sel.at[r, c].set(sel[r, c] | ok)
+        return (tag2, tag3, sel), None
+
+    init = (jnp.zeros(d2, bool), jnp.zeros(d3, bool),
+            jnp.zeros((d2, d3), bool))
+    (tag2, tag3, sel), _ = lax.scan(step, init, order)
+    return sel
+
+
+@register("similarity_focus")
+def _similarity_focus(ctx, ins, attrs):
+    """ref: operators/similarity_focus_op.h — for each slice of X at
+    ``indexes`` along ``axis``, greedily pick cells whose two free-axis
+    coordinates are unused (highest value first) and light up the full
+    ``axis`` fiber at each picked coordinate pair."""
+    a = x(ins, "X")                  # [N, d1, d2, d3]
+    axis = int(attrs["axis"])
+    indexes = list(attrs["indexes"])
+    if a.ndim != 4:
+        raise ValueError("similarity_focus expects a 4-D input")
+    if axis not in (1, 2, 3):
+        raise ValueError("axis must be 1, 2 or 3")
+
+    out = jnp.zeros(a.shape, a.dtype)
+    for index in indexes:
+        if axis == 1:
+            plane = a[:, index, :, :]                   # [N, d2, d3]
+            sel = jax.vmap(_focus_mask)(plane)          # [N, d2, d3]
+            out = jnp.maximum(out, sel[:, None, :, :].astype(a.dtype))
+        elif axis == 2:
+            plane = a[:, :, index, :]                   # [N, d1, d3]
+            sel = jax.vmap(_focus_mask)(plane)
+            out = jnp.maximum(out, sel[:, :, None, :].astype(a.dtype))
+        else:
+            plane = a[:, :, :, index]                   # [N, d1, d2]
+            sel = jax.vmap(_focus_mask)(plane)
+            out = jnp.maximum(out, sel[:, :, :, None].astype(a.dtype))
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# sample_logits
+# ---------------------------------------------------------------------------
+
+
+def _log_uniform_prob(v, num_classes):
+    """P(v) of the log-uniform (Zipfian) sampler
+    (ref: math/sampler.cc LogUniformSampler::Probability)."""
+    v = v.astype(jnp.float32)
+    return (jnp.log(v + 2.0) - jnp.log(v + 1.0)) / np.log(num_classes + 1.0)
+
+
+@register("sample_logits")
+def _sample_logits(ctx, ins, attrs):
+    """ref: operators/sample_logits_op.h — gather logits at {NT true
+    labels} ∪ {S log-uniform negatives, shared across the batch}, subtract
+    log Q, optionally mask accidental hits with -1e20.
+
+    The reference draws uniques by rejection and adjusts Q with the tried
+    count; here the uniques come from Gumbel top-k over the log-uniform
+    weights and Q uses the expected-count form -expm1(S·log1p(-p)) — the
+    same estimator TF's log_uniform_candidate_sampler exposes.  Gradients
+    need no custom rule: d(SampledLogits) scatter-adds back through the
+    gather exactly as the reference's grad kernel does.
+    """
+    logits = x(ins, "Logits")                    # [N, C]
+    labels = x(ins, "Labels").astype(jnp.int64)  # [N, NT]
+    n, num_classes = logits.shape
+    num_true = labels.shape[1]
+    s = int(attrs["num_samples"])
+    remove_hits = bool(attrs.get("remove_accidental_hits", True))
+
+    if attrs.get("use_customized_samples", False):
+        samples = x(ins, "CustomizedSamples").astype(jnp.int64)
+        probs = x(ins, "CustomizedProbabilities")
+    else:
+        seed = int(attrs.get("seed", 0))
+        key = jax.random.PRNGKey(seed) if seed else ctx.next_key()
+        all_p = _log_uniform_prob(jnp.arange(num_classes), num_classes)
+        g = jax.random.gumbel(key, (num_classes,)) + jnp.log(all_p)
+        _, sampled = lax.top_k(g, s)             # unique, shared over batch
+        sampled = sampled.astype(jnp.int64)
+        samples = jnp.concatenate(
+            [labels, jnp.broadcast_to(sampled[None, :], (n, s))], axis=1)
+        p = _log_uniform_prob(samples, num_classes)
+        probs = -jnp.expm1(s * jnp.log1p(-p))    # expected count Q(y|x)
+
+    samples = lax.stop_gradient(samples)
+    probs = lax.stop_gradient(probs)
+    sampled_logits = jnp.take_along_axis(logits, samples.astype(jnp.int32),
+                                         axis=1)
+    if remove_hits:
+        neg = samples[:, num_true:]              # [N, S]
+        hit = jnp.any(neg[:, :, None] == labels[:, None, :], axis=-1)
+        mask = jnp.concatenate(
+            [jnp.zeros((n, num_true), bool), hit], axis=1)
+        sampled_logits = sampled_logits - \
+            lax.stop_gradient(jnp.where(mask, 1e20, 0.0)).astype(
+                sampled_logits.dtype)
+    logq = jnp.clip(jnp.log(probs), -1e20, 1e20)
+    sampled_logits = sampled_logits - logq.astype(sampled_logits.dtype)
+    sampled_labels = jnp.broadcast_to(
+        jnp.arange(num_true, dtype=jnp.int64)[None, :], (n, num_true))
+    return {"Samples": samples, "Probabilities": probs,
+            "SampledLogits": sampled_logits, "SampledLabels": sampled_labels}
+
+
+# ---------------------------------------------------------------------------
+# filter_by_instag
+# ---------------------------------------------------------------------------
+
+
+@register("filter_by_instag")
+def _filter_by_instag(ctx, ins, attrs):
+    """ref: operators/filter_by_instag_op.h — keep instances whose tag set
+    intersects Filter_tag; kept instances are left-packed into Out, with
+    LossWeight 1 on kept rows / 0 on padding and an IndexMap of
+    (out_row, src_row, row_count) triples (-1 on padding).
+
+    Dense contract: one instance per leading-dim row.  ``is_lod=True``
+    instances are [T, ...] blocks (the padded form of the reference's
+    variable-length LoD instances); Ins_tag is [N, K] padded with -1.
+    Gradients flow to kept rows only (gather-based packing), matching the
+    reference grad kernel's zero-fill of dropped rows."""
+    ins_x = x(ins, "Ins")                        # [N, ...]
+    tags = x(ins, "Ins_tag").astype(jnp.int64)   # [N, K]
+    filt = x(ins, "Filter_tag").astype(jnp.int64).reshape(-1)   # [F]
+    out_val = float(attrs.get("out_val_if_empty", 0))
+
+    n = ins_x.shape[0]
+    tags2 = tags.reshape(n, -1)
+    hit = (tags2[:, :, None] == filt[None, None, :]) & \
+        (tags2 >= 0)[:, :, None]
+    match = jnp.any(hit, axis=(1, 2))            # [N]
+    out_idx = jnp.cumsum(match.astype(jnp.int32)) - 1
+    num_kept = jnp.sum(match.astype(jnp.int32))
+
+    # inverse permutation: src row feeding each packed output slot
+    src = jnp.zeros((n,), jnp.int32).at[
+        jnp.where(match, out_idx, n)].set(jnp.arange(n, dtype=jnp.int32),
+                                          mode="drop")
+    valid_out = jnp.arange(n) < num_kept
+    packed = jnp.take(ins_x, src, axis=0)
+    shape1 = (n,) + (1,) * (ins_x.ndim - 1)
+    out = jnp.where(valid_out.reshape(shape1), packed,
+                    jnp.asarray(out_val, ins_x.dtype))
+    rows_per = int(np.prod(ins_x.shape[1:-1])) if ins_x.ndim > 2 else 1
+    index_map = jnp.stack(
+        [jnp.where(valid_out, jnp.arange(n), -1),
+         jnp.where(valid_out, src, -1),
+         jnp.where(valid_out, rows_per, -1)], axis=1).astype(jnp.int64)
+    loss_weight = valid_out.astype(jnp.float32).reshape(n, 1)
+    return {"Out": out, "LossWeight": loss_weight, "IndexMap": index_map}
+
+
+# ---------------------------------------------------------------------------
+# inplace_abn
+# ---------------------------------------------------------------------------
+
+
+@register("inplace_abn")
+def _inplace_abn(ctx, ins, attrs):
+    """ref: operators/inplace_abn_op.cc — batch norm fused with an
+    activation, reusing the input buffer.  Buffer reuse is XLA's problem
+    (donation + fusion), so semantically this is batch_norm followed by
+    identity/leaky_relu/elu."""
+    act = attrs.get("activation", "identity")
+    alpha = float(attrs.get("alpha", 0.1))
+    outs = get_op("batch_norm")(ctx, ins, attrs)
+    y = outs["Y"]
+    if act == "leaky_relu":
+        y = jnp.where(y >= 0, y, alpha * y)
+    elif act == "elu":
+        y = jnp.where(y >= 0, y, alpha * jnp.expm1(y))
+    elif act not in ("identity", ""):
+        raise NotImplementedError(
+            f"inplace_abn activation {act!r}; the reference supports "
+            f"identity/leaky_relu/elu (inplace_abn_op.cc)")
+    outs["Y"] = y
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# detection_map
+# ---------------------------------------------------------------------------
+
+
+def _np_detection_map(det, det_len, gt, gt_len, pos_count, true_pos,
+                      tp_len, false_pos, fp_len, has_state, class_num,
+                      background_label, overlap_threshold,
+                      evaluate_difficult, ap_type, cap):
+    """Host mAP evaluator (ref: detection_map_op.h CalcTrueAndFalsePositive
+    + CalcMAP), written over the dense-padded batch layout.  Per class the
+    accumulated (score, flag) lists live in fixed-cap arrays."""
+    b = det.shape[0]
+    has_difficult = gt.shape[2] == 6
+
+    # parse per-image, per-class boxes
+    label_pos = {}
+    tp, fp = {}, {}
+    if int(has_state):
+        for c in range(class_num):
+            label_pos[c] = int(pos_count[c, 0])
+        for c in range(class_num):
+            for j in range(int(tp_len[c])):
+                tp.setdefault(c, []).append(
+                    (float(true_pos[c, j, 0]), int(true_pos[c, j, 1])))
+            for j in range(int(fp_len[c])):
+                fp.setdefault(c, []).append(
+                    (float(false_pos[c, j, 0]), int(false_pos[c, j, 1])))
+
+    def jaccard(b1, b2):
+        if b2[0] > b1[2] or b2[2] < b1[0] or b2[1] > b1[3] or b2[3] < b1[1]:
+            return 0.0
+        ixmin, iymin = max(b1[0], b2[0]), max(b1[1], b2[1])
+        ixmax, iymax = min(b1[2], b2[2]), min(b1[3], b2[3])
+        inter = (ixmax - ixmin) * (iymax - iymin)
+        a1 = (b1[2] - b1[0]) * (b1[3] - b1[1])
+        a2 = (b2[2] - b2[0]) * (b2[3] - b2[1])
+        return inter / (a1 + a2 - inter) if (a1 + a2 - inter) > 0 else 0.0
+
+    for i in range(b):
+        gts = {}
+        for j in range(int(gt_len[i])):
+            row = gt[i, j]
+            lbl = int(row[0])
+            if has_difficult:
+                box = (row[2], row[3], row[4], row[5])
+                diff = abs(float(row[1])) >= 1e-6
+            else:
+                box = (row[1], row[2], row[3], row[4])
+                diff = False
+            gts.setdefault(lbl, []).append((box, diff))
+        for lbl, boxes in gts.items():
+            cnt = len(boxes) if evaluate_difficult else \
+                sum(1 for _, d in boxes if not d)
+            if cnt:
+                label_pos[lbl] = label_pos.get(lbl, 0) + cnt
+
+        dets = {}
+        for j in range(int(det_len[i])):
+            row = det[i, j]
+            dets.setdefault(int(row[0]), []).append(
+                (float(row[1]), (row[2], row[3], row[4], row[5])))
+        for lbl, preds in dets.items():
+            if not gts or lbl not in gts:
+                for score, _ in preds:
+                    tp.setdefault(lbl, []).append((score, 0))
+                    fp.setdefault(lbl, []).append((score, 1))
+                continue
+            cands = gts[lbl]
+            visited = [False] * len(cands)
+            preds = sorted(preds, key=lambda kv: -kv[0])
+            for score, box in preds:
+                cb = tuple(min(max(float(v), 0.0), 1.0) for v in box)
+                best, best_j = -1.0, 0
+                for j, (gbox, _) in enumerate(cands):
+                    ov = jaccard(cb, gbox)
+                    if ov > best:
+                        best, best_j = ov, j
+                if best > overlap_threshold:
+                    if evaluate_difficult or not cands[best_j][1]:
+                        if not visited[best_j]:
+                            tp.setdefault(lbl, []).append((score, 1))
+                            fp.setdefault(lbl, []).append((score, 0))
+                            visited[best_j] = True
+                        else:
+                            tp.setdefault(lbl, []).append((score, 0))
+                            fp.setdefault(lbl, []).append((score, 1))
+                else:
+                    tp.setdefault(lbl, []).append((score, 0))
+                    fp.setdefault(lbl, []).append((score, 1))
+
+    # mAP over classes with positives
+    mAP, count = 0.0, 0
+    for lbl, num_pos in label_pos.items():
+        # sic: the reference compares the positive COUNT (not the label)
+        # to background_label (detection_map_op.h:423-428
+        # `if (label_num_pos == background_label) continue;`) — a known
+        # upstream quirk, reproduced for parity
+        if num_pos == background_label:
+            continue
+        if lbl not in tp:
+            count += 1
+            continue
+        ltp = sorted(tp[lbl], key=lambda kv: -kv[0])
+        lfp = sorted(fp[lbl], key=lambda kv: -kv[0])
+        tp_sum = np.cumsum([flag for _, flag in ltp])
+        fp_sum = np.cumsum([flag for _, flag in lfp])
+        prec = tp_sum / np.maximum(tp_sum + fp_sum, 1)
+        rec = tp_sum / float(num_pos)
+        num = len(tp_sum)
+        if ap_type == "11point":
+            max_precisions = [0.0] * 11
+            start_idx = num - 1
+            for j in range(10, -1, -1):
+                for i2 in range(start_idx, -1, -1):
+                    if rec[i2] < j / 10.0:
+                        start_idx = i2
+                        if j > 0:
+                            max_precisions[j - 1] = max_precisions[j]
+                        break
+                    if max_precisions[j] < prec[i2]:
+                        max_precisions[j] = prec[i2]
+            mAP += sum(max_precisions) / 11.0
+            count += 1
+        else:                                    # integral
+            prev_rec = 0.0
+            ap = 0.0
+            for i2 in range(num):
+                if abs(rec[i2] - prev_rec) > 1e-6:
+                    ap += prec[i2] * abs(rec[i2] - prev_rec)
+                    prev_rec = rec[i2]
+            mAP += ap
+            count += 1
+    mAP = mAP / count if count else 0.0
+
+    # pack accumulated state back into the fixed-cap layout
+    out_pos = np.zeros((class_num, 1), np.int32)
+    out_tp = np.zeros((class_num, cap, 2), np.float32)
+    out_tp_len = np.zeros((class_num,), np.int32)
+    out_fp = np.zeros((class_num, cap, 2), np.float32)
+    out_fp_len = np.zeros((class_num,), np.int32)
+    for c in range(class_num):
+        out_pos[c, 0] = label_pos.get(c, 0)
+        for name, store, ln in (("tp", out_tp, out_tp_len),
+                                ("fp", out_fp, out_fp_len)):
+            entries = (tp if name == "tp" else fp).get(c, [])
+            if len(entries) > cap:
+                raise RuntimeError(
+                    f"detection_map accumulated {len(entries)} "
+                    f"(score, flag) entries for class {c}, exceeding the "
+                    f"accum_cap of {cap}; raise the cap attr")
+            for j, (score, flag) in enumerate(entries):
+                store[c, j, 0] = score
+                store[c, j, 1] = flag
+            ln[c] = len(entries)
+    return (np.float32(mAP).reshape(1), out_pos, out_tp, out_tp_len,
+            out_fp, out_fp_len)
+
+
+@register("detection_map")
+def _detection_map(ctx, ins, attrs):
+    """ref: operators/detection_map_op.h — the evaluator is a CPU-only
+    kernel in the reference too, so it runs host-side via pure_callback.
+    Dense contract: DetectRes [B, M, 6] + DetectLength, Label [B, G, 5|6]
+    + LabelLength; accumulation state uses fixed caps (attr accum_cap)."""
+    det = x(ins, "DetectRes")
+    gt = x(ins, "Label")
+    det_len = x(ins, "DetectLength")
+    gt_len = x(ins, "LabelLength")
+    class_num = int(attrs["class_num"])
+    cap = int(attrs.get("accum_cap", 2048))
+    background_label = int(attrs.get("background_label", 0))
+    overlap_threshold = float(attrs.get("overlap_threshold", 0.5))
+    evaluate_difficult = bool(attrs.get("evaluate_difficult", True))
+    ap_type = attrs.get("ap_type", "integral")
+
+    b, m = det.shape[0], det.shape[1]
+    if det_len is None:
+        det_len = jnp.full((b,), m, jnp.int32)
+    if gt_len is None:
+        gt_len = jnp.full((b,), gt.shape[1], jnp.int32)
+
+    pos_count = x(ins, "PosCount")
+    true_pos = x(ins, "TruePos")
+    tp_len = x(ins, "TruePosLength")
+    false_pos = x(ins, "FalsePos")
+    fp_len = x(ins, "FalsePosLength")
+    has_state = x(ins, "HasState")
+    if pos_count is None:
+        pos_count = jnp.zeros((class_num, 1), jnp.int32)
+        true_pos = jnp.zeros((class_num, cap, 2), jnp.float32)
+        tp_len = jnp.zeros((class_num,), jnp.int32)
+        false_pos = jnp.zeros((class_num, cap, 2), jnp.float32)
+        fp_len = jnp.zeros((class_num,), jnp.int32)
+    if has_state is None:
+        has_state = jnp.zeros((1,), jnp.int32)
+
+    shapes = (
+        jax.ShapeDtypeStruct((1,), np.float32),
+        jax.ShapeDtypeStruct((class_num, 1), np.int32),
+        jax.ShapeDtypeStruct((class_num, cap, 2), np.float32),
+        jax.ShapeDtypeStruct((class_num,), np.int32),
+        jax.ShapeDtypeStruct((class_num, cap, 2), np.float32),
+        jax.ShapeDtypeStruct((class_num,), np.int32),
+    )
+
+    def host(det_, dl_, gt_, gl_, pc_, tp_, tl_, fp_, fl_, hs_):
+        return _np_detection_map(
+            np.asarray(det_, np.float32), np.asarray(dl_),
+            np.asarray(gt_, np.float32), np.asarray(gl_),
+            np.asarray(pc_), np.asarray(tp_), np.asarray(tl_),
+            np.asarray(fp_), np.asarray(fl_), np.asarray(hs_).ravel()[0],
+            class_num, background_label, overlap_threshold,
+            evaluate_difficult, ap_type, cap)
+
+    (map_out, out_pos, out_tp, out_tp_len, out_fp, out_fp_len) = \
+        jax.pure_callback(host, shapes, det, det_len, gt, gt_len,
+                          pos_count, true_pos, tp_len, false_pos, fp_len,
+                          has_state)
+    return {"MAP": map_out,
+            "AccumPosCount": out_pos,
+            "AccumTruePos": out_tp, "AccumTruePosLength": out_tp_len,
+            "AccumFalsePos": out_fp, "AccumFalsePosLength": out_fp_len}

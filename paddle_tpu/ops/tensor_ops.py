@@ -434,8 +434,5 @@ def _memcpy(ctx, ins, attrs):
     return {"Out": x(ins, "X")}
 
 
-@register("print")
-def _print(ctx, ins, attrs):
-    a = x(ins, "In")
-    jax.debug.print("{msg}: {v}", msg=attrs.get("message", ""), v=a)
-    return {"Out": a}
+# "print" is registered in legacy_cf_ops.py (full print_op.cc surface:
+# summarize, tensor name, shape/dtype header)

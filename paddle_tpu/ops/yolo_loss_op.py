@@ -119,8 +119,12 @@ def _yolov3_loss(ctx, ins, attrs):
 
     # -- objectness: positives carry score, ignored carry -1 --
     obj_mask = jnp.where(ignore, -1.0, 0.0)              # [N, A, H, W]
-    obj_mask = obj_mask.at[bidx, aidx, gj, gi].set(
-        jnp.where(matched, gt_score, obj_mask[bidx, aidx, gj, gi]))
+    # only matched gts scatter (the reference skips invalid gts in its
+    # per-gt loop): unmatched/padded rows get an out-of-range batch index
+    # and are dropped, so a stale padding write can never clobber a real
+    # positive at (anchor 0, cell 0,0) where their clamped indices land
+    obj_mask = obj_mask.at[jnp.where(matched, bidx, n), aidx, gj, gi].set(
+        gt_score, mode="drop")
     obj_logit = xr[:, :, 4]
     obj_loss = jnp.where(
         obj_mask > 0, _bce(obj_logit, 1.0) * obj_mask,

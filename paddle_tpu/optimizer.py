@@ -418,13 +418,25 @@ class AdamOptimizer(Optimizer):
     def _lookup_ids_for(self, block, param):
         """Ids vars of every lookup_table op reading ``param`` — the rows
         the batch touched (SelectedRows rows; ref: selected_rows.h:32,
-        adam_op.h lazy_mode sparse branch)."""
+        adam_op.h lazy_mode sparse branch).
+
+        Lazy mode only applies when lookup_table ops are the param's SOLE
+        gradient contributors: the reference takes the sparse branch only
+        when the grad var really is SelectedRows (adam_op.cc grad type
+        dispatch), and a param with another consumer (e.g. tied in/out
+        embeddings reused in a matmul) gets a dense grad whose non-lookup
+        rows a masked update would silently freeze."""
         ids = []
         for op in block.ops:
-            if op.type in ("lookup_table", "lookup_table_v2") and \
-                    param.name in op.input_names():
+            if op.type == "backward":
+                break          # consumers live in the forward section
+            if param.name not in op.input_names():
+                continue
+            if op.type in ("lookup_table", "lookup_table_v2"):
                 ids.extend(n for n in op.inputs.get("Ids", ())
                            if n not in ids)
+            else:
+                return []      # dense contributor present → dense Adam
         return ids
 
     def _append_optimize_op(self, block, pg):

@@ -1,0 +1,161 @@
+"""Regression tests for the round-3 advisor findings (ADVICE.md r3).
+
+1. lazy-mode Adam must fall back to dense updates when the embedding
+   param has a non-lookup consumer (tied weights) — masked updates would
+   silently freeze rows whose gradient arrives through the other use.
+2. yolov3_loss objectness scatter: padding gt rows must not clobber a
+   real positive at (anchor 0, cell 0,0).
+3. AsyncCheckpointer same-id re-save leaves no window with the
+   checkpoint dir missing and cleans its .old staging dir.
+4. teacher_student_sigmoid_loss forward is computed on the UNCLIPPED
+   logit (ref: teacher_student_sigmoid_loss_op.h:44-62 applies the
+   soft_max bounds only in grad).
+"""
+
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import Program, program_guard
+
+
+# -- 1: tied-weights lazy Adam --------------------------------------------
+
+def _tied_net(vocab=8, dim=4):
+    ids = fluid.layers.data("ids", shape=[2], dtype="int64")
+    emb = fluid.layers.embedding(
+        ids, size=[vocab, dim],
+        param_attr=fluid.ParamAttr(
+            name="tied_w",
+            initializer=fluid.initializer.Constant(0.5)))
+    pooled = fluid.layers.reduce_mean(emb, dim=1)          # [B, dim]
+    # tied output projection: the SAME param used as a dense matmul weight
+    w = fluid.default_main_program().global_block().var("tied_w")
+    logits = fluid.layers.matmul(pooled, w, transpose_y=True)  # [B, vocab]
+    return fluid.layers.mean(fluid.layers.square(logits))
+
+
+def test_lazy_adam_tied_weights_falls_back_to_dense():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        loss = _tied_net()
+        opt = fluid.optimizer.Adam(0.1, lazy_mode=True)
+        opt.minimize(loss)
+    adam_ops = [op for op in main.global_block().ops if op.type == "adam"]
+    assert adam_ops, "adam op not appended"
+    for op in adam_ops:
+        # dense fallback: no SparseRows input, no lazy_mode attr
+        assert "SparseRows" not in op.inputs
+        assert not op.attrs.get("lazy_mode", False)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {"ids": np.array([[1, 2]], np.int64)}
+        for _ in range(2):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        w = np.asarray(scope.find_var("tied_w"))
+    # every row receives gradient through the matmul branch — none frozen
+    assert (np.abs(w - 0.5) > 1e-7).any(axis=1).all(), \
+        "some rows were frozen by a wrongly-applied lazy mask"
+
+
+def test_lazy_adam_pure_lookup_still_lazy():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[2], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, size=[8, 4],
+            param_attr=fluid.ParamAttr(
+                name="pure_w",
+                initializer=fluid.initializer.Constant(0.5)))
+        loss = fluid.layers.mean(fluid.layers.square(emb))
+        fluid.optimizer.Adam(0.1, lazy_mode=True).minimize(loss)
+    adam_ops = [op for op in main.global_block().ops if op.type == "adam"
+                and "pure_w" in op.inputs["Param"]]
+    assert adam_ops and adam_ops[0].attrs.get("lazy_mode") is True
+
+
+# -- 2: yolo objectness scatter vs padding rows ---------------------------
+
+def test_yolo_padding_gt_does_not_clobber_positive():
+    from paddle_tpu.ops.registry import get_op, LoweringContext
+    import jax
+
+    n, h, w, class_num = 1, 4, 4, 2
+    anchors = [10, 13, 16, 30]          # two anchors
+    mask = [0, 1]
+    a = len(mask)
+    rng = np.random.RandomState(0)
+    inp = rng.randn(n, a * (5 + class_num), h, w).astype(np.float32)
+    # one REAL gt centered in cell (0, 0) matching anchor-slot 0 by shape,
+    # followed by padding rows (all zeros — invalid)
+    gt_box = np.zeros((n, 4, 4), np.float32)
+    gt_box[0, 0] = [0.07, 0.07, 10 / 128.0, 13 / 128.0]
+    gt_label = np.zeros((n, 4), np.int32)
+    gt_score = np.ones((n, 4), np.float32)
+
+    ctx = LoweringContext(jax.random.PRNGKey(0), None, (), True)
+    import jax.numpy as jnp
+    out = get_op("yolov3_loss")(
+        ctx,
+        {"X": [jnp.asarray(inp)], "GTBox": [jnp.asarray(gt_box)],
+         "GTLabel": [jnp.asarray(gt_label)],
+         "GTScore": [jnp.asarray(gt_score)]},
+        {"anchors": anchors, "anchor_mask": mask, "class_num": class_num,
+         "ignore_thresh": 0.7, "downsample_ratio": 32})
+    obj = np.asarray(out["ObjectnessMask"])
+    # the matched positive must survive the padded rows' (dropped) writes
+    assert obj[0, 0, 0, 0] == 1.0
+
+
+# -- 3: AsyncCheckpointer same-id re-save ---------------------------------
+
+def test_async_checkpointer_resave_keeps_dir_and_cleans_old(tmp_path):
+    from paddle_tpu.io import AsyncCheckpointer, TrainStatus
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        fc = fluid.layers.fc(x, size=2, name="ck_fc")
+        loss = fluid.layers.mean(fc)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ck = AsyncCheckpointer()
+        st = TrainStatus(epoch_no=7)
+        path = str(tmp_path / "ckpt")
+        ck.save(exe, path, st, main_program=main, scope=scope)
+        ck.wait()
+        exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+                fetch_list=[loss])
+        ck.save(exe, path, st, main_program=main, scope=scope)
+        ck.wait()
+    final = os.path.join(path, "checkpoint_7")
+    assert os.path.isdir(final)
+    assert not os.path.isdir(final + ".old"), ".old staging dir leaked"
+    with open(os.path.join(final, "train_status.json")) as f:
+        assert json.load(f)["epoch_no"] == 7
+
+
+# -- 4: teacher_student forward uses the unclipped logit ------------------
+
+def test_teacher_student_forward_unclipped():
+    from paddle_tpu.ops.registry import get_op, LoweringContext
+    import jax
+
+    z = np.array([20.0, -20.0], np.float32)        # beyond the ±15 bounds
+    label = np.array([-2.0, -1.0], np.float32)     # clk=0 / clk=1, no q
+    ctx = LoweringContext(jax.random.PRNGKey(0), None, (), True)
+    out = get_op("teacher_student_sigmoid_loss")(
+        ctx, {"X": [z], "Label": [label]}, {})
+    y = np.asarray(out["Y"]).ravel()
+    # exact BCE on the raw logit: ce0(20) = 20 + log1p(e^-20); ce1(-20)=…
+    np.testing.assert_allclose(
+        y, [20.0 + np.log1p(np.exp(-20.0)), 20.0 + np.log1p(np.exp(-20.0))],
+        rtol=1e-6)

@@ -72,7 +72,11 @@ def test_cvm_and_cross_entropy2():
         return y, ce
 
     y, ce = _run(build, {"x": x, "cvm": cvm, "p": p, "l": lab})
-    np.testing.assert_allclose(y[:, 0], np.log(cvm[:, 0] + 1), rtol=1e-5)
+    # ref cvm_op.h CvmComputeKernel: Y's first two columns come from X's
+    # OWN show/click columns (the CVM input only feeds the grad kernel)
+    np.testing.assert_allclose(y[:, 0], np.log(x[:, 0] + 1), rtol=1e-5)
+    np.testing.assert_allclose(y[:, 1], np.log(x[:, 1] + 1) -
+                               np.log(x[:, 0] + 1), rtol=1e-4)
     np.testing.assert_allclose(
         ce.reshape(-1), -np.log([0.7, 0.8]), rtol=1e-5)
 

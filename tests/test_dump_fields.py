@@ -1,0 +1,117 @@
+"""dump_fields / dump_param per-worker observability (VERDICT r3 missing
+#7; ref: trainer_desc.proto:12-15 + device_worker.cc DumpField/DumpParam)
+and the set_hdfs_config loud warning.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from tests.test_native_dataset import _make_files
+
+
+def _build_ctr(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        label = fluid.layers.data("label", shape=[1], dtype="float32")
+        ids = fluid.layers.data("ids", shape=[8], dtype="int64")
+        dense = fluid.layers.data("dense", shape=[3], dtype="float32")
+        emb = fluid.layers.embedding(ids, size=[100, 8])
+        pooled = fluid.layers.reduce_sum(emb, dim=1)
+        feat = fluid.layers.concat([pooled, dense], axis=1)
+        logit = fluid.layers.fc(feat, size=1, name="dump_fc")
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(logit, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, logit, loss
+
+
+def test_dump_fields_and_param_roundtrip(tmp_path):
+    files, _ = _make_files(tmp_path, n_files=1, rows_per_file=24, seed=5)
+    main, startup, logit, loss = _build_ctr(tmp_path)
+    dump_dir = str(tmp_path / "dumps")
+    main._fleet_opt = {
+        "dump_fields": [logit.name],
+        "dump_fields_path": dump_dir,
+        "dump_param": ["dump_fc.b_0"],
+    }
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_use_var([v for v in [main.global_block().var("label"),
+                                main.global_block().var("ids"),
+                                main.global_block().var("dense")]])
+    ds.set_batch_size(8)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.train_from_dataset(program=main, dataset=ds, fetch_list=[loss],
+                           print_period=100)
+
+    path = os.path.join(dump_dir, "worker-0")
+    assert os.path.exists(path)
+    with open(path) as f:
+        lines = f.read().strip().splitlines()
+    field_lines = [l for l in lines if "\t" in l]
+    param_lines = [l for l in lines if l.startswith("(")]
+    # 24 instances → 24 field lines, each `lineid \t name:len:values`
+    assert len(field_lines) == 24
+    lineids = [int(l.split("\t")[0]) for l in field_lines]
+    assert lineids == list(range(24))
+    name, ln, *vals = field_lines[0].split("\t")[1].split(":")
+    assert name == logit.name and int(ln) == 1
+    float(vals[0])                               # parseable value
+    # 3 steps of batch 8 → 3 param dumps `(step,name):v...`
+    assert len(param_lines) == 3
+    assert param_lines[0].startswith("(0,dump_fc.b_0):")
+    assert param_lines[-1].startswith("(2,dump_fc.b_0):")
+
+
+def test_dump_needs_path(tmp_path):
+    files, _ = _make_files(tmp_path, n_files=1, rows_per_file=8)
+    main, startup, logit, loss = _build_ctr(tmp_path)
+    main._fleet_opt = {"dump_fields": [logit.name]}
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_use_var([main.global_block().var("label"),
+                    main.global_block().var("ids"),
+                    main.global_block().var("dense")])
+    ds.set_batch_size(8)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with pytest.raises(ValueError, match="dump_fields_path"):
+        exe.train_from_dataset(program=main, dataset=ds)
+
+
+def test_set_hdfs_config_warns():
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    with pytest.warns(UserWarning, match="LOCAL filesystem"):
+        ds.set_hdfs_config("hdfs://nameservice", "user,passwd")
+
+
+def test_dump_field_also_in_fetch_list(tmp_path):
+    # a dump field that is ALSO fetched must be dumped under its own name
+    files, _ = _make_files(tmp_path, n_files=1, rows_per_file=8, seed=9)
+    main, startup, logit, loss = _build_ctr(tmp_path)
+    dump_dir = str(tmp_path / "dumps2")
+    main._fleet_opt = {"dump_fields": [logit.name],
+                       "dump_fields_path": dump_dir}
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_use_var([main.global_block().var("label"),
+                    main.global_block().var("ids"),
+                    main.global_block().var("dense")])
+    ds.set_batch_size(8)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.train_from_dataset(program=main, dataset=ds,
+                           fetch_list=[main.global_block().var(logit.name)],
+                           print_period=100)
+    with open(os.path.join(dump_dir, "worker-0")) as f:
+        lines = [l for l in f.read().strip().splitlines() if "\t" in l]
+    assert len(lines) == 8
+    assert all(l.split("\t")[1].startswith(logit.name + ":") for l in lines)

@@ -1,0 +1,80 @@
+"""A/B bench of the Pallas kernel families at bench shapes (VERDICT r3
+next-round #2): flash attention and the fused LN/add-LN/bias-GELU/Adam
+kernels, flag on vs off, same window, same methodology as bench.py
+(device-resident feeds, pipelined dispatch, one final sync).
+
+Prints one line per configuration:
+    {"config": ..., "samples_per_sec": N, "ms_per_step": N}
+
+Run on the real chip: python tools/kernel_ab.py [steps]
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_config(flash, fused, steps):
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import bert
+    from paddle_tpu.framework.core import reset_default_programs
+    from paddle_tpu.framework.executor import global_scope
+
+    reset_default_programs()
+    global_scope().drop_all()
+    fluid.set_flags({"FLAGS_use_flash_attention": flash,
+                     "FLAGS_use_pallas_fused": fused})
+
+    batch, seq, num_masks = 96, 128, 20
+    cfg = bert.BertConfig.base()
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        feeds, total, mlm, nsp = bert.build_pretrain_network(cfg)
+        from paddle_tpu.contrib.mixed_precision import decorate
+        opt = decorate(fluid.optimizer.Adam(1e-4), use_pure_bf16=True)
+        opt.minimize(total)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    data = bert.make_fake_batch(np.random.RandomState(0), cfg,
+                                batch_size=batch, seq_len=seq,
+                                num_masks=num_masks)
+    for v in data.values():
+        if hasattr(v, "flags"):
+            v.flags.writeable = False
+    l, = exe.run(main_prog, feed=data, fetch_list=[total])   # compile
+    assert np.isfinite(l).all()
+    l, = exe.run(main_prog, feed=data, fetch_list=[total],
+                 return_numpy=False)
+    jax.block_until_ready(l)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l, = exe.run(main_prog, feed=data, fetch_list=[total],
+                     return_numpy=False)
+    np.asarray(l)
+    jax.block_until_ready(list(global_scope().vars.values()))
+    dt = (time.perf_counter() - t0) / steps
+    return batch / dt, dt * 1e3
+
+
+def main():
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    configs = [
+        ("baseline (no pallas)", False, False),
+        ("+flash_attention", True, False),
+        ("+fused_ln_adam", False, True),
+        ("both (bench default)", True, True),
+    ]
+    for name, flash, fused in configs:
+        sps, ms = bench_config(flash, fused, steps)
+        print(json.dumps({"config": name, "samples_per_sec": round(sps, 2),
+                          "ms_per_step": round(ms, 2)}))
+
+
+if __name__ == "__main__":
+    main()

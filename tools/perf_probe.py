@@ -38,12 +38,28 @@ def main():
     data = bert.make_fake_batch(rng, cfg, batch_size=batch, seq_len=seq,
                                 num_masks=num_masks)
 
-    # ---- executor path (bench.py methodology) ----
+    # ---- legacy executor path (writable feeds, per-step numpy sync) ----
     l, = exe.run(main_prog, feed=data, fetch_list=[total])   # compile
     t0 = time.perf_counter()
     for _ in range(steps):
         l, = exe.run(main_prog, feed=data, fetch_list=[total])
     t_exec = (time.perf_counter() - t0) / steps
+
+    # ---- executor path, r4 bench methodology: frozen feeds (device cache
+    # hit after first step) + device-resident fetches, one final sync ----
+    for v in data.values():
+        if hasattr(v, "flags"):
+            v.flags.writeable = False
+    l, = exe.run(main_prog, feed=data, fetch_list=[total],
+                 return_numpy=False)
+    jax.block_until_ready(l)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l, = exe.run(main_prog, feed=data, fetch_list=[total],
+                     return_numpy=False)
+    np.asarray(l)
+    jax.block_until_ready(list(fluid.global_scope().vars.values()))
+    t_exec_async = (time.perf_counter() - t0) / steps
 
     # ---- pure jitted step with device-resident feeds ----
     compiled = exe._compile(main_prog, dict(data), [total.name],
@@ -72,12 +88,14 @@ def main():
         np.asarray(fetches[0])       # force device→host each step
     t_sync = (time.perf_counter() - t0) / steps
 
-    print(f"t_exec  {t_exec*1e3:8.2f} ms/step   (Executor.run: feed+fetch)")
-    print(f"t_sync  {t_sync*1e3:8.2f} ms/step   (device feeds, fetch sync)")
-    print(f"t_pure  {t_pure*1e3:8.2f} ms/step   (device feeds, async)")
+    print(f"t_exec       {t_exec*1e3:8.2f} ms/step   (legacy Executor.run: h2d feed + d2h sync)")
+    print(f"t_exec_async {t_exec_async*1e3:8.2f} ms/step   (Executor.run: cached feeds, async fetch)")
+    print(f"t_sync       {t_sync*1e3:8.2f} ms/step   (raw step: device feeds, fetch sync)")
+    print(f"t_pure       {t_pure*1e3:8.2f} ms/step   (raw step: device feeds, async)")
     from bench import bert_flops_per_step
     fl = bert_flops_per_step(cfg, batch, seq, num_masks)
-    for nm, t in (("exec", t_exec), ("sync", t_sync), ("pure", t_pure)):
+    for nm, t in (("exec", t_exec), ("exec_async", t_exec_async),
+                  ("sync", t_sync), ("pure", t_pure)):
         print(f"MFU_{nm} {fl / t / 197e12 * 100:6.2f}%")
 
 
