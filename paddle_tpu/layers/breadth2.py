@@ -152,9 +152,10 @@ def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
 
 
 def hash(input, hash_size, num_hash=1, name=None):  # noqa: A001
+    # ref hash_op.h HashOutputSize: out = in_dims[:-1] + [num_hash, 1]
+    # (the whole last dim hashes to ONE bucket per probe)
     return _simple("hash",
-                   out_shape=tuple(input.shape[:-1]) +
-                   (num_hash, input.shape[-1]),
+                   out_shape=tuple(input.shape[:-1]) + (num_hash, 1),
                    out_dtype="int64", X=input,
                    attrs={"num_hash": num_hash, "mod_by": hash_size},
                    name=name)

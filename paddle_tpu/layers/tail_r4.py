@@ -17,7 +17,10 @@ from .detection import _op
 
 __all__ = [
     "chunk_eval", "ctc_align", "similarity_focus", "sample_logits",
-    "filter_by_instag", "inplace_abn",
+    "filter_by_instag", "inplace_abn", "resize_linear", "beam_search",
+    "beam_search_decode", "reorder_lod_tensor_by_rank", "templatedoc",
+    "autodoc", "deprecated", "generate_layer_fn",
+    "generate_activation_fn",
 ]
 
 
@@ -150,3 +153,123 @@ def inplace_abn(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                "use_global_stats": use_global_stats,
                "activation": act or "identity", "alpha": act_alpha})
     return out
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None,
+                  align_corners=True, align_mode=1, data_format="NCW"):
+    """ref: layers/nn.py resize_linear — 1-D interpolation over [N,C,W]
+    (NWC inputs are transposed through the same NCW kernel)."""
+    if data_format not in ("NCW", "NWC"):
+        raise ValueError(f"resize_linear data_format must be NCW or "
+                         f"NWC, got {data_format!r}")
+    from .tensor_ops import transpose
+    if data_format == "NWC":
+        input = transpose(input, [0, 2, 1])
+    ow = out_shape[0] if out_shape else -1
+    n, c = input.shape[0], input.shape[1]
+    if (ow is None or ow < 0) and scale:
+        ow = int(input.shape[2] * scale)
+    out = _op("linear_interp", {"X": input},
+              {"out_w": ow, "scale": scale or 0.0,
+               "align_corners": align_corners, "align_mode": align_mode},
+              {"Out": ((n, c, ow), input.dtype)})["Out"]
+    if data_format == "NWC":
+        out = transpose(out, [0, 2, 1])
+    return out
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """ref: layers/nn.py beam_search → math/beam_search.cc.  Dense
+    contract: beam_size consecutive rows per source; finished beams keep
+    emitting (end_id, pre_score) instead of LoD pruning."""
+    rows = scores.shape[0]
+    out = _op("beam_search",
+              {"pre_ids": pre_ids, "pre_scores": pre_scores,
+               "ids": ids, "scores": scores},
+              {"beam_size": beam_size, "end_id": end_id, "level": level,
+               "is_accumulated": is_accumulated},
+              {"selected_ids": ((rows, 1), "int64"),
+               "selected_scores": ((rows, 1), "float32"),
+               "parent_idx": ((rows,), "int32")})
+    if return_parent_idx:
+        return (out["selected_ids"], out["selected_scores"],
+                out["parent_idx"])
+    return out["selected_ids"], out["selected_scores"]
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None,
+                       parents=None):
+    """ref: layers/nn.py beam_search_decode → beam_search_decode_op.cc.
+    Dense contract: ``ids``/``scores`` are the per-step beam outputs
+    stacked time-major [T, B*beam]; ``parents`` is the stacked
+    parent_idx from beam_search(return_parent_idx=True) — it carries the
+    backtracking links the reference encodes in each step's LoD."""
+    if parents is None:
+        raise ValueError(
+            "beam_search_decode dense contract needs `parents` — stack "
+            "the parent_idx outputs of beam_search(return_parent_idx="
+            "True) over time (the reference encodes them in step LoDs)")
+    t, rows = ids.shape[0], ids.shape[1]
+    b = rows // beam_size
+    out = _op("beam_search_decode",
+              {"Ids": ids, "Scores": scores, "Parents": parents},
+              {"beam_size": beam_size, "end_id": end_id},
+              {"SentenceIds": ((b, beam_size, t), "int64"),
+               "SentenceScores": ((b, beam_size), "float32"),
+               "SentenceLength": ((b, beam_size), "int32")})
+    return out["SentenceIds"], out["SentenceScores"]
+
+
+def reorder_lod_tensor_by_rank(x, rank_table, name=None):
+    """ref: layers/control_flow.py reorder_lod_tensor_by_rank — permute
+    the batch dim by the rank table (dense: an index vector)."""
+    return _op("reorder_lod_tensor_by_rank",
+               {"X": x, "RankTable": rank_table}, {},
+               {"Out": (tuple(x.shape), x.dtype)})["Out"]
+
+
+# -- doc/codegen helpers (API-compat shims; the reference uses these to
+# generate docstrings and thin layer wrappers at import time:
+# layers/layer_function_generator.py) --------------------------------------
+
+def templatedoc(op_type=None):
+    def deco(fn):
+        return fn
+    return deco
+
+
+def autodoc(comment=""):
+    def deco(fn):
+        return fn
+    return deco
+
+
+def deprecated(since="", instead="", reason=""):
+    def deco(fn):
+        import functools
+        import warnings
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            warnings.warn(f"{fn.__name__} is deprecated since {since}; "
+                          f"use {instead}", DeprecationWarning,
+                          stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def generate_layer_fn(op_type):
+    """ref: layer_function_generator.py generate_layer_fn — a thin
+    builder for a registered op with the standard X→Out shape."""
+    def fn(x=None, name=None, **attrs):
+        return _op(op_type, {"X": x}, attrs,
+                   {"Out": (tuple(x.shape), x.dtype)})["Out"]
+    fn.__name__ = op_type
+    return fn
+
+
+def generate_activation_fn(op_type):
+    return generate_layer_fn(op_type)

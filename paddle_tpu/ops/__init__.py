@@ -31,3 +31,5 @@ from . import ps_ops        # noqa: F401
 from . import eval_tail_ops  # noqa: F401
 from . import label_gen_ops  # noqa: F401
 from . import legacy_cf_ops  # noqa: F401
+from . import beam_ops       # noqa: F401
+from . import registry_tail_ops  # noqa: F401

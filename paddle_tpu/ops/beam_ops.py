@@ -1,0 +1,114 @@
+"""Beam-search step ops (ref: operators/math/beam_search.cc
+BeamSearchFunctor, operators/beam_search_decode_op.cc) under the dense
+contract: a fixed ``beam_size`` rows per source instead of shrinking LoD
+beams — finished beams keep emitting (end_id, pre_score) rather than
+being pruned away, so shapes stay static (MIGRATION.md).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, x
+
+_NEG = -1e9
+
+
+@register("beam_search")
+def _beam_search(ctx, ins, attrs):
+    """One beam step.  Per source sentence (``beam_size`` consecutive
+    rows): candidates are the K expansions of each live beam — scored
+    ``scores`` directly (is_accumulated) or ``pre_score + log(score)`` —
+    while a finished beam (pre_id == end_id) contributes the single
+    candidate (end_id, pre_score) (ref: beam_search.cc:246-262).  The
+    top beam_size by (score desc, offset asc) become the next beams."""
+    pre_ids = x(ins, "pre_ids").reshape(-1)          # [B*beam]
+    pre_scores = x(ins, "pre_scores").reshape(-1).astype(jnp.float32)
+    scores = x(ins, "scores").astype(jnp.float32)    # [B*beam, K]
+    ids = x(ins, "ids")
+    beam = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+    accumulated = bool(attrs.get("is_accumulated", True))
+
+    rows, k = scores.shape
+    if rows % beam:
+        raise ValueError(
+            f"beam_search dense contract: rows ({rows}) must be a "
+            f"multiple of beam_size ({beam})")
+    b = rows // beam
+    if ids is None:
+        ids = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int64)[None, :],
+                               (rows, k))
+    ids = ids.astype(jnp.int64)
+
+    cand = scores if accumulated else \
+        pre_scores[:, None] + jnp.log(jnp.maximum(scores, 1e-30))
+    finished = pre_ids == end_id                     # [B*beam]
+    # finished beams: slot 0 carries (end_id, pre_score), rest -inf
+    slot0 = jnp.zeros((rows, k), bool).at[:, 0].set(True)
+    cand = jnp.where(finished[:, None],
+                     jnp.where(slot0, pre_scores[:, None], _NEG), cand)
+    cand_ids = jnp.where(finished[:, None], end_id, ids)
+
+    flat = cand.reshape(b, beam * k)
+    top_scores, top_idx = lax.top_k(flat, beam)      # offset-major ties →
+    parent_local = top_idx // k                      # smaller offset first
+    parent = parent_local + jnp.arange(b)[:, None] * beam
+    sel_ids = jnp.take_along_axis(cand_ids.reshape(b, beam * k),
+                                  top_idx, axis=1)
+    return {"selected_ids": sel_ids.reshape(rows, 1),
+            "selected_scores": top_scores.reshape(rows, 1),
+            "parent_idx": parent.reshape(rows).astype(jnp.int32)}
+
+
+@register("beam_search_decode")
+def _beam_search_decode(ctx, ins, attrs):
+    """ref: operators/beam_search_decode_op.cc — backtrack the per-step
+    beams into whole sentences.  Dense contract: Ids/Parents/Scores are
+    the per-step outputs stacked time-major [T, B*beam]; backtracking is
+    gather_tree semantics, then sequences are cut at the first end_id."""
+    ids = x(ins, "Ids").astype(jnp.int64)            # [T, R]
+    parents = x(ins, "Parents").astype(jnp.int32)    # [T, R]
+    scores = x(ins, "Scores").astype(jnp.float32)    # [T, R]
+    end_id = int(attrs["end_id"])
+    beam = int(attrs["beam_size"])
+    t_len, rows = ids.shape
+    b = rows // beam
+    # local parent within each source's beam block
+    local_parent = parents.reshape(t_len, b, beam) - \
+        (jnp.arange(b) * beam)[None, :, None]
+
+    def backtrack(carry, xs):
+        beam_idx = carry                             # [B, beam]
+        step_ids, step_par = xs
+        tok = jnp.take_along_axis(step_ids, beam_idx, axis=1)
+        prev = jnp.take_along_axis(step_par, beam_idx, axis=1)
+        return prev, tok
+
+    init = jnp.broadcast_to(jnp.arange(beam)[None, :], (b, beam))
+    _, toks = lax.scan(backtrack, init,
+                       (ids.reshape(t_len, b, beam), local_parent),
+                       reverse=True)
+    sentences = jnp.moveaxis(toks, 0, -1)            # [B, beam, T]
+    # mask everything after (and including the second) end_id
+    is_end = sentences == end_id
+    seen_end = jnp.cumsum(is_end.astype(jnp.int32), axis=-1)
+    sentences = jnp.where(seen_end > 1, end_id, sentences)
+    lengths = jnp.sum((seen_end == 0).astype(jnp.int32), axis=-1) + \
+        jnp.any(is_end, axis=-1).astype(jnp.int32)
+    final_scores = scores[-1].reshape(b, beam)
+    return {"SentenceIds": sentences,
+            "SentenceScores": final_scores,
+            "SentenceLength": lengths}
+
+
+@register("reorder_lod_tensor_by_rank")
+def _reorder_by_rank(ctx, ins, attrs):
+    """ref: operators/reorder_lod_tensor_by_rank_op.cc — permute the
+    batch dim of X by the rank-table order (dense: RankTable is the
+    permutation index vector)."""
+    a = x(ins, "X")
+    rank = x(ins, "RankTable").reshape(-1).astype(jnp.int32)
+    return {"Out": jnp.take(a, rank, axis=0)}

@@ -125,28 +125,23 @@ def _gaussian_bsl(ctx, ins, attrs):
     return {"Out": out}
 
 
-def mix_hash(v, seed):
-    """The framework's shared integer mixer (SplitMix-style finalizer) —
-    every hashing op (hash, pyramid_hash) MUST use this one function so
-    bucket assignments stay consistent across ops and checkpoints."""
-    v = v.astype(jnp.uint32)
-    v = (v ^ (v >> 16)) * jnp.uint32(0x85ebca6b)
-    v = (v ^ (v >> 13)) * jnp.uint32(0xc2b2ae35 + seed)
-    return v ^ (v >> 16)
+# (the former mix_hash SplitMix mixer is gone: both hashing ops are
+# bitwise xxHash since round 4 — see ops/xxhash_jax.py)
 
 
 @register("hash")
 def _hash(ctx, ins, attrs):
-    """ref: operators/hash_op.h (xxHash mod space).  mix_hash replaces
-    xxHash — same contract: a deterministic spread of ids into `mod_by`
-    buckets, num_hash probes."""
-    a = x(ins, "X").astype(jnp.uint32)
+    """ref: operators/hash_op.h — ``XXH64(row bytes, ihash) % mod_by``
+    per probe ihash, BITWISE-compatible since round 4 (each id hashed as
+    its int64 storage bytes, the reference's T=int64 instantiation)."""
+    from .xxhash_jax import xxh64_mod
+    a = x(ins, "X")
     num_hash = int(attrs.get("num_hash", 1))
     mod_by = int(attrs.get("mod_by", 1))
-
-    outs = [mix_hash(a, 0x9e37 * (i + 1)).astype(jnp.int64) % mod_by
+    outs = [xxh64_mod(a, i, mod_by).astype(jnp.int64)
             for i in range(num_hash)]
-    return {"Out": jnp.stack(outs, axis=-2)}   # [..., num_hash, last]
+    out = jnp.stack(outs, axis=-1)             # [..., num_hash]
+    return {"Out": out[..., None]}             # [..., num_hash, 1]
 
 
 @register("is_empty")

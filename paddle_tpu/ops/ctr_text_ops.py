@@ -253,9 +253,12 @@ def _pyramid_hash(ctx, ins, attrs):
     Static contract (the reference emits one LoD row per kept n-gram):
     Out [B, L-1, T, num_emb] — window size ℓ+1 at row ℓ-1, position t —
     with DropPos [B, L-1, T] the keep mask (invalid positions, too-short
-    windows, and train-time dropout are 0 rows).  The hash is this
-    framework's SplitMix-style mix, not bitwise XXH32; bloom-filter
-    white/black lists are not supported (use_filter must be False)."""
+    windows, and train-time dropout are 0 rows).  Buckets are BITWISE
+    XXH32 over the int32 n-gram bytes with seed k*rand_len per block k —
+    identical to hash_embedding_ff (pyramid_hash_op.cc:229-245), so
+    reference-trained pyramid checkpoints address the same rows.
+    Bloom-filter white/black lists are not supported (use_filter must
+    be False)."""
     ids = x(ins, "X")                      # [B, T] int ids
     w = x(ins, "W").reshape(-1)            # [space_len + rand_len]
     length = x(ins, "Length")
@@ -267,7 +270,8 @@ def _pyramid_hash(ctx, ins, attrs):
             f"pyramid_hash: num_emb ({num_emb}) must be divisible by "
             f"rand_len ({rand_len}) — the reference enforces this and a "
             f"silent truncation would break the declared output width")
-    seed_base = int(attrs.get("seed", 0))
+    # the reference's `seed` attr feeds only its rand_r dropout stream,
+    # never the bucket hash — dropout here rides the program PRNG chain
     pyramid_layer = int(attrs.get("pyramid_layer", 2))
     drop_out = float(attrs.get("drop_out_percent", 0.0))
     is_training = bool(attrs.get("is_training", False)) and not ctx.is_test
@@ -283,25 +287,24 @@ def _pyramid_hash(ctx, ins, attrs):
     else:
         lens = length.reshape(-1).astype(jnp.int32)
 
-    from .breadth2_ops import mix_hash as mix
+    from .xxhash_jax import xxh32_words
     layers_out = []
     keeps = []
     win_idx = jnp.arange(t)
     for ell in range(1, pyramid_layer):
         width = ell + 1
-        # order-dependent n-gram hash: fold ids through the mixer
-        h = jnp.zeros((b, t), jnp.uint32)
-        for k in range(width):
-            shifted = jnp.pad(ids, [(0, 0), (0, k)])[:, k:k + t]
-            h = mix(h ^ shifted.astype(jnp.uint32),
-                    0x9e37 + k + seed_base)
+        # the n-gram's int32 words, exactly the bytes the reference
+        # hashes ((const float*)(bottom_data + l), width*4 bytes)
+        words = jnp.stack(
+            [jnp.pad(ids, [(0, 0), (0, k)])[:, k:k + t]
+             for k in range(width)], axis=-1).astype(jnp.uint32)
         valid = (win_idx[None, :] + width) <= lens[:, None]   # [B, T]
         if is_training and drop_out > 0:
             keep_draw = jax.random.uniform(ctx.next_key(), (b, t))
             valid = valid & (keep_draw >= drop_out)
         pieces = []
         for j in range(nblocks):
-            bucket = (mix(h, 0x51ed + j * rand_len + seed_base)
+            bucket = (xxh32_words(words, j * rand_len)
                       % jnp.uint32(space_len)).astype(jnp.int32)
             idx = bucket[..., None] + jnp.arange(rand_len)    # [B, T, r]
             pieces.append(w[idx])

@@ -153,6 +153,33 @@ def _make_interp(name, method):
     return impl
 
 
+@register("linear_interp")
+def _linear_interp(ctx, ins, attrs):
+    """ref: operators/interpolate_op.h LinearInterpolation — 1-D resize
+    over NCW tensors."""
+    a = x(ins, "X")                  # [N, C, W]
+    w_in = a.shape[2]
+    ow = attrs.get("out_w", -1)
+    scale = attrs.get("scale", 0.0)
+    if (ow is None or ow < 0) and scale:
+        ow = int(w_in * scale)
+    align = attrs.get("align_corners", True)
+    mode = attrs.get("align_mode", 1)
+    if align:
+        xs = jnp.linspace(0.0, w_in - 1.0, ow)
+    elif mode == 0:
+        xs = jnp.clip((jnp.arange(ow) + 0.5) * w_in / ow - 0.5, 0,
+                      w_in - 1)
+    else:
+        xs = jnp.clip(jnp.arange(ow) * (w_in / ow), 0, w_in - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w_in - 1)
+    x1 = jnp.clip(x0 + 1, 0, w_in - 1)
+    frac = (xs - x0).astype(jnp.float32)
+    v0 = a[:, :, x0].astype(jnp.float32)
+    v1 = a[:, :, x1].astype(jnp.float32)
+    return {"Out": (v0 * (1 - frac) + v1 * frac).astype(a.dtype)}
+
+
 _make_interp("bilinear_interp_v2", "bilinear")
 _make_interp("nearest_interp_v2", "nearest")
 _make_interp("bicubic_interp", "bicubic")

@@ -1,0 +1,280 @@
+"""Registry-diff closure ops (round 4): small genuine gaps surfaced by
+diffing REGISTER_OPERATOR names against the live registry — reverse,
+size, fc, max_pool3d_with_index, split/merge_lod_tensor, nms2/zeros-like
+aliases, and the reference-named QAT quantizers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, get_op, x
+from .quant_ops import _qmax, _abs_max
+
+
+@register("reverse")
+def _reverse(ctx, ins, attrs):
+    """ref: operators/reverse_op.cc — flip along the given axes."""
+    a = x(ins, "X")
+    axes = attrs.get("axis", [0])
+    return {"Out": jnp.flip(a, axis=tuple(int(i) for i in axes))}
+
+
+@register("size")
+def _size(ctx, ins, attrs):
+    """ref: operators/size_op.cc — element count as int64 scalar."""
+    a = x(ins, "Input")
+    return {"Out": jnp.asarray(a.size, jnp.int64)}
+
+
+@register("fc")
+def _fc(ctx, ins, attrs):
+    """ref: operators/fc_op.cc — the fused inference FC (mul + bias +
+    activation); the layer builds mul/elementwise_add, this is the op
+    form inference passes emit."""
+    a = x(ins, "Input")
+    w = x(ins, "W")
+    b = x(ins, "Bias")
+    ncd = int(attrs.get("in_num_col_dims", 1))
+    lead = 1
+    for s in a.shape[:ncd]:
+        lead *= s
+    out = a.reshape(lead, -1) @ w
+    if b is not None:
+        out = out + b.reshape(1, -1)
+    if attrs.get("activation_type") == "relu":
+        out = jnp.maximum(out, 0)
+    return {"Out": out.reshape(a.shape[:ncd] + (w.shape[1],))}
+
+
+@register("max_pool3d_with_index")
+def _max_pool3d_with_index(ctx, ins, attrs):
+    """ref: operators/pool_with_index_op.cc (3-D) — max pool over NCDHW
+    returning the flat argmax index per window."""
+    a = x(ins, "X")
+    ks = list(attrs["ksize"])
+    st = list(attrs.get("strides", ks))
+    pd = list(attrs.get("paddings", [0, 0, 0]))
+    n, c, d, h, w = a.shape
+    neg = jnp.finfo(a.dtype).min
+    ap = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1]),
+                     (pd[2], pd[2])), constant_values=neg)
+    flat_idx = jnp.arange(d * h * w).reshape(1, 1, d, h, w)
+    flat_idx = jnp.pad(flat_idx, ((0, 0), (0, 0), (pd[0], pd[0]),
+                                  (pd[1], pd[1]), (pd[2], pd[2])),
+                       constant_values=-1)
+    od = (ap.shape[2] - ks[0]) // st[0] + 1
+    oh = (ap.shape[3] - ks[1]) // st[1] + 1
+    ow = (ap.shape[4] - ks[2]) // st[2] + 1
+    patches = []
+    idxs = []
+    for kd in range(ks[0]):
+        for kh in range(ks[1]):
+            for kw in range(ks[2]):
+                sl = ap[:, :, kd:kd + od * st[0]:st[0],
+                        kh:kh + oh * st[1]:st[1],
+                        kw:kw + ow * st[2]:st[2]]
+                il = jnp.broadcast_to(
+                    flat_idx[:, :, kd:kd + od * st[0]:st[0],
+                             kh:kh + oh * st[1]:st[1],
+                             kw:kw + ow * st[2]:st[2]],
+                    sl.shape)
+                patches.append(sl)
+                idxs.append(il)
+    stack = jnp.stack(patches)                  # [K, N, C, OD, OH, OW]
+    istack = jnp.stack(idxs)
+    best = jnp.argmax(stack, axis=0)
+    out = jnp.take_along_axis(stack, best[None], axis=0)[0]
+    mask = jnp.take_along_axis(istack, best[None], axis=0)[0]
+    return {"Out": out, "Mask": mask.astype(jnp.int64)}
+
+
+@register("split_lod_tensor")
+def _split_lod_tensor(ctx, ins, attrs):
+    """ref: operators/split_lod_tensor_op.cc — the IfElse front half.
+    Dense contract: both outputs keep the full batch; rows not selected
+    by the mask are zeroed (the merge half recombines by mask)."""
+    a = x(ins, "X")
+    mask = x(ins, "Mask").reshape(-1).astype(bool)
+    m = mask.reshape((-1,) + (1,) * (a.ndim - 1))
+    return {"OutTrue": jnp.where(m, a, 0),
+            "OutFalse": jnp.where(m, 0, a)}
+
+
+@register("merge_lod_tensor")
+def _merge_lod_tensor(ctx, ins, attrs):
+    """ref: operators/merge_lod_tensor_op.cc — the IfElse back half:
+    row-select InTrue/InFalse by the mask."""
+    t, f = x(ins, "InTrue"), x(ins, "InFalse")
+    mask = x(ins, "Mask").reshape(-1).astype(bool)
+    m = mask.reshape((-1,) + (1,) * (t.ndim - 1))
+    return {"Out": jnp.where(m, t, f)}
+
+
+# -- thin aliases for reference op names whose semantics already exist --
+
+register("fill_zeros_like2")(get_op("fill_zeros_like"))
+register("multiclass_nms2")(get_op("multiclass_nms"))   # + RoisNum output
+register("conditional_block_infer")(get_op("conditional_block"))
+
+
+# -- QAT quantizers under the reference's op names ------------------------
+# (ref: operators/fake_quantize_op.cc; the repo's native pair
+# quantize_abs_max/fake_quantize_dequantize_abs_max covers freeze/QAT —
+# these expose the same math under the names QAT passes emit)
+
+
+@register("fake_quantize_abs_max")
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    a = x(ins, "X")
+    bits = attrs.get("bit_length", 8)
+    qmax = _qmax(bits)
+    scale = _abs_max(a)
+    q = jnp.clip(jnp.round(a / jnp.maximum(scale, 1e-9) * qmax),
+                 -qmax, qmax)
+    return {"Out": q, "OutScale": scale.reshape(1)}
+
+
+@register("fake_channel_wise_quantize_abs_max")
+def _fake_cw_quantize_abs_max(ctx, ins, attrs):
+    a = x(ins, "X")
+    bits = attrs.get("bit_length", 8)
+    axis = attrs.get("quant_axis", 0)
+    qmax = _qmax(bits)
+    scale = _abs_max(a, axis)
+    q = jnp.clip(jnp.round(a / jnp.maximum(scale, 1e-9) * qmax),
+                 -qmax, qmax)
+    return {"Out": q, "OutScale": scale.reshape(-1)}
+
+
+@register("fake_dequantize_max_abs")
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    q, scale = x(ins, "X"), x(ins, "Scale")
+    return {"Out": q.astype(jnp.float32) * scale.reshape(()) /
+            float(attrs.get("max_range", _qmax(8)))}
+
+
+@register("fake_channel_wise_dequantize_max_abs")
+def _fake_cw_dequantize_max_abs(ctx, ins, attrs):
+    """ref: fake_quantize_op.cc channel-wise dequantize — one Scales
+    entry dequantizes weights; TWO entries are the QAT-freeze path
+    (channel weight scale × scalar activation scale, divided by both
+    quantization ranges)."""
+    q = x(ins, "X")
+    scales = ins.get("Scales") or []
+    axis = attrs.get("quant_axis", 0)
+    bits = list(attrs.get("quant_bits") or [8])
+    s = scales[0].reshape(-1)
+    shape = [1] * q.ndim
+    shape[axis] = -1
+    out = q.astype(jnp.float32) * s.reshape(shape) / _qmax(bits[0])
+    if len(scales) > 1:
+        b1 = bits[1] if len(bits) > 1 else 8
+        out = out * scales[1].reshape(()) / _qmax(b1)
+    return {"Out": out}
+
+
+def _moving_average_scale(state, accum, scale_now, rate):
+    """ref: fake_quantize_op.cc FindMovingAverageAbsMaxFunctor."""
+    new_state = state * rate + 1.0
+    new_accum = accum * rate + scale_now
+    return new_state, new_accum, new_accum / new_state
+
+
+@register("moving_average_abs_max_scale")
+def _moving_average_abs_max_scale(ctx, ins, attrs):
+    a = x(ins, "X")
+    state = x(ins, "InState")
+    accum = x(ins, "InAccum")
+    rate = float(attrs.get("moving_rate", 0.9))
+    if state is None:
+        state = jnp.zeros((1,), jnp.float32)
+    if accum is None:
+        accum = jnp.zeros((1,), jnp.float32)
+    cur = _abs_max(a).reshape(1)
+    if attrs.get("is_test", False) or ctx.is_test:
+        scale = jnp.where(state > 0, accum / jnp.maximum(state, 1e-9), cur)
+        return {"Out": a, "OutScale": scale,
+                "OutState": state, "OutAccum": accum}
+    ns, na, scale = _moving_average_scale(state, accum, cur, rate)
+    return {"Out": a, "OutScale": scale,
+            "OutState": lax.stop_gradient(ns),
+            "OutAccum": lax.stop_gradient(na)}
+
+
+@register("fake_quantize_moving_average_abs_max")
+def _fake_q_moving_average(ctx, ins, attrs):
+    a = x(ins, "X")
+    bits = attrs.get("bit_length", 8)
+    rate = float(attrs.get("moving_rate", 0.9))
+    state = x(ins, "InState")
+    accum = x(ins, "InAccum")
+    in_scale = x(ins, "InScale")
+    qmax = _qmax(bits)
+    if state is None:
+        state = jnp.zeros((1,), jnp.float32)
+    if accum is None:
+        accum = jnp.zeros((1,), jnp.float32)
+    if attrs.get("is_test", False) or ctx.is_test:
+        scale = in_scale.reshape(1) if in_scale is not None else \
+            _abs_max(a).reshape(1)
+        ns, na = state, accum
+    else:
+        cur = _abs_max(a).reshape(1)
+        ns, na, scale = _moving_average_scale(state, accum, cur, rate)
+    q = jnp.clip(jnp.round(a / jnp.maximum(scale.reshape(()), 1e-9)
+                           * qmax), -qmax, qmax)
+    return {"Out": q, "OutScale": lax.stop_gradient(scale),
+            "OutState": lax.stop_gradient(ns),
+            "OutAccum": lax.stop_gradient(na)}
+
+
+@register("fake_quantize_dequantize_moving_average_abs_max")
+def _fake_qdq_moving_average(ctx, ins, attrs):
+    outs = _fake_q_moving_average(ctx, ins, attrs)
+    bits = attrs.get("bit_length", 8)
+    scale = outs["OutScale"].reshape(())
+    outs["Out"] = outs["Out"] * scale / _qmax(bits)
+    return outs
+
+
+@register("fake_quantize_range_abs_max")
+def _fake_q_range_abs_max(ctx, ins, attrs):
+    """ref: fake_quantize_op.cc FindRangeAbsMaxFunctor — windowed max of
+    recent scales; densely the window lives in OutScales [window] with
+    Iter the running step."""
+    a = x(ins, "X")
+    bits = attrs.get("bit_length", 8)
+    window = int(attrs.get("window_size", 10000))
+    in_scale = x(ins, "InScale")
+    it = x(ins, "Iter")
+    scales = x(ins, "OutScales")
+    qmax = _qmax(bits)
+    if attrs.get("is_test", False) or ctx.is_test:
+        scale = in_scale.reshape(())
+        q = jnp.clip(jnp.round(a / jnp.maximum(scale, 1e-9) * qmax),
+                     -qmax, qmax)
+        return {"Out": q, "OutScale": scale.reshape(1)}
+    cur = _abs_max(a)
+    if scales is None:
+        scales = jnp.zeros((window,), jnp.float32)
+    if it is None:
+        it = jnp.zeros((1,), jnp.int64)
+    pos = (it.reshape(()) % window).astype(jnp.int32)
+    scales = scales.at[pos].set(cur)
+    scale = jnp.max(scales)
+    q = jnp.clip(jnp.round(a / jnp.maximum(scale, 1e-9) * qmax),
+                 -qmax, qmax)
+    return {"Out": q, "OutScale": scale.reshape(1),
+            "OutScales": lax.stop_gradient(scales),
+            "Iter": it + 1}
+
+
+@register("fake_init")
+def _fake_init(ctx, ins, attrs):
+    """ref: operators/fill_constant_op.cc fake_init — PS-side shape
+    placeholder; densely a zero fill."""
+    shape = tuple(int(s) for s in attrs.get("shape", (1,)))
+    return {"Out": jnp.zeros(shape, jnp.float32)}

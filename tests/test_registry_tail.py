@@ -1,0 +1,148 @@
+"""Registry-diff closure ops: reverse, size, fc, max_pool3d_with_index,
+split/merge_lod_tensor, reference-named QAT quantizers.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import get_op, LoweringContext
+
+
+def ctx(is_test=False):
+    return LoweringContext(jax.random.PRNGKey(0), None, (), is_test)
+
+
+def test_reverse_and_size():
+    a = jnp.asarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+    out = get_op("reverse")(ctx(), {"X": [a]}, {"axis": [1]})
+    np.testing.assert_allclose(np.asarray(out["Out"]),
+                               [[2, 1, 0], [5, 4, 3]])
+    s = get_op("size")(ctx(), {"Input": [a]}, {})
+    assert int(s["Out"]) == 6
+
+
+def test_fc_op_matches_matmul():
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.rand(4, 5).astype(np.float32))
+    w = jnp.asarray(rng.rand(5, 3).astype(np.float32))
+    b = jnp.asarray(rng.rand(3).astype(np.float32))
+    out = get_op("fc")(ctx(), {"Input": [a], "W": [w], "Bias": [b]},
+                       {"activation_type": "relu"})
+    expect = np.maximum(np.asarray(a) @ np.asarray(w) + np.asarray(b), 0)
+    np.testing.assert_allclose(np.asarray(out["Out"]), expect, rtol=1e-5)
+
+
+def test_max_pool3d_with_index():
+    a = np.zeros((1, 1, 2, 4, 4), np.float32)
+    a[0, 0, 1, 2, 3] = 9.0          # flat index 1*16 + 2*4 + 3 = 27
+    out = get_op("max_pool3d_with_index")(
+        ctx(), {"X": [jnp.asarray(a)]},
+        {"ksize": [2, 4, 4], "strides": [2, 4, 4], "paddings": [0, 0, 0]})
+    assert float(np.asarray(out["Out"])[0, 0, 0, 0, 0]) == 9.0
+    assert int(np.asarray(out["Mask"])[0, 0, 0, 0, 0]) == 27
+
+
+def test_split_merge_lod_tensor_roundtrip():
+    a = jnp.asarray(np.arange(8, dtype=np.float32).reshape(4, 2))
+    mask = jnp.asarray(np.array([1, 0, 1, 0], np.int32))
+    sp = get_op("split_lod_tensor")(ctx(), {"X": [a], "Mask": [mask]}, {})
+    mg = get_op("merge_lod_tensor")(
+        ctx(), {"InTrue": [sp["OutTrue"]], "InFalse": [sp["OutFalse"]],
+                "Mask": [mask], "X": [a]}, {})
+    np.testing.assert_allclose(np.asarray(mg["Out"]), np.asarray(a))
+
+
+class TestReferenceNamedQuant:
+    def test_fake_quantize_dequantize_roundtrip(self):
+        a = jnp.asarray(np.array([[-1.0, 0.5, 0.25]], np.float32))
+        q = get_op("fake_quantize_abs_max")(
+            ctx(), {"X": [a]}, {"bit_length": 8})
+        scale = float(q["OutScale"][0])
+        assert scale == 1.0
+        dq = get_op("fake_dequantize_max_abs")(
+            ctx(), {"X": [q["Out"]], "Scale": [q["OutScale"]]},
+            {"max_range": 127.0})
+        np.testing.assert_allclose(np.asarray(dq["Out"]), np.asarray(a),
+                                   atol=1.0 / 127)
+
+    def test_moving_average_state_updates(self):
+        a = jnp.asarray(np.array([2.0, -4.0], np.float32))
+        out = get_op("fake_quantize_moving_average_abs_max")(
+            ctx(), {"X": [a]}, {"bit_length": 8, "moving_rate": 0.9})
+        # state 0*0.9+1=1; accum 0*0.9+4=4; scale 4/1
+        np.testing.assert_allclose(float(out["OutScale"][0]), 4.0)
+        np.testing.assert_allclose(float(out["OutState"][0]), 1.0)
+        out2 = get_op("fake_quantize_moving_average_abs_max")(
+            ctx(), {"X": [a * 0.5], "InState": [out["OutState"]],
+                    "InAccum": [out["OutAccum"]]},
+            {"bit_length": 8, "moving_rate": 0.9})
+        # state 1*.9+1=1.9; accum 4*.9+2=5.6; scale 5.6/1.9
+        np.testing.assert_allclose(float(out2["OutScale"][0]), 5.6 / 1.9,
+                                   rtol=1e-6)
+
+    def test_range_abs_max_window(self):
+        a = jnp.asarray(np.array([3.0], np.float32))
+        out = get_op("fake_quantize_range_abs_max")(
+            ctx(), {"X": [a]}, {"bit_length": 8, "window_size": 4})
+        np.testing.assert_allclose(float(out["OutScale"][0]), 3.0)
+        out2 = get_op("fake_quantize_range_abs_max")(
+            ctx(), {"X": [a * 0.1], "OutScales": [out["OutScales"]],
+                    "Iter": [out["Iter"]]},
+            {"bit_length": 8, "window_size": 4})
+        # window still holds the 3.0 from step 1
+        np.testing.assert_allclose(float(out2["OutScale"][0]), 3.0)
+
+    def test_channel_wise_pair(self):
+        a = jnp.asarray(np.array([[1.0, 0.5], [-2.0, 4.0]], np.float32))
+        q = get_op("fake_channel_wise_quantize_abs_max")(
+            ctx(), {"X": [a]}, {"bit_length": 8, "quant_axis": 0})
+        np.testing.assert_allclose(np.asarray(q["OutScale"]), [1.0, 4.0])
+        dq = get_op("fake_channel_wise_dequantize_max_abs")(
+            ctx(), {"X": [q["Out"]], "Scales": [q["OutScale"]]},
+            {"quant_axis": 0, "quant_bits": [8]})
+        np.testing.assert_allclose(np.asarray(dq["Out"]), np.asarray(a),
+                                   atol=4.0 / 127)
+
+
+def test_cw_dequantize_two_scale_freeze_path():
+    # QAT-freeze: channel weight scale × scalar activation scale
+    q = jnp.asarray(np.array([[127.0], [64.0]], np.float32))
+    ws = jnp.asarray(np.array([2.0, 4.0], np.float32))
+    act = jnp.asarray(np.array([8.0], np.float32))
+    out = get_op("fake_channel_wise_dequantize_max_abs")(
+        ctx(), {"X": [q], "Scales": [ws, act]},
+        {"quant_axis": 0, "quant_bits": [8, 8]})
+    o = np.asarray(out["Out"])
+    np.testing.assert_allclose(
+        o.ravel(), [127 * 2 / 127 * 8 / 127, 64 * 4 / 127 * 8 / 127],
+        rtol=1e-6)
+
+
+def test_hash_layer_shape_matches_op():
+    import paddle_tpu.fluid as fluid
+    x_ = fluid.layers.data("hx", shape=[3], dtype="int64")
+    h = fluid.layers.hash(x_, hash_size=500, num_hash=2)
+    assert tuple(h.shape[-2:]) == (2, 1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    o, = exe.run(fluid.default_main_program(),
+                 feed={"hx": np.array([[1, 2, 3]], np.int64)},
+                 fetch_list=[h])
+    assert o.shape == (1, 2, 1)
+
+
+def test_resize_linear_nwc():
+    import paddle_tpu.fluid as fluid
+    x_ = fluid.layers.data("rx", shape=[4, 2], dtype="float32")
+    out = fluid.layers.resize_linear(x_, out_shape=[7],
+                                     data_format="NWC")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.arange(8, dtype=np.float32).reshape(1, 4, 2)
+    o, = exe.run(fluid.default_main_program(), feed={"rx": xv},
+                 fetch_list=[out])
+    assert o.shape == (1, 7, 2)
+    # endpoints preserved per channel (align_corners)
+    np.testing.assert_allclose(o[0, 0], xv[0, 0], atol=1e-6)
+    np.testing.assert_allclose(o[0, -1], xv[0, -1], atol=1e-6)

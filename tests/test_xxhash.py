@@ -1,0 +1,195 @@
+"""Bitwise XXH32 validation (VERDICT r3 weak #5): the JAX lane
+implementation must agree with an independent from-spec Python XXH32 on
+whole-word inputs, and pyramid_hash must address the reference's
+buckets.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops.xxhash_jax import xxh32_words
+from paddle_tpu.ops.registry import get_op, LoweringContext
+
+M32 = 0xFFFFFFFF
+P1, P2, P3, P4, P5 = (2654435761, 2246822519, 3266489917, 668265263,
+                      374761393)
+
+
+def _rotl(x, r):
+    x &= M32
+    return ((x << r) | (x >> (32 - r))) & M32
+
+
+def xxh32_ref(data: bytes, seed: int = 0) -> int:
+    """Pure-python XXH32 written from the public spec
+    (github.com/Cyan4973/xxHash/blob/dev/doc/xxhash_spec.md)."""
+    n = len(data)
+    i = 0
+    if n >= 16:
+        v1 = (seed + P1 + P2) & M32
+        v2 = (seed + P2) & M32
+        v3 = seed & M32
+        v4 = (seed - P1) & M32
+        while i + 16 <= n:
+            for j, v in enumerate((v1, v2, v3, v4)):
+                lane = int.from_bytes(data[i + 4 * j:i + 4 * j + 4],
+                                      "little")
+                v = (v + lane * P2) & M32
+                v = (_rotl(v, 13) * P1) & M32
+                if j == 0:
+                    v1 = v
+                elif j == 1:
+                    v2 = v
+                elif j == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            i += 16
+        h = (_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12)
+             + _rotl(v4, 18)) & M32
+    else:
+        h = (seed + P5) & M32
+    h = (h + n) & M32
+    while i + 4 <= n:
+        lane = int.from_bytes(data[i:i + 4], "little")
+        h = (h + lane * P3) & M32
+        h = (_rotl(h, 17) * P4) & M32
+        i += 4
+    while i < n:
+        h = (h + data[i] * P5) & M32
+        h = (_rotl(h, 11) * P1) & M32
+        i += 1
+    h ^= h >> 15
+    h = (h * P2) & M32
+    h ^= h >> 13
+    h = (h * P3) & M32
+    h ^= h >> 16
+    return h
+
+
+def test_spec_reference_known_vectors():
+    # published XXH32 test vectors (xxhash_spec.md)
+    assert xxh32_ref(b"", 0) == 0x02CC5D05
+    assert xxh32_ref(b"", 0x9E3779B1) == 0x36B78AE7
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 8, 11])
+@pytest.mark.parametrize("seed", [0, 4, 16, 12345])
+def test_jax_matches_spec(n, seed):
+    rng = np.random.RandomState(n * 1000 + seed)
+    words = rng.randint(0, 2**31, size=(6, n)).astype(np.uint32)
+    got = np.asarray(xxh32_words(jnp.asarray(words), seed))
+    for row in range(6):
+        expect = xxh32_ref(words[row].astype("<u4").tobytes(), seed)
+        assert int(got[row]) == expect, (n, seed, row)
+
+
+def test_pyramid_hash_buckets_are_reference_xxh32():
+    # bucket of block k for an n-gram must be XXH32(bytes, k*rand_len)
+    # % space_len, matching hash_embedding_ff — checked by planting a
+    # recognisable value in the weight row the reference would read
+    space_len, rand_len, num_emb = 97, 2, 6
+    ids = np.array([[3, 7, 0]], np.int64)
+    w = np.arange(space_len + rand_len, dtype=np.float32)
+    ctx = LoweringContext(jax.random.PRNGKey(0), None, (), True)
+    out = get_op("pyramid_hash")(
+        ctx,
+        {"X": [jnp.asarray(ids)], "W": [jnp.asarray(w.reshape(-1, 1))],
+         "Length": [jnp.asarray([2], dtype=jnp.int32)]},
+        {"num_emb": num_emb, "space_len": space_len, "rand_len": rand_len,
+         "pyramid_layer": 2, "drop_out_percent": 0.0,
+         "is_training": False, "use_filter": False})
+    o = np.asarray(out["Out"])          # [1, 1, 3, 6]
+    ngram = np.array([3, 7], dtype="<u4").tobytes()
+    for k in range(num_emb // rand_len):
+        pos = xxh32_ref(ngram, k * rand_len) % space_len
+        np.testing.assert_allclose(
+            o[0, 0, 0, k * rand_len:(k + 1) * rand_len],
+            w[pos:pos + rand_len])
+
+
+Q1, Q2, Q3, Q4, Q5 = (11400714785074694791, 14029467366897019727,
+                      1609587929392839161, 9650029242287828579,
+                      2870177450012600261)
+M64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _rotl64(x, r):
+    x &= M64
+    return ((x << r) | (x >> (64 - r))) & M64
+
+
+def xxh64_ref(data: bytes, seed: int = 0) -> int:
+    """Pure-python XXH64 from the public spec."""
+    n = len(data)
+    i = 0
+
+    def rnd(acc, lane):
+        return (_rotl64((acc + lane * Q2) & M64, 31) * Q1) & M64
+
+    if n >= 32:
+        v = [(seed + Q1 + Q2) & M64, (seed + Q2) & M64, seed & M64,
+             (seed - Q1) & M64]
+        while i + 32 <= n:
+            for j in range(4):
+                lane = int.from_bytes(data[i + 8 * j:i + 8 * j + 8],
+                                      "little")
+                v[j] = rnd(v[j], lane)
+            i += 32
+        h = (_rotl64(v[0], 1) + _rotl64(v[1], 7) + _rotl64(v[2], 12)
+             + _rotl64(v[3], 18)) & M64
+        for j in range(4):
+            h = ((h ^ rnd(0, v[j])) * Q1 + Q4) & M64
+    else:
+        h = (seed + Q5) & M64
+    h = (h + n) & M64
+    while i + 8 <= n:
+        lane = int.from_bytes(data[i:i + 8], "little")
+        h = ((_rotl64(h ^ rnd(0, lane), 27) * Q1) + Q4) & M64
+        i += 8
+    if i + 4 <= n:
+        lane = int.from_bytes(data[i:i + 4], "little")
+        h = ((_rotl64(h ^ ((lane * Q1) & M64), 23) * Q2) + Q3) & M64
+        i += 4
+    while i < n:
+        h = (_rotl64(h ^ ((data[i] * Q5) & M64), 11) * Q1) & M64
+        i += 1
+    h ^= h >> 33
+    h = (h * Q2) & M64
+    h ^= h >> 29
+    h = (h * Q3) & M64
+    h ^= h >> 32
+    return h
+
+
+def test_xxh64_spec_known_vectors():
+    assert xxh64_ref(b"", 0) == 0xEF46DB3751D8E999
+    assert xxh64_ref(b"", 2654435761) == 0xAC75FDA2929B17EF
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7])
+@pytest.mark.parametrize("seed", [0, 1, 5])
+def test_jax_xxh64_matches_spec(n, seed):
+    from paddle_tpu.ops.xxhash_jax import xxh64_int64_rows
+    rng = np.random.RandomState(n * 31 + seed)
+    vals = rng.randint(0, 2**31, size=(4, n)).astype(np.int64)
+    hi, lo = xxh64_int64_rows(jnp.asarray(vals, jnp.int32), seed)
+    for r in range(4):
+        expect = xxh64_ref(vals[r].astype("<i8").tobytes(), seed)
+        got = (int(np.asarray(hi)[r]) << 32) | int(np.asarray(lo)[r])
+        assert got == expect, (n, seed, r)
+
+
+def test_hash_op_is_reference_xxh64():
+    ids = np.array([[7], [13]], np.int64)
+    ctx_ = LoweringContext(jax.random.PRNGKey(0), None, (), True)
+    out = get_op("hash")(ctx_, {"X": [jnp.asarray(ids, jnp.int32)]},
+                         {"num_hash": 2, "mod_by": 1000})
+    o = np.asarray(out["Out"])
+    assert o.shape == (2, 2, 1)
+    for row, idv in enumerate([7, 13]):
+        data = np.array([idv], dtype="<i8").tobytes()
+        for ih in range(2):
+            assert int(o[row, ih, 0]) == xxh64_ref(data, ih) % 1000

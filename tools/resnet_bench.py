@@ -1,0 +1,88 @@
+"""ResNet-50 ImageNet-shape training throughput (BASELINE config 2) —
+single-chip images/s + MFU with the r4 pipelined methodology, and a
+dp-scaling check over a virtual mesh when no chip is reachable.
+
+Usage:
+  python tools/resnet_bench.py            # real chip
+  RESNET_VIRTUAL=8 python tools/resnet_bench.py   # 8-dev CPU mesh check
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def resnet50_flops(batch, image=224, class_dim=1000):
+    """~3x fwd GEMM FLOPs; ResNet-50 fwd ≈ 4.1 GFLOP per 224x224 image."""
+    return 3 * 4.1e9 * batch * (image / 224.0) ** 2
+
+
+def main():
+    virtual = int(os.environ.get("RESNET_VIRTUAL", 0))
+    if virtual:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={virtual}").strip()
+    import jax
+    if virtual:
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import resnet
+
+    batch = int(os.environ.get("RESNET_BATCH",
+                               2 * virtual if virtual else 128))
+    image = int(os.environ.get("RESNET_IMAGE", 32 if virtual else 224))
+    steps = int(os.environ.get("RESNET_STEPS", 2 if virtual else 20))
+    classes = 100 if virtual else 1000
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        img, label, loss, acc1, acc5 = resnet.build_train_network(
+            class_dim=classes, depth=50, image_shape=(3, image, image))
+        fluid.optimizer.Momentum(0.1, 0.9).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.rand(batch, 3, image, image).astype(np.float32),
+            "label": rng.randint(0, classes, (batch, 1)).astype(np.int64)}
+    for v in feed.values():
+        v.flags.writeable = False
+
+    if virtual:
+        from paddle_tpu.framework.compiler import make_mesh
+        prog = fluid.CompiledProgram(main_prog).with_data_parallel(
+            loss_name=loss.name, mesh=make_mesh(virtual, "dp"))
+    else:
+        prog = main_prog
+    exe = fluid.Executor(fluid.CPUPlace() if virtual else fluid.TPUPlace(0))
+    exe.run(startup)
+    l, = exe.run(prog, feed=feed, fetch_list=[loss])      # compile
+    assert np.isfinite(l).all()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l, = exe.run(prog, feed=feed, fetch_list=[loss],
+                     return_numpy=False)
+    l_host = np.asarray(l)
+    jax.block_until_ready(list(fluid.global_scope().vars.values()))
+    dt = (time.perf_counter() - t0) / steps
+    assert np.isfinite(l_host).all()
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec"
+                  + ("_virtual" if virtual else "_per_chip"),
+        "value": round(batch / dt, 2),
+        "unit": "images/s",
+        "ms_per_step": round(dt * 1e3, 2),
+        "mfu": round(resnet50_flops(batch, image) / dt / 197e12, 4)
+        if not virtual else None,
+        "devices": virtual or 1,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,15 +9,15 @@ N=0
 while true; do
   N=$((N+1))
   ts=$(date -u +%H:%M:%S)
-  if flock -n /tmp/tpu.lock -c 'timeout 180 python -c "import jax; assert jax.devices(); print(\"up\")" >/tmp/tpu_watch/probe.out 2>&1' \
+  if flock -n /tmp/tpu.lock -c 'timeout -k 20 180 python -c "import jax; assert jax.devices(); print(\"up\")" >/tmp/tpu_watch/probe.out 2>&1' \
       && grep -q up /tmp/tpu_watch/probe.out; then
     echo "[$ts] attempt $N: TUNNEL UP — running battery" | tee -a /tmp/tpu_watch/log
     flock /tmp/tpu.lock -c '
       set -x
-      PYTHONPATH=/root/repo:$PYTHONPATH timeout 1800 python tools/perf_probe.py 20 2>&1 | tee /tmp/tpu_watch/perf_probe.txt
-      timeout 1200 python bench.py 2>&1 | tee /tmp/tpu_watch/bench.txt
-      PYTHONPATH=/root/repo:$PYTHONPATH timeout 2400 python tools/kernel_ab.py 20 2>&1 | tee /tmp/tpu_watch/kernel_ab.txt
-      PYTHONPATH=/root/repo:$PYTHONPATH timeout 1800 python tools/tpu_smoke.py 2>&1 | tee /tmp/tpu_watch/smoke.txt
+      PYTHONPATH=/root/repo:$PYTHONPATH timeout -k 30 1800 python tools/perf_probe.py 20 2>&1 | tee /tmp/tpu_watch/perf_probe.txt
+      timeout -k 30 1200 python bench.py 2>&1 | tee /tmp/tpu_watch/bench.txt
+      PYTHONPATH=/root/repo:$PYTHONPATH timeout -k 30 2400 python tools/kernel_ab.py 20 2>&1 | tee /tmp/tpu_watch/kernel_ab.txt
+      PYTHONPATH=/root/repo:$PYTHONPATH timeout -k 30 1800 python tools/tpu_smoke.py 2>&1 | tee /tmp/tpu_watch/smoke.txt
     ' 2>&1 | tail -120 >> /tmp/tpu_watch/log
     # keep only artifacts that actually contain measurements
     grep -q "t_pure" /tmp/tpu_watch/perf_probe.txt && cp /tmp/tpu_watch/perf_probe.txt PERF_PROBE_r04.txt
