@@ -40,10 +40,12 @@ class PassBuilder:
 
     #: default inference pipeline, mirroring the reference's
     #: GpuPassStrategy order: fusions first, folds, DCE last
-    INFERENCE_PASSES = ["fuse_elemwise_add_act", "fuse_bn_act",
+    INFERENCE_PASSES = ["embedding_eltwise_layernorm_fuse",
+                        "fuse_elemwise_add_act", "fuse_bn_act",
                         "fuse_add_layernorm", "multihead_matmul_fuse",
-                        "transpose_matmul_fold", "fold_identity_ops",
-                        "cast_elimination", "dead_code_elimination"]
+                        "fc_fuse", "transpose_matmul_fold",
+                        "fold_identity_ops", "cast_elimination",
+                        "dead_code_elimination"]
 
     def __init__(self, passes: Optional[Sequence[str]] = None):
         self._passes: List[str] = list(
@@ -455,5 +457,124 @@ def multihead_matmul_fuse(program: Program, fetch_names=(), **_):
                         "dropout_implementation": dropout_impl,
                         "is_test": is_test}
             drop.update(chain[1:])
+        block.ops[:] = [op for k, op in enumerate(block.ops)
+                        if k not in drop]
+
+
+@register_pass("fc_fuse")
+def fc_fuse(program: Program, fetch_names=(), **_):
+    """mul → elementwise_add(1-D bias) [→ relu]  ⇒  one ``fc`` op
+    (ref: framework/ir/fc_fuse_pass.cc → operators/fc_op.cc) — the
+    inference-time FC form every analysis-predictor pipeline emits."""
+    for block in program.blocks:
+        uses = _use_counts(block, keep_names=fetch_names)
+        drop = set()
+        for i, op in enumerate(block.ops):
+            if op.type != "mul" or i in drop:
+                continue
+            if op.attrs.get("y_num_col_dims", 1) != 1:
+                continue
+            hit = _single_use_chain(block, i, uses, ("elementwise_add",))
+            if hit is None:
+                continue
+            j, add = hit
+            mul_out = op.outputs["Out"][0]
+            xs = add.inputs.get("X", [])
+            ys = add.inputs.get("Y", [])
+            bias = ys[0] if xs and xs[0] == mul_out else \
+                (xs[0] if ys and ys[0] == mul_out else None)
+            if bias is None:
+                continue
+            bv = block._find_var_recursive(bias)
+            if bv is None or len(bv.shape) != 1:
+                continue            # fc bias is 1-D [size]
+            act = None
+            end = j
+            hit2 = _single_use_chain(block, j, uses, ("relu",))
+            if hit2 is not None:
+                end, _relu = hit2
+                act = "relu"
+            tail = block.ops[end]
+            tail.type = "fc"
+            tail.inputs = {"Input": list(op.inputs["X"]),
+                           "W": list(op.inputs["Y"]),
+                           "Bias": [bias]}
+            tail.attrs = {"in_num_col_dims":
+                          op.attrs.get("x_num_col_dims", 1),
+                          "activation_type": act or ""}
+            drop.add(i)
+            if end != j:
+                drop.add(j)
+        block.ops[:] = [op for k, op in enumerate(block.ops)
+                        if k not in drop]
+
+
+@register_pass("embedding_eltwise_layernorm_fuse")
+def embedding_eltwise_layernorm_fuse(program: Program, fetch_names=(),
+                                     **_):
+    """N lookup_tables summed pairwise then layer_norm'd  ⇒  one
+    ``fused_embedding_eltwise_layernorm`` op (ref:
+    framework/ir/embedding_eltwise_layernorm_fuse_pass.cc → operators/
+    fused/fused_embedding_eltwise_layernorm_op.cu) — BERT's embedding
+    stack (word + position + sentence)."""
+    for block in program.blocks:
+        uses = _use_counts(block, keep_names=fetch_names)
+        drop = set()
+        lookup_out = {}
+        for i, op in enumerate(block.ops):
+            if op.type in ("lookup_table", "lookup_table_v2"):
+                lookup_out[op.outputs["Out"][0]] = i
+        for i, op in enumerate(block.ops):
+            if op.type not in ("lookup_table", "lookup_table_v2") \
+                    or i in drop:
+                continue
+            # greedily follow the add chain collecting lookup outputs
+            chain_ops = [i]
+            members = [i]
+            cur = i
+            while True:
+                hit = _single_use_chain(block, cur, uses,
+                                        ("elementwise_add",))
+                if hit is None:
+                    break
+                j, add = hit
+                prev_out = block.ops[cur].outputs["Out"][0]
+                xs = add.inputs.get("X", [])
+                ys = add.inputs.get("Y", [])
+                other = ys[0] if xs and xs[0] == prev_out else \
+                    (xs[0] if ys and ys[0] == prev_out else None)
+                if other is None or other not in lookup_out or \
+                        uses.get(other, 0) != 1:
+                    break
+                members.append(lookup_out[other])
+                chain_ops.append(j)
+                cur = j
+            if len(members) < 2:
+                continue
+            hit = _single_use_chain(block, cur, uses, ("layer_norm",))
+            if hit is None:
+                continue
+            ln_i, ln = hit
+            aux = [n for slot in ("Mean", "Variance")
+                   for n in ln.outputs.get(slot, ())]
+            if any(uses.get(n, 0) > 0 for n in aux) or \
+                    any(n in set(fetch_names) for n in aux):
+                continue
+            # the fused op normalises the LAST axis only
+            yv = block._find_var_recursive(ln.outputs["Y"][0])
+            if yv is None or \
+                    ln.attrs.get("begin_norm_axis", 1) != len(yv.shape) - 1:
+                continue
+            ids, tables = [], []
+            for m in members:
+                lk = block.ops[m]
+                ids.append(lk.inputs["Ids"][0])
+                tables.append(lk.inputs["W"][0])
+            ln.type = "fused_embedding_eltwise_layernorm"
+            ln.inputs = {"Ids": ids, "Embs": tables,
+                         "Scale": list(ln.inputs.get("Scale", [])),
+                         "Bias": list(ln.inputs.get("Bias", []))}
+            drop.update(members)
+            drop.update(chain_ops[1:])
         block.ops[:] = [op for k, op in enumerate(block.ops)
                         if k not in drop]

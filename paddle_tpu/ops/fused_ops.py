@@ -149,3 +149,32 @@ def _multihead_matmul(ctx, ins, attrs):
     if post != 1.0:
         out = out * jnp.asarray(post, out.dtype)
     return {"Out": out.astype(v.dtype)}
+
+
+@register("fused_embedding_eltwise_layernorm")
+def _fused_embedding_eltwise_layernorm(ctx, ins, attrs):
+    """Sum of N embedding lookups + LayerNorm in one op (emitted by the
+    embedding_eltwise_layernorm_fuse pass; ref CUDA analog:
+    operators/fused/fused_embedding_eltwise_layernorm_op.cu — BERT's
+    word+position+sentence embedding stack).  XLA fuses the gathers and
+    the norm into one HBM pass."""
+    ids_list = ins.get("Ids", [])
+    emb_list = ins.get("Embs", [])
+    scale, bias = x(ins, "Scale"), x(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    acc = None
+    for ids, table in zip(ids_list, emb_list):
+        idx = ids.reshape(ids.shape[:2]).astype(jnp.int32)
+        g = table[idx]                       # [B, S, D]
+        acc = g if acc is None else acc + g
+    mean = jnp.mean(acc, axis=-1, keepdims=True)
+    var = jnp.var(acc, axis=-1, keepdims=True)
+    y = (acc - mean) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        y = y * scale.reshape(1, 1, -1)
+    if bias is not None:
+        y = y + bias.reshape(1, 1, -1)
+    d = acc.shape[-1]
+    zeros = jnp.zeros(acc.shape[:-1], jnp.float32)
+    return {"Y": y.astype(acc.dtype), "Out": y.astype(acc.dtype),
+            "Mean": zeros, "Variance": zeros}

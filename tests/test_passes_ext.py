@@ -149,3 +149,69 @@ def test_add_layer_norm_kernel_grads():
     for x_, y_ in zip(gk, gr):
         np.testing.assert_allclose(np.asarray(x_), np.asarray(y_),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_fc_fuse_pass():
+    # mul + elementwise_add(bias) + relu ⇒ one fc op, numerics unchanged
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = L.data("x", shape=[5])
+        h = L.fc(x, size=4, act="relu", name="fcf")
+        out = L.reduce_sum(h)
+    xb = np.random.RandomState(0).rand(3, 5).astype(np.float32)
+    ref = _run_prog(main, startup, {"x": xb}, [out])
+    assert "mul" in _types(main)
+    apply_pass(main, "fc_fuse", fetch_names=[out.name])
+    t = _types(main)
+    assert "fc" in t and "mul" not in t and "relu" not in t, t
+    got = _run_prog(main, startup, {"x": xb}, [out])
+    np.testing.assert_allclose(got[0], ref[0], rtol=1e-5)
+
+
+def test_embedding_eltwise_layernorm_fuse_pass():
+    # BERT embedding stack: 3 lookups + 2 adds + LN ⇒ one fused op
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        w_ids = L.data("w", shape=[8], dtype="int64")
+        p_ids = L.data("p", shape=[8], dtype="int64")
+        s_ids = L.data("s", shape=[8], dtype="int64")
+        we = L.embedding(w_ids, size=[30, 16])
+        pe = L.embedding(p_ids, size=[10, 16])
+        se = L.embedding(s_ids, size=[2, 16])
+        summed = L.elementwise_add(L.elementwise_add(we, pe), se)
+        normed = L.layer_norm(summed, begin_norm_axis=2)
+        out = normed
+    rng = np.random.RandomState(1)
+    feed = {"w": rng.randint(0, 30, (2, 8)).astype(np.int64),
+            "p": rng.randint(0, 10, (2, 8)).astype(np.int64),
+            "s": rng.randint(0, 2, (2, 8)).astype(np.int64)}
+    ref = _run_prog(main, startup, feed, [out])
+    apply_pass(main, "embedding_eltwise_layernorm_fuse",
+               fetch_names=[out.name])
+    t = _types(main)
+    assert "fused_embedding_eltwise_layernorm" in t, t
+    assert "lookup_table" not in t and "elementwise_add" not in t, t
+    got = _run_prog(main, startup, feed, [out])
+    np.testing.assert_allclose(got[0], ref[0], rtol=1e-5, atol=1e-6)
+
+
+def test_embedding_fuse_skips_when_mean_fetched():
+    # LN statistics consumed → fusion must not fire
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        w_ids = L.data("w", shape=[8], dtype="int64")
+        p_ids = L.data("p", shape=[8], dtype="int64")
+        we = L.embedding(w_ids, size=[30, 16])
+        pe = L.embedding(p_ids, size=[10, 16])
+        summed = L.elementwise_add(we, pe)
+        normed = L.layer_norm(summed, begin_norm_axis=2)
+        out = L.reduce_sum(normed)
+    ln_op = [op for op in main.global_block().ops
+             if op.type == "layer_norm"][0]
+    mean_name = ln_op.outputs["Mean"][0]
+    apply_pass(main, "embedding_eltwise_layernorm_fuse",
+               fetch_names=[out.name, mean_name])
+    assert "fused_embedding_eltwise_layernorm" not in _types(main)
