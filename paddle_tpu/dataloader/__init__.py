@@ -1,3 +1,5 @@
 from .reader import DataLoader                      # noqa: F401
 from .dataset import Dataset, IterableDataset       # noqa: F401
 from .batch_sampler import BatchSampler, RandomSampler, SequenceSampler  # noqa: F401
+from .bucketing import (bucket_by_length, bucket_length,  # noqa: F401
+                        DEFAULT_LADDER)

@@ -60,12 +60,9 @@ class VarBase:
         return self._grad
 
     def backward(self, retain_graph=False):
-        if getattr(self, "_static_output", False):
-            raise RuntimeError(
-                "this VarBase came out of a @to_static/@declarative "
-                "forward, which compiles inference only — use "
-                "paddle_tpu.jit.train_step for a compiled training step, "
-                "or call the undecorated forward for eager autograd")
+        # @declarative outputs are ordinary tape outputs since r5 (the
+        # whole compiled step is one tape node with the step's vjp), so
+        # backward() works uniformly on eager and compiled forwards
         tracer().run_backward(self, retain_graph=retain_graph)
 
     def gradient(self):

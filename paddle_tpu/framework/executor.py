@@ -418,16 +418,36 @@ class _FieldDumper:
             return
         arrays = [np.asarray(_fetch_numpy(v)) for v in field_vals]
         if arrays:
-            batch = arrays[0].shape[0] if arrays[0].ndim >= 1 else 1
-            for i in range(batch):
-                parts = [str(self._lineid)]
-                for name, a in zip(self.field_names, arrays):
-                    if a.ndim != 2 or a.shape[0] != batch:
-                        continue     # CheckValidOutput: 2-D batch vars only
-                    row = a[i].ravel().tolist()
-                    parts.append(f"{name}:{len(row)}:{self._fmt(row)}")
-                self._f.write("\t".join(parts) + "\n")
-                self._lineid += 1
+            # derive the batch from the first field that PASSES the 2-D
+            # check (a scalar loss listed first must not set batch=1 and
+            # silently skip every valid field — advisor r4; the
+            # reference's CheckValidOutput enforces instead of dropping)
+            batch = next((a.shape[0] for a in arrays if a.ndim == 2), None)
+            if batch is None:
+                import warnings
+                warnings.warn(
+                    f"dump_fields {self.field_names}: no 2-D [batch, D] "
+                    f"field (shapes "
+                    f"{[tuple(a.shape) for a in arrays]}); nothing dumped "
+                    f"(ref device_worker.cc CheckValidOutput)",
+                    stacklevel=2)
+            else:
+                skipped = [n for n, a in zip(self.field_names, arrays)
+                           if a.ndim != 2 or a.shape[0] != batch]
+                if skipped:
+                    import warnings
+                    warnings.warn(
+                        f"dump_fields: skipping non-[batch, D] fields "
+                        f"{skipped} (ref CheckValidOutput)", stacklevel=2)
+                for i in range(batch):
+                    parts = [str(self._lineid)]
+                    for name, a in zip(self.field_names, arrays):
+                        if a.ndim != 2 or a.shape[0] != batch:
+                            continue  # CheckValidOutput: 2-D batch vars
+                        row = a[i].ravel().tolist()
+                        parts.append(f"{name}:{len(row)}:{self._fmt(row)}")
+                    self._f.write("\t".join(parts) + "\n")
+                    self._lineid += 1
         for name in self.param_names:
             v = self.scope.find_var(name)
             if v is None:
@@ -910,7 +930,13 @@ class Executor:
                 if v is not None:
                     da = getattr(v, "dist_attr", None)
                     if da:
-                        return P(*da)
+                        # axes absent from THIS mesh replicate: a program
+                        # annotated for tp may run on an sp/dp-only mesh
+                        # (the collectives degrade to identity the same
+                        # way), so dangling axis names must not leak into
+                        # shard_map specs
+                        return P(*(a if a in axis_names else None
+                                   for a in da))
                     return P()
             return P()
 

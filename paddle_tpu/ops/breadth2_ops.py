@@ -138,8 +138,9 @@ def _hash(ctx, ins, attrs):
     a = x(ins, "X")
     num_hash = int(attrs.get("num_hash", 1))
     mod_by = int(attrs.get("mod_by", 1))
-    outs = [xxh64_mod(a, i, mod_by).astype(jnp.int64)
-            for i in range(num_hash)]
+    # int32 buckets: the value is < mod_by (< 2^31) and with x64 disabled
+    # an int64 astype would be demoted (with a warning) anyway
+    outs = [xxh64_mod(a, i, mod_by) for i in range(num_hash)]
     out = jnp.stack(outs, axis=-1)             # [..., num_hash]
     return {"Out": out[..., None]}             # [..., num_hash, 1]
 

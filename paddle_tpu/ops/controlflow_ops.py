@@ -42,6 +42,30 @@ def _scalar_bool(v):
     return jnp.reshape(v, ()).astype(bool)
 
 
+def masked_while_scan(cond_fn, body_fn, init, length=None, xs=None):
+    """Bounded while as a masked ``lax.scan`` — the shared
+    reverse-differentiable lowering behind the functional ``while_loop``
+    (maximum_trip_count), the legacy ``While(max_iters=)`` class, and the
+    dygraph→static converter's bounded loops.
+
+    ``cond_fn(vals, x) -> bool``; ``body_fn(vals, x) -> (new_vals, ys)``
+    (ys may be None).  Runs ``length`` (or ``len(xs)``) iterations; once
+    the predicate goes false the carry freezes (latched ``done`` flag).
+    Returns ``(final_vals, stacked_ys)``."""
+    def scan_fn(carry, x):
+        vals, done = carry
+        pred = jnp.logical_and(cond_fn(vals, x), ~done)
+        new_vals, ys = body_fn(vals, x)
+        sel = tuple(jnp.where(pred, nv, v)
+                    for nv, v in zip(new_vals, vals))
+        return (sel, ~pred), ys
+
+    (out, _), stacked = jax.lax.scan(
+        scan_fn, (tuple(init), jnp.asarray(False)), xs,
+        length=None if xs is not None else int(length))
+    return out, stacked
+
+
 @register("while_loop")
 def _while_loop_op(ctx, ins, attrs):
     xs = list(ins.get("X") or [])
@@ -97,17 +121,9 @@ def _while_loop_op(ctx, ins, attrs):
     # carry once the predicate goes false; reverse-differentiable.  Per-step
     # `collect_names` values are stacked into [max_trip, ...] outputs (the
     # scan ys — dynamic_decode's token accumulator rides this).
-    def scan_fn(carry, key):
-        vals, done = carry
-        pred = jnp.logical_and(eval_cond(vals, key), ~done)
-        new_vals, collected = eval_body(vals, key)
-        sel = tuple(jnp.where(pred, nv, v)
-                    for nv, v in zip(new_vals, vals))
-        return (sel, ~pred), collected
-
     keys = jax.random.split(ctx.next_key(), int(max_trip))
-    (out_vals, _), stacked = jax.lax.scan(
-        scan_fn, (init, jnp.asarray(False)), keys)
+    out_vals, stacked = masked_while_scan(eval_cond, eval_body, init,
+                                          xs=keys)
     out = {"Out": list(out_vals)}
     if collect_names:
         out["Collected"] = list(stacked)

@@ -24,17 +24,44 @@ from .controlflow_ops import _run_block, _sub_ctx, _scalar_bool
 @register("legacy_while")
 def _legacy_while(ctx, ins, attrs):
     """ref: operators/controlflow/while_op.cc — run the body block while
-    the cond var (updated INSIDE the body) is true.  Dynamic trip count ↦
-    lax.while_loop (forward-only, as the reference's While without
-    while_grad)."""
+    the cond var (updated INSIDE the body) is true.
+
+    Two lowerings, by trip-count knowledge (the reference trains through
+    While via its registered while_grad, while_op.cc WhileGradOp; XLA has
+    no adjoint for a dynamic-trip while_loop, so the trainable path needs
+    a declared bound):
+
+    * ``max_iters`` declared → masked ``lax.scan`` over max_iters steps
+      (carry freezes once cond goes false) — reverse-differentiable, so
+      ``append_backward`` trains through the loop.
+    * no bound → ``lax.while_loop`` (dynamic trip count, forward-only).
+    """
     carried = list(ins.get("X") or [])
     closure = list(ins.get("Closure") or [])
     carried_names = list(attrs["carried_names"])
     closure_names = list(attrs["closure_names"])
     block = attrs["body_block"]
     cond_name = attrs["cond_name"]
+    max_iters = attrs.get("max_iters")
     cond_idx = carried_names.index(cond_name)
     base_env = dict(zip(closure_names, closure))
+
+    def run_body(vals, key):
+        env = dict(base_env)
+        env.update(zip(carried_names, vals))
+        env = _run_block(block, env, _sub_ctx(ctx, key))
+        return tuple(env[n] for n in carried_names)
+
+    if max_iters is not None:
+        # bounded → masked scan (differentiable); shared lowering with
+        # the functional while_loop's maximum_trip_count path
+        from .controlflow_ops import masked_while_scan
+        keys = jax.random.split(ctx.next_key(), int(max_iters))
+        out_vals, _ = masked_while_scan(
+            lambda vals, _k: _scalar_bool(vals[cond_idx]),
+            lambda vals, k: (run_body(vals, k), None),
+            carried, xs=keys)
+        return {"Out": list(out_vals)}
 
     def cond_fn(carry):
         vals, _key = carry
@@ -43,10 +70,7 @@ def _legacy_while(ctx, ins, attrs):
     def body_fn(carry):
         vals, key = carry
         k_step, k_next = jax.random.split(key)
-        env = dict(base_env)
-        env.update(zip(carried_names, vals))
-        env = _run_block(block, env, _sub_ctx(ctx, k_step))
-        return tuple(env[n] for n in carried_names), k_next
+        return run_body(vals, k_step), k_next
 
     out_vals, _ = jax.lax.while_loop(cond_fn, body_fn,
                                      (tuple(carried), ctx.next_key()))

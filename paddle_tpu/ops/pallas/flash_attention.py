@@ -411,7 +411,9 @@ def supported(shape_bhsd, k_seq=None, backend=None):
     if d % 128 and d != 64:
         # lane dim must tile; 64 still packs efficiently as (8, 128)
         return False
-    backend = backend or jax.default_backend()
+    if backend is None:
+        from . import effective_backend
+        backend = effective_backend()
     return backend in _TPU_BACKENDS
 
 

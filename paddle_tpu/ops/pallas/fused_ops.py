@@ -36,10 +36,8 @@ BLOCK_R = 128          # row-block for [R, D] layouts
 
 
 def _on_tpu() -> bool:
-    try:
-        return jax.default_backend() not in ("cpu", "gpu")
-    except Exception:
-        return False
+    from . import effective_backend
+    return effective_backend() not in ("cpu", "gpu")
 
 
 def _row_mask(i, r_total, block_rows):

@@ -24,9 +24,12 @@ def _reverse(ctx, ins, attrs):
 
 @register("size")
 def _size(ctx, ins, attrs):
-    """ref: operators/size_op.cc — element count as int64 scalar."""
+    """ref: operators/size_op.cc — element count as a 1-element int64
+    tensor (the reference emits shape [1], not a 0-d scalar; downstream
+    concat/reshape of the declared [1] output needs the rank — advisor
+    r4)."""
     a = x(ins, "Input")
-    return {"Out": jnp.asarray(a.size, jnp.int64)}
+    return {"Out": jnp.full((1,), a.size, jnp.int64)}
 
 
 @register("fc")
@@ -261,7 +264,10 @@ def _fake_q_range_abs_max(ctx, ins, attrs):
     if scales is None:
         scales = jnp.zeros((window,), jnp.float32)
     if it is None:
-        it = jnp.zeros((1,), jnp.int64)
+        # int32 deliberately: with x64 disabled an int64 request would be
+        # silently demoted anyway (and warn on every trace); the window
+        # counter only feeds `% window`, safe until 2^31 steps
+        it = jnp.zeros((1,), jnp.int32)
     pos = (it.reshape(()) % window).astype(jnp.int32)
     scales = scales.at[pos].set(cur)
     scale = jnp.max(scales)

@@ -25,20 +25,27 @@ def _rotl(x, r):
     return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
 
 
+def _u32(x) -> np.uint32:
+    """Wrap Python-int arithmetic into uint32 without tripping numpy's
+    scalar-overflow RuntimeWarning (seed mixes like seed+P1+P2 wrap by
+    design)."""
+    return np.uint32(int(x) & 0xFFFFFFFF)
+
+
 def xxh32_words(words, seed):
     """XXH32 of ``words`` ([..., n] interpreted as n little-endian 4-byte
     lanes, i.e. the byte string of n int32 values) with ``seed``.
     ``n`` must be static; returns uint32 [...]."""
     words = words.astype(jnp.uint32)
     n = words.shape[-1]
-    seed = np.uint32(seed)
+    seed = int(seed)
     i = 0
     if n >= 4:
-        v1 = jnp.broadcast_to(jnp.uint32(seed + _P1 + _P2),
+        v1 = jnp.broadcast_to(_u32(seed + int(_P1) + int(_P2)),
                               words.shape[:-1])
-        v2 = jnp.broadcast_to(jnp.uint32(seed + _P2), words.shape[:-1])
-        v3 = jnp.broadcast_to(jnp.uint32(seed), words.shape[:-1])
-        v4 = jnp.broadcast_to(jnp.uint32(seed - _P1), words.shape[:-1])
+        v2 = jnp.broadcast_to(_u32(seed + int(_P2)), words.shape[:-1])
+        v3 = jnp.broadcast_to(_u32(seed), words.shape[:-1])
+        v4 = jnp.broadcast_to(_u32(seed - int(_P1)), words.shape[:-1])
         while i + 4 <= n:
             v1 = _rotl(v1 + words[..., i] * _P2, 13) * _P1
             v2 = _rotl(v2 + words[..., i + 1] * _P2, 13) * _P1
@@ -47,7 +54,7 @@ def xxh32_words(words, seed):
             i += 4
         h = _rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12) + _rotl(v4, 18)
     else:
-        h = jnp.broadcast_to(jnp.uint32(seed + _P5), words.shape[:-1])
+        h = jnp.broadcast_to(_u32(seed + int(_P5)), words.shape[:-1])
     h = h + jnp.uint32(4 * n)
     while i < n:
         h = _rotl(h + words[..., i] * _P3, 17) * _P4
@@ -73,7 +80,11 @@ def xxh64_int64_rows(vals, seed):
     one little-endian 8-byte lane (sign-extended, as int64 storage is).
     Runs in true 64-bit inside a local x64 scope; returns the digest as
     (hi, lo) uint32 pairs so the result survives leaving the scope.
-    """
+
+    Bitwise-parity scope: ids must fit int32.  With jax x64 disabled the
+    device feed path stores int64 ids as int32, so ids >= 2^31 reach this
+    function already truncated and bucket differently from the reference
+    (MIGRATION.md "Known gaps" scopes the compat claim accordingly)."""
     import jax
 
     with jax.enable_x64(True):

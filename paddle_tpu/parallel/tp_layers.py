@@ -161,9 +161,13 @@ def parallel_multihead_attention(x: Variable, hidden: int, num_heads: int,
         inputs["AttnBias"] = [attn_mask]
     if kv_mask is not None:
         inputs["KVMask"] = [kv_mask]
+    # n_head is the GLOBAL head count + head_dim: the op derives the
+    # LOCAL head count from the traced q width (hidden/tp on the mesh,
+    # full hidden off-mesh where collectives are identity) — baking
+    # local_heads in would mis-shape the off-mesh fallback (r5 parity)
     helper.append_op(
         type="fused_attention", inputs=inputs, outputs={"Out": [out]},
-        attrs={"n_head": local_heads, "dropout_rate": dropout,
-               "_seq_axis": seq_axis})
+        attrs={"n_head": num_heads, "head_dim": head_dim,
+               "dropout_rate": dropout, "_seq_axis": seq_axis})
     return row_parallel_fc(out, hidden, tp_degree, axis_name,
                            name=nm + "_proj")

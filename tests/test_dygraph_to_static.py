@@ -69,17 +69,37 @@ def test_concrete_condition_still_python():
     np.testing.assert_allclose(np.asarray(dn.value), [0.0])
 
 
-def test_unsupported_falls_back_to_trace():
+def test_closure_converts_with_fresh_cells():
+    # closures convert since r5 (free variables re-read per call — the
+    # common `def fwd(x): return m(x)` dygraph shape)
     free = 3.0
 
     def h(x):
-        if x.value.sum() > 0:      # closure over `free` → unsupported
+        if x.value.sum() > 0:
             y = x * free
         else:
             y = x
         return y
 
-    assert convert_function(h) is None   # silent trace-based fallback
+    conv = convert_function(h)
+    assert conv is not None and conv.__pt_converted__
+    with fluid.dygraph.guard():
+        up = conv(_eager([2.0]))
+        dn = conv(_eager([-2.0]))
+    np.testing.assert_allclose(np.asarray(up.value), [6.0])
+    np.testing.assert_allclose(np.asarray(dn.value), [-2.0])
+    free = 5.0   # rebinding the local does NOT rebind the cell — but a
+    # mutated cell value would be re-read; this line documents the scope
+
+
+def test_unsupported_falls_back_to_trace():
+    def h(x):
+        if x.value.sum() > 0:      # return inside if → unsupported
+            return x * 2.0
+        return x
+
+    with pytest.warns(UserWarning, match="TRACE-based"):
+        assert convert_function(h) is None
 
 
 def test_nested_if_in_while():
@@ -100,3 +120,108 @@ def test_nested_if_in_while():
     # i=0: s=0→2 (else); i=1: s=2→... s.sum()=2 not >2 → s=4;
     # i=2: s.sum()=4>2 → s=8
     np.testing.assert_allclose(np.asarray(out.value), [8.0])
+
+
+# ---------------------------------------------------------------------------
+# TRAINING through converted control flow (VERDICT r4 ask #4)
+# ---------------------------------------------------------------------------
+
+
+def test_declarative_branch_trains_matching_static():
+    """A dygraph function with a data-dependent branch TRAINS under
+    @declarative, and its per-step losses match the handwritten static
+    program (layers.cond + minimize) — the reference ProgramTranslator
+    contract (program_translator.py + append_backward)."""
+    from paddle_tpu.dygraph import Linear
+    from paddle_tpu.optimizer import SGDOptimizer
+    from paddle_tpu.framework.initializer import ConstantInitializer
+    from paddle_tpu.framework.layer_helper import ParamAttr
+
+    rng = np.random.RandomState(7)
+    batches = [rng.randn(4, 2).astype(np.float32) * (1 if i % 2 else -1)
+               for i in range(6)]
+    targets = [rng.randn(4, 1).astype(np.float32) for _ in range(6)]
+    lr = 0.05
+
+    # -- dygraph @declarative --------------------------------------------
+    class M(fluid.dygraph.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = Linear(
+                2, 1, param_attr=ParamAttr(
+                    initializer=ConstantInitializer(0.5)),
+                bias_attr=False)
+
+        @ptjit.declarative
+        def forward(self, x):
+            y = self.lin(x)
+            if x.value.sum() > 0:
+                out = y * 2.0
+            else:
+                out = 0.0 - y
+            return out
+
+    dyg_losses = []
+    with fluid.dygraph.guard():
+        m = M()
+        opt = SGDOptimizer(learning_rate=lr,
+                           parameter_list=m.parameters())
+        for xb, tb in zip(batches, targets):
+            out = m(VarBase(xb))
+            loss = ((out - VarBase(tb)) ** 2).mean()
+            loss.backward()
+            opt.minimize(loss)
+            m.clear_gradients()
+            dyg_losses.append(float(np.asarray(loss.value)))
+
+    # -- handwritten static program --------------------------------------
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4, 2], append_batch_size=False)
+        t = fluid.layers.data("t", shape=[4, 1], append_batch_size=False)
+        w = fluid.layers.create_parameter(
+            [2, 1], "float32", name="w_cond_static",
+            default_initializer=ConstantInitializer(0.5))
+        y = fluid.layers.matmul(x, w)
+        pred = fluid.layers.greater_than(
+            fluid.layers.reduce_sum(x),
+            fluid.layers.fill_constant([], "float32", 0.0))
+        out = fluid.layers.cond(pred, lambda: y * 2.0, lambda: 0.0 - y)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(out - t))
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        static_losses = [
+            float(exe.run(main, feed={"x": xb, "t": tb},
+                          fetch_list=[loss])[0])
+            for xb, tb in zip(batches, targets)]
+
+    np.testing.assert_allclose(dyg_losses, static_losses, rtol=1e-5)
+    assert dyg_losses[-1] < dyg_losses[0]   # and it actually learned
+
+
+def test_declarative_bounded_while_trains():
+    """@declarative(max_loop_iters=N): a data-dependent while lowers to
+    the masked scan and gradients flow through it (while_grad analog)."""
+    @ptjit.declarative(max_loop_iters=8)
+    def f(w, x):
+        acc = x * 0.0
+        i = x * 0.0                  # traced counter (VarBase)
+        while (i.sum() < 3.0).value:
+            acc = acc + w * x
+            i = i + 1.0
+        return acc
+
+    with fluid.dygraph.guard():
+        w = VarBase(np.full((1,), 0.1, np.float32), stop_gradient=False)
+        x = VarBase(np.ones((1,), np.float32))
+        losses = []
+        for _ in range(40):
+            acc = f(w, x)            # 3 * w * x
+            loss = ((acc - 6.0) ** 2).mean()
+            loss.backward()
+            w.value = w.value - 0.05 * w.gradient_value
+            w._grad = None
+            losses.append(float(np.asarray(loss.value)))
+    assert losses[-1] < 0.05 * losses[0], losses[::10]

@@ -177,3 +177,69 @@ def test_assert_inside_training_program():
     l, = exe.run(feed={"x": np.ones((4, 3), np.float32)},
                  fetch_list=[loss])
     assert np.isfinite(l).all()
+
+
+def test_while_max_iters_trains():
+    """VERDICT r4 ask #5: a While loop with a declared trip bound lowers
+    to the differentiable masked scan, so append_backward (via
+    optimizer.minimize) trains THROUGH the loop — the reference's
+    while_grad contract (ref: operators/controlflow/while_op.cc
+    WhileGradOp)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[1], dtype="float32",
+                              append_batch_size=False)
+        w = fluid.layers.create_parameter(
+            [1], "float32", name="w_while_train",
+            default_initializer=fluid.initializer.ConstantInitializer(0.1))
+        i = fluid.layers.fill_constant(shape=[1], dtype='int64', value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype='int64', value=3)
+        acc = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                         value=0.0)
+        cond = fluid.layers.less_than(x=i, y=n)
+        loop = fluid.layers.While(cond=cond, max_iters=8)
+        with loop.block():
+            s = fluid.layers.elementwise_add(
+                x=acc, y=fluid.layers.elementwise_mul(x=w, y=x))
+            fluid.layers.assign(s, acc)
+            fluid.layers.increment(x=i, value=1, in_place=True)
+            fluid.layers.less_than(x=i, y=n, cond=cond)
+        target = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                            value=6.0)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square(acc - target))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.ones((1,), np.float32)}
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+              for _ in range(40)]
+    # acc = 3*w*x, so w should head toward 2.0 and the loss toward 0
+    assert losses[-1] < 0.05 * losses[0], losses[::10]
+
+
+def test_unbounded_while_grad_raises():
+    """Without max_iters the lowering is lax.while_loop — forward-only;
+    a gradient request must fail loudly, not silently skip the loop."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = fluid.layers.create_parameter(
+            [1], "float32", name="w_while_nograd",
+            default_initializer=fluid.initializer.ConstantInitializer(0.1))
+        i = fluid.layers.fill_constant(shape=[1], dtype='int64', value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype='int64', value=3)
+        acc = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                         value=0.0)
+        cond = fluid.layers.less_than(x=i, y=n)
+        loop = fluid.layers.While(cond=cond)
+        with loop.block():
+            s = fluid.layers.elementwise_add(x=acc, y=w)
+            fluid.layers.assign(s, acc)
+            fluid.layers.increment(x=i, value=1, in_place=True)
+            fluid.layers.less_than(x=i, y=n, cond=cond)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(acc))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with pytest.raises(Exception, match="(?i)while|differenti"):
+        exe.run(main, fetch_list=[loss])

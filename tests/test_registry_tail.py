@@ -19,7 +19,8 @@ def test_reverse_and_size():
     np.testing.assert_allclose(np.asarray(out["Out"]),
                                [[2, 1, 0], [5, 4, 3]])
     s = get_op("size")(ctx(), {"Input": [a]}, {})
-    assert int(s["Out"]) == 6
+    assert s["Out"].shape == (1,)      # reference size_op emits [1]
+    assert int(s["Out"][0]) == 6
 
 
 def test_fc_op_matches_matmul():

@@ -1,0 +1,116 @@
+"""Tunnel-independent perf verification (VERDICT r4 ask #1).
+
+Cross-lowers the bench-shape BERT training step for ``platforms=("tpu",)``
+on the CPU host via jax.export and asserts, from the StableHLO text alone:
+
+  * the Pallas flash-attention kernels (fwd + both bwd) are present as
+    ``tpu_custom_call``s,
+  * the fused LayerNorm and Adam Pallas kernels are present,
+  * every state buffer is donated (``tf.aliasing_output``),
+  * the step is ONE executable and same-shape fresh batches do not
+    recompile.
+
+This proves the perf-critical kernels and donation really reach the
+compiled TPU program even when no TPU is reachable (the tunnel was down
+for rounds 1-4; see BENCH_r0*.json).
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.export import lower_train_step_for_tpu
+from paddle_tpu.models import bert
+
+
+def _build_pretrain(cfg):
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        feeds, total, mlm, nsp = bert.build_pretrain_network(cfg)
+        from paddle_tpu.contrib.mixed_precision import decorate
+        opt = decorate(fluid.optimizer.Adam(1e-4), use_pure_bf16=True)
+        opt.minimize(total)
+    return main_prog, startup, total
+
+
+@pytest.fixture(scope="module")
+def lowered_bench_step():
+    """The exact bench.py model/optimizer config, cross-lowered for TPU.
+
+    Bench shapes (batch 96, seq 128) with a 2-layer config: layers share
+    shapes, so kernel presence/donation are identical to the 12-layer
+    module while tracing stays fast on the CPU CI host."""
+    cfg = bert.BertConfig.base()
+    cfg.num_hidden_layers = 2
+    main_prog, startup, total = _build_pretrain(cfg)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        data = bert.make_fake_batch(rng, cfg, batch_size=96, seq_len=128,
+                                    num_masks=20)
+        exported = lower_train_step_for_tpu(main_prog, data, [total],
+                                            scope=scope)
+    return exported
+
+
+def test_platform_is_tpu(lowered_bench_step):
+    assert tuple(lowered_bench_step.platforms) == ("tpu",)
+
+
+def test_pallas_kernels_present(lowered_bench_step):
+    txt = lowered_bench_step.mlir_module()
+    names = set(re.findall(r'kernel_name = "(\w+)"', txt))
+    assert txt.count("tpu_custom_call") > 0, "no Mosaic custom calls at all"
+    # flash attention: forward + both backward kernels
+    assert "_fwd_kernel" in names, f"flash fwd missing; found {names}"
+    assert "_bwd_dq_kernel" in names, f"flash bwd dq missing; found {names}"
+    assert "_bwd_dkv_kernel" in names, f"flash bwd dkv missing; found {names}"
+    # fused LayerNorm fwd+bwd
+    assert "_ln_fwd_kernel" in names, f"fused LN fwd missing; found {names}"
+    assert "_ln_bwd_kernel" in names, f"fused LN bwd missing; found {names}"
+    # fused Adam update
+    assert "_adam_kernel" in names, f"fused Adam missing; found {names}"
+
+
+def test_state_buffers_donated(lowered_bench_step):
+    txt = lowered_bench_step.mlir_module()
+    sig = re.search(r"func\.func public @main\((.*?)\)\s*->", txt,
+                    re.DOTALL).group(1)
+    donated = sig.count("tf.aliasing_output")
+    # state is arg 1 (a dict pytree); every leaf must be donated.  The
+    # signature flattens (feed, state, key): feed leaves + state leaves +
+    # key.  Count state leaves from the carry annotations.
+    state_args = len(re.findall(r'loc\("state', sig)) or None
+    if state_args is not None:
+        assert donated >= state_args, \
+            f"only {donated} of {state_args} state buffers donated"
+    # regardless of loc-name matching, a bf16 BERT step has hundreds of
+    # state buffers; all must alias
+    assert donated >= 50, f"donation annotations missing ({donated} found)"
+
+
+def test_single_executable_no_per_step_recompile():
+    """Fresh same-shape batches must hit the one cached executable — the
+    'no per-step recompile' leg of the perf invariant, at tiny shapes so
+    it executes on CPU."""
+    from paddle_tpu.monitor import stat
+    cfg = bert.BertConfig.tiny()
+    main_prog, startup, total = _build_pretrain(cfg)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        before = stat("executor_compile_count").get()
+        for _ in range(3):
+            data = bert.make_fake_batch(rng, cfg, batch_size=4, seq_len=64,
+                                        num_masks=3)
+            l, = exe.run(main_prog, feed=data, fetch_list=[total])
+            assert np.isfinite(l).all()
+        compiles = stat("executor_compile_count").get() - before
+    assert compiles == 1, f"expected 1 executable, got {compiles} compiles"

@@ -1,7 +1,8 @@
 #!/bin/bash
-# TPU-window watcher: probe the flaky axon tunnel; the moment it responds,
-# run the round-4 measurement battery (perf decomposition -> bench -> smoke)
-# under an exclusive lock (concurrent chip access wedges the tunnel).
+# TPU-window watcher (round 5): probe the flaky axon tunnel; the moment it
+# responds, run the measurement battery (perf decomposition -> bench
+# [cached+streamed] -> kernel A/B -> resnet -> transformer -> smoke) under
+# an exclusive lock (concurrent chip access wedges the tunnel).
 # Artifacts land in /root/repo with per-attempt logs in /tmp/tpu_watch/.
 cd /root/repo
 mkdir -p /tmp/tpu_watch
@@ -15,16 +16,22 @@ while true; do
     flock /tmp/tpu.lock -c '
       set -x
       PYTHONPATH=/root/repo:$PYTHONPATH timeout -k 30 1800 python tools/perf_probe.py 20 2>&1 | tee /tmp/tpu_watch/perf_probe.txt
-      timeout -k 30 1200 python bench.py 2>&1 | tee /tmp/tpu_watch/bench.txt
+      timeout -k 30 1800 python bench.py 2>&1 | tee /tmp/tpu_watch/bench.txt
       PYTHONPATH=/root/repo:$PYTHONPATH timeout -k 30 2400 python tools/kernel_ab.py 20 2>&1 | tee /tmp/tpu_watch/kernel_ab.txt
+      PYTHONPATH=/root/repo:$PYTHONPATH timeout -k 30 1500 python tools/resnet_bench.py 2>&1 | tee /tmp/tpu_watch/resnet.txt
+      PYTHONPATH=/root/repo:$PYTHONPATH timeout -k 30 1500 python tools/transformer_bench.py 2>&1 | tee /tmp/tpu_watch/transformer.txt
+      PYTHONPATH=/root/repo:$PYTHONPATH timeout -k 30 1500 python tools/serve_demo.py 2>&1 | tee /tmp/tpu_watch/serve.txt
       PYTHONPATH=/root/repo:$PYTHONPATH timeout -k 30 1800 python tools/tpu_smoke.py 2>&1 | tee /tmp/tpu_watch/smoke.txt
-    ' 2>&1 | tail -120 >> /tmp/tpu_watch/log
+    ' 2>&1 | tail -160 >> /tmp/tpu_watch/log
     # keep only artifacts that actually contain measurements
-    grep -q "t_pure" /tmp/tpu_watch/perf_probe.txt && cp /tmp/tpu_watch/perf_probe.txt PERF_PROBE_r04.txt
-    grep -q '"value": 0.0' /tmp/tpu_watch/bench.txt || { grep -q '"metric"' /tmp/tpu_watch/bench.txt && grep '"metric"' /tmp/tpu_watch/bench.txt | tail -1 > BENCH_MEASURED_r04.json; }
-    grep -q "samples_per_sec" /tmp/tpu_watch/kernel_ab.txt && cp /tmp/tpu_watch/kernel_ab.txt KERNEL_AB_r04.txt
-    grep -q "OK" /tmp/tpu_watch/smoke.txt && cp /tmp/tpu_watch/smoke.txt TPU_SMOKE_r04.txt
-    echo "[$ts] battery done (artifacts: $(ls PERF_PROBE_r04.txt BENCH_MEASURED_r04.json TPU_SMOKE_r04.txt 2>/dev/null | tr '\n' ' '))" >> /tmp/tpu_watch/log
+    grep -q "t_pure" /tmp/tpu_watch/perf_probe.txt && cp /tmp/tpu_watch/perf_probe.txt PERF_PROBE_r05.txt
+    grep -q '"value": 0.0' /tmp/tpu_watch/bench.txt || { grep -q '"metric"' /tmp/tpu_watch/bench.txt && grep '"metric"' /tmp/tpu_watch/bench.txt | tail -1 > BENCH_MEASURED_r05.json; }
+    grep -q "samples_per_sec" /tmp/tpu_watch/kernel_ab.txt && cp /tmp/tpu_watch/kernel_ab.txt KERNEL_AB_r05.txt
+    grep -q '"metric"' /tmp/tpu_watch/resnet.txt && grep '"metric"' /tmp/tpu_watch/resnet.txt | tail -1 > RESNET_BENCH_r05.json
+    grep -q '"metric"' /tmp/tpu_watch/transformer.txt && grep '"metric"' /tmp/tpu_watch/transformer.txt | tail -1 > TRANSFORMER_BENCH_r05.json
+    grep -q "SERVE_DEMO_OK" /tmp/tpu_watch/serve.txt && cp /tmp/tpu_watch/serve.txt PJRT_SERVE_r05.txt
+    grep -q "OK" /tmp/tpu_watch/smoke.txt && cp /tmp/tpu_watch/smoke.txt TPU_SMOKE_r05.txt
+    echo "[$ts] battery done (artifacts: $(ls PERF_PROBE_r05.txt BENCH_MEASURED_r05.json KERNEL_AB_r05.txt RESNET_BENCH_r05.json TRANSFORMER_BENCH_r05.json TPU_SMOKE_r05.txt 2>/dev/null | tr '\n' ' '))" >> /tmp/tpu_watch/log
   else
     echo "[$ts] attempt $N: tunnel down" >> /tmp/tpu_watch/log
   fi

@@ -1,0 +1,170 @@
+"""Transformer-big (BASELINE config 4, WMT14 En-De shapes) and
+ERNIE-finetune (BASELINE config 5) training throughput — the two
+BASELINE rows that never had a bench harness before round 5.
+
+Feeds are RAGGED (synthetic Zipf-ish length distribution matching WMT14's
+~25-token mean) and run through the bucketing ladder, so the measured
+number includes the real bucketed-compilation story (one executable per
+ladder step, SURVEY hard part #3) rather than best-case max-padding.
+
+Usage:
+  python tools/transformer_bench.py              # real chip, both models
+  TB_VIRTUAL=1 TB_TINY=1 python tools/transformer_bench.py  # CPU smoke
+Prints one JSON line per model.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _ragged_pairs(rng, n, mean_len, max_len, vocab):
+    """Synthetic ragged corpus: lognormal lengths (WMT14-ish tail)."""
+    out = []
+    for _ in range(n):
+        ls = int(np.clip(rng.lognormal(np.log(mean_len), 0.45), 2, max_len))
+        lt = int(np.clip(rng.lognormal(np.log(mean_len), 0.45), 2, max_len))
+        out.append((list(rng.randint(3, vocab - 1, ls)),
+                    list(rng.randint(3, vocab - 1, lt))))
+    return out
+
+
+def bench_transformer(virtual):
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import transformer
+    from paddle_tpu.dataloader import bucket_by_length
+    from paddle_tpu.framework.core import reset_default_programs
+    from paddle_tpu.framework.executor import global_scope
+
+    reset_default_programs()
+    global_scope().drop_all()
+    tiny = bool(os.environ.get("TB_TINY"))
+    cfg = transformer.TransformerConfig.tiny() if tiny \
+        else transformer.TransformerConfig.big()
+    ladder = (8, 16) if tiny else (64, 128, 256)
+    batch = int(os.environ.get("TB_BATCH", 4 if tiny else 64))
+    n_batches = int(os.environ.get("TB_BATCHES", 4 if tiny else 24))
+    mean_len = 6 if tiny else 25
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feeds, loss, logits = transformer.build_train_network(cfg)
+        from paddle_tpu.contrib.mixed_precision import decorate
+        opt = fluid.optimizer.Adam(1e-4)
+        if not virtual:
+            opt = decorate(opt, use_pure_bf16=True)
+        opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace() if virtual else fluid.TPUPlace(0))
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    pairs = _ragged_pairs(rng, batch * n_batches, mean_len,
+                          cfg.max_length, min(cfg.src_vocab_size, 30000))
+    batches = []
+    for b_len, group in bucket_by_length(
+            pairs, ladder=ladder, batch_size=batch,
+            len_fn=lambda p: max(len(p[0]), len(p[1]) + 1)):
+        src, trg = zip(*group)
+        batches.append(transformer.make_batch(list(src), list(trg), cfg,
+                                              bucket_ladder=ladder))
+    # warmup: compile every bucket executable once
+    seen = set()
+    for f in batches:
+        s = f["src_ids"].shape
+        if s not in seen:
+            seen.add(s)
+            l, = exe.run(main, feed=f, fetch_list=[loss])
+            assert np.isfinite(l).all()
+    tokens = sum(float(f["trg_mask"].sum()) for f in batches)
+    t0 = time.perf_counter()
+    for f in batches:
+        l, = exe.run(main, feed=f, fetch_list=[loss], return_numpy=False)
+    l_host = np.asarray(l)
+    jax.block_until_ready(list(fluid.global_scope().vars.values()))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(l_host).all()
+    print(json.dumps({
+        "metric": "transformer_big_wmt14_tokens_per_sec"
+                  + ("_virtual" if virtual else "_per_chip"),
+        "value": round(tokens / dt, 2),
+        "unit": "target_tokens/s",
+        "buckets_compiled": len(seen),
+        "batches": len(batches),
+        "ragged": True,
+    }))
+
+
+def bench_ernie(virtual):
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import ernie
+    from paddle_tpu.framework.core import reset_default_programs
+    from paddle_tpu.framework.executor import global_scope
+
+    reset_default_programs()
+    global_scope().drop_all()
+    tiny = bool(os.environ.get("TB_TINY"))
+    cfg = ernie.ErnieConfig.tiny() if tiny else ernie.ErnieConfig.base()
+    batch = int(os.environ.get("EB_BATCH", 4 if tiny else 32))
+    seq = int(os.environ.get("EB_SEQ", 16 if tiny else 128))
+    steps = int(os.environ.get("EB_STEPS", 3 if tiny else 20))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feeds, loss, probs, acc = ernie.build_classification_network(
+            cfg, num_labels=2)
+        from paddle_tpu.contrib.mixed_precision import decorate
+        opt = fluid.optimizer.Adam(2e-5)
+        if not virtual:
+            opt = decorate(opt, use_pure_bf16=True)
+        opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace() if virtual else fluid.TPUPlace(0))
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {
+        "src_ids": rng.randint(0, cfg.vocab_size, (batch, seq)).astype(
+            np.int64),
+        "pos_ids": np.tile(np.arange(seq, dtype=np.int64), (batch, 1)),
+        "sent_ids": np.zeros((batch, seq), np.int64),
+        "task_ids": np.zeros((batch, seq), np.int64),
+        "input_mask": np.ones((batch, seq, 1), np.float32),
+        "label": rng.randint(0, 2, (batch, 1)).astype(np.int64),
+    }
+    l, = exe.run(main, feed=feed, fetch_list=[loss])     # compile
+    assert np.isfinite(l).all()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l, = exe.run(main, feed=feed, fetch_list=[loss],
+                     return_numpy=False)
+    l_host = np.asarray(l)
+    jax.block_until_ready(list(fluid.global_scope().vars.values()))
+    dt = (time.perf_counter() - t0) / steps
+    assert np.isfinite(l_host).all()
+    print(json.dumps({
+        "metric": "ernie_finetune_samples_per_sec"
+                  + ("_virtual" if virtual else "_per_chip"),
+        "value": round(batch / dt, 2),
+        "unit": "samples/s",
+        "ms_per_step": round(dt * 1e3, 2),
+    }))
+
+
+def main():
+    virtual = bool(os.environ.get("TB_VIRTUAL"))
+    if virtual:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    bench_transformer(virtual)
+    bench_ernie(virtual)
+
+
+if __name__ == "__main__":
+    main()

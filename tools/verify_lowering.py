@@ -1,0 +1,78 @@
+"""Tunnel-independent perf verification artifact (VERDICT r4 ask #1).
+
+Cross-lowers the EXACT bench.py configuration (BERT-base 12-layer, batch
+96, seq 128, pure-bf16 Adam) for platforms=("tpu",) on this CPU host and
+reports what is provably inside the compiled TPU program:
+
+  * every Pallas kernel custom_call, by kernel_name, with counts
+  * state-buffer donation coverage
+  * module size / executable count
+
+Usage: PYTHONPATH=/root/repo python tools/verify_lowering.py [out.txt]
+"""
+
+import re
+import sys
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.export import lower_train_step_for_tpu
+    from paddle_tpu.models import bert
+
+    cfg = bert.BertConfig.base()
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        feeds, total, mlm, nsp = bert.build_pretrain_network(cfg)
+        from paddle_tpu.contrib.mixed_precision import decorate
+        opt = decorate(fluid.optimizer.Adam(1e-4), use_pure_bf16=True)
+        opt.minimize(total)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        data = bert.make_fake_batch(rng, cfg, batch_size=96, seq_len=128,
+                                    num_masks=20)
+        exported = lower_train_step_for_tpu(main_prog, data, [total],
+                                            scope=scope)
+
+    txt = exported.mlir_module()
+    kernels = {}
+    for n in re.findall(r'kernel_name = "(\w+)"', txt):
+        kernels[n] = kernels.get(n, 0) + 1
+    sig = re.search(r"func\.func public @main\((.*?)\)\s*->", txt,
+                    re.DOTALL).group(1)
+    donated = sig.count("tf.aliasing_output")
+    n_args = sig.count("%arg")
+
+    lines = [
+        "TPU cross-lowering verification (bench.py config: BERT-base, "
+        "batch 96, seq 128, pure-bf16 Adam)",
+        f"platforms: {tuple(exported.platforms)}",
+        f"module bytes: {len(txt)}",
+        f"tpu_custom_call sites: {txt.count('tpu_custom_call')}",
+        "pallas kernels in compiled TPU program:",
+    ]
+    for n in sorted(kernels):
+        lines.append(f"  {n}: {kernels[n]}")
+    lines.append(f"main args: {n_args}, donated (tf.aliasing_output): "
+                 f"{donated}")
+    want = {"_fwd_kernel", "_bwd_dq_kernel", "_bwd_dkv_kernel",
+            "_ln_fwd_kernel", "_ln_bwd_kernel", "_adam_kernel"}
+    missing = want - set(kernels)
+    lines.append(f"required kernel set: "
+                 f"{'COMPLETE' if not missing else f'MISSING {missing}'}")
+    lines.append(f"donation: {'OK' if donated >= 50 else 'INSUFFICIENT'}")
+    out = "\n".join(lines)
+    print(out)
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            f.write(out + "\n")
+
+
+if __name__ == "__main__":
+    main()
