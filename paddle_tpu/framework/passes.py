@@ -40,7 +40,8 @@ class PassBuilder:
 
     #: default inference pipeline, mirroring the reference's
     #: GpuPassStrategy order: fusions first, folds, DCE last
-    INFERENCE_PASSES = ["embedding_eltwise_layernorm_fuse",
+    INFERENCE_PASSES = ["conv_bn_fuse", "conv_affine_channel_fuse",
+                        "embedding_eltwise_layernorm_fuse",
                         "fuse_elemwise_add_act", "fuse_bn_act",
                         "fuse_add_layernorm", "multihead_matmul_fuse",
                         "fc_fuse", "transpose_matmul_fold",
@@ -205,6 +206,131 @@ def fuse_bn_act(program: Program, fetch_names=(), **_):
             drop.add(j)
         block.ops[:] = [op for k, op in enumerate(block.ops)
                         if k not in drop]
+
+
+def _fold_conv_scale(program, block, op, scale, bias, out_name, scope,
+                     drop_outputs=()):
+    """Scale the conv's filter per OUT-channel in the scope and replace
+    the follower op with a channel bias add — shared folding step of
+    conv_bn_fuse / conv_affine_channel_fuse."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from . import unique_name
+    w_name = op.inputs["Filter"][0]
+    w = scope.find_var(w_name)
+    if w is None:
+        return False
+    w = np.asarray(w)
+    if w.ndim != 4 or w.shape[0] != scale.shape[0]:
+        return False                 # OIHW with O == C_out only
+    scope.set_var(w_name, jnp.asarray(
+        w * scale.reshape(-1, 1, 1, 1).astype(w.dtype)))
+    b_name = unique_name.generate(w_name + ".folded_bias")
+    block.create_var(name=b_name, shape=(bias.shape[0],),
+                     dtype=str(bias.dtype), persistable=True)
+    scope.set_var(b_name, jnp.asarray(bias))
+    return b_name
+
+
+def _conv_channel_fuse(program, fetch_names, scope, follower,
+                       get_factors):
+    """Shared driver: conv2d → <follower> ⇒ conv2d(folded W) +
+    elementwise_add(channel bias).  ``get_factors(op, scope)`` returns
+    (scale[C], bias[C]) or None."""
+    import numpy as np
+    if scope is None:
+        return                       # weight folding needs values
+    for block in program.blocks:
+        uses = _use_counts(block, keep_names=fetch_names)
+        for i, op in enumerate(block.ops):
+            if op.type not in ("conv2d", "depthwise_conv2d"):
+                continue
+            if op.attrs.get("data_format", "NCHW") not in ("NCHW",
+                                                           "AnyLayout"):
+                continue
+            hit = _single_use_chain(block, i, uses, (follower,))
+            if hit is None:
+                continue
+            j, fop = hit
+            conv_out = op.outputs["Output"][0]
+            if fop.inputs.get("X", [None])[0] != conv_out:
+                continue
+            # follower side outputs (saved mean/var) must be dead — but
+            # ignore the follower's own reads (batch_norm's MeanOut
+            # aliases its Mean input in place)
+            main_out = "Y" if "Y" in fop.outputs else "Out"
+            side = set(n for slot, ns in fop.outputs.items()
+                       if slot != main_out for n in ns)
+            consumed = any(
+                n in side for k, other in enumerate(block.ops)
+                if other is not fop for n in other.input_names()) or \
+                side & set(fetch_names)
+            if consumed:
+                continue
+            factors = get_factors(fop, scope)
+            if factors is None:
+                continue
+            scale, bias = factors
+            b_name = _fold_conv_scale(program, block, op, scale, bias,
+                                      conv_out, scope)
+            if not b_name:
+                continue
+            out_name = fop.outputs[main_out][0]
+            fop.type = "elementwise_add"
+            fop.inputs = {"X": [conv_out], "Y": [b_name]}
+            fop.outputs = {"Out": [out_name]}
+            fop.attrs = {"axis": 1}
+
+
+@register_pass("conv_bn_fuse")
+def conv_bn_fuse(program: Program, fetch_names=(), scope=None, **_):
+    """conv2d → batch_norm (inference form)  ⇒  conv2d with the BN
+    folded into the filter + one channel bias add (ref:
+    framework/ir/conv_bn_fuse_pass.cc).  This is a WEIGHT-folding pass —
+    XLA cannot do it because weights are runtime state, so it needs the
+    predictor's scope; silently skipped without one."""
+    import numpy as np
+
+    def factors(bn, scope):
+        if not (bn.attrs.get("is_test") or
+                bn.attrs.get("use_global_stats")):
+            return None
+        vals = []
+        for slot in ("Scale", "Bias", "Mean", "Variance"):
+            n = bn.inputs.get(slot, [None])[0]
+            v = scope.find_var(n) if n else None
+            if v is None:
+                return None
+            vals.append(np.asarray(v))
+        gamma, beta, mean, var = vals
+        eps = float(bn.attrs.get("epsilon", 1e-5))
+        factor = gamma / np.sqrt(var + eps)
+        return factor, beta - mean * factor
+
+    _conv_channel_fuse(program, fetch_names, scope, "batch_norm",
+                       factors)
+
+
+@register_pass("conv_affine_channel_fuse")
+def conv_affine_channel_fuse(program: Program, fetch_names=(),
+                             scope=None, **_):
+    """conv2d → affine_channel  ⇒  folded conv + channel bias add (ref:
+    framework/ir/conv_affine_channel_fuse_pass.cc)."""
+    import numpy as np
+
+    def factors(ac, scope):
+        vals = []
+        for slot in ("Scale", "Bias"):
+            n = ac.inputs.get(slot, [None])[0]
+            v = scope.find_var(n) if n else None
+            if v is None:
+                return None
+            vals.append(np.asarray(v))
+        return vals[0], vals[1]
+
+    _conv_channel_fuse(program, fetch_names, scope, "affine_channel",
+                       factors)
 
 
 @register_pass("fold_identity_ops")

@@ -106,8 +106,11 @@ class AnalysisPredictor:
                 params_filename=config.params_file)
         self._fetch_names = [v.name for v in fetch_vars]
         if config.ir_optim():
+            # scope enables the WEIGHT-folding passes (conv+bn folding
+            # rewrites filter values, not just the op list)
             program = config.pass_builder().apply(
-                program, fetch_names=self._fetch_names)
+                program, fetch_names=self._fetch_names,
+                scope=self._scope)
         self._program = program
         self._feed_names = list(feed_names)
         self._fetch_vars = [program.global_block().var(n)

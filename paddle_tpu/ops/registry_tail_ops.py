@@ -27,9 +27,12 @@ def _size(ctx, ins, attrs):
     """ref: operators/size_op.cc — element count as a 1-element int64
     tensor (the reference emits shape [1], not a 0-d scalar; downstream
     concat/reshape of the declared [1] output needs the rank — advisor
-    r4)."""
+    r4).  int64 only when x64 is live; a bare int64 request under the
+    default x64-off config is demoted anyway and warns on every call."""
+    import jax as _jax
     a = x(ins, "Input")
-    return {"Out": jnp.full((1,), a.size, jnp.int64)}
+    dt = jnp.int64 if _jax.config.jax_enable_x64 else jnp.int32
+    return {"Out": jnp.full((1,), a.size, dt)}
 
 
 @register("fc")

@@ -1,0 +1,107 @@
+"""Weight-folding fusion passes (ref: framework/ir/conv_bn_fuse_pass.cc,
+conv_affine_channel_fuse_pass.cc): conv2d followed by an inference-form
+batch_norm / affine_channel folds into the conv filter + one channel
+bias add — numerics must be identical and the normalisation op gone.
+These are the passes XLA cannot do itself (weights are runtime state)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.passes import apply_pass
+
+
+def _run(program, scope, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        out, = exe.run(program, feed=feed, fetch_list=[fetch])
+    return np.asarray(out)
+
+
+def _randomize(scope, names, rng):
+    import jax.numpy as jnp
+    for n in names:
+        v = scope.find_var(n)
+        if v is not None:
+            a = rng.rand(*np.asarray(v).shape).astype(np.float32) * 0.5 \
+                + 0.25
+            scope.set_var(n, jnp.asarray(a))
+
+
+def test_conv_bn_fuse_numerics_identical():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3, 8, 8], dtype="float32")
+        c = fluid.layers.conv2d(x, num_filters=4, filter_size=3,
+                                padding=1, bias_attr=False)
+        y = fluid.layers.batch_norm(c, is_test=True)
+        out = fluid.layers.relu(y)
+    test_prog = main.clone(for_test=True)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    rng = np.random.RandomState(0)
+    # non-trivial BN stats/params so the fold actually changes weights
+    _randomize(scope, [v.name for v in main.global_block().vars.values()
+                       if v.persistable], rng)
+    feed = {"x": rng.randn(2, 3, 8, 8).astype(np.float32)}
+    before = _run(test_prog, scope, feed, out.name)
+    w_name = next(op.inputs["Filter"][0]
+                  for op in test_prog.global_block().ops
+                  if op.type == "conv2d")
+    w_before = np.asarray(scope.find_var(w_name)).copy()
+
+    apply_pass(test_prog, "conv_bn_fuse", fetch_names=[out.name],
+               scope=scope)
+
+    types = [op.type for op in test_prog.global_block().ops]
+    assert "batch_norm" not in types, types
+    assert "elementwise_add" in types, types
+    assert not np.allclose(w_before, np.asarray(scope.find_var(w_name)))
+    after = _run(test_prog, scope, feed, out.name)
+    np.testing.assert_allclose(before, after, rtol=2e-5, atol=2e-6)
+
+
+def test_conv_affine_channel_fuse():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3, 6, 6], dtype="float32")
+        c = fluid.layers.conv2d(x, num_filters=5, filter_size=1,
+                                bias_attr=False)
+        scale = fluid.layers.create_parameter([5], "float32",
+                                              name="ac_scale")
+        bias = fluid.layers.create_parameter([5], "float32",
+                                             name="ac_bias")
+        from paddle_tpu.framework.layer_helper import LayerHelper
+        helper = LayerHelper("affine_channel")
+        y = helper.create_variable_for_type_inference("float32", c.shape)
+        helper.append_op(type="affine_channel",
+                         inputs={"X": [c], "Scale": [scale],
+                                 "Bias": [bias]},
+                         outputs={"Out": [y]}, attrs={})
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    rng = np.random.RandomState(1)
+    _randomize(scope, [v.name for v in main.global_block().vars.values()
+                       if v.persistable], rng)
+    feed = {"x": rng.randn(2, 3, 6, 6).astype(np.float32)}
+    before = _run(main, scope, feed, y.name)
+    apply_pass(main, "conv_affine_channel_fuse", fetch_names=[y.name],
+               scope=scope)
+    types = [op.type for op in main.global_block().ops]
+    assert "affine_channel" not in types, types
+    after = _run(main, scope, feed, y.name)
+    np.testing.assert_allclose(before, after, rtol=2e-5, atol=2e-6)
+
+
+def test_conv_bn_fuse_skipped_without_scope():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3, 8, 8], dtype="float32")
+        c = fluid.layers.conv2d(x, num_filters=4, filter_size=3,
+                                bias_attr=False)
+        y = fluid.layers.batch_norm(c, is_test=True)
+    apply_pass(main, "conv_bn_fuse", fetch_names=[y.name])  # no scope
+    assert "batch_norm" in [op.type for op in main.global_block().ops]
