@@ -65,16 +65,22 @@ class DatasetBase:
         self._pipe_command = cmd
 
     def set_hdfs_config(self, fs_name, fs_ugi):
-        """Accepted for API parity but NOT implemented: filelists are
-        read from the local filesystem only (ref:
-        incubate/fleet/utils/hdfs.py pluggable fs client).  Warn loudly —
-        a user pointing at HDFS would otherwise silently read local
-        paths."""
+        """Record the HDFS endpoint (ref: dataset.py set_hdfs_config).
+        Filelist paths are still OPENED locally by the native reader;
+        stage remote files first with the fs client this config maps to:
+
+            from paddle_tpu.distributed.fs import HDFSClient
+            fs = HDFSClient(hadoop_home, configs={
+                "fs.default.name": fs_name, "hadoop.job.ugi": fs_ugi})
+            fs.download(remote_path, local_path)
+
+        A warning still fires so nobody assumes transparent remote
+        reads."""
         import warnings
         warnings.warn(
-            f"set_hdfs_config({fs_name!r}, ...): HDFS access is not "
-            f"implemented in paddle_tpu — filelist paths will be opened "
-            f"on the LOCAL filesystem. Stage files locally (or via a "
+            f"set_hdfs_config({fs_name!r}, ...): filelist paths are "
+            f"opened on the LOCAL filesystem — stage remote files with "
+            f"paddle_tpu.distributed.fs.HDFSClient.download() (or a "
             f"fuse mount) before training.", UserWarning, stacklevel=2)
         self._hdfs = (fs_name, fs_ugi)
 

@@ -3,3 +3,5 @@ from .fleet import init_parallel_env, get_world_size, get_rank  # noqa: F401
 from .launch import launch    # noqa: F401
 from . import metrics         # noqa: F401
 from . import ps              # noqa: F401
+from . import fs              # noqa: F401
+from .fs import LocalFS, HDFSClient  # noqa: F401
