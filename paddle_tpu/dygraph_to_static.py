@@ -261,8 +261,11 @@ def _has_escape(node, kinds):
 # constructs that BIND names outside plain assignments: a converted
 # branch/loop body containing one would silently lose the binding (the
 # write-set analysis only sees Assign/AugAssign — advisor r4), so the
-# whole function falls back to the trace path instead
-_BINDING_STMTS = (ast.For, ast.AsyncFor, ast.With, ast.AsyncWith,
+# whole function falls back to the trace path instead.  ``for`` is NOT
+# in the list: visit_For rewrites for-range into while form (non-range
+# fors raise _Unsupported there).  Checked BEFORE generic_visit — the
+# conversion itself emits Try capture blocks.
+_BINDING_STMTS = (ast.AsyncFor, ast.With, ast.AsyncWith,
                   ast.NamedExpr, ast.Import, ast.ImportFrom, ast.Try,
                   ast.Delete, ast.Global, ast.Nonlocal)
 
@@ -315,7 +318,7 @@ class _Transformer(ast.NodeTransformer):
             raise _Unsupported("return inside a converted if")
         if _has_escape(node, _BINDING_STMTS):
             raise _Unsupported(
-                "for/with/walrus/import/try binding inside a converted if")
+                "with/walrus/import/try binding inside a converted if")
         self.generic_visit(node)
         assigned = sorted(set(_assigned_names(node.body)) |
                           set(_assigned_names(node.orelse)))
@@ -344,6 +347,60 @@ class _Transformer(ast.NodeTransformer):
                 keywords=[]))
         return self._capture(assigned) + [tdef, fdef, call]
 
+    def visit_For(self, node):
+        """``for i in range(...)`` → while form, then the while
+        conversion (ref: loop_transformer.py for-range handling).  A
+        concrete range still runs as a Python loop at trace time (the
+        runtime helper dispatches on tracedness); a range over a TRACED
+        length becomes the lax loop that a plain ``for`` could never be.
+        Non-range iterables and tuple targets fall back to trace."""
+        if node.orelse:
+            raise _Unsupported("for/else")
+        if _has_escape(node, (ast.Break, ast.Continue, ast.Return)):
+            raise _Unsupported("break/continue/return in converted for")
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords):
+            raise _Unsupported("for over a non-range iterable")
+        if not isinstance(node.target, ast.Name):
+            raise _Unsupported("tuple target in a converted for")
+        a = it.args
+        zero, one = ast.Constant(value=0), ast.Constant(value=1)
+        if len(a) == 1:
+            start, stop, step = zero, a[0], one
+        elif len(a) == 2:
+            start, stop, step = a[0], a[1], one
+        elif len(a) == 3:
+            start, stop, step = a
+        else:
+            raise _Unsupported("range() with >3 args")
+        if not (isinstance(step, ast.Constant)
+                and isinstance(step.value, int) and step.value != 0):
+            raise _Unsupported(
+                "range() step must be a non-zero int constant (the "
+                "comparison direction must be static)")
+        i_name = node.target.id
+        stop_name = self._fresh("stop")
+        init = [
+            ast.Assign(targets=[ast.Name(id=i_name, ctx=ast.Store())],
+                       value=start),
+            ast.Assign(targets=[ast.Name(id=stop_name, ctx=ast.Store())],
+                       value=stop),
+        ]
+        cmp_op = ast.Lt() if step.value > 0 else ast.Gt()
+        test = ast.Compare(left=ast.Name(id=i_name, ctx=ast.Load()),
+                           ops=[cmp_op],
+                           comparators=[ast.Name(id=stop_name,
+                                                 ctx=ast.Load())])
+        bump = ast.Assign(
+            targets=[ast.Name(id=i_name, ctx=ast.Store())],
+            value=ast.BinOp(left=ast.Name(id=i_name, ctx=ast.Load()),
+                            op=ast.Add(),
+                            right=ast.Constant(value=step.value)))
+        wh = ast.While(test=test, body=list(node.body) + [bump],
+                       orelse=[])
+        return init + self.visit_While(wh)
+
     def visit_While(self, node):
         if node.orelse:
             raise _Unsupported("while/else")
@@ -351,7 +408,7 @@ class _Transformer(ast.NodeTransformer):
             raise _Unsupported("break/continue/return in converted while")
         if _has_escape(node, _BINDING_STMTS):
             raise _Unsupported(
-                "for/with/walrus/import/try binding inside a converted "
+                "with/walrus/import/try binding inside a converted "
                 "while")
         self.generic_visit(node)
         loop_vars = _assigned_names(node.body)
@@ -404,7 +461,7 @@ def convert_function(fn: Callable):
         fdef = tree.body[0]
         if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
             raise _Unsupported("not a plain function")
-        has_cf = any(isinstance(n, (ast.If, ast.While))
+        has_cf = any(isinstance(n, (ast.If, ast.While, ast.For))
                      for n in ast.walk(fdef))
         if not has_cf:
             return None              # nothing to convert

@@ -225,3 +225,75 @@ def test_declarative_bounded_while_trains():
             w._grad = None
             losses.append(float(np.asarray(loss.value)))
     assert losses[-1] < 0.05 * losses[0], losses[::10]
+
+
+def test_for_range_traced_length():
+    """for-over-range with a TRACED stop converts to the lax loop (ref:
+    loop_transformer.py); a plain trace would fail on range(tracer)."""
+    @ptjit.declarative
+    def f(x, n):
+        acc = x * 0.0
+        for i in range(n):
+            acc = acc + x
+        return acc
+
+    with fluid.dygraph.guard():
+        out3 = f(_eager([2.0]), _eager(3))
+        out5 = f(_eager([2.0]), _eager(5))
+    np.testing.assert_allclose(np.asarray(out3.value), [6.0])
+    np.testing.assert_allclose(np.asarray(out5.value), [10.0])
+
+
+def test_for_range_concrete_still_python():
+    conv = convert_function(lambda: None)  # noqa: E731 (sanity import)
+
+    def g(x, n):
+        s = x * 0.0
+        for i in range(n):
+            s = s + x * float(i + 1)
+        return s
+
+    conv = convert_function(g)
+    assert conv is not None
+    with fluid.dygraph.guard():
+        out = conv(_eager([1.0]), 3)       # concrete: python loop
+    np.testing.assert_allclose(np.asarray(out.value), [6.0])  # 1+2+3
+
+
+def test_for_with_nested_if_converts():
+    @ptjit.declarative
+    def f(x, n):
+        acc = x * 0.0
+        for i in range(n):
+            if acc.value.sum() > 2.0:
+                acc = acc + 2.0 * x
+            else:
+                acc = acc + x
+        return acc
+
+    with fluid.dygraph.guard():
+        out = f(_eager([2.0]), _eager(3))
+    # 0→2 (else); 2 not >2 → 4; 4>2 → 8
+    np.testing.assert_allclose(np.asarray(out.value), [8.0])
+
+
+def test_for_range_trains_with_bound():
+    @ptjit.declarative(max_loop_iters=8)
+    def f(w, x, n):
+        acc = x * 0.0
+        for i in range(n):
+            acc = acc + w * x
+        return acc
+
+    with fluid.dygraph.guard():
+        w = VarBase(np.full((1,), 0.1, np.float32), stop_gradient=False)
+        x = VarBase(np.ones((1,), np.float32))
+        n = VarBase(np.asarray(3, np.int32))
+        losses = []
+        for _ in range(40):
+            loss = ((f(w, x, n) - 6.0) ** 2).mean()
+            loss.backward()
+            w.value = w.value - 0.05 * w.gradient_value
+            w._grad = None
+            losses.append(float(np.asarray(loss.value)))
+    assert losses[-1] < 0.05 * losses[0], losses[::10]
