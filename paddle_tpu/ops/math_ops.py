@@ -81,6 +81,68 @@ def _flatten2(a, num_col_dims):
     return a.reshape(lead, rest)
 
 
+def _mm_accum(a, b):
+    """GEMM with f32 accumulation, result cast back to the input dtype
+    (bf16 in / f32 accumulate / bf16 out — the MXU contract)."""
+    return jnp.matmul(a, b,
+                      preferred_element_type=jnp.float32).astype(a.dtype)
+
+
+def _unbroadcast(g, shape):
+    """Reduce a gradient back to ``shape`` after matmul broadcasting."""
+    if g.shape == tuple(shape):
+        return g
+    extra = g.ndim - len(shape)
+    if extra > 0:
+        g = g.sum(axis=tuple(range(extra)))
+    axes = tuple(i for i, (gs, s) in enumerate(zip(g.shape, shape))
+                 if s == 1 and gs != 1)
+    if axes:
+        g = g.sum(axis=axes, keepdims=True)
+    return g.reshape(shape)
+
+
+@jax.custom_vjp
+def mxu_matmul(a, b):
+    """Matmul whose BACKWARD dots also run with operand-dtype inputs.
+
+    jax's native dot transpose feeds the f32 cotangent (from
+    ``preferred_element_type=f32``) straight into the bwd GEMMs, so a
+    pure-bf16 step still executes its largest backward dots as mixed
+    f32×bf16 — on the MXU that forfeits the bf16 throughput the AMP
+    decorator exists to buy (observed in the cross-lowered bench step:
+    24 of 37 dots had an f32 operand).  The custom vjp casts the
+    cotangent to the operand dtype first: every GEMM, forward and
+    backward, is bf16-in/f32-accumulate."""
+    return _mm_accum(a, b)
+
+
+def _mxu_mm_fwd(a, b):
+    return _mm_accum(a, b), (a, b)
+
+
+def _mxu_mm_bwd(res, g):
+    a, b = res
+    g = g.astype(a.dtype)
+    da = _mm_accum(g, jnp.swapaxes(b, -1, -2))
+    db = _mm_accum(jnp.swapaxes(a, -1, -2), g)
+    return (_unbroadcast(da, a.shape).astype(a.dtype),
+            _unbroadcast(db, b.shape).astype(b.dtype))
+
+
+mxu_matmul.defvjp(_mxu_mm_fwd, _mxu_mm_bwd)
+
+
+def _matmul_any(a, b):
+    """Dispatch: low-precision rank≥2 operands take the custom-vjp MXU
+    path; everything else keeps jax's native matmul/vjp."""
+    if a.ndim >= 2 and b.ndim >= 2 and \
+            a.dtype == b.dtype and \
+            a.dtype in (jnp.bfloat16, jnp.float16):
+        return mxu_matmul(a, b)
+    return _mm_accum(a, b)
+
+
 @register("mul")
 def _mul(ctx, ins, attrs):
     """2-D GEMM with leading-dim flattening (ref: mul_op.cc)."""
@@ -90,7 +152,7 @@ def _mul(ctx, ins, attrs):
     out_shape = a.shape[:xn] + b.shape[yn:]
     a2 = _flatten2(a, xn)
     b2 = _flatten2(b, yn)
-    out = jnp.matmul(a2, b2, preferred_element_type=jnp.float32).astype(a.dtype)
+    out = _matmul_any(a2, b2)
     return {"Out": out.reshape(out_shape)}
 
 
@@ -104,7 +166,7 @@ def _matmul(ctx, ins, attrs):
         a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
     if tb:
         b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
-    out = jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    out = _matmul_any(a, b)
     if alpha != 1.0:
         out = out * alpha
     return {"Out": out}
@@ -117,7 +179,7 @@ def _matmul_v2(ctx, ins, attrs):
         a = jnp.swapaxes(a, -1, -2)
     if attrs.get("trans_y", False):
         b = jnp.swapaxes(b, -1, -2)
-    out = jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    out = _matmul_any(a, b)
     return {"Out": out}
 
 

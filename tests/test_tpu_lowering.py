@@ -77,6 +77,24 @@ def test_pallas_kernels_present(lowered_bench_step):
     assert "_adam_kernel" in names, f"fused Adam missing; found {names}"
 
 
+def test_all_gemms_pure_bf16(lowered_bench_step):
+    """Every dot in the pure-bf16 step must have bf16×bf16 operands —
+    jax's native dot transpose used to feed f32 cotangents into the
+    backward GEMMs (24 of 37 dots mixed f32×bf16 before the mxu_matmul
+    custom vjp), forfeiting bf16 MXU throughput on ~2/3 of the FLOPs."""
+    txt = lowered_bench_step.mlir_module()
+    pairs = []
+    for line in txt.splitlines():
+        if "stablehlo.dot_general" not in line:
+            continue
+        m = re.search(r":\s*\(tensor<([^>]*)>,\s*tensor<([^>]*)>\)", line)
+        if m:
+            pairs.append(tuple(t.rsplit("x", 1)[-1] for t in m.groups()))
+    assert pairs, "no dots found"
+    mixed = [p for p in pairs if p != ("bf16", "bf16")]
+    assert not mixed, f"non-bf16 GEMM operands: {mixed}"
+
+
 def test_state_buffers_donated(lowered_bench_step):
     txt = lowered_bench_step.mlir_module()
     sig = re.search(r"func\.func public @main\((.*?)\)\s*->", txt,

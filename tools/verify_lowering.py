@@ -44,6 +44,14 @@ def main():
     kernels = {}
     for n in re.findall(r'kernel_name = "(\w+)"', txt):
         kernels[n] = kernels.get(n, 0) + 1
+    gemm_pairs = {}
+    for line in txt.splitlines():
+        if "stablehlo.dot_general" not in line:
+            continue
+        m = re.search(r":\s*\(tensor<([^>]*)>,\s*tensor<([^>]*)>\)", line)
+        if m:
+            key = "x".join(t.rsplit("x", 1)[-1] for t in m.groups())
+            gemm_pairs[key] = gemm_pairs.get(key, 0) + 1
     sig = re.search(r"func\.func public @main\((.*?)\)\s*->", txt,
                     re.DOTALL).group(1)
     donated = sig.count("tf.aliasing_output")
@@ -61,6 +69,8 @@ def main():
         lines.append(f"  {n}: {kernels[n]}")
     lines.append(f"main args: {n_args}, donated (tf.aliasing_output): "
                  f"{donated}")
+    lines.append(f"GEMM operand dtypes: {gemm_pairs} "
+                 f"({'PURE bf16' if set(gemm_pairs) <= {'bf16xbf16'} else 'MIXED — check mxu_matmul routing'})")
     want = {"_fwd_kernel", "_bwd_dq_kernel", "_bwd_dkv_kernel",
             "_ln_fwd_kernel", "_ln_bwd_kernel", "_adam_kernel"}
     missing = want - set(kernels)
