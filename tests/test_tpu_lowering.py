@@ -132,3 +132,37 @@ def test_single_executable_no_per_step_recompile():
             assert np.isfinite(l).all()
         compiles = stat("executor_compile_count").get() - before
     assert compiles == 1, f"expected 1 executable, got {compiles} compiles"
+
+
+def test_flops_denominator_sane():
+    """XLA's counted FLOPs for the compiled step must bracket the
+    analytic GEMM model bench.py divides by — a wrong denominator would
+    silently misreport MFU (tiny config; the full-scale audit artifact
+    is FLOPS_AUDIT_r05.json via tools/flops_audit.py)."""
+    import jax
+    from bench import bert_flops_per_step
+    cfg = bert.BertConfig.tiny()
+    batch, seq, masks = 8, 64, 4
+    main_prog, startup, total = _build_pretrain(cfg)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        data = bert.make_fake_batch(np.random.RandomState(0), cfg,
+                                    batch_size=batch, seq_len=seq,
+                                    num_masks=masks)
+        feed = {k: np.asarray(v) for k, v in data.items()}
+        step = exe._compile(main_prog, feed, [total.name], scope, None,
+                            (), None)
+        state = {n: np.asarray(scope.find_var(n))
+                 for n in step.state_in_names}
+        compiled = jax.jit(step.raw_fn).lower(
+            feed, state, jax.random.PRNGKey(0)).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    xla = float(ca.get("flops", 0.0))
+    analytic = float(bert_flops_per_step(cfg, batch, seq, masks))
+    ratio = xla / analytic
+    # tiny models carry relatively more non-GEMM work, so the band is
+    # loose; at bench scale the tool reports ~1.0-1.3
+    assert 0.7 < ratio < 3.0, (xla, analytic, ratio)
