@@ -177,13 +177,21 @@ class DataLoader:
                  batch_sampler: Optional[BatchSampler] = None,
                  num_replicas: int = 1, rank: int = 0, seed=None,
                  use_multiprocess: bool = False,
-                 use_double_buffer: bool = False, places=None):
+                 use_double_buffer: bool = False, places=None,
+                 bucket_ladder=None, len_fn=len):
         self.dataset = dataset
         self.feed_list = feed_list
         self.capacity = capacity
         self._want_double_buffer = use_double_buffer
         self.places = places
         self.collate_fn = collate_fn or default_collate
+        # sequence-length bucketing (SURVEY hard part #3): group samples
+        # so every emitted batch pads to one ladder step — one XLA
+        # executable per bucket on ragged data.  A 2-arg collate_fn
+        # receives (samples, bucket_len) and must pad to bucket_len.
+        self.bucket_ladder = tuple(bucket_ladder) if bucket_ladder \
+            else None
+        self.len_fn = len_fn
         self.num_workers = num_workers
         self.use_multiprocess = use_multiprocess or num_workers > 0
         self._generator = None
@@ -220,15 +228,25 @@ class DataLoader:
         if places is not None:
             self.places = places
 
-        def gen():
-            batch = []
-            for sample in reader():
-                batch.append(sample)
-                if len(batch) == batch_size:
+        if self.bucket_ladder:
+            from .bucketing import bucket_by_length
+
+            def gen():
+                for b_len, batch in bucket_by_length(
+                        reader, ladder=self.bucket_ladder,
+                        batch_size=batch_size, len_fn=self.len_fn,
+                        drop_last=drop_last):
+                    yield self._collate_bucket(batch, b_len)
+        else:
+            def gen():
+                batch = []
+                for sample in reader():
+                    batch.append(sample)
+                    if len(batch) == batch_size:
+                        yield self.collate_fn(batch)
+                        batch = []
+                if batch and not drop_last:
                     yield self.collate_fn(batch)
-                    batch = []
-            if batch and not drop_last:
-                yield self.collate_fn(batch)
         self._generator = gen
         return self
 
@@ -247,6 +265,23 @@ class DataLoader:
             self.places = places
         self._generator = reader
         return self
+
+    def _collate_bucket(self, samples, bucket_len):
+        """Collate one bucket's samples: a 2-arg collate_fn gets the
+        bucket length and must pad to it (the one-shape-per-bucket
+        contract); a 1-arg collate_fn is called as usual (its padding
+        rule must itself be bucket-stable)."""
+        import inspect
+        try:
+            params = [
+                p for p in
+                inspect.signature(self.collate_fn).parameters.values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+            two = len(params) >= 2
+        except (TypeError, ValueError):
+            two = False
+        return self.collate_fn(samples, bucket_len) if two \
+            else self.collate_fn(samples)
 
     # -- iteration -------------------------------------------------------
     def _produce(self):

@@ -57,7 +57,6 @@ def ring_attention(q, k, v, axis_name: str,
             except AttributeError:
                 return t
 
-    q32 = q.astype(jnp.float32)
     m0 = _vary(jnp.full((b, h, s_loc), -jnp.inf, jnp.float32))
     l0 = _vary(jnp.zeros((b, h, s_loc), jnp.float32))
     acc0 = _vary(jnp.zeros((b, h, s_loc, d), jnp.float32))
@@ -68,7 +67,11 @@ def ring_attention(q, k, v, axis_name: str,
     def step(carry, i):
         k_blk, v_blk, msk, m, l, acc = carry
         src = (my_idx - i) % n                       # owner of this K/V block
-        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32))
+        # operand-dtype in, f32 accumulate: bf16 q/k ride the MXU at the
+        # bf16 rate instead of being upcast (same numerics contract as
+        # the flash kernel; identical math for f32 inputs)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                       preferred_element_type=jnp.float32)
         s = s * scale
         if bias is not None:
             s = s + bias.astype(s.dtype)
@@ -85,7 +88,8 @@ def ring_attention(q, k, v, axis_name: str,
         p = jnp.exp(s - m_new[..., None])
         l_new = l * corr + jnp.sum(p, axis=-1)
         acc_new = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+            "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         msk = lax.ppermute(msk, axis_name, perm)

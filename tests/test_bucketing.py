@@ -97,3 +97,32 @@ def test_bucketed_loss_matches_maxpad():
         lb, = exe.run(main, feed=f_bucket, fetch_list=[loss])
         lf, = exe.run(main, feed=f_full, fetch_list=[loss])
     np.testing.assert_allclose(np.mean(lb), np.mean(lf), rtol=2e-5)
+
+
+def test_dataloader_bucketed_sample_generator():
+    """DataLoader(bucket_ladder=...) + a padding collate: every emitted
+    batch is padded to its bucket and the stream covers all samples."""
+    from paddle_tpu.dataloader import DataLoader
+
+    rng = np.random.RandomState(3)
+    samples = [list(rng.randint(1, 100, rng.randint(1, 30)))
+               for _ in range(30)]
+
+    def pad_collate(batch, bucket_len):
+        out = np.zeros((len(batch), bucket_len), np.int64)
+        for i, s in enumerate(batch):
+            out[i, :len(s)] = s
+        return {"ids": out}
+
+    loader = DataLoader(feed_list=None, collate_fn=pad_collate,
+                        bucket_ladder=(8, 16, 32))
+    loader.set_sample_generator(lambda: iter(samples), batch_size=4,
+                                drop_last=False)
+    shapes = set()
+    total = 0
+    for feed in loader:
+        assert feed["ids"].shape[1] in (8, 16, 32)
+        shapes.add(feed["ids"].shape[1])
+        total += feed["ids"].shape[0]
+    assert total == len(samples)
+    assert len(shapes) >= 2          # data really straddles buckets
