@@ -136,11 +136,14 @@ def moe_ffn_fn(xf, gate_w, w1, w2, b1=None, b2=None, *, top_k=2,
         xe = xe.reshape(e_local, ep_size * g * capacity, m)
     else:
         xe = xe.reshape(e, g * capacity, m)
-    h = jnp.einsum("esm,emh->esh", xe, w1)
+    # expert FFN GEMMs are batched matmuls — route through the dtype-
+    # aware path so bf16 MoE keeps bf16 operands in fwd AND bwd dots
+    from .math_ops import _matmul_any
+    h = _matmul_any(xe, w1)                        # esm,emh->esh
     if b1 is not None:
         h = h + b1[:, None, :]
     h = _ACTS[act](h)
-    ye = jnp.einsum("esh,ehm->esm", h, w2)
+    ye = _matmul_any(h, w2)                        # esh,ehm->esm
     if b2 is not None:
         ye = ye + b2[:, None, :]
     if ep_axis is not None:
