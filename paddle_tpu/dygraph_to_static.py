@@ -437,6 +437,38 @@ class _Transformer(ast.NodeTransformer):
         return self._capture(loop_vars) + [cdef, bdef, call]
 
 
+def _lower_tail_return_if(fdef) -> None:
+    """``if c: ... return A else: ... return B`` as the FUNCTION'S LAST
+    statement → both returns become assignments to a fresh result name
+    followed by one tail return, so the If converts like any other (the
+    minimal slice of the reference's return_transformer.py; returns in
+    other positions still fall back to trace)."""
+    if not fdef.body or not isinstance(fdef.body[-1], ast.If):
+        return
+    tail = fdef.body[-1]
+    if not tail.body or not tail.orelse:
+        return
+    if not (isinstance(tail.body[-1], ast.Return)
+            and isinstance(tail.orelse[-1], ast.Return)):
+        return
+    # no OTHER returns anywhere inside (multi-exit branches stay
+    # unsupported)
+    inner_returns = [n for branch in (tail.body[:-1], tail.orelse[:-1])
+                     for s in branch for n in ast.walk(s)
+                     if isinstance(n, ast.Return)]
+    if inner_returns:
+        return
+    ret = "_d2s_ret"   # must NOT use the _pt_ plumbing prefix: it is
+    # real carried state the write-set analysis needs to see
+    for branch in (tail.body, tail.orelse):
+        r = branch[-1]
+        branch[-1] = ast.Assign(
+            targets=[ast.Name(id=ret, ctx=ast.Store())],
+            value=r.value if r.value is not None
+            else ast.Constant(value=None))
+    fdef.body.append(ast.Return(value=ast.Name(id=ret, ctx=ast.Load())))
+
+
 def _is_declarative_deco(node) -> bool:
     """Is this decorator expression @declarative/@to_static (possibly
     dotted or called, e.g. @paddle_tpu.jit.to_static or
@@ -469,6 +501,7 @@ def convert_function(fn: Callable):
         # decorator must survive conversion (advisor r4)
         fdef.decorator_list = [d for d in fdef.decorator_list
                                if not _is_declarative_deco(d)]
+        _lower_tail_return_if(fdef)
         new = _Transformer().visit(tree)
         ast.fix_missing_locations(new)
         code = compile(new, f"<dygraph_to_static {fn.__name__}>", "exec")

@@ -297,3 +297,33 @@ def test_for_range_trains_with_bound():
             w._grad = None
             losses.append(float(np.asarray(loss.value)))
     assert losses[-1] < 0.05 * losses[0], losses[::10]
+
+
+def test_tail_if_with_returns_converts():
+    """`if c: return A else: return B` as the last statement converts
+    (the reference return_transformer's most common shape) instead of
+    falling back to trace."""
+    @ptjit.declarative
+    def f(x):
+        if x.value.sum() > 0:
+            return x * 2.0
+        else:
+            return x - 10.0
+
+    with fluid.dygraph.guard():
+        pos = f(_eager([1.0, 2.0]))
+        neg = f(_eager([-3.0, -4.0]))
+    assert f._static._fn.__pt_converted__
+    np.testing.assert_allclose(np.asarray(pos.value), [2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(neg.value), [-13.0, -14.0])
+
+
+def test_mid_function_return_still_falls_back():
+    def h(x):
+        if x.value.sum() > 0:          # early return NOT at tail
+            return x * 2.0
+        y = x + 1.0
+        return y
+
+    with pytest.warns(UserWarning, match="TRACE-based"):
+        assert convert_function(h) is None
