@@ -182,16 +182,38 @@ class DataLoader:
         self.dataset = dataset
         self.feed_list = feed_list
         self.capacity = capacity
+        self._batch_size = batch_size
         self._want_double_buffer = use_double_buffer
         self.places = places
         self.collate_fn = collate_fn or default_collate
         # sequence-length bucketing (SURVEY hard part #3): group samples
         # so every emitted batch pads to one ladder step — one XLA
-        # executable per bucket on ragged data.  A 2-arg collate_fn
-        # receives (samples, bucket_len) and must pad to bucket_len.
+        # executable per bucket on ragged data.  A collate_fn with a
+        # second REQUIRED positional parameter receives
+        # (samples, bucket_len) and must pad to bucket_len.
         self.bucket_ladder = tuple(bucket_ladder) if bucket_ladder \
             else None
         self.len_fn = len_fn
+        self._collate_wants_bucket = False
+        if self.bucket_ladder:
+            if dataset is not None and \
+                    not isinstance(dataset, IterableDataset):
+                raise ValueError(
+                    "bucket_ladder is not supported with map-style "
+                    "datasets (the batch_sampler fixes batch membership "
+                    "before lengths are known) — use an IterableDataset "
+                    "or set_sample_generator")
+            import inspect
+            try:
+                params = [
+                    p for p in
+                    inspect.signature(self.collate_fn).parameters.values()
+                    if p.kind in (p.POSITIONAL_ONLY,
+                                  p.POSITIONAL_OR_KEYWORD)
+                    and p.default is p.empty]
+                self._collate_wants_bucket = len(params) >= 2
+            except (TypeError, ValueError):
+                self._collate_wants_bucket = False
         self.num_workers = num_workers
         self.use_multiprocess = use_multiprocess or num_workers > 0
         self._generator = None
@@ -267,21 +289,14 @@ class DataLoader:
         return self
 
     def _collate_bucket(self, samples, bucket_len):
-        """Collate one bucket's samples: a 2-arg collate_fn gets the
-        bucket length and must pad to it (the one-shape-per-bucket
-        contract); a 1-arg collate_fn is called as usual (its padding
-        rule must itself be bucket-stable)."""
-        import inspect
-        try:
-            params = [
-                p for p in
-                inspect.signature(self.collate_fn).parameters.values()
-                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
-            two = len(params) >= 2
-        except (TypeError, ValueError):
-            two = False
-        return self.collate_fn(samples, bucket_len) if two \
-            else self.collate_fn(samples)
+        """Collate one bucket's samples: a collate_fn with a second
+        REQUIRED positional parameter gets the bucket length and must
+        pad to it (the one-shape-per-bucket contract); otherwise it is
+        called as usual and its padding rule must itself be
+        bucket-stable.  Arity is decided once at construction —
+        defaulted extras (e.g. dtype=...) do NOT receive the bucket."""
+        return self.collate_fn(samples, bucket_len) \
+            if self._collate_wants_bucket else self.collate_fn(samples)
 
     # -- iteration -------------------------------------------------------
     def _produce(self):
@@ -289,8 +304,17 @@ class DataLoader:
             for batch in self._generator():
                 yield self._to_feed(batch)
         elif isinstance(self.dataset, IterableDataset):
-            for sample in self.dataset:
-                yield self._to_feed(sample)
+            if self.bucket_ladder:
+                from .bucketing import bucket_by_length
+                for b_len, batch in bucket_by_length(
+                        self.dataset, ladder=self.bucket_ladder,
+                        batch_size=self._batch_size,
+                        len_fn=self.len_fn):
+                    yield self._to_feed(self._collate_bucket(batch,
+                                                             b_len))
+            else:
+                for sample in self.dataset:
+                    yield self._to_feed(sample)
         else:
             for idx_batch in self.batch_sampler:
                 samples = [self.dataset[i] for i in idx_batch]

@@ -154,12 +154,14 @@ def _retry(f):
     @functools.wraps(f)
     def wrapper(self, *args, **kwargs):
         last = None
-        for attempt in range(max(1, self._retry_times)):
+        tries = max(1, self._retry_times)
+        for attempt in range(tries):
             try:
                 return f(self, *args, **kwargs)
             except ExecuteError as e:
                 last = e
-                time.sleep(self._retry_sleep_s * (attempt + 1))
+                if attempt + 1 < tries:     # no sleep after the FINAL try
+                    time.sleep(self._retry_sleep_s * (attempt + 1))
         raise last
 
     return wrapper

@@ -379,25 +379,39 @@ class _Transformer(ast.NodeTransformer):
             raise _Unsupported(
                 "range() step must be a non-zero int constant (the "
                 "comparison direction must be static)")
+        # a HIDDEN counter drives the loop and the user's variable is
+        # assigned from it INSIDE the body, so after the loop the user
+        # var holds the last ITERATED value (Python semantics: n-1, not
+        # the first failing value).  For an empty range the user var
+        # keeps its pre-init (start) — lax carries need a value, so
+        # Python's "unbound" cannot be reproduced; this is the closest
+        # faithful form.  The counter must NOT use the _pt_ prefix (that
+        # marks non-carried plumbing in the write-set analysis).
         i_name = node.target.id
+        self._n += 1
+        ctr = f"_d2s_i_{self._n}"
         stop_name = self._fresh("stop")
         init = [
             ast.Assign(targets=[ast.Name(id=i_name, ctx=ast.Store())],
                        value=start),
+            ast.Assign(targets=[ast.Name(id=ctr, ctx=ast.Store())],
+                       value=ast.Name(id=i_name, ctx=ast.Load())),
             ast.Assign(targets=[ast.Name(id=stop_name, ctx=ast.Store())],
                        value=stop),
         ]
         cmp_op = ast.Lt() if step.value > 0 else ast.Gt()
-        test = ast.Compare(left=ast.Name(id=i_name, ctx=ast.Load()),
+        test = ast.Compare(left=ast.Name(id=ctr, ctx=ast.Load()),
                            ops=[cmp_op],
                            comparators=[ast.Name(id=stop_name,
                                                  ctx=ast.Load())])
+        take = ast.Assign(targets=[ast.Name(id=i_name, ctx=ast.Store())],
+                          value=ast.Name(id=ctr, ctx=ast.Load()))
         bump = ast.Assign(
-            targets=[ast.Name(id=i_name, ctx=ast.Store())],
-            value=ast.BinOp(left=ast.Name(id=i_name, ctx=ast.Load()),
+            targets=[ast.Name(id=ctr, ctx=ast.Store())],
+            value=ast.BinOp(left=ast.Name(id=ctr, ctx=ast.Load()),
                             op=ast.Add(),
                             right=ast.Constant(value=step.value)))
-        wh = ast.While(test=test, body=list(node.body) + [bump],
+        wh = ast.While(test=test, body=[take] + list(node.body) + [bump],
                        orelse=[])
         return init + self.visit_While(wh)
 

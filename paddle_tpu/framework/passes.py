@@ -235,26 +235,57 @@ def _fold_conv_scale(program, block, op, scale, bias, out_name, scope,
 
 def _conv_channel_fuse(program, fetch_names, scope, follower,
                        get_factors):
-    """Shared driver: conv2d → <follower> ⇒ conv2d(folded W) +
-    elementwise_add(channel bias).  ``get_factors(op, scope)`` returns
-    (scale[C], bias[C]) or None."""
+    """Shared driver: conv2d [→ elementwise_add(channel bias)] →
+    <follower>  ⇒  conv2d(folded W) + elementwise_add(channel bias).
+    The optional intermediate add is the layer-built conv BIAS (the
+    default ``bias_attr`` shape) — it folds into the new bias exactly
+    like the reference pass folds the conv's Bias input.
+    ``get_factors(op, scope)`` returns (scale[C], bias[C]) or None."""
     import numpy as np
     if scope is None:
         return                       # weight folding needs values
+    # a filter consumed by MORE THAN ONE op must not fold at all:
+    # scaling it in the scope would corrupt every other consumer
+    filter_users: dict = {}
+    for block in program.blocks:
+        for op in block.ops:
+            for n in op.input_names():
+                filter_users[n] = filter_users.get(n, 0) + 1
     for block in program.blocks:
         uses = _use_counts(block, keep_names=fetch_names)
+        drop_ops = []                # absorbed bias adds, removed after
         for i, op in enumerate(block.ops):
             if op.type not in ("conv2d", "depthwise_conv2d"):
                 continue
             if op.attrs.get("data_format", "NCHW") not in ("NCHW",
                                                            "AnyLayout"):
                 continue
-            hit = _single_use_chain(block, i, uses, (follower,))
+            if filter_users.get(op.inputs["Filter"][0], 0) != 1:
+                continue
+            hit = _single_use_chain(block, i, uses,
+                                    (follower, "elementwise_add"))
             if hit is None:
                 continue
             j, fop = hit
             conv_out = op.outputs["Output"][0]
-            if fop.inputs.get("X", [None])[0] != conv_out:
+            conv_bias = None         # np [C] conv bias folded via the add
+            if fop.type == "elementwise_add" and fop.type != follower:
+                # conv's bias add: 1-D Y broadcast over the channel axis
+                bn = fop.inputs.get("Y", [None])[0]
+                bv = block._find_var_recursive(bn) if bn else None
+                bval = scope.find_var(bn) if bn else None
+                if bv is None or bval is None or len(bv.shape) != 1 or \
+                        fop.attrs.get("axis", -1) != 1:
+                    continue
+                hit2 = _single_use_chain(block, j, uses, (follower,))
+                if hit2 is None:
+                    continue
+                conv_bias = np.asarray(bval)
+                add_out = fop.outputs["Out"][0]
+                j, fop = hit2
+                if fop.inputs.get("X", [None])[0] != add_out:
+                    continue
+            elif fop.inputs.get("X", [None])[0] != conv_out:
                 continue
             # follower side outputs (saved mean/var) must be dead — but
             # ignore the follower's own reads (batch_norm's MeanOut
@@ -272,6 +303,11 @@ def _conv_channel_fuse(program, fetch_names, scope, follower,
             if factors is None:
                 continue
             scale, bias = factors
+            if conv_bias is not None:
+                if conv_bias.shape != scale.shape:
+                    continue
+                # follower(conv + b) = scale*conv + (scale*b + bias)
+                bias = scale * conv_bias + bias
             b_name = _fold_conv_scale(program, block, op, scale, bias,
                                       conv_out, scope)
             if not b_name:
@@ -281,6 +317,15 @@ def _conv_channel_fuse(program, fetch_names, scope, follower,
             fop.inputs = {"X": [conv_out], "Y": [b_name]}
             fop.outputs = {"Out": [out_name]}
             fop.attrs = {"axis": 1}
+            if conv_bias is not None:
+                # the layer's own bias add is absorbed into the folded
+                # channel bias — remove it after the scan
+                drop_ops.extend(
+                    o for o in block.ops
+                    if o is not fop
+                    and o.outputs.get("Out", [None])[0] == add_out)
+        if drop_ops:
+            block.ops[:] = [o for o in block.ops if o not in drop_ops]
 
 
 @register_pass("conv_bn_fuse")

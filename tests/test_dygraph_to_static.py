@@ -327,3 +327,27 @@ def test_mid_function_return_still_falls_back():
 
     with pytest.warns(UserWarning, match="TRACE-based"):
         assert convert_function(h) is None
+
+
+def test_for_loop_var_python_semantics_after_loop():
+    """After `for i in range(n)`, i must hold the LAST ITERATED value
+    (n-1), not the first failing value — post-loop reads of the loop
+    variable are common."""
+    @ptjit.declarative
+    def f(x, n):
+        acc = x * 0.0
+        for i in range(n):
+            acc = acc + x
+        return acc * float(1.0) + acc * 0.0, acc  # force tuple path
+
+    def g(x, n):
+        s = x * 0.0
+        for i in range(n):
+            s = s + x
+        return s * (i + 1)            # reads i AFTER the loop
+
+    conv = convert_function(g)
+    assert conv is not None
+    with fluid.dygraph.guard():
+        out = conv(_eager([2.0]), 3)  # s=6, i=2 → 18
+    np.testing.assert_allclose(np.asarray(out.value), [18.0])

@@ -105,3 +105,73 @@ def test_conv_bn_fuse_skipped_without_scope():
         y = fluid.layers.batch_norm(c, is_test=True)
     apply_pass(main, "conv_bn_fuse", fetch_names=[y.name])  # no scope
     assert "batch_norm" in [op.type for op in main.global_block().ops]
+
+
+def test_conv_bn_fuse_with_default_conv_bias():
+    """conv2d with its DEFAULT bias (layer-built elementwise_add between
+    conv and bn) — the most common configuration — must fold too: the
+    conv bias is absorbed into the new channel bias and the intermediate
+    add disappears."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3, 8, 8], dtype="float32")
+        c = fluid.layers.conv2d(x, num_filters=4, filter_size=3,
+                                padding=1)          # default bias_attr
+        y = fluid.layers.batch_norm(c, is_test=True)
+        out = fluid.layers.relu(y)
+    test_prog = main.clone(for_test=True)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    rng = np.random.RandomState(4)
+    _randomize(scope, [v.name for v in main.global_block().vars.values()
+                       if v.persistable], rng)
+    feed = {"x": rng.randn(2, 3, 8, 8).astype(np.float32)}
+    before = _run(test_prog, scope, feed, out.name)
+    n_ops_before = len(test_prog.global_block().ops)
+
+    apply_pass(test_prog, "conv_bn_fuse", fetch_names=[out.name],
+               scope=scope)
+
+    types = [op.type for op in test_prog.global_block().ops]
+    assert "batch_norm" not in types, types
+    # conv's own bias add absorbed: one add (the folded bias) remains
+    assert types.count("elementwise_add") == 1, types
+    assert len(test_prog.global_block().ops) == n_ops_before - 1
+    after = _run(test_prog, scope, feed, out.name)
+    np.testing.assert_allclose(before, after, rtol=2e-5, atol=2e-6)
+
+
+def test_conv_bn_fuse_shared_filter_folds_once():
+    """Two convs SHARING one filter, each followed by BN: NEITHER pair
+    folds — scaling the shared filter in the scope would corrupt the
+    other consumer; numerics must be unchanged."""
+    from paddle_tpu.framework.layer_helper import ParamAttr
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3, 6, 6], dtype="float32")
+        shared = ParamAttr(name="shared_w")
+        c1 = fluid.layers.conv2d(x, 4, 3, padding=1, param_attr=shared,
+                                 bias_attr=False)
+        c2 = fluid.layers.conv2d(x, 4, 3, padding=1, param_attr=shared,
+                                 bias_attr=False)
+        y1 = fluid.layers.batch_norm(c1, is_test=True, name="bn_a")
+        y2 = fluid.layers.batch_norm(c2, is_test=True, name="bn_b")
+        out = fluid.layers.elementwise_add(y1, y2)
+    test_prog = main.clone(for_test=True)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    rng = np.random.RandomState(5)
+    _randomize(scope, [v.name for v in main.global_block().vars.values()
+                       if v.persistable], rng)
+    feed = {"x": rng.randn(2, 3, 6, 6).astype(np.float32)}
+    before = _run(test_prog, scope, feed, out.name)
+    apply_pass(test_prog, "conv_bn_fuse", fetch_names=[out.name],
+               scope=scope)
+    types = [op.type for op in test_prog.global_block().ops]
+    assert types.count("batch_norm") == 2, types   # both pairs kept
+    after = _run(test_prog, scope, feed, out.name)
+    np.testing.assert_allclose(before, after, rtol=2e-5, atol=2e-6)

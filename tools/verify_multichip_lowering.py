@@ -29,7 +29,13 @@ with fluid.program_guard(main, startup):
     fluid.optimizer.Adam(1e-4).minimize(loss)
 from jax.sharding import PartitionSpec as P
 feed_specs = {f.name: P("dp", "sp") for f in feeds}
-compiled = fluid.CompiledProgram(main).with_mesh(mesh, loss_name=loss.name, batch_axis="dp", seq_axis="sp", feed_specs=feed_specs)
+# NOT dead code: with_mesh MUTATES `main` in place — it inserts the
+# scale + c_allreduce_sum grad-sync ops over dp and sp (the
+# GradAllReduce transpiler rewrite); without it the lowered module
+# carries only the Megatron/ring collectives (15 all_reduce vs 53)
+fluid.CompiledProgram(main).with_mesh(
+    mesh, loss_name=loss.name, batch_axis="dp", seq_axis="sp",
+    feed_specs=feed_specs)
 exe = fluid.Executor()
 scope = fluid.Scope()
 rng = np.random.RandomState(0)
