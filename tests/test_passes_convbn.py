@@ -175,3 +175,49 @@ def test_conv_bn_fuse_shared_filter_folds_once():
     assert types.count("batch_norm") == 2, types   # both pairs kept
     after = _run(test_prog, scope, feed, out.name)
     np.testing.assert_allclose(before, after, rtol=2e-5, atol=2e-6)
+
+
+def test_predictor_pipeline_folds_and_matches(tmp_path):
+    """END-TO-END: save_inference_model → AnalysisPredictor applies the
+    full INFERENCE_PASSES pipeline (conv_bn_fuse with scope, add+act
+    fuse, fc_fuse) and the served outputs match the raw test program."""
+    import jax.numpy as jnp
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3, 8, 8], dtype="float32")
+        c = fluid.layers.conv2d(x, 8, 3, padding=1)   # default bias
+        y = fluid.layers.batch_norm(c, is_test=False)
+        h = fluid.layers.relu(y)
+        out = fluid.layers.fc(h, 10, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for v in main.global_block().vars.values():
+            sv = scope.find_var(v.name)
+            if v.persistable and sv is not None:
+                a = rng.rand(*np.asarray(sv).shape).astype(np.float32) \
+                    * 0.5 + 0.25
+                scope.set_var(v.name, jnp.asarray(a))
+        d = str(tmp_path / "model")
+        fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                      main_program=main)
+        test_prog = main.clone(for_test=True)
+        xb = rng.randn(2, 3, 8, 8).astype(np.float32)
+        ref, = exe.run(test_prog, feed={"x": xb}, fetch_list=[out])
+
+    from paddle_tpu.inference import AnalysisConfig, \
+        create_paddle_predictor
+    cfg = AnalysisConfig(d)
+    cfg.disable_gpu()
+    pred = create_paddle_predictor(cfg)
+    t = pred.get_input_tensor(pred.get_input_names()[0])
+    t.copy_from_cpu(xb)
+    pred.zero_copy_run()
+    got = pred.get_output_tensor(pred.get_output_names()[0]).copy_to_cpu()
+    types = [op.type for op in pred._program.global_block().ops]
+    assert "batch_norm" not in types, types        # folded
+    np.testing.assert_allclose(np.asarray(ref), got, rtol=2e-4,
+                               atol=2e-5)
