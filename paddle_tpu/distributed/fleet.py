@@ -144,7 +144,12 @@ class DistributedStrategy:
         self.lamb = False
         self.lamb_configs = {"lamb_weight_decay": 0.01}
         self.use_dgc = False          # N/A on ICI (bandwidth-rich); no-op
-        self.sharding = False         # ZeRO-style optimizer sharding
+        # ZeRO-1 sharded weight update (reduce_scatter → sharded update →
+        # all_gather; arXiv:2004.13336).  ``sharding`` is the reference
+        # fleet spelling; ``sharded_update`` the explicit alias — either
+        # enables the rewrite.
+        self.sharding = False
+        self.sharded_update = False
         self.tensor_parallel = False
         self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
         self.pipeline = False
@@ -152,7 +157,15 @@ class DistributedStrategy:
         # legacy knobs kept for script compat; XLA owns these
         self.nccl_comm_num = 1
         self.use_hierarchical_allreduce = False
+        # gradient bucketing (ref: incubate/fleet/collective/__init__.py
+        # DistributedStrategy defaults fuse_all_reduce_ops on; size cap ref:
+        # BuildStrategy.fuse_grad_size_in_MB): per-leaf grad all-reduces
+        # coalesce into ≤⌈bytes/cap⌉ flat fused buckets per dtype
         self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        # bf16-compressed grad collectives (cast → all_reduce → upcast;
+        # EQuARX-style).  Parity bound documented in test_grad_comm.py.
+        self.bf16_allreduce = False
         self.mesh = None              # explicit jax Mesh override
         # execution/build strategies accepted and largely absorbed by XLA
         self.exec_strategy = None
@@ -287,10 +300,27 @@ class CollectiveOptimizer:
             raise ValueError(
                 "DistributedStrategy: lamb and use_dgc both replace the "
                 "base optimizer (LambOptimizer vs DGCMomentumOptimizer)")
+        sharded = getattr(s, "sharded_update", False) or \
+            getattr(s, "sharding", False)
+        if sharded and s.localsgd:
+            raise ValueError(
+                "DistributedStrategy: sharded_update needs the per-step "
+                "reduce_scatter grad sync that localsgd removes — the "
+                "combination is contradictory")
+        if sharded and s.use_dgc:
+            raise ValueError(
+                "DistributedStrategy: use_dgc masks top-k of the FULL "
+                "gradient; a shard-local top-k diverges across replicas — "
+                "sharded_update cannot compose with DGC")
+        if sharded and s.lamb:
+            raise ValueError(
+                "DistributedStrategy: lamb trust ratios need full-tensor "
+                "norms and cannot run on ZeRO shards — disable one")
 
-    def _compose(self, optimizer):
-        """Apply meta-optimizers in the reference's order: LAMB swap, AMP,
-        recompute, gradient merge (strategy_compiler.py ordering)."""
+    def _compose(self, optimizer, mesh=None):
+        """Apply meta-optimizers in the reference's order: LAMB swap,
+        ZeRO-1 sharded update, AMP, recompute, gradient merge
+        (strategy_compiler.py ordering)."""
         from .. import optimizer as opt_mod
         s = self._strategy
         self._validate(s)
@@ -309,6 +339,21 @@ class CollectiveOptimizer:
                 learning_rate=optimizer._learning_rate,
                 lamb_weight_decay=s.lamb_configs.get("lamb_weight_decay",
                                                      0.01))
+        if (getattr(s, "sharded_update", False) or
+                getattr(s, "sharding", False)) and mesh is not None and \
+                mesh.devices.size > 1:
+            if len(mesh.axis_names) != 1:
+                raise ValueError(
+                    "sharded_update currently shards over a single-axis "
+                    "(data-parallel) mesh; got axes "
+                    f"{tuple(mesh.axis_names)} — use CompiledProgram"
+                    ".with_mesh + ShardedUpdateOptimizer directly for "
+                    "hybrid grids")
+            optimizer = opt_mod.ShardedUpdateOptimizer(
+                optimizer, nranks=mesh.devices.size,
+                axis_name=mesh.axis_names[0],
+                compress_dtype="bfloat16" if getattr(s, "bf16_allreduce",
+                                                     False) else None)
         if s.amp:
             from ..contrib.mixed_precision import decorate
             optimizer = decorate(
@@ -332,16 +377,24 @@ class CollectiveOptimizer:
                 begin_step=s.localsgd_configs.get("begin_step", 1))
         return optimizer
 
+    def _build_strategy(self):
+        """Map the DistributedStrategy comm knobs onto the compiler's
+        BuildStrategy (the reference keeps them on BuildStrategy;
+        fleet mirrors them — incubate/fleet/collective/__init__.py)."""
+        from ..framework.compiler import BuildStrategy
+        s = self._strategy
+        build = s.build_strategy or BuildStrategy()
+        build.fuse_all_reduce_ops = bool(getattr(s, "fuse_all_reduce_ops",
+                                                 False))
+        build.fuse_grad_size_in_MB = getattr(s, "fuse_grad_size_in_MB", 32)
+        if getattr(s, "bf16_allreduce", False):
+            build.allreduce_compress_dtype = "bfloat16"
+        return build
+
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         fleet._ensure_init()
         fleet._strategy = self._strategy
-        optimizer = self._compose(self._inner)
-        opt_ops, params_grads = optimizer.minimize(
-            loss, startup_program, parameter_list, no_grad_set)
-
-        program = loss.block.program
-        fleet._origin_program = program
         mesh = self._strategy.mesh
         if mesh is None:
             import jax
@@ -349,15 +402,27 @@ class CollectiveOptimizer:
             devs = jax.devices()
             if len(devs) > 1:
                 mesh = Mesh(np.array(devs), ("dp",))
+        optimizer = self._compose(self._inner, mesh=mesh)
+        opt_ops, params_grads = optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+
+        program = loss.block.program
+        fleet._origin_program = program
         fleet._mesh = mesh
         if mesh is not None and mesh.devices.size > 1:
             from ..framework.compiler import CompiledProgram
             # LocalSGD replaces per-step grad allreduce with periodic param
-            # averaging (already appended by LocalSGDOptimizer) — pass
-            # loss_name=None so no grad allreduce is inserted
-            ln = None if self._strategy.localsgd else loss.name
+            # averaging (already appended by LocalSGDOptimizer), and the
+            # ZeRO-1 sharded update syncs grads with its own
+            # reduce_scatter — pass loss_name=None so no grad allreduce is
+            # inserted for either
+            sharded = getattr(self._strategy, "sharded_update", False) or \
+                getattr(self._strategy, "sharding", False)
+            ln = None if (self._strategy.localsgd or sharded) else loss.name
             fleet._compiled_program = CompiledProgram(
-                program).with_data_parallel(loss_name=ln, mesh=mesh)
+                program).with_data_parallel(
+                loss_name=ln, mesh=mesh,
+                build_strategy=self._build_strategy())
         else:
             fleet._compiled_program = None
         return opt_ops, params_grads

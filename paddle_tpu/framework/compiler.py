@@ -48,7 +48,16 @@ class BuildStrategy:
         self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
         self.gradient_scale_strategy = \
             BuildStrategy.GradientScaleStrategy.CoeffNumDevice
-        self.fuse_all_reduce_ops = True      # XLA fuses collectives itself
+        # gradient bucketing (ref: build_strategy.h fuse_all_reduce_ops +
+        # FLAGS_fuse_parameter_memory_size): coalesce per-leaf grad
+        # all-reduces into size-capped flat buckets.  Off by default like
+        # the reference's BuildStrategy; fleet's DistributedStrategy turns
+        # it on (mirroring the reference collective strategy default).
+        self.fuse_all_reduce_ops = False
+        self.fuse_grad_size_in_MB = 32
+        # optional compressed grad collectives: cast → all_reduce → upcast
+        # (EQuARX-style, bf16 granularity).  None = full precision.
+        self.allreduce_compress_dtype = None
         # off by default like the reference (build_strategy.h); XLA fuses
         # elementwise chains anyway — enabling only shrinks the op list
         self.fuse_elewise_add_act_ops = False
@@ -136,11 +145,25 @@ class CompiledProgram:
                                         n, axis_name=reduce_axes)
         return self
 
+    _DTYPE_BYTES = {"float64": 8, "int64": 8, "float32": 4, "int32": 4,
+                    "bfloat16": 2, "float16": 2, "int16": 2, "int8": 1,
+                    "uint8": 1, "bool": 1}
+
     def _insert_grad_allreduce(self, strategy, nranks, axis_name=None):
-        """Insert scale + c_allreduce_sum after the backward op for every
-        param grad — the exact rewrite of the reference's GradAllReduce
-        transpiler (transpiler/collective.py:190-226) minus the stream-sync
-        ops XLA makes unnecessary."""
+        """Insert the per-step gradient sync after the backward op — the
+        rewrite of the reference's GradAllReduce transpiler
+        (transpiler/collective.py:190-226) minus the stream-sync ops XLA
+        makes unnecessary.
+
+        Two shapes: per-leaf ``scale`` + ``c_allreduce_sum`` (the default,
+        one collective per gradient), or — with
+        ``strategy.fuse_all_reduce_ops`` — bucketed ``c_fused_allreduce_sum``
+        ops (ref: details/fused_all_reduce_op_handle.cc; BuildStrategy
+        fuse_all_reduce_ops + fuse_grad_size_in_MB), which coalesce the
+        grads into ≤N flat buckets partitioned by (dtype, reduce-axes) and
+        capped at ``fuse_grad_size_in_MB`` each.  The mean-loss 1/n scale
+        folds into the fused op, so a bucket of k grads replaces 2k ops
+        with one."""
         block = self._program.global_block()
         bw_idx = next((i for i, op in enumerate(block.ops)
                        if op.type == "backward"), None)
@@ -151,9 +174,14 @@ class CompiledProgram:
             return
         bw.attrs["_allreduce_inserted"] = True
         scale_strategy = strategy.gradient_scale_strategy
+        need_scale = scale_strategy == \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        compress = getattr(strategy, "allreduce_compress_dtype", None)
         insert_at = bw_idx + 1
         all_axes = axis_name if isinstance(axis_name, (tuple, list)) else \
             (axis_name or self._batch_axis or "dp",)
+
+        leaves = []          # (grad_name, p_axes, dtype, nbytes)
         for pname in bw.attrs["param_names"]:
             pvar = block._find_var_recursive(pname)
             if pvar is not None and getattr(pvar, "is_distributed", False):
@@ -164,18 +192,71 @@ class CompiledProgram:
             # keep the mean-loss 1/n scale, which is per-token not per-axis
             da = tuple(getattr(pvar, "dist_attr", None) or ())
             p_axes = tuple(a for a in all_axes if a not in da)
-            g = grad_var_name(pname)
-            if scale_strategy == BuildStrategy.GradientScaleStrategy.CoeffNumDevice:
-                block._insert_op(insert_at, type="scale",
-                                 inputs={"X": [g]}, outputs={"Out": [g]},
-                                 attrs={"scale": 1.0 / nranks})
-                insert_at += 1
-            if p_axes:
-                block._insert_op(insert_at, type="c_allreduce_sum",
-                                 inputs={"X": [g]}, outputs={"Out": [g]},
-                                 attrs={"ring_id": 0,
-                                        "_axis_name": tuple(p_axes)
-                                        if len(p_axes) > 1 else p_axes[0]})
+            dtype = str(getattr(pvar, "dtype", "float32") or "float32")
+            numel = int(abs(np.prod(pvar.shape))) if pvar is not None and \
+                len(tuple(pvar.shape)) else 1
+            nbytes = numel * self._DTYPE_BYTES.get(dtype, 4)
+            leaves.append((grad_var_name(pname), p_axes, dtype, nbytes))
+
+        if not getattr(strategy, "fuse_all_reduce_ops", False):
+            for g, p_axes, _, _ in leaves:
+                if need_scale:
+                    block._insert_op(insert_at, type="scale",
+                                     inputs={"X": [g]}, outputs={"Out": [g]},
+                                     attrs={"scale": 1.0 / nranks})
+                    insert_at += 1
+                if p_axes:
+                    attrs = {"ring_id": 0,
+                             "_axis_name": tuple(p_axes)
+                             if len(p_axes) > 1 else p_axes[0]}
+                    if compress:
+                        attrs["compress_dtype"] = compress
+                    block._insert_op(insert_at, type="c_allreduce_sum",
+                                     inputs={"X": [g]}, outputs={"Out": [g]},
+                                     attrs=attrs)
+                    insert_at += 1
+            return
+
+        # -- bucketed path ------------------------------------------------
+        cap_mb = getattr(strategy, "fuse_grad_size_in_MB", 32) or 0
+        cap = int(cap_mb * (1 << 20)) if cap_mb > 0 else None
+        groups = {}          # (dtype, p_axes) -> list of buckets
+        order = []
+        for g, p_axes, dtype, nbytes in leaves:
+            key = (dtype, p_axes)
+            if key not in groups:
+                groups[key] = [([], 0)]
+                order.append(key)
+            names, size = groups[key][-1]
+            if names and cap is not None and size + nbytes > cap:
+                groups[key].append(([g], nbytes))
+            else:
+                groups[key][-1] = (names + [g], size + nbytes)
+        for key in order:
+            dtype, p_axes = key
+            for names, _ in groups[key]:
+                if not p_axes:
+                    # nothing to reduce over (fully sharded param): the
+                    # mean-scale still applies, per leaf
+                    if need_scale:
+                        for g in names:
+                            block._insert_op(
+                                insert_at, type="scale",
+                                inputs={"X": [g]}, outputs={"Out": [g]},
+                                attrs={"scale": 1.0 / nranks})
+                            insert_at += 1
+                    continue
+                attrs = {"ring_id": 0,
+                         "_axis_name": tuple(p_axes)
+                         if len(p_axes) > 1 else p_axes[0]}
+                if need_scale:
+                    attrs["scale"] = 1.0 / nranks
+                if compress:
+                    attrs["compress_dtype"] = compress
+                block._insert_op(insert_at, type="c_fused_allreduce_sum",
+                                 inputs={"X": list(names)},
+                                 outputs={"Out": list(names)},
+                                 attrs=attrs)
                 insert_at += 1
 
     # pass-through conveniences so CompiledProgram quacks like Program

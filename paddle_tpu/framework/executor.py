@@ -956,9 +956,10 @@ class Executor:
                         {k: state_in_specs[k] for k in state_vals}, P())
             # fetches are merged to replicated inside the step; state keeps
             # its (possibly tp-sharded) layout
-            fn = jax.shard_map(step, mesh=mesh, in_specs=in_specs,
-                               out_specs=(P(), state_out_specs, P()),
-                               check_vma=False)
+            from .jax_compat import shard_map
+            fn = shard_map(step, mesh=mesh, in_specs=in_specs,
+                           out_specs=(P(), state_out_specs, P()),
+                           check_vma=False)
             return fn(feed_vals, state_vals, rng_key)
 
         return jax.jit(sharded, donate_argnums=(1,)), feed_spec, state_in_specs

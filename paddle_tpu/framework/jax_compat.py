@@ -1,0 +1,45 @@
+"""Version tolerance for the handful of jax APIs that moved out of
+``jax.experimental`` between releases.
+
+The framework targets the current jax surface (``jax.shard_map``,
+``jax.enable_x64``); on older runtimes those names live in
+``jax.experimental`` with slightly different keyword spellings
+(``check_rep`` vs ``check_vma``).  Everything routes through here so the
+rest of the codebase can use ONE spelling.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` with graceful fallback to
+    ``jax.experimental.shard_map.shard_map`` (where the no-replication-
+    check knob is spelled ``check_rep``)."""
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    kw = {} if check_vma is None else {"check_rep": bool(check_vma)}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` for mapped axes; on runtimes predating it,
+    ``psum(1, axis)`` — which jax constant-folds to the axis size."""
+    import jax.lax
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def enable_x64(new_val: bool = True):
+    """``jax.enable_x64`` context manager, falling back to
+    ``jax.experimental.enable_x64``."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(new_val)
+    from jax.experimental import enable_x64 as _enable_x64
+    return _enable_x64(new_val)

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, x
+from .registry import register, x, i64
 
 _NEG = -1e9
 
@@ -39,9 +39,9 @@ def _beam_search(ctx, ins, attrs):
             f"multiple of beam_size ({beam})")
     b = rows // beam
     if ids is None:
-        ids = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int64)[None, :],
+        ids = jnp.broadcast_to(jnp.arange(k, dtype=i64())[None, :],
                                (rows, k))
-    ids = ids.astype(jnp.int64)
+    ids = ids.astype(i64())
 
     cand = scores if accumulated else \
         pre_scores[:, None] + jnp.log(jnp.maximum(scores, 1e-30))
@@ -69,7 +69,7 @@ def _beam_search_decode(ctx, ins, attrs):
     beams into whole sentences.  Dense contract: Ids/Parents/Scores are
     the per-step outputs stacked time-major [T, B*beam]; backtracking is
     gather_tree semantics, then sequences are cut at the first end_id."""
-    ids = x(ins, "Ids").astype(jnp.int64)            # [T, R]
+    ids = x(ins, "Ids").astype(i64())            # [T, R]
     parents = x(ins, "Parents").astype(jnp.int32)    # [T, R]
     scores = x(ins, "Scores").astype(jnp.float32)    # [T, R]
     end_id = int(attrs["end_id"])

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .registry import register, x
+from .registry import register, x, i64
 
 
 @register("add_position_encoding")
@@ -248,7 +248,7 @@ def _py_func(ctx, ins, attrs):
 @register("max_sequence_len")
 def _max_sequence_len(ctx, ins, attrs):
     lens = x(ins, "RankTable")
-    return {"Out": jnp.max(lens).astype(jnp.int64)}
+    return {"Out": jnp.max(lens).astype(i64())}
 
 
 @register("select_input")

@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, x
+from .registry import register, x, i64
 
 
 # ---------------------------------------------------------------------------
@@ -390,7 +390,7 @@ def _conv_shift(ctx, ins, attrs):
 def _randperm(ctx, ins, attrs):
     n = int(attrs["n"])
     return {"Out": jax.random.permutation(ctx.next_key(), n).astype(
-        jnp.int64)}
+        i64())}
 
 
 @register("seed")
@@ -435,8 +435,8 @@ def _shuffle_batch(ctx, ins, attrs):
     a = x(ins, "X")
     key = ctx.next_key()
     perm = jax.random.permutation(key, a.shape[0])
-    return {"Out": a[perm], "ShuffleIdx": perm.astype(jnp.int64),
-            "SeedOut": jnp.zeros((1,), jnp.int64)}
+    return {"Out": a[perm], "ShuffleIdx": perm.astype(i64()),
+            "SeedOut": jnp.zeros((1,), i64())}
 
 
 @register("sequence_erase")
@@ -457,7 +457,7 @@ def _sequence_erase(ctx, ins, attrs):
     tgt = jnp.where(keep, pos, t - 1)
     out = out.at[bi.reshape(-1), tgt.reshape(-1)].max(
         jnp.where(keep, a, jnp.zeros_like(a)).reshape(-1))
-    return {"Out": out, "Length": jnp.sum(keep, 1).astype(jnp.int64)}
+    return {"Out": out, "Length": jnp.sum(keep, 1).astype(i64())}
 
 
 @register("sequence_topk_avg_pooling")

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .registry import register, x
+from .registry import register, x, i64
 
 
 # ---------------------------------------------------------------------------
@@ -50,7 +50,7 @@ def _unique(ctx, ins, attrs):
     s = jnp.sort(a)
     n_uniq = 1 + jnp.sum(s[1:] != s[:-1]) if n > 1 else jnp.asarray(n)
     return {"Out": uniq, "Index": idx.reshape(x(ins, "X").shape),
-            "Count": n_uniq.astype(jnp.int64)}
+            "Count": n_uniq.astype(i64())}
 
 
 @register("pad_constant_like")
@@ -108,7 +108,7 @@ def _sampling_id(ctx, ins, attrs):
     p = x(ins, "X")
     key = ctx.next_key()
     return {"Out": jax.random.categorical(
-        key, jnp.log(jnp.maximum(p, 1e-30)), axis=-1).astype(jnp.int64)}
+        key, jnp.log(jnp.maximum(p, 1e-30)), axis=-1).astype(i64())}
 
 
 @register("random_crop")
@@ -264,8 +264,8 @@ def _mean_iou(ctx, ins, attrs):
     present = union > 0
     iou = jnp.where(present, inter / jnp.maximum(union, 1e-9), 0.0)
     miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
-    return {"OutMeanIou": miou, "OutWrong": (ph - inter).astype(jnp.int64),
-            "OutCorrect": inter.astype(jnp.int64)}
+    return {"OutMeanIou": miou, "OutWrong": (ph - inter).astype(i64()),
+            "OutCorrect": inter.astype(i64())}
 
 
 # ---------------------------------------------------------------------------

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, x
+from .registry import register, x, i64
 
 NEG = -1e30
 
@@ -126,12 +126,12 @@ def _crf_decoding(ctx, ins, attrs):
         path = last_tag[:, None]
     tidx = jnp.arange(t)
     in_len = tidx[None, :] < lens[:, None]
-    path = jnp.where(in_len, path, 0).astype(jnp.int64)
+    path = jnp.where(in_len, path, 0).astype(i64())
     label = x(ins, "Label")
     if label is not None:
         label = label.reshape(b, -1)
         return {"ViterbiPath": jnp.where(
-            in_len, (path == label).astype(jnp.int64), 0)}
+            in_len, (path == label).astype(i64()), 0)}
     return {"ViterbiPath": path}
 
 
@@ -220,12 +220,12 @@ def _ctc_greedy_decoder(ctx, ins, attrs):
     in_len = jnp.arange(t)[None, :] < lens[:, None]
     keep = (tok != blank) & (tok != prev) & in_len
     pos = jnp.cumsum(keep, axis=1) - 1               # target slot
-    out = jnp.full((b, t), -1, jnp.int64)
+    out = jnp.full((b, t), -1, i64())
     bidx = jnp.repeat(jnp.arange(b)[:, None], t, 1)
     out = out.at[bidx.reshape(-1),
                  jnp.where(keep, pos, t - 1).reshape(-1)].max(
-        jnp.where(keep, tok, -1).astype(jnp.int64).reshape(-1))
-    return {"Output": out, "OutLength": jnp.sum(keep, 1).astype(jnp.int64)}
+        jnp.where(keep, tok, -1).astype(i64()).reshape(-1))
+    return {"Output": out, "OutLength": jnp.sum(keep, 1).astype(i64())}
 
 
 # ---------------------------------------------------------------------------
@@ -273,7 +273,7 @@ def _edit_distance(ctx, ins, attrs):
         step, row0, (jnp.broadcast_to(idx[:, None], (t1, b)),
                      jnp.moveaxis(hyp, 0, 1)))
     dist = jnp.take_along_axis(rows_final, rlen[:, None], 1)[:, 0]
-    seq_num = jnp.asarray(b, jnp.int64)
+    seq_num = jnp.asarray(b, i64())
     if normalized:
         dist = dist / jnp.maximum(rlen, 1)
     return {"Out": dist.reshape(-1, 1), "SequenceNum": seq_num}

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, x, get_op
+from .registry import register, x, get_op, i64
 
 
 # ---------------------------------------------------------------------------
@@ -129,9 +129,9 @@ def _chunk_eval(ctx, ins, attrs):
     return {"Precision": precision.reshape(1),
             "Recall": recall.reshape(1),
             "F1-Score": f1.reshape(1),
-            "NumInferChunks": n_infer.astype(jnp.int64).reshape(1),
-            "NumLabelChunks": n_label.astype(jnp.int64).reshape(1),
-            "NumCorrectChunks": n_correct.astype(jnp.int64).reshape(1)}
+            "NumInferChunks": n_infer.astype(i64()).reshape(1),
+            "NumLabelChunks": n_label.astype(i64()).reshape(1),
+            "NumCorrectChunks": n_correct.astype(i64()).reshape(1)}
 
 
 # ---------------------------------------------------------------------------
@@ -165,7 +165,7 @@ def _ctc_align(ctx, ins, attrs):
     rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t_len))
     out = out.at[rows, jnp.where(keep, pos, t_len)].set(tok2, mode="drop")
     out_len = jnp.sum(keep, axis=1).astype(
-        length.dtype if length is not None else jnp.int64)
+        length.dtype if length is not None else i64())
     return {"Output": out.reshape(tok.shape), "OutputLength": out_len}
 
 
@@ -253,14 +253,14 @@ def _sample_logits(ctx, ins, attrs):
     gather exactly as the reference's grad kernel does.
     """
     logits = x(ins, "Logits")                    # [N, C]
-    labels = x(ins, "Labels").astype(jnp.int64)  # [N, NT]
+    labels = x(ins, "Labels").astype(i64())  # [N, NT]
     n, num_classes = logits.shape
     num_true = labels.shape[1]
     s = int(attrs["num_samples"])
     remove_hits = bool(attrs.get("remove_accidental_hits", True))
 
     if attrs.get("use_customized_samples", False):
-        samples = x(ins, "CustomizedSamples").astype(jnp.int64)
+        samples = x(ins, "CustomizedSamples").astype(i64())
         probs = x(ins, "CustomizedProbabilities")
     else:
         seed = int(attrs.get("seed", 0))
@@ -268,7 +268,7 @@ def _sample_logits(ctx, ins, attrs):
         all_p = _log_uniform_prob(jnp.arange(num_classes), num_classes)
         g = jax.random.gumbel(key, (num_classes,)) + jnp.log(all_p)
         _, sampled = lax.top_k(g, s)             # unique, shared over batch
-        sampled = sampled.astype(jnp.int64)
+        sampled = sampled.astype(i64())
         samples = jnp.concatenate(
             [labels, jnp.broadcast_to(sampled[None, :], (n, s))], axis=1)
         p = _log_uniform_prob(samples, num_classes)
@@ -289,7 +289,7 @@ def _sample_logits(ctx, ins, attrs):
     logq = jnp.clip(jnp.log(probs), -1e20, 1e20)
     sampled_logits = sampled_logits - logq.astype(sampled_logits.dtype)
     sampled_labels = jnp.broadcast_to(
-        jnp.arange(num_true, dtype=jnp.int64)[None, :], (n, num_true))
+        jnp.arange(num_true, dtype=i64())[None, :], (n, num_true))
     return {"Samples": samples, "Probabilities": probs,
             "SampledLogits": sampled_logits, "SampledLabels": sampled_labels}
 
@@ -312,8 +312,8 @@ def _filter_by_instag(ctx, ins, attrs):
     Gradients flow to kept rows only (gather-based packing), matching the
     reference grad kernel's zero-fill of dropped rows."""
     ins_x = x(ins, "Ins")                        # [N, ...]
-    tags = x(ins, "Ins_tag").astype(jnp.int64)   # [N, K]
-    filt = x(ins, "Filter_tag").astype(jnp.int64).reshape(-1)   # [F]
+    tags = x(ins, "Ins_tag").astype(i64())   # [N, K]
+    filt = x(ins, "Filter_tag").astype(i64()).reshape(-1)   # [F]
     out_val = float(attrs.get("out_val_if_empty", 0))
 
     n = ins_x.shape[0]
@@ -337,7 +337,7 @@ def _filter_by_instag(ctx, ins, attrs):
     index_map = jnp.stack(
         [jnp.where(valid_out, jnp.arange(n), -1),
          jnp.where(valid_out, src, -1),
-         jnp.where(valid_out, rows_per, -1)], axis=1).astype(jnp.int64)
+         jnp.where(valid_out, rows_per, -1)], axis=1).astype(i64())
     loss_weight = valid_out.astype(jnp.float32).reshape(n, 1)
     return {"Out": out, "LossWeight": loss_weight, "IndexMap": index_map}
 

@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, x
+from .registry import register, x, i64
 
 
 def _unary(name, fn):
@@ -184,7 +184,7 @@ def _histogram(ctx, ins, attrs):
     if lo == 0 and hi == 0:
         lo, hi = jnp.min(a), jnp.max(a)
     h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
-    return {"Out": h.astype(jnp.int64)}
+    return {"Out": h.astype(i64())}
 
 
 @register("bincount")
@@ -237,7 +237,7 @@ def _kthvalue(ctx, ins, attrs):
     if attrs.get("keepdim", False):
         vals = jnp.expand_dims(vals, ax)
         inds = jnp.expand_dims(inds, ax)
-    return {"Out": vals, "Indices": inds.astype(jnp.int64)}
+    return {"Out": vals, "Indices": inds.astype(i64())}
 
 
 @register("mode")
@@ -259,7 +259,7 @@ def _mode(ctx, ins, attrs):
     if attrs.get("keepdim", False):
         vals = jnp.expand_dims(vals, ax)
         idx = jnp.expand_dims(idx, ax)
-    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+    return {"Out": vals, "Indices": idx.astype(i64())}
 
 
 # -- indexing / reshuffling -------------------------------------------------
@@ -334,8 +334,8 @@ def _unique_with_counts(ctx, ins, attrs):
     n = a.shape[0]
     vals, idx, counts = jnp.unique(a, size=n, fill_value=0,
                                    return_inverse=True, return_counts=True)
-    return {"Out": vals, "Index": idx.astype(jnp.int64).reshape(-1),
-            "Count": counts.astype(jnp.int64)}
+    return {"Out": vals, "Index": idx.astype(i64()).reshape(-1),
+            "Count": counts.astype(i64())}
 
 
 @register("shard_index")
@@ -367,7 +367,7 @@ def _masked_select(ctx, ins, attrs):
 def _tril_indices(ctx, ins, attrs):
     r, c = attrs["rows"], attrs["cols"]
     out = jnp.stack(jnp.tril_indices(r, attrs.get("offset", 0), c))
-    return {"Out": out.astype(jnp.int64)}
+    return {"Out": out.astype(i64())}
 
 
 @register("logcumsumexp")

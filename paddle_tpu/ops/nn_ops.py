@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, x
+from .registry import register, x, i64
 
 
 # ---------------------------------------------------------------------------
@@ -466,7 +466,7 @@ def _top_k(ctx, ins, attrs):
     a = x(ins, "X")
     k = attrs.get("k", 1)
     vals, idx = lax.top_k(a, k)
-    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+    return {"Out": vals, "Indices": idx.astype(i64())}
 
 
 @register("top_k_v2")
@@ -483,7 +483,7 @@ def _top_k_v2(ctx, ins, attrs):
     if axis not in (-1, a.ndim - 1):
         vals = jnp.moveaxis(vals, -1, axis)
         idx = jnp.moveaxis(idx, -1, axis)
-    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+    return {"Out": vals, "Indices": idx.astype(i64())}
 
 
 @register("arg_max")
@@ -493,7 +493,7 @@ def _arg_max(ctx, ins, attrs):
     out = jnp.argmax(a, axis=axis)
     if attrs.get("keepdims", False):
         out = jnp.expand_dims(out, axis)
-    return {"Out": out.astype(jnp.int64)}
+    return {"Out": out.astype(i64())}
 
 
 @register("arg_min")
@@ -503,7 +503,7 @@ def _arg_min(ctx, ins, attrs):
     out = jnp.argmin(a, axis=axis)
     if attrs.get("keepdims", False):
         out = jnp.expand_dims(out, axis)
-    return {"Out": out.astype(jnp.int64)}
+    return {"Out": out.astype(i64())}
 
 
 @register("argsort")
@@ -513,7 +513,7 @@ def _argsort(ctx, ins, attrs):
     desc = attrs.get("descending", False)
     idx = jnp.argsort(-a if desc else a, axis=axis)
     out = jnp.take_along_axis(a, idx, axis=axis)
-    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+    return {"Out": out, "Indices": idx.astype(i64())}
 
 
 @register("interp_nearest")

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, x
+from .registry import register, x, i64
 
 
 @register("tdm_child")
@@ -29,8 +29,8 @@ def _tdm_child(ctx, ins, attrs):
     is_item = (info[children.reshape(-1), 0] != 0).reshape(children.shape)
     mask = jnp.where(has_child[:, None], is_item.astype(jnp.int32), 0)
     out_shape = tuple(ids.shape) + (child_nums,)
-    return {"Child": children.reshape(out_shape).astype(jnp.int64),
-            "LeafMask": mask.reshape(out_shape).astype(jnp.int64)}
+    return {"Child": children.reshape(out_shape).astype(i64()),
+            "LeafMask": mask.reshape(out_shape).astype(i64())}
 
 
 @register("tdm_sampler")
@@ -88,11 +88,11 @@ def _tdm_sampler(ctx, ins, attrs):
         masks.append(jnp.where(valid_layer[:, None],
                                jnp.ones_like(stacked), 0))
     out = jnp.concatenate(outs, -1)
-    return {"Out": out.astype(jnp.int64)[..., None],
+    return {"Out": out.astype(i64())[..., None],
             "Labels": jnp.concatenate(labels, -1).astype(
-                jnp.int64)[..., None],
+                i64())[..., None],
             "Mask": jnp.concatenate(masks, -1).astype(
-                jnp.int64)[..., None]}
+                i64())[..., None]}
 
 
 @register("batch_fc")

@@ -73,3 +73,17 @@ def x(ins, slot, i=0):
     if not v:
         return None
     return v[i]
+
+
+def canonical_dtype(dtype):
+    """The dtype jax will actually use: int64 → int32 (float64 → float32)
+    when x64 is disabled — WITHOUT the per-site truncation UserWarning an
+    explicit ``astype(jnp.int64)`` fires on every trace.  Op impls that
+    produce the reference's int64 outputs (indices, lengths, counts) must
+    request dtypes through here so real warnings stay visible."""
+    return jax.dtypes.canonicalize_dtype(dtype)
+
+
+def i64():
+    """Canonical wide int (the reference's int64 index/length dtype)."""
+    return jax.dtypes.canonicalize_dtype("int64")

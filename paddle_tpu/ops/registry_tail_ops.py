@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, get_op, x
+from .registry import register, get_op, x, i64
 from .quant_ops import _qmax, _abs_max
 
 
@@ -94,7 +94,7 @@ def _max_pool3d_with_index(ctx, ins, attrs):
     best = jnp.argmax(stack, axis=0)
     out = jnp.take_along_axis(stack, best[None], axis=0)[0]
     mask = jnp.take_along_axis(istack, best[None], axis=0)[0]
-    return {"Out": out, "Mask": mask.astype(jnp.int64)}
+    return {"Out": out, "Mask": mask.astype(i64())}
 
 
 @register("split_lod_tensor")

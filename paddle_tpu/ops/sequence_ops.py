@@ -18,7 +18,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import register, x
+from .registry import register, x, i64
 
 
 def _length_mask(a, length, time_axis=1):
@@ -43,7 +43,7 @@ def _sequence_mask(ctx, ins, attrs):
             "max(length) would make the output shape data-dependent)")
     out_dtype = attrs.get("out_dtype", "int64")
     mask = jnp.arange(maxlen)[None, :] < lens[:, None]
-    return {"Y": mask.astype(jnp.int64 if out_dtype == "int64"
+    return {"Y": mask.astype(i64() if out_dtype == "int64"
                              else jnp.dtype(out_dtype))}
 
 

@@ -8,13 +8,17 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, x
+from .registry import register, x, canonical_dtype
 from ..framework.core import convert_dtype
 
 
 def _np_dtype(attrs, default="float32"):
-    return np.dtype(convert_dtype(attrs.get("dtype", default))) \
-        if convert_dtype(attrs.get("dtype", default)) != "bfloat16" else jnp.bfloat16
+    # canonicalized so int64/float64 requests under x64-off resolve to the
+    # 32-bit dtype jax would use anyway, without the per-trace truncation
+    # UserWarning (round-5 weak #5)
+    dt = convert_dtype(attrs.get("dtype", default))
+    return canonical_dtype(np.dtype(dt)) if dt != "bfloat16" \
+        else jnp.bfloat16
 
 
 # ---------------------------------------------------------------------------
@@ -335,6 +339,8 @@ def _tile(ctx, ins, attrs):
 @register("cast")
 def _cast(ctx, ins, attrs):
     dtype = convert_dtype(attrs.get("out_dtype", attrs.get("dtype", "float32")))
+    if dtype != "bfloat16":
+        dtype = canonical_dtype(dtype)
     return {"Out": x(ins, "X").astype(dtype)}
 
 

@@ -85,9 +85,9 @@ def xxh64_int64_rows(vals, seed):
     device feed path stores int64 ids as int32, so ids >= 2^31 reach this
     function already truncated and bucket differently from the reference
     (MIGRATION.md "Known gaps" scopes the compat claim accordingly)."""
-    import jax
+    from ..framework.jax_compat import enable_x64
 
-    with jax.enable_x64(True):
+    with enable_x64(True):
         u64 = jnp.uint64
         lanes = vals.astype(jnp.int64).astype(u64)
         n = lanes.shape[-1]
@@ -137,10 +137,10 @@ def xxh64_mod(vals, seed, mod_by):
     """``XXH64(row bytes, seed) % mod_by`` as an int32 bucket index —
     the remainder is taken in true 64-bit inside the x64 scope, then the
     (< mod_by) result is safe to carry back to 32-bit mode."""
-    import jax
+    from ..framework.jax_compat import enable_x64
 
     hi, lo = xxh64_int64_rows(vals, seed)
-    with jax.enable_x64(True):
+    with enable_x64(True):
         m = jnp.uint64(mod_by)
         h = (hi.astype(jnp.uint64) << jnp.uint64(32)) | \
             lo.astype(jnp.uint64)

@@ -13,7 +13,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import register, x
+from .registry import register, x, i64
 
 
 def _bce(logit, target):
@@ -134,4 +134,4 @@ def _yolov3_loss(ctx, ins, attrs):
     return {"Loss": loss,
             "ObjectnessMask": obj_mask,
             "GTMatchMask": jnp.where(gt_valid, mask_idx, -1).astype(
-                jnp.int64)}
+                i64())}

@@ -829,6 +829,155 @@ class GradientMergeOptimizer(Optimizer):
         return opt_ops, merged
 
 
+class ShardedUpdateOptimizer(Optimizer):
+    """ZeRO-1 sharded weight update (ref: "Automatic Cross-Replica
+    Sharding of Weight Update in Data-Parallel Training",
+    arXiv:2004.13336; the reference fleet's ``sharding`` stage-1).
+
+    Rewrites data-parallel grad sync + optimizer apply from
+
+        all_reduce(g);  p = update(p, g)            # every replica, full
+
+    into
+
+        g_shard = reduce_scatter(flat(g)) / n       # zero_reduce_scatter
+        p_shard = slice(flat(p))                    # zero_shard_slice
+        p_shard = update(p_shard, g_shard)          # inner optimizer op
+        p       = all_gather(p_shard)               # zero_all_gather
+
+    Optimizer accumulators are created at SHARD granularity (flat, padded
+    to n·⌈numel/n⌉, ``dist_attr`` over the data axis) so each replica
+    holds 1/n of the optimizer state — the ZeRO-1 memory saving — and the
+    update math runs on 1/n of the elements.  Wire bytes match one
+    all-reduce (reduce-scatter + all-gather).
+
+    Composition rules:
+      * only elementwise update rules may be sharded — LAMB/LARS need
+        full-tensor norms and are rejected;
+      * norm-based gradient clipping is rejected (a shard-local norm
+        would clip each replica differently); ``GradientClipByValue``
+        composes fine;
+      * tensor-parallel params (``dist_attr`` set) keep the classic
+        dense all-reduce + full update — ZeRO shards only the replicated
+        params.
+    """
+
+    _ELEMENTWISE = {"sgd", "momentum", "adam", "adamw", "adagrad",
+                    "decayed_adagrad", "rmsprop", "adadelta", "adamax",
+                    "ftrl", "dpsgd"}
+
+    def __init__(self, optimizer, nranks, axis_name="dp",
+                 compress_dtype=None):
+        base = getattr(optimizer, "type", None)
+        if base not in self._ELEMENTWISE:
+            raise ValueError(
+                f"sharded_update: optimizer type {base!r} is not an "
+                f"elementwise update rule (LAMB/LARS trust ratios need "
+                f"full-tensor norms) — supported: "
+                f"{sorted(self._ELEMENTWISE)}")
+        self._inner = optimizer
+        self._nranks = int(nranks)
+        self._axes = tuple(axis_name) if isinstance(axis_name, (tuple, list)) \
+            else (axis_name,)
+        self._compress = compress_dtype
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None, checkpoints=None):
+        return self._inner.backward(loss, startup_program, parameter_list,
+                                    no_grad_set, callbacks, checkpoints)
+
+    def _check_clip(self):
+        from .clip import (get_gradient_clip, GradientClipByNorm,
+                           GradientClipByGlobalNorm)
+        clip = self._inner._grad_clip or get_gradient_clip()
+        if isinstance(clip, (GradientClipByNorm, GradientClipByGlobalNorm)):
+            raise NotImplementedError(
+                "sharded_update: norm-based gradient clipping would use "
+                "shard-local norms (each replica clips differently) — "
+                "use GradientClipByValue or disable sharded_update")
+
+    def apply_gradients(self, params_grads):
+        self._check_clip()
+        prog = default_main_program()
+        block = prog.current_block()
+        n = self._nranks
+        data_axis = self._axes[0]
+        axis_attr = self._axes if len(self._axes) > 1 else data_axis
+        shard_pairs, gathers, plain = [], [], []
+        for p, g in params_grads:
+            if getattr(p, "dist_attr", None) or \
+                    getattr(p, "is_distributed", False):
+                plain.append((p, g))
+                continue
+            numel = int(np.prod(p.shape)) if len(tuple(p.shape)) else 1
+            padded = numel + (-numel % n)
+            gsh = block.create_var(
+                name=unique_name.generate(f"{p.name}_grad_zshard"),
+                shape=(padded,), dtype=p.dtype)
+            block.append_op(
+                type="zero_reduce_scatter", inputs={"X": [g]},
+                outputs={"Out": [gsh]},
+                attrs={"ring_id": 0, "_axis_name": axis_attr,
+                       "scale": 1.0 / n,
+                       **({"compress_dtype": self._compress}
+                          if self._compress else {})})
+            psh = block.create_var(
+                name=unique_name.generate(f"{p.name}_zshard"),
+                shape=(padded,), dtype=p.dtype)
+            # accumulators created from the shard var inherit this layout
+            # (flat, sharded over the data axis) — the ZeRO-1 state shard
+            psh.dist_attr = (data_axis,)
+            psh.regularizer = getattr(p, "regularizer", None)
+            psh.optimize_attrs = dict(getattr(p, "optimize_attrs", {}) or {})
+            psh.trainable = True
+            block.append_op(
+                type="zero_shard_slice", inputs={"X": [p]},
+                outputs={"Out": [psh]},
+                attrs={"ring_id": 0, "_axis_name": data_axis})
+            shard_pairs.append((psh, gsh))
+            gathers.append((psh, p, numel))
+        opt_ops = []
+        if shard_pairs:
+            opt_ops += self._inner.apply_gradients(shard_pairs)
+        for psh, p, numel in gathers:
+            opt_ops.append(block.append_op(
+                type="zero_all_gather", inputs={"X": [psh]},
+                outputs={"Out": [p]},
+                attrs={"ring_id": 0, "_axis_name": data_axis,
+                       "numel": numel, "shape": list(p.shape)}))
+        if plain:
+            # tp/ep-sharded params: classic mean-scale + dense all-reduce
+            # over the data axes their shards do NOT cover, full update
+            for p, g in plain:
+                da = tuple(getattr(p, "dist_attr", None) or ())
+                axes = tuple(a for a in self._axes if a not in da)
+                block.append_op(type="scale", inputs={"X": [g]},
+                                outputs={"Out": [g]},
+                                attrs={"scale": 1.0 / n})
+                if axes:
+                    block.append_op(
+                        type="c_allreduce_sum", inputs={"X": [g]},
+                        outputs={"Out": [g]},
+                        attrs={"ring_id": 0,
+                               "_axis_name": axes if len(axes) > 1
+                               else axes[0]})
+            opt_ops += self._inner.apply_gradients(plain)
+        return opt_ops
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .framework.core import program_guard
+        with program_guard(loss.block.program,
+                           startup_program or default_startup_program()):
+            params_grads = self.backward(loss, startup_program,
+                                         parameter_list, no_grad_set)
+            opt_ops = self.apply_gradients(params_grads)
+        return opt_ops, params_grads
+
+
 def _persistable_scalar(main, startup, prefix, value=0.0):
     """Create a persistable (1,) float32 var in main+startup, startup-filled
     with ``value``.  Shared by every step-counter/accumulator below."""

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..framework.jax_compat import axis_size
+
 
 def ring_attention(q, k, v, axis_name: str,
                    bias: Optional[jax.Array] = None,
@@ -40,7 +42,7 @@ def ring_attention(q, k, v, axis_name: str,
 
     Returns [B, H, S_local, D].
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))

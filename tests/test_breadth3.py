@@ -8,6 +8,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.ops.registry import OPS, LoweringContext
+from paddle_tpu.framework.jax_compat import shard_map
 
 
 def _ctx(**kw):
@@ -97,7 +98,7 @@ def test_sync_batch_norm_mesh_statistics():
             ctx, {"X": [xs]}, {"epsilon": 1e-5})
         return out["Y"], out["SavedMean"]
 
-    y, mean = jax.jit(jax.shard_map(
+    y, mean = jax.jit(shard_map(
         step, mesh=mesh, in_specs=P("dp"),
         out_specs=(P("dp"), P())))(xg)
     want_mean = xg.mean(axis=(0, 2, 3))

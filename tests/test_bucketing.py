@@ -99,6 +99,25 @@ def test_bucketed_loss_matches_maxpad():
     np.testing.assert_allclose(np.mean(lb), np.mean(lf), rtol=2e-5)
 
 
+def test_big_ladder_compile_census():
+    """The ladder-of-executables invariant at BENCH scale (the BIG
+    64/128/256 ladder, d_model 1024, 6 layers) — compile-only: 3 bucket
+    shapes produce exactly 3 executor cache entries, fresh same-shape
+    batches hit the cache, and the first bucket abstractly lowers to one
+    module.  Nothing executes, so the check is tier-1 cheap while proving
+    what TB_TINY could not: the invariant holds at transformer_bench's
+    real shapes."""
+    from tools.transformer_bench import ladder_compile_census
+
+    census = ladder_compile_census(ladder=(64, 128, 256), batch=8,
+                                   lower_buckets=1)
+    assert census["ladder"] == [64, 128, 256]
+    assert census["cache_entries"] == 3, census
+    assert census["compiles"] == 3, census
+    assert census["d_model"] == 1024 and census["n_layer"] == 6
+    assert census["lowered_bytes"][64] > 100_000   # a real traced module
+
+
 def test_dataloader_bucketed_sample_generator():
     """DataLoader(bucket_ladder=...) + a padding collate: every emitted
     batch is padded to its bucket and the stream covers all samples."""

@@ -8,6 +8,7 @@ import numpy as np
 import paddle_tpu.fluid as fluid
 from paddle_tpu.framework.core import Program, program_guard
 from paddle_tpu.framework.compiler import make_mesh
+from paddle_tpu.framework.jax_compat import shard_map
 
 
 def _build(seed=0):
@@ -119,7 +120,7 @@ def test_c_allreduce_prod_zeros_and_negatives():
         ctx = LoweringContext(jax.random.PRNGKey(0), mesh, ("dp",), False)
         return impl(ctx, {"X": [v]}, {"ring_id": 0})["Out"]
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         shard_fn, mesh=mesh,
         in_specs=jax.sharding.PartitionSpec("dp"),
         out_specs=jax.sharding.PartitionSpec("dp")))(vals)
@@ -129,7 +130,7 @@ def test_c_allreduce_prod_zeros_and_negatives():
     # one rank contributes a zero → exact 0, not NaN
     vals0 = vals.copy()
     vals0[3] = 0.0
-    out0 = jax.jit(jax.shard_map(
+    out0 = jax.jit(shard_map(
         shard_fn, mesh=mesh,
         in_specs=jax.sharding.PartitionSpec("dp"),
         out_specs=jax.sharding.PartitionSpec("dp")))(vals0)

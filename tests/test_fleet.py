@@ -44,7 +44,11 @@ def test_fleet_collective_trains_on_mesh():
         losses.append(float(l))
     assert losses[-1] < losses[0]
     types = [op.type for op in main.global_block().ops]
-    assert "c_allreduce_sum" in types
+    # fleet defaults to bucketed grad sync (strategy.fuse_all_reduce_ops,
+    # mirroring the reference collective DistributedStrategy default):
+    # one fused collective instead of one per gradient leaf
+    assert "c_fused_allreduce_sum" in types
+    assert "c_allreduce_sum" not in types
 
 
 def test_fleet_strategy_composition():
@@ -189,7 +193,7 @@ def test_fleet_full_bert_recipe_composition():
     assert "cast" in types                       # amp rewrite ran
     bw = next(op for op in block.ops if op.type == "backward")
     assert bw.attrs.get("checkpoints"), "recompute checkpoints not wired"
-    assert "c_allreduce_sum" in types            # collective dp
+    assert "c_fused_allreduce_sum" in types      # bucketed collective dp
 
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)

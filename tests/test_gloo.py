@@ -76,12 +76,35 @@ def test_gloo_prod_handles_zeros_and_negatives():
 
 
 _CHILD = r"""
+import os
 import sys
+import time
 import numpy as np
 from paddle_tpu.distributed.gloo import GlooContext
-rank, world, ep = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
-ctx = GlooContext(rank, world, ep, timeout=60.0)
+rank, world, ep_file = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+if rank == 0:
+    # bind an EPHEMERAL port (0) — a fixed port is a flake under suite
+    # ordering: an earlier test's socket in TIME_WAIT (or a stray child)
+    # makes the bind fail only when the whole suite runs.  The resolved
+    # endpoint is published through an atomic file rename.
+    ctx = GlooContext(0, world, "127.0.0.1:0", timeout=60.0)
+    tmp = ep_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(ctx.endpoint)
+    os.replace(tmp, ep_file)
+else:
+    deadline = time.monotonic() + 60.0
+    while not os.path.exists(ep_file):
+        if time.monotonic() > deadline:
+            raise TimeoutError("rank0 never published its endpoint")
+        time.sleep(0.05)
+    with open(ep_file) as f:
+        ep = f.read().strip()
+    ctx = GlooContext(rank, world, ep, timeout=60.0)
 s = ctx.all_reduce(np.asarray([rank + 1.0]))
+# the barrier both proves the rendezvous AND sequences the teardown:
+# every rank has its result before rank 0 may stop the hub, so no rank
+# can race a collective against server shutdown
 ctx.barrier()
 print("RESULT", float(np.asarray(s)[0]))
 if rank == 0:
@@ -91,9 +114,12 @@ if rank == 0:
 
 def test_gloo_across_real_processes(tmp_path):
     """Two real processes rendezvous over TCP (the DCN-tier proof,
-    pattern: ref test_collective_base.py launches localhost workers)."""
+    pattern: ref test_collective_base.py launches localhost workers).
+    Deterministic under suite load: ephemeral port + file handshake, no
+    fixed port to collide on."""
     script = tmp_path / "gloo_child.py"
     script.write_text(_CHILD)
+    ep_file = tmp_path / "gloo_endpoint"
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # a site hook on PYTHONPATH can re-register a hardware PJRT plugin and
@@ -102,13 +128,17 @@ def test_gloo_across_real_processes(tmp_path):
     for trigger in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_TPU_GEN",
                     "PALLAS_AXON_REMOTE_COMPILE"):
         env.pop(trigger, None)
-    port = 23451
     procs = [subprocess.Popen(
-        [sys.executable, str(script), str(r), "2", f"127.0.0.1:{port}"],
+        [sys.executable, str(script), str(r), "2", str(ep_file)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=env, cwd="/root/repo")
         for r in range(2)]
-    outs = [p.communicate(timeout=120) for p in procs]
+    try:
+        outs = [p.communicate(timeout=120) for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
     for p, (o, e) in zip(procs, outs):
         assert p.returncode == 0, (o, e)
         assert "RESULT 3.0" in o, (o, e)

@@ -1,0 +1,280 @@
+"""Gradient-communication optimization legs (the dp8 parity harness for
+the comm layer): bucketed fused all-reduce, bf16-compressed collectives,
+and the ZeRO-1 sharded weight update, each proven against the plain
+per-leaf dp8 baseline on the 8-device virtual CPU mesh and against
+single-device training (the existing parity-leg bound).
+
+Structural contracts (program-level op census) ride along: buckets
+respect the size cap, the sharded program carries reduce_scatter/
+all_gather and NO full-gradient all-reduce."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.distributed.fleet import (fleet, DistributedStrategy,
+                                          distributed_optimizer,
+                                          UserDefinedRoleMaker)
+
+STEPS = 4
+
+
+def _model():
+    x = fluid.layers.data("x", shape=[16])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, 32, act="relu",
+                        param_attr=fluid.ParamAttr(
+                            name="w1",
+                            initializer=fluid.initializer.Constant(0.05)),
+                        bias_attr=False)
+    h = fluid.layers.fc(h, 32, act="relu",
+                        param_attr=fluid.ParamAttr(
+                            name="w2",
+                            initializer=fluid.initializer.Constant(0.04)),
+                        bias_attr=False)
+    pred = fluid.layers.fc(h, 4, act="softmax",
+                           param_attr=fluid.ParamAttr(
+                               name="w3",
+                               initializer=fluid.initializer.Constant(0.05)),
+                           bias_attr=False)
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    return loss
+
+
+def _batches(n=STEPS):
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(n):
+        xs = rng.randn(64, 16).astype(np.float32)
+        ys = (xs.sum(1) > 0).astype(np.int64).reshape(-1, 1) * 3
+        out.append((xs, ys))
+    return out
+
+
+def _run_leg(mutate_strategy=None, optimizer=None, ndev=8):
+    """Train the model via the fleet surface; returns (losses, w1, program)."""
+    from paddle_tpu.framework.core import reset_default_programs
+    reset_default_programs()
+    main, startup = Program(), Program()
+    from jax.sharding import Mesh
+    with program_guard(main, startup):
+        loss = _model()
+        fleet.init(UserDefinedRoleMaker(0, 1))
+        strategy = DistributedStrategy()
+        if ndev > 1:
+            strategy.mesh = Mesh(np.array(jax.devices()[:ndev]), ("dp",))
+        else:
+            strategy.mesh = None
+        if mutate_strategy:
+            mutate_strategy(strategy)
+        opt = distributed_optimizer(
+            optimizer() if optimizer else fluid.optimizer.Adam(5e-3),
+            strategy)
+        opt.minimize(loss)
+    prog = fleet.main_program if ndev > 1 else main
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for xs, ys in _batches():
+            l, = exe.run(prog, feed={"x": xs, "label": ys},
+                         fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(())))
+        w1 = np.asarray(scope.find_var("w1"))
+    return losses, w1, main
+
+
+def _baseline_dp8():
+    def mut(s):
+        s.fuse_all_reduce_ops = False
+    return _run_leg(mut)
+
+
+# ---------------------------------------------------------------------------
+# dp8 + buckets
+# ---------------------------------------------------------------------------
+
+
+def test_dp8_bucketed_parity():
+    """Bucketing only restructures the collectives (concat → one
+    all_reduce → split); numerics match the per-leaf dp8 baseline to
+    ≤1e-6 rel and single-device training to the standard dp bound."""
+    base_l, base_w, _ = _baseline_dp8()
+
+    def mut(s):
+        s.fuse_all_reduce_ops = True
+    fused_l, fused_w, prog = _run_leg(mut)
+
+    np.testing.assert_allclose(base_l, fused_l, rtol=1e-6)
+    np.testing.assert_allclose(base_w, fused_w, rtol=1e-6)
+
+    types = [op.type for op in prog.global_block().ops]
+    assert "c_fused_allreduce_sum" in types
+    assert "c_allreduce_sum" not in types
+    # all 3 fp32 grads share one (dtype, axes) bucket under the default cap
+    assert types.count("c_fused_allreduce_sum") == 1
+    # the fold-in of the mean-scale removed the per-leaf scale ops too
+    bw = types.index("backward")
+    assert "scale" not in types[bw + 1:bw + 3]
+
+    single_l, single_w, _ = _run_leg(mutate_strategy=None, ndev=1)
+    np.testing.assert_allclose(single_l, fused_l, rtol=2e-3)
+
+
+def test_bucket_size_cap_partitions():
+    """fuse_grad_size_in_MB caps each flat bucket: with a cap smaller
+    than one w-matrix the three grads land in three buckets."""
+    def mut(s):
+        s.fuse_all_reduce_ops = True
+        s.fuse_grad_size_in_MB = 1e-4        # ~100 bytes
+    _, _, prog = _run_leg(mut)
+    types = [op.type for op in prog.global_block().ops]
+    assert types.count("c_fused_allreduce_sum") == 3
+
+
+# ---------------------------------------------------------------------------
+# dp8 + bf16-compressed all-reduce
+# ---------------------------------------------------------------------------
+
+
+def test_dp8_bf16_compressed_parity():
+    """bf16 grad collectives: same training trajectory within the
+    documented looser bound (bf16 has ~3 decimal digits; over 4 Adam
+    steps on this model the observed drift is <1e-2 rel — we bound at
+    5e-2 to keep the leg robust) and still learning."""
+    base_l, _, _ = _baseline_dp8()
+
+    def mut(s):
+        s.fuse_all_reduce_ops = True
+        s.bf16_allreduce = True
+    comp_l, _, prog = _run_leg(mut)
+
+    ops = prog.global_block().ops
+    fused = [op for op in ops if op.type == "c_fused_allreduce_sum"]
+    assert fused and all(op.attrs.get("compress_dtype") == "bfloat16"
+                         for op in fused)
+    np.testing.assert_allclose(base_l, comp_l, rtol=5e-2)
+    assert comp_l[-1] < comp_l[0]
+
+
+def test_bf16_compress_composes_with_per_leaf():
+    """compress_dtype also rides the un-fused per-leaf c_allreduce_sum."""
+    base_l, _, _ = _baseline_dp8()
+
+    def mut(s):
+        s.fuse_all_reduce_ops = False
+        s.bf16_allreduce = True
+    comp_l, _, prog = _run_leg(mut)
+    ops = prog.global_block().ops
+    leaf = [op for op in ops if op.type == "c_allreduce_sum"]
+    assert leaf and all(op.attrs.get("compress_dtype") == "bfloat16"
+                        for op in leaf)
+    np.testing.assert_allclose(base_l, comp_l, rtol=5e-2)
+
+
+# ---------------------------------------------------------------------------
+# dp8 + ZeRO-1 sharded update
+# ---------------------------------------------------------------------------
+
+
+def test_dp8_sharded_update_parity():
+    """reduce_scatter → sharded Adam → all_gather matches the dense dp8
+    baseline to ≤1e-6 rel (same update math, 1/8 of it per replica) and
+    the program carries NO full-gradient all-reduce."""
+    base_l, base_w, _ = _baseline_dp8()
+
+    def mut(s):
+        s.sharded_update = True
+    sh_l, sh_w, prog = _run_leg(mut)
+
+    np.testing.assert_allclose(base_l, sh_l, rtol=1e-6)
+    np.testing.assert_allclose(base_w, sh_w, rtol=1e-6)
+
+    types = [op.type for op in prog.global_block().ops]
+    assert types.count("zero_reduce_scatter") == 3
+    assert types.count("zero_shard_slice") == 3
+    assert types.count("zero_all_gather") == 3
+    assert "c_allreduce_sum" not in types
+    assert "c_fused_allreduce_sum" not in types
+
+    single_l, _, _ = _run_leg(mutate_strategy=None, ndev=1)
+    np.testing.assert_allclose(single_l, sh_l, rtol=2e-3)
+
+
+def test_sharded_update_shards_optimizer_state():
+    """The ZeRO-1 memory claim: Adam moment accumulators are declared at
+    flat padded-numel size with dist_attr over dp, so each replica's
+    scope shard holds 1/8 of the state."""
+    def mut(s):
+        s.sharded_update = True
+    _, _, prog = _run_leg(mut)
+    accs = [v for n, v in prog.global_block().vars.items()
+            if "_zshard" in n and "moment" in n]
+    assert len(accs) == 6            # 3 params × 2 Adam moments
+    for v in accs:
+        assert tuple(getattr(v, "dist_attr", ())) == ("dp",)
+        assert len(v.shape) == 1     # flat ZeRO shard layout
+
+
+def test_sharded_update_sgd_and_momentum():
+    """The rewrite is optimizer-generic over elementwise rules."""
+    def mut(s):
+        s.sharded_update = True
+    for make in (lambda: fluid.optimizer.SGD(0.2),
+                 lambda: fluid.optimizer.Momentum(0.1, momentum=0.9)):
+        base_l, base_w, _ = _run_leg(
+            lambda s: setattr(s, "fuse_all_reduce_ops", False),
+            optimizer=make)
+        sh_l, sh_w, _ = _run_leg(mut, optimizer=make)
+        np.testing.assert_allclose(base_l, sh_l, rtol=1e-6)
+        np.testing.assert_allclose(base_w, sh_w, rtol=1e-6)
+
+
+def test_sharded_update_rejects_norm_clip_and_lamb():
+    def mut(s):
+        s.sharded_update = True
+    with pytest.raises(NotImplementedError, match="norm"):
+        _run_leg(mut, optimizer=lambda: fluid.optimizer.Adam(
+            1e-3, grad_clip=fluid.clip.GradientClipByGlobalNorm(1.0)))
+
+    s = DistributedStrategy()
+    s.sharded_update = True
+    s.lamb = True
+    from paddle_tpu.distributed.fleet import CollectiveOptimizer
+    with pytest.raises(ValueError, match="lamb"):
+        CollectiveOptimizer._validate(s)
+
+
+# ---------------------------------------------------------------------------
+# composition
+# ---------------------------------------------------------------------------
+
+
+def test_buckets_compose_with_amp_and_gradient_merge():
+    """The bucketed sync rides the composed AMP + gradient-merge recipe
+    (grads all-reduce every micro-step, apply gated at k=2)."""
+    def mut(s):
+        s.fuse_all_reduce_ops = True
+        s.amp = True
+        s.gradient_merge = True
+        s.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    losses, _, prog = _run_leg(mut)
+    types = [op.type for op in prog.global_block().ops]
+    assert "c_fused_allreduce_sum" in types
+    assert "cast" in types           # amp rewrite ran
+    assert all(np.isfinite(losses))
+
+
+def test_sharded_update_composes_with_amp():
+    def mut(s):
+        s.sharded_update = True
+        s.amp = True
+    losses, _, prog = _run_leg(mut)
+    types = [op.type for op in prog.global_block().ops]
+    assert "zero_reduce_scatter" in types
+    assert "cast" in types
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]

@@ -242,7 +242,7 @@ class TestSampleLogits:
 
     def test_logq_subtraction(self):
         logits = jnp.zeros((1, 20), jnp.float32)
-        labels = jnp.asarray([[3]], dtype=jnp.int64)
+        labels = jnp.asarray(np.asarray([[3]], np.int64))
         out = get_op("sample_logits")(
             ctx(), {"Logits": [logits], "Labels": [labels]},
             {"num_samples": 5, "remove_accidental_hits": False})
@@ -256,8 +256,8 @@ class TestSampleLogits:
 
     def test_accidental_hits_masked(self):
         logits = jnp.zeros((1, 6), jnp.float32)
-        labels = jnp.asarray([[2]], dtype=jnp.int64)
-        custom = jnp.asarray([[2, 2, 4]], dtype=jnp.int64)   # negative == true
+        labels = jnp.asarray(np.asarray([[2]], np.int64))
+        custom = jnp.asarray(np.asarray([[2, 2, 4]], np.int64))   # negative == true
         cprobs = jnp.full((1, 3), 0.5, jnp.float32)
         out = get_op("sample_logits")(
             ctx(), {"Logits": [logits], "Labels": [labels],
@@ -273,7 +273,7 @@ class TestSampleLogits:
     def test_grad_scatters_back(self):
         rng = np.random.RandomState(1)
         logits = jnp.asarray(rng.randn(2, 30).astype(np.float32))
-        labels = jnp.asarray([[0], [1]], dtype=jnp.int64)
+        labels = jnp.asarray(np.asarray([[0], [1]], np.int64))
 
         def f(lg):
             out = get_op("sample_logits")(
@@ -316,8 +316,8 @@ def test_filter_by_instag_packs_and_weights():
 
 def test_filter_by_instag_grads_only_to_kept():
     ins = jnp.asarray(np.ones((3, 2), np.float32))
-    tags = jnp.asarray([[5], [1], [5]], dtype=jnp.int64)
-    filt = jnp.asarray([5], dtype=jnp.int64)
+    tags = jnp.asarray(np.asarray([[5], [1], [5]], np.int64))
+    filt = jnp.asarray(np.asarray([5], np.int64))
 
     def f(v):
         out = get_op("filter_by_instag")(

@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 
 import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.jax_compat import shard_map
 from paddle_tpu.framework.core import Program, program_guard
 from paddle_tpu import parallel
 from paddle_tpu.parallel import build_mesh
@@ -140,7 +141,7 @@ def test_ring_attention_matches_full_attention():
     def f(q, k, v):
         return ring_attention(q, k, v, "sp")
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         f, mesh=mesh,
         in_specs=(P(None, None, "sp"), P(None, None, "sp"),
                   P(None, None, "sp")),
@@ -165,7 +166,7 @@ def test_ring_attention_causal():
     ref = np.einsum("bhqk,bhkd->bhqd", p, v)
 
     mesh = build_mesh({"sp": 4})
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda q, k, v: ring_attention(q, k, v, "sp", causal=True),
         mesh=mesh,
         in_specs=(P(None, None, "sp"),) * 3,
@@ -190,7 +191,7 @@ def test_gpipe_spmd_matches_sequential():
     def stage(w, x):
         return jnp.tanh(x @ w[0])        # w: [1, dim, dim] local slice
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda w, x: parallel.gpipe_spmd(stage, w, x, "pp"),
         mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
         check_vma=False))(ws, xs)

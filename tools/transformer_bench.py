@@ -156,12 +156,102 @@ def bench_ernie(virtual):
     }))
 
 
+def ladder_compile_census(ladder=(64, 128, 256), batch=8, lower_buckets=1,
+                          tiny=False):
+    """Compile-only proof of the ladder-of-executables invariant at BIG
+    bench scale (SURVEY hard part #3): build the Transformer-big train
+    program, present one ragged batch per ladder step, and count executor
+    cache entries — exactly one per bucket shape, zero per extra batch.
+    Nothing executes: the startup program never runs and the per-bucket
+    check goes through ``Executor._compile`` (cache identity) plus an
+    abstract ``jax.jit(...).lower`` on the first bucket (shape-only
+    tracing via ShapeDtypeStruct), so the check is cheap enough for
+    tier-1 while still exercising the bench-scale model.
+
+    Returns a dict census: buckets given, cache entries created, compile
+    counter delta, and the lowered module size for the traced bucket.
+    """
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import transformer
+    from paddle_tpu.framework.core import reset_default_programs
+    from paddle_tpu.framework.executor import global_scope
+    from paddle_tpu.monitor import stat
+
+    reset_default_programs()
+    global_scope().drop_all()
+    cfg = transformer.TransformerConfig.tiny() if tiny \
+        else transformer.TransformerConfig.big()
+    cfg.max_length = max(ladder)
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        feeds, loss, logits = transformer.build_train_network(cfg)
+        fluid.optimizer.Adam(1e-4).minimize(loss)
+
+    rng = np.random.RandomState(0)
+
+    def batch_for(bucket_len):
+        lo = 2 if bucket_len == min(ladder) else \
+            ladder[ladder.index(bucket_len) - 1] + 1
+        lengths = rng.randint(lo, bucket_len, batch)
+        src = [list(rng.randint(3, 100, l)) for l in lengths]
+        trg = [list(rng.randint(3, 100, max(2, l - 1))) for l in lengths]
+        return transformer.make_batch(src, trg, cfg, bucket_ladder=ladder)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    before = stat("executor_compile_count").get()
+    steps = {}
+    with fluid.scope_guard(scope):
+        for b_len in ladder:
+            feed = {k: np.asarray(v) for k, v in batch_for(b_len).items()}
+            assert feed["src_ids"].shape[1] == b_len, \
+                (feed["src_ids"].shape, b_len)
+            steps[b_len] = (exe._compile(main_p, feed, [loss.name], scope,
+                                         None, (), None), feed)
+        # a fresh same-shape batch must hit the cache, not compile
+        for b_len in ladder:
+            step2, _ = steps[b_len]
+            feed = {k: np.asarray(v) for k, v in batch_for(b_len).items()}
+            again = exe._compile(main_p, feed, [loss.name], scope, None,
+                                 (), None)
+            assert again is step2, f"bucket {b_len} re-compiled"
+    compiles = stat("executor_compile_count").get() - before
+    distinct = len({id(s) for s, _ in steps.values()})
+
+    # abstract lowering of the first bucket(s): proves the bench-scale
+    # step TRACES to one module per bucket without touching a device
+    block = main_p.global_block()
+    lowered_bytes = {}
+    for b_len in ladder[:lower_buckets]:
+        step, feed = steps[b_len]
+        abstract_feed = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                         for k, v in feed.items()}
+        state = {}
+        for n in step.state_in_names:
+            v = block._find_var_recursive(n)
+            state[n] = jax.ShapeDtypeStruct(
+                tuple(v.shape), np.dtype(str(v.dtype)))
+        key = jax.ShapeDtypeStruct((2,), np.uint32)
+        lowered = jax.jit(step.raw_fn).lower(abstract_feed, state, key)
+        lowered_bytes[b_len] = len(lowered.as_text())
+    return {"ladder": list(ladder), "cache_entries": distinct,
+            "compiles": compiles, "lowered_bytes": lowered_bytes,
+            "d_model": cfg.d_model, "n_layer": cfg.n_layer}
+
+
 def main():
     virtual = bool(os.environ.get("TB_VIRTUAL"))
-    if virtual:
+    if virtual or os.environ.get("TB_COMPILE_ONLY"):
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
         jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("TB_COMPILE_ONLY"):
+        census = ladder_compile_census(tiny=bool(os.environ.get("TB_TINY")))
+        print(json.dumps({"metric": "transformer_big_ladder_compile_census",
+                          **census}))
+        return
     bench_transformer(virtual)
     bench_ernie(virtual)
 

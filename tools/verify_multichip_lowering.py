@@ -5,63 +5,156 @@ the XLA collectives in the compiled TPU module — the sharded path's
 grad all-reduces, Megatron f/g pair, and ring-attention permutes are
 checked invariants, not claims.
 
-Usage: PYTHONPATH=/root/repo python tools/verify_multichip_lowering.py [out.txt]
+Since the grad-comm PR the report is a per-collective CENSUS (op kind,
+count, total payload bytes) emitted as a JSON artifact next to the text
+report, and ``collective_census``/``donation_ratio`` are importable by
+the tier-1 tests that assert the bucketed-collective bound
+(tests/test_tpu_lowering.py).
+
+Usage: PYTHONPATH=/root/repo python tools/verify_multichip_lowering.py [out.txt [census.json]]
 """
 
-import os, re
-os.environ['JAX_PLATFORMS'] = 'cpu'
-os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8').strip()
-import jax; jax.config.update('jax_platforms', 'cpu')
-import numpy as np, sys
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-import paddle_tpu.fluid as fluid
-from paddle_tpu.models import bert
-from paddle_tpu.parallel import build_mesh
-from paddle_tpu.ops.pallas import lowering_target
-from jax import export as jexp
+import json
+import os
+import re
+import sys
 
-devs = jax.devices()[:8]
-mesh = build_mesh({"dp": 2, "tp": 2, "sp": 2}, devs)
-cfg = bert.BertConfig.tiny()
-main, startup = fluid.Program(), fluid.Program()
-with fluid.program_guard(main, startup):
-    feeds, loss = bert.build_pretrain_network_parallel(cfg, tp_degree=2, seq_axis="sp")
-    fluid.optimizer.Adam(1e-4).minimize(loss)
-from jax.sharding import PartitionSpec as P
-feed_specs = {f.name: P("dp", "sp") for f in feeds}
-# NOT dead code: with_mesh MUTATES `main` in place — it inserts the
-# scale + c_allreduce_sum grad-sync ops over dp and sp (the
-# GradAllReduce transpiler rewrite); without it the lowered module
-# carries only the Megatron/ring collectives (15 all_reduce vs 53)
-fluid.CompiledProgram(main).with_mesh(
-    mesh, loss_name=loss.name, batch_axis="dp", seq_axis="sp",
-    feed_specs=feed_specs)
-exe = fluid.Executor()
-scope = fluid.Scope()
-rng = np.random.RandomState(0)
-batch = bert.make_fake_parallel_batch(rng, cfg, batch_size=4, seq_len=64)
-with fluid.scope_guard(scope):
-    exe.run(startup)
-    feed = {k: np.asarray(v) for k, v in batch.items()}
-    step = exe._compile(main, feed, [loss.name], scope, mesh, tuple(mesh.axis_names), "dp", seq_axis="sp", feed_specs=feed_specs)
-    state = {n: np.asarray(scope.find_var(n)) for n in step.state_in_names}
-    key = jax.random.PRNGKey(0)
-    with lowering_target('tpu'):
-        exported = jexp.export(step.fn, platforms=('tpu',))(feed, state, key)
-txt = exported.mlir_module()
-colls = {}
-for name in ("all_reduce", "all_gather", "collective_permute", "all_to_all", "reduce_scatter"):
-    n = txt.count(f"stablehlo.{name}")
-    if n: colls[name] = n
-lines = [
-    "Multi-chip TPU cross-lowering (dp2 x tp2 x sp2 BERT-tiny train step)",
-    f"platforms: {tuple(exported.platforms)}",
-    f"module bytes: {len(txt)}",
-    f"collectives: {colls}",
-    f"verdict: {'OK' if colls.get('all_reduce', 0) >= 10 and colls.get('collective_permute', 0) >= 3 else 'MISSING COLLECTIVES'}",
-]
-out = "\n".join(lines)
-print(out)
-if len(sys.argv) > 1:
-    with open(sys.argv[1], "w") as f:
-        f.write(out + "\n")
+COLLECTIVES = ("all_reduce", "all_gather", "collective_permute",
+               "all_to_all", "reduce_scatter")
+
+_DTYPE_BYTES = {"f64": 8, "i64": 8, "u64": 8, "f32": 4, "i32": 4, "u32": 4,
+                "bf16": 2, "f16": 2, "i16": 2, "u16": 2, "i8": 1, "u8": 1,
+                "i1": 1}
+
+
+def _tensor_bytes(ty):
+    """bytes of one 'NxMx...xdtype' tensor type string."""
+    parts = ty.split("x")
+    dtype = parts[-1]
+    n = 1
+    for d in parts[:-1]:
+        try:
+            n *= int(d)
+        except ValueError:
+            return 0           # dynamic dim — don't count
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def collective_census(mlir_txt):
+    """Per-collective census of a StableHLO module: op kind → {count,
+    bytes} where bytes is the summed payload (result tensors) moved by
+    that collective kind.  Region-carrying ops (all_reduce,
+    reduce_scatter) print their type on the closing ``}) : ... ->``
+    line; region-free ops carry it inline."""
+    census = {k: {"count": 0, "bytes": 0} for k in COLLECTIVES}
+    pending = None
+    for line in mlir_txt.splitlines():
+        m = re.search(r"stablehlo\.(\w+)", line)
+        kind = m.group(1) if m and m.group(1) in COLLECTIVES else None
+        if kind:
+            census[kind]["count"] += 1
+            if "->" not in line:
+                pending = kind       # type comes on the region-close line
+                continue
+            target = kind
+        elif pending and "->" in line and line.lstrip().startswith("})"):
+            target, pending = pending, None
+        else:
+            continue
+        res = line.rsplit("->", 1)[-1]
+        for ty in re.findall(r"tensor<([^>]+)>", res):
+            census[target]["bytes"] += _tensor_bytes(ty)
+    return {k: v for k, v in census.items() if v["count"]}
+
+
+def donation_ratio(mlir_txt):
+    """(donated_args, total_args) of @main — the buffer-donation census
+    (tf.aliasing_output annotations; the XLA image of the reference's
+    inplace/memory-reuse passes)."""
+    sig = re.search(r"func\.func public @main\((.*?)\)\s*->", mlir_txt,
+                    re.DOTALL).group(1)
+    total = sig.count("tensor<")
+    donated = sig.count("tf.aliasing_output")
+    return donated, total
+
+
+def main():
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') +
+                               ' --xla_force_host_platform_device_count=8'
+                               ).strip()
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import numpy as np
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel import build_mesh
+    from paddle_tpu.ops.pallas import lowering_target
+    from jax import export as jexp
+
+    devs = jax.devices()[:8]
+    mesh = build_mesh({"dp": 2, "tp": 2, "sp": 2}, devs)
+    cfg = bert.BertConfig.tiny()
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        feeds, loss = bert.build_pretrain_network_parallel(
+            cfg, tp_degree=2, seq_axis="sp")
+        fluid.optimizer.Adam(1e-4).minimize(loss)
+    from jax.sharding import PartitionSpec as P
+    feed_specs = {f.name: P("dp", "sp") for f in feeds}
+    # NOT dead code: with_mesh MUTATES `main_p` in place — it inserts the
+    # scale + c_allreduce_sum grad-sync ops over dp and sp (the
+    # GradAllReduce transpiler rewrite); without it the lowered module
+    # carries only the Megatron/ring collectives (15 all_reduce vs 53)
+    fluid.CompiledProgram(main_p).with_mesh(
+        mesh, loss_name=loss.name, batch_axis="dp", seq_axis="sp",
+        feed_specs=feed_specs)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    batch = bert.make_fake_parallel_batch(rng, cfg, batch_size=4, seq_len=64)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {k: np.asarray(v) for k, v in batch.items()}
+        step = exe._compile(main_p, feed, [loss.name], scope, mesh,
+                            tuple(mesh.axis_names), "dp", seq_axis="sp",
+                            feed_specs=feed_specs)
+        state = {n: np.asarray(scope.find_var(n))
+                 for n in step.state_in_names}
+        key = jax.random.PRNGKey(0)
+        with lowering_target('tpu'):
+            exported = jexp.export(step.fn, platforms=('tpu',))(feed, state,
+                                                                key)
+    txt = exported.mlir_module()
+    census = collective_census(txt)
+    donated, total = donation_ratio(txt)
+    counts = {k: v["count"] for k, v in census.items()}
+    lines = [
+        "Multi-chip TPU cross-lowering (dp2 x tp2 x sp2 BERT-tiny train step)",
+        f"platforms: {tuple(exported.platforms)}",
+        f"module bytes: {len(txt)}",
+        f"collectives: {counts}",
+        "census (count / payload bytes): " + ", ".join(
+            f"{k}={v['count']}/{v['bytes']}" for k, v in census.items()),
+        f"arg donation: {donated}/{total}",
+        f"verdict: {'OK' if counts.get('all_reduce', 0) >= 10 and counts.get('collective_permute', 0) >= 3 else 'MISSING COLLECTIVES'}",
+    ]
+    out = "\n".join(lines)
+    print(out)
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            f.write(out + "\n")
+    census_path = sys.argv[2] if len(sys.argv) > 2 else (
+        os.path.splitext(sys.argv[1])[0] + "_census.json"
+        if len(sys.argv) > 1 else None)
+    if census_path:
+        with open(census_path, "w") as f:
+            json.dump({"module": "dp2xtp2xsp2_bert_tiny_train",
+                       "census": census,
+                       "arg_donation": [donated, total]}, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
