@@ -361,6 +361,23 @@ class DataLoader:
         return self._wrap_device(_PrefetchIterator(self._produce,
                                                    self.capacity))
 
+    def run_prepared(self, prepared):
+        """Drive a ``PreparedStep`` from this loader: batches flow from
+        the prefetch thread through the double-buffer device stage (the
+        H2D copy for batch N+1 is in flight while step N computes, ref:
+        operators/reader/buffered_reader.cc:92) straight into
+        ``prepared.run`` — no host round trip between the staged device
+        batch and dispatch.  Yields each step's FetchHandle list, so the
+        loop stays fully asynchronous until a handle is read."""
+        it = iter(self)
+        try:
+            for feed in it:
+                yield prepared.run(feed)
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+
     def __len__(self):
         if self.batch_sampler is not None:
             return len(self.batch_sampler)

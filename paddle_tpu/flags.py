@@ -52,6 +52,13 @@ _register("use_pallas_fused", True)        # fused LN/bias-gelu/adam kernels
 # (ref: operators/reader/buffered_reader.cc:92 double-buffer slots)
 _register("cache_feed_arrays", True)
 _register("benchmark", False)              # ref: flags.cc benchmark
+# prepared fast path (Executor.prepare): how many steps the host may run
+# ahead of the device before blocking once on the oldest in-flight step —
+# backpressure instead of lockstep (the role ExecutionStrategy's
+# num_iteration_per_drop_scope plays for the reference's scope churn,
+# ref: details/execution_strategy.h).  0 disables the window (unbounded
+# run-ahead; fetch reads are then the only device syncs).
+_register("max_inflight_steps", 2)
 _register("print_executor_cache_hits", False)
 # accepted no-ops: XLA owns these concerns (ref: flags.cc lines noted)
 _register("fraction_of_gpu_memory_to_use", 0.92, noop=True)   # :343

@@ -11,7 +11,8 @@ from ..framework.core import (Program, Variable, Parameter,  # noqa: F401
                               CPUPlace, TPUPlace, CUDAPlace,
                               is_compiled_with_tpu)
 from ..framework.executor import (Executor, Scope, global_scope,  # noqa: F401
-                                  scope_guard)
+                                  scope_guard, PreparedStep, FetchHandle,
+                                  sync_prepared_state)
 from ..framework.backward import append_backward, gradients  # noqa: F401
 from ..framework.compiler import (CompiledProgram, BuildStrategy,  # noqa: F401
                                   ExecutionStrategy)

@@ -259,6 +259,44 @@ class CompiledProgram:
                                  attrs=attrs)
                 insert_at += 1
 
+    # retained pass-variant clones (one per fetch list) — bounds memory
+    # for fetch-list-churny eval loops while keeping the hot lists cached
+    _VARIANT_CAP = 8
+
+    def _variant_for(self, fetch_names):
+        """Resolve the pass-rewritten program clone for this fetch list.
+
+        Strategy passes (fuse_elemwise_add_act, ...) run against a clone
+        per fetch list so fetched intermediates survive and run order
+        doesn't matter.  The clone cache is a true LRU: a hit promotes the
+        variant (``move_to_end``), so alternating between a hot train
+        fetch list and a rotating set of eval lists evicts the cold eval
+        clones — not the hottest variant, which the old insertion-order
+        pop hit first and recompiled every cycle.
+
+        Returns ``(program, evicted_uid)``; a non-None ``evicted_uid`` is
+        the dropped clone's ``_uid`` so the executor can purge its
+        compiled steps."""
+        if not self._pending_passes:
+            return self._program, None
+        from collections import OrderedDict
+        variants = self.__dict__.setdefault("_pass_variants", OrderedDict())
+        vkey = tuple(fetch_names)
+        hit = variants.get(vkey)
+        if hit is not None:
+            variants.move_to_end(vkey)       # promote on hit (true LRU)
+            return hit, None
+        from .passes import apply_pass
+        clone = self._program.clone()
+        for pname in self._pending_passes:
+            apply_pass(clone, pname, fetch_names=list(fetch_names))
+        evicted_uid = None
+        if len(variants) >= self._VARIANT_CAP:
+            _, stale = variants.popitem(last=False)
+            evicted_uid = stale._uid
+        variants[vkey] = clone
+        return clone, evicted_uid
+
     # pass-through conveniences so CompiledProgram quacks like Program
     def __getattr__(self, item):
         return getattr(self._program, item)

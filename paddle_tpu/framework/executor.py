@@ -24,7 +24,9 @@ map to ``jax.checkpoint`` over op segments (ref: backward.py:629).
 
 from __future__ import annotations
 
+import collections
 import contextlib
+import time
 import weakref
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -40,10 +42,20 @@ _RNG_VAR = "@RNG_STATE@"
 
 
 class Scope:
-    """Name → device-array store (ref: framework/scope.h:46)."""
+    """Name → device-array store (ref: framework/scope.h:46).
+
+    ``_version`` counts writes so the prepared fast path (PreparedStep,
+    which keeps state device-resident OUTSIDE the scope between explicit
+    sync points) can detect external writes — load_persistables, a plain
+    ``Executor.run``, user ``set_var`` — and re-pull state instead of
+    reusing donated-away buffers.  ``_prepared`` holds the live
+    PreparedSteps bound to this scope so direct readers can flush them
+    first (``sync_prepared_state``)."""
 
     def __init__(self):
         self.vars: Dict[str, Any] = {}
+        self._version = 0
+        self._prepared: "weakref.WeakSet" = weakref.WeakSet()
 
     def var_names(self):
         return list(self.vars)
@@ -53,9 +65,14 @@ class Scope:
 
     def set_var(self, name, value):
         self.vars[name] = value
+        self._version += 1
 
     def drop_all(self):
         self.vars.clear()
+        self._version += 1
+        # a dropped scope invalidates any prepared state bound to it —
+        # unregister so a later checkpoint can't flush stale params back
+        self._prepared = weakref.WeakSet()
 
 
 _global_scope = Scope()
@@ -73,6 +90,15 @@ def scope_guard(scope: Scope):
         yield
     finally:
         _global_scope = old
+
+
+def sync_prepared_state(scope: Scope):
+    """Flush every live PreparedStep's device-resident state back into
+    ``scope`` (cheap dict writes — no device sync) so direct scope readers
+    (a plain ``Executor.run``, io.save_*, the param-swap optimizers) never
+    observe values that are stale behind the prepared fast path."""
+    for ps in list(getattr(scope, "_prepared", ()) or ()):
+        ps.sync_scope()
 
 
 # ---------------------------------------------------------------------------
@@ -462,6 +488,367 @@ class _FieldDumper:
             self._f = None
 
 
+class FetchHandle:
+    """Lazy fetch result: holds the device array a prepared step produced
+    and blocks only on the first host read (``numpy()``/``__array__``) —
+    the opposite of ``Executor.run``'s ``return_numpy=True``, which forces
+    a device sync per fetch per step.  The host value is cached, so
+    repeated reads sync once."""
+
+    __slots__ = ("name", "_value", "_host", "_stats")
+
+    def __init__(self, value, name=None, stats=None):
+        self.name = name
+        self._value = value
+        self._host = None
+        self._stats = stats
+
+    @property
+    def value(self):
+        """The device array — no sync."""
+        return self._value
+
+    def is_ready(self):
+        """True when the producing step has completed on device."""
+        ready = getattr(self._value, "is_ready", None)
+        return bool(ready()) if ready is not None else True
+
+    def block_until_ready(self):
+        jax.block_until_ready(self._value)
+        return self
+
+    def numpy(self):
+        if self._host is None:
+            from ..profiler import RecordEvent
+            t0 = time.perf_counter_ns()
+            with RecordEvent("prepared::fetch_sync"):
+                self._host = _fetch_numpy(self._value)
+            if self._stats is not None:
+                self._stats["fetch_wait_ns"] += time.perf_counter_ns() - t0
+        return self._host
+
+    def __array__(self, dtype=None, copy=None):
+        a = self.numpy()
+        if dtype is not None:
+            return a.astype(dtype)
+        return np.array(a) if copy else a
+
+    def __float__(self):
+        return float(self.numpy().reshape(()))
+
+    def __repr__(self):
+        state = "host" if self._host is not None else (
+            "ready" if self.is_ready() else "in-flight")
+        return f"FetchHandle({self.name!r}, {state})"
+
+
+class PreparedStep:
+    """Steady-state executor fast path — the analog of the reference's
+    ``Executor.prepare``/``RunPreparedContext`` pair (ref: executor.py:1084
+    per-program ctx cache; executor.cc:368 Executor::Prepare) and of
+    ``ParallelExecutor``'s reusable execution graph built once and re-run
+    per step (ref: parallel_executor.cc:536).
+
+    ``Executor.run`` pays per step for answers that never change: fetch
+    name translation, pass-variant resolution, the compile-cache key, a
+    Scope round trip for every persistable (``find_var`` per state var in,
+    ``set_var`` per state var out), and — under ``return_numpy=True`` — a
+    device sync per fetch.  ``prepare()`` resolves all of it once;
+    ``run(feed)`` is the minimal hot loop:
+
+      * state stays DEVICE-RESIDENT between steps and its buffers are
+        donated to the compiled step (``donate_argnums`` over state_in —
+        the ``tf.aliasing_output`` annotations the multichip census
+        artifact counts), with NO Scope write-back until ``sync_scope()``;
+        ``Executor.run``, io.save_*, and the param-swap optimizers flush
+        implicitly through ``sync_prepared_state``, so checkpoints are
+        never stale;
+      * fetches return as lazy ``FetchHandle``s — the host blocks only on
+        the first ``.numpy()`` read;
+      * dispatch runs ahead of the device up to
+        ``flag("max_inflight_steps")`` steps; when the window is full the
+        host blocks once on the oldest in-flight step (state chains step
+        to step, so one token bounds the whole queue) — backpressure
+        instead of lockstep.
+
+    External scope writes (load_persistables, a plain ``Executor.run``,
+    user ``set_var``) bump the scope's version counter and make the next
+    ``run`` re-pull state.  Two PreparedSteps updating the same state on
+    one scope must interleave through ``sync_scope()`` — donation consumes
+    the other's buffers otherwise."""
+
+    def __init__(self, executor, program, feed_names, fetch_list, scope,
+                 feed=None):
+        from .compiler import CompiledProgram
+        self._exe = executor
+        self._scope = scope
+        self._mesh = None
+        self._axis_names = ()
+        self._batch_axis = None
+        self._seq_axis = None
+        self._feed_specs = {}
+        if isinstance(program, CompiledProgram):
+            self._mesh = program._mesh
+            self._axis_names = program._axis_names
+            self._batch_axis = program._batch_axis
+            self._seq_axis = program._seq_axis
+            self._feed_specs = program._feed_specs
+            # pass variants pinned ONCE — the hot loop never re-resolves
+            prog, evicted = program._variant_for(_fetch_names(fetch_list))
+            if evicted is not None:
+                executor._evict_program(evicted)
+            program = prog
+        self._program = program
+        self._fetch_names = _fetch_names(fetch_list)
+        self._declared_feed_names = list(feed_names or [])
+        self._readers = tuple(getattr(program, "_py_readers", ()))
+        # one _CompiledStep per feed signature (bucketed data keeps several
+        # live); state is shared across them — same program, same vars
+        self._steps: Dict[Any, _CompiledStep] = {}
+        self._cur: Optional[_CompiledStep] = None
+        self._cur_sig: Any = None
+        self._cur_exact = False
+        self._state: Optional[Dict[str, Any]] = None
+        self._key = None
+        self._dirty = False
+        self._scope_version = None           # forces state pull on first run
+        self._inflight: collections.deque = collections.deque()
+        self._feed_struct: Dict[str, Any] = {}
+        self._cur_check: list = []
+        self.stats = {"steps": 0, "blocking_syncs": 0, "max_inflight": 0,
+                      "dispatch_ns": 0, "feed_wait_ns": 0,
+                      "fetch_wait_ns": 0}
+        scope._prepared.add(self)
+        if feed is not None:
+            feed = dict(feed)
+            self._bind(feed, self._signature(feed))
+
+    # -- resolution (cold path) ------------------------------------------
+    @staticmethod
+    def _signature(feed):
+        """Shape/dtype signature; normalizes non-array values in place."""
+        items = []
+        for k, v in feed.items():
+            if not hasattr(v, "dtype"):
+                v = np.asarray(v)
+                feed[k] = v
+            items.append((k, tuple(v.shape), str(v.dtype)))
+        items.sort()
+        return tuple(items)
+
+    def _bind(self, feed, sig):
+        step = self._steps.get(sig)
+        if step is None:
+            from ..profiler import RecordEvent
+            with RecordEvent("executor::compile"):
+                step = self._exe._compile(
+                    self._program, feed, self._fetch_names, self._scope,
+                    self._mesh, self._axis_names, self._batch_axis,
+                    self._seq_axis, self._feed_specs)
+            self._steps[sig] = step
+        self._cur, self._cur_sig = step, sig
+        self._cur_exact = set(step.state_in_names) == \
+            set(step.state_out_names)
+        self._feed_struct = {
+            k: jax.ShapeDtypeStruct(tuple(feed[k].shape), feed[k].dtype)
+            for k in step.feed_names}
+        # steady-state check list: (name, shape, dtype) over the WHOLE
+        # bound feed (extras included — an extra key must force the slow
+        # path, not silently alias another signature)
+        self._cur_check = [(k, tuple(v.shape), v.dtype)
+                           for k, v in feed.items()]
+        if self._state is not None:
+            # a later signature must not lose state the earlier steps
+            # already advanced — only fill names this one newly reads
+            for n in step.state_in_names:
+                if n not in self._state:
+                    v = self._scope.find_var(n)
+                    if v is None:
+                        raise RuntimeError(
+                            f"persistable var {n!r} not initialised in "
+                            f"scope — run the startup program first")
+                    self._state[n] = v
+        return step
+
+    def _refresh_state(self, step):
+        """(Re-)pull state from the scope: first run, or an external write
+        (load_persistables / Executor.run / user set_var) bumped the scope
+        version while this step held device-resident state."""
+        scope = self._scope
+        state = {}
+        for n in step.state_in_names:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"persistable var {n!r} not initialised in scope — run "
+                    f"the startup program first (ref semantics: executor.cc "
+                    f"scope vars)")
+            state[n] = v
+        self._state = state
+        rng = scope.find_var(_RNG_VAR)
+        self._key = rng if rng is not None else \
+            jax.random.PRNGKey(self._program.random_seed)
+        self._scope_version = scope._version
+        self._dirty = False
+        self._inflight.clear()
+
+    def _feed_matches(self, feed):
+        """Steady-state check: does ``feed`` match the bound signature?
+        Cheap identity-of-shape/dtype compare — no string building."""
+        chk = self._cur_check
+        if len(feed) != len(chk):
+            return False
+        try:
+            for k, shp, dt in chk:
+                v = feed[k]
+                if v.shape != shp or v.dtype != dt:
+                    return False
+        except (KeyError, AttributeError):
+            return False
+        return True
+
+    # -- hot loop ---------------------------------------------------------
+    def run(self, feed=None, return_numpy=False):
+        """One training step.  Returns ``FetchHandle``s (device-resident;
+        block on first read) unless ``return_numpy=True``."""
+        from ..flags import flag
+        from ..profiler import RecordEvent
+        feed = dict(feed) if feed else {}
+        if self._readers:
+            t0 = time.perf_counter_ns()
+            with RecordEvent("prepared::feed_wait"):
+                for reader in self._readers:
+                    if reader._started:
+                        for k, v in reader._next_feed().items():
+                            feed.setdefault(k, v)
+            self.stats["feed_wait_ns"] += time.perf_counter_ns() - t0
+        if self._cur is not None and self._feed_matches(feed):
+            step = self._cur
+        else:
+            sig = self._signature(feed)
+            step = self._cur if sig == self._cur_sig else \
+                self._bind(feed, sig)
+        if self._scope._version != self._scope_version:
+            self._refresh_state(step)
+        state = self._state
+        state_in = state if self._cur_exact else \
+            {n: state[n] for n in step.state_in_names}
+        feed_vals = {k: feed[k] for k in step.feed_names}
+        rng_key = self._key
+        if step.spans_processes:
+            from jax.sharding import PartitionSpec as P
+            mesh = self._mesh
+            feed_vals = {k: _to_global(mesh, step.feed_spec_fn(k), v,
+                                       local_shard=True)
+                         for k, v in feed_vals.items()}
+            state_in = {n: _to_global(mesh,
+                                      step.state_in_specs.get(n, P()), v)
+                        for n, v in state_in.items()}
+            rng_key = _to_global(mesh, P(), rng_key)
+
+        window = flag("max_inflight_steps")
+        if window and window > 0:
+            inflight = self._inflight
+            while len(inflight) >= window:
+                tok = inflight.popleft()
+                ready = getattr(tok, "is_ready", None)
+                if ready is None or not ready():
+                    self.stats["blocking_syncs"] += 1
+                    t0 = time.perf_counter_ns()
+                    with RecordEvent("prepared::fetch_sync"):
+                        jax.block_until_ready(tok)
+                    self.stats["fetch_wait_ns"] += \
+                        time.perf_counter_ns() - t0
+
+        t0 = time.perf_counter_ns()
+        with RecordEvent("prepared::dispatch"):
+            fetches, state_out, new_key = step.fn(feed_vals, state_in,
+                                                  rng_key)
+        self.stats["dispatch_ns"] += time.perf_counter_ns() - t0
+        self.stats["steps"] += 1
+        self._state = state_out
+        self._key = new_key
+        self._dirty = True
+        if window and window > 0:
+            self._inflight.append(new_key)
+            if len(self._inflight) > self.stats["max_inflight"]:
+                self.stats["max_inflight"] = len(self._inflight)
+
+        if flag("benchmark"):
+            # per-step wall-clock mode: barrier covers fetches AND the
+            # carried state + RNG key, like Executor.run's
+            jax.block_until_ready((fetches, state_out, new_key))
+        if flag("check_nan_inf"):
+            self._exe._check_nan_inf(self._fetch_names, fetches, state_out)
+        handles = [FetchHandle(v, n, self.stats)
+                   for n, v in zip(self._fetch_names, fetches)]
+        if return_numpy:
+            return [h.numpy() for h in handles]
+        return handles
+
+    # -- sync points ------------------------------------------------------
+    def sync_scope(self):
+        """Write the device-resident state (and RNG key) back into the
+        Scope.  Cheap — dict writes of device arrays, no host transfer or
+        device sync.  Called implicitly by Executor.run / io.save_* via
+        ``sync_prepared_state``; call it yourself before reading state
+        through the scope directly."""
+        if not self._dirty:
+            return
+        from ..profiler import RecordEvent
+        scope = self._scope
+        with RecordEvent("prepared::scope_sync"):
+            for n, v in self._state.items():
+                scope.set_var(n, v)
+            if self._key is not None:
+                scope.set_var(_RNG_VAR, self._key)
+        self._dirty = False
+        self._scope_version = scope._version
+
+    def wait(self):
+        """Block until every dispatched step completed on device (state
+        chains step-to-step, so the newest key is a full barrier)."""
+        if self._key is not None:
+            jax.block_until_ready(self._key)
+        self._inflight.clear()
+        return self
+
+    def close(self):
+        self.sync_scope()
+        self._scope._prepared.discard(self)
+        self._steps.clear()
+        self._cur = None
+        self._cur_sig = None
+
+    # -- introspection ----------------------------------------------------
+    def donation(self):
+        """(donated_args, total_args) of the current step's lowered
+        ``@main`` — the same ``tf.aliasing_output`` census
+        tools/verify_multichip_lowering.donation_ratio reports for the
+        multichip artifact, so prepared-step aliasing can be verified
+        against it."""
+        import re
+        step = self._cur
+        if step is None:
+            raise RuntimeError("no step bound yet — run at least one step "
+                               "(or prepare with an example feed)")
+        state_src = self._state or {}
+        abss = {}
+        for n in step.state_in_names:
+            v = state_src.get(n)
+            if v is None:
+                v = self._scope.find_var(n)
+            if not hasattr(v, "dtype"):
+                v = np.asarray(v)
+            abss[n] = jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+        key = self._key if self._key is not None else jax.random.PRNGKey(0)
+        key_struct = jax.ShapeDtypeStruct(tuple(key.shape), key.dtype)
+        txt = step.fn.lower(self._feed_struct, abss, key_struct).as_text()
+        sig = re.search(r"func\.func public @main\((.*?)\)\s*->", txt,
+                        re.DOTALL).group(1)
+        return sig.count("tf.aliasing_output"), sig.count("tensor<")
+
+
 class Executor:
     """User-facing executor (ref: python executor.py:896 Executor.run)."""
 
@@ -479,6 +866,11 @@ class Executor:
         scope = scope or global_scope()
         feed = feed or {}
         fetch_list = fetch_list or []
+
+        if getattr(scope, "_prepared", None):
+            # staleness guard: flush prepared fast-path state into the
+            # scope before this run reads (and donates) it
+            sync_prepared_state(scope)
 
         # CompiledProgram wrapper (data parallel etc.)
         from .compiler import CompiledProgram
@@ -535,21 +927,9 @@ class Executor:
             # strategy passes run against a clone per fetch list: fetched
             # intermediates are protected, and a later run with different
             # fetches sees the untouched original (no run-order dependence)
-            variants = compiled_wrapper.__dict__.setdefault(
-                "_pass_variants", {})
-            vkey = tuple(fetch_names)
-            if vkey not in variants:
-                from .passes import apply_pass
-                clone = program.clone()
-                for pname in compiled_wrapper._pending_passes:
-                    apply_pass(clone, pname, fetch_names=fetch_names)
-                if len(variants) >= 8:   # bound clone retention (LRU-ish)
-                    oldest = next(iter(variants))
-                    stale = variants.pop(oldest)
-                    self._cache = {k: v for k, v in self._cache.items()
-                                   if k[0] != stale._uid}
-                variants[vkey] = clone
-            program = variants[vkey]
+            program, evicted_uid = compiled_wrapper._variant_for(fetch_names)
+            if evicted_uid is not None:
+                self._evict_program(evicted_uid)
         feed = {k: np.asarray(v) if not hasattr(v, "dtype") else v
                 for k, v in feed.items()}
 
@@ -600,8 +980,11 @@ class Executor:
                                                       key)
             if flag("benchmark"):
                 # ref: FLAGS_benchmark forces a device sync per run so
-                # wall-clock timing is accurate
-                jax.block_until_ready(fetches)
+                # wall-clock timing is accurate; the barrier covers the
+                # fetches AND the carried state + RNG key — a fetch-only
+                # sync let state lag, and bench tools compensated by
+                # blocking on the whole scope
+                jax.block_until_ready((fetches, state_out, new_key))
         stat("executor_run_count").add()
         scope.set_var(_RNG_VAR, new_key)
         for n, v in state_out.items():
@@ -617,6 +1000,23 @@ class Executor:
         if return_numpy:
             return [_fetch_numpy(f) for f in fetches]
         return list(fetches)
+
+    def prepare(self, program: Optional[Program] = None, feed_names=None,
+                fetch_list=None, scope: Optional[Scope] = None, feed=None):
+        """Resolve ``program`` + ``fetch_list`` into a :class:`PreparedStep`
+        whose ``run(feed)`` is the steady-state fast path (ref:
+        Executor._prepare/ExecutorPrepareContext, executor.py:551, and the
+        ParallelExecutor build-once/run-many contract).  Pass an example
+        ``feed`` (shapes matter, values don't) to compile eagerly;
+        otherwise compilation happens on the first ``run``."""
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        return PreparedStep(self, program, feed_names, fetch_list or [],
+                            scope, feed=feed)
+
+    def _evict_program(self, uid):
+        """Drop compiled steps belonging to an evicted pass-variant clone."""
+        self._cache = {k: v for k, v in self._cache.items() if k[0] != uid}
 
     def _run_per_op_debug(self, program, step, feed_vals, state_in, key,
                           fetch_names):
@@ -962,10 +1362,30 @@ class Executor:
                            check_vma=False)
             return fn(feed_vals, state_vals, rng_key)
 
-        return jax.jit(sharded, donate_argnums=(1,)), feed_spec, state_in_specs
+        # explicit GSPMD shardings on the jit boundary: without them XLA
+        # cannot prove the donated state buffers alias their outputs and
+        # silently DROPS the aliasing under shard_map — the multichip
+        # census artifact showed arg donation 0/N until r07.  With
+        # in+out shardings pinned to the shard_map specs, state donation
+        # is live on the mesh path too (tf.aliasing_output per state arg)
+        from jax.sharding import NamedSharding
+
+        def ns(spec):
+            return NamedSharding(mesh, spec)
+
+        in_sh = ({k: ns(feed_spec(k)) for k in feed_names},
+                 {n: ns(state_in_specs[n]) for n in state_in_names},
+                 ns(P()))
+        out_sh = (ns(P()),
+                  {n: ns(state_out_specs[n]) for n in state_out_names},
+                  ns(P()))
+        fn = jax.jit(sharded, donate_argnums=(1,), in_shardings=in_sh,
+                     out_shardings=out_sh)
+        return fn, feed_spec, state_in_specs
 
     def close(self):
         self._cache.clear()
 
 
-__all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
+__all__ = ["Executor", "Scope", "global_scope", "scope_guard",
+           "PreparedStep", "FetchHandle", "sync_prepared_state"]

@@ -20,7 +20,7 @@ from typing import List, Optional
 import numpy as np
 
 from .framework.core import Program, Variable, default_main_program
-from .framework.executor import Scope, global_scope
+from .framework.executor import Scope, global_scope, sync_prepared_state
 
 _RNG_VAR = "@RNG_STATE@"
 
@@ -56,6 +56,10 @@ def save_persistables(executor, dirname, main_program: Optional[Program] = None,
     """ref: io.py:598 — saves every persistable var of the program."""
     main_program = main_program or default_main_program()
     scope = scope or global_scope()
+    # prepared fast-path state is device-resident between explicit sync
+    # points — flush it so the checkpoint is never stale (PreparedStep
+    # scope-sync contract)
+    sync_prepared_state(scope)
     os.makedirs(dirname, exist_ok=True)
     filename = filename or "params.npz"
     arrays = {}
@@ -287,6 +291,7 @@ def save_persistables_sharded(executor, dirname,
     import jax
     main_program = main_program or default_main_program()
     scope = scope or global_scope()
+    sync_prepared_state(scope)     # staleness guard (prepared fast path)
     os.makedirs(dirname, exist_ok=True)
     p = jax.process_index()
     arrays = {}
@@ -398,6 +403,7 @@ class AsyncCheckpointer:
         self.wait()
         main_program = main_program or default_main_program()
         scope = scope or global_scope()
+        sync_prepared_state(scope)   # staleness guard (prepared fast path)
         # synchronous device→host snapshot: values at THIS step
         snap = {}
         for name in _persistable_names(main_program):

@@ -859,7 +859,12 @@ class ShardedUpdateOptimizer(Optimizer):
         composes fine;
       * tensor-parallel params (``dist_attr`` set) keep the classic
         dense all-reduce + full update — ZeRO shards only the replicated
-        params.
+        params;
+      * the flat 1/n state shards are ordinary persistables, so the
+        prepared fast path (``Executor.prepare``) keeps them
+        device-resident and donated between steps — checkpointing goes
+        through io.save_*, which flushes via ``sync_prepared_state``
+        before reading the scope (sharded state is never saved stale).
     """
 
     _ELEMENTWISE = {"sgd", "momentum", "adam", "adamw", "adagrad",
@@ -1035,6 +1040,12 @@ def _swap_context(executor, apply_program, restore_fn, need_restore):
 
     @contextlib.contextmanager
     def _ctx():
+        # the swap program reads params/accumulators through the scope —
+        # flush any prepared fast-path state first (PreparedStep keeps the
+        # training state device-resident between explicit sync points, so
+        # averaged weights must not be computed from pre-training values)
+        from .framework.executor import global_scope, sync_prepared_state
+        sync_prepared_state(global_scope())
         executor.run(apply_program)
         try:
             yield

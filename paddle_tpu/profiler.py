@@ -155,3 +155,36 @@ def profiler(state: str = "All", sorted_key: str = "total",
 def get_events():
     with _lock:
         return list(_events)
+
+
+# ---------------------------------------------------------------------------
+# prepared-executor per-step breakdown
+# ---------------------------------------------------------------------------
+
+# the four host-side phases of one prepared train step (PreparedStep.run
+# emits these markers): waiting on the input pipeline, python+jit dispatch,
+# blocking on device results (backpressure + FetchHandle reads), and the
+# explicit scope write-back
+PREPARED_PHASES = ("prepared::feed_wait", "prepared::dispatch",
+                   "prepared::fetch_sync", "prepared::scope_sync")
+
+
+def step_breakdown(events=None):
+    """Aggregate the prepared fast path's per-step markers into
+    ``{phase: {"calls", "total_ms", "avg_us"}}`` — the host-side story of
+    a training step (where did the step's host time go: feed-wait /
+    dispatch / fetch-sync / scope-sync), complementing the event table
+    with a per-phase view the reference exposes through its
+    DeviceTracer sections."""
+    if events is None:
+        with _lock:
+            events = list(_events)
+    out = {}
+    for name, start, end, _ in events:
+        if name in PREPARED_PHASES:
+            rec = out.setdefault(name, {"calls": 0, "total_ms": 0.0})
+            rec["calls"] += 1
+            rec["total_ms"] += (end - start) / 1e6
+    for rec in out.values():
+        rec["avg_us"] = rec["total_ms"] * 1e3 / rec["calls"]
+    return out
