@@ -60,6 +60,16 @@ _register("benchmark", False)              # ref: flags.cc benchmark
 # run-ahead; fetch reads are then the only device syncs).
 _register("max_inflight_steps", 2)
 _register("print_executor_cache_hits", False)
+# static program verification (framework/analysis.py — the
+# InferShape/PADDLE_ENFORCE safety net): Executor.prepare and
+# CompiledProgram verify each program once per (_uid, _version) and raise
+# InvalidArgumentError diagnostics anchored at the op's creation site
+_register("verify_programs", True)
+# pass-boundary invariant checking: PassBuilder.apply / apply_pass verify
+# the program before/after each pass (defined-var + fetch-reachability
+# diff) — catches a fusion pass that breaks well-formedness at the pass
+# boundary instead of at compile.  Off by default (lint/CI turns it on).
+_register("verify_passes", False)
 # accepted no-ops: XLA owns these concerns (ref: flags.cc lines noted)
 _register("fraction_of_gpu_memory_to_use", 0.92, noop=True)   # :343
 _register("eager_delete_tensor_gb", 0.0, noop=True)           # :257

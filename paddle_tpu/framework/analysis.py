@@ -1,0 +1,761 @@
+"""Static verifier + analysis-pass framework over Program/Block/Operator.
+
+The reference front-loads correctness into C++ infrastructure this rebuild
+deliberately dropped: ``InferShape``/``InferVarType`` run at every op
+insertion (ref: framework/op_desc.cc, shape_inference.h) and
+``PADDLE_ENFORCE`` guards every kernel, so a malformed ProgramDesc fails at
+build time with the op named.  Here a malformed Program previously failed
+deep inside jit tracing with a raw JAX traceback — and some defect classes
+(a donated state var in the fetch list, a collective sequence that diverges
+across mesh ranks) produced no error at all, just wrong results or a hang.
+
+This module restores that safety net at trace-free cost:
+
+* **structural verification** — use-before-def per block (recursing into
+  control-flow sub-blocks via Block-valued attrs), undeclared inputs,
+  duplicate/dangling writes, ops with no registry implementation,
+  startup-vs-main parameter shape/dtype agreement;
+* **static shape & dtype inference** — the ``op_spec`` metadata channel
+  (ops/registry.py) propagates shapes/dtypes from feed vars and parameters
+  through the op list, reporting mismatches as diagnostics anchored to the
+  op's recorded user callstack (framework/errors.py) instead of an in-jit
+  XLA error;
+* **distributed soundness** — collectives under divergent control flow,
+  inconsistent collective sequences across program clones, bf16-compressed
+  collectives applied to integer gradients, donation/aliasing conflicts
+  (the PR 2 silently-dropped-donation bug class);
+* **pass-pipeline invariant checking** — ``apply_pass``/``PassBuilder``
+  verify the program around each pass under ``flag("verify_passes")``,
+  diffing defined-var and fetch-reachability sets at the pass boundary.
+
+``Executor.prepare`` and ``CompiledProgram`` call :func:`verify_cached`,
+which verifies each program at most once per ``(_uid, _version)`` (plus
+feed/fetch signature); ``tools/proglint.py`` lints a serialized program
+from the CLI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import Block, Operator, Program, Variable
+from .errors import Error, InvalidArgumentError
+
+# defect-class codes (the lint taxonomy; see MIGRATION.md "Static analysis
+# mapping" for the defect-class ↔ reference-enforcement table)
+USE_BEFORE_DEF = "use-before-def"
+UNDECLARED_INPUT = "undeclared-input"
+DANGLING_WRITE = "dangling-write"
+DUPLICATE_WRITE = "duplicate-write"
+MISSING_OP_IMPL = "missing-op-impl"
+SHAPE_MISMATCH = "shape-mismatch"
+DTYPE_MISMATCH = "dtype-mismatch"
+STARTUP_MAIN_MISMATCH = "startup-main-mismatch"
+COLLECTIVE_DIVERGENT_CF = "collective-divergent-control-flow"
+COLLECTIVE_SEQ_DIVERGENCE = "collective-sequence-divergence"
+BF16_ALLREDUCE_INTEGER = "bf16-allreduce-integer"
+DONATED_VAR_FETCHED = "donated-var-fetched"
+READ_AFTER_DONATE = "read-after-donate"
+UNSPECCED_OP = "unspecced-op"
+PASS_INVARIANT = "pass-invariant"
+
+#: meta-ops interpreted by the executor itself, not the registry
+META_OPS = frozenset({"feed", "fetch", "backward", "pipeline"})
+
+
+class PassInvariantError(Error):
+    """A program pass broke a well-formedness invariant at the pass
+    boundary (the analog of an ir::Graph pass failing its
+    post-condition checks)."""
+    code = "PASS_INVARIANT"
+
+
+class Diagnostic:
+    """One verifier finding, anchored (when possible) to the op's recorded
+    user creation site — the op_call_stack.cc contract applied at static
+    verification time instead of at kernel failure."""
+
+    __slots__ = ("severity", "code", "message", "op_type", "block_idx",
+                 "op_index", "callstack")
+
+    def __init__(self, severity: str, code: str, message: str,
+                 op: Optional[Operator] = None, block_idx: int = 0,
+                 op_index: int = -1):
+        self.severity = severity        # "error" | "warning"
+        self.code = code
+        self.message = message
+        self.op_type = op.type if op is not None else None
+        self.block_idx = block_idx
+        self.op_index = op_index
+        self.callstack = list(getattr(op, "callstack", None) or ())
+
+    def format(self) -> str:
+        loc = ""
+        if self.op_type is not None:
+            loc = (f" [operator < {self.op_type} > "
+                   f"block {self.block_idx} op #{self.op_index}]")
+        lines = [f"{self.severity.upper()} {self.code}{loc}: {self.message}"]
+        if self.callstack:
+            lines.append("  Python call stack (op creation site):")
+            lines.extend(f"    {frame}" for frame in self.callstack)
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return f"Diagnostic({self.severity}, {self.code}, {self.op_type})"
+
+
+class VerifyResult:
+    """Collected diagnostics + the unspecced-op census for one program."""
+
+    def __init__(self, program: Optional[Program] = None):
+        self.program = program
+        self.diagnostics: List[Diagnostic] = []
+        self.unspecced_ops: Dict[str, int] = {}
+
+    # -- collection ------------------------------------------------------
+    def add(self, severity, code, message, op=None, block_idx=0,
+            op_index=-1):
+        self.diagnostics.append(
+            Diagnostic(severity, code, message, op, block_idx, op_index))
+
+    def merge(self, other: "VerifyResult"):
+        self.diagnostics.extend(other.diagnostics)
+        for k, v in other.unspecced_ops.items():
+            self.unspecced_ops[k] = self.unspecced_ops.get(k, 0) + v
+
+    # -- queries ---------------------------------------------------------
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "warning"]
+
+    def by_code(self, code: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.code == code]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors()
+
+    def raise_on_error(self):
+        errs = self.errors()
+        if errs:
+            raise InvalidArgumentError(
+                "program verification failed with "
+                f"{len(errs)} error(s):\n" +
+                "\n".join(d.format() for d in errs))
+        return self
+
+    def report(self) -> str:
+        lines = [f"program verification: {len(self.errors())} error(s), "
+                 f"{len(self.warnings())} warning(s)"]
+        for d in self.diagnostics:
+            lines.append(d.format())
+        if self.unspecced_ops:
+            lines.append(
+                "unspecced ops (no op_spec registered — shape/dtype "
+                "inference skipped):")
+            for name, count in sorted(self.unspecced_ops.items()):
+                lines.append(f"  {name}: {count} op(s)")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# helpers shared by the checks
+# ---------------------------------------------------------------------------
+
+
+def _iter_sub_blocks(op: Operator):
+    """Block-valued attrs of a control-flow op (single or list-valued)."""
+    for v in op.attrs.values():
+        if isinstance(v, Block):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                if isinstance(item, Block):
+                    yield item
+
+
+def _attr_name_lists(op: Operator) -> Set[str]:
+    """Names carried by string-list attrs (x_names/closure_names/...):
+    the in-block bindings a control-flow op seeds its sub-blocks with."""
+    out: Set[str] = set()
+    for k, v in op.attrs.items():
+        if isinstance(v, (list, tuple)) and v and \
+                all(isinstance(item, str) for item in v):
+            out.update(v)
+        elif isinstance(v, str) and k.endswith(("_out", "_name")):
+            out.add(v)
+    return out
+
+
+def op_reads_recursive(op: Operator) -> Set[str]:
+    """All names ``op`` reads, including reads made inside its control-flow
+    sub-blocks (recursively) — the closure an interpreter-style prune must
+    treat as live (satellite fix consumed by ``Program._prune``)."""
+    reads = set(op.input_names())
+    for sub in _iter_sub_blocks(op):
+        for sub_op in sub.ops:
+            reads |= op_reads_recursive(sub_op)
+    return reads
+
+
+def _collective_types() -> Set[str]:
+    from ..ops.registry import OP_SPECS
+    return {name for name, spec in OP_SPECS.items() if spec.collective}
+
+
+def _seed_available(block: Block, feed_names: Iterable[str],
+                    scope_names: Iterable[str]) -> Set[str]:
+    """Names readable before any op of ``block`` runs: feeds, data vars,
+    persistables (startup-initialised), initializer-carrying vars, plus
+    anything already materialised in the scope."""
+    avail = set(feed_names) | set(scope_names)
+    b: Optional[Block] = block
+    while b is not None:
+        for name, v in b.vars.items():
+            if v.persistable or v.is_data or v.initializer is not None:
+                avail.add(name)
+        b = b.parent_block
+    return avail
+
+
+# ---------------------------------------------------------------------------
+# 1. structural verification
+# ---------------------------------------------------------------------------
+
+
+def verify_structure(program: Program, result: VerifyResult,
+                     feed_names: Iterable[str] = (),
+                     scope_names: Iterable[str] = ()):
+    """Use-before-def / undeclared inputs / duplicate+dangling writes /
+    missing registry impls, recursing into control-flow sub-blocks."""
+    from ..ops.registry import has_op
+
+    produced_anywhere: Set[str] = set()
+    for b in program.blocks:
+        for op in b.ops:
+            produced_anywhere |= set(op.output_names())
+
+    def check_block(block: Block, available: Set[str], top_level: bool):
+        defined = set(available)
+        writer_index: Dict[str, int] = {}
+        read_since_write: Set[str] = set()
+        for idx, op in enumerate(block.ops):
+            if op.type not in META_OPS and not has_op(op.type):
+                result.add(
+                    "error", MISSING_OP_IMPL,
+                    f"op {op.type!r} has no JAX implementation in the "
+                    f"registry — it will fail at lowering",
+                    op, block.idx, idx)
+            for slot, names in op.inputs.items():
+                for n in names:
+                    read_since_write.add(n)
+                    if n in defined:
+                        continue
+                    declared = block._find_var_recursive(n) is not None
+                    if not declared and n not in produced_anywhere:
+                        # warning, not error: a name declared nowhere can
+                        # still be a scope-resident var another program
+                        # initialised (e.g. a decode program reusing the
+                        # train program's weights by name)
+                        result.add(
+                            "warning", UNDECLARED_INPUT,
+                            f"op {op.type!r} input {slot}={n!r} is not "
+                            f"declared in any reachable block and no op "
+                            f"produces it",
+                            op, block.idx, idx)
+                    else:
+                        result.add(
+                            "error" if top_level else "warning",
+                            USE_BEFORE_DEF,
+                            f"op {op.type!r} reads {slot}={n!r} before any "
+                            f"op defines it (not a feed/data var, not "
+                            f"persistable, no initializer)",
+                            op, block.idx, idx)
+                    defined.add(n)      # report each name once per block
+            # recurse into control-flow sub-blocks: outer defs so far plus
+            # the op's declared in-block bindings are visible inside
+            sub_avail = defined | _attr_name_lists(op)
+            for sub in _iter_sub_blocks(op):
+                check_block(sub, sub_avail, top_level=False)
+            for slot, names in op.outputs.items():
+                for n in names:
+                    var = block._find_var_recursive(n)
+                    if var is None:
+                        result.add(
+                            "warning", DANGLING_WRITE,
+                            f"op {op.type!r} writes {slot}={n!r} but the "
+                            f"variable is not declared in any reachable "
+                            f"block",
+                            op, block.idx, idx)
+                    prev = writer_index.get(n)
+                    if prev is not None and n not in read_since_write and \
+                            n not in op.input_names() and \
+                            (var is None or not var.persistable):
+                        result.add(
+                            "warning", DUPLICATE_WRITE,
+                            f"op {op.type!r} overwrites {n!r} (first "
+                            f"written by op #{prev}) before any op read "
+                            f"it — the first value is dead",
+                            op, block.idx, idx)
+                    writer_index[n] = idx
+                    read_since_write.discard(n)
+                    defined.add(n)
+
+    top = program.global_block()
+    check_block(top, _seed_available(top, feed_names, scope_names),
+                top_level=True)
+
+
+def verify_startup_agreement(main: Program, startup: Program,
+                             result: VerifyResult):
+    """Persistables declared in both programs must agree on shape/dtype —
+    the startup program materialises the buffers the main program will
+    lower against (ref contract: the two-program convention of
+    framework.py default_main_program/default_startup_program)."""
+    sb = startup.global_block()
+    for name, v in main.global_block().vars.items():
+        if not v.persistable:
+            continue
+        sv = sb.vars.get(name)
+        if sv is None:
+            continue
+        if tuple(sv.shape) != tuple(v.shape) and sv.shape and v.shape:
+            result.add(
+                "error", STARTUP_MAIN_MISMATCH,
+                f"parameter {name!r}: startup declares shape "
+                f"{list(sv.shape)} but main declares {list(v.shape)}")
+        elif str(sv.dtype) != str(v.dtype):
+            result.add(
+                "error", STARTUP_MAIN_MISMATCH,
+                f"parameter {name!r}: startup declares dtype {sv.dtype} "
+                f"but main declares {v.dtype}")
+
+
+# ---------------------------------------------------------------------------
+# 2. static shape & dtype inference
+# ---------------------------------------------------------------------------
+
+
+def _declared_sig(block: Block, name: str):
+    from ..ops.registry import VarSig
+    v = block._find_var_recursive(name)
+    if v is None:
+        return None
+    shape = tuple(v.shape)
+    # a declared () is ambiguous (scalar OR "shape not filled in") —
+    # treat it as unknown so it never fights real inference
+    return VarSig(shape if shape else None, v.dtype)
+
+
+def _merge_sig(declared, inferred):
+    from ..ops.registry import VarSig
+    if declared is None or declared.shape is None:
+        return inferred
+    if inferred.shape is None:
+        return VarSig(declared.shape, inferred.dtype)
+    if len(declared.shape) != len(inferred.shape):
+        return inferred
+    shape = tuple(d if i < 0 else i
+                  for d, i in zip(declared.shape, inferred.shape))
+    return VarSig(shape, inferred.dtype)
+
+
+def _shapes_conflict(declared, inferred) -> bool:
+    if declared is None or inferred is None:
+        return False
+    if declared.shape is None or inferred.shape is None:
+        return False
+    if len(declared.shape) != len(inferred.shape):
+        return True
+    return any(d >= 0 and i >= 0 and d != i
+               for d, i in zip(declared.shape, inferred.shape))
+
+
+def infer_shapes(program: Program, result: VerifyResult,
+                 feed_names: Iterable[str] = ()):
+    """Propagate static (shape, dtype) signatures through the global
+    block's op list via the ``op_spec`` infer channel, reporting
+    mismatches against declared variable metadata.  Ops without a spec
+    pass their declared output metadata through and are counted in the
+    unspecced census (the warn-don't-fail long-tail path)."""
+    from ..ops.registry import OP_SPECS, SpecMismatch, VarSig
+
+    block = program.global_block()
+    env: Dict[str, Any] = {}
+
+    def sig_of(name: str):
+        if name in env:
+            return env[name]
+        return _declared_sig(block, name)
+
+    for idx, op in enumerate(block.ops):
+        if op.type in META_OPS:
+            # the backward meta-op defines grads shaped like their params
+            if op.type == "backward":
+                for pname in op.attrs.get("param_names", ()):
+                    from .core import grad_var_name
+                    g = grad_var_name(pname)
+                    psig = sig_of(pname)
+                    if psig is not None:
+                        env[g] = psig
+            continue
+        spec = OP_SPECS.get(op.type)
+        if spec is None:
+            result.unspecced_ops[op.type] = \
+                result.unspecced_ops.get(op.type, 0) + 1
+            for n in op.output_names():
+                d = _declared_sig(block, n)
+                if d is not None:
+                    env[n] = d
+            continue
+        if spec.infer is None:
+            for n in op.output_names():
+                d = _declared_sig(block, n)
+                if d is not None:
+                    env[n] = d
+            continue
+        ins = {slot: [sig_of(n) or VarSig(None, "float32") for n in names]
+               for slot, names in op.inputs.items()}
+        try:
+            out = spec.infer(ins, op.attrs)
+        except SpecMismatch as e:
+            code = DTYPE_MISMATCH if e.kind == "dtype" else SHAPE_MISMATCH
+            result.add("error", code, str(e), op, block.idx, idx)
+            out = None
+        except Exception as e:          # an infer bug must not kill lint
+            result.add(
+                "warning", UNSPECCED_OP,
+                f"op_spec infer for {op.type!r} failed "
+                f"({type(e).__name__}: {e}) — treating as unspecced",
+                op, block.idx, idx)
+            out = None
+        if not out:
+            for n in op.output_names():
+                d = _declared_sig(block, n)
+                if d is not None:
+                    env[n] = d
+            continue
+        for slot, sigs in out.items():
+            names = op.outputs.get(slot, [])
+            for n, inferred in zip(names, sigs):
+                declared = _declared_sig(block, n)
+                if _shapes_conflict(declared, inferred):
+                    result.add(
+                        "error", SHAPE_MISMATCH,
+                        f"op {op.type!r} output {slot}={n!r}: inferred "
+                        f"shape {list(inferred.shape)} conflicts with "
+                        f"declared {list(declared.shape)}",
+                        op, block.idx, idx)
+                env[n] = _merge_sig(declared, inferred)
+        # outputs in slots the spec had no opinion about
+        for slot, names in op.outputs.items():
+            if slot in out:
+                continue
+            for n in names:
+                d = _declared_sig(block, n)
+                if d is not None:
+                    env[n] = d
+    return env
+
+
+# ---------------------------------------------------------------------------
+# 3. distributed soundness
+# ---------------------------------------------------------------------------
+
+
+def verify_distributed(program: Program, result: VerifyResult,
+                       fetch_names: Iterable[str] = ()):
+    """Collective & donation soundness over one program."""
+    from ..ops.registry import OP_SPECS
+
+    collectives = _collective_types()
+    fetch = set(fetch_names)
+    block = program.global_block()
+
+    # (a) collectives under divergent control flow: a collective inside a
+    # conditional_block/switch_case/while_loop sub-block executes a
+    # data-dependent number of times — mesh ranks disagree and the program
+    # hangs (the reference cannot express this; our sub-block lowering can)
+    def scan_cf(parent_op, blk, depth):
+        for idx, op in enumerate(blk.ops):
+            if op.type in collectives:
+                result.add(
+                    "error", COLLECTIVE_DIVERGENT_CF,
+                    f"collective op {op.type!r} appears inside the "
+                    f"sub-block of control-flow op {parent_op.type!r} — "
+                    f"collectives under divergent control flow deadlock "
+                    f"when ranks disagree on the branch/trip count",
+                    op, blk.idx, idx)
+            for sub in _iter_sub_blocks(op):
+                scan_cf(op, sub, depth + 1)
+
+    # the pipeline mega-op's stage blocks run under a rank-STATIC
+    # schedule (every rank executes the same switch sequence), so
+    # collectives inside its stages are sound — exempt
+    cf_exempt = {"pipeline"}
+    for op in block.ops:
+        if op.type in cf_exempt:
+            continue
+        for sub in _iter_sub_blocks(op):
+            scan_cf(op, sub, 1)
+
+    # (b) bf16-compressed collectives on integer tensors: the cast →
+    # psum → upcast rewrite silently truncates integer payloads
+    for idx, op in enumerate(block.ops):
+        comp = op.attrs.get("compress_dtype")
+        if not comp or op.type not in collectives:
+            continue
+        for n in op.input_names():
+            v = block._find_var_recursive(n)
+            if v is not None and str(v.dtype) in (
+                    "int8", "uint8", "int16", "int32", "int64", "bool"):
+                result.add(
+                    "error", BF16_ALLREDUCE_INTEGER,
+                    f"collective {op.type!r} compresses {n!r} "
+                    f"({v.dtype}) to {comp} — integer payloads must not "
+                    f"ride compressed collectives",
+                    op, block.idx, idx)
+
+    # (c) donation/aliasing conflicts (the PR 2 bug class).  State vars
+    # (persistables written by the program) are donated on the jit
+    # boundary; a fetch of the same name aliases a buffer the NEXT step's
+    # dispatch will donate away, so the handle dies under the reader.
+    donated_state = set()
+    for op in block.ops:
+        for n in op.output_names():
+            v = block._find_var_recursive(n)
+            if v is not None and v.persistable:
+                donated_state.add(n)
+    for n in sorted(donated_state & fetch):
+        writer = next((op for op in block.ops if n in op.output_names()),
+                      None)
+        result.add(
+            "error", DONATED_VAR_FETCHED,
+            f"fetch target {n!r} is a donated state var (persistable, "
+            f"updated in-program) — the fetched handle aliases a buffer "
+            f"the next step donates away; fetch a copy (assign) or sync "
+            f"the scope instead",
+            writer, block.idx,
+            block.ops.index(writer) if writer is not None else -1)
+
+    # (d) explicit donation annotations: an op that declares it consumes
+    # (donates) an input buffer — attrs["_donated_inputs"] — must be the
+    # LAST reader of those names
+    for idx, op in enumerate(block.ops):
+        donated = op.attrs.get("_donated_inputs")
+        if not donated:
+            continue
+        for later_idx in range(idx + 1, len(block.ops)):
+            later = block.ops[later_idx]
+            hit = set(donated) & set(later.input_names())
+            for n in sorted(hit):
+                result.add(
+                    "error", READ_AFTER_DONATE,
+                    f"op {later.type!r} reads {n!r} after op "
+                    f"{op.type!r} (op #{idx}) donated its buffer",
+                    later, block.idx, later_idx)
+        for n in sorted(set(donated) & fetch):
+            result.add(
+                "error", DONATED_VAR_FETCHED,
+                f"fetch target {n!r} is donated by op {op.type!r} "
+                f"(op #{idx}) — the fetched handle would alias a "
+                f"consumed buffer",
+                op, block.idx, idx)
+
+
+def collective_signature(program: Program) -> List[Tuple]:
+    """The ordered collective schedule of a program: (op type, reduce
+    axes, ring id, operand names) per collective op.  Operand names are
+    part of the schedule — a bucketing pass that splits or reorders the
+    same grads differently on one rank deadlocks the mesh even though
+    the op kinds agree.  Two clones of one program running on different
+    ranks MUST have identical signatures."""
+    collectives = _collective_types()
+    sig = []
+    for op in program.global_block().ops:
+        if op.type in collectives:
+            axes = op.attrs.get("_axis_name")
+            if isinstance(axes, (list, tuple)):
+                axes = tuple(axes)
+            sig.append((op.type, axes, op.attrs.get("ring_id", 0),
+                        tuple(op.input_names())))
+    return sig
+
+
+def check_collective_consistency(programs: Sequence[Program],
+                                 result: Optional[VerifyResult] = None
+                                 ) -> VerifyResult:
+    """Compare the collective schedules of program clones (one per rank /
+    per pass variant).  Divergence — different op order, bucket split, or
+    reduce axes — is the cross-rank deadlock class the runtime cannot
+    detect (every rank blocks in a different collective)."""
+    result = result or VerifyResult()
+    if len(programs) < 2:
+        return result
+    base = collective_signature(programs[0])
+    for i, p in enumerate(programs[1:], start=1):
+        sig = collective_signature(p)
+        if sig != base:
+            # find the first divergence point for the message
+            j = 0
+            while j < min(len(base), len(sig)) and base[j] == sig[j]:
+                j += 1
+            a = base[j] if j < len(base) else "<end of schedule>"
+            b = sig[j] if j < len(sig) else "<end of schedule>"
+            result.add(
+                "error", COLLECTIVE_SEQ_DIVERGENCE,
+                f"program clone #{i} diverges from clone #0 at collective "
+                f"#{j}: {a} vs {b} ({len(base)} vs {len(sig)} collectives "
+                f"total) — ranks would deadlock mid-step")
+    return result
+
+
+# ---------------------------------------------------------------------------
+# top-level entry points
+# ---------------------------------------------------------------------------
+
+
+def verify_program(program: Program, startup: Optional[Program] = None,
+                   feed_names: Iterable[str] = (),
+                   fetch_names: Iterable[str] = (),
+                   scope_names: Iterable[str] = ()) -> VerifyResult:
+    """Run every static check over ``program``; returns the collected
+    :class:`VerifyResult` (caller decides whether to raise)."""
+    result = VerifyResult(program)
+    verify_structure(program, result, feed_names, scope_names)
+    if startup is not None:
+        verify_startup_agreement(program, startup, result)
+    infer_shapes(program, result, feed_names)
+    verify_distributed(program, result, fetch_names)
+    return result
+
+
+#: verification cache — a program is verified at most once per
+#: (_uid, _version, feeds, fetches); ``stats`` is asserted by tier-1
+_VERIFY_CACHE: Dict[Tuple, VerifyResult] = {}
+_VERIFY_CACHE_CAP = 256
+VERIFY_STATS = {"runs": 0, "hits": 0}
+
+
+def verify_cached(program: Program, feed_names: Iterable[str] = (),
+                  fetch_names: Iterable[str] = (),
+                  scope_names: Iterable[str] = (),
+                  startup: Optional[Program] = None,
+                  raise_on_error: bool = True) -> VerifyResult:
+    """Cached :func:`verify_program` — the Executor/CompiledProgram wiring
+    point.  The full-program walk runs once per program version; repeat
+    ``prepare``/``run`` calls hit the cache."""
+    key = (program._uid, program._version,
+           tuple(sorted(feed_names)), tuple(fetch_names))
+    result = _VERIFY_CACHE.get(key)
+    if result is None:
+        VERIFY_STATS["runs"] += 1
+        result = verify_program(program, startup=startup,
+                                feed_names=feed_names,
+                                fetch_names=fetch_names,
+                                scope_names=scope_names)
+        if len(_VERIFY_CACHE) >= _VERIFY_CACHE_CAP:
+            _VERIFY_CACHE.pop(next(iter(_VERIFY_CACHE)))
+        _VERIFY_CACHE[key] = result
+    else:
+        VERIFY_STATS["hits"] += 1
+    if raise_on_error:
+        result.raise_on_error()
+    return result
+
+
+def clear_verify_cache():
+    _VERIFY_CACHE.clear()
+    VERIFY_STATS["runs"] = 0
+    VERIFY_STATS["hits"] = 0
+
+
+# ---------------------------------------------------------------------------
+# 4. pass-pipeline invariant checking
+# ---------------------------------------------------------------------------
+
+
+def _defined_names(program: Program) -> Set[str]:
+    """Names either declared or produced somewhere in the program."""
+    out: Set[str] = set()
+    for b in program.blocks:
+        out |= set(b.vars)
+        for op in b.ops:
+            out |= set(op.output_names())
+    return out
+
+
+def _producible_names(program: Program, feed_names=()) -> Set[str]:
+    """Names a lowering could materialise: feeds, data/persistable/
+    initializer vars, and every op output."""
+    out = set(feed_names)
+    for b in program.blocks:
+        for name, v in b.vars.items():
+            if v.persistable or v.is_data or v.initializer is not None:
+                out.add(name)
+        for op in b.ops:
+            out |= set(op.output_names())
+    return out
+
+
+def pass_snapshot(program: Program, fetch_names: Iterable[str] = ()
+                  ) -> Dict[str, Any]:
+    """Pre-pass state consumed by :func:`check_pass_invariants`."""
+    return {
+        "defined": _defined_names(program),
+        "producible": _producible_names(program),
+        "fetch_names": tuple(fetch_names),
+        "op_count": sum(len(b.ops) for b in program.blocks),
+    }
+
+
+def check_pass_invariants(program: Program, pass_name: str,
+                          snapshot: Dict[str, Any],
+                          fetch_names: Iterable[str] = ()):
+    """Post-pass invariant check (ref: the reference's per-pass graph
+    validity checks in framework/ir/pass.cc ApplyImpl wrappers): the
+    rewritten program must still be structurally well-formed, and every
+    fetch target that was producible before the pass must remain
+    producible after it.  Raises :class:`PassInvariantError` naming the
+    pass, with the defined-var diff — so a fusion pass that breaks
+    well-formedness is caught at the pass boundary, not at compile."""
+    fetch_names = tuple(fetch_names) or snapshot.get("fetch_names", ())
+    result = VerifyResult(program)
+    verify_structure(program, result)
+    problems = [d for d in result.errors()
+                if d.code in (USE_BEFORE_DEF, UNDECLARED_INPUT,
+                              MISSING_OP_IMPL)]
+    producible = _producible_names(program)
+    lost_fetches = [n for n in fetch_names
+                    if n in snapshot["producible"] and n not in producible]
+    if not problems and not lost_fetches:
+        return
+    defined_now = _defined_names(program)
+    dropped = sorted(snapshot["defined"] - defined_now)
+    added = sorted(defined_now - snapshot["defined"])
+    lines = [f"pass {pass_name!r} broke program invariants "
+             f"(ops {snapshot['op_count']} → "
+             f"{sum(len(b.ops) for b in program.blocks)}):"]
+    if lost_fetches:
+        lines.append(f"  fetch targets no longer producible: {lost_fetches}")
+    for d in problems:
+        lines.append("  " + d.format().replace("\n", "\n  "))
+    if dropped:
+        lines.append(f"  defined-var set dropped: {dropped[:20]}"
+                     + (" ..." if len(dropped) > 20 else ""))
+    if added:
+        lines.append(f"  defined-var set added: {added[:20]}"
+                     + (" ..." if len(added) > 20 else ""))
+    raise PassInvariantError("\n".join(lines))
+
+
+__all__ = [
+    "Diagnostic", "VerifyResult", "PassInvariantError",
+    "verify_program", "verify_cached", "clear_verify_cache",
+    "verify_structure", "verify_startup_agreement", "infer_shapes",
+    "verify_distributed", "collective_signature",
+    "check_collective_consistency", "pass_snapshot",
+    "check_pass_invariants", "op_reads_recursive", "VERIFY_STATS",
+]

@@ -290,6 +290,20 @@ class CompiledProgram:
         clone = self._program.clone()
         for pname in self._pending_passes:
             apply_pass(clone, pname, fetch_names=list(fetch_names))
+        from ..flags import flag
+        if flag("verify_programs"):
+            # the rewritten variant is a NEW program (_uid) — verify it
+            # once here (cached) so a strategy pass that broke
+            # well-formedness is reported against the pass pipeline, not
+            # as an in-jit trace error.  The collective schedule of the
+            # variant must also match the base program's: a pass that
+            # reorders/drops collectives would deadlock ranks mid-step.
+            from .analysis import (check_collective_consistency,
+                                   verify_cached)
+            verify_cached(clone, fetch_names=list(fetch_names),
+                          raise_on_error=True)
+            check_collective_consistency(
+                [self._program, clone]).raise_on_error()
         evicted_uid = None
         if len(variants) >= self._VARIANT_CAP:
             _, stale = variants.popitem(last=False)

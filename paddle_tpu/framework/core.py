@@ -267,14 +267,36 @@ class Block:
             b = b.parent_block
         return None
 
-    def create_var(self, name=None, shape=(), dtype="float32",
+    def create_var(self, name=None, shape=None, dtype=None,
                    persistable=False, stop_gradient=True, is_data=False,
                    initializer=None, **kw) -> Variable:
         if name is None:
             name = unique_name.generate("tmp")
         if name in self.vars:
-            return self.vars[name]
-        v = Variable(self, name, shape, dtype, persistable=persistable,
+            # re-declaration returns the existing var — but only when the
+            # requested metadata agrees with it.  Silently handing back a
+            # conflicting declaration masks real layer bugs (ref:
+            # framework.py Block.create_var raises on VarDesc mismatch);
+            # a () shape or omitted dtype means "unspecified" and never
+            # conflicts.
+            existing = self.vars[name]
+            from .errors import InvalidArgumentError
+            if shape and existing.shape and \
+                    tuple(int(s) for s in shape) != tuple(existing.shape):
+                raise InvalidArgumentError(
+                    f"create_var({name!r}): requested shape "
+                    f"{list(shape)} conflicts with existing declaration "
+                    f"{list(existing.shape)}")
+            if dtype is not None and \
+                    convert_dtype(dtype) != existing.dtype:
+                raise InvalidArgumentError(
+                    f"create_var({name!r}): requested dtype "
+                    f"{convert_dtype(dtype)} conflicts with existing "
+                    f"declaration {existing.dtype}")
+            return existing
+        v = Variable(self, name, shape if shape is not None else (),
+                     dtype if dtype is not None else "float32",
+                     persistable=persistable,
                      stop_gradient=stop_gradient, is_data=is_data,
                      initializer=initializer)
         self.vars[name] = v
@@ -438,17 +460,33 @@ class Program:
 
     # -- pruning (ref: framework.py:4399 _prune) -------------------------
     def _prune(self, targets: Sequence[Variable]) -> "Program":
-        """Return a clone keeping only ops needed to compute ``targets``."""
+        """Return a clone keeping only ops needed to compute ``targets``.
+
+        An op's read set includes reads made inside its control-flow
+        sub-blocks (while/cond bodies close over outer vars through the
+        Block-valued attrs): scanning only global-block op inputs would
+        prune away the producers a loop body depends on."""
         p = self.clone()
         target_names = {t.name if isinstance(t, Variable) else str(t)
                         for t in targets}
         blk = p.global_block()
         needed = set(target_names)
         kept = []
+
+        def op_reads(op):
+            reads = set(op.input_names())
+            for attr in op.attrs.values():
+                subs = attr if isinstance(attr, (list, tuple)) else (attr,)
+                for sub in subs:
+                    if isinstance(sub, Block):
+                        for sub_op in sub.ops:
+                            reads |= op_reads(sub_op)
+            return reads
+
         for op in reversed(blk.ops):
             if set(op.output_names()) & needed:
                 kept.append(op)
-                needed |= set(op.input_names())
+                needed |= op_reads(op)
         blk.ops = list(reversed(kept))
         p._bump_version()
         return p

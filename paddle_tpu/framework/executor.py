@@ -601,6 +601,21 @@ class PreparedStep:
         self._program = program
         self._fetch_names = _fetch_names(fetch_list)
         self._declared_feed_names = list(feed_names or [])
+        from ..flags import flag
+        if flag("verify_programs"):
+            # static verification (framework/analysis.py): once per
+            # program (_uid, _version) — the InferShape/PADDLE_ENFORCE
+            # safety net, run before any trace/compile cost.  Errors are
+            # InvalidArgumentError diagnostics anchored at the op's
+            # creation site.  The prepared path also enforces the
+            # donation soundness rules (donated-var-fetched), which are
+            # real aliasing hazards under the device-resident fast path.
+            from .analysis import verify_cached
+            verify_cached(self._program,
+                          feed_names=self._declared_feed_names,
+                          fetch_names=self._fetch_names,
+                          scope_names=scope.var_names(),
+                          raise_on_error=True)
         self._readers = tuple(getattr(program, "_py_readers", ()))
         # one _CompiledStep per feed signature (bucketed data keeps several
         # live); state is shared across them — same program, same vars

@@ -28,9 +28,25 @@ def register_pass(name: str):
 
 
 def apply_pass(program: Program, name: str, **kwargs) -> Program:
-    """Apply one pass in place (ref: pass.h Pass::Apply)."""
+    """Apply one pass in place (ref: pass.h Pass::Apply).
+
+    Under ``flag("verify_passes")`` the program is snapshotted before and
+    invariant-checked after the rewrite (framework/analysis.py): a pass
+    that drops a fetch target's producer or leaves a dangling input read
+    raises :class:`analysis.PassInvariantError` naming the pass — the
+    boundary check the reference gets from per-pass ir::Graph validation."""
+    from ..flags import flag
+    verify = flag("verify_passes")
+    snap = None
+    if verify:
+        from .analysis import pass_snapshot
+        snap = pass_snapshot(program, kwargs.get("fetch_names") or ())
     PASSES[name](program, **kwargs)
     program._bump_version()
+    if verify:
+        from .analysis import check_pass_invariants
+        check_pass_invariants(program, name, snap,
+                              kwargs.get("fetch_names") or ())
     return program
 
 

@@ -121,7 +121,7 @@ def py_reader(capacity: int, shapes: Sequence, dtypes: Sequence,
     data_vars = []
     for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
         v = block.create_var(name=f"{rname}.slot{i}", shape=tuple(shape),
-                             dtype=dtype)
+                             dtype=dtype, is_data=True)
         data_vars.append(v)
     reader = PyReader(capacity, data_vars, rname, use_double_buffer)
     main.__dict__.setdefault("_py_readers", []).append(reader)

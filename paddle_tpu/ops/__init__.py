@@ -1,6 +1,9 @@
 """JAX op implementations — importing this package registers all ops."""
 
-from .registry import OPS, register, get_op, has_op, LoweringContext
+from .registry import (OPS, OP_SPECS, register, get_op, has_op,
+                       LoweringContext, op_spec, get_op_spec, has_op_spec,
+                       VarSig, SpecMismatch)
+from . import op_specs   # noqa: F401  (registers the built-in spec library)
 from . import math_ops      # noqa: F401
 from . import nn_ops        # noqa: F401
 from . import tensor_ops    # noqa: F401

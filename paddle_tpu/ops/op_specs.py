@@ -1,0 +1,708 @@
+"""Static shape/dtype specs for the op registry — the InferShape library.
+
+The reference implements per-op ``InferShape``/``InferVarType`` in C++
+(ref: operators/*_op.cc InferShape methods, framework/shape_inference.h);
+each spec here is the trace-free Python analog, registered through the
+``op_spec`` channel next to the op's JAX impl and consumed by the static
+verifier (framework/analysis.py).
+
+Conventions:
+
+* ``ins`` maps input slot → list of :class:`VarSig`; a dim of ``-1`` is
+  unknown (batch), ``shape is None`` is fully unknown.
+* An infer function returns ``{slot: [VarSig, ...]}`` for the output
+  slots it has an opinion about (others are left to declared metadata),
+  or ``None`` for "no opinion".
+* Invalid input combinations raise :class:`SpecMismatch` with
+  ``kind="shape"`` or ``kind="dtype"`` — the verifier turns that into an
+  ``InvalidArgumentError`` diagnostic anchored at the op's creation site.
+
+Long-tail ops register with ``infer=None``: they count as *specced* for
+coverage purposes (the op is known to the static layer) without claiming
+shape knowledge — the warn-don't-fail path for exotic ops.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .registry import SpecMismatch, VarSig, op_spec
+
+_INT_DTYPES = ("int8", "uint8", "int16", "int32", "int64", "bool")
+
+
+def _sig(ins, slot, i=0) -> Optional[VarSig]:
+    v = ins.get(slot)
+    if not v or i >= len(v):
+        return None
+    return v[i]
+
+
+def _is_int(dtype: str) -> bool:
+    return dtype in _INT_DTYPES
+
+
+def _known(shape) -> bool:
+    return shape is not None and all(int(d) >= 0 for d in shape)
+
+
+def _numel(shape):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _dim_join(a: int, b: int) -> Optional[int]:
+    """Broadcast-join two dims; None signals a conflict."""
+    a, b = int(a), int(b)
+    if a == b:
+        return a
+    if a == 1:
+        return b
+    if b == 1:
+        return a
+    if a == -1 or b == -1:
+        return -1
+    return None
+
+
+def broadcast_shapes(sx, sy, axis=-1, op_name=""):
+    """Paddle elementwise broadcast: Y aligns into X at ``axis`` (trailing
+    when -1).  Returns the output shape or raises SpecMismatch."""
+    if sx is None or sy is None:
+        return None
+    big, small = (sx, sy) if len(sx) >= len(sy) else (sy, sx)
+    if axis == -1 or len(sx) == len(sy):
+        offset = len(big) - len(small)
+    else:
+        offset = int(axis)
+        if offset < 0 or offset + len(small) > len(big):
+            raise SpecMismatch(
+                f"{op_name}: axis={axis} places Y{list(sy)} outside "
+                f"X{list(sx)}", kind="shape")
+    out = [int(d) for d in big]
+    for i, d in enumerate(small):
+        j = _dim_join(out[offset + i], d)
+        if j is None:
+            raise SpecMismatch(
+                f"{op_name}: operands X{list(sx)} and Y{list(sy)} are not "
+                f"broadcast-compatible at dim {offset + i} "
+                f"({out[offset + i]} vs {int(d)})", kind="shape")
+        out[offset + i] = j
+    return tuple(out)
+
+
+def _require_same_dtype(x, y, op_name):
+    if x is not None and y is not None and x.dtype != y.dtype:
+        raise SpecMismatch(
+            f"{op_name}: operand dtypes differ — X is {x.dtype}, Y is "
+            f"{y.dtype} (insert an explicit cast)", kind="dtype")
+
+
+# ---------------------------------------------------------------------------
+# generic infer builders
+# ---------------------------------------------------------------------------
+
+
+def same_as_input(slot="X", out_slot="Out"):
+    """Unary shape/dtype-preserving op."""
+    def infer(ins, attrs):
+        v = _sig(ins, slot)
+        if v is None:
+            return None
+        return {out_slot: [VarSig(v.shape, v.dtype)]}
+    return infer
+
+
+def elementwise(out_dtype=None, check_dtype=True):
+    """Binary broadcast op; ``out_dtype`` overrides (comparison → bool)."""
+    def infer(ins, attrs):
+        xv, yv = _sig(ins, "X"), _sig(ins, "Y")
+        if xv is None or yv is None:
+            return None
+        name = attrs.get("_op_type", "elementwise")
+        if check_dtype and out_dtype is None:
+            _require_same_dtype(xv, yv, name)
+        shape = broadcast_shapes(xv.shape, yv.shape,
+                                 attrs.get("axis", -1), name)
+        return {"Out": [VarSig(shape, out_dtype or xv.dtype)]}
+    return infer
+
+
+def from_shape_attr(dtype_default="float32"):
+    """Ops whose output shape/dtype come from attrs (fill_constant,
+    random initializer ops)."""
+    def infer(ins, attrs):
+        shape = attrs.get("shape")
+        if shape is None:
+            return None
+        dtype = attrs.get("dtype", dtype_default)
+        try:
+            from ..framework.core import convert_dtype
+            dtype = convert_dtype(dtype)
+        except Exception:
+            dtype = dtype_default
+        return {"Out": [VarSig(tuple(int(s) for s in shape), dtype)]}
+    return infer
+
+
+# ---------------------------------------------------------------------------
+# math ops
+# ---------------------------------------------------------------------------
+
+
+def _infer_mul(ins, attrs):
+    xv, yv = _sig(ins, "X"), _sig(ins, "Y")
+    if xv is None or yv is None or xv.shape is None or yv.shape is None:
+        return None
+    _require_same_dtype(xv, yv, "mul")
+    xn = int(attrs.get("x_num_col_dims", 1))
+    yn = int(attrs.get("y_num_col_dims", 1))
+    sx, sy = xv.shape, yv.shape
+    if len(sx) < xn + 1 or len(sy) < yn + 1:
+        raise SpecMismatch(
+            f"mul: rank too small for x_num_col_dims={xn}/"
+            f"y_num_col_dims={yn} — X{list(sx)}, Y{list(sy)}", kind="shape")
+    k_x = sx[xn:]
+    k_y = sy[:yn]
+    if _known(k_x) and _known(k_y) and _numel(k_x) != _numel(k_y):
+        raise SpecMismatch(
+            f"mul: inner dims disagree — X{list(sx)} flattens to "
+            f"[*, {_numel(k_x)}] but Y{list(sy)} flattens to "
+            f"[{_numel(k_y)}, *]", kind="shape")
+    out = tuple(sx[:xn]) + tuple(sy[yn:])
+    return {"Out": [VarSig(out, xv.dtype)]}
+
+
+def _infer_matmul(ins, attrs):
+    xv, yv = _sig(ins, "X"), _sig(ins, "Y")
+    if xv is None or yv is None or xv.shape is None or yv.shape is None:
+        return None
+    _require_same_dtype(xv, yv, "matmul")
+    tx = bool(attrs.get("transpose_X", attrs.get("trans_x", False)))
+    ty = bool(attrs.get("transpose_Y", attrs.get("trans_y", False)))
+    sx, sy = list(xv.shape), list(yv.shape)
+    if len(sx) < 2 or len(sy) < 2:
+        return None                      # 1-D matmul forms: leave to jax
+    mx, kx = (sx[-1], sx[-2]) if tx else (sx[-2], sx[-1])
+    ky, ny = (sy[-1], sy[-2]) if ty else (sy[-2], sy[-1])
+    if kx >= 0 and ky >= 0 and kx != ky:
+        raise SpecMismatch(
+            f"matmul: contracted dims disagree — X{list(xv.shape)}"
+            f"{'^T' if tx else ''} × Y{list(yv.shape)}"
+            f"{'^T' if ty else ''} contracts {kx} against {ky}",
+            kind="shape")
+    batch_x, batch_y = sx[:-2], sy[:-2]
+    big, small = (batch_x, batch_y) if len(batch_x) >= len(batch_y) \
+        else (batch_y, batch_x)
+    batch = [int(d) for d in big]
+    off = len(big) - len(small)
+    for i, d in enumerate(small):
+        j = _dim_join(batch[off + i], d)
+        if j is None:
+            raise SpecMismatch(
+                f"matmul: batch dims disagree — X{list(xv.shape)} vs "
+                f"Y{list(yv.shape)}", kind="shape")
+        batch[off + i] = j
+    return {"Out": [VarSig(tuple(batch) + (mx, ny), xv.dtype)]}
+
+
+def _infer_mean(ins, attrs):
+    v = _sig(ins, "X")
+    if v is None:
+        return None
+    return {"Out": [VarSig((), v.dtype)]}
+
+
+def _infer_sum(ins, attrs):
+    vs = ins.get("X") or []
+    if not vs:
+        return None
+    base = vs[0]
+    for v in vs[1:]:
+        if v.shape is not None and base.shape is not None and \
+                len(v.shape) == len(base.shape):
+            for a, b in zip(v.shape, base.shape):
+                if a >= 0 and b >= 0 and a != b:
+                    raise SpecMismatch(
+                        f"sum: operand shapes disagree — {list(base.shape)} "
+                        f"vs {list(v.shape)}", kind="shape")
+        if v.dtype != base.dtype:
+            raise SpecMismatch(
+                f"sum: operand dtypes disagree — {base.dtype} vs {v.dtype}",
+                kind="dtype")
+    return {"Out": [VarSig(base.shape, base.dtype)]}
+
+
+def _infer_reduce(ins, attrs):
+    v = _sig(ins, "X")
+    if v is None or v.shape is None:
+        return None
+    if attrs.get("reduce_all") or attrs.get("dim") is None:
+        dims = list(range(len(v.shape)))
+    else:
+        d = attrs["dim"]
+        dims = [d] if isinstance(d, int) else list(d)
+        dims = [x + len(v.shape) if x < 0 else x for x in dims]
+    keep = bool(attrs.get("keep_dim", attrs.get("keepdim", False)))
+    out = []
+    for i, d in enumerate(v.shape):
+        if i in dims:
+            if keep:
+                out.append(1)
+        else:
+            out.append(d)
+    dtype = "bool" if attrs.get("_bool_out") else v.dtype
+    return {"Out": [VarSig(tuple(out), dtype)]}
+
+
+def _infer_scale(ins, attrs):
+    return same_as_input()(ins, attrs)
+
+
+def _infer_cast(ins, attrs):
+    v = _sig(ins, "X")
+    if v is None:
+        return None
+    dtype = attrs.get("out_dtype", attrs.get("dtype", "float32"))
+    try:
+        from ..framework.core import convert_dtype
+        dtype = convert_dtype(dtype)
+    except Exception:
+        return None
+    return {"Out": [VarSig(v.shape, dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# nn ops
+# ---------------------------------------------------------------------------
+
+
+def _conv_out_dim(size, k, pad, stride, dilation=1):
+    if size < 0:
+        return -1
+    eff = (k - 1) * dilation + 1
+    return (size + 2 * pad - eff) // stride + 1
+
+
+def _infer_conv2d(ins, attrs):
+    iv, fv = _sig(ins, "Input"), _sig(ins, "Filter")
+    if iv is None or fv is None or iv.shape is None or fv.shape is None:
+        return None
+    _require_same_dtype(iv, fv, "conv2d")
+    if len(iv.shape) != 4 or len(fv.shape) != 4:
+        raise SpecMismatch(
+            f"conv2d: expects 4-D NCHW input and OIHW filter, got "
+            f"Input{list(iv.shape)} Filter{list(fv.shape)}", kind="shape")
+    n, c, h, w = iv.shape
+    o, i, kh, kw = fv.shape
+    groups = int(attrs.get("groups", 1) or 1)
+    if c >= 0 and i >= 0 and c != i * groups:
+        raise SpecMismatch(
+            f"conv2d: input channels {c} != filter in-channels {i} × "
+            f"groups {groups}", kind="shape")
+    strides = list(attrs.get("strides", (1, 1)))
+    pads = list(attrs.get("paddings", (0, 0)))
+    dil = list(attrs.get("dilations", (1, 1)))
+    ho = _conv_out_dim(h, kh, pads[0], strides[0], dil[0])
+    wo = _conv_out_dim(w, kw, pads[1], strides[1], dil[1])
+    return {"Output": [VarSig((n, o, ho, wo), iv.dtype)]}
+
+
+def _infer_pool2d(ins, attrs):
+    v = _sig(ins, "X")
+    if v is None or v.shape is None or len(v.shape) != 4:
+        return None
+    n, c, h, w = v.shape
+    if attrs.get("global_pooling") or attrs.get("adaptive"):
+        ks = attrs.get("ksize", (1, 1))
+        if attrs.get("global_pooling"):
+            return {"Out": [VarSig((n, c, 1, 1), v.dtype)]}
+        return {"Out": [VarSig((n, c, int(ks[0]), int(ks[1])), v.dtype)]}
+    ks = list(attrs.get("ksize", (1, 1)))
+    strides = list(attrs.get("strides", ks))
+    pads = list(attrs.get("paddings", (0, 0)))
+    ceil = bool(attrs.get("ceil_mode", False))
+
+    def out_dim(size, k, p, s):
+        if size < 0:
+            return -1
+        if ceil:
+            return (size + 2 * p - k + s - 1) // s + 1
+        return (size + 2 * p - k) // s + 1
+
+    return {"Out": [VarSig((n, c, out_dim(h, ks[0], pads[0], strides[0]),
+                            out_dim(w, ks[1], pads[1], strides[1])),
+                           v.dtype)]}
+
+
+def _infer_layer_norm(ins, attrs):
+    v = _sig(ins, "X")
+    if v is None:
+        return None
+    return {"Y": [VarSig(v.shape, v.dtype)]}
+
+
+def _infer_batch_norm(ins, attrs):
+    v = _sig(ins, "X")
+    if v is None:
+        return None
+    return {"Y": [VarSig(v.shape, v.dtype)]}
+
+
+def _infer_lookup_table_v2(ins, attrs):
+    w, ids = _sig(ins, "W"), _sig(ins, "Ids")
+    if w is None or ids is None or w.shape is None:
+        return None
+    if not _is_int(ids.dtype):
+        raise SpecMismatch(
+            f"lookup_table_v2: Ids must be an integer tensor, got "
+            f"{ids.dtype}", kind="dtype")
+    if len(w.shape) != 2:
+        raise SpecMismatch(
+            f"lookup_table_v2: W must be 2-D [vocab, dim], got "
+            f"{list(w.shape)}", kind="shape")
+    if ids.shape is None:
+        return None
+    # the layer convention (layers/nn.py embedding) squeezes a declared
+    # trailing 1 dim from Ids — mirror it so declared metadata agrees
+    base = tuple(ids.shape[:-1]) if len(ids.shape) > 1 and \
+        ids.shape[-1] == 1 else tuple(ids.shape)
+    return {"Out": [VarSig(base + (w.shape[1],), w.dtype)]}
+
+
+def _infer_lookup_table(ins, attrs):
+    w, ids = _sig(ins, "W"), _sig(ins, "Ids")
+    if w is None or ids is None or w.shape is None or ids.shape is None:
+        return None
+    if not _is_int(ids.dtype):
+        raise SpecMismatch(
+            f"lookup_table: Ids must be an integer tensor, got {ids.dtype}",
+            kind="dtype")
+    base = tuple(ids.shape[:-1]) if ids.shape and ids.shape[-1] == 1 \
+        else tuple(ids.shape)
+    return {"Out": [VarSig(base + (w.shape[1],), w.dtype)]}
+
+
+def _infer_softmax_with_ce(ins, attrs):
+    logits, label = _sig(ins, "Logits"), _sig(ins, "Label")
+    if logits is None or logits.shape is None:
+        return None
+    if label is not None and not attrs.get("soft_label", False) and \
+            not _is_int(label.dtype):
+        raise SpecMismatch(
+            f"softmax_with_cross_entropy: hard Label must be integer, got "
+            f"{label.dtype}", kind="dtype")
+    loss_shape = tuple(logits.shape[:-1]) + (1,)
+    return {"Softmax": [VarSig(logits.shape, logits.dtype)],
+            "Loss": [VarSig(loss_shape, logits.dtype)]}
+
+
+def _infer_cross_entropy(ins, attrs):
+    xv, label = _sig(ins, "X"), _sig(ins, "Label")
+    if xv is None or xv.shape is None:
+        return None
+    if label is not None and not attrs.get("soft_label", False) and \
+            not _is_int(label.dtype):
+        raise SpecMismatch(
+            f"cross_entropy: hard Label must be integer, got {label.dtype}",
+            kind="dtype")
+    return {"Y": [VarSig(tuple(xv.shape[:-1]) + (1,), xv.dtype)],
+            "Out": [VarSig(tuple(xv.shape[:-1]) + (1,), xv.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# tensor manipulation
+# ---------------------------------------------------------------------------
+
+
+def _infer_reshape2(ins, attrs):
+    v = _sig(ins, "X")
+    target = attrs.get("shape")
+    if v is None or target is None:
+        return None
+    out = []
+    for i, d in enumerate(target):
+        d = int(d)
+        if d == 0:
+            out.append(v.shape[i] if v.shape is not None and
+                       i < len(v.shape) else -1)
+        else:
+            out.append(d)
+    if v.shape is not None and _known(v.shape) and _known(out):
+        if _numel(v.shape) != _numel(out):
+            raise SpecMismatch(
+                f"reshape2: cannot reshape {list(v.shape)} "
+                f"({_numel(v.shape)} elements) into {list(out)} "
+                f"({_numel(out)} elements)", kind="shape")
+    if v.shape is not None and _known(v.shape) and out.count(-1) == 1:
+        rest = 1
+        for d in out:
+            if d != -1:
+                rest *= d
+        if rest and _numel(v.shape) % rest == 0:
+            out[out.index(-1)] = _numel(v.shape) // rest
+    return {"Out": [VarSig(tuple(out), v.dtype)]}
+
+
+def _infer_transpose2(ins, attrs):
+    v = _sig(ins, "X")
+    perm = attrs.get("axis")
+    if v is None or v.shape is None or perm is None:
+        return None
+    if len(perm) != len(v.shape):
+        raise SpecMismatch(
+            f"transpose2: perm {list(perm)} rank != input rank "
+            f"{len(v.shape)} ({list(v.shape)})", kind="shape")
+    return {"Out": [VarSig(tuple(v.shape[int(p)] for p in perm), v.dtype)]}
+
+
+def _infer_unsqueeze2(ins, attrs):
+    v = _sig(ins, "X")
+    axes = attrs.get("axes")
+    if v is None or v.shape is None or axes is None:
+        return None
+    out = list(v.shape)
+    for a in axes:
+        a = int(a)
+        if a < 0:
+            a += len(out) + 1
+        out.insert(a, 1)
+    return {"Out": [VarSig(tuple(out), v.dtype)]}
+
+
+def _infer_concat(ins, attrs):
+    vs = ins.get("X") or []
+    if not vs or any(v.shape is None for v in vs):
+        return None
+    axis = int(attrs.get("axis", 0))
+    rank = len(vs[0].shape)
+    if axis < 0:
+        axis += rank
+    for v in vs[1:]:
+        if len(v.shape) != rank:
+            raise SpecMismatch(
+                f"concat: operand ranks differ — {list(vs[0].shape)} vs "
+                f"{list(v.shape)}", kind="shape")
+        if v.dtype != vs[0].dtype:
+            raise SpecMismatch(
+                f"concat: operand dtypes differ — {vs[0].dtype} vs "
+                f"{v.dtype}", kind="dtype")
+    out = list(vs[0].shape)
+    total = 0
+    for v in vs:
+        d = v.shape[axis]
+        if d < 0 or total < 0:
+            total = -1
+        else:
+            total += d
+    for i in range(rank):
+        if i == axis:
+            continue
+        for v in vs[1:]:
+            j = _dim_join(out[i], v.shape[i])
+            if j is None:
+                raise SpecMismatch(
+                    f"concat: non-axis dim {i} differs — "
+                    f"{list(vs[0].shape)} vs {list(v.shape)}", kind="shape")
+            out[i] = j
+    out[axis] = total
+    return {"Out": [VarSig(tuple(out), vs[0].dtype)]}
+
+
+def _infer_split(ins, attrs):
+    v = _sig(ins, "X")
+    if v is None or v.shape is None:
+        return None
+    axis = int(attrs.get("axis", 0))
+    if axis < 0:
+        axis += len(v.shape)
+    sections = attrs.get("sections") or []
+    num = int(attrs.get("num", 0) or 0)
+    outs = []
+    if sections:
+        for s in sections:
+            shp = list(v.shape)
+            shp[axis] = int(s)
+            outs.append(VarSig(tuple(shp), v.dtype))
+    elif num:
+        shp = list(v.shape)
+        if shp[axis] >= 0:
+            if shp[axis] % num != 0:
+                raise SpecMismatch(
+                    f"split: dim {axis} of {list(v.shape)} not divisible "
+                    f"by num={num}", kind="shape")
+            shp[axis] = shp[axis] // num
+        outs = [VarSig(tuple(shp), v.dtype) for _ in range(num)]
+    else:
+        return None
+    return {"Out": outs}
+
+
+def _infer_top_k(ins, attrs):
+    v = _sig(ins, "X")
+    if v is None or v.shape is None:
+        return None
+    k = int(attrs.get("k", 1))
+    out = tuple(v.shape[:-1]) + (k,)
+    return {"Out": [VarSig(out, v.dtype)]}
+
+
+def _infer_one_hot(ins, attrs):
+    v = _sig(ins, "X")
+    depth = attrs.get("depth")
+    if v is None or v.shape is None or depth is None:
+        return None
+    base = tuple(v.shape[:-1]) if v.shape and v.shape[-1] == 1 \
+        else tuple(v.shape)
+    return {"Out": [VarSig(base + (int(depth),), "float32")]}
+
+
+def _infer_fill_zeros_like(ins, attrs):
+    return same_as_input()(ins, attrs)
+
+
+def _infer_where(ins, attrs):
+    xv = _sig(ins, "X")
+    if xv is None:
+        return None
+    return {"Out": [VarSig(xv.shape, xv.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# optimizer / update ops
+# ---------------------------------------------------------------------------
+
+
+def _infer_opt_update(ins, attrs):
+    p, g = _sig(ins, "Param"), _sig(ins, "Grad")
+    if p is None:
+        return None
+    if g is not None and p.shape is not None and g.shape is not None and \
+            _known(p.shape) and _known(g.shape) and \
+            tuple(p.shape) != tuple(g.shape):
+        raise SpecMismatch(
+            f"optimizer update: Param{list(p.shape)} and Grad"
+            f"{list(g.shape)} shapes disagree", kind="shape")
+    return {"ParamOut": [VarSig(p.shape, p.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# collectives (flagged for the distributed-soundness checks)
+# ---------------------------------------------------------------------------
+
+
+def _infer_collective_same(ins, attrs):
+    return same_as_input()(ins, attrs)
+
+
+def register_default_specs():
+    """Register the built-in spec library (idempotent)."""
+    # elementwise family
+    for name in ("elementwise_add", "elementwise_sub", "elementwise_mul",
+                 "elementwise_div", "elementwise_max", "elementwise_min",
+                 "elementwise_pow", "elementwise_mod",
+                 "elementwise_floordiv"):
+        op_spec(name, infer=elementwise())
+    for name in ("equal", "not_equal", "less_than", "less_equal",
+                 "greater_than", "greater_equal"):
+        op_spec(name, infer=elementwise(out_dtype="bool", check_dtype=False))
+    for name in ("logical_and", "logical_or", "logical_xor"):
+        op_spec(name, infer=elementwise(out_dtype="bool", check_dtype=False))
+    op_spec("logical_not", infer=same_as_input())
+
+    # unary shape/dtype-preserving
+    for name in ("relu", "relu6", "sigmoid", "tanh", "gelu", "softmax",
+                 "log_softmax", "exp", "log", "sqrt", "rsqrt", "square",
+                 "abs", "floor", "ceil", "round", "sign", "softplus",
+                 "swish", "hard_swish", "hard_sigmoid", "leaky_relu",
+                 "dropout", "scale", "assign", "clip", "pow",
+                 "softsign", "erf", "sin", "cos"):
+        op_spec(name, infer=same_as_input())
+
+    # math
+    op_spec("mul", infer=_infer_mul)
+    op_spec("matmul", infer=_infer_matmul)
+    op_spec("matmul_v2", infer=_infer_matmul)
+    op_spec("mean", infer=_infer_mean)
+    op_spec("sum", infer=_infer_sum)
+    for name in ("reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+                 "reduce_prod"):
+        op_spec(name, infer=_infer_reduce)
+    op_spec("reduce_all", infer=_infer_reduce)
+    op_spec("reduce_any", infer=_infer_reduce)
+    op_spec("cast", infer=_infer_cast)
+
+    # nn
+    op_spec("conv2d", infer=_infer_conv2d)
+    op_spec("depthwise_conv2d", infer=_infer_conv2d)
+    op_spec("pool2d", infer=_infer_pool2d)
+    op_spec("layer_norm", infer=_infer_layer_norm)
+    op_spec("batch_norm", infer=_infer_batch_norm)
+    op_spec("lookup_table", infer=_infer_lookup_table)
+    op_spec("lookup_table_v2", infer=_infer_lookup_table_v2)
+    op_spec("softmax_with_cross_entropy", infer=_infer_softmax_with_ce)
+    op_spec("cross_entropy", infer=_infer_cross_entropy)
+    op_spec("cross_entropy2", infer=_infer_cross_entropy)
+
+    # tensor manipulation
+    op_spec("reshape2", infer=_infer_reshape2)
+    op_spec("reshape", infer=_infer_reshape2)
+    op_spec("transpose2", infer=_infer_transpose2)
+    op_spec("transpose", infer=_infer_transpose2)
+    op_spec("unsqueeze2", infer=_infer_unsqueeze2)
+    op_spec("squeeze2", infer=None)
+    op_spec("concat", infer=_infer_concat)
+    op_spec("split", infer=_infer_split)
+    op_spec("top_k", infer=_infer_top_k)
+    op_spec("one_hot", infer=_infer_one_hot)
+    op_spec("fill_zeros_like", infer=_infer_fill_zeros_like)
+    op_spec("where", infer=_infer_where)
+    op_spec("fill_constant", infer=from_shape_attr())
+    for name in ("gaussian_random", "uniform_random",
+                 "truncated_gaussian_random"):
+        op_spec(name, infer=from_shape_attr())
+
+    # optimizer updates
+    for name in ("sgd", "momentum", "adam", "adamw", "adamax", "adagrad",
+                 "rmsprop", "lars_momentum", "lamb"):
+        op_spec(name, infer=_infer_opt_update)
+
+    # meta ops (known to the static layer, no shape opinion)
+    for name in ("feed", "fetch", "backward", "pipeline", "assign_value",
+                 "fill_constant_batch_size_like", "expand", "expand_as",
+                 "slice", "strided_slice", "stack", "gather", "gather_nd",
+                 "scatter", "arg_max", "arg_min", "argsort", "shape",
+                 "accuracy", "auc", "increment", "cumsum", "put_along_axis",
+                 "take_along_axis", "tile", "range", "linspace",
+                 "while_loop", "conditional_block", "switch_case",
+                 "static_rnn", "py_func", "print", "beam_gather",
+                 "gather_tree", "gather_tokens", "fused_attention",
+                 "multihead_matmul", "fused_elemwise_activation",
+                 "fused_bn_activation", "fused_add_layernorm",
+                 "fused_embedding_eltwise_layernorm", "fc",
+                 "affine_channel", "flatten2", "flatten",
+                 "uniform_random_batch_size_like", "seed"):
+        op_spec(name, infer=None)
+
+    # collectives — flagged so the distributed-soundness pass can find
+    # them structurally (divergent control flow, sequence divergence)
+    for name in ("c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+                 "c_allreduce_prod", "mp_allreduce_sum"):
+        op_spec(name, infer=_infer_collective_same, collective=True)
+    op_spec("c_identity", infer=_infer_collective_same)
+    op_spec("c_sync_calc_stream", infer=_infer_collective_same)
+    op_spec("c_sync_comm_stream", infer=_infer_collective_same)
+    for name in ("c_fused_allreduce_sum", "c_broadcast", "c_allgather",
+                 "c_reducescatter", "c_concat", "c_split", "alltoall",
+                 "collective_permute", "zero_reduce_scatter",
+                 "zero_all_gather", "zero_shard_slice", "c_embedding",
+                 "local_sgd_sync", "moe_ffn", "mp_copy"):
+        op_spec(name, infer=None, collective=True)
+    # zero_shard_slice/mp_copy are local ops but ride the collective
+    # schedule (their placement must agree across ranks), so they are
+    # flagged too.
+
+
+register_default_specs()

@@ -15,7 +15,7 @@ threading and lowering-time info (mesh, train/eval).
 
 from __future__ import annotations
 
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
 import jax
 
@@ -33,6 +33,84 @@ def register(name: str):
         OPS[name] = fn
         return fn
     return deco
+
+
+# ---------------------------------------------------------------------------
+# op_spec — optional static shape/dtype metadata channel
+# ---------------------------------------------------------------------------
+# The reference runs C++ InferShape/InferVarType at every op insertion
+# (ref: framework/op_desc.cc InferShape, shape_inference.h); this rebuild
+# deliberately dropped that machinery, so a malformed program only fails
+# deep inside jit tracing.  ``op_spec`` restores the metadata channel: an
+# op may register, alongside its JAX impl, a trace-free ``infer`` function
+# consumed by the static verifier (framework/analysis.py).
+#
+#     infer(ins, attrs) -> {slot: [VarSig, ...]}   # or None (no opinion)
+#
+# where ``ins`` maps input slot names → lists of VarSig (shape tuple with
+# -1 for unknown dims, canonical dtype string).  An infer function raises
+# ``SpecMismatch`` to report an invalid input combination (wrong rank,
+# incompatible inner dims, conflicting dtypes); the verifier anchors the
+# resulting diagnostic to the op's recorded user callstack.
+
+OP_SPECS: Dict[str, "OpSpec"] = {}
+
+
+class VarSig:
+    """Static (shape, dtype) signature of a variable.  ``shape`` entries of
+    -1 are unknown (batch dims); ``shape is None`` means fully unknown."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = None if shape is None else tuple(int(s) for s in shape)
+        self.dtype = str(dtype)
+
+    def __repr__(self):
+        return f"VarSig(shape={self.shape}, dtype={self.dtype!r})"
+
+    def __eq__(self, other):
+        return (isinstance(other, VarSig) and self.shape == other.shape
+                and self.dtype == other.dtype)
+
+
+class SpecMismatch(Exception):
+    """Raised by an ``infer`` function when the op's static inputs are
+    inconsistent (the InferShape-failure analog).  ``kind`` distinguishes
+    shape from dtype defects for diagnostics."""
+
+    def __init__(self, message: str, kind: str = "shape"):
+        super().__init__(message)
+        self.kind = kind
+
+
+class OpSpec:
+    """Static metadata for one op type."""
+
+    __slots__ = ("name", "infer", "collective")
+
+    def __init__(self, name: str, infer: Optional[Callable] = None,
+                 collective: bool = False):
+        self.name = name
+        self.infer = infer
+        self.collective = collective
+
+
+def op_spec(name: str, infer: Optional[Callable] = None,
+            collective: bool = False):
+    """Register static metadata for op ``name`` (idempotent per name —
+    re-registration replaces, so spec modules can be reloaded)."""
+    spec = OpSpec(name, infer=infer, collective=collective)
+    OP_SPECS[name] = spec
+    return spec
+
+
+def get_op_spec(name: str) -> Optional[OpSpec]:
+    return OP_SPECS.get(name)
+
+
+def has_op_spec(name: str) -> bool:
+    return name in OP_SPECS
 
 
 def get_op(name: str) -> Callable:

@@ -1,0 +1,511 @@
+"""Static verifier tests (framework/analysis.py): one minimal failing
+program per defect class with a callstack-anchored diagnostic, op_spec
+coverage over the model zoo, pass-pipeline invariant checking, the
+verification cache contract on Executor.prepare, and the dp8/ZeRO-1
+collective/donation soundness census."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import flags
+from paddle_tpu.framework import analysis
+from paddle_tpu.framework.analysis import (
+    BF16_ALLREDUCE_INTEGER, COLLECTIVE_DIVERGENT_CF,
+    COLLECTIVE_SEQ_DIVERGENCE, DONATED_VAR_FETCHED, DTYPE_MISMATCH,
+    DUPLICATE_WRITE, MISSING_OP_IMPL, READ_AFTER_DONATE, SHAPE_MISMATCH,
+    STARTUP_MAIN_MISMATCH, USE_BEFORE_DEF, PassInvariantError,
+    check_collective_consistency, collective_signature, verify_program)
+from paddle_tpu.framework.core import Program, program_guard
+from paddle_tpu.framework.errors import InvalidArgumentError
+
+
+def _one(result, code, severity="error"):
+    """The single diagnostic of ``code``; asserts it exists."""
+    hits = result.by_code(code)
+    assert hits, (f"no {code!r} diagnostic; got "
+                  f"{[(d.code, d.message) for d in result.diagnostics]}")
+    assert all(d.severity == severity for d in hits)
+    return hits[0]
+
+
+def _assert_anchored(diag, op_type):
+    """Diagnostic names the op type and the user's creation call site."""
+    assert diag.op_type == op_type
+    assert any("test_analysis.py" in frame for frame in diag.callstack), \
+        f"callstack not anchored to user site: {diag.callstack}"
+    assert op_type in diag.format()
+
+
+# ---------------------------------------------------------------------------
+# seeded defect classes (acceptance: all six, with anchored diagnostics)
+# ---------------------------------------------------------------------------
+
+
+def test_detects_use_before_def():
+    p = Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4, 4))
+    b.create_var(name="y", shape=(4, 4))
+    # y is read before anything defines it (not data/persistable)
+    b.append_op(type="relu", inputs={"X": ["y"]}, outputs={"Out": ["x"]})
+    d = _one(verify_program(p), USE_BEFORE_DEF)
+    _assert_anchored(d, "relu")
+
+
+def test_detects_missing_op_impl():
+    p = Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4,), is_data=True)
+    b.create_var(name="y", shape=(4,))
+    b.append_op(type="totally_unregistered_op", inputs={"X": ["x"]},
+                outputs={"Out": ["y"]})
+    d = _one(verify_program(p), MISSING_OP_IMPL)
+    _assert_anchored(d, "totally_unregistered_op")
+
+
+def test_detects_shape_mismatch():
+    p = Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(8, 16), is_data=True)
+    b.create_parameter(name="w", shape=(32, 4))     # inner dim 16 != 32
+    b.create_var(name="out", shape=(8, 4))
+    b.append_op(type="mul", inputs={"X": ["x"], "Y": ["w"]},
+                outputs={"Out": ["out"]},
+                attrs={"x_num_col_dims": 1, "y_num_col_dims": 1})
+    d = _one(verify_program(p), SHAPE_MISMATCH)
+    _assert_anchored(d, "mul")
+    assert "16" in d.message and "32" in d.message
+
+
+def test_detects_declared_vs_inferred_shape_conflict():
+    p = Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(8, 16), is_data=True)
+    b.create_parameter(name="w", shape=(16, 4))
+    b.create_var(name="out", shape=(8, 7))          # layer declared 7, op gives 4
+    b.append_op(type="mul", inputs={"X": ["x"], "Y": ["w"]},
+                outputs={"Out": ["out"]},
+                attrs={"x_num_col_dims": 1, "y_num_col_dims": 1})
+    d = _one(verify_program(p), SHAPE_MISMATCH)
+    _assert_anchored(d, "mul")
+
+
+def test_detects_dtype_mismatch():
+    p = Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4, 4), dtype="float32", is_data=True)
+    b.create_var(name="i", shape=(4, 4), dtype="int64", is_data=True)
+    b.create_var(name="out", shape=(4, 4))
+    b.append_op(type="elementwise_add", inputs={"X": ["x"], "Y": ["i"]},
+                outputs={"Out": ["out"]}, attrs={"axis": -1})
+    d = _one(verify_program(p), DTYPE_MISMATCH)
+    _assert_anchored(d, "elementwise_add")
+    assert "float32" in d.message and "int64" in d.message
+
+
+def test_detects_donated_var_fetched():
+    p = Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4, 4), is_data=True)
+    w = b.create_parameter(name="w", shape=(4, 4))
+    # w is updated in-program (donated state) AND fetched
+    b.append_op(type="elementwise_add", inputs={"X": ["w"], "Y": ["x"]},
+                outputs={"Out": ["w"]}, attrs={"axis": -1})
+    d = _one(verify_program(p, fetch_names=["w"]), DONATED_VAR_FETCHED)
+    _assert_anchored(d, "elementwise_add")
+    # without the fetch the same program is clean
+    assert verify_program(p).ok
+
+
+def test_detects_collective_under_divergent_control_flow():
+    p = Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4,), is_data=True)
+    b.create_var(name="cond", shape=(1,), dtype="bool", is_data=True)
+    b.create_var(name="out", shape=(4,))
+    sub = p._create_block()
+    sub.append_op(type="c_allreduce_sum", inputs={"X": ["x"]},
+                  outputs={"Out": ["x"]}, attrs={"ring_id": 0})
+    p._rollback()
+    b.append_op(type="conditional_block",
+                inputs={"Cond": ["cond"], "Closure": ["x"]},
+                outputs={"Out": ["out"]},
+                attrs={"true_block": sub, "false_block": sub,
+                       "closure_names": ["x"], "true_out_names": ["x"],
+                       "false_out_names": ["x"]})
+    d = _one(verify_program(p), COLLECTIVE_DIVERGENT_CF)
+    assert d.op_type == "c_allreduce_sum"
+    assert "conditional_block" in d.message
+
+
+# ---------------------------------------------------------------------------
+# further defect classes
+# ---------------------------------------------------------------------------
+
+
+def test_detects_bf16_allreduce_on_integer_grad():
+    p = Program()
+    b = p.global_block()
+    b.create_var(name="g", shape=(16,), dtype="int32", is_data=True)
+    b.append_op(type="c_allreduce_sum", inputs={"X": ["g"]},
+                outputs={"Out": ["g"]},
+                attrs={"ring_id": 0, "compress_dtype": "bfloat16"})
+    d = _one(verify_program(p), BF16_ALLREDUCE_INTEGER)
+    _assert_anchored(d, "c_allreduce_sum")
+
+
+def test_detects_read_after_donate():
+    p = Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4,), is_data=True)
+    b.create_var(name="y", shape=(4,))
+    b.create_var(name="z", shape=(4,))
+    b.append_op(type="scale", inputs={"X": ["x"]}, outputs={"Out": ["y"]},
+                attrs={"scale": 2.0, "_donated_inputs": ["x"]})
+    b.append_op(type="relu", inputs={"X": ["x"]}, outputs={"Out": ["z"]})
+    d = _one(verify_program(p), READ_AFTER_DONATE)
+    _assert_anchored(d, "relu")
+
+
+def test_detects_duplicate_write_and_startup_mismatch():
+    main, startup = Program(), Program()
+    b = main.global_block()
+    b.create_var(name="x", shape=(4,), is_data=True)
+    b.create_var(name="t", shape=(4,))
+    # t written twice, never read in between: first value is dead
+    b.append_op(type="relu", inputs={"X": ["x"]}, outputs={"Out": ["t"]})
+    b.append_op(type="tanh", inputs={"X": ["x"]}, outputs={"Out": ["t"]})
+    b.create_parameter(name="w", shape=(4, 4))
+    startup.global_block().create_parameter(name="w", shape=(4, 8))
+    r = verify_program(main, startup=startup)
+    assert _one(r, DUPLICATE_WRITE, severity="warning").op_type == "tanh"
+    assert "w" in _one(r, STARTUP_MAIN_MISMATCH).message
+
+
+def test_collective_sequence_divergence_across_clones():
+    def build(reverse):
+        p = Program()
+        b = p.global_block()
+        for n in ("g1", "g2"):
+            b.create_var(name=n, shape=(8,), is_data=True)
+        order = ("g2", "g1") if reverse else ("g1", "g2")
+        for n in order:
+            b.append_op(type="c_allreduce_sum", inputs={"X": [n]},
+                        outputs={"Out": [n]},
+                        attrs={"ring_id": 0, "_axis_name": "dp"})
+        return p
+
+    a, bb = build(False), build(True)
+    assert check_collective_consistency([a, a.clone()]).ok
+    r = check_collective_consistency([a, bb])
+    d = _one(r, COLLECTIVE_SEQ_DIVERGENCE)
+    assert "deadlock" in d.message
+    # bucket-order divergence: same ops, different arity
+    c = build(False)
+    c.global_block().ops[0].inputs["X"] = ["g1", "g2"]
+    assert not check_collective_consistency([a, c]).ok
+
+
+# ---------------------------------------------------------------------------
+# satellites: create_var conflicts, _prune through sub-blocks
+# ---------------------------------------------------------------------------
+
+
+def test_create_var_conflicting_redeclaration_raises():
+    p = Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4, 8), dtype="float32")
+    # benign re-gets: unspecified or agreeing metadata
+    assert b.create_var(name="x") is b.vars["x"]
+    assert b.create_var(name="x", shape=(4, 8)) is b.vars["x"]
+    with pytest.raises(InvalidArgumentError):
+        b.create_var(name="x", shape=(4, 9))
+    with pytest.raises(InvalidArgumentError):
+        b.create_var(name="x", dtype="int64")
+
+
+def test_prune_follows_subblock_reads():
+    p = Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4,), is_data=True)
+    b.create_var(name="h", shape=(4,))
+    b.create_var(name="out", shape=(4,))
+    # producer whose ONLY consumer lives inside a control-flow sub-block
+    b.append_op(type="relu", inputs={"X": ["x"]}, outputs={"Out": ["h"]})
+    sub = p._create_block()
+    sub.append_op(type="tanh", inputs={"X": ["h"]}, outputs={"Out": ["h"]})
+    p._rollback()
+    b.append_op(type="while_loop", inputs={"X": ["x"]},
+                outputs={"Out": ["out"]},
+                attrs={"body_block": sub, "x_names": ["x"],
+                       "closure_names": ["h"], "cond_block": sub,
+                       "cond_out": "h", "body_out_names": ["out"]})
+    pruned = p._prune([b.var("out")])
+    kept = [op.type for op in pruned.global_block().ops]
+    assert "relu" in kept, \
+        f"pruning dropped the producer a sub-block depends on: {kept}"
+
+
+# ---------------------------------------------------------------------------
+# op_spec coverage over the model zoo (warn-don't-fail for the long tail)
+# ---------------------------------------------------------------------------
+
+
+def _model_zoo_programs():
+    from paddle_tpu.models import (bert, ernie, resnet, se_resnext,
+                                   transformer, word2vec)
+    out = []
+
+    def build(name, fn):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            fetch = fn()
+        out.append((name, main, startup, fetch))
+
+    build("bert", lambda: [fluid.optimizer.Adam(1e-3).minimize(
+        bert.build_pretrain_network(bert.BertConfig.tiny())[1]) and None,
+        ][0])
+    build("resnet18", lambda: fluid.optimizer.Momentum(0.01, 0.9).minimize(
+        resnet.build_train_network(class_dim=10, depth=18,
+                                   image_shape=(3, 32, 32))[2]) and None)
+    cfg = transformer.TransformerConfig(
+        src_vocab_size=50, trg_vocab_size=50, max_length=16, d_model=32,
+        d_inner=64, n_head=2, n_layer=1, dropout=0.0)
+    build("transformer", lambda: fluid.optimizer.Adam(3e-3).minimize(
+        transformer.build_train_network(cfg)[1]) and None)
+    build("beam", lambda: transformer.build_beam_decode_network(
+        cfg, beam_size=3, max_out=4, bos=1, eos=2) and None)
+    build("ernie", lambda: fluid.optimizer.Adam(1e-3).minimize(
+        ernie.build_classification_network(ernie.ErnieConfig.tiny(),
+                                           3)[1]) and None)
+    build("word2vec", lambda: fluid.optimizer.Adam(1e-2).minimize(
+        word2vec.build_ngram_lm(100)[1]) and None)
+    build("se_resnext", lambda: fluid.optimizer.Momentum(
+        0.01, 0.9).minimize(se_resnext.build_classifier(
+            10, depth=50)[2]) and None)
+    return out
+
+
+def test_op_spec_coverage_over_model_zoo():
+    """Every op the model-zoo programs emit has an op_spec registered —
+    new ops must land with static metadata (the InferShape contract)."""
+    from paddle_tpu.ops.registry import OP_SPECS
+    missing = {}
+    for name, main, startup, _ in _model_zoo_programs():
+        for prog in (main, startup):
+            for blk in prog.blocks:
+                for op in blk.ops:
+                    if op.type not in OP_SPECS:
+                        missing.setdefault(op.type, 0)
+                        missing[op.type] += 1
+    assert not missing, (
+        f"model-zoo ops without op_spec (register one in "
+        f"ops/op_specs.py): {missing}")
+
+
+def test_unspecced_long_tail_warns_not_fails():
+    from paddle_tpu.ops.registry import register
+
+    if "exotic_longtail_op" not in __import__(
+            "paddle_tpu.ops.registry", fromlist=["OPS"]).OPS:
+        register("exotic_longtail_op")(lambda ctx, ins, attrs:
+                                       {"Out": ins["X"][0]})
+    p = Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4,), is_data=True)
+    b.create_var(name="y", shape=(4,))
+    b.append_op(type="exotic_longtail_op", inputs={"X": ["x"]},
+                outputs={"Out": ["y"]})
+    r = verify_program(p)
+    assert r.ok                                     # warn, don't fail
+    assert r.unspecced_ops.get("exotic_longtail_op") == 1
+    assert "exotic_longtail_op" in r.report()       # counted in lint report
+
+
+def test_model_zoo_programs_lint_clean_and_pass_pipeline_verifies():
+    """Integration: every model-zoo program (and every
+    PassBuilder.INFERENCE_PASSES output) lints clean with verification
+    on — including pass-boundary invariant checking."""
+    from paddle_tpu.framework.passes import PassBuilder
+    for name, main, startup, _ in _model_zoo_programs():
+        r = verify_program(main, startup=startup)
+        assert r.ok, f"{name}: {[d.format() for d in r.errors()]}"
+        infer = main.clone(for_test=True)
+        flags.set_flags({"verify_passes": True})
+        try:
+            PassBuilder().apply(infer)
+        finally:
+            flags.set_flags({"verify_passes": False})
+        r2 = verify_program(infer)
+        assert r2.ok, (f"{name} after INFERENCE_PASSES: "
+                       f"{[d.format() for d in r2.errors()]}")
+
+
+# ---------------------------------------------------------------------------
+# pass-pipeline invariant checking
+# ---------------------------------------------------------------------------
+
+
+def test_broken_pass_caught_at_pass_boundary():
+    from paddle_tpu.framework.passes import PASSES, apply_pass, register_pass
+
+    @register_pass("_test_broken_pass")
+    def _broken(program, fetch_names=(), **_):
+        # drop the producer of the fetch target — well-formedness broken
+        blk = program.global_block()
+        blk.ops[:] = blk.ops[:-1]
+
+    try:
+        p = Program()
+        with program_guard(p, Program()):
+            x = fluid.layers.data("x", shape=[4])
+            h = fluid.layers.fc(x, 8)
+        flags.set_flags({"verify_passes": True})
+        try:
+            with pytest.raises(PassInvariantError) as ei:
+                apply_pass(p, "_test_broken_pass", fetch_names=[h.name])
+        finally:
+            flags.set_flags({"verify_passes": False})
+        msg = str(ei.value)
+        assert "_test_broken_pass" in msg
+        assert h.name in msg                # names the lost fetch target
+        # without the flag the broken pass sails through (caught later)
+        p2 = Program()
+        with program_guard(p2, Program()):
+            x2 = fluid.layers.data("x", shape=[4])
+            h2 = fluid.layers.fc(x2, 8)
+        apply_pass(p2, "_test_broken_pass", fetch_names=[h2.name])
+    finally:
+        PASSES.pop("_test_broken_pass", None)
+
+
+# ---------------------------------------------------------------------------
+# Executor.prepare wiring: verified once per program version (cached)
+# ---------------------------------------------------------------------------
+
+
+def test_prepare_verifies_once_per_program_version():
+    """A clean model-zoo program pays the verification cost at most once
+    per program version (acceptance criterion)."""
+    from paddle_tpu.models import bert
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        feeds, total, mlm, nsp = bert.build_pretrain_network(
+            bert.BertConfig.tiny())
+        fluid.optimizer.Adam(1e-3).minimize(total)
+    exe = fluid.Executor(fluid.CPUPlace())
+    analysis.clear_verify_cache()
+    # no example feed: compilation is deferred, so prepare-time cost here
+    # IS the verification walk
+    p1 = exe.prepare(main, fetch_list=[total])
+    assert analysis.VERIFY_STATS["runs"] == 1
+    p2 = exe.prepare(main, fetch_list=[total])
+    p3 = exe.prepare(main, fetch_list=[total])
+    # same program version: cache hits, NOT re-verifications
+    assert analysis.VERIFY_STATS["runs"] == 1
+    assert analysis.VERIFY_STATS["hits"] >= 2
+    # mutating the program bumps the version → one more verification
+    main.global_block().create_var(name="poke", shape=(1,))
+    exe.prepare(main, fetch_list=[total])
+    assert analysis.VERIFY_STATS["runs"] == 2
+    p1.close(); p2.close(); p3.close()
+
+
+def test_prepared_run_path_verifies_and_still_trains():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.fc(x, 4)
+        loss = fluid.layers.mean(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    analysis.clear_verify_cache()
+    feed = {"x": np.ones((2, 4), np.float32)}
+    p1 = exe.prepare(main, fetch_list=[loss], feed=feed)
+    out, = p1.run(feed)
+    assert np.isfinite(out.numpy()).all()
+    assert analysis.VERIFY_STATS["runs"] == 1
+    p1.close()
+
+
+def test_prepare_raises_anchored_diagnostic_on_bad_program():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.fc(x, 4)
+    # corrupt: make the fc's mul read an undefined (declared, never
+    # written, non-data) var
+    blk = main.global_block()
+    blk.create_var(name="ghost", shape=(2, 4))
+    mul = next(op for op in blk.ops if op.type == "mul")
+    mul.inputs["X"] = ["ghost"]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(InvalidArgumentError) as ei:
+        exe.prepare(main, fetch_list=[y])
+    assert "use-before-def" in str(ei.value)
+    assert "mul" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# dp8 / ZeRO-1 lowering census under the soundness checks (satellite:
+# regressions of the silent-donation-drop class fail tier-1)
+# ---------------------------------------------------------------------------
+
+
+def _build_dp8_sharded(loss_holder):
+    import jax
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.fleet import (DistributedStrategy,
+                                              UserDefinedRoleMaker,
+                                              distributed_optimizer, fleet)
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu", bias_attr=False)
+        pred = fluid.layers.fc(h, 4, act="softmax", bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fleet.init(UserDefinedRoleMaker(0, 1))
+        strategy = DistributedStrategy()
+        strategy.mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+        strategy.sharded_update = True
+        opt = distributed_optimizer(fluid.optimizer.Adam(5e-3), strategy)
+        opt.minimize(loss)
+    loss_holder.append(loss)
+    return fleet.main_program, startup
+
+
+@pytest.mark.skipif(
+    __import__("jax").device_count() < 8,
+    reason="needs the 8-device virtual CPU mesh")
+def test_dp8_zero1_census_passes_soundness_checks():
+    holder = []
+    prog, startup = _build_dp8_sharded(holder)
+    loss = holder[0]
+    sig = collective_signature(prog)
+    kinds = [s[0] for s in sig]
+    # the ZeRO-1 schedule is present…
+    assert "zero_reduce_scatter" in kinds and "zero_all_gather" in kinds
+    assert "c_allreduce_sum" not in kinds      # no full-grad all-reduce
+    # …and the program is sound under the collective/donation checks
+    r = verify_program(prog, startup=startup, fetch_names=[loss.name])
+    bad = [d for d in r.errors()]
+    assert not bad, [d.format() for d in bad]
+    # two clones of the schedule agree rank-to-rank
+    assert check_collective_consistency([prog, prog.clone()]).ok
+
+    # regression guard for the silent-donation-drop class: fetching the
+    # donated param state must be flagged…
+    pname = prog.all_parameters()[0].name
+    r2 = verify_program(prog, fetch_names=[loss.name, pname])
+    assert r2.by_code(DONATED_VAR_FETCHED)
+    # …and a rank whose bucket order diverges must be flagged
+    broken = prog.clone()
+    blk = broken.global_block()
+    coll = [i for i, op in enumerate(blk.ops)
+            if op.type == "zero_reduce_scatter"]
+    if len(coll) >= 2:
+        i, j = coll[0], coll[1]
+        blk.ops[i], blk.ops[j] = blk.ops[j], blk.ops[i]
+        assert not check_collective_consistency([prog, broken]).ok

@@ -8,6 +8,10 @@ cd "$(dirname "$0")/.."
 echo "== preflight: pytest =="
 python -m pytest tests/ -q -x
 
+echo "== preflight: proglint (static verifier over serialized program +"
+echo "   INFERENCE_PASSES under verify_passes) =="
+python tools/proglint.py --selftest
+
 echo "== preflight: dryrun_multichip(8) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

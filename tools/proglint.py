@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""proglint — lint a serialized Program from the CLI.
+
+The static-verifier front end (framework/analysis.py): structural
+verification, op_spec shape/dtype inference, distributed soundness, and
+the unspecced-op census, over a program loaded from disk — so a saved
+artifact can be checked without tracing or compiling anything.
+
+Usage:
+    python tools/proglint.py PATH [options]
+    python tools/proglint.py --selftest
+
+PATH is one of:
+  * a JSON program desc (the versioned schema framework/serialization.py
+    writes, or an io.save_inference_model payload with "program_desc");
+  * a directory containing an ``__model__`` inference artifact;
+  * a legacy pickle of a live Program.
+
+Options:
+  --fetch NAME       fetch target(s) — enables donation-soundness checks
+  --feed NAME        feed name(s) seeded as defined
+  --startup PATH     startup program to cross-check parameter agreement
+  --strict           exit non-zero on warnings too
+  --selftest         build, serialize, reload and lint a model-zoo
+                     program plus every PassBuilder.INFERENCE_PASSES
+                     output under flag("verify_passes") — the preflight
+                     CI gate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def load_program(path: str):
+    from paddle_tpu.framework.serialization import desc_to_program
+    if os.path.isdir(path):
+        path = os.path.join(path, "__model__")
+    with open(path, "rb") as f:
+        head = f.read(1)
+    if head in (b"{", b"["):
+        with open(path) as f:
+            payload = json.load(f)
+        desc = payload.get("program_desc", payload)
+        return desc_to_program(desc)
+    import pickle
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if isinstance(payload, dict) and "program_desc" in payload:
+        return desc_to_program(payload["program_desc"])
+    if isinstance(payload, dict) and "program" in payload:
+        return payload["program"]
+    return payload
+
+
+def lint(program, startup=None, feed_names=(), fetch_names=(),
+         strict=False, out=sys.stdout):
+    from paddle_tpu.framework.analysis import verify_program
+    result = verify_program(program, startup=startup,
+                            feed_names=feed_names, fetch_names=fetch_names)
+    print(result.report(), file=out)
+    if result.errors():
+        return 1
+    if strict and result.warnings():
+        return 1
+    return 0
+
+
+def selftest() -> int:
+    """Zero-setup lint path for CI: serialize a model-zoo program through
+    the versioned desc schema, reload it, lint it; then run every
+    INFERENCE_PASSES pipeline under pass-invariant checking."""
+    import tempfile
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import flags
+    from paddle_tpu.framework.core import Program, program_guard
+    from paddle_tpu.framework.passes import PassBuilder
+    from paddle_tpu.framework.serialization import program_to_desc
+    from paddle_tpu.models import bert
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        feeds, total, mlm, nsp = bert.build_pretrain_network(
+            bert.BertConfig.tiny())
+        fluid.optimizer.Adam(1e-3).minimize(total)
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "prog.json")
+        with open(path, "w") as f:
+            json.dump({"program_desc": program_to_desc(main)}, f)
+        prog = load_program(path)
+    rc = lint(prog, startup=startup, fetch_names=[total.name])
+    if rc:
+        print("proglint selftest: serialized program FAILED lint")
+        return rc
+
+    # inference pipeline under pass-invariant checking
+    infer = main.clone(for_test=True)
+    flags.set_flags({"verify_passes": True})
+    try:
+        PassBuilder().apply(infer, fetch_names=[mlm.name, nsp.name])
+    finally:
+        flags.set_flags({"verify_passes": False})
+    rc = lint(infer, fetch_names=[mlm.name, nsp.name])
+    if rc:
+        print("proglint selftest: INFERENCE_PASSES output FAILED lint")
+        return rc
+    print("proglint selftest OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="proglint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("path", nargs="?", help="serialized program to lint")
+    ap.add_argument("--fetch", action="append", default=[])
+    ap.add_argument("--feed", action="append", default=[])
+    ap.add_argument("--startup")
+    ap.add_argument("--strict", action="store_true")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if not args.path:
+        ap.error("PATH required (or --selftest)")
+    program = load_program(args.path)
+    startup = load_program(args.startup) if args.startup else None
+    return lint(program, startup=startup, feed_names=args.feed,
+                fetch_names=args.fetch, strict=args.strict)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

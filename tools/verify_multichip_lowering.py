@@ -131,6 +131,15 @@ def main():
     census = collective_census(txt)
     donated, total = donation_ratio(txt)
     counts = {k: v["count"] for k, v in census.items()}
+    # static collective/donation soundness over the SAME program the
+    # census lowers (framework/analysis.py): a silently-dropped donation
+    # or divergent collective schedule fails the artifact, not just the
+    # numbers (regression gate for the PR 2 silent-donation-drop class)
+    from paddle_tpu.framework.analysis import (check_collective_consistency,
+                                               verify_program)
+    vr = verify_program(main_p, startup=startup, fetch_names=[loss.name])
+    check_collective_consistency([main_p, main_p.clone()], vr)
+    soundness_errs = [d.format() for d in vr.errors()]
     lines = [
         "Multi-chip TPU cross-lowering (dp2 x tp2 x sp2 BERT-tiny train step)",
         f"platforms: {tuple(exported.platforms)}",
@@ -139,9 +148,11 @@ def main():
         "census (count / payload bytes): " + ", ".join(
             f"{k}={v['count']}/{v['bytes']}" for k, v in census.items()),
         f"arg donation: {donated}/{total}",
-        f"verdict: {'OK' if counts.get('all_reduce', 0) >= 10 and counts.get('collective_permute', 0) >= 3 else 'MISSING COLLECTIVES'}",
+        f"static soundness: {'OK' if not soundness_errs else 'FAIL'} "
+        f"({len(soundness_errs)} error(s))",
+        f"verdict: {'OK' if counts.get('all_reduce', 0) >= 10 and counts.get('collective_permute', 0) >= 3 and not soundness_errs else 'MISSING COLLECTIVES OR UNSOUND'}",
     ]
-    out = "\n".join(lines)
+    out = "\n".join(lines + soundness_errs)
     print(out)
     if len(sys.argv) > 1:
         with open(sys.argv[1], "w") as f:
@@ -153,7 +164,9 @@ def main():
         with open(census_path, "w") as f:
             json.dump({"module": "dp2xtp2xsp2_bert_tiny_train",
                        "census": census,
-                       "arg_donation": [donated, total]}, f, indent=1)
+                       "arg_donation": [donated, total],
+                       "static_soundness_errors": soundness_errs}, f,
+                      indent=1)
 
 
 if __name__ == "__main__":
