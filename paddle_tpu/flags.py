@@ -51,6 +51,12 @@ _register("use_pallas_fused", True)        # fused LN/bias-gelu/adam kernels
 # buffered_reader keeping the staged GPU copy alive
 # (ref: operators/reader/buffered_reader.cc:92 double-buffer slots)
 _register("cache_feed_arrays", True)
+# capacity of the host→device feed cache above (entries).  The old
+# hardcoded 64 thrashes under a serving stream of distinct frozen request
+# tensors; read live per lookup so a serving process can widen it at
+# runtime.  0 disables caching.  Hit/miss counters surface in
+# profiler.step_breakdown()["feed_cache"].
+_register("feed_cache_size", 64)
 _register("benchmark", False)              # ref: flags.cc benchmark
 # prepared fast path (Executor.prepare): how many steps the host may run
 # ahead of the device before blocking once on the oldest in-flight step —

@@ -58,6 +58,12 @@ DONATED_VAR_FETCHED = "donated-var-fetched"
 READ_AFTER_DONATE = "read-after-donate"
 UNSPECCED_OP = "unspecced-op"
 PASS_INVARIANT = "pass-invariant"
+# inference/serving profile (a SERVED program must be a pure read-only
+# function of its feeds — see verify_inference)
+INFERENCE_COLLECTIVE = "inference-collective"
+INFERENCE_TRAINING_OP = "inference-training-op"
+INFERENCE_STATE_WRITE = "inference-state-write"
+INFERENCE_DONATED_READ = "inference-donated-read"
 
 #: meta-ops interpreted by the executor itself, not the registry
 META_OPS = frozenset({"feed", "fetch", "backward", "pipeline"})
@@ -632,6 +638,74 @@ def verify_program(program: Program, startup: Optional[Program] = None,
     return result
 
 
+def verify_inference(program: Program, feed_names: Iterable[str] = (),
+                     fetch_names: Iterable[str] = (),
+                     scope_names: Iterable[str] = ()) -> VerifyResult:
+    """Inference/serving verification profile: everything
+    :func:`verify_program` checks, plus rejections specific to a SERVED
+    program.  A served program must be a pure read-only function of its
+    feeds — it runs on a single replica (no mesh peers to rendezvous
+    with), under the predictor's read-only-state fast path (weights
+    device-resident, never donated), on arbitrary request streams:
+
+    * **collectives** anywhere in the program deadlock a single serving
+      replica (there is no peer to complete the rendezvous);
+    * **backward/grad ops** mean the training graph leaked through the
+      ``save_inference_model`` prune;
+    * **persistable writes** would mutate (and, under the training fast
+      path, donate) the shared weight buffers request-to-request — a
+      served program must not update state;
+    * **donation annotations** (``_donated_inputs``) consume buffers the
+      next request still needs.
+
+    Wired at :class:`AnalysisPredictor` load under
+    ``flag("verify_programs")`` and exposed as
+    ``tools/proglint.py --inference``."""
+    result = verify_program(program, feed_names=feed_names,
+                            fetch_names=fetch_names,
+                            scope_names=scope_names)
+    collectives = _collective_types()
+
+    def scan(block: Block):
+        for idx, op in enumerate(block.ops):
+            if op.type in collectives:
+                result.add(
+                    "error", INFERENCE_COLLECTIVE,
+                    f"served program contains collective op {op.type!r} — "
+                    f"a single serving replica has no mesh peers and "
+                    f"deadlocks at the rendezvous",
+                    op, block.idx, idx)
+            if op.type == "backward" or op.type.endswith("_grad"):
+                result.add(
+                    "error", INFERENCE_TRAINING_OP,
+                    f"served program contains training op {op.type!r} — "
+                    f"the backward graph leaked through the inference "
+                    f"prune (save_inference_model)",
+                    op, block.idx, idx)
+            if op.attrs.get("_donated_inputs"):
+                result.add(
+                    "error", INFERENCE_DONATED_READ,
+                    f"op {op.type!r} donates inputs "
+                    f"{sorted(op.attrs['_donated_inputs'])} — a served "
+                    f"program must not consume buffers; the next request "
+                    f"reads the same weights",
+                    op, block.idx, idx)
+            for n in op.output_names():
+                v = block._find_var_recursive(n)
+                if v is not None and v.persistable:
+                    result.add(
+                        "error", INFERENCE_STATE_WRITE,
+                        f"served program writes persistable {n!r} (op "
+                        f"{op.type!r}) — inference state is read-only; a "
+                        f"write would mutate weights request-to-request",
+                        op, block.idx, idx)
+            for sub in _iter_sub_blocks(op):
+                scan(sub)
+
+    scan(program.global_block())
+    return result
+
+
 #: verification cache — a program is verified at most once per
 #: (_uid, _version, feeds, fetches); ``stats`` is asserted by tier-1
 _VERIFY_CACHE: Dict[Tuple, VerifyResult] = {}
@@ -753,7 +827,8 @@ def check_pass_invariants(program: Program, pass_name: str,
 
 __all__ = [
     "Diagnostic", "VerifyResult", "PassInvariantError",
-    "verify_program", "verify_cached", "clear_verify_cache",
+    "verify_program", "verify_inference", "verify_cached",
+    "clear_verify_cache",
     "verify_structure", "verify_startup_agreement", "infer_shapes",
     "verify_distributed", "collective_signature",
     "check_collective_consistency", "pass_snapshot",

@@ -363,12 +363,24 @@ class _FeedDeviceCache:
     mutated in place, which makes identity (object id + data pointer +
     shape + dtype) a sound key.  Entries hold a weakref to the source so
     a GC'd array (whose data pointer may be reused) drops its entry.
+
+    Capacity comes from ``flag("feed_cache_size")`` (read live, so a
+    serving process can widen it at runtime for a stream of distinct
+    request tensors that would thrash the old hardcoded 64); hit/miss
+    counters are published through the monitor registry and surfaced by
+    ``profiler.step_breakdown()``.
     """
 
-    def __init__(self, device, maxsize=64):
+    def __init__(self, device, maxsize=None):
         self._device = device
-        self._maxsize = maxsize
+        self._maxsize = maxsize      # explicit override (tests); else flag
         self._entries: Dict[Any, Any] = {}   # key -> (weakref, device_array)
+
+    def capacity(self) -> int:
+        if self._maxsize is not None:
+            return self._maxsize
+        from ..flags import flag
+        return int(flag("feed_cache_size"))
 
     def lookup(self, arr):
         """Return a device-resident copy of ``arr``, or None if uncacheable."""
@@ -379,16 +391,22 @@ class _FeedDeviceCache:
             # backing buffer can still change under the same pointer —
             # only an owning array somebody froze is a deliberate promise
             return None
+        from ..monitor import stat
         key = (id(arr), arr.__array_interface__["data"][0], arr.shape,
                str(arr.dtype))
         hit = self._entries.get(key)
         if hit is not None:
             ref, buf = hit
             if ref() is arr:
+                stat("feed_cache_hit").add()
                 return buf
             del self._entries[key]
+        stat("feed_cache_miss").add()
+        cap = self.capacity()
+        if cap <= 0:
+            return None
         buf = jax.device_put(arr, self._device)
-        if len(self._entries) >= self._maxsize:
+        while len(self._entries) >= cap:
             self._entries.pop(next(iter(self._entries)))
         self._entries[key] = (weakref.ref(arr), buf)
         return buf
@@ -575,13 +593,27 @@ class PreparedStep:
     user ``set_var``) bump the scope's version counter and make the next
     ``run`` re-pull state.  Two PreparedSteps updating the same state on
     one scope must interleave through ``sync_scope()`` — donation consumes
-    the other's buffers otherwise."""
+    the other's buffers otherwise.
+
+    ``donate_state=False`` selects the READ-ONLY-STATE mode built for
+    serving (AnalysisPredictor / ServingEngine): state buffers are passed
+    to the compiled step WITHOUT donation and pass-through state is
+    dropped from the step outputs entirely, so inference weights stay
+    device-resident across requests, are never consumed, and never
+    round-trip through a device copy per request.  The scope stays the
+    owner of the buffers, so plain ``Executor.run`` / ``io.save_*``
+    interleavings need no staleness flush, and many PreparedSteps (one
+    per shape bucket) can share one scope safely.  Only persistables the
+    program genuinely WRITES (none, in a well-formed served program —
+    the inference verifier rejects them) still flow out and mark the
+    step dirty."""
 
     def __init__(self, executor, program, feed_names, fetch_list, scope,
-                 feed=None):
+                 feed=None, donate_state=True):
         from .compiler import CompiledProgram
         self._exe = executor
         self._scope = scope
+        self._donate_state = donate_state
         self._mesh = None
         self._axis_names = ()
         self._batch_axis = None
@@ -659,7 +691,8 @@ class PreparedStep:
                 step = self._exe._compile(
                     self._program, feed, self._fetch_names, self._scope,
                     self._mesh, self._axis_names, self._batch_axis,
-                    self._seq_axis, self._feed_specs)
+                    self._seq_axis, self._feed_specs,
+                    donate_state=self._donate_state)
             self._steps[sig] = step
         self._cur, self._cur_sig = step, sig
         self._cur_exact = set(step.state_in_names) == \
@@ -781,9 +814,15 @@ class PreparedStep:
                                                   rng_key)
         self.stats["dispatch_ns"] += time.perf_counter_ns() - t0
         self.stats["steps"] += 1
-        self._state = state_out
+        if self._donate_state:
+            self._state = state_out
+            self._dirty = True
+        elif state_out:
+            # read-only-state mode only round-trips persistables the
+            # program actually writes; pass-through weights stay put
+            self._state.update(state_out)
+            self._dirty = True
         self._key = new_key
-        self._dirty = True
         if window and window > 0:
             self._inflight.append(new_key)
             if len(self._inflight) > self.stats["max_inflight"]:
@@ -1017,17 +1056,23 @@ class Executor:
         return list(fetches)
 
     def prepare(self, program: Optional[Program] = None, feed_names=None,
-                fetch_list=None, scope: Optional[Scope] = None, feed=None):
+                fetch_list=None, scope: Optional[Scope] = None, feed=None,
+                donate_state: bool = True):
         """Resolve ``program`` + ``fetch_list`` into a :class:`PreparedStep`
         whose ``run(feed)`` is the steady-state fast path (ref:
         Executor._prepare/ExecutorPrepareContext, executor.py:551, and the
         ParallelExecutor build-once/run-many contract).  Pass an example
         ``feed`` (shapes matter, values don't) to compile eagerly;
-        otherwise compilation happens on the first ``run``."""
+        otherwise compilation happens on the first ``run``.
+
+        ``donate_state=False`` is the inference/serving mode: state is
+        read-only for the compiled step (no buffer donation, no per-step
+        state round-trip), so weights stay device-resident across
+        requests and the scope remains the buffer owner."""
         program = program or default_main_program()
         scope = scope or global_scope()
         return PreparedStep(self, program, feed_names, fetch_list or [],
-                            scope, feed=feed)
+                            scope, feed=feed, donate_state=donate_state)
 
     def _evict_program(self, uid):
         """Drop compiled steps belonging to an evicted pass-variant clone."""
@@ -1206,12 +1251,14 @@ class Executor:
                             for k, v in feed.items()))
 
     def _compile(self, program, feed, fetch_names, scope, mesh, axis_names,
-                 batch_axis, seq_axis=None, feed_specs=None):
+                 batch_axis, seq_axis=None, feed_specs=None,
+                 donate_state=True):
         from ..flags import flag
         # flags consulted at trace time are part of the executable identity
         key = (program._uid, program._version, self._feed_signature(feed),
                tuple(fetch_names), _mesh_identity(mesh),
-               flag("use_flash_attention"), flag("use_pallas_fused"))
+               flag("use_flash_attention"), flag("use_pallas_fused"),
+               donate_state)
         if key in self._cache:
             if flag("print_executor_cache_hits"):
                 print(f"executor cache hit: program v{program._version}")
@@ -1243,16 +1290,26 @@ class Executor:
                     n not in state_in_names:
                 state_in_names.append(n)
 
-        # every state input must come back out (read-only vars pass through
-        # unchanged) — their buffers are donated, so the scope must be handed
-        # fresh (aliased) arrays or it would retain deleted buffers
-        state_out_names = list(state_in_names)
+        written_state: List[str] = []
         for op in ops:
             for n in op.output_names():
                 var = block._find_var_recursive(n)
                 if var is not None and var.persistable and \
-                        n not in state_out_names:
-                    state_out_names.append(n)
+                        n not in written_state:
+                    written_state.append(n)
+        if donate_state:
+            # every state input must come back out (read-only vars pass
+            # through unchanged) — their buffers are donated, so the scope
+            # must be handed fresh (aliased) arrays or it would retain
+            # deleted buffers
+            state_out_names = list(state_in_names)
+            state_out_names += [n for n in written_state
+                                if n not in state_out_names]
+        else:
+            # read-only-state mode: pass-through state is dropped from the
+            # outputs entirely — no donation means returning it would force
+            # a full device copy of the weights per request
+            state_out_names = written_state
 
         bw_idx = next((i for i, op in enumerate(ops)
                        if op.type == "backward"), None)
@@ -1316,9 +1373,11 @@ class Executor:
             if mesh is not None:
                 fn, feed_spec_fn, state_in_specs = self._wrap_sharded(
                     step, mesh, axis_names, batch_axis, program, feed_names,
-                    state_in_names, state_out_names, feed_specs or {})
+                    state_in_names, state_out_names, feed_specs or {},
+                    donate_state=donate_state)
             else:
-                fn = jax.jit(step, donate_argnums=(1,))
+                fn = jax.jit(step, donate_argnums=(1,)) if donate_state \
+                    else jax.jit(step)
 
         compiled = _CompiledStep(fn, state_in_names, state_out_names,
                                  feed_names, fetch_names, raw_fn=step,
@@ -1329,7 +1388,7 @@ class Executor:
 
     def _wrap_sharded(self, step, mesh, axis_names, batch_axis, program,
                       feed_names, state_in_names, state_out_names,
-                      feed_specs):
+                      feed_specs, donate_state=True):
         """Run the step under shard_map over the FULL named mesh: feeds
         sharded on their batch (dp) / sequence (sp) dims, params per their
         ``dist_attr`` PartitionSpec (tensor-parallel shards), everything
@@ -1394,8 +1453,9 @@ class Executor:
         out_sh = (ns(P()),
                   {n: ns(state_out_specs[n]) for n in state_out_names},
                   ns(P()))
-        fn = jax.jit(sharded, donate_argnums=(1,), in_shardings=in_sh,
-                     out_shardings=out_sh)
+        fn = jax.jit(sharded,
+                     donate_argnums=(1,) if donate_state else (),
+                     in_shardings=in_sh, out_shardings=out_sh)
         return fn, feed_spec, state_in_specs
 
     def close(self):

@@ -90,7 +90,13 @@ class _ZeroCopyTensor:
 
 class AnalysisPredictor:
     """ref: inference/api/analysis_predictor.cc — load → analyze (passes)
-    → per-request ZeroCopyRun over a private scope."""
+    → per-request ZeroCopyRun over a private scope.
+
+    ``prepare()`` additionally binds the predictor onto the
+    PreparedStep fast path in READ-ONLY-STATE mode (no buffer donation,
+    no per-request state round-trip), so weights stay device-resident
+    across requests — the steady-state serving path the ServingEngine
+    (paddle_tpu.serving) drives."""
 
     def __init__(self, config: AnalysisConfig):
         from .. import io
@@ -115,6 +121,20 @@ class AnalysisPredictor:
         self._feed_names = list(feed_names)
         self._fetch_vars = [program.global_block().var(n)
                             for n in self._fetch_names]
+        from ..flags import flag
+        if flag("verify_programs"):
+            # static verification in the INFERENCE profile: beyond the
+            # standard structural/shape checks, a served program must be
+            # a pure read-only function of its feeds (no collectives, no
+            # training ops, no persistable writes, no donation) — errors
+            # here mean the artifact is not servable, caught at load
+            # instead of at the first bad request
+            from ..framework.analysis import verify_inference
+            verify_inference(
+                program, feed_names=self._feed_names,
+                fetch_names=self._fetch_names,
+                scope_names=self._scope.var_names()).raise_on_error()
+        self._prepared = None
 
     # -- zero-copy API ----------------------------------------------------
     def get_input_names(self) -> List[str]:
@@ -139,10 +159,55 @@ class AnalysisPredictor:
         for n, v in zip(self._fetch_names, outs):
             self._scope.set_var(n, v)
 
+    # -- prepared fast path (serving) -------------------------------------
+    def prepare(self, example_feed: Optional[Dict[str, np.ndarray]] = None):
+        """Bind onto the Executor.prepare read-only-state fast path: feed
+        translation, pass variants and compile keys resolve once; weights
+        stay device-resident and UN-DONATED across requests (the serving
+        analog of PR 2's training PreparedStep).  Idempotent.  Pass an
+        ``example_feed`` to compile that shape eagerly."""
+        if self._prepared is None:
+            self._prepared = self._exe.prepare(
+                self._program, feed_names=self._feed_names,
+                fetch_list=self._fetch_vars, scope=self._scope,
+                feed=example_feed, donate_state=False)
+        return self._prepared
+
+    @property
+    def compiled_executables(self) -> int:
+        """How many distinct executables (one per feed-shape signature)
+        this predictor's prepared fast path holds — the serving
+        compile-count the bucket bound is asserted against."""
+        return len(self._prepared._steps) if self._prepared is not None \
+            else 0
+
     # -- batch API (ref: PaddlePredictor::Run) ----------------------------
     def run(self, inputs: Sequence[np.ndarray]) -> List[np.ndarray]:
-        feed = {n: a for n, a in zip(self._feed_names, inputs)}
-        outs = self._exe.run(self._program, feed=feed,
+        from ..framework.errors import InvalidArgumentError
+        if len(inputs) != len(self._feed_names):
+            raise InvalidArgumentError(
+                f"AnalysisPredictor.run got {len(inputs)} input(s) but "
+                f"the model declares {len(self._feed_names)} feed(s) "
+                f"{self._feed_names} — extra/missing inputs would be "
+                f"silently dropped (ref: PaddlePredictor::Run arity "
+                f"contract)")
+        return self.run_feed({n: a for n, a in
+                              zip(self._feed_names, inputs)})
+
+    def run_feed(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        """Dict-keyed run with strict feed-name validation; uses the
+        prepared fast path once :meth:`prepare` has been called."""
+        from ..framework.errors import InvalidArgumentError
+        missing = [n for n in self._feed_names if n not in feed]
+        extra = [n for n in feed if n not in self._feed_names]
+        if missing or extra:
+            raise InvalidArgumentError(
+                f"predictor feed mismatch: missing {missing}, "
+                f"unexpected {extra}; the model declares "
+                f"{self._feed_names}")
+        if self._prepared is not None:
+            return list(self._prepared.run(feed, return_numpy=True))
+        outs = self._exe.run(self._program, feed=dict(feed),
                              fetch_list=self._fetch_vars,
                              scope=self._scope)
         return [np.asarray(o) for o in outs]

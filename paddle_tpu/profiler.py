@@ -168,23 +168,71 @@ def get_events():
 PREPARED_PHASES = ("prepared::feed_wait", "prepared::dispatch",
                    "prepared::fetch_sync", "prepared::scope_sync")
 
+# the host-side phases of one serving micro-batch (ServingEngine's worker
+# emits these): waiting for the batch window to close, padding/assembly
+# into the bucket shape, the predictor run, and splitting fetches back
+# per request
+SERVING_PHASES = ("serving::wait", "serving::pad", "serving::run",
+                  "serving::split")
+
 
 def step_breakdown(events=None):
-    """Aggregate the prepared fast path's per-step markers into
-    ``{phase: {"calls", "total_ms", "avg_us"}}`` — the host-side story of
-    a training step (where did the step's host time go: feed-wait /
-    dispatch / fetch-sync / scope-sync), complementing the event table
-    with a per-phase view the reference exposes through its
-    DeviceTracer sections."""
+    """Aggregate the prepared fast path's and the serving engine's
+    per-step markers into ``{phase: {"calls", "total_ms", "avg_us"}}`` —
+    the host-side story of a training step / serving micro-batch (where
+    did the host time go: feed-wait / dispatch / fetch-sync / scope-sync,
+    batch-wait / pad / run / split), complementing the event table with a
+    per-phase view the reference exposes through its DeviceTracer
+    sections.  The extra ``"feed_cache"`` entry carries the
+    _FeedDeviceCache hit/miss counters and its live
+    ``flag("feed_cache_size")`` capacity."""
     if events is None:
         with _lock:
             events = list(_events)
+    phases = PREPARED_PHASES + SERVING_PHASES
     out = {}
     for name, start, end, _ in events:
-        if name in PREPARED_PHASES:
+        if name in phases:
             rec = out.setdefault(name, {"calls": 0, "total_ms": 0.0})
             rec["calls"] += 1
             rec["total_ms"] += (end - start) / 1e6
     for rec in out.values():
         rec["avg_us"] = rec["total_ms"] * 1e3 / rec["calls"]
+    from .monitor import stat
+    from .flags import flag
+    out["feed_cache"] = {"hits": stat("feed_cache_hit").get(),
+                         "misses": stat("feed_cache_miss").get(),
+                         "capacity": int(flag("feed_cache_size"))}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# serving-engine stats (ServingEngine registers itself here)
+# ---------------------------------------------------------------------------
+
+import weakref as _weakref
+
+_serving_engines: List = []   # weakrefs to live ServingEngines
+
+
+def register_serving_engine(engine):
+    """Expose a ServingEngine's stats through :func:`serving_stats` —
+    called by the engine constructor."""
+    _serving_engines.append(_weakref.ref(engine))
+
+
+def serving_stats():
+    """Snapshot of every live serving engine's counters (QPS, p50/p99
+    latency, padding-waste ratio, compile count, batch-size histogram) —
+    the profiler-side view of the serving tier."""
+    out = []
+    dead = []
+    for ref in _serving_engines:
+        engine = ref()
+        if engine is None:
+            dead.append(ref)
+            continue
+        out.append(engine.stats())
+    for ref in dead:
+        _serving_engines.remove(ref)
     return out

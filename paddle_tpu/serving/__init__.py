@@ -1,0 +1,19 @@
+"""Serving tier: dynamic micro-batching + shape-bucketed compilation over
+the inference predictor (see engine.py for the design notes).
+
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    pred = create_paddle_predictor(AnalysisConfig(model_dir))
+    engine = ServingEngine(pred, ServingConfig(
+        max_batch_size=8, seq_buckets=(32, 64),
+        seq_feeds=("src_ids", "pos_ids", "sent_ids", "input_mask")))
+    engine.warmup(example_feed)          # AOT-compile the buckets
+    fut = engine.submit(feed)            # -> Future of [np.ndarray, ...]
+    outputs = fut.result()
+    engine.shutdown()
+"""
+
+from .engine import ServingConfig, ServingEngine, pad_request
+
+__all__ = ["ServingConfig", "ServingEngine", "pad_request"]

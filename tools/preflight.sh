@@ -12,6 +12,10 @@ echo "== preflight: proglint (static verifier over serialized program +"
 echo "   INFERENCE_PASSES under verify_passes) =="
 python tools/proglint.py --selftest
 
+echo "== preflight: serve_bench (serving engine parity + bucket compile"
+echo "   bounds on a mixed-shape stream) =="
+python tools/serve_bench.py --selftest
+
 echo "== preflight: dryrun_multichip(8) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

@@ -20,6 +20,10 @@ Options:
   --fetch NAME       fetch target(s) — enables donation-soundness checks
   --feed NAME        feed name(s) seeded as defined
   --startup PATH     startup program to cross-check parameter agreement
+  --inference        lint in the SERVING profile: additionally reject
+                     collectives, backward/grad ops, persistable writes,
+                     and donation annotations (a served program must be a
+                     pure read-only function of its feeds)
   --strict           exit non-zero on warnings too
   --selftest         build, serialize, reload and lint a model-zoo
                      program plus every PassBuilder.INFERENCE_PASSES
@@ -60,10 +64,20 @@ def load_program(path: str):
 
 
 def lint(program, startup=None, feed_names=(), fetch_names=(),
-         strict=False, out=sys.stdout):
-    from paddle_tpu.framework.analysis import verify_program
-    result = verify_program(program, startup=startup,
-                            feed_names=feed_names, fetch_names=fetch_names)
+         strict=False, inference=False, out=sys.stdout):
+    from paddle_tpu.framework.analysis import (verify_inference,
+                                               verify_program)
+    if inference:
+        result = verify_inference(program, feed_names=feed_names,
+                                  fetch_names=fetch_names)
+        if startup is not None:
+            from paddle_tpu.framework.analysis import \
+                verify_startup_agreement
+            verify_startup_agreement(program, startup, result)
+    else:
+        result = verify_program(program, startup=startup,
+                                feed_names=feed_names,
+                                fetch_names=fetch_names)
     print(result.report(), file=out)
     if result.errors():
         return 1
@@ -112,6 +126,22 @@ def selftest() -> int:
     if rc:
         print("proglint selftest: INFERENCE_PASSES output FAILED lint")
         return rc
+
+    # the SERVING profile must accept the pruned inference program and
+    # reject the training program (backward + optimizer state writes)
+    served = main.clone(for_test=True)._prune([mlm, nsp])
+    rc = lint(served, fetch_names=[mlm.name, nsp.name], inference=True)
+    if rc:
+        print("proglint selftest: inference profile FAILED on the "
+              "pruned program")
+        return rc
+    import io as _io
+    sink = _io.StringIO()
+    if lint(prog, fetch_names=[total.name], inference=True,
+            out=sink) == 0:
+        print("proglint selftest: inference profile ACCEPTED a training "
+              "program")
+        return 1
     print("proglint selftest OK")
     return 0
 
@@ -124,6 +154,7 @@ def main(argv=None) -> int:
     ap.add_argument("--fetch", action="append", default=[])
     ap.add_argument("--feed", action="append", default=[])
     ap.add_argument("--startup")
+    ap.add_argument("--inference", action="store_true")
     ap.add_argument("--strict", action="store_true")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args(argv)
@@ -135,7 +166,8 @@ def main(argv=None) -> int:
     program = load_program(args.path)
     startup = load_program(args.startup) if args.startup else None
     return lint(program, startup=startup, feed_names=args.feed,
-                fetch_names=args.fetch, strict=args.strict)
+                fetch_names=args.fetch, strict=args.strict,
+                inference=args.inference)
 
 
 if __name__ == "__main__":
