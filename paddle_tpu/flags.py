@@ -76,6 +76,27 @@ _register("verify_programs", True)
 # diff) — catches a fusion pass that breaks well-formedness at the pass
 # boundary instead of at compile.  Off by default (lint/CI turns it on).
 _register("verify_passes", False)
+# static per-device HBM budget in GiB (framework/memory_analysis.py):
+# Executor.prepare / Executor._compile / CompiledProgram._variant_for
+# estimate the program's per-device peak HBM (sharding- and
+# donation-aware, from op_spec shape/dtype inference) and raise
+# InvalidArgumentError BEFORE any XLA trace/compile when the estimate
+# exceeds the budget — the failure names the top live tensors and their
+# creation sites instead of an opaque HLO buffer after a multi-minute
+# compile.  0 (default) disables the gate.
+#
+# Mapping from the reference's runtime allocator flags (both accepted
+# below as no-ops, since XLA owns the allocator here):
+#   * fraction_of_gpu_memory_to_use=0.92 capped the arena the allocator
+#     could grow into → here the analog is a STATIC pre-compile gate:
+#     set hbm_budget_gb to (fraction × device HBM), e.g. 0.92 × 16 for
+#     a v5e chip, and over-budget programs are rejected up front;
+#   * eager_delete_tensor_gb tuned WHEN dead tensors were garbage-
+#     collected at runtime → liveness is static now (XLA frees at
+#     last-use by construction); the analyzer's lint profile
+#     (donation-gap / fetch-retention / grad-accum-doubling) reports
+#     the retention bugs that flag used to paper over.
+_register("hbm_budget_gb", 0.0)
 # accepted no-ops: XLA owns these concerns (ref: flags.cc lines noted)
 _register("fraction_of_gpu_memory_to_use", 0.92, noop=True)   # :343
 _register("eager_delete_tensor_gb", 0.0, noop=True)           # :257

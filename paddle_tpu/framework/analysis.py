@@ -380,16 +380,22 @@ def _shapes_conflict(declared, inferred) -> bool:
 
 
 def infer_shapes(program: Program, result: VerifyResult,
-                 feed_names: Iterable[str] = ()):
+                 feed_names: Iterable[str] = (),
+                 init_env: Optional[Dict[str, Any]] = None):
     """Propagate static (shape, dtype) signatures through the global
     block's op list via the ``op_spec`` infer channel, reporting
     mismatches against declared variable metadata.  Ops without a spec
     pass their declared output metadata through and are counted in the
-    unspecced census (the warn-don't-fail long-tail path)."""
+    unspecced census (the warn-don't-fail long-tail path).
+
+    ``init_env`` seeds the propagation environment with concrete
+    signatures (name → VarSig) — the memory analyzer binds the actual
+    feed shapes here so batch/seq dims declared ``-1`` resolve to real
+    extents instead of staying unknown."""
     from ..ops.registry import OP_SPECS, SpecMismatch, VarSig
 
     block = program.global_block()
-    env: Dict[str, Any] = {}
+    env: Dict[str, Any] = dict(init_env or {})
 
     def sig_of(name: str):
         if name in env:

@@ -304,6 +304,16 @@ class CompiledProgram:
                           raise_on_error=True)
             check_collective_consistency(
                 [self._program, clone]).raise_on_error()
+        if flag("hbm_budget_gb"):
+            # static budget gate on the pass-rewritten variant before it
+            # reaches the executor (declared-shape lower bound — exact
+            # feed shapes re-gate at Executor._compile)
+            from .memory_analysis import check_hbm_budget, mesh_axes_of
+            check_hbm_budget(clone, fetch_names=list(fetch_names),
+                             mesh_axes=mesh_axes_of(self._mesh),
+                             batch_axis=self._batch_axis,
+                             seq_axis=self._seq_axis,
+                             feed_specs=self._feed_specs)
         evicted_uid = None
         if len(variants) >= self._VARIANT_CAP:
             _, stale = variants.popitem(last=False)

@@ -648,6 +648,19 @@ class PreparedStep:
                           fetch_names=self._fetch_names,
                           scope_names=scope.var_names(),
                           raise_on_error=True)
+        if flag("hbm_budget_gb"):
+            # budget gate at prepare time, before any compile is even
+            # scheduled: exact when an example feed is given, a declared-
+            # shape lower bound otherwise (the first run's _bind re-gates
+            # with exact shapes through Executor._compile)
+            from .memory_analysis import check_hbm_budget, mesh_axes_of
+            check_hbm_budget(self._program, feed_shapes=feed,
+                             fetch_names=self._fetch_names,
+                             mesh_axes=mesh_axes_of(self._mesh),
+                             batch_axis=self._batch_axis,
+                             seq_axis=self._seq_axis,
+                             feed_specs=self._feed_specs,
+                             donate_state=donate_state)
         self._readers = tuple(getattr(program, "_py_readers", ()))
         # one _CompiledStep per feed signature (bucketed data keeps several
         # live); state is shared across them — same program, same vars
@@ -1263,6 +1276,18 @@ class Executor:
             if flag("print_executor_cache_hits"):
                 print(f"executor cache hit: program v{program._version}")
             return self._cache[key]
+        if flag("hbm_budget_gb"):
+            # static pre-compile budget gate (memory_analysis.py): an
+            # over-budget program is rejected HERE, with the top live
+            # tensors and their creation sites, before any trace/compile
+            # cost — feed shapes are exact at this point
+            from .memory_analysis import check_hbm_budget, mesh_axes_of
+            check_hbm_budget(program, feed_shapes=feed,
+                             fetch_names=fetch_names,
+                             mesh_axes=mesh_axes_of(mesh),
+                             batch_axis=batch_axis, seq_axis=seq_axis,
+                             feed_specs=feed_specs,
+                             donate_state=donate_state)
         from ..monitor import stat
         stat("executor_compile_count").add()
 

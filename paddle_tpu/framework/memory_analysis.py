@@ -1,0 +1,834 @@
+"""Static liveness & peak-HBM analyzer over a verified Program.
+
+The north-star workload (BERT-base pretrain on a v5e-32) is HBM-bound
+long before it is FLOP-bound, yet an over-budget program previously
+failed DEEP inside XLA — after a multi-minute trace+compile — with an
+allocator error naming an HLO buffer, not a Program variable.  And the
+PR 2 donation bug class (state buffers silently not aliased) showed up
+only as 2× live-set growth at runtime.  This module turns PR 3's
+op_spec shape/dtype inference into the missing memory model, entirely
+statically (no trace, no device):
+
+* **liveness** — per-block def/last-use intervals over the op list,
+  recursing into Block-valued control-flow attrs (a read inside a while
+  body is a use at the while op's index in the parent block);
+  feed/fetch/persistable roots are pinned across the whole step;
+* **per-device peak-HBM estimate** — every variable priced at its
+  canonical on-device width (int64 → int32 under disabled x64, bf16/amp
+  at 2 bytes — the op_spec dtype inference supplies true widths) and
+  divided by its mesh sharding: persistables by their ``dist_attr``
+  axes (ZeRO-1 flat state shards, tp-split weights), feeds/activations
+  by the batch/sequence axes; donated state is counted ONCE (the arg
+  aliases its output), non-donated written persistables twice;
+* **lint profile** — donation gaps (a trainable persistable that
+  receives a gradient but is never updated in place), fetch-induced
+  retention (fetching an early activation pins it across the peak),
+  and gradient-accumulation doubling (param-shaped persistable grad
+  accumulators), each anchored to the op's recorded creation site.
+
+The transient (XLA "temp") model is deliberately simple and validated
+against ground truth rather than derived from a scheduler simulation
+(``tools/mem_probe.py`` compares it to
+``jit(...).lower().compile().memory_analysis()`` per leg, artifact
+``MEM_ESTIMATE_r09.json`` asserted within ±15 % in tier-1):
+
+    transient = RESIDUAL_FACTOR × Σ residual classes
+              + Σ op-internal backward extras      (op_spec mem channel)
+              + grads                              (collective programs)
+
+where a *residual class* is an alias set of forward intermediates
+collapsed across fusible ops (views, elementwise chains, activations —
+XLA assigns them one buffer), ``RESIDUAL_FACTOR = 1.5`` prices the
+forward value plus the ~half of its cotangents in flight during the
+reverse sweep, op-internal extras come from the op_spec byte-accounting
+channel (attention probability matrices, softmax-CE logit copies — the
+values an op impl materialises that never appear as named Program
+vars), and the grad term is included only when grad-sync collectives
+force the gradient set to materialise (single-program fused updates
+reuse donated state buffers instead — measured, not assumed).
+
+Wired three ways: ``tools/proglint.py --memory`` prints the report;
+``flag("hbm_budget_gb")`` makes ``Executor.prepare`` /
+``CompiledProgram._variant_for`` / ``Executor._compile`` raise
+``InvalidArgumentError`` BEFORE any XLA compile when the estimate
+exceeds budget; ``tools/mem_probe.py`` validates the estimator.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from .core import Block, Program
+from .errors import InvalidArgumentError
+from .analysis import (VerifyResult, _iter_sub_blocks, infer_shapes,
+                       op_reads_recursive)
+
+# lint codes (joins the analysis.py taxonomy; warning severity — memory
+# lints are retention smells, not well-formedness errors)
+DONATION_GAP = "donation-gap"
+FETCH_RETENTION = "fetch-retention"
+GRAD_ACCUM_DOUBLING = "grad-accum-doubling"
+
+#: forward residual + in-flight cotangents during the reverse sweep,
+#: per residual class (calibrated against XLA buffer assignment across
+#: the transformer-bench ladder; see module docstring and mem_probe)
+RESIDUAL_FACTOR = 1.5
+
+_GIB = float(1 << 30)
+
+
+# ---------------------------------------------------------------------------
+# byte pricing
+# ---------------------------------------------------------------------------
+
+
+def sig_bytes(sig, unknown_dim: int = 1) -> int:
+    """On-device bytes of one VarSig: canonical dtype width (int64→int32
+    when x64 is off — feeds are canonicalised at device_put), unknown
+    dims priced at ``unknown_dim``."""
+    if sig is None or sig.shape is None:
+        return 0
+    from ..ops.registry import dtype_nbytes
+    n = 1
+    for d in sig.shape:
+        d = int(d)
+        n *= d if d > 0 else unknown_dim
+    return n * dtype_nbytes(sig.dtype)
+
+
+def _axis_divisor(axes, mesh_axes: Dict[str, int]) -> int:
+    div = 1
+    for a in axes or ():
+        if a:
+            div *= int(mesh_axes.get(a, 1))
+    return div
+
+
+def _var_sig(v):
+    """Declared VarSig of a Variable (None-safe)."""
+    if v is None:
+        return None
+    from ..ops.registry import VarSig
+    return VarSig(tuple(v.shape) or None, v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# 1. liveness (def / last-use intervals, sub-blocks recursed)
+# ---------------------------------------------------------------------------
+
+
+class Interval:
+    """Liveness interval of one name inside one block: ``def_idx`` is the
+    first producing op (None for roots that pre-exist the block — feeds,
+    persistables, closure vars), ``last_use`` the last op reading it
+    (uses inside a control-flow sub-block count at the PARENT op's
+    index).  ``pinned`` roots (feeds / fetches / persistables) live
+    across the whole block regardless of their last textual use."""
+
+    __slots__ = ("name", "def_idx", "last_use", "pinned", "def_op")
+
+    def __init__(self, name, def_idx=None, last_use=-1, pinned=False,
+                 def_op=None):
+        self.name = name
+        self.def_idx = def_idx
+        self.last_use = last_use
+        self.pinned = pinned
+        self.def_op = def_op           # Operator, for creation-site anchors
+
+    def live_at(self, idx: int, end: int) -> bool:
+        if self.pinned:
+            return True
+        lo = self.def_idx if self.def_idx is not None else 0
+        return lo <= idx <= (end if self.last_use < 0 else self.last_use)
+
+    def __repr__(self):
+        return (f"Interval({self.name!r}, def={self.def_idx}, "
+                f"last_use={self.last_use}, pinned={self.pinned})")
+
+
+def block_liveness(block: Block, feed_names: Iterable[str] = (),
+                   fetch_names: Iterable[str] = (),
+                   pinned_extra: Iterable[str] = ()
+                   ) -> Dict[str, Interval]:
+    """Def/last-use intervals for every name touched in ``block``.
+
+    A control-flow op (while_loop / conditional_block / ...) reads, at
+    its own index, every name its sub-blocks read recursively (the
+    closure contract ``Program._prune`` follows), so an outer var
+    consumed only inside a loop body stays live through the loop op.
+    Feed / fetch / persistable roots are pinned."""
+    fetch = set(fetch_names)
+    pinned = set(feed_names) | set(pinned_extra)
+    out: Dict[str, Interval] = {}
+    for idx, op in enumerate(block.ops):
+        if op.type in ("feed", "fetch"):
+            continue
+        reads = set(op.input_names())
+        for sub in _iter_sub_blocks(op):
+            for sub_op in sub.ops:
+                reads |= op_reads_recursive(sub_op)
+        for n in reads:
+            iv = out.get(n)
+            if iv is None:
+                iv = out[n] = Interval(n)
+            iv.last_use = max(iv.last_use, idx)
+        for n in op.output_names():
+            iv = out.get(n)
+            if iv is None:
+                iv = out[n] = Interval(n)
+            if iv.def_idx is None:
+                iv.def_idx = idx
+                iv.def_op = op
+    for n, iv in out.items():
+        v = block._find_var_recursive(n)
+        if n in pinned or n in fetch or (
+                v is not None and (v.persistable or v.is_data)):
+            iv.pinned = True
+    return out
+
+
+def program_liveness(program: Program, feed_names: Iterable[str] = (),
+                     fetch_names: Iterable[str] = ()
+                     ) -> Dict[int, Dict[str, Interval]]:
+    """Liveness per block index, sub-blocks included (each sub-block gets
+    its OWN interval table; its closure reads also appear as uses in the
+    parent table at the owning op's index)."""
+    tables: Dict[int, Dict[str, Interval]] = {}
+
+    def walk(block, feeds, fetches):
+        tables[block.idx] = block_liveness(block, feeds, fetches)
+        for op in block.ops:
+            for sub in _iter_sub_blocks(op):
+                if sub.idx not in tables:
+                    walk(sub, (), ())
+    walk(program.global_block(), feed_names, fetch_names)
+    return tables
+
+
+# ---------------------------------------------------------------------------
+# 2. per-device peak-HBM estimate
+# ---------------------------------------------------------------------------
+
+
+class LiveTensor:
+    """One entry of the top-k live set at the peak point."""
+
+    __slots__ = ("name", "nbytes", "kind", "op_type", "callstack")
+
+    def __init__(self, name, nbytes, kind, op_type=None, callstack=()):
+        self.name = name
+        self.nbytes = int(nbytes)
+        self.kind = kind               # param|opt-state|feed|activation|...
+        self.op_type = op_type
+        self.callstack = list(callstack or ())
+
+    def format(self) -> str:
+        loc = f" (op {self.op_type!r})" if self.op_type else ""
+        line = f"{self.nbytes / (1 << 20):9.3f} MiB  {self.kind:<10s} " \
+               f"{self.name}{loc}"
+        if self.callstack:
+            line += "\n" + "\n".join(f"        {f}"
+                                     for f in self.callstack[-2:])
+        return line
+
+
+class MemoryEstimate:
+    """Per-device peak-HBM estimate + its components.
+
+    ``peak_bytes = args_bytes + transient_bytes`` corresponds to XLA's
+    ``argument_size_in_bytes + temp_size_in_bytes`` (donated outputs
+    alias their args; non-aliased outputs are reported separately in
+    ``output_bytes``)."""
+
+    def __init__(self):
+        self.feed_bytes = 0
+        self.param_bytes = 0           # trainable persistables
+        self.opt_state_bytes = 0       # non-trainable persistables
+        self.rng_bytes = 8
+        self.residual_bytes = 0        # Σ residual classes (pre-factor)
+        self.internal_bytes = 0        # op_spec backward extras
+        self.grad_bytes = 0            # counted when collectives force it
+        self.output_bytes = 0          # non-aliased outputs (fetches, and
+        self.transient_bytes = 0       # written state when not donated)
+        self.peak_op_idx = None
+        self.top_live: List[LiveTensor] = []
+        self.mesh_axes: Dict[str, int] = {}
+        self.notes: List[str] = []
+
+    @property
+    def args_bytes(self) -> int:
+        return (self.feed_bytes + self.param_bytes + self.opt_state_bytes
+                + self.rng_bytes)
+
+    @property
+    def state_bytes(self) -> int:
+        return self.param_bytes + self.opt_state_bytes
+
+    @property
+    def peak_bytes(self) -> int:
+        return self.args_bytes + self.transient_bytes
+
+    @property
+    def peak_gb(self) -> float:
+        return self.peak_bytes / _GIB
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "peak_bytes": self.peak_bytes,
+            "peak_gb": round(self.peak_gb, 6),
+            "args_bytes": self.args_bytes,
+            "feed_bytes": self.feed_bytes,
+            "param_bytes": self.param_bytes,
+            "opt_state_bytes": self.opt_state_bytes,
+            "transient_bytes": self.transient_bytes,
+            "residual_bytes": self.residual_bytes,
+            "internal_bytes": self.internal_bytes,
+            "grad_bytes": self.grad_bytes,
+            "output_bytes": self.output_bytes,
+            "mesh_axes": dict(self.mesh_axes),
+            "peak_op_idx": self.peak_op_idx,
+            "top_live": [{"name": t.name, "bytes": t.nbytes,
+                          "kind": t.kind, "op_type": t.op_type}
+                         for t in self.top_live],
+            "notes": list(self.notes),
+        }
+
+    def report(self) -> str:
+        mb = 1 << 20
+        lines = [
+            f"static per-device peak HBM estimate: "
+            f"{self.peak_bytes / mb:.2f} MiB ({self.peak_gb:.4f} GiB)"
+            + (f"  [mesh {self.mesh_axes}]" if self.mesh_axes else ""),
+            f"  arguments  {self.args_bytes / mb:10.2f} MiB  "
+            f"(feeds {self.feed_bytes / mb:.2f}, params "
+            f"{self.param_bytes / mb:.2f}, opt state "
+            f"{self.opt_state_bytes / mb:.2f})",
+            f"  transient  {self.transient_bytes / mb:10.2f} MiB  "
+            f"(residuals {self.residual_bytes / mb:.2f} ×"
+            f"{RESIDUAL_FACTOR}, op-internal "
+            f"{self.internal_bytes / mb:.2f}, grads "
+            f"{self.grad_bytes / mb:.2f})",
+            f"  outputs    {self.output_bytes / mb:10.2f} MiB  "
+            f"(non-aliased)",
+        ]
+        if self.top_live:
+            lines.append(f"  top live tensors at the peak point"
+                         + (f" (op #{self.peak_op_idx})"
+                            if self.peak_op_idx is not None else "") + ":")
+            lines.extend("    " + t.format() for t in self.top_live)
+        for n in self.notes:
+            lines.append(f"  note: {n}")
+        return "\n".join(lines)
+
+
+def _feed_sigs(program: Program, feed_shapes, unknown_dim: int):
+    """Concrete (or declared-fallback) VarSigs for the feed roots."""
+    from ..ops.registry import VarSig
+    block = program.global_block()
+    sigs: Dict[str, Any] = {}
+    if feed_shapes:
+        for name, v in feed_shapes.items():
+            if hasattr(v, "shape") and hasattr(v, "dtype"):
+                sigs[name] = VarSig(tuple(v.shape), str(v.dtype))
+            else:
+                shape, dtype = v
+                sigs[name] = VarSig(tuple(shape), str(dtype))
+    for name, v in block.vars.items():
+        if v.is_data and name not in sigs:
+            shape = tuple(int(d) if int(d) > 0 else unknown_dim
+                          for d in v.shape)
+            sigs[name] = VarSig(shape, v.dtype)
+    return sigs
+
+
+def _state_names(program: Program, fetch_names) -> Tuple[List[str],
+                                                         List[str]]:
+    """(state_in, written_state) exactly as Executor._compile resolves
+    them: persistables read before being written, fetched never-written
+    persistables, and persistables any op writes."""
+    block = program.global_block()
+    ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+    written: set = set()
+    state_in: List[str] = []
+    for op in ops:
+        for n in op.input_names():
+            if n in written or n in state_in:
+                continue
+            var = block._find_var_recursive(n)
+            if var is not None and var.persistable:
+                state_in.append(n)
+        written |= set(op.output_names())
+    for n in fetch_names:
+        var = block._find_var_recursive(n)
+        if var is not None and var.persistable and n not in written and \
+                n not in state_in:
+            state_in.append(n)
+    written_state = []
+    for op in ops:
+        for n in op.output_names():
+            var = block._find_var_recursive(n)
+            if var is not None and var.persistable and \
+                    n not in written_state:
+                written_state.append(n)
+    return state_in, written_state
+
+
+#: fusible op families: XLA assigns one buffer to the whole chain, so
+#: their outputs join their largest input's residual class instead of
+#: opening a new one (views, elementwise arithmetic, activations whose
+#: backward is recomputed inside the fusion)
+_TRANSPARENT_FALLBACK = frozenset({
+    "reshape2", "reshape", "squeeze2", "unsqueeze2", "flatten2", "flatten",
+    "scale", "assign", "cast", "clip", "relu", "gelu", "tanh", "sigmoid",
+    "dropout", "softmax", "elementwise_add", "elementwise_sub",
+    "elementwise_mul",
+})
+
+
+def _op_transparent(op_type: str) -> bool:
+    from ..ops.registry import OP_SPECS
+    spec = OP_SPECS.get(op_type)
+    if spec is not None and spec.mem_transparent is not None:
+        return bool(spec.mem_transparent)
+    return op_type in _TRANSPARENT_FALLBACK
+
+
+def _op_backward_extra(op, env) -> int:
+    """Op-internal bytes retained for backward beyond named vars (the
+    op_spec byte-accounting channel — e.g. attention probability
+    matrices)."""
+    from ..ops.registry import OP_SPECS
+    spec = OP_SPECS.get(op.type)
+    fn = spec.mem_backward_extra if spec is not None else None
+    if fn is None:
+        return 0
+    ins = {slot: [env.get(n) for n in names]
+           for slot, names in op.inputs.items()}
+    outs = {slot: [env.get(n) for n in names]
+            for slot, names in op.outputs.items()}
+    try:
+        return int(fn(ins, outs, op.attrs) or 0)
+    except Exception:       # an accounting bug must not kill the analyzer
+        return 0
+
+
+class _AliasSets:
+    """Union-find over var names for residual-class collapse."""
+
+    def __init__(self):
+        self._parent: Dict[str, str] = {}
+
+    def find(self, x: str) -> str:
+        p = self._parent
+        while p.get(x, x) != x:
+            p[x] = p.get(p[x], p[x])
+            x = p[x]
+        return x
+
+    def union(self, root: str, member: str):
+        self._parent[self.find(member)] = self.find(root)
+
+
+def analyze_memory(program: Program, feed_shapes=None,
+                   fetch_names: Iterable[str] = (),
+                   mesh_axes: Optional[Dict[str, int]] = None,
+                   batch_axis: Optional[str] = None,
+                   seq_axis: Optional[str] = None,
+                   feed_specs: Optional[Dict[str, Any]] = None,
+                   donate_state: bool = True, unknown_dim: int = 1,
+                   top_k: int = 8) -> MemoryEstimate:
+    """Static per-device peak-HBM estimate for one step of ``program``.
+
+    ``feed_shapes`` maps feed names to arrays or ``(shape, dtype)``
+    pairs; absent feeds fall back to declared metadata with unknown dims
+    priced at ``unknown_dim`` (so a gate with no example feed is a lower
+    bound).  ``mesh_axes`` maps axis name → size ({"dp": 8, "tp": 2});
+    persistables divide by their ``dist_attr`` axes, feeds by their
+    ``feed_specs`` entry (default: batch axis on dim 0), activations by
+    the batch × sequence axes.
+    """
+    from ..ops.registry import VarSig
+
+    mesh_axes = dict(mesh_axes or {})
+    fetch_names = list(fetch_names)
+    block = program.global_block()
+    est = MemoryEstimate()
+    est.mesh_axes = mesh_axes
+
+    # -- shape env: feeds bound concretely, op_spec inference forward ----
+    feed_sigs = _feed_sigs(program, feed_shapes, unknown_dim)
+    scratch = VerifyResult(program)    # throwaway: bucket-vs-declared
+    env = infer_shapes(program, scratch, feed_names=list(feed_sigs),
+                       init_env=dict(feed_sigs))
+
+    def sig_of(name):
+        s = env.get(name)
+        if s is not None and s.shape is not None:
+            return s
+        v = block._find_var_recursive(name)
+        if v is None:
+            return s
+        return VarSig(tuple(v.shape) or None, v.dtype)
+
+    act_div = _axis_divisor((batch_axis, seq_axis), mesh_axes)
+
+    def var_bytes(name, activation=False):
+        v = block._find_var_recursive(name)
+        b = sig_bytes(sig_of(name), unknown_dim)
+        if not mesh_axes:
+            return b
+        if v is not None and getattr(v, "dist_attr", None):
+            return b // _axis_divisor(v.dist_attr, mesh_axes)
+        if name in feed_sigs:
+            spec = (feed_specs or {}).get(name)
+            axes = tuple(spec) if spec is not None else (batch_axis,)
+            return b // _axis_divisor(axes, mesh_axes)
+        if activation:
+            return b // act_div
+        return b
+
+    # -- arguments (per device) ------------------------------------------
+    state_in, written_state = _state_names(program, fetch_names)
+    for n in feed_sigs:
+        est.feed_bytes += var_bytes(n)
+    for n in state_in:
+        v = block._find_var_recursive(n)
+        b = var_bytes(n)
+        if v is not None and getattr(v, "trainable", False):
+            est.param_bytes += b
+        else:
+            est.opt_state_bytes += b
+
+    ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+    bw_idx = next((i for i, op in enumerate(ops)
+                   if op.type == "backward"), None)
+    liveness = block_liveness(block, feed_names=list(feed_sigs),
+                              fetch_names=fetch_names)
+    from ..ops.registry import OP_SPECS
+
+    top: List[LiveTensor] = []
+
+    def anchor(name):
+        iv = liveness.get(name)
+        op = iv.def_op if iv is not None else None
+        return ((op.type if op is not None else None),
+                getattr(op, "callstack", None) or ())
+
+    if bw_idx is not None:
+        # ---- training step: peak sits at the backward sweep ------------
+        checkpoints = set(ops[bw_idx].attrs.get("checkpoints") or ())
+        aliases = _AliasSets()
+        fwd_names: Dict[str, int] = {}
+        internal = 0
+        for idx, op in enumerate(ops[:bw_idx]):
+            outs = op.output_names()
+            for n in outs:
+                v = block._find_var_recursive(n)
+                if v is not None and v.persistable:
+                    continue
+                fwd_names.setdefault(n, var_bytes(n, activation=True))
+            internal += _op_backward_extra(op, env) // act_div
+            ins = op.input_names()
+            if outs and ins and _op_transparent(op.type):
+                # ALL outputs join the input's class (a dropout's Out AND
+                # Mask live in the one fused buffer region)
+                big = max(ins, key=lambda n: fwd_names.get(
+                    n, var_bytes(n, activation=True)))
+                for o in outs:
+                    aliases.union(big, o)
+        classes: Dict[str, Tuple[int, str]] = {}
+        for n, b in fwd_names.items():
+            r = aliases.find(n)
+            cur = classes.get(r)
+            if cur is None or b > cur[0]:
+                classes[r] = (b, n)
+        if checkpoints:
+            # recompute segments: only checkpointed values persist to the
+            # backward sweep; everything else re-materialises per segment
+            kept = {r: (b, n) for r, (b, n) in classes.items()
+                    if n in checkpoints or aliases.find(n) in
+                    {aliases.find(c) for c in checkpoints if c in fwd_names}}
+            dropped = sum(b for r, (b, n) in classes.items()
+                          if r not in kept)
+            est.notes.append(
+                f"recompute checkpoints: {len(checkpoints)} boundaries, "
+                f"{dropped / (1 << 20):.2f} MiB of residuals not retained")
+            classes = kept or classes
+        est.residual_bytes = sum(b for b, _ in classes.values())
+        est.internal_bytes = internal
+        # grad-sync collectives after the backward op keep BOTH their
+        # source and result buffers live (a psum cannot update in place;
+        # a reduce_scatter's full-grad input coexists with its 1/n
+        # shard).  The fused single-program update instead streams each
+        # grad straight into the donated state buffers — measured
+        # against XLA buffer assignment, not assumed — so without a
+        # grad-sync zone the gradient set contributes no extra term.
+        scatter_ops = {"zero_reduce_scatter", "c_reducescatter",
+                       "reduce_scatter"}
+        for op in ops[bw_idx + 1:]:
+            spec = OP_SPECS.get(op.type)
+            if spec is None or not spec.collective:
+                continue
+            axes = op.attrs.get("_axis_name")
+            axes = (axes,) if isinstance(axes, str) else tuple(axes or ())
+            for n in op.input_names():
+                v = block._find_var_recursive(n)
+                if v is None or not v.persistable:
+                    est.grad_bytes += var_bytes(n)
+            for n in op.output_names():
+                v = block._find_var_recursive(n)
+                if v is None or not v.persistable:
+                    b = var_bytes(n)
+                    if op.type in scatter_ops:
+                        # a reduce-scatter's result is physically the
+                        # 1/n shard even though the var is declared at
+                        # the full flat shape
+                        b //= _axis_divisor(axes, mesh_axes)
+                    est.grad_bytes += b
+        est.transient_bytes = int(RESIDUAL_FACTOR * est.residual_bytes
+                                  + est.internal_bytes + est.grad_bytes)
+        est.peak_op_idx = bw_idx
+        # top-k live at the peak: params/state + residual classes
+        for n in state_in:
+            t, cs = anchor(n)
+            v = block._find_var_recursive(n)
+            kind = "param" if (v is not None and
+                               getattr(v, "trainable", False)) \
+                else "opt-state"
+            top.append(LiveTensor(n, var_bytes(n), kind, t, cs))
+        for r, (b, n) in classes.items():
+            t, cs = anchor(n)
+            top.append(LiveTensor(n, int(b * RESIDUAL_FACTOR),
+                                  "activation", t, cs))
+        for n in feed_sigs:
+            top.append(LiveTensor(n, var_bytes(n), "feed"))
+    else:
+        # ---- forward-only program: scan the live set over the op list --
+        names = set(liveness)
+        peak, peak_idx, peak_set = 0, 0, []
+        end = len(block.ops) - 1
+        cache: Dict[str, int] = {}
+
+        def nb(n):
+            if n not in cache:
+                cache[n] = var_bytes(n, activation=True)
+            return cache[n]
+
+        sub_extra: Dict[int, int] = {}
+        for idx, op in enumerate(block.ops):
+            extra = 0
+            for sub in _iter_sub_blocks(op):
+                sl = block_liveness(sub)
+                extra += sum(sig_bytes(sig_of(n), unknown_dim) // act_div
+                             for n in sl
+                             if block._find_var_recursive(n) is None
+                             or not block._find_var_recursive(n).persistable)
+            sub_extra[idx] = extra
+        for idx, op in enumerate(block.ops):
+            if op.type in ("feed", "fetch"):
+                continue
+            live = [n for n in names
+                    if liveness[n].live_at(idx, end)
+                    and not liveness[n].pinned]
+            total = sum(nb(n) for n in live) + sub_extra.get(idx, 0)
+            if total > peak:
+                peak, peak_idx, peak_set = total, idx, live
+        est.residual_bytes = peak
+        est.transient_bytes = peak
+        est.peak_op_idx = peak_idx
+        for n in sorted(peak_set, key=nb, reverse=True)[:top_k]:
+            t, cs = anchor(n)
+            top.append(LiveTensor(n, nb(n), "activation", t, cs))
+        for n in state_in:
+            t, cs = anchor(n)
+            top.append(LiveTensor(n, var_bytes(n), "param", t, cs))
+        for n in feed_sigs:
+            top.append(LiveTensor(n, var_bytes(n), "feed"))
+
+    # -- outputs ---------------------------------------------------------
+    for n in fetch_names:
+        v = block._find_var_recursive(n)
+        if v is None or not v.persistable:
+            est.output_bytes += sig_bytes(sig_of(n), unknown_dim)
+    if not donate_state:
+        # read-only-state mode: written persistables come back as FRESH
+        # buffers (no aliasing), so they are live twice at step end
+        dbl = sum(var_bytes(n) for n in written_state)
+        est.output_bytes += dbl
+        est.transient_bytes += dbl
+        if dbl:
+            est.notes.append(
+                f"donate_state=False: {len(written_state)} written "
+                f"persistable(s) counted twice "
+                f"(+{dbl / (1 << 20):.2f} MiB — no buffer aliasing)")
+
+    top.sort(key=lambda t: -t.nbytes)
+    est.top_live = top[:top_k]
+    return est
+
+
+# ---------------------------------------------------------------------------
+# 3. memory lint profile
+# ---------------------------------------------------------------------------
+
+
+def lint_memory(program: Program, fetch_names: Iterable[str] = (),
+                result: Optional[VerifyResult] = None) -> VerifyResult:
+    """Memory-retention lints over one program (warning severity,
+    creation-site anchored):
+
+    * ``donation-gap`` — a trainable persistable receives a gradient
+      (listed in the backward op's param_names) but NO op ever writes it:
+      its update either never happened or landed in a separate buffer,
+      so the stale param stays pinned next to the new value — the silent
+      2× live-set growth of the PR 2 bug class;
+    * ``fetch-retention`` — a fetched non-persistable whose last real
+      consumer runs before the peak point (the backward op): the fetch
+      pins an early activation across the whole step;
+    * ``grad-accum-doubling`` — a param-shaped persistable accumulator
+      summed from a gradient (``sum``/``elementwise_add`` writing back
+      to a persistable input): doubles the per-device gradient live set;
+      shard it (ZeRO-1) or accumulate in bf16.
+    """
+    from .core import GRAD_SUFFIX
+
+    result = result or VerifyResult(program)
+    block = program.global_block()
+    ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+    bw_idx = next((i for i, op in enumerate(ops)
+                   if op.type == "backward"), None)
+    fetch = list(fetch_names)
+    liveness = block_liveness(block, fetch_names=fetch)
+
+    written: Dict[str, int] = {}
+    for idx, op in enumerate(ops):
+        for n in op.output_names():
+            written.setdefault(n, idx)
+
+    # (a) donation gap
+    if bw_idx is not None:
+        for pname in ops[bw_idx].attrs.get("param_names", ()):
+            if pname in written:
+                continue
+            v = block._find_var_recursive(pname)
+            if v is None or not v.persistable:
+                continue
+            reader_idx, reader = next(
+                ((i, op) for i, op in enumerate(ops)
+                 if pname in op.input_names()), (-1, None))
+            b = sig_bytes(_var_sig(v))
+            result.add(
+                "warning", DONATION_GAP,
+                f"trainable persistable {pname!r} receives a gradient but "
+                f"is never updated in place — the update (if any) lives in "
+                f"a separate buffer while the stale param stays pinned "
+                f"(+{b / (1 << 20):.2f} MiB live-set growth); write the "
+                f"optimizer output back to {pname!r} so its donated "
+                f"buffer is reused",
+                reader, block.idx, reader_idx)
+
+    # (b) fetch-induced retention
+    peak_idx = bw_idx if bw_idx is not None else len(ops) - 1
+    for n in fetch:
+        v = block._find_var_recursive(n)
+        if v is not None and (v.persistable or v.is_data):
+            continue
+        iv = liveness.get(n)
+        if iv is None or iv.def_idx is None:
+            continue
+        last_real = max((i for i, op in enumerate(ops)
+                         if n in op.input_names()), default=-1)
+        if last_real < peak_idx and iv.def_idx < peak_idx:
+            b = sig_bytes(_var_sig(v))
+            result.add(
+                "warning", FETCH_RETENTION,
+                f"fetch target {n!r} is produced at op #{iv.def_idx} and "
+                f"last consumed at op #{last_real}, but the fetch pins it "
+                f"across the peak point (op #{peak_idx})"
+                + (f" — +{b / (1 << 20):.2f} MiB held through the "
+                   f"backward sweep" if b else "")
+                + "; fetch a reduced copy or move the fetch off the hot "
+                  "step",
+                iv.def_op, block.idx, iv.def_idx)
+
+    # (c) gradient-accumulation doubling
+    for idx, op in enumerate(ops):
+        if op.type not in ("sum", "elementwise_add"):
+            continue
+        ins = op.input_names()
+        outs = op.output_names()
+        if not outs:
+            continue
+        acc = outs[0]
+        if acc not in ins:
+            continue
+        v = block._find_var_recursive(acc)
+        if v is None or not v.persistable:
+            continue
+        if not any(n.endswith(GRAD_SUFFIX) for n in ins if n != acc):
+            continue
+        b = sig_bytes(_var_sig(v))
+        result.add(
+            "warning", GRAD_ACCUM_DOUBLING,
+            f"persistable gradient accumulator {acc!r} doubles the "
+            f"per-device gradient live set (+{b / (1 << 20):.2f} MiB "
+            f"pinned across every micro-step); shard it with ZeRO-1 "
+            f"(strategy.sharded_update) or accumulate in bf16",
+            op, block.idx, idx)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# 4. HBM budget gate (flag("hbm_budget_gb"))
+# ---------------------------------------------------------------------------
+
+
+def check_hbm_budget(program: Program, feed_shapes=None,
+                     fetch_names: Iterable[str] = (),
+                     mesh_axes: Optional[Dict[str, int]] = None,
+                     batch_axis: Optional[str] = None,
+                     seq_axis: Optional[str] = None,
+                     feed_specs: Optional[Dict[str, Any]] = None,
+                     donate_state: bool = True,
+                     budget_gb: Optional[float] = None
+                     ) -> Optional[MemoryEstimate]:
+    """Raise ``InvalidArgumentError`` BEFORE any trace/compile when the
+    static estimate exceeds ``flag("hbm_budget_gb")`` (0 = gate off).
+
+    Replaces the reference's runtime allocator knobs
+    (``fraction_of_gpu_memory_to_use`` / ``eager_delete_tensor_gb``,
+    accepted as no-ops — XLA owns the allocator) with a STATIC pre-compile
+    budget: an over-budget program is rejected in milliseconds with the
+    top live tensors and their creation sites, not after a multi-minute
+    XLA compile with an opaque HLO buffer name."""
+    if budget_gb is None:
+        from ..flags import flag
+        budget_gb = float(flag("hbm_budget_gb") or 0.0)
+    if not budget_gb or budget_gb <= 0:
+        return None
+    est = analyze_memory(program, feed_shapes=feed_shapes,
+                         fetch_names=fetch_names, mesh_axes=mesh_axes,
+                         batch_axis=batch_axis, seq_axis=seq_axis,
+                         feed_specs=feed_specs, donate_state=donate_state)
+    if est.peak_gb > budget_gb:
+        raise InvalidArgumentError(
+            f"program exceeds hbm_budget_gb={budget_gb:g}: static "
+            f"per-device peak estimate {est.peak_gb:.4f} GiB "
+            f"({est.peak_bytes} bytes) — rejected before compile.\n"
+            + est.report())
+    return est
+
+
+def mesh_axes_of(mesh) -> Dict[str, int]:
+    """{axis name: size} of a jax Mesh (None → {})."""
+    if mesh is None:
+        return {}
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+__all__ = [
+    "DONATION_GAP", "FETCH_RETENTION", "GRAD_ACCUM_DOUBLING",
+    "RESIDUAL_FACTOR", "Interval", "LiveTensor", "MemoryEstimate",
+    "block_liveness", "program_liveness", "analyze_memory", "lint_memory",
+    "check_hbm_budget", "mesh_axes_of", "sig_bytes",
+]

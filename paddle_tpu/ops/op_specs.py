@@ -341,7 +341,74 @@ def _infer_layer_norm(ins, attrs):
     v = _sig(ins, "X")
     if v is None:
         return None
-    return {"Y": [VarSig(v.shape, v.dtype)]}
+    out = {"Y": [VarSig(v.shape, v.dtype)]}
+    if v.shape is not None and len(v.shape) >= 1:
+        # Mean/Variance are per-row statistics over the normalised axis
+        stat = VarSig(tuple(v.shape[:-1]), "float32")
+        out["Mean"] = [stat]
+        out["Variance"] = [stat]
+    return out
+
+
+def _infer_dropout(ins, attrs):
+    v = _sig(ins, "X")
+    if v is None:
+        return None
+    return {"Out": [VarSig(v.shape, v.dtype)],
+            "Mask": [VarSig(v.shape, v.dtype)]}
+
+
+def _infer_fused_attention(ins, attrs):
+    """Out mirrors Q ([B, Sq, hidden]); K/V must agree on the hidden
+    width and on Sk between themselves."""
+    q, k, v = _sig(ins, "Q"), _sig(ins, "K"), _sig(ins, "V")
+    if q is None or q.shape is None:
+        return None
+    for other, nm in ((k, "K"), (v, "V")):
+        if other is None or other.shape is None:
+            continue
+        if len(other.shape) == len(q.shape) and \
+                other.shape[-1] >= 0 and q.shape[-1] >= 0 and \
+                other.shape[-1] != q.shape[-1]:
+            raise SpecMismatch(
+                f"fused_attention: {nm} hidden width {other.shape[-1]} "
+                f"!= Q hidden width {q.shape[-1]}", kind="shape")
+    return {"Out": [VarSig(q.shape, q.dtype)]}
+
+
+def _attention_probs_bytes(ins, outs, attrs):
+    """Backward residual the attention impl materialises internally:
+    the pre-softmax logits + probability matrices [B, n_head, Sq, Sk]
+    (never named Program vars — the op is one fused node)."""
+    from .registry import dtype_nbytes
+    q = _sig(ins, "Q")
+    k = _sig(ins, "K") or q
+    if q is None or q.shape is None or len(q.shape) < 3:
+        return 0
+    ksh = k.shape if k is not None and k.shape is not None else q.shape
+    b, sq = int(q.shape[0]), int(q.shape[1])
+    sk = int(ksh[1]) if len(ksh) > 1 else sq
+    if min(b, sq, sk) < 0:
+        return 0
+    n_head = int(attrs.get("n_head", 1) or 1)
+    head_dim = attrs.get("head_dim")
+    if head_dim and q.shape[-1] > 0:
+        n_head = max(1, int(q.shape[-1]) // int(head_dim))
+    return 2 * b * n_head * sq * sk * dtype_nbytes(q.dtype)
+
+
+def _softmax_ce_extra_bytes(ins, outs, attrs):
+    """softmax-CE keeps the logit-sized softmax for backward, and its
+    cotangent is logit-sized too — two full logit copies beyond the
+    named Loss/Softmax outputs' alias classes."""
+    lg = _sig(ins, "Logits")
+    if lg is None or lg.shape is None or any(int(d) < 0 for d in lg.shape):
+        return 0
+    from .registry import dtype_nbytes
+    n = 1
+    for d in lg.shape:
+        n *= int(d)
+    return 2 * n * dtype_nbytes(lg.dtype)
 
 
 def _infer_batch_norm(ins, attrs):
@@ -598,28 +665,39 @@ def _infer_collective_same(ins, attrs):
 
 
 def register_default_specs():
-    """Register the built-in spec library (idempotent)."""
-    # elementwise family
-    for name in ("elementwise_add", "elementwise_sub", "elementwise_mul",
-                 "elementwise_div", "elementwise_max", "elementwise_min",
+    """Register the built-in spec library (idempotent).
+
+    ``mem_transparent=True`` marks the fusible families for the memory
+    analyzer's residual-class collapse (framework/memory_analysis.py):
+    XLA assigns one buffer to a view/elementwise/activation chain, so
+    these ops join their input's alias class instead of adding bytes.
+    """
+    # elementwise family (add/sub/mul fuse into their producer's buffer;
+    # div/max/min keep both operands as backward residuals — opaque)
+    for name in ("elementwise_add", "elementwise_sub", "elementwise_mul"):
+        op_spec(name, infer=elementwise(), mem_transparent=True)
+    for name in ("elementwise_div", "elementwise_max", "elementwise_min",
                  "elementwise_pow", "elementwise_mod",
                  "elementwise_floordiv"):
         op_spec(name, infer=elementwise())
     for name in ("equal", "not_equal", "less_than", "less_equal",
                  "greater_than", "greater_equal"):
-        op_spec(name, infer=elementwise(out_dtype="bool", check_dtype=False))
+        op_spec(name, infer=elementwise(out_dtype="bool", check_dtype=False),
+                mem_transparent=True)
     for name in ("logical_and", "logical_or", "logical_xor"):
-        op_spec(name, infer=elementwise(out_dtype="bool", check_dtype=False))
-    op_spec("logical_not", infer=same_as_input())
+        op_spec(name, infer=elementwise(out_dtype="bool", check_dtype=False),
+                mem_transparent=True)
+    op_spec("logical_not", infer=same_as_input(), mem_transparent=True)
 
-    # unary shape/dtype-preserving
+    # unary shape/dtype-preserving (all fusible elementwise)
     for name in ("relu", "relu6", "sigmoid", "tanh", "gelu", "softmax",
                  "log_softmax", "exp", "log", "sqrt", "rsqrt", "square",
                  "abs", "floor", "ceil", "round", "sign", "softplus",
                  "swish", "hard_swish", "hard_sigmoid", "leaky_relu",
-                 "dropout", "scale", "assign", "clip", "pow",
+                 "scale", "assign", "clip", "pow",
                  "softsign", "erf", "sin", "cos"):
-        op_spec(name, infer=same_as_input())
+        op_spec(name, infer=same_as_input(), mem_transparent=True)
+    op_spec("dropout", infer=_infer_dropout, mem_transparent=True)
 
     # math
     op_spec("mul", infer=_infer_mul)
@@ -632,7 +710,7 @@ def register_default_specs():
         op_spec(name, infer=_infer_reduce)
     op_spec("reduce_all", infer=_infer_reduce)
     op_spec("reduce_any", infer=_infer_reduce)
-    op_spec("cast", infer=_infer_cast)
+    op_spec("cast", infer=_infer_cast, mem_transparent=True)
 
     # nn
     op_spec("conv2d", infer=_infer_conv2d)
@@ -642,17 +720,20 @@ def register_default_specs():
     op_spec("batch_norm", infer=_infer_batch_norm)
     op_spec("lookup_table", infer=_infer_lookup_table)
     op_spec("lookup_table_v2", infer=_infer_lookup_table_v2)
-    op_spec("softmax_with_cross_entropy", infer=_infer_softmax_with_ce)
+    op_spec("softmax_with_cross_entropy", infer=_infer_softmax_with_ce,
+            mem_backward_extra=_softmax_ce_extra_bytes)
     op_spec("cross_entropy", infer=_infer_cross_entropy)
     op_spec("cross_entropy2", infer=_infer_cross_entropy)
+    op_spec("fused_attention", infer=_infer_fused_attention,
+            mem_backward_extra=_attention_probs_bytes)
 
-    # tensor manipulation
-    op_spec("reshape2", infer=_infer_reshape2)
-    op_spec("reshape", infer=_infer_reshape2)
+    # tensor manipulation (views are pure aliases)
+    op_spec("reshape2", infer=_infer_reshape2, mem_transparent=True)
+    op_spec("reshape", infer=_infer_reshape2, mem_transparent=True)
     op_spec("transpose2", infer=_infer_transpose2)
     op_spec("transpose", infer=_infer_transpose2)
-    op_spec("unsqueeze2", infer=_infer_unsqueeze2)
-    op_spec("squeeze2", infer=None)
+    op_spec("unsqueeze2", infer=_infer_unsqueeze2, mem_transparent=True)
+    op_spec("squeeze2", infer=None, mem_transparent=True)
     op_spec("concat", infer=_infer_concat)
     op_spec("split", infer=_infer_split)
     op_spec("top_k", infer=_infer_top_k)
@@ -678,13 +759,15 @@ def register_default_specs():
                  "take_along_axis", "tile", "range", "linspace",
                  "while_loop", "conditional_block", "switch_case",
                  "static_rnn", "py_func", "print", "beam_gather",
-                 "gather_tree", "gather_tokens", "fused_attention",
+                 "gather_tree", "gather_tokens",
                  "multihead_matmul", "fused_elemwise_activation",
                  "fused_bn_activation", "fused_add_layernorm",
                  "fused_embedding_eltwise_layernorm", "fc",
-                 "affine_channel", "flatten2", "flatten",
+                 "affine_channel",
                  "uniform_random_batch_size_like", "seed"):
         op_spec(name, infer=None)
+    op_spec("flatten2", infer=None, mem_transparent=True)
+    op_spec("flatten", infer=None, mem_transparent=True)
 
     # collectives — flagged so the distributed-soundness pass can find
     # them structurally (divergent control flow, sequence divergence)

@@ -85,22 +85,47 @@ class SpecMismatch(Exception):
 
 
 class OpSpec:
-    """Static metadata for one op type."""
+    """Static metadata for one op type.
 
-    __slots__ = ("name", "infer", "collective")
+    Beyond shape/dtype inference (``infer``) and the collective flag, a
+    spec may carry **byte accounting** consumed by the static memory
+    analyzer (framework/memory_analysis.py):
+
+    * ``mem_transparent`` — True for fusible ops (views, elementwise
+      arithmetic, activations): XLA assigns the whole chain one buffer,
+      so the op's output joins its input's residual alias class instead
+      of opening a new one.  None (default) defers to the analyzer's
+      built-in fallback set.
+    * ``mem_backward_extra(ins, outs, attrs) -> bytes`` — op-internal
+      values retained for the backward sweep that never appear as named
+      Program vars (an attention impl's probability matrices, a fused
+      loss's logit-sized softmax), where ``ins``/``outs`` map slots to
+      lists of VarSig (or None when unknown).
+    """
+
+    __slots__ = ("name", "infer", "collective", "mem_transparent",
+                 "mem_backward_extra")
 
     def __init__(self, name: str, infer: Optional[Callable] = None,
-                 collective: bool = False):
+                 collective: bool = False,
+                 mem_transparent: Optional[bool] = None,
+                 mem_backward_extra: Optional[Callable] = None):
         self.name = name
         self.infer = infer
         self.collective = collective
+        self.mem_transparent = mem_transparent
+        self.mem_backward_extra = mem_backward_extra
 
 
 def op_spec(name: str, infer: Optional[Callable] = None,
-            collective: bool = False):
+            collective: bool = False,
+            mem_transparent: Optional[bool] = None,
+            mem_backward_extra: Optional[Callable] = None):
     """Register static metadata for op ``name`` (idempotent per name —
     re-registration replaces, so spec modules can be reloaded)."""
-    spec = OpSpec(name, infer=infer, collective=collective)
+    spec = OpSpec(name, infer=infer, collective=collective,
+                  mem_transparent=mem_transparent,
+                  mem_backward_extra=mem_backward_extra)
     OP_SPECS[name] = spec
     return spec
 
@@ -165,3 +190,20 @@ def canonical_dtype(dtype):
 def i64():
     """Canonical wide int (the reference's int64 index/length dtype)."""
     return jax.dtypes.canonicalize_dtype("int64")
+
+
+_DTYPE_NBYTES_CACHE: Dict[str, int] = {}
+
+
+def dtype_nbytes(dtype) -> int:
+    """On-device bytes per element of ``dtype`` AFTER canonicalisation
+    (int64 → int32 / float64 → float32 when x64 is off) — the width the
+    memory analyzer must price, since device_put canonicalises feeds.
+    bfloat16 correctly prices at 2."""
+    key = str(dtype)
+    b = _DTYPE_NBYTES_CACHE.get(key)
+    if b is None:
+        import numpy as np
+        b = int(np.dtype(jax.dtypes.canonicalize_dtype(key)).itemsize)
+        _DTYPE_NBYTES_CACHE[key] = b
+    return b

@@ -149,6 +149,11 @@ def host_overhead_probe(steps=60, tiny=True):
         "donated_args": donated,
         "total_args": total,
     }
+    # static memory trajectory alongside the timing columns (r09+)
+    from paddle_tpu.framework.memory_analysis import analyze_memory
+    art["static_peak_hbm_mb"] = round(analyze_memory(
+        main, feed_shapes=feed,
+        fetch_names=[loss.name]).peak_bytes / (1 << 20), 3)
     return art
 
 

@@ -9,8 +9,8 @@ echo "== preflight: pytest =="
 python -m pytest tests/ -q -x
 
 echo "== preflight: proglint (static verifier over serialized program +"
-echo "   INFERENCE_PASSES under verify_passes) =="
-python tools/proglint.py --selftest
+echo "   INFERENCE_PASSES under verify_passes + memory profile/budget gate) =="
+python tools/proglint.py --memory --selftest
 
 echo "== preflight: serve_bench (serving engine parity + bucket compile"
 echo "   bounds on a mixed-shape stream) =="

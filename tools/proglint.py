@@ -1,14 +1,16 @@
 #!/usr/bin/env python
 """proglint — lint a serialized Program from the CLI.
 
-The static-verifier front end (framework/analysis.py): structural
-verification, op_spec shape/dtype inference, distributed soundness, and
-the unspecced-op census, over a program loaded from disk — so a saved
-artifact can be checked without tracing or compiling anything.
+The static-verifier front end (framework/analysis.py +
+framework/memory_analysis.py): structural verification, op_spec
+shape/dtype inference, distributed soundness, the unspecced-op census,
+the memory lint profile and the per-device peak-HBM estimate, over a
+program loaded from disk — so a saved artifact can be checked without
+tracing or compiling anything.
 
 Usage:
     python tools/proglint.py PATH [options]
-    python tools/proglint.py --selftest
+    python tools/proglint.py --selftest [--memory]
 
 PATH is one of:
   * a JSON program desc (the versioned schema framework/serialization.py
@@ -24,11 +26,20 @@ Options:
                      collectives, backward/grad ops, persistable writes,
                      and donation annotations (a served program must be a
                      pure read-only function of its feeds)
-  --strict           exit non-zero on warnings too
+  --memory           run the memory lint profile (donation-gap /
+                     fetch-retention / grad-accum-doubling) and print the
+                     static per-device peak-HBM estimate with the top
+                     live tensors at the peak point
+  --json             machine-readable report on stdout (diagnostics,
+                     unspecced-op census, memory estimate) for CI
+  --strict           exit non-zero on warnings too, AND whenever the
+                     unspecced-op census is non-empty — op_spec coverage
+                     can never silently regress under a --strict CI gate
   --selftest         build, serialize, reload and lint a model-zoo
                      program plus every PassBuilder.INFERENCE_PASSES
                      output under flag("verify_passes") — the preflight
-                     CI gate
+                     CI gate; with --memory also exercises the memory
+                     profile + budget gate on the same program
 """
 
 from __future__ import annotations
@@ -64,7 +75,9 @@ def load_program(path: str):
 
 
 def lint(program, startup=None, feed_names=(), fetch_names=(),
-         strict=False, inference=False, out=sys.stdout):
+         strict=False, inference=False, memory=False, as_json=False,
+         out=None):
+    out = out if out is not None else sys.stdout
     from paddle_tpu.framework.analysis import (verify_inference,
                                                verify_program)
     if inference:
@@ -78,18 +91,47 @@ def lint(program, startup=None, feed_names=(), fetch_names=(),
         result = verify_program(program, startup=startup,
                                 feed_names=feed_names,
                                 fetch_names=fetch_names)
-    print(result.report(), file=out)
+    estimate = None
+    if memory:
+        from paddle_tpu.framework.memory_analysis import (analyze_memory,
+                                                          lint_memory)
+        lint_memory(program, fetch_names=fetch_names, result=result)
+        estimate = analyze_memory(program, fetch_names=fetch_names)
+    if as_json:
+        payload = {
+            "errors": len(result.errors()),
+            "warnings": len(result.warnings()),
+            "diagnostics": [
+                {"severity": d.severity, "code": d.code,
+                 "message": d.message, "op_type": d.op_type,
+                 "block": d.block_idx, "op_index": d.op_index,
+                 "callstack": list(d.callstack)}
+                for d in result.diagnostics],
+            "unspecced_ops": dict(result.unspecced_ops),
+        }
+        if estimate is not None:
+            payload["memory"] = estimate.as_dict()
+        print(json.dumps(payload, indent=1), file=out)
+    else:
+        print(result.report(), file=out)
+        if estimate is not None:
+            print(estimate.report(), file=out)
     if result.errors():
         return 1
-    if strict and result.warnings():
+    if strict and (result.warnings() or result.unspecced_ops):
         return 1
     return 0
 
 
-def selftest() -> int:
+def selftest(memory=False) -> int:
     """Zero-setup lint path for CI: serialize a model-zoo program through
     the versioned desc schema, reload it, lint it; then run every
-    INFERENCE_PASSES pipeline under pass-invariant checking."""
+    INFERENCE_PASSES pipeline under pass-invariant checking.  With
+    ``memory``, additionally exercise the memory profile: the training
+    program must produce a positive peak estimate whose components add
+    up, the JSON report must carry it, and the ``hbm_budget_gb`` gate
+    must reject the program against a sub-estimate budget BEFORE any
+    compile."""
     import tempfile
 
     import paddle_tpu.fluid as fluid
@@ -142,6 +184,38 @@ def selftest() -> int:
         print("proglint selftest: inference profile ACCEPTED a training "
               "program")
         return 1
+
+    if memory:
+        from paddle_tpu.framework.errors import InvalidArgumentError
+        from paddle_tpu.framework.memory_analysis import (analyze_memory,
+                                                          check_hbm_budget)
+        est = analyze_memory(main, fetch_names=[total.name])
+        ok = (est.peak_bytes > 0 and est.param_bytes > 0
+              and est.args_bytes + est.transient_bytes == est.peak_bytes)
+        if not ok:
+            print("proglint selftest: memory estimate inconsistent: "
+                  + json.dumps(est.as_dict()))
+            return 1
+        sink = _io.StringIO()
+        rc = lint(main, fetch_names=[total.name], memory=True,
+                  as_json=True, out=sink)
+        if rc or '"memory"' not in sink.getvalue():
+            print("proglint selftest: --memory --json report missing the "
+                  "estimate")
+            return 1
+        try:
+            check_hbm_budget(main, fetch_names=[total.name],
+                             budget_gb=est.peak_gb / 2)
+            print("proglint selftest: hbm budget gate ACCEPTED an "
+                  "over-budget program")
+            return 1
+        except InvalidArgumentError:
+            pass
+        check_hbm_budget(main, fetch_names=[total.name],
+                         budget_gb=est.peak_gb * 2)
+        print("proglint memory selftest OK "
+              f"(peak {est.peak_bytes / (1 << 20):.2f} MiB)")
+
     print("proglint selftest OK")
     return 0
 
@@ -155,19 +229,22 @@ def main(argv=None) -> int:
     ap.add_argument("--feed", action="append", default=[])
     ap.add_argument("--startup")
     ap.add_argument("--inference", action="store_true")
+    ap.add_argument("--memory", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--strict", action="store_true")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args(argv)
 
     if args.selftest:
-        return selftest()
+        return selftest(memory=args.memory)
     if not args.path:
         ap.error("PATH required (or --selftest)")
     program = load_program(args.path)
     startup = load_program(args.startup) if args.startup else None
     return lint(program, startup=startup, feed_names=args.feed,
                 fetch_names=args.fetch, strict=args.strict,
-                inference=args.inference)
+                inference=args.inference, memory=args.memory,
+                as_json=args.as_json)
 
 
 if __name__ == "__main__":

@@ -81,6 +81,18 @@ def bench_transformer(virtual):
             seen.add(s)
             l, = exe.run(main, feed=f, fetch_list=[loss])
             assert np.isfinite(l).all()
+    # static per-device peak-HBM estimate over the bucket grid (one
+    # analysis per distinct shape, no trace) — bench artifacts carry a
+    # memory trajectory alongside the timing columns from r09 on
+    from paddle_tpu.framework.memory_analysis import analyze_memory
+    peak_by_bucket = {}
+    for f in batches:
+        s = f["src_ids"].shape
+        if s not in peak_by_bucket:
+            peak_by_bucket[s] = analyze_memory(
+                main, feed_shapes=f, fetch_names=[loss.name]).peak_bytes
+    static_peak_mb = max(peak_by_bucket.values()) / (1 << 20)
+
     tokens = sum(float(f["trg_mask"].sum()) for f in batches)
     t0 = time.perf_counter()
     host_ns = 0
@@ -130,6 +142,7 @@ def bench_transformer(virtual):
             {f["src_ids"].shape for f in batches}),
         "batches": len(batches),
         "ragged": True,
+        "static_peak_hbm_mb": round(static_peak_mb, 3),
     }))
 
 
@@ -170,6 +183,11 @@ def bench_ernie(virtual):
         "input_mask": np.ones((batch, seq, 1), np.float32),
         "label": rng.randint(0, 2, (batch, 1)).astype(np.int64),
     }
+    from paddle_tpu.framework.memory_analysis import analyze_memory
+    static_peak_mb = analyze_memory(
+        main, feed_shapes=feed,
+        fetch_names=[loss.name]).peak_bytes / (1 << 20)
+
     l, = exe.run(main, feed=feed, fetch_list=[loss])     # compile
     assert np.isfinite(l).all()
     t0 = time.perf_counter()
@@ -203,6 +221,7 @@ def bench_ernie(virtual):
         "ms_per_step": round(dt * 1e3, 2),
         "samples_per_sec_prepared": round(batch / dt_prep, 2),
         "ms_per_step_prepared": round(dt_prep * 1e3, 2),
+        "static_peak_hbm_mb": round(static_peak_mb, 3),
     }))
 
 
@@ -270,6 +289,15 @@ def ladder_compile_census(ladder=(64, 128, 256), batch=8, lower_buckets=1,
     compiles = stat("executor_compile_count").get() - before
     distinct = len({id(s) for s, _ in steps.values()})
 
+    # static per-device peak estimate per rung — the compile-only census
+    # carries the memory trajectory of the ladder too (no trace needed)
+    from paddle_tpu.framework.memory_analysis import analyze_memory
+    static_peak_mb = {
+        str(b_len): round(analyze_memory(
+            main_p, feed_shapes=feed,
+            fetch_names=[loss.name]).peak_bytes / (1 << 20), 3)
+        for b_len, (_, feed) in steps.items()}
+
     # abstract lowering of the first bucket(s): proves the bench-scale
     # step TRACES to one module per bucket without touching a device
     block = main_p.global_block()
@@ -288,6 +316,7 @@ def ladder_compile_census(ladder=(64, 128, 256), batch=8, lower_buckets=1,
         lowered_bytes[b_len] = len(lowered.as_text())
     return {"ladder": list(ladder), "cache_entries": distinct,
             "compiles": compiles, "lowered_bytes": lowered_bytes,
+            "static_peak_hbm_mb": static_peak_mb,
             "d_model": cfg.d_model, "n_layer": cfg.n_layer}
 
 
