@@ -166,6 +166,16 @@ class DistributedStrategy:
         # bf16-compressed grad collectives (cast → all_reduce → upcast;
         # EQuARX-style).  Parity bound documented in test_grad_comm.py.
         self.bf16_allreduce = False
+        # blockwise-quantized grad collectives (the general wire-
+        # compression layer, ops/quantize_wire.py): int8 ≈4× / int4 ≈8×
+        # fewer bytes than fp32 on the wire, per-block float32 scales,
+        # optional stochastic rounding.  Parity bounds per dtype tier in
+        # test_grad_comm.py; mutually exclusive with bf16_allreduce
+        # (pick-one semantics — bf16 IS the 16-bit tier: to get it via
+        # this path set quant_configs["dtype"] = "bfloat16").
+        self.quant_allreduce = False
+        self.quant_configs = {"dtype": "int8", "block_size": 256,
+                              "stochastic_rounding": False}
         self.mesh = None              # explicit jax Mesh override
         # execution/build strategies accepted and largely absorbed by XLA
         self.exec_strategy = None
@@ -286,6 +296,20 @@ class CollectiveOptimizer:
         (the reference's StrategyCompiler drops invalid meta-optimizers
         silently, ref: fleet/base/strategy_compiler.py; here an explicit
         error beats a silently changed recipe)."""
+        if getattr(s, "bf16_allreduce", False) and \
+                getattr(s, "quant_allreduce", False):
+            from ..framework.errors import InvalidArgumentError
+            raise InvalidArgumentError(
+                "DistributedStrategy: bf16_allreduce and quant_allreduce "
+                "both rewrite the grad-collective wire format and cannot "
+                "compose — pick one (bf16 is the 16-bit tier of the "
+                "compression ladder: keep quant_allreduce and set "
+                "quant_configs['dtype'] = 'bfloat16' for the same wire "
+                "bytes)")
+        if getattr(s, "quant_allreduce", False):
+            # fail at strategy level, not deep in the bucket pass
+            from ..ops.quantize_wire import CompressionSpec
+            CompressionSpec.from_attr(dict(s.quant_configs or {}))
         if s.localsgd and s.gradient_merge:
             raise ValueError(
                 "DistributedStrategy: localsgd and gradient_merge both "
@@ -353,7 +377,8 @@ class CollectiveOptimizer:
                 optimizer, nranks=mesh.devices.size,
                 axis_name=mesh.axis_names[0],
                 compress_dtype="bfloat16" if getattr(s, "bf16_allreduce",
-                                                     False) else None)
+                                                     False) else None,
+                quant_spec=self._quant_spec())
         if s.amp:
             from ..contrib.mixed_precision import decorate
             optimizer = decorate(
@@ -377,6 +402,16 @@ class CollectiveOptimizer:
                 begin_step=s.localsgd_configs.get("begin_step", 1))
         return optimizer
 
+    def _quant_spec(self):
+        """The strategy's CompressionSpec (int8/int4 tiers), or None.
+        The bfloat16 tier rides the legacy cast path instead."""
+        s = self._strategy
+        if not getattr(s, "quant_allreduce", False):
+            return None
+        from ..ops.quantize_wire import CompressionSpec
+        spec = CompressionSpec.from_attr(dict(s.quant_configs or {}))
+        return None if spec.dtype == "bfloat16" else spec
+
     def _build_strategy(self):
         """Map the DistributedStrategy comm knobs onto the compiler's
         BuildStrategy (the reference keeps them on BuildStrategy;
@@ -389,6 +424,12 @@ class CollectiveOptimizer:
         build.fuse_grad_size_in_MB = getattr(s, "fuse_grad_size_in_MB", 32)
         if getattr(s, "bf16_allreduce", False):
             build.allreduce_compress_dtype = "bfloat16"
+        if getattr(s, "quant_allreduce", False):
+            spec = self._quant_spec()
+            if spec is not None:
+                build.allreduce_quant_spec = spec.to_attr()
+            else:                      # bfloat16 tier → legacy cast path
+                build.allreduce_compress_dtype = "bfloat16"
         return build
 
     def minimize(self, loss, startup_program=None, parameter_list=None,

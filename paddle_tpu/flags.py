@@ -97,6 +97,13 @@ _register("verify_passes", False)
 #     (donation-gap / fetch-retention / grad-accum-doubling) reports
 #     the retention bugs that flag used to paper over.
 _register("hbm_budget_gb", 0.0)
+# quant-small-bucket lint threshold (framework/analysis.py, surfaced by
+# tools/proglint.py): a blockwise-quantized collective whose payload is
+# under this many KiB pays more in per-block scale tensors + the extra
+# all_to_all/all_gather stage than the narrower wire dtype saves —
+# the verifier warns so tiny buckets stay full-precision (raise
+# fuse_grad_size_in_MB to coalesce them instead).  0 disables the lint.
+_register("quant_min_bucket_kb", 16)
 # accepted no-ops: XLA owns these concerns (ref: flags.cc lines noted)
 _register("fraction_of_gpu_memory_to_use", 0.92, noop=True)   # :343
 _register("eager_delete_tensor_gb", 0.0, noop=True)           # :257

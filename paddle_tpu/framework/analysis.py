@@ -54,6 +54,9 @@ STARTUP_MAIN_MISMATCH = "startup-main-mismatch"
 COLLECTIVE_DIVERGENT_CF = "collective-divergent-control-flow"
 COLLECTIVE_SEQ_DIVERGENCE = "collective-sequence-divergence"
 BF16_ALLREDUCE_INTEGER = "bf16-allreduce-integer"
+QUANT_COLLECTIVE_INTEGER = "quant-collective-integer"
+QUANT_NON_SUM = "quant-collective-non-sum"
+QUANT_SMALL_BUCKET = "quant-small-bucket"
 DONATED_VAR_FETCHED = "donated-var-fetched"
 READ_AFTER_DONATE = "read-after-donate"
 UNSPECCED_OP = "unspecced-op"
@@ -530,6 +533,75 @@ def verify_distributed(program: Program, result: VerifyResult,
                     f"ride compressed collectives",
                     op, block.idx, idx)
 
+    # (b2) quantized wire-compression collectives (ops/quantize_wire.py):
+    # blockwise amax-scaling is only meaningful on float payloads that
+    # are SUMMED — integer payloads would be truncated twice (quantize +
+    # dequant-accumulate), and a non-sum reduction (max/min/prod, raw
+    # gather/permute) has no dequant-accumulate stage for the per-block
+    # scales to cancel in.  Also: the quant-small-bucket lint — a payload
+    # under flag("quant_min_bucket_kb") pays more in scale-tensor and
+    # extra-collective overhead than the narrower dtype saves.
+    _INT_DTYPES = ("int8", "uint8", "int16", "int32", "int64", "bool")
+    _QUANT_SUM_OPS = {"c_quant_allreduce_sum", "c_fused_quant_allreduce_sum",
+                      "quant_reduce_scatter", "c_allreduce_sum",
+                      "c_fused_allreduce_sum", "zero_reduce_scatter",
+                      "c_reducescatter"}
+    from ..flags import flag
+    min_bucket = float(flag("quant_min_bucket_kb")) * 1024.0
+    for idx, op in enumerate(block.ops):
+        quantized = op.type in ("c_quant_allreduce_sum",
+                                "c_fused_quant_allreduce_sum",
+                                "quant_reduce_scatter") or \
+            op.attrs.get("quant_spec") is not None
+        if not quantized or op.type not in collectives:
+            continue
+        if op.type not in _QUANT_SUM_OPS:
+            result.add(
+                "error", QUANT_NON_SUM,
+                f"collective {op.type!r} carries a quant_spec but is not "
+                f"a summing reduction — blockwise dequant-accumulate-"
+                f"requant is only sound for '+' (use the full-precision "
+                f"op, or c_quant_allreduce_sum for sums)",
+                op, block.idx, idx)
+            continue
+        payload, payload_known = 0, True
+        for n in op.input_names():
+            v = block._find_var_recursive(n)
+            if v is None:
+                payload_known = False
+                continue
+            if str(v.dtype) in _INT_DTYPES:
+                result.add(
+                    "error", QUANT_COLLECTIVE_INTEGER,
+                    f"quantized collective {op.type!r} would blockwise-"
+                    f"quantize {n!r} ({v.dtype}) — integer payloads must "
+                    f"ride full-precision collectives (amax/qmax scaling "
+                    f"truncates them silently)",
+                    op, block.idx, idx)
+                payload_known = False
+                continue
+            shape = tuple(v.shape)
+            if not shape or any(int(d) < 0 for d in shape):
+                payload_known = False
+                continue
+            width = {"float64": 8, "float32": 4, "bfloat16": 2,
+                     "float16": 2}.get(str(v.dtype), 4)
+            numel = 1
+            for d in shape:
+                numel *= int(d)
+            payload += numel * width
+        if payload_known and min_bucket > 0 and payload < min_bucket:
+            result.add(
+                "warning", QUANT_SMALL_BUCKET,
+                f"quantized collective {op.type!r} moves only "
+                f"{payload} payload bytes "
+                f"({sorted(op.input_names())}) < quant_min_bucket_kb = "
+                f"{min_bucket / 1024:.0f} KiB — per-block scale tensors "
+                f"and the extra collective stage outweigh the byte "
+                f"saving; raise fuse_grad_size_in_MB or leave this "
+                f"bucket full-precision",
+                op, block.idx, idx)
+
     # (c) donation/aliasing conflicts (the PR 2 bug class).  State vars
     # (persistables written by the program) are donated on the jit
     # boundary; a fetch of the same name aliases a buffer the NEXT step's
@@ -833,6 +905,7 @@ def check_pass_invariants(program: Program, pass_name: str,
 
 __all__ = [
     "Diagnostic", "VerifyResult", "PassInvariantError",
+    "QUANT_COLLECTIVE_INTEGER", "QUANT_NON_SUM", "QUANT_SMALL_BUCKET",
     "verify_program", "verify_inference", "verify_cached",
     "clear_verify_cache",
     "verify_structure", "verify_startup_agreement", "infer_shapes",

@@ -58,6 +58,13 @@ class BuildStrategy:
         # optional compressed grad collectives: cast → all_reduce → upcast
         # (EQuARX-style, bf16 granularity).  None = full precision.
         self.allreduce_compress_dtype = None
+        # blockwise-quantized grad collectives (the int8/int4 tiers of
+        # the wire-compression layer, ops/quantize_wire.py): a
+        # CompressionSpec (or its dict form) routing float grad sync
+        # through c_quant_allreduce_sum / c_fused_quant_allreduce_sum.
+        # None = no quantization.  Mutually exclusive with
+        # allreduce_compress_dtype (fleet validates the strategy flags).
+        self.allreduce_quant_spec = None
         # off by default like the reference (build_strategy.h); XLA fuses
         # elementwise chains anyway — enabling only shrinks the op list
         self.fuse_elewise_add_act_ops = False
@@ -149,6 +156,22 @@ class CompiledProgram:
                     "bfloat16": 2, "float16": 2, "int16": 2, "int8": 1,
                     "uint8": 1, "bool": 1}
 
+    def _qscale_blocks(self, numel, p_axes, qspec):
+        """Static length of a quantized bucket's stage-2 scale tensor:
+        the op pads the flat payload so every rank of the LAST reduce
+        axis owns whole blocks; one float32 scale per block.  -1 when
+        the mesh (and so the pad) is unknown at insertion time."""
+        sizes = {}
+        if self._mesh is not None:
+            sizes = dict(zip(self._mesh.axis_names,
+                             self._mesh.devices.shape))
+        n = int(sizes.get(p_axes[-1], 0) or 0)
+        if n <= 0:
+            return -1
+        chunk = n * qspec.block_size
+        padded = -(-int(numel) // chunk) * chunk
+        return padded // qspec.block_size
+
     def _insert_grad_allreduce(self, strategy, nranks, axis_name=None):
         """Insert the per-step gradient sync after the backward op — the
         rewrite of the reference's GradAllReduce transpiler
@@ -177,6 +200,12 @@ class CompiledProgram:
         need_scale = scale_strategy == \
             BuildStrategy.GradientScaleStrategy.CoeffNumDevice
         compress = getattr(strategy, "allreduce_compress_dtype", None)
+        from ..ops.quantize_wire import CompressionSpec
+        qspec = CompressionSpec.from_attr(
+            getattr(strategy, "allreduce_quant_spec", None))
+        if qspec is not None and qspec.dtype == "bfloat16":
+            # the bf16 tier IS the legacy cast path — route it there
+            compress, qspec = "bfloat16", None
         insert_at = bw_idx + 1
         all_axes = axis_name if isinstance(axis_name, (tuple, list)) else \
             (axis_name or self._batch_axis or "dp",)
@@ -198,8 +227,10 @@ class CompiledProgram:
             nbytes = numel * self._DTYPE_BYTES.get(dtype, 4)
             leaves.append((grad_var_name(pname), p_axes, dtype, nbytes))
 
+        _FLOAT_DTYPES = ("float32", "float64", "float16", "bfloat16")
+
         if not getattr(strategy, "fuse_all_reduce_ops", False):
-            for g, p_axes, _, _ in leaves:
+            for g, p_axes, dtype, _ in leaves:
                 if need_scale:
                     block._insert_op(insert_at, type="scale",
                                      inputs={"X": [g]}, outputs={"Out": [g]},
@@ -209,9 +240,13 @@ class CompiledProgram:
                     attrs = {"ring_id": 0,
                              "_axis_name": tuple(p_axes)
                              if len(p_axes) > 1 else p_axes[0]}
-                    if compress:
+                    op_type = "c_allreduce_sum"
+                    if qspec is not None and dtype in _FLOAT_DTYPES:
+                        op_type = "c_quant_allreduce_sum"
+                        attrs["quant_spec"] = qspec.to_attr()
+                    elif compress:
                         attrs["compress_dtype"] = compress
-                    block._insert_op(insert_at, type="c_allreduce_sum",
+                    block._insert_op(insert_at, type=op_type,
                                      inputs={"X": [g]}, outputs={"Out": [g]},
                                      attrs=attrs)
                     insert_at += 1
@@ -234,7 +269,7 @@ class CompiledProgram:
                 groups[key][-1] = (names + [g], size + nbytes)
         for key in order:
             dtype, p_axes = key
-            for names, _ in groups[key]:
+            for names, bucket_bytes in groups[key]:
                 if not p_axes:
                     # nothing to reduce over (fully sharded param): the
                     # mean-scale still applies, per leaf
@@ -251,11 +286,27 @@ class CompiledProgram:
                          if len(p_axes) > 1 else p_axes[0]}
                 if need_scale:
                     attrs["scale"] = 1.0 / nranks
-                if compress:
+                op_type = "c_fused_allreduce_sum"
+                outputs = {"Out": list(names)}
+                if qspec is not None and dtype in _FLOAT_DTYPES:
+                    # quantized bucket: the per-bucket stage-2 scale
+                    # tensor rides alongside the payload — declare it as
+                    # a real var so the static layer (memory analyzer,
+                    # census readers) prices the scales, not just the
+                    # int payload
+                    op_type = "c_fused_quant_allreduce_sum"
+                    attrs["quant_spec"] = qspec.to_attr()
+                    numel = bucket_bytes // self._DTYPE_BYTES.get(dtype, 4)
+                    sv = block.create_var(
+                        name=f"{names[0]}@quant_scale",
+                        shape=(self._qscale_blocks(numel, p_axes, qspec),),
+                        dtype="float32")
+                    outputs["QScale"] = [sv.name]
+                elif compress:
                     attrs["compress_dtype"] = compress
-                block._insert_op(insert_at, type="c_fused_allreduce_sum",
+                block._insert_op(insert_at, type=op_type,
                                  inputs={"X": list(names)},
-                                 outputs={"Out": list(names)},
+                                 outputs=outputs,
                                  attrs=attrs)
                 insert_at += 1
 

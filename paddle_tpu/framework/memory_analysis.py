@@ -250,6 +250,13 @@ class MemoryEstimate:
         self.grad_bytes = 0            # counted when collectives force it
         self.output_bytes = 0          # non-aliased outputs (fetches, and
         self.transient_bytes = 0       # written state when not donated)
+        # grad-sync collective wire accounting (the op_spec ``wire``
+        # channel): logical payload bytes vs the bytes the ring schedule
+        # actually moves over ICI under the ops' compression specs.
+        # Reported, not part of peak (wire buffers are transient and
+        # already inside the residual factor's slack).
+        self.wire_logical_bytes = 0
+        self.wire_bytes = 0
         self.peak_op_idx = None
         self.top_live: List[LiveTensor] = []
         self.mesh_axes: Dict[str, int] = {}
@@ -285,6 +292,11 @@ class MemoryEstimate:
             "internal_bytes": self.internal_bytes,
             "grad_bytes": self.grad_bytes,
             "output_bytes": self.output_bytes,
+            "wire_logical_bytes": self.wire_logical_bytes,
+            "wire_bytes": self.wire_bytes,
+            "wire_compression_ratio": round(
+                self.wire_logical_bytes / self.wire_bytes, 3)
+            if self.wire_bytes else 1.0,
             "mesh_axes": dict(self.mesh_axes),
             "peak_op_idx": self.peak_op_idx,
             "top_live": [{"name": t.name, "bytes": t.nbytes,
@@ -311,6 +323,13 @@ class MemoryEstimate:
             f"  outputs    {self.output_bytes / mb:10.2f} MiB  "
             f"(non-aliased)",
         ]
+        if self.wire_logical_bytes:
+            ratio = (self.wire_logical_bytes / self.wire_bytes
+                     if self.wire_bytes else 1.0)
+            lines.append(
+                f"  grad-sync wire {self.wire_bytes / mb:6.2f} MiB on ICI "
+                f"(logical {self.wire_logical_bytes / mb:.2f} MiB, "
+                f"compression {ratio:.2f}x)")
         if self.top_live:
             lines.append(f"  top live tensors at the peak point"
                          + (f" (op #{self.peak_op_idx})"
@@ -563,8 +582,8 @@ def analyze_memory(program: Program, feed_shapes=None,
         # grad straight into the donated state buffers — measured
         # against XLA buffer assignment, not assumed — so without a
         # grad-sync zone the gradient set contributes no extra term.
-        scatter_ops = {"zero_reduce_scatter", "c_reducescatter",
-                       "reduce_scatter"}
+        scatter_ops = {"zero_reduce_scatter", "quant_reduce_scatter",
+                       "c_reducescatter", "reduce_scatter"}
         for op in ops[bw_idx + 1:]:
             spec = OP_SPECS.get(op.type)
             if spec is None or not spec.collective:
@@ -585,6 +604,22 @@ def analyze_memory(program: Program, feed_shapes=None,
                         # the full flat shape
                         b //= _axis_divisor(axes, mesh_axes)
                     est.grad_bytes += b
+            # true wire accounting (the op_spec ``wire`` channel): what
+            # this collective moves over ICI vs its logical payload —
+            # quantized collectives additionally keep their wire-width
+            # payload + scale staging buffers live during the exchange
+            wb = None
+            if getattr(spec, "wire", None) is not None:
+                ins = {slot: [sig_of(n) for n in names]
+                       for slot, names in op.inputs.items()}
+                try:
+                    wb = spec.wire(ins, op.attrs, mesh_axes)
+                except Exception:   # accounting must not kill the analyzer
+                    wb = None
+            if wb is not None:
+                logical, wire = wb
+                est.wire_logical_bytes += logical
+                est.wire_bytes += wire
         est.transient_bytes = int(RESIDUAL_FACTOR * est.residual_bytes
                                   + est.internal_bytes + est.grad_bytes)
         est.peak_op_idx = bw_idx

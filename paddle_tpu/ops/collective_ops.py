@@ -21,6 +21,8 @@ import numpy as np
 from jax import lax
 
 from .registry import register, x
+from .quantize_wire import (CompressionSpec, dequantize_blockwise,
+                            pad_to_blocks, quantize_blockwise)
 
 from ..framework.jax_compat import axis_size
 
@@ -124,13 +126,168 @@ def _c_fused_allreduce_sum(ctx, ins, attrs):
     return {"Out": pieces}
 
 
-def _flat_pad(a, n):
-    """Flatten and zero-pad to a multiple of n (the shard count)."""
+def _flat_pad(a, n, align=1):
+    """Flatten and zero-pad to a multiple of n·align (n = shard count;
+    align > 1 makes every shard a whole number of quantization blocks,
+    the quant_reduce_scatter/zero_shard_slice layout contract)."""
     flat = a.reshape(-1)
-    pad = (-flat.shape[0]) % n
+    pad = (-flat.shape[0]) % (n * align)
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
     return flat
+
+
+# ---------------------------------------------------------------------------
+# quantized wire-compression collectives (EQuARX-style, quantize_wire.py)
+# ---------------------------------------------------------------------------
+
+
+def _quant_key(ctx, spec, ax):
+    """Stochastic-rounding key, decorrelated per rank (the trace is SPMD
+    so ctx.key alone is identical on every rank)."""
+    if not spec.stochastic_rounding:
+        return None
+    k = ctx.next_key()
+    return jax.random.fold_in(k, lax.axis_index(ax))
+
+
+def _quant_allreduce_axis(flat, ax, spec, ctx):
+    """One reduce axis of the two-stage quantized all-reduce: quantize →
+    all_to_all shards (wire-width payload + f32 scales) → dequant →
+    upcast-accumulate → requantize → all_gather → dequant.  Returns the
+    reduced f32 flat array at the input length."""
+    n = axis_size(ax)
+    numel = flat.shape[0]
+    bs = spec.block_size
+    flat = pad_to_blocks(flat, n * bs)
+    shard_blocks = flat.shape[0] // (n * bs)
+    q, s = quantize_blockwise(flat, spec, key=_quant_key(ctx, spec, ax))
+    # stage 1: each rank receives every peer's quantized shard-i and
+    # reduces it locally at full precision
+    qx = lax.all_to_all(q.reshape(n, shard_blocks, -1), ax,
+                        split_axis=0, concat_axis=0)
+    sx = lax.all_to_all(s.reshape(n, shard_blocks), ax,
+                        split_axis=0, concat_axis=0)
+    contrib = dequantize_blockwise(
+        qx.reshape(n * shard_blocks, -1), sx.reshape(-1), spec)
+    local = contrib.reshape(n, -1).sum(axis=0)
+    q2, s2 = quantize_blockwise(local, spec,
+                                key=_quant_key(ctx, spec, ax))
+    # stage 2: rebuild the full reduced tensor — same bytes on every
+    # rank, so local dequant cannot diverge across replicas
+    qf = lax.all_gather(q2.reshape(-1), ax, axis=0, tiled=True)
+    sf = lax.all_gather(s2, ax, axis=0, tiled=True)
+    full = dequantize_blockwise(qf.reshape(n * shard_blocks, -1), sf, spec)
+    return full[:numel], sf
+
+
+def _quant_allreduce_flat(flat, axes, spec, ctx):
+    """Sequential per-axis quantized all-reduce (dp×sp grids reduce one
+    axis at a time; quantization error compounds per stage, the byte
+    saving applies on every axis).  Returns (reduced flat f32, last
+    stage-2 scale tensor)."""
+    scales = None
+    for ax in _axes_tuple(axes):
+        flat, scales = _quant_allreduce_axis(flat, ax, spec, ctx)
+    return flat, scales
+
+
+@register("c_quant_allreduce_sum")
+def _c_quant_allreduce_sum(ctx, ins, attrs):
+    """Per-leaf blockwise-quantized all-reduce (the int8/int4 tier of the
+    wire-compression layer; bf16 stays on c_allreduce_sum's cast path).
+    attrs: ``quant_spec`` (dict, see CompressionSpec), optional ``scale``
+    folding the 1/nranks mean into the payload before quantization."""
+    a = x(ins, "X")
+    axis = _ring_axis(ctx, attrs)
+    scale = attrs.get("scale")
+    if scale is not None:
+        a = a * jnp.asarray(scale, a.dtype)
+    if axis is None:
+        return {"Out": a}
+    spec = CompressionSpec.from_attr(attrs["quant_spec"])
+    orig = a.dtype
+    flat, _ = _quant_allreduce_flat(
+        a.reshape(-1).astype(jnp.float32), axis, spec, ctx)
+    return {"Out": flat.reshape(a.shape).astype(orig)}
+
+
+@register("c_fused_quant_allreduce_sum")
+def _c_fused_quant_allreduce_sum(ctx, ins, attrs):
+    """Bucketed quantized all-reduce: the bucket's grads flatten into one
+    buffer, ride the two-stage quantized collective ONCE, and split back
+    — c_fused_allreduce_sum's latency win times the wire-byte win.  The
+    per-bucket stage-2 scale tensor is exposed on the ``QScale`` slot
+    (the compiler declares a var for it, so the static layer prices the
+    scales that ride alongside the payload)."""
+    xs = list(ins.get("X", []))
+    if not xs:
+        return {"Out": []}
+    axis = _ring_axis(ctx, attrs)
+    scale = attrs.get("scale")
+    outs = xs
+    if scale is not None:
+        outs = [a * jnp.asarray(scale, a.dtype) for a in outs]
+    if axis is None:
+        return {"Out": outs}
+    spec = CompressionSpec.from_attr(attrs["quant_spec"])
+    sizes = [int(np.prod(a.shape)) if a.ndim else 1 for a in outs]
+    flat = jnp.concatenate([a.reshape(-1) for a in outs])
+    orig = flat.dtype
+    red, scales = _quant_allreduce_flat(
+        flat.astype(jnp.float32), axis, spec, ctx)
+    red = red.astype(orig)
+    pieces, off = [], 0
+    for a, n in zip(outs, sizes):
+        pieces.append(red[off:off + n].reshape(a.shape))
+        off += n
+    result = {"Out": pieces}
+    if scales is not None:
+        result["QScale"] = scales
+    return result
+
+
+@register("quant_reduce_scatter")
+def _quant_reduce_scatter(ctx, ins, attrs):
+    """Quantized grad sync for the ZeRO-1 path: quantize → all_to_all
+    (each rank receives every peer's quantized copy of ITS shard, at
+    wire width) → dequant → upcast-accumulate.  The output is the
+    rank's reduced f32 flat shard — consumed locally by the sharded
+    optimizer update, so no stage-2 requantization is needed (the
+    all_gather half of ZeRO-1 moves updated PARAMS, not grads, and
+    stays full precision).
+
+    attrs: ``quant_spec``, ``scale`` (mean fold), ``_axis_name``; with
+    multiple reduce axes the scatter rides the FIRST axis and a psum
+    folds the rest (matching zero_reduce_scatter).  The flat pad is
+    aligned to n·block_size — zero_shard_slice must be given the same
+    ``align`` so param and grad shards cover identical element ranges."""
+    g = x(ins, "X")
+    axis = _ring_axis(ctx, attrs)
+    scale = attrs.get("scale")
+    if scale is not None:
+        g = g * jnp.asarray(scale, g.dtype)
+    spec = CompressionSpec.from_attr(attrs["quant_spec"])
+    if axis is None:
+        return {"Out": g.reshape(-1)}
+    axes = _axes_tuple(axis)
+    scatter_ax, rest = axes[0], axes[1:]
+    n = axis_size(scatter_ax)
+    orig = g.dtype
+    flat = _flat_pad(g.astype(jnp.float32), n, align=spec.block_size)
+    if rest:
+        flat = lax.psum(flat, rest)
+    shard_blocks = flat.shape[0] // (n * spec.block_size)
+    q, s = quantize_blockwise(flat, spec,
+                              key=_quant_key(ctx, spec, scatter_ax))
+    qx = lax.all_to_all(q.reshape(n, shard_blocks, -1), scatter_ax,
+                        split_axis=0, concat_axis=0)
+    sx = lax.all_to_all(s.reshape(n, shard_blocks), scatter_ax,
+                        split_axis=0, concat_axis=0)
+    contrib = dequantize_blockwise(
+        qx.reshape(n * shard_blocks, -1), sx.reshape(-1), spec)
+    out = contrib.reshape(n, -1).sum(axis=0)
+    return {"Out": out.astype(orig)}
 
 
 def _axes_tuple(axis):
@@ -180,7 +337,9 @@ def _zero_shard_slice(ctx, ins, attrs):
         return {"Out": a.reshape(-1)}
     ax = _axes_tuple(axis)[0]
     n = axis_size(ax)
-    flat = _flat_pad(a, n)
+    # ``align`` matches the flat pad of a quantized grad scatter so the
+    # param shard covers the same element range as the grad shard
+    flat = _flat_pad(a, n, align=attrs.get("align", 1))
     shard = flat.shape[0] // n
     return {"Out": lax.dynamic_slice_in_dim(
         flat, lax.axis_index(ax) * shard, shard)}

@@ -664,6 +664,88 @@ def _infer_collective_same(ins, attrs):
     return same_as_input()(ins, attrs)
 
 
+# -- wire-byte accounting (the ``wire`` op_spec channel) --------------------
+#
+# Ring cost model over one reduce axis of size n (the standard
+# bandwidth-optimal schedule XLA uses on ICI):
+#
+#   all_reduce       2·(n-1)/n · payload     (reduce-scatter + all-gather)
+#   reduce_scatter     (n-1)/n · payload
+#   all_gather         (n-1)/n · payload
+#   all_to_all         (n-1)/n · payload
+#
+# ``logical_bytes`` prices the payload at the program dtype;
+# ``wire_bytes`` prices it at the op's CompressionSpec tier (payload +
+# per-block scales, quantize_wire.py) — for full-precision collectives
+# the two are equal, ratio 1.0 (the census back-compat default).
+
+_WIRE_DTYPE_BYTES = {"float64": 8, "int64": 8, "float32": 4, "int32": 4,
+                     "bfloat16": 2, "float16": 2, "int16": 2, "int8": 1,
+                     "uint8": 1, "bool": 1}
+
+
+def _ring_factor(attrs, axis_sizes, passes):
+    """Σ over the op's reduce axes of passes·(n-1)/n; falls back to
+    ``passes`` per axis when the mesh is unknown (n → ∞ bound)."""
+    axes = attrs.get("_axis_name") or ()
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    if not axes:
+        axes = (None,)
+    total = 0.0
+    for ax in axes:
+        n = (axis_sizes or {}).get(ax) if ax is not None else None
+        total += passes * ((n - 1) / n if n and n > 1 else 1.0)
+    return total
+
+
+def _collective_wire(passes):
+    """Build a ``wire`` accounting fn for a (possibly quantized) reduce
+    collective moving its payload ``passes`` times per axis."""
+    def wire(ins, attrs, axis_sizes=None):
+        from .quantize_wire import quant_spec_of
+        numel, width = 0, 4
+        for sig in ins.get("X", []):
+            if sig is None or sig.shape is None or not _known(sig.shape):
+                return None              # dynamic payload — no claim
+            numel += _numel(sig.shape)
+            width = _WIRE_DTYPE_BYTES.get(sig.dtype, 4)
+        if not numel:
+            return None
+        factor = _ring_factor(attrs, axis_sizes, passes)
+        logical = int(numel * width * factor)
+        spec = quant_spec_of(attrs)
+        per_pass = spec.wire_bytes(numel) if spec is not None \
+            else numel * width
+        return logical, int(per_pass * factor)
+    return wire
+
+
+#: collective op type → its ``wire`` accounting fn (2 payload passes for
+#: all-reduce shapes, 1 for scatter/gather halves)
+_WIRE_SPECS = {
+    "c_allreduce_sum": _collective_wire(2),
+    "c_fused_allreduce_sum": _collective_wire(2),
+    "c_quant_allreduce_sum": _collective_wire(2),
+    "c_fused_quant_allreduce_sum": _collective_wire(2),
+    "zero_reduce_scatter": _collective_wire(1),
+    "quant_reduce_scatter": _collective_wire(1),
+    "c_reducescatter": _collective_wire(1),
+    "zero_all_gather": _collective_wire(1),
+    "c_allgather": _collective_wire(1),
+}
+
+
+def collective_wire_bytes(op_type, ins, attrs, axis_sizes=None):
+    """(logical_bytes, wire_bytes) for one collective op, or None when
+    the op has no wire accounting or its payload is dynamic."""
+    from .registry import OP_SPECS
+    spec = OP_SPECS.get(op_type)
+    fn = getattr(spec, "wire", None) if spec is not None else None
+    if fn is None:
+        return None
+    return fn(ins, attrs, axis_sizes)
+
+
 def register_default_specs():
     """Register the built-in spec library (idempotent).
 
@@ -773,16 +855,22 @@ def register_default_specs():
     # them structurally (divergent control flow, sequence divergence)
     for name in ("c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
                  "c_allreduce_prod", "mp_allreduce_sum"):
-        op_spec(name, infer=_infer_collective_same, collective=True)
+        op_spec(name, infer=_infer_collective_same, collective=True,
+                wire=_WIRE_SPECS.get(name))
+    op_spec("c_quant_allreduce_sum", infer=_infer_collective_same,
+            collective=True, wire=_WIRE_SPECS["c_quant_allreduce_sum"])
     op_spec("c_identity", infer=_infer_collective_same)
     op_spec("c_sync_calc_stream", infer=_infer_collective_same)
     op_spec("c_sync_comm_stream", infer=_infer_collective_same)
-    for name in ("c_fused_allreduce_sum", "c_broadcast", "c_allgather",
+    for name in ("c_fused_allreduce_sum", "c_fused_quant_allreduce_sum",
+                 "c_broadcast", "c_allgather",
                  "c_reducescatter", "c_concat", "c_split", "alltoall",
                  "collective_permute", "zero_reduce_scatter",
+                 "quant_reduce_scatter",
                  "zero_all_gather", "zero_shard_slice", "c_embedding",
                  "local_sgd_sync", "moe_ffn", "mp_copy"):
-        op_spec(name, infer=None, collective=True)
+        op_spec(name, infer=None, collective=True,
+                wire=_WIRE_SPECS.get(name))
     # zero_shard_slice/mp_copy are local ops but ride the collective
     # schedule (their placement must agree across ranks), so they are
     # flagged too.

@@ -101,31 +101,41 @@ class OpSpec:
       Program vars (an attention impl's probability matrices, a fused
       loss's logit-sized softmax), where ``ins``/``outs`` map slots to
       lists of VarSig (or None when unknown).
+    * ``wire(ins, attrs, axis_sizes) -> (logical_bytes, wire_bytes)`` —
+      collective wire-byte accounting (ops/op_specs.py): the logical
+      payload bytes the collective syncs vs the bytes it actually moves
+      over ICI under its compression spec (ring cost model; axis_sizes
+      maps mesh axis name → size, or None when the mesh is unknown).
+      Consumed by the memory analyzer's wire summary and the
+      quant-small-bucket lint.
     """
 
     __slots__ = ("name", "infer", "collective", "mem_transparent",
-                 "mem_backward_extra")
+                 "mem_backward_extra", "wire")
 
     def __init__(self, name: str, infer: Optional[Callable] = None,
                  collective: bool = False,
                  mem_transparent: Optional[bool] = None,
-                 mem_backward_extra: Optional[Callable] = None):
+                 mem_backward_extra: Optional[Callable] = None,
+                 wire: Optional[Callable] = None):
         self.name = name
         self.infer = infer
         self.collective = collective
         self.mem_transparent = mem_transparent
         self.mem_backward_extra = mem_backward_extra
+        self.wire = wire
 
 
 def op_spec(name: str, infer: Optional[Callable] = None,
             collective: bool = False,
             mem_transparent: Optional[bool] = None,
-            mem_backward_extra: Optional[Callable] = None):
+            mem_backward_extra: Optional[Callable] = None,
+            wire: Optional[Callable] = None):
     """Register static metadata for op ``name`` (idempotent per name —
     re-registration replaces, so spec modules can be reloaded)."""
     spec = OpSpec(name, infer=infer, collective=collective,
                   mem_transparent=mem_transparent,
-                  mem_backward_extra=mem_backward_extra)
+                  mem_backward_extra=mem_backward_extra, wire=wire)
     OP_SPECS[name] = spec
     return spec
 

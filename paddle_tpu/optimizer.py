@@ -872,7 +872,7 @@ class ShardedUpdateOptimizer(Optimizer):
                     "ftrl", "dpsgd"}
 
     def __init__(self, optimizer, nranks, axis_name="dp",
-                 compress_dtype=None):
+                 compress_dtype=None, quant_spec=None):
         base = getattr(optimizer, "type", None)
         if base not in self._ELEMENTWISE:
             raise ValueError(
@@ -885,6 +885,14 @@ class ShardedUpdateOptimizer(Optimizer):
         self._axes = tuple(axis_name) if isinstance(axis_name, (tuple, list)) \
             else (axis_name,)
         self._compress = compress_dtype
+        # blockwise int8/int4 wire compression for the grad reduce-scatter
+        # (quant_reduce_scatter; ops/quantize_wire.py).  The param
+        # all-gather half stays full precision — it moves updated
+        # WEIGHTS, whose error would accumulate step over step.
+        from .ops.quantize_wire import CompressionSpec
+        self._quant = CompressionSpec.from_attr(quant_spec)
+        if self._quant is not None and self._quant.dtype == "bfloat16":
+            self._compress, self._quant = "bfloat16", None
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
@@ -912,23 +920,33 @@ class ShardedUpdateOptimizer(Optimizer):
         data_axis = self._axes[0]
         axis_attr = self._axes if len(self._axes) > 1 else data_axis
         shard_pairs, gathers, plain = [], [], []
+        # quantized grad scatter pads flat payloads so every rank's shard
+        # is a whole number of quantization blocks — the param slice must
+        # use the same alignment or param/grad shards would cover
+        # different element ranges
+        align = self._quant.block_size if self._quant is not None else 1
         for p, g in params_grads:
             if getattr(p, "dist_attr", None) or \
                     getattr(p, "is_distributed", False):
                 plain.append((p, g))
                 continue
             numel = int(np.prod(p.shape)) if len(tuple(p.shape)) else 1
-            padded = numel + (-numel % n)
+            padded = numel + (-numel % (n * align))
             gsh = block.create_var(
                 name=unique_name.generate(f"{p.name}_grad_zshard"),
                 shape=(padded,), dtype=p.dtype)
+            scatter_attrs = {"ring_id": 0, "_axis_name": axis_attr,
+                             "scale": 1.0 / n}
+            if self._quant is not None:
+                scatter_type = "quant_reduce_scatter"
+                scatter_attrs["quant_spec"] = self._quant.to_attr()
+            else:
+                scatter_type = "zero_reduce_scatter"
+                if self._compress:
+                    scatter_attrs["compress_dtype"] = self._compress
             block.append_op(
-                type="zero_reduce_scatter", inputs={"X": [g]},
-                outputs={"Out": [gsh]},
-                attrs={"ring_id": 0, "_axis_name": axis_attr,
-                       "scale": 1.0 / n,
-                       **({"compress_dtype": self._compress}
-                          if self._compress else {})})
+                type=scatter_type, inputs={"X": [g]},
+                outputs={"Out": [gsh]}, attrs=scatter_attrs)
             psh = block.create_var(
                 name=unique_name.generate(f"{p.name}_zshard"),
                 shape=(padded,), dtype=p.dtype)
@@ -941,7 +959,8 @@ class ShardedUpdateOptimizer(Optimizer):
             block.append_op(
                 type="zero_shard_slice", inputs={"X": [p]},
                 outputs={"Out": [psh]},
-                attrs={"ring_id": 0, "_axis_name": data_axis})
+                attrs={"ring_id": 0, "_axis_name": data_axis,
+                       **({"align": align} if align > 1 else {})})
             shard_pairs.append((psh, gsh))
             gathers.append((psh, p, numel))
         opt_ops = []

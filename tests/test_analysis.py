@@ -155,6 +155,68 @@ def test_detects_bf16_allreduce_on_integer_grad():
     _assert_anchored(d, "c_allreduce_sum")
 
 
+def test_detects_quant_collective_on_integer_payload():
+    """The wire-compression analog of the bf16 check: blockwise
+    amax-quantization silently truncates integer payloads — rejected
+    with a diagnostic anchored at the op's creation site."""
+    from paddle_tpu.framework.analysis import QUANT_COLLECTIVE_INTEGER
+    p = Program()
+    b = p.global_block()
+    b.create_var(name="g", shape=(1 << 20,), dtype="int32", is_data=True)
+    b.append_op(type="c_quant_allreduce_sum", inputs={"X": ["g"]},
+                outputs={"Out": ["g"]},
+                attrs={"ring_id": 0,
+                       "quant_spec": {"dtype": "int8", "block_size": 256}})
+    d = _one(verify_program(p), QUANT_COLLECTIVE_INTEGER)
+    _assert_anchored(d, "c_quant_allreduce_sum")
+    assert "int32" in d.message
+
+
+def test_detects_quant_spec_on_non_summing_collective():
+    """A quant_spec on a max/min/prod reduction is rejected: the
+    dequant-accumulate-requant stages are only sound for '+'."""
+    from paddle_tpu.framework.analysis import QUANT_NON_SUM
+    p = Program()
+    b = p.global_block()
+    b.create_var(name="g", shape=(1 << 20,), dtype="float32", is_data=True)
+    b.append_op(type="c_allreduce_max", inputs={"X": ["g"]},
+                outputs={"Out": ["g"]},
+                attrs={"ring_id": 0,
+                       "quant_spec": {"dtype": "int8", "block_size": 256}})
+    d = _one(verify_program(p), QUANT_NON_SUM)
+    _assert_anchored(d, "c_allreduce_max")
+
+
+def test_warns_quant_small_bucket():
+    """A quantized collective whose payload undercuts
+    flag("quant_min_bucket_kb") warns (scale-tensor overhead exceeds the
+    byte saving); a big payload stays clean; 0 disables the lint."""
+    from paddle_tpu.framework.analysis import QUANT_SMALL_BUCKET
+
+    def prog(numel):
+        p = Program()
+        b = p.global_block()
+        b.create_var(name="g", shape=(numel,), dtype="float32",
+                     is_data=True)
+        b.append_op(type="c_quant_allreduce_sum", inputs={"X": ["g"]},
+                    outputs={"Out": ["g"]},
+                    attrs={"ring_id": 0,
+                           "quant_spec": {"dtype": "int8",
+                                          "block_size": 256}})
+        return p
+
+    d = _one(verify_program(prog(256)), QUANT_SMALL_BUCKET,
+             severity="warning")
+    _assert_anchored(d, "c_quant_allreduce_sum")
+    assert "quant_min_bucket_kb" in d.message
+    assert not verify_program(prog(1 << 20)).by_code(QUANT_SMALL_BUCKET)
+    flags.set_flags({"quant_min_bucket_kb": 0})
+    try:
+        assert not verify_program(prog(256)).by_code(QUANT_SMALL_BUCKET)
+    finally:
+        flags.set_flags({"quant_min_bucket_kb": 16})
+
+
 def test_detects_read_after_donate():
     p = Program()
     b = p.global_block()

@@ -16,6 +16,10 @@ echo "== preflight: serve_bench (serving engine parity + bucket compile"
 echo "   bounds on a mixed-shape stream) =="
 python tools/serve_bench.py --selftest
 
+echo "== preflight: quant wire-compression census (dp8 BERT bucketed grad"
+echo "   sync: int8 >=3.5x fp32 / >=1.9x bf16 ring-model wire bytes) =="
+python tools/verify_multichip_lowering.py --selftest
+
 echo "== preflight: dryrun_multichip(8) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

@@ -185,6 +185,37 @@ def selftest(memory=False) -> int:
               "program")
         return 1
 
+    # wire-compression lints: a tiny quantized collective must raise the
+    # quant-small-bucket warning (scale overhead > byte saving), an
+    # adequately sized one must not, and an integer payload must be an
+    # error (the quantized analog of the bf16-on-integer rejection)
+    from paddle_tpu.framework.analysis import (QUANT_COLLECTIVE_INTEGER,
+                                               QUANT_SMALL_BUCKET,
+                                               verify_program)
+    qp = Program()
+    qb = qp.global_block()
+    qb.create_var(name="g_small", shape=(64,), dtype="float32",
+                  is_data=True)
+    qb.create_var(name="g_big", shape=(1 << 20,), dtype="float32",
+                  is_data=True)
+    qb.create_var(name="g_int", shape=(1 << 20,), dtype="int32",
+                  is_data=True)
+    qattrs = {"ring_id": 0,
+              "quant_spec": {"dtype": "int8", "block_size": 64}}
+    for g in ("g_small", "g_big", "g_int"):
+        qb.append_op(type="c_quant_allreduce_sum", inputs={"X": [g]},
+                     outputs={"Out": [g]}, attrs=dict(qattrs))
+    qres = verify_program(qp)
+    small = qres.by_code(QUANT_SMALL_BUCKET)
+    if len(small) != 1 or "g_small" not in small[0].message:
+        print("proglint selftest: quant-small-bucket lint fired "
+              f"{len(small)}x (expected once, on the 256-byte payload)")
+        return 1
+    if not qres.by_code(QUANT_COLLECTIVE_INTEGER):
+        print("proglint selftest: integer payload on a quantized "
+              "collective was not rejected")
+        return 1
+
     if memory:
         from paddle_tpu.framework.errors import InvalidArgumentError
         from paddle_tpu.framework.memory_analysis import (analyze_memory,

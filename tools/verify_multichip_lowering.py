@@ -11,7 +11,22 @@ report, and ``collective_census``/``donation_ratio`` are importable by
 the tier-1 tests that assert the bucketed-collective bound
 (tests/test_tpu_lowering.py).
 
-Usage: PYTHONPATH=/root/repo python tools/verify_multichip_lowering.py [out.txt [census.json]]
+Since the wire-compression PR each census row also carries true WIRE
+accounting (ring cost model over the op's replica-group size):
+``wire_bytes`` (what the schedule actually moves over ICI),
+``logical_bytes`` (the same payload priced at ≥fp32 master width) and
+``compression_ratio`` = logical/wire — 1.0 for full-precision rows (the
+back-compat default r06/r07 readers assume), ≈4 for int8 payloads, and
+a ``by_dtype`` byte breakdown that the zero-full-precision-collectives
+test asserts on.  The artifact gains a ``quant_dp8`` section comparing
+the dp8 BERT bucketed grad sync across the fp32/bf16/int8/int4 tiers
+(``MULTICHIP_CENSUS_r10.json``, ratio floors asserted in tier-1).
+
+Usage:
+    PYTHONPATH=/root/repo python tools/verify_multichip_lowering.py \
+        [out.txt [census.json]]
+    PYTHONPATH=/root/repo python tools/verify_multichip_lowering.py \
+        --selftest        # dp8 quant census only, asserts ratio floors
 """
 
 import json
@@ -26,9 +41,16 @@ _DTYPE_BYTES = {"f64": 8, "i64": 8, "u64": 8, "f32": 4, "i32": 4, "u32": 4,
                 "bf16": 2, "f16": 2, "i16": 2, "u16": 2, "i8": 1, "u8": 1,
                 "i1": 1}
 
+#: dp8 end-to-end parity bounds per wire dtype tier, as asserted by the
+#: tests/test_grad_comm.py legs (loss-trajectory rtol vs the fp32 dp8
+#: baseline over 4 Adam steps) — recorded in the census artifact so the
+#: byte numbers always travel with their accuracy contract
+PARITY_BOUNDS = {"bf16": 5e-2, "int8": 5e-2, "int4": 2.5e-1}
 
-def _tensor_bytes(ty):
-    """bytes of one 'NxMx...xdtype' tensor type string."""
+
+def _tensor_elems_dtype(ty):
+    """(elems, dtype) of one 'NxMx...xdtype' tensor type string; elems 0
+    when a dim is dynamic."""
     parts = ty.split("x")
     dtype = parts[-1]
     n = 1
@@ -36,17 +58,58 @@ def _tensor_bytes(ty):
         try:
             n *= int(d)
         except ValueError:
-            return 0           # dynamic dim — don't count
+            return 0, dtype    # dynamic dim — don't count
+    return n, dtype
+
+
+def _tensor_bytes(ty):
+    """bytes of one 'NxMx...xdtype' tensor type string."""
+    n, dtype = _tensor_elems_dtype(ty)
     return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def _group_size(line):
+    """Replica-group size of a collective op line (the n of the ring
+    cost model), from ``replica_groups = dense<..> : tensor<GxNxi64>``."""
+    m = re.search(r"replica_groups[^:]*:\s*tensor<(\d+)x(\d+)xi64>", line)
+    return int(m.group(2)) if m else None
+
+
+def _wire_bytes(kind, n, result_bytes):
+    """Ring-schedule wire bytes for one collective, from its RESULT
+    bytes: all_reduce moves the payload twice ((n-1)/n each for the
+    reduce-scatter and all-gather passes), gather/all_to_all once, and
+    a reduce_scatter's wire payload is its n× larger input."""
+    ring = (n - 1) / n if n and n > 1 else 1.0
+    if kind == "all_reduce":
+        return 2.0 * ring * result_bytes
+    if kind == "reduce_scatter":
+        return ring * (n if n else 1) * result_bytes
+    if kind in ("all_gather", "all_to_all"):
+        return ring * result_bytes
+    return float(result_bytes)       # collective_permute: one hop
 
 
 def collective_census(mlir_txt):
     """Per-collective census of a StableHLO module: op kind → {count,
-    bytes} where bytes is the summed payload (result tensors) moved by
-    that collective kind.  Region-carrying ops (all_reduce,
-    reduce_scatter) print their type on the closing ``}) : ... ->``
-    line; region-free ops carry it inline."""
-    census = {k: {"count": 0, "bytes": 0} for k in COLLECTIVES}
+    bytes, by_dtype, wire_bytes, logical_bytes, compression_ratio}.
+
+    ``bytes`` is the summed payload (result tensors) of that collective
+    kind — the r06/r07 field, unchanged.  ``wire_bytes`` applies the
+    ring cost model (see :func:`_wire_bytes`) at the payload's actual
+    element width; ``logical_bytes`` prices the same elements at master
+    width (≥4 bytes — a bf16/int8 payload is a compressed view of fp32
+    values; int4 payloads are packed 2-per-byte int8 carriers, so their
+    census ratio understates the true 8× which the cross-tier
+    ``quant_dp8`` artifact section measures directly).
+    ``compression_ratio`` = logical/wire, 1.0 when unknown (the
+    back-compat default old artifact readers assume for rows without
+    the field).
+
+    Region-carrying ops (all_reduce, reduce_scatter) print their type on
+    the closing ``}) : ... ->`` line; region-free ops carry it inline."""
+    census = {k: {"count": 0, "bytes": 0, "by_dtype": {},
+                  "wire_bytes": 0, "logical_bytes": 0} for k in COLLECTIVES}
     pending = None
     for line in mlir_txt.splitlines():
         m = re.search(r"stablehlo\.(\w+)", line)
@@ -54,17 +117,35 @@ def collective_census(mlir_txt):
         if kind:
             census[kind]["count"] += 1
             if "->" not in line:
-                pending = kind       # type comes on the region-close line
+                # type comes on the region-close line; replica_groups is
+                # on this opening line
+                pending = (kind, _group_size(line))
                 continue
-            target = kind
+            target, n = kind, _group_size(line)
         elif pending and "->" in line and line.lstrip().startswith("})"):
-            target, pending = pending, None
+            (target, n), pending = pending, None
         else:
             continue
+        row = census[target]
         res = line.rsplit("->", 1)[-1]
         for ty in re.findall(r"tensor<([^>]+)>", res):
-            census[target]["bytes"] += _tensor_bytes(ty)
-    return {k: v for k, v in census.items() if v["count"]}
+            elems, dtype = _tensor_elems_dtype(ty)
+            width = _DTYPE_BYTES.get(dtype, 4)
+            b = elems * width
+            row["bytes"] += b
+            row["by_dtype"][dtype] = row["by_dtype"].get(dtype, 0) + b
+            row["wire_bytes"] += int(_wire_bytes(target, n, b))
+            row["logical_bytes"] += int(
+                _wire_bytes(target, n, elems * max(width, 4)))
+    out = {}
+    for k, v in census.items():
+        if not v["count"]:
+            continue
+        v["compression_ratio"] = round(
+            v["logical_bytes"] / v["wire_bytes"], 3) \
+            if v["wire_bytes"] else 1.0
+        out[k] = v
+    return out
 
 
 def donation_ratio(mlir_txt):
@@ -78,16 +159,111 @@ def donation_ratio(mlir_txt):
     return donated, total
 
 
-def main():
+def _env8():
     os.environ['JAX_PLATFORMS'] = 'cpu'
     os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') +
                                ' --xla_force_host_platform_device_count=8'
                                ).strip()
     import jax
     jax.config.update('jax_platforms', 'cpu')
-    import numpy as np
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+
+
+def lower_dp8_bert_census(mode):
+    """Cross-lower the dp8 BERT-tiny BUCKETED train step for TPU with
+    the grad collectives at wire tier ``mode`` ∈ {fp32, bf16, int8,
+    int4} and return the module's collective census."""
+    import jax
+    import numpy as np
+    from jax import export as jexp
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.compiler import BuildStrategy, make_mesh
+    from paddle_tpu.models import bert
+    from paddle_tpu.ops.pallas import lowering_target
+
+    cfg = bert.BertConfig.tiny()
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        feeds, total, mlm, nsp = bert.build_pretrain_network(cfg)
+        fluid.optimizer.Adam(1e-4).minimize(total)
+    mesh = make_mesh(8, "dp")
+    bs = BuildStrategy()
+    bs.fuse_all_reduce_ops = True
+    if mode == "bf16":
+        bs.allreduce_compress_dtype = "bfloat16"
+    elif mode in ("int8", "int4"):
+        bs.allreduce_quant_spec = {"dtype": mode, "block_size": 256}
+    elif mode != "fp32":
+        raise ValueError(f"unknown wire tier {mode!r}")
+    fluid.CompiledProgram(main_p).with_data_parallel(
+        loss_name=total.name, mesh=mesh, build_strategy=bs)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        data = bert.make_fake_batch(np.random.RandomState(0), cfg,
+                                    batch_size=8, seq_len=64, num_masks=3)
+        feed = {k: np.asarray(v) for k, v in data.items()}
+        step = exe._compile(main_p, feed, [total.name], scope, mesh,
+                            ("dp",), "dp")
+        state = {n: np.asarray(scope.find_var(n))
+                 for n in step.state_in_names}
+        with lowering_target("tpu"):
+            exported = jexp.export(step.fn, platforms=("tpu",))(
+                feed, state, jax.random.PRNGKey(0))
+    return collective_census(exported.mlir_module())
+
+
+def quant_dp8_section():
+    """The wire-compression comparison the r10 artifact carries: total
+    ring-model wire bytes of the dp8 BERT bucketed grad sync per dtype
+    tier, and the headline compression ratios (asserted ≥3.5×
+    int8-vs-fp32 / ≥1.9× int8-vs-bf16 in tier-1)."""
+    modes = {}
+    for mode in ("fp32", "bf16", "int8", "int4"):
+        census = lower_dp8_bert_census(mode)
+        modes[mode] = {
+            "census": census,
+            "total_wire_bytes": sum(r["wire_bytes"]
+                                    for r in census.values()),
+            "total_logical_bytes": sum(r["logical_bytes"]
+                                       for r in census.values()),
+        }
+    w = {m: modes[m]["total_wire_bytes"] for m in modes}
+    ratios = {
+        "bf16_vs_fp32": round(w["fp32"] / w["bf16"], 3),
+        "int8_vs_fp32": round(w["fp32"] / w["int8"], 3),
+        "int8_vs_bf16": round(w["bf16"] / w["int8"], 3),
+        "int4_vs_fp32": round(w["fp32"] / w["int4"], 3),
+    }
+    return {"module": "dp8_bert_tiny_train_bucketed",
+            "modes": modes, "ratios": ratios,
+            "parity_bounds": PARITY_BOUNDS}
+
+
+def selftest():
+    """Preflight gate: the quant census ratios must clear the floors the
+    artifact (and tier-1) promise."""
+    _env8()
+    section = quant_dp8_section()
+    r = section["ratios"]
+    print("dp8 quant census ratios:", json.dumps(r))
+    for m, info in section["modes"].items():
+        print(f"  {m}: wire={info['total_wire_bytes']} "
+              f"logical={info['total_logical_bytes']}")
+    ok = (r["int8_vs_fp32"] >= 3.5 and r["int8_vs_bf16"] >= 1.9
+          and r["int4_vs_fp32"] >= r["int8_vs_fp32"]
+          and r["bf16_vs_fp32"] >= 1.7)
+    print("census selftest", "OK" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+def main():
+    _env8()
+    import jax
+    import numpy as np
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models import bert
     from paddle_tpu.parallel import build_mesh
@@ -152,6 +328,10 @@ def main():
         f"({len(soundness_errs)} error(s))",
         f"verdict: {'OK' if counts.get('all_reduce', 0) >= 10 and counts.get('collective_permute', 0) >= 3 and not soundness_errs else 'MISSING COLLECTIVES OR UNSOUND'}",
     ]
+    # dp8 wire-compression comparison across dtype tiers (the r10
+    # headline: int8 buckets ≥3.5× fewer wire bytes than fp32)
+    quant = quant_dp8_section()
+    lines.append("dp8 quant wire ratios: " + json.dumps(quant["ratios"]))
     out = "\n".join(lines + soundness_errs)
     print(out)
     if len(sys.argv) > 1:
@@ -165,9 +345,12 @@ def main():
             json.dump({"module": "dp2xtp2xsp2_bert_tiny_train",
                        "census": census,
                        "arg_donation": [donated, total],
-                       "static_soundness_errors": soundness_errs}, f,
+                       "static_soundness_errors": soundness_errs,
+                       "quant_dp8": quant}, f,
                       indent=1)
 
 
 if __name__ == "__main__":
+    if "--selftest" in sys.argv:
+        sys.exit(selftest())
     main()
