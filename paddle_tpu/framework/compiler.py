@@ -365,6 +365,16 @@ class CompiledProgram:
                              batch_axis=self._batch_axis,
                              seq_axis=self._seq_axis,
                              feed_specs=self._feed_specs)
+        if flag("aot_cache_dir"):
+            # pin the clone's CONTENT hash now (cached per _version):
+            # pass-variant clones get fresh per-process _uids, but their
+            # descs are deterministic given (base program, pass list), so
+            # the persistent AOT executable cache (framework/aot_cache.py)
+            # keys them stably across process restarts — computing the
+            # hash here keeps the desc walk out of the first compile's
+            # critical path
+            from .aot_cache import program_content_hash
+            program_content_hash(clone)
         evicted_uid = None
         if len(variants) >= self._VARIANT_CAP:
             _, stale = variants.popitem(last=False)

@@ -285,9 +285,13 @@ def _replicated_var_names(ops, bw_idx):
 class _CompiledStep:
     def __init__(self, fn, state_in_names, state_out_names, feed_names,
                  fetch_names, raw_fn=None, mesh=None, feed_spec_fn=None,
-                 state_in_specs=None):
+                 state_in_specs=None, jit_fn=None):
         self.fn = fn                 # jitted, donating state buffers
         self.raw_fn = raw_fn or fn   # unjitted pure step (for export)
+        # the re-lowerable jax.jit wrapper when fn is a deserialized
+        # jax.stages.Compiled from the AOT cache (introspection — e.g.
+        # PreparedStep.donation() — needs .lower(), which Compiled lacks)
+        self.jit_fn = jit_fn if jit_fn is not None else fn
         self.state_in_names = state_in_names
         self.state_out_names = state_out_names
         self.feed_names = feed_names
@@ -887,6 +891,22 @@ class PreparedStep:
         self._cur = None
         self._cur_sig = None
 
+    def drop_step(self, sig) -> bool:
+        """Evict ONE compiled feed-signature variant (its executable and
+        the executor's matching cache entry) while the rest stay hot —
+        the per-bucket eviction lever ServingFleet's HBM admission uses.
+        ``sig`` is a :meth:`_signature` tuple.  Returns False when no
+        such variant is compiled."""
+        step = self._steps.pop(sig, None)
+        if step is None:
+            return False
+        if self._cur_sig == sig:
+            self._cur = None
+            self._cur_sig = None
+            self._cur_check = []
+        self._exe._evict_signature(self._program._uid, sig)
+        return True
+
     # -- introspection ----------------------------------------------------
     def donation(self):
         """(donated_args, total_args) of the current step's lowered
@@ -910,7 +930,8 @@ class PreparedStep:
             abss[n] = jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
         key = self._key if self._key is not None else jax.random.PRNGKey(0)
         key_struct = jax.ShapeDtypeStruct(tuple(key.shape), key.dtype)
-        txt = step.fn.lower(self._feed_struct, abss, key_struct).as_text()
+        txt = step.jit_fn.lower(self._feed_struct, abss,
+                                key_struct).as_text()
         sig = re.search(r"func\.func public @main\((.*?)\)\s*->", txt,
                         re.DOTALL).group(1)
         return sig.count("tf.aliasing_output"), sig.count("tensor<")
@@ -1090,6 +1111,12 @@ class Executor:
     def _evict_program(self, uid):
         """Drop compiled steps belonging to an evicted pass-variant clone."""
         self._cache = {k: v for k, v in self._cache.items() if k[0] != uid}
+
+    def _evict_signature(self, uid, feed_sig):
+        """Drop the compiled step(s) for ONE feed signature of a program
+        (PreparedStep.drop_step's executor-cache half)."""
+        self._cache = {k: v for k, v in self._cache.items()
+                       if not (k[0] == uid and k[2] == feed_sig)}
 
     def _run_per_op_debug(self, program, step, feed_vals, state_in, key,
                           fetch_names):
@@ -1271,7 +1298,7 @@ class Executor:
         key = (program._uid, program._version, self._feed_signature(feed),
                tuple(fetch_names), _mesh_identity(mesh),
                flag("use_flash_attention"), flag("use_pallas_fused"),
-               donate_state)
+               donate_state, str(flag("aot_cache_dir") or ""))
         if key in self._cache:
             if flag("print_executor_cache_hits"):
                 print(f"executor cache hit: program v{program._version}")
@@ -1289,7 +1316,6 @@ class Executor:
                              feed_specs=feed_specs,
                              donate_state=donate_state)
         from ..monitor import stat
-        stat("executor_compile_count").add()
 
         block = program.global_block()
         ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
@@ -1394,6 +1420,8 @@ class Executor:
             fn = step
         feed_spec_fn = None
         state_in_specs = None
+        jit_fn = None
+        fresh_trace = True          # False only on an AOT-cache disk hit
         if not host_idxs:
             if mesh is not None:
                 fn, feed_spec_fn, state_in_specs = self._wrap_sharded(
@@ -1401,15 +1429,84 @@ class Executor:
                     state_in_names, state_out_names, feed_specs or {},
                     donate_state=donate_state)
             else:
-                fn = jax.jit(step, donate_argnums=(1,)) if donate_state \
+                jit_fn = jax.jit(step, donate_argnums=(1,)) if donate_state \
                     else jax.jit(step)
+                fn = jit_fn
+                aot_dir = str(flag("aot_cache_dir") or "")
+                if aot_dir:
+                    # persistent AOT executable cache: a restarted process
+                    # deserializes the executable (~ms) instead of paying
+                    # the trace+compile — the serving warm-restart path
+                    loaded, fresh_trace = self._aot_resolve(
+                        aot_dir, jit_fn, program, feed, feed_names,
+                        fetch_names, scope, state_in_names, donate_state)
+                    if loaded is not None:
+                        fn = loaded
+        if fresh_trace:
+            stat("executor_compile_count").add()
 
         compiled = _CompiledStep(fn, state_in_names, state_out_names,
                                  feed_names, fetch_names, raw_fn=step,
                                  mesh=mesh, feed_spec_fn=feed_spec_fn,
-                                 state_in_specs=state_in_specs)
+                                 state_in_specs=state_in_specs,
+                                 jit_fn=jit_fn)
         self._cache[key] = compiled
         return compiled
+
+    def _aot_resolve(self, cache_dir, jit_fn, program, feed, feed_names,
+                     fetch_names, scope, state_in_names, donate_state):
+        """Disk-backed executable resolution for single-device compiles
+        (``flag("aot_cache_dir")``).  Returns ``(callable_or_None,
+        fresh_trace)``: a cache hit deserializes the stored executable
+        (no trace, no compile — ``fresh_trace=False``); a miss lowers and
+        compiles eagerly at this exact feed/state signature, persists the
+        result atomically, and returns the live ``jax.stages.Compiled``.
+        Any serialization gap (backend without PJRT executable
+        serialization, uninitialised state vars) degrades to the plain
+        jitted path — the cache can never cost correctness."""
+        from . import aot_cache
+        from ..flags import flag
+
+        feed_sig = self._feed_signature(feed)
+        trace_flags = (flag("use_flash_attention"),
+                       flag("use_pallas_fused"))
+        key = aot_cache.entry_key(program, feed_sig, fetch_names,
+                                  donate_state, trace_flags)
+        cached = aot_cache.load(cache_dir, key)
+        if cached is not None:
+            return cached, False
+
+        def _struct(v):
+            if not hasattr(v, "shape") or not hasattr(v, "dtype"):
+                v = np.asarray(v)
+            return jax.ShapeDtypeStruct(
+                tuple(v.shape), jax.dtypes.canonicalize_dtype(v.dtype))
+
+        state_structs = {}
+        for n in state_in_names:
+            v = scope.find_var(n)
+            if v is None:
+                # shapes unknown until the startup program runs — skip
+                # the cache for this compile rather than guess
+                return None, True
+            state_structs[n] = _struct(v)
+        rng = scope.find_var(_RNG_VAR)
+        if rng is None:
+            rng = jax.random.PRNGKey(program.random_seed)
+        try:
+            compiled = jit_fn.lower(
+                {k: _struct(feed[k]) for k in feed_names},
+                state_structs, _struct(rng)).compile()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException:
+            return None, True       # lazy jit path will surface the error
+        aot_cache.store(cache_dir, key, compiled,
+                        meta={"fetches": list(fetch_names),
+                              "feed_sig": [list(map(str, i))
+                                           for i in feed_sig],
+                              "donate_state": bool(donate_state)})
+        return compiled, True
 
     def _wrap_sharded(self, step, mesh, axis_names, batch_axis, program,
                       feed_names, state_in_names, state_out_names,

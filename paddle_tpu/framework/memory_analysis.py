@@ -854,6 +854,30 @@ def check_hbm_budget(program: Program, feed_shapes=None,
     return est
 
 
+def estimate(program: Program, feed_shapes=None,
+             fetch_names: Iterable[str] = (),
+             mesh_axes: Optional[Dict[str, int]] = None,
+             batch_axis: Optional[str] = None,
+             seq_axis: Optional[str] = None,
+             feed_specs: Optional[Dict[str, Any]] = None,
+             donate_state: bool = True, unknown_dim: int = 1,
+             top_k: int = 8) -> MemoryEstimate:
+    """The admission-control entry point: one program's static per-device
+    peak-HBM estimate at concrete feed shapes (an alias of
+    :func:`analyze_memory` under the name the serving tier uses).
+
+    ``ServingFleet`` prices each (model x bucket variant) with this —
+    ``state_bytes`` is the model's resident weight footprint (shared by
+    every bucket variant of one predictor) and ``peak_bytes -
+    state_bytes`` the per-variant dynamic working set — and admits model
+    sets under ``hbm_budget_gb`` BEFORE any compile is attempted."""
+    return analyze_memory(program, feed_shapes=feed_shapes,
+                          fetch_names=fetch_names, mesh_axes=mesh_axes,
+                          batch_axis=batch_axis, seq_axis=seq_axis,
+                          feed_specs=feed_specs, donate_state=donate_state,
+                          unknown_dim=unknown_dim, top_k=top_k)
+
+
 def mesh_axes_of(mesh) -> Dict[str, int]:
     """{axis name: size} of a jax Mesh (None → {})."""
     if mesh is None:
@@ -864,6 +888,6 @@ def mesh_axes_of(mesh) -> Dict[str, int]:
 __all__ = [
     "DONATION_GAP", "FETCH_RETENTION", "GRAD_ACCUM_DOUBLING",
     "RESIDUAL_FACTOR", "Interval", "LiveTensor", "MemoryEstimate",
-    "block_liveness", "program_liveness", "analyze_memory", "lint_memory",
-    "check_hbm_budget", "mesh_axes_of", "sig_bytes",
+    "block_liveness", "program_liveness", "analyze_memory", "estimate",
+    "lint_memory", "check_hbm_budget", "mesh_axes_of", "sig_bytes",
 ]

@@ -212,6 +212,24 @@ class AnalysisPredictor:
                              scope=self._scope)
         return [np.asarray(o) for o in outs]
 
+    def run_feed_async(self, feed: Dict[str, np.ndarray]) -> List:
+        """Dispatch one request WITHOUT materializing results: returns
+        lazy ``FetchHandle``s (host blocks only on ``.numpy()``).  The
+        continuous-batching serving worker uses this to assemble and
+        dispatch the next micro-batch while this one computes on device.
+        Binds the prepared fast path on first use."""
+        from ..framework.errors import InvalidArgumentError
+        missing = [n for n in self._feed_names if n not in feed]
+        extra = [n for n in feed if n not in self._feed_names]
+        if missing or extra:
+            raise InvalidArgumentError(
+                f"predictor feed mismatch: missing {missing}, "
+                f"unexpected {extra}; the model declares "
+                f"{self._feed_names}")
+        if self._prepared is None:
+            self.prepare()
+        return list(self._prepared.run(feed))
+
     @property
     def program(self) -> Program:
         return self._program

@@ -170,10 +170,16 @@ PREPARED_PHASES = ("prepared::feed_wait", "prepared::dispatch",
 
 # the host-side phases of one serving micro-batch (ServingEngine's worker
 # emits these): waiting for the batch window to close, padding/assembly
-# into the bucket shape, the predictor run, and splitting fetches back
-# per request
-SERVING_PHASES = ("serving::wait", "serving::pad", "serving::run",
-                  "serving::split")
+# into the bucket shape (``serving::pack`` is the ragged token-packing
+# assembly of the packing mode), the predictor dispatch, and splitting
+# fetches back per request
+SERVING_PHASES = ("serving::wait", "serving::pad", "serving::pack",
+                  "serving::run", "serving::split")
+
+# the persistent AOT executable cache's host phases (framework/
+# aot_cache.py): deserializing a stored executable vs serializing a
+# fresh compile to disk
+AOT_CACHE_PHASES = ("aot_cache::load", "aot_cache::save")
 
 
 def step_breakdown(events=None):
@@ -189,7 +195,7 @@ def step_breakdown(events=None):
     if events is None:
         with _lock:
             events = list(_events)
-    phases = PREPARED_PHASES + SERVING_PHASES
+    phases = PREPARED_PHASES + SERVING_PHASES + AOT_CACHE_PHASES
     out = {}
     for name, start, end, _ in events:
         if name in phases:
@@ -203,6 +209,12 @@ def step_breakdown(events=None):
     out["feed_cache"] = {"hits": stat("feed_cache_hit").get(),
                          "misses": stat("feed_cache_miss").get(),
                          "capacity": int(flag("feed_cache_size"))}
+    # persistent AOT executable cache counters (framework/aot_cache.py):
+    # a warm serving restart shows hits == its bucket grid and ZERO
+    # fresh executor compiles
+    from .framework.aot_cache import cache_stats
+    out["aot_cache"] = dict(cache_stats())
+    out["aot_cache"]["dir"] = str(flag("aot_cache_dir") or "")
     return out
 
 

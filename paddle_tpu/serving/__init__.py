@@ -1,5 +1,9 @@
-"""Serving tier: dynamic micro-batching + shape-bucketed compilation over
-the inference predictor (see engine.py for the design notes).
+"""Serving tier: dynamic micro-batching + shape-bucketed compilation,
+ragged sequence packing, continuous batching, a persistent AOT
+executable cache, and multi-tenant HBM admission (see engine.py and
+fleet.py for the design notes).
+
+Single model:
 
     from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
     from paddle_tpu.serving import ServingConfig, ServingEngine
@@ -7,13 +11,29 @@ the inference predictor (see engine.py for the design notes).
     pred = create_paddle_predictor(AnalysisConfig(model_dir))
     engine = ServingEngine(pred, ServingConfig(
         max_batch_size=8, seq_buckets=(32, 64),
-        seq_feeds=("src_ids", "pos_ids", "sent_ids", "input_mask")))
-    engine.warmup(example_feed)          # AOT-compile the buckets
+        seq_feeds=("src_ids", "pos_ids", "sent_ids", "input_mask"),
+        seq_fetches=("seq_out",),
+        packing=True, mask_feed="input_mask"))   # ragged token packing
+    engine.warmup(example_feed)          # AOT-compile the buckets (a warm
+                                         # restart under flag("aot_cache_dir")
+                                         # deserializes instead)
     fut = engine.submit(feed)            # -> Future of [np.ndarray, ...]
     outputs = fut.result()
     engine.shutdown()
+
+Multi-tenant (one device, several models, static HBM admission):
+
+    from paddle_tpu.serving import ServingFleet
+
+    fleet = ServingFleet(hbm_budget_gb=14.7)
+    fleet.add_model("encoder", model_dir, config, example_feed=example)
+    outputs = fleet.submit("encoder", feed).result()
+    fleet.shutdown()
 """
 
-from .engine import ServingConfig, ServingEngine, pad_request
+from .engine import (ServingConfig, ServingEngine, pack_requests,
+                     pad_request)
+from .fleet import ServingFleet
 
-__all__ = ["ServingConfig", "ServingEngine", "pad_request"]
+__all__ = ["ServingConfig", "ServingEngine", "ServingFleet",
+           "pack_requests", "pad_request"]

@@ -19,15 +19,35 @@ request — so the batching/bucketing tier lives here, inside the framework:
   the additive attention bias sends padded positions to exactly-zero
   softmax weight and real rows/positions are bit-identical to an
   unbatched run at the same bucket shape;
+* **ragged sequence packing** (``ServingConfig(packing=True)``) — instead
+  of giving every request its own padded row, requests pack along the
+  token axis: several short sequences share one ``seq_bucket``-long row,
+  separated by a SEGMENT-CHANNEL mask.  The model's attention bias is
+  built as ``matmul(mask, mask^T)`` (BERT/ERNIE recipe), so lifting the
+  ``[b, s, 1]`` mask feed to ``[b, s, K]`` with one-hot segment channels
+  makes the bias exactly block-diagonal — co-packed segments get
+  exactly-zero attention weight into each other, no model change.  The
+  row/offset placement rides on the future (``fut.placement``), and
+  per-request fetch slices come back from the ``seq_fetches`` plumbing.
+  This is what kills the padding tax: a (1, 9)-token request no longer
+  pays for a (1, 64) row;
+* **continuous batching** — while one micro-batch is in flight on the
+  device, the worker assembles and dispatches the next one behind it
+  (up to ``max_inflight_batches``), so newly arrived group-compatible
+  requests ride the next dispatch instead of waiting for the device to
+  go idle; padding/assembly and result-splitting overlap device compute;
 * **prepared fast path** — the predictor binds onto the read-only-state
   ``Executor.prepare`` mode (weights device-resident, never donated);
+  with ``flag("aot_cache_dir")`` set the executables behind ``warmup()``
+  come from the persistent AOT cache on a warm restart;
 * **observability** — QPS, p50/p99 latency, padding-waste ratio, compile
-  count and a batch-size histogram via :meth:`ServingEngine.stats`
-  (surfaced through ``profiler.serving_stats()``), plus
-  ``serving::wait/pad/run/split`` RecordEvent markers aggregated by
-  ``profiler.step_breakdown()``;
+  count, batch-size histogram and a spurious-wakeup counter via
+  :meth:`ServingEngine.stats` (surfaced through
+  ``profiler.serving_stats()``), plus ``serving::wait/pad/pack/run/split``
+  RecordEvent markers aggregated by ``profiler.step_breakdown()``;
 * **lifecycle** — graceful ``drain``/``shutdown`` and a per-request
-  ``timeout_ms`` deadline.
+  ``timeout_ms`` deadline, swept across the WHOLE queue every wakeup.
+  The idle engine is notify-driven (no poll): zero wakeups, zero CPU.
 """
 
 from __future__ import annotations
@@ -62,7 +82,16 @@ class ServingConfig:
     (e.g. BERT's src_ids/pos_ids/sent_ids/input_mask); ``seq_fetches``
     names fetches whose axis 1 must be sliced back to the request's true
     length.  With ``seq_buckets`` empty no sequence padding happens and
-    only requests with identical non-batch dims coalesce."""
+    only requests with identical non-batch dims coalesce.
+
+    ``packing=True`` turns on ragged sequence packing: requests share
+    bucket rows along the token axis, separated by one-hot segment
+    channels on ``mask_feed`` (which must be one of ``seq_feeds`` with a
+    trailing dim of 1 — the engine owns the channel axis and emits it at
+    ``pack_max_segments`` wide).  Packing requires every model feed to be
+    sequence-major (in ``seq_feeds``) and every fetch to be in
+    ``seq_fetches`` — a pooled [batch, H] output of a packed row would
+    blend segments, so the engine refuses the configuration instead."""
 
     def __init__(self, max_batch_size: int = 8,
                  max_wait_ms: float = 2.0,
@@ -71,7 +100,11 @@ class ServingConfig:
                  seq_feeds: Sequence[str] = (),
                  seq_fetches: Sequence[str] = (),
                  pad_values: Optional[Dict[str, Any]] = None,
-                 timeout_ms: Optional[float] = None):
+                 timeout_ms: Optional[float] = None,
+                 packing: bool = False,
+                 mask_feed: Optional[str] = None,
+                 pack_max_segments: int = 4,
+                 max_inflight_batches: int = 2):
         if max_batch_size < 1:
             raise InvalidArgumentError("max_batch_size must be >= 1")
         self.max_batch_size = int(max_batch_size)
@@ -93,6 +126,22 @@ class ServingConfig:
                 "engine cannot tell which feeds carry the sequence dim")
         self.pad_values = dict(pad_values or {})
         self.timeout_ms = timeout_ms
+        self.packing = bool(packing)
+        self.mask_feed = mask_feed
+        self.pack_max_segments = int(pack_max_segments)
+        self.max_inflight_batches = max(1, int(max_inflight_batches))
+        if self.packing:
+            if not self.seq_buckets:
+                raise InvalidArgumentError(
+                    "packing=True requires seq_buckets — the packed token "
+                    "axis needs a bucket ladder to pack into")
+            if mask_feed is None or mask_feed not in self.seq_feeds:
+                raise InvalidArgumentError(
+                    f"packing=True requires mask_feed (one of seq_feeds "
+                    f"{list(self.seq_feeds)}) — the feed whose trailing "
+                    f"axis carries the one-hot segment channels")
+            if self.pack_max_segments < 1:
+                raise InvalidArgumentError("pack_max_segments must be >= 1")
 
     @property
     def bucket_capacity(self) -> int:
@@ -131,6 +180,105 @@ def pad_request(feed: Dict[str, np.ndarray], seq_bucket: Optional[int],
     return out
 
 
+# ---------------------------------------------------------------------------
+# ragged packing
+# ---------------------------------------------------------------------------
+
+
+def _plan_bins(row_lens: Sequence[int], capacity: int, max_segments: int,
+               max_rows: int):
+    """First-fit the per-row sequence lengths into packed rows of
+    ``capacity`` tokens with at most ``max_segments`` segments each.
+    Returns ``(placements, n_bins)`` — ``placements[i] = (row, offset)``
+    for input row i — or None when it doesn't fit in ``max_rows``."""
+    bins: List[List[int]] = []     # [used_tokens, n_segments]
+    placements = []
+    for s in row_lens:
+        idx = None
+        for i, b in enumerate(bins):
+            if b[0] + s <= capacity and b[1] < max_segments:
+                idx = i
+                break
+        if idx is None:
+            if len(bins) >= max_rows or s > capacity:
+                return None
+            bins.append([0, 0])
+            idx = len(bins) - 1
+        placements.append((idx, bins[idx][0]))
+        bins[idx][0] += s
+        bins[idx][1] += 1
+    return placements, len(bins)
+
+
+def pack_requests(feeds: Sequence[Dict[str, np.ndarray]],
+                  config: ServingConfig,
+                  feed_names: Optional[Sequence[str]] = None):
+    """Pack per-request feed dicts into ONE packed feed — EXACTLY the
+    normalization a packing engine applies, exported so parity baselines
+    can reproduce it: the engine's per-request results are bit-identical
+    to slicing a lone ``predictor.run`` of the packed feed returned here.
+
+    Every row of every request becomes a segment placed first-fit into
+    ``(batch_bucket, seq_bucket)`` rows; the ``mask_feed`` is lifted to
+    ``pack_max_segments`` one-hot channels so ``matmul(mask, mask^T)``
+    attention biases are block-diagonal across segments.  Returns
+    ``(packed_feed, placements, (batch_bucket, seq_bucket))`` with
+    ``placements[i]`` the request's per-row ``(row, offset)`` tuple."""
+    cfg = config
+    if not cfg.packing:
+        raise InvalidArgumentError("pack_requests needs packing=True")
+    arrs = [{n: np.asarray(v) for n, v in f.items()} for f in feeds]
+    if feed_names is None:
+        feed_names = list(arrs[0])
+    seqs = [int(a[cfg.seq_feeds[0]].shape[1]) for a in arrs]
+    rows = [int(a[cfg.seq_feeds[0]].shape[0]) for a in arrs]
+    smax = max(seqs)
+    bucket_s = next((s for s in cfg.seq_buckets if s >= smax), None)
+    if bucket_s is None:
+        raise InvalidArgumentError(
+            f"sequence length {smax} exceeds the largest seq bucket "
+            f"{cfg.seq_buckets[-1]}")
+    row_lens = [s for s, r in zip(seqs, rows) for _ in range(r)]
+    plan = _plan_bins(row_lens, bucket_s, cfg.pack_max_segments,
+                      cfg.max_batch_size)
+    if plan is None:
+        raise InvalidArgumentError(
+            f"requests ({sum(rows)} rows, {sum(row_lens)} tokens) do not "
+            f"pack into max_batch_size={cfg.max_batch_size} rows of "
+            f"{bucket_s} tokens x {cfg.pack_max_segments} segments")
+    flat_placements, n_bins = plan
+    bucket_b = next(b for b in cfg.batch_buckets if b >= n_bins)
+
+    placements: List[Tuple[Tuple[int, int], ...]] = []
+    it = iter(flat_placements)
+    for r in rows:
+        placements.append(tuple(next(it) for _ in range(r)))
+
+    K = cfg.pack_max_segments
+    packed: Dict[str, np.ndarray] = {}
+    seg_counter = [0] * bucket_b       # next free channel per packed row
+    for name in feed_names:
+        ref = arrs[0][name]
+        if name == cfg.mask_feed:
+            packed[name] = np.zeros((bucket_b, bucket_s, K), ref.dtype)
+        else:
+            trail = tuple(ref.shape[2:])
+            packed[name] = np.full((bucket_b, bucket_s) + trail,
+                                   cfg.pad_values.get(name, 0), ref.dtype)
+    for a, seq, nrows, place in zip(arrs, seqs, rows, placements):
+        for r in range(nrows):
+            row, off = place[r]
+            for name in feed_names:
+                if name == cfg.mask_feed:
+                    continue
+                packed[name][row, off:off + seq] = a[name][r]
+            ch = seg_counter[row]
+            seg_counter[row] += 1
+            packed[cfg.mask_feed][row, off:off + seq, ch] = \
+                a[cfg.mask_feed][r, :, 0]
+    return packed, placements, (bucket_b, bucket_s)
+
+
 class _Request:
     __slots__ = ("feed", "rows", "seq", "group", "future", "deadline",
                  "t_submit")
@@ -145,13 +293,45 @@ class _Request:
         self.t_submit = time.monotonic()
 
 
+class _ReadyHandle:
+    """Completed-result shim for duck-typed predictors without the async
+    FetchHandle path."""
+
+    __slots__ = ("_v",)
+
+    def __init__(self, v):
+        self._v = v
+
+    def numpy(self):
+        return np.asarray(self._v)
+
+
+class _Batch:
+    """One picked micro-batch, from selection through in-flight dispatch
+    to completion."""
+
+    __slots__ = ("picked", "bucket_b", "bucket_s", "rows_total",
+                 "placements", "handles")
+
+    def __init__(self, picked, bucket_b, bucket_s, rows_total,
+                 placements=None):
+        self.picked = picked
+        self.bucket_b = bucket_b
+        self.bucket_s = bucket_s
+        self.rows_total = rows_total
+        self.placements = placements
+        self.handles = None
+
+
 class ServingEngine:
     """Dynamic micro-batcher over an :class:`AnalysisPredictor`.
 
     ``submit(feed)`` returns a ``concurrent.futures.Future`` resolving to
     the request's fetch list (one np.ndarray per model output).  A single
     worker thread owns the predictor's prepared fast path, so submission
-    is safe from any number of threads."""
+    is safe from any number of threads.  The worker pipelines: while one
+    batch runs on the device, the next is assembled and dispatched behind
+    it (continuous batching) and completed results are split back."""
 
     def __init__(self, predictor, config: Optional[ServingConfig] = None,
                  auto_start: bool = True):
@@ -159,11 +339,26 @@ class ServingEngine:
         self._predictor = predictor
         self._feed_names = list(predictor.get_input_names())
         self._fetch_names = list(predictor.get_output_names())
-        bad = [n for n in self.config.seq_feeds
-               if n not in self._feed_names]
+        cfg = self.config
+        bad = [n for n in cfg.seq_feeds if n not in self._feed_names]
         if bad:
             raise InvalidArgumentError(
                 f"seq_feeds {bad} are not model feeds {self._feed_names}")
+        if cfg.packing:
+            non_seq = [n for n in self._feed_names if n not in cfg.seq_feeds]
+            if non_seq:
+                raise InvalidArgumentError(
+                    f"packing=True requires every model feed to carry the "
+                    f"packed token axis (be in seq_feeds); {non_seq} are "
+                    f"not — a per-row feed cannot address {'>'}1 packed "
+                    f"segments")
+            loose = [n for n in self._fetch_names if n not in cfg.seq_fetches]
+            if loose:
+                raise InvalidArgumentError(
+                    f"packing=True requires every fetch in seq_fetches so "
+                    f"results can be sliced back per segment; {loose} are "
+                    f"not — a pooled [batch, ...] output of a packed row "
+                    f"would blend co-packed requests")
         predictor.prepare()          # read-only-state device-resident mode
         self._queue: List[_Request] = []
         self._cond = threading.Condition()
@@ -171,7 +366,8 @@ class ServingEngine:
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._accepting = True
-        self._busy = False
+        self._active = 0             # picked batches not yet completed
+        self._spurious_wakeups = 0   # idle-wait wakeups that found no work
         # stats (under _stats_lock)
         self._stats_lock = threading.Lock()
         self._submitted = 0
@@ -186,6 +382,10 @@ class ServingEngine:
         self._batch_hist: Dict[int, int] = {}
         self._t_first_submit: Optional[float] = None
         self._t_last_done: Optional[float] = None
+        # bucket → compiled feed signature + last-use (ServingFleet's
+        # LRU-eviction levers)
+        self._bucket_sigs: Dict[Tuple, Any] = {}
+        self._bucket_used: Dict[Tuple, float] = {}
         register_serving_engine(self)
         if auto_start:
             self.start()
@@ -205,17 +405,18 @@ class ServingEngine:
         deadline = time.monotonic() + timeout
         with self._cond:
             self._cond.notify_all()
-        while time.monotonic() < deadline:
-            with self._cond:
-                if not self._queue and not self._busy:
-                    return True
-            time.sleep(0.002)
-        return False
+            while self._queue or self._active:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
 
     def shutdown(self, drain: bool = True, timeout: float = 30.0) -> bool:
         """Stop the engine.  ``drain=True`` finishes everything queued
         first; ``drain=False`` fails pending requests with
-        UnavailableError.  Further ``submit`` calls raise."""
+        UnavailableError (batches already in flight on the device still
+        complete).  Further ``submit`` calls raise."""
         with self._cond:
             self._accepting = False
             if not drain:
@@ -290,6 +491,13 @@ class ServingEngine:
                 raise InvalidArgumentError(
                     f"request seq length {seq} exceeds the largest "
                     f"seq bucket {cfg.seq_buckets[-1]}")
+        if cfg.packing:
+            m = arrs[cfg.mask_feed]
+            if m.ndim != 3 or m.shape[2] != 1:
+                raise InvalidArgumentError(
+                    f"packing mask feed {cfg.mask_feed!r} must be "
+                    f"[batch, seq, 1] (the engine owns the segment-channel "
+                    f"axis), got shape {list(m.shape)}")
         group = self._group_key(arrs)
         deadline = None
         if cfg.timeout_ms is not None:
@@ -322,54 +530,102 @@ class ServingEngine:
 
     # -- worker -----------------------------------------------------------
     def _worker_loop(self):
+        inflight: List[_Batch] = []
         while True:
-            picked = self._next_batch()
-            if picked is None:
-                return
-            if picked:
-                try:
-                    self._run_batch(picked)
-                finally:
-                    with self._cond:
-                        self._busy = False
+            if len(inflight) >= self.config.max_inflight_batches:
+                self._complete(inflight.pop(0))
+                continue
+            got = self._next_batch(block=not inflight)
+            if got is None:                      # stop, queue drained
+                break
+            if isinstance(got, _Batch):
+                batch = self._dispatch(got)
+                if batch is not None:
+                    inflight.append(batch)
+            elif inflight:
+                self._complete(inflight.pop(0))
+        while inflight:
+            self._complete(inflight.pop(0))
 
-    def _next_batch(self) -> Optional[List[_Request]]:
+    def _earliest_deadline(self):
+        ds = [r.deadline for r in self._queue if r.deadline is not None]
+        return min(ds) if ds else None
+
+    def _next_batch(self, block: bool = True):
+        """Select the next micro-batch.  Returns a :class:`_Batch`, ``[]``
+        when there is nothing to pick right now (only with
+        ``block=False`` — the continuous-batching probe behind an
+        in-flight batch), or None once stopped with an empty queue.
+
+        Every wakeup sweeps request deadlines across the WHOLE queue —
+        a queued request from a non-head group times out on schedule even
+        while another group monopolizes the batches."""
         cfg = self.config
+        expired: List[Tuple[_Request, float]] = []
+        batch = None
+
+        def sweep(now):
+            for r in list(self._queue):
+                if r.deadline is not None and now > r.deadline:
+                    self._queue.remove(r)
+                    expired.append((r, now))
+
         with self._cond:
-            while not self._queue:
-                if self._stop:
-                    return None
-                self._cond.wait(0.05)
-            first = self._queue[0]
-            close_at = first.t_submit + cfg.max_wait_ms / 1e3
-            with RecordEvent("serving::wait"):
-                while not self._stop:
-                    avail = sum(r.rows for r in self._queue
-                                if r.group == first.group)
-                    if avail >= cfg.max_batch_size:
-                        break
-                    remaining = close_at - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    self._cond.wait(remaining)
-            picked: List[_Request] = []
-            rows = 0
-            now = time.monotonic()
-            expired: List[_Request] = []
-            for req in list(self._queue):
-                if req.group != first.group:
-                    continue
-                if rows + req.rows > cfg.max_batch_size:
+            while True:
+                sweep(time.monotonic())
+                if self._stop and not self._queue:
+                    batch = None
                     break
-                self._queue.remove(req)
-                if req.deadline is not None and now > req.deadline:
-                    expired.append(req)
+                if not self._queue:
+                    if expired or not block:
+                        # expired requests must be failed NOW, outside
+                        # the lock — don't re-enter the idle wait first
+                        batch = []
+                        break
+                    # notify-driven idle wait: nothing queued means no
+                    # deadline to watch either — sleep until a submit or
+                    # shutdown notifies (no poll; an idle engine takes
+                    # ZERO wakeups, counted to prove it)
+                    self._cond.wait()
+                    if not self._queue and not self._stop:
+                        self._spurious_wakeups += 1
                     continue
-                picked.append(req)
-                rows += req.rows
-            if picked:
-                self._busy = True
-        for req in expired:
+                first = self._queue[0]
+                if block and not self._stop:
+                    restart = False
+                    close_at = first.t_submit + cfg.max_wait_ms / 1e3
+                    with RecordEvent("serving::wait"):
+                        while not self._stop:
+                            now = time.monotonic()
+                            sweep(now)
+                            if first not in self._queue:
+                                restart = True   # head expired: new head
+                                break
+                            avail = sum(r.rows for r in self._queue
+                                        if r.group == first.group)
+                            if avail >= cfg.max_batch_size:
+                                break
+                            until = close_at
+                            dl = self._earliest_deadline()
+                            if dl is not None and dl < until:
+                                until = dl
+                            remaining = until - now
+                            if remaining <= 0:
+                                break
+                            self._cond.wait(remaining)
+                    if restart:
+                        continue
+                    sweep(time.monotonic())
+                    if not self._queue:
+                        continue
+                    first = self._queue[0]
+                batch = self._pick(first.group)
+                if batch is None:
+                    batch = []
+                    break
+                self._active += 1
+                break
+        for req, now in expired:
             req.future.set_exception(ExecutionTimeoutError(
                 f"request spent "
                 f"{(now - req.t_submit) * 1e3:.1f} ms queued > "
@@ -377,58 +633,176 @@ class ServingEngine:
         if expired:
             with self._stats_lock:
                 self._timed_out += len(expired)
-        return picked
+            with self._cond:
+                self._cond.notify_all()      # drain() watches the queue
+        return batch
 
-    def _run_batch(self, picked: List[_Request]):
+    def _pick(self, group) -> Optional[_Batch]:
+        """Select queued requests of ``group`` into one batch (queue lock
+        held).  The scan CONTINUES past a request that would overflow —
+        a later smaller request that still fits is admitted instead of
+        being head-of-line blocked behind the big one."""
         cfg = self.config
-        rows_total = sum(r.rows for r in picked)
-        bucket_b = next(b for b in cfg.batch_buckets if b >= rows_total)
+        if cfg.packing:
+            return self._pick_packed(group)
+        picked: List[_Request] = []
+        rows = 0
+        for req in list(self._queue):
+            if req.group != group:
+                continue
+            if rows + req.rows > cfg.max_batch_size:
+                continue                  # keep scanning (head-of-line fix)
+            self._queue.remove(req)
+            picked.append(req)
+            rows += req.rows
+        if not picked:
+            return None
+        bucket_b = next(b for b in cfg.batch_buckets if b >= rows)
         bucket_s = None
         if cfg.seq_buckets:
             seq_max = max(r.seq for r in picked)
             bucket_s = next(s for s in cfg.seq_buckets if s >= seq_max)
+        return _Batch(picked, bucket_b, bucket_s, rows)
+
+    def _pick_packed(self, group) -> Optional[_Batch]:
+        """Packing-aware selection: admit requests while their rows still
+        first-fit into ``max_batch_size`` packed rows x the (growing)
+        seq bucket x ``pack_max_segments`` segments.  Same continue-scan
+        head-of-line behavior as :meth:`_pick`."""
+        cfg = self.config
+        picked: List[_Request] = []
+        row_lens: List[int] = []
+        bucket_s = None
+        for req in list(self._queue):
+            if req.group != group:
+                continue
+            need_s = bucket_s
+            if need_s is None or req.seq > need_s:
+                need_s = next(s for s in cfg.seq_buckets if s >= req.seq)
+            trial = row_lens + [req.seq] * req.rows
+            if _plan_bins(trial, need_s, cfg.pack_max_segments,
+                          cfg.max_batch_size) is None:
+                continue                  # keep scanning (head-of-line fix)
+            self._queue.remove(req)
+            picked.append(req)
+            row_lens = trial
+            bucket_s = need_s
+        if not picked:
+            return None
+        placements, n_bins = _plan_bins(row_lens, bucket_s,
+                                        cfg.pack_max_segments,
+                                        cfg.max_batch_size)
+        bucket_b = next(b for b in cfg.batch_buckets if b >= n_bins)
+        return _Batch(picked, bucket_b, bucket_s,
+                      sum(r.rows for r in picked))
+
+    # -- dispatch / completion (pipelined) --------------------------------
+    def _run_async(self, feed):
+        run_async = getattr(self._predictor, "run_feed_async", None)
+        if run_async is not None:
+            return run_async(feed)
+        return [_ReadyHandle(v) for v in self._predictor.run_feed(feed)]
+
+    def _dispatch(self, batch: _Batch) -> Optional[_Batch]:
+        """Assemble + dispatch one batch; device execution proceeds while
+        the worker loops back for the next batch (continuous batching)."""
+        cfg = self.config
         try:
-            with RecordEvent("serving::pad"):
-                feed = self._assemble(picked, rows_total, bucket_b,
-                                      bucket_s)
+            if cfg.packing:
+                with RecordEvent("serving::pack"):
+                    feed, placements, (bb, bs) = pack_requests(
+                        [r.feed for r in batch.picked], cfg,
+                        self._feed_names)
+                    batch.placements = placements
+                    batch.bucket_b, batch.bucket_s = bb, bs
+            else:
+                with RecordEvent("serving::pad"):
+                    feed = self._assemble(batch.picked, batch.rows_total,
+                                          batch.bucket_b, batch.bucket_s)
+            self._record_bucket(feed, batch.bucket_b, batch.bucket_s)
             with RecordEvent("serving::run"), self._run_lock:
-                outs = self._predictor.run_feed(feed)
-            with RecordEvent("serving::split"):
-                off = 0
-                for req in picked:
-                    res = []
-                    for name, o in zip(self._fetch_names, outs):
-                        piece = o[off:off + req.rows]
-                        if bucket_s is not None and \
-                                name in cfg.seq_fetches and piece.ndim >= 2:
-                            piece = piece[:, :req.seq]
-                        res.append(np.ascontiguousarray(piece))
-                    off += req.rows
-                    # the canonical shape this request was computed at —
-                    # a lone predictor.run of pad_request(feed, *bucket)
-                    # reproduces the result bit-for-bit
-                    req.future.bucket = (bucket_b, bucket_s)
-                    req.future.set_result(res)
+                batch.handles = self._run_async(feed)
         except BaseException as e:
-            for req in picked:
+            for req in batch.picked:
                 if not req.future.done():
                     req.future.set_exception(e)
             with self._stats_lock:
-                self._failed += len(picked)
-            return
-        done = time.monotonic()
-        with self._stats_lock:
-            self._completed += len(picked)
-            self._batches += 1
-            self._batch_hist[rows_total] = \
-                self._batch_hist.get(rows_total, 0) + 1
-            for req in picked:
-                self._latencies_ms.append((done - req.t_submit) * 1e3)
-                self._real_tokens += req.rows * (req.seq or 1)
-            self._padded_tokens += bucket_b * (bucket_s or 1)
-            self._t_last_done = done
-            if len(self._latencies_ms) > 100000:
-                del self._latencies_ms[:50000]
+                self._failed += len(batch.picked)
+            with self._cond:
+                self._active -= 1
+                self._cond.notify_all()
+            return None
+        return batch
+
+    def _complete(self, batch: _Batch):
+        """Materialize one in-flight batch's results and route them back
+        per request."""
+        cfg = self.config
+        try:
+            with RecordEvent("serving::split"):
+                outs = [h.numpy() for h in batch.handles]
+                if cfg.packing:
+                    self._split_packed(batch, outs)
+                else:
+                    self._split_padded(batch, outs)
+        except BaseException as e:
+            for req in batch.picked:
+                if not req.future.done():
+                    req.future.set_exception(e)
+            with self._stats_lock:
+                self._failed += len(batch.picked)
+        else:
+            done = time.monotonic()
+            with self._stats_lock:
+                self._completed += len(batch.picked)
+                self._batches += 1
+                self._batch_hist[batch.rows_total] = \
+                    self._batch_hist.get(batch.rows_total, 0) + 1
+                for req in batch.picked:
+                    self._latencies_ms.append((done - req.t_submit) * 1e3)
+                    self._real_tokens += req.rows * (req.seq or 1)
+                self._padded_tokens += batch.bucket_b * (batch.bucket_s or 1)
+                self._t_last_done = done
+                if len(self._latencies_ms) > 100000:
+                    del self._latencies_ms[:50000]
+        finally:
+            with self._cond:
+                self._active -= 1
+                self._cond.notify_all()
+
+    def _split_padded(self, batch: _Batch, outs):
+        cfg = self.config
+        off = 0
+        for req in batch.picked:
+            res = []
+            for name, o in zip(self._fetch_names, outs):
+                piece = o[off:off + req.rows]
+                if batch.bucket_s is not None and \
+                        name in cfg.seq_fetches and piece.ndim >= 2:
+                    piece = piece[:, :req.seq]
+                res.append(np.ascontiguousarray(piece))
+            off += req.rows
+            # the canonical shape this request was computed at — a lone
+            # predictor.run of pad_request(feed, *bucket) reproduces the
+            # result bit-for-bit
+            req.future.bucket = (batch.bucket_b, batch.bucket_s)
+            req.future.set_result(res)
+
+    def _split_packed(self, batch: _Batch, outs):
+        """Per-request slices out of the packed layout: each request row
+        lives at its ``(packed_row, offset)`` placement; a lone
+        predictor.run of the ``pack_requests`` feed reproduces every
+        slice bit-for-bit."""
+        for req, place in zip(batch.picked, batch.placements):
+            res = []
+            for o in outs:
+                rows = [o[row, off:off + req.seq] for row, off in place]
+                piece = rows[0][None] if len(rows) == 1 else \
+                    np.stack(rows, axis=0)
+                res.append(np.ascontiguousarray(piece))
+            req.future.bucket = (batch.bucket_b, batch.bucket_s)
+            req.future.placement = place
+            req.future.set_result(res)
 
     def _assemble(self, picked, rows_total, bucket_b, bucket_s):
         cfg = self.config
@@ -456,34 +830,89 @@ class ServingEngine:
             feed[n] = stack
         return feed
 
+    def _record_bucket(self, feed, bucket_b, bucket_s):
+        """Remember the compiled feed signature + last-use per bucket —
+        the handles ServingFleet's LRU admission eviction pulls on."""
+        sig = tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                           for k, v in feed.items()))
+        with self._stats_lock:
+            self._bucket_sigs[(bucket_b, bucket_s)] = sig
+            self._bucket_used[(bucket_b, bucket_s)] = time.monotonic()
+
     # -- warmup -----------------------------------------------------------
-    def warmup(self, example_feed: Dict[str, Any]) -> int:
+    def _combo_feed(self, ex: Dict[str, np.ndarray], bb: int,
+                    sb: Optional[int]) -> Dict[str, np.ndarray]:
+        """The canonical feed for one (batch bucket, seq bucket) combo —
+        exactly the shapes/dtypes batch assembly produces, so warmup
+        compiles (and the ServingFleet admission model prices) the same
+        executables live traffic uses."""
+        cfg = self.config
+        feed = {}
+        for n in self._feed_names:
+            v = ex[n][:1]
+            if sb is not None and n in cfg.seq_feeds:
+                v = v[:, :sb]
+                if v.shape[1] < sb:
+                    widths = [(0, 0), (0, sb - v.shape[1])] + \
+                        [(0, 0)] * (v.ndim - 2)
+                    v = np.pad(v, widths,
+                               constant_values=cfg.pad_values.get(n, 0))
+            if cfg.packing and n == cfg.mask_feed:
+                # one-hot segment channels: the example rides channel 0
+                m = np.zeros(v.shape[:2] + (cfg.pack_max_segments,),
+                             v.dtype)
+                m[:, :, 0] = v[:, :, 0]
+                v = m
+            feed[n] = np.concatenate([v] * bb, axis=0) if bb > 1 else v
+        return feed
+
+    def warmup(self, example_feed: Dict[str, Any],
+               combos: Optional[Sequence[Tuple[int, Optional[int]]]] = None
+               ) -> int:
         """AOT-compile every configured (batch bucket x seq bucket) combo
         from one example request, so a cold engine serves its first mixed
-        stream without in-band compiles.  Returns the combo count."""
+        stream without in-band compiles.  With ``flag("aot_cache_dir")``
+        set, a warm restart deserializes each combo from the persistent
+        cache instead of re-compiling.  ``combos`` restricts the grid
+        (ServingFleet warms only the admitted variants).  Returns the
+        combo count."""
         ex = {n: np.asarray(v) for n, v in example_feed.items()}
         missing = [n for n in self._feed_names if n not in ex]
         if missing:
             raise InvalidArgumentError(
                 f"warmup example missing feeds {missing}")
         cfg = self.config
-        combos = [(bb, sb) for bb in cfg.batch_buckets
-                  for sb in (cfg.seq_buckets or (None,))]
+        if combos is None:
+            combos = [(bb, sb) for bb in cfg.batch_buckets
+                      for sb in (cfg.seq_buckets or (None,))]
         for bb, sb in combos:
-            feed = {}
-            for n in self._feed_names:
-                v = ex[n][:1]
-                if sb is not None and n in cfg.seq_feeds:
-                    v = v[:, :sb]
-                    if v.shape[1] < sb:
-                        widths = [(0, 0), (0, sb - v.shape[1])] + \
-                            [(0, 0)] * (v.ndim - 2)
-                        v = np.pad(v, widths,
-                                   constant_values=cfg.pad_values.get(n, 0))
-                feed[n] = np.concatenate([v] * bb, axis=0) if bb > 1 else v
+            feed = self._combo_feed(ex, bb, sb)
+            self._record_bucket(feed, bb, sb)
             with self._run_lock:
                 self._predictor.run_feed(feed)
         return len(combos)
+
+    # -- fleet levers -----------------------------------------------------
+    def evict_bucket(self, bucket: Tuple[int, Optional[int]]) -> bool:
+        """Drop ONE bucket variant's compiled executable (ServingFleet's
+        HBM admission eviction).  The bucket recompiles on next use."""
+        bucket = tuple(bucket)
+        with self._stats_lock:
+            sig = self._bucket_sigs.get(bucket)
+        prepared = getattr(self._predictor, "_prepared", None)
+        if sig is None or prepared is None:
+            return False
+        with self._run_lock:
+            dropped = prepared.drop_step(sig)
+        if dropped:
+            with self._stats_lock:
+                self._bucket_used.pop(bucket, None)
+        return dropped
+
+    def bucket_usage(self) -> Dict[Tuple, float]:
+        """{bucket: last-use monotonic time} — the fleet's LRU input."""
+        with self._stats_lock:
+            return dict(self._bucket_used)
 
     # -- observability ----------------------------------------------------
     @staticmethod
@@ -518,11 +947,15 @@ class ServingEngine:
                                   self._padded_tokens)
                 if self._padded_tokens else 0.0,
                 "batch_size_hist": dict(self._batch_hist),
+                "packing": self.config.packing,
             }
         out["compile_count"] = self._predictor.compiled_executables
         with self._cond:
             out["pending"] = len(self._queue)
+            out["inflight"] = self._active
+            out["spurious_wakeups"] = self._spurious_wakeups
         return out
 
 
-__all__ = ["ServingConfig", "ServingEngine", "pad_request"]
+__all__ = ["ServingConfig", "ServingEngine", "pad_request",
+           "pack_requests"]

@@ -1,0 +1,285 @@
+"""Multi-tenant serving with static HBM admission control.
+
+One accelerator serves many models ("as many scenarios as you can
+imagine" — the north star's multi-tenant leg): each tenant is an
+:class:`AnalysisPredictor` + :class:`ServingEngine` pair, and what
+bounds co-residency is device HBM — every bucket variant a tenant warms
+is another executable whose arguments (the model's resident weights,
+counted once per tenant) and working set live on the chip.  The
+reference had no static answer here (its allocator grew until the
+runtime OOM'd); this fleet uses PR 5's static analyzer
+(``framework/memory_analysis.estimate``) as the admission cost model:
+
+* **pricing** — each (model x bucket variant) is priced at the exact
+  bucket feed shapes warmup would compile: ``state_bytes`` (the weights,
+  shared across that model's variants) + the variant's dynamic working
+  set (``peak_bytes - state_bytes``).  A tenant costs
+  ``resident + max(admitted variant dynamics)`` — engines run one
+  micro-batch at a time, so variants of one model share their working
+  set's peak slot;
+* **admission** — ``add_model`` sums the fleet under
+  ``hbm_budget_gb`` BEFORE any compile is attempted; an over-budget
+  model set is rejected with the offending model NAMED and its top live
+  tensors (creation-site anchored) in the error — milliseconds of
+  static analysis instead of an opaque device OOM mid-traffic;
+* **eviction** — bucket variants are individually evictable
+  (:meth:`evict` → ``ServingEngine.evict_bucket`` →
+  ``PreparedStep.drop_step``), and ``add_model(..., evict_lru=True)``
+  auto-evicts least-recently-used variants fleet-wide until the new
+  tenant fits.  An evicted bucket recompiles on next use — admission
+  trades tail latency for co-residency, never correctness.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..framework.errors import InvalidArgumentError
+from .engine import ServingConfig, ServingEngine
+
+_GIB = float(1 << 30)
+_MIB = float(1 << 20)
+
+
+class _Tenant:
+    __slots__ = ("name", "predictor", "engine", "config", "example",
+                 "resident_bytes", "dynamic_bytes", "admitted", "top_live")
+
+    def __init__(self, name, predictor, engine, config, example):
+        self.name = name
+        self.predictor = predictor
+        self.engine = engine
+        self.config = config
+        self.example = example
+        self.resident_bytes = 0
+        # {(batch_bucket, seq_bucket): dynamic working-set bytes}
+        self.dynamic_bytes: Dict[Tuple, int] = {}
+        self.admitted: set = set()
+        self.top_live: List[str] = []      # of the largest variant
+
+    def cost_bytes(self) -> int:
+        dyn = [self.dynamic_bytes[v] for v in self.admitted]
+        return self.resident_bytes + (max(dyn) if dyn else 0)
+
+
+class ServingFleet:
+    """Host multiple served models on one device under an HBM budget.
+
+    ::
+
+        fleet = ServingFleet(hbm_budget_gb=0.5)
+        fleet.add_model("ranker", ranker_dir, cfg, example_feed=ex)
+        fleet.add_model("encoder", enc_dir, cfg2, example_feed=ex2)
+        fut = fleet.submit("ranker", feed)
+
+    ``hbm_budget_gb=None`` falls back to ``flag("hbm_budget_gb")``;
+    0 disables admission control (everything admits)."""
+
+    def __init__(self, hbm_budget_gb: Optional[float] = None,
+                 use_gpu: bool = False):
+        if hbm_budget_gb is None:
+            from ..flags import flag
+            hbm_budget_gb = float(flag("hbm_budget_gb") or 0.0)
+        self.hbm_budget_gb = float(hbm_budget_gb)
+        self._use_gpu = use_gpu
+        self._models: Dict[str, _Tenant] = {}
+        self._lock = threading.Lock()
+
+    # -- pricing ----------------------------------------------------------
+    def _price(self, tenant: _Tenant):
+        """Static per-variant estimates at the exact bucket feed shapes
+        warmup compiles — no trace, no compile."""
+        from ..framework.memory_analysis import estimate
+        engine, cfg = tenant.engine, tenant.config
+        ex = {n: np.asarray(v) for n, v in tenant.example.items()}
+        program = tenant.predictor.program
+        fetch_names = tenant.predictor.get_output_names()
+        combos = [(bb, sb) for bb in cfg.batch_buckets
+                  for sb in (cfg.seq_buckets or (None,))]
+        worst = None
+        for bb, sb in combos:
+            feed = engine._combo_feed(ex, bb, sb)
+            est = estimate(program, feed_shapes=feed,
+                           fetch_names=fetch_names, donate_state=False)
+            tenant.resident_bytes = max(tenant.resident_bytes,
+                                        est.state_bytes)
+            tenant.dynamic_bytes[(bb, sb)] = \
+                max(0, est.peak_bytes - est.state_bytes)
+            if worst is None or est.peak_bytes > worst.peak_bytes:
+                worst = est
+        tenant.top_live = [t.format() for t in worst.top_live] \
+            if worst is not None else []
+        tenant.admitted = set(combos)
+
+    def _total_bytes(self, extra: Optional[_Tenant] = None) -> int:
+        tenants = list(self._models.values())
+        if extra is not None:
+            tenants.append(extra)
+        return sum(t.cost_bytes() for t in tenants)
+
+    def _budget_bytes(self) -> Optional[int]:
+        if not self.hbm_budget_gb or self.hbm_budget_gb <= 0:
+            return None
+        return int(self.hbm_budget_gb * _GIB)
+
+    # -- admission --------------------------------------------------------
+    def add_model(self, name: str, model_dir: Optional[str] = None,
+                  config: Optional[ServingConfig] = None,
+                  example_feed: Optional[Dict[str, Any]] = None,
+                  predictor=None, warmup: bool = True,
+                  evict_lru: bool = False) -> ServingEngine:
+        """Load + admit one model; returns its :class:`ServingEngine`.
+
+        Admission runs BEFORE any compile: the combined fleet estimate
+        over ``hbm_budget_gb`` raises ``InvalidArgumentError`` naming
+        this model and its top live tensors.  ``evict_lru=True`` instead
+        evicts least-recently-used bucket variants fleet-wide until the
+        model fits (raising only if it cannot fit even then).  On admit,
+        ``warmup=True`` AOT-compiles the admitted variants (hitting the
+        persistent cache under ``flag("aot_cache_dir")``)."""
+        with self._lock:
+            if name in self._models:
+                raise InvalidArgumentError(
+                    f"fleet already serves a model named {name!r}")
+            if example_feed is None:
+                raise InvalidArgumentError(
+                    "add_model needs example_feed — admission prices each "
+                    "bucket variant at its exact feed shapes")
+            if predictor is None:
+                if model_dir is None:
+                    raise InvalidArgumentError(
+                        "add_model needs model_dir or a predictor")
+                from ..inference import (AnalysisConfig,
+                                         create_paddle_predictor)
+                acfg = AnalysisConfig(model_dir)
+                if not self._use_gpu:
+                    acfg.disable_gpu()
+                predictor = create_paddle_predictor(acfg)
+            engine = ServingEngine(predictor, config, auto_start=False)
+            tenant = _Tenant(name, predictor, engine, engine.config,
+                             example_feed)
+            self._price(tenant)
+            budget = self._budget_bytes()
+            if budget is not None:
+                if evict_lru:
+                    self._evict_until_fits(tenant, budget)
+                total = self._total_bytes(extra=tenant)
+                if total > budget:
+                    overage = total - budget
+                    lines = "\n".join("    " + t for t in tenant.top_live)
+                    raise InvalidArgumentError(
+                        f"HBM admission rejected model {name!r}: fleet "
+                        f"estimate {total / _MIB:.1f} MiB exceeds "
+                        f"hbm_budget_gb={self.hbm_budget_gb} "
+                        f"({budget / _MIB:.1f} MiB) by "
+                        f"{overage / _MIB:.1f} MiB.  {name!r} costs "
+                        f"{tenant.cost_bytes() / _MIB:.1f} MiB (resident "
+                        f"weights {tenant.resident_bytes / _MIB:.1f} MiB + "
+                        f"largest bucket variant working set); top live "
+                        f"tensors of its largest variant:\n{lines}\n"
+                        f"  evict bucket variants (ServingFleet.evict) or "
+                        f"shrink its bucket grid, then retry")
+            self._models[name] = tenant
+        engine.start()
+        if warmup:
+            engine.warmup(example_feed,
+                          combos=sorted(tenant.admitted))
+        return engine
+
+    def _evict_until_fits(self, tenant: _Tenant, budget: int):
+        """LRU-evict bucket variants fleet-wide (other tenants first,
+        then the candidate's own largest variants) until the candidate
+        fits — the over-budget path of continuous operation."""
+        while self._total_bytes(extra=tenant) > budget:
+            victims: List[Tuple[float, _Tenant, Tuple]] = []
+            for t in self._models.values():
+                if len(t.admitted) <= 1:
+                    continue          # keep every tenant minimally alive
+                usage = t.engine.bucket_usage()
+                for v in t.admitted:
+                    victims.append((usage.get(v, 0.0), t, v))
+            if not victims:
+                # last resort: shrink the CANDIDATE's own grid, largest
+                # dynamic variant first
+                own = sorted(tenant.admitted,
+                             key=lambda v: tenant.dynamic_bytes[v])
+                if len(own) <= 1:
+                    return            # nothing left — caller raises
+                tenant.admitted.discard(own[-1])
+                continue
+            victims.sort(key=lambda x: x[0])
+            _, t, v = victims[0]
+            t.admitted.discard(v)
+            t.engine.evict_bucket(v)
+
+    # -- operations -------------------------------------------------------
+    def evict(self, name: str, bucket: Tuple[int, Optional[int]]) -> bool:
+        """Evict one admitted bucket variant of ``name`` (its executable
+        is dropped; the variant leaves the admission ledger)."""
+        with self._lock:
+            tenant = self._models.get(name)
+            if tenant is None:
+                raise InvalidArgumentError(
+                    f"fleet serves no model named {name!r}; models: "
+                    f"{sorted(self._models)}")
+            bucket = tuple(bucket)
+            if bucket not in tenant.admitted:
+                return False
+            tenant.admitted.discard(bucket)
+        tenant.engine.evict_bucket(bucket)
+        return True
+
+    def submit(self, name: str, feed: Dict[str, Any]):
+        tenant = self._models.get(name)
+        if tenant is None:
+            raise InvalidArgumentError(
+                f"fleet serves no model named {name!r}; models: "
+                f"{sorted(self._models)}")
+        return tenant.engine.submit(feed)
+
+    def engine(self, name: str) -> ServingEngine:
+        return self._models[name].engine
+
+    def models(self) -> List[str]:
+        return sorted(self._models)
+
+    def admission_report(self) -> Dict[str, Any]:
+        """The fleet's HBM ledger — what admission decided and why."""
+        with self._lock:
+            models = {}
+            for name, t in self._models.items():
+                models[name] = {
+                    "resident_mb": round(t.resident_bytes / _MIB, 3),
+                    "cost_mb": round(t.cost_bytes() / _MIB, 3),
+                    "admitted": sorted(str(list(v)) for v in t.admitted),
+                    "variants": {
+                        str(list(v)): round(b / _MIB, 3)
+                        for v, b in sorted(t.dynamic_bytes.items())},
+                }
+            return {
+                "hbm_budget_gb": self.hbm_budget_gb,
+                "total_mb": round(self._total_bytes() / _MIB, 3),
+                "models": models,
+            }
+
+    def stats(self) -> Dict[str, Any]:
+        return {name: t.engine.stats()
+                for name, t in self._models.items()}
+
+    def shutdown(self, drain: bool = True):
+        for t in self._models.values():
+            t.engine.shutdown(drain=drain)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+
+__all__ = ["ServingFleet"]

@@ -498,11 +498,12 @@ def test_profiler_step_breakdown():
     assert bd["prepared::fetch_sync"]["calls"] >= 1
     assert bd["prepared::scope_sync"]["calls"] == 1
     for name, rec in bd.items():
-        if name == "feed_cache":      # counters, not a timed phase
+        if name in ("feed_cache", "aot_cache"):  # counters, not phases
             assert rec["hits"] >= 0 and rec["misses"] >= 0
-            assert rec["capacity"] > 0
             continue
         assert rec["avg_us"] >= 0
+    assert bd["feed_cache"]["capacity"] > 0
+    assert "dir" in bd["aot_cache"]
 
 
 # ---------------------------------------------------------------------------

@@ -12,8 +12,8 @@ echo "== preflight: proglint (static verifier over serialized program +"
 echo "   INFERENCE_PASSES under verify_passes + memory profile/budget gate) =="
 python tools/proglint.py --memory --selftest
 
-echo "== preflight: serve_bench (serving engine parity + bucket compile"
-echo "   bounds on a mixed-shape stream) =="
+echo "== preflight: serve_bench (ragged-packing parity + padding-waste"
+echo "   bound, AOT-cache cold/warm restart, ServingFleet HBM admission) =="
 python tools/serve_bench.py --selftest
 
 echo "== preflight: quant wire-compression census (dp8 BERT bucketed grad"
