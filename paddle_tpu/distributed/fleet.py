@@ -177,6 +177,22 @@ class DistributedStrategy:
         self.quant_configs = {"dtype": "int8", "block_size": 256,
                               "stochastic_rounding": False}
         self.mesh = None              # explicit jax Mesh override
+        # auto-sharding planner (framework/shard_planner.py): search
+        # every legal (data, fsdp, tp) factorization of the device count
+        # pre-compile with the static HBM + wire-cost model, stamp the
+        # winning MeshLayout (ZeRO-3 fsdp rewrite included) and compile
+        # ONLY the winner.  Mutually exclusive with the manual layout
+        # knobs (sharded_update/sharding/tensor_parallel/mesh) — the
+        # planner owns the layout when auto_shard is on.
+        self.auto_shard = False
+        self.auto_shard_configs = {
+            "hbm_budget_gb": None,     # None → flag("hbm_budget_gb")
+            "max_tp": None,            # cap the tp search dimension
+            "min_shard_numel": 2048,   # ZeRO-3 skip threshold
+            "num_devices": None,       # None → jax.device_count()
+            "feed_shapes": None,       # {name: (shape, dtype)} for exact
+            "report_path": None,       # write PLAN_SEARCH json here
+        }
         # execution/build strategies accepted and largely absorbed by XLA
         self.exec_strategy = None
         self.build_strategy = None
@@ -194,6 +210,7 @@ class _Fleet:
         self._origin_program = None
         self._compiled_program = None
         self._mesh = None
+        self._plan = None          # last auto_shard Plan (auditable)
 
     # -- lifecycle -------------------------------------------------------
     def init(self, role_maker: Optional[RoleMakerBase] = None,
@@ -226,6 +243,12 @@ class _Fleet:
     @property
     def mesh(self):
         return self._mesh
+
+    @property
+    def plan(self):
+        """The ranked auto-shard Plan of the last ``auto_shard=True``
+        minimize (framework/shard_planner.py), or None."""
+        return self._plan
 
     # -- host barriers (ref: fleet barrier_worker via GlooWrapper) -------
     @property
@@ -310,6 +333,30 @@ class CollectiveOptimizer:
             # fail at strategy level, not deep in the bucket pass
             from ..ops.quantize_wire import CompressionSpec
             CompressionSpec.from_attr(dict(s.quant_configs or {}))
+        if getattr(s, "auto_shard", False):
+            from ..framework.errors import InvalidArgumentError
+            manual = [name for name in ("sharded_update", "sharding",
+                                        "tensor_parallel")
+                      if getattr(s, name, False)]
+            if manual:
+                raise InvalidArgumentError(
+                    f"DistributedStrategy: auto_shard=True and manual "
+                    f"{'/'.join(name + '=True' for name in manual)} both "
+                    f"claim the sharding layout and cannot compose — the "
+                    f"planner already searches ZeRO/tp configurations; "
+                    f"pick one (drop the manual flag, or set "
+                    f"auto_shard=False to keep the hand-picked layout)")
+            if s.mesh is not None:
+                raise InvalidArgumentError(
+                    "DistributedStrategy: auto_shard=True and an explicit "
+                    "strategy.mesh both pin the device layout and cannot "
+                    "compose — the planner builds the winning mesh itself; "
+                    "pick one (drop strategy.mesh, or set auto_shard=False)")
+            if s.localsgd:
+                raise InvalidArgumentError(
+                    "DistributedStrategy: auto_shard prices per-step grad "
+                    "sync that localsgd removes — the cost model would be "
+                    "wrong; pick one")
         if s.localsgd and s.gradient_merge:
             raise ValueError(
                 "DistributedStrategy: localsgd and gradient_merge both "
@@ -432,10 +479,79 @@ class CollectiveOptimizer:
                 build.allreduce_compress_dtype = "bfloat16"
         return build
 
+    def _minimize_auto(self, loss, startup_program=None,
+                       parameter_list=None, no_grad_set=None):
+        """``strategy.auto_shard`` path: build the plain training
+        program first (backward + update ops, no manual layout), let the
+        planner search (data, fsdp, tp) factorizations statically, stamp
+        the winning MeshLayout onto THIS program (ZeRO-3 rewrite when
+        fsdp > 1 — optimizer accumulators shard along via their stamped
+        dist_attrs), and compile only the winner."""
+        import jax
+        from ..framework.errors import InvalidArgumentError
+        from ..framework.shard_planner import plan_sharding, \
+            stamp_winning_layout
+        from ..flags import flag
+
+        s = self._strategy
+        cfgs = dict(s.auto_shard_configs or {})
+        program = loss.block.program
+        # manual per-param fsdp stamps conflict with the planner exactly
+        # like manual strategy flags do (tp annotations are fine — the
+        # planner searches the tp dimension they declare)
+        from ..framework.mesh_layout import FSDP_AXIS, _flat_axes
+        for p in program.all_parameters():
+            da = getattr(p, "dist_attr", None)
+            if da and FSDP_AXIS in _flat_axes(tuple(da)):
+                raise InvalidArgumentError(
+                    f"DistributedStrategy: auto_shard=True and a manual "
+                    f"per-param dist_attr override on {p.name!r} "
+                    f"({tuple(da)!r}) both claim the {FSDP_AXIS!r} axis "
+                    f"and cannot compose — drop the manual stamp or set "
+                    f"auto_shard=False")
+
+        optimizer = self._compose(self._inner, mesh=None)
+        opt_ops, params_grads = optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+
+        ndev = int(cfgs.get("num_devices") or jax.device_count())
+        budget = cfgs.get("hbm_budget_gb")
+        if budget is None:
+            budget = float(flag("hbm_budget_gb") or 0.0) or None
+        min_numel = int(cfgs.get("min_shard_numel") or 2048)
+        plan = plan_sharding(
+            program, ndev, loss_name=loss.name,
+            feed_shapes=cfgs.get("feed_shapes"),
+            fetch_names=[loss.name], hbm_budget_gb=budget,
+            build_strategy=self._build_strategy(),
+            max_tp=cfgs.get("max_tp"), min_shard_numel=min_numel,
+            module="auto_shard",
+            report_path=cfgs.get("report_path"))
+        layout = stamp_winning_layout(program, plan,
+                                      min_shard_numel=min_numel)
+        fleet._plan = plan
+        fleet._origin_program = program
+        mesh = layout.build_mesh()
+        fleet._mesh = mesh
+        if mesh is not None:
+            from ..framework.compiler import CompiledProgram
+            fleet._compiled_program = CompiledProgram(
+                program).with_mesh(
+                mesh, loss_name=loss.name,
+                batch_axis=layout.batch_axes,
+                build_strategy=self._build_strategy())
+        else:
+            fleet._compiled_program = None
+        return opt_ops, params_grads
+
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         fleet._ensure_init()
         fleet._strategy = self._strategy
+        if getattr(self._strategy, "auto_shard", False):
+            self._validate(self._strategy)
+            return self._minimize_auto(loss, startup_program,
+                                       parameter_list, no_grad_set)
         mesh = self._strategy.mesh
         if mesh is None:
             import jax

@@ -59,6 +59,10 @@ QUANT_NON_SUM = "quant-collective-non-sum"
 QUANT_SMALL_BUCKET = "quant-small-bucket"
 DONATED_VAR_FETCHED = "donated-var-fetched"
 READ_AFTER_DONATE = "read-after-donate"
+# named-axis layout soundness (the MeshLayout/ShardSpec contract —
+# framework/mesh_layout.py, stamped by the auto-shard planner)
+SHARD_LAYOUT_UNKNOWN_AXIS = "shard-layout-unknown-axis"
+SHARD_LAYOUT_COLLECTIVE_MISMATCH = "shard-layout-collective-mismatch"
 UNSPECCED_OP = "unspecced-op"
 PASS_INVARIANT = "pass-invariant"
 # inference/serving profile (a SERVED program must be a pure read-only
@@ -649,6 +653,105 @@ def verify_distributed(program: Program, result: VerifyResult,
                 op, block.idx, idx)
 
 
+#: gathers whose INPUT must be sharded over the gather axis (the op
+#: rebuilds a full tensor from per-rank shards — feeding it a var whose
+#: stamped spec does not cover the axis means the layout and the
+#: collective schedule disagree)
+_SHARD_GATHER_OPS = frozenset({"fsdp_all_gather", "zero_all_gather"})
+#: summing reductions whose reduce axes must be DISJOINT from the
+#: payload's sharded axes (reducing over an axis the payload is already
+#: sharded on double-counts shards that hold different slices)
+_SHARD_REDUCE_OPS = frozenset({
+    "c_allreduce_sum", "c_fused_allreduce_sum", "c_quant_allreduce_sum",
+    "c_fused_quant_allreduce_sum", "zero_reduce_scatter",
+    "quant_reduce_scatter", "c_reducescatter", "mp_allreduce_sum"})
+
+
+def verify_shard_layout(program: Program, result: VerifyResult):
+    """Named-axis layout soundness over one program (the shard-layout-*
+    diagnostic codes):
+
+    * ``shard-layout-unknown-axis`` — a var's stamped ``dist_attr``
+      references a mesh axis that does not exist in the program's
+      :class:`~.mesh_layout.MeshLayout` (checked only when a layout is
+      stamped — hand-annotated programs without a layout keep the old
+      dangling-axes-replicate behavior);
+    * ``shard-layout-collective-mismatch`` — a per-var spec disagrees
+      with an op's collective schedule: a shard gather
+      (``fsdp_all_gather``/``zero_all_gather``) whose input is NOT
+      sharded over the gather axis, or a summing reduction whose reduce
+      axes intersect the payload's sharded axes (each rank holds a
+      DIFFERENT slice — summing them is not a replica reduction).
+
+    Diagnostics are anchored to the op's recorded creation site (for
+    unknown axes: the first op touching the var)."""
+    from .mesh_layout import _flat_axes
+
+    block = program.global_block()
+    layout = getattr(program, "_mesh_layout", None)
+
+    if layout is not None:
+        layout_axes = set(layout.axis_names)
+        for name, v in block.vars.items():
+            da = tuple(getattr(v, "dist_attr", None) or ())
+            bad = [a for a in _flat_axes(da) if a not in layout_axes]
+            if not bad:
+                continue
+            idx, op = next(
+                ((i, op) for i, op in enumerate(block.ops)
+                 if name in op.input_names() or name in op.output_names()),
+                (-1, None))
+            result.add(
+                "error", SHARD_LAYOUT_UNKNOWN_AXIS,
+                f"var {name!r} dist_attr {tuple(da)!r} references mesh "
+                f"axis(es) {bad} that do not exist in the program's "
+                f"MeshLayout {dict(layout.sizes)} — the stamp would "
+                f"silently replicate on the real mesh; fix the spec or "
+                f"the layout",
+                op, block.idx, idx)
+
+    for idx, op in enumerate(block.ops):
+        axes = op.attrs.get("_axis_name") or ()
+        op_axes = set(_flat_axes(axes))
+        if not op_axes:
+            continue
+        if op.type in _SHARD_GATHER_OPS:
+            for n in op.input_names():
+                v = block._find_var_recursive(n)
+                da = set(_flat_axes(tuple(
+                    getattr(v, "dist_attr", None) or ()))) \
+                    if v is not None else set()
+                missing = op_axes - da
+                if missing:
+                    result.add(
+                        "error", SHARD_LAYOUT_COLLECTIVE_MISMATCH,
+                        f"shard gather {op.type!r} rebuilds {n!r} over "
+                        f"axis(es) {sorted(missing)} but the var's "
+                        f"dist_attr {tuple(getattr(v, 'dist_attr', None) or ()) if v is not None else None!r} "
+                        f"does not shard over them — gathering a "
+                        f"replicated tensor would tile duplicate copies",
+                        op, block.idx, idx)
+        elif op.type in _SHARD_REDUCE_OPS:
+            for n in op.input_names():
+                v = block._find_var_recursive(n)
+                if v is None:
+                    continue
+                da = set(_flat_axes(tuple(
+                    getattr(v, "dist_attr", None) or ())))
+                overlap = op_axes & da
+                if overlap:
+                    result.add(
+                        "error", SHARD_LAYOUT_COLLECTIVE_MISMATCH,
+                        f"collective {op.type!r} sum-reduces {n!r} over "
+                        f"axis(es) {sorted(overlap)} that its dist_attr "
+                        f"{tuple(getattr(v, 'dist_attr', None) or ())!r} "
+                        f"already shards — each rank holds a DIFFERENT "
+                        f"slice there, so the reduction double-counts; "
+                        f"reduce only over the axes the payload is "
+                        f"replicated on",
+                        op, block.idx, idx)
+
+
 def collective_signature(program: Program) -> List[Tuple]:
     """The ordered collective schedule of a program: (op type, reduce
     axes, ring id, operand names) per collective op.  Operand names are
@@ -713,6 +816,7 @@ def verify_program(program: Program, startup: Optional[Program] = None,
         verify_startup_agreement(program, startup, result)
     infer_shapes(program, result, feed_names)
     verify_distributed(program, result, fetch_names)
+    verify_shard_layout(program, result)
     return result
 
 
@@ -906,10 +1010,11 @@ def check_pass_invariants(program: Program, pass_name: str,
 __all__ = [
     "Diagnostic", "VerifyResult", "PassInvariantError",
     "QUANT_COLLECTIVE_INTEGER", "QUANT_NON_SUM", "QUANT_SMALL_BUCKET",
+    "SHARD_LAYOUT_UNKNOWN_AXIS", "SHARD_LAYOUT_COLLECTIVE_MISMATCH",
     "verify_program", "verify_inference", "verify_cached",
     "clear_verify_cache",
     "verify_structure", "verify_startup_agreement", "infer_shapes",
-    "verify_distributed", "collective_signature",
+    "verify_distributed", "verify_shard_layout", "collective_signature",
     "check_collective_consistency", "pass_snapshot",
     "check_pass_invariants", "op_reads_recursive", "VERIFY_STATS",
 ]

@@ -114,7 +114,10 @@ class CompiledProgram:
 
         strategy = build_strategy or BuildStrategy()
         if nranks > 1 and loss_name is not None:
-            self._insert_grad_allreduce(strategy, nranks)
+            insert_grad_sync(self._program, strategy, nranks,
+                             (self._batch_axis or "dp",),
+                             axis_sizes=dict(zip(mesh.axis_names,
+                                                 mesh.devices.shape)))
         if strategy.fuse_elewise_add_act_ops:
             # ref: build_strategy.cc:51 runs fuse_elewise_add_act_pass in
             # the training pipeline; deferred to the executor's first
@@ -124,191 +127,49 @@ class CompiledProgram:
         return self
 
     def with_mesh(self, mesh, loss_name: Optional[str] = None,
-                  batch_axis: str = "dp", seq_axis: Optional[str] = None,
+                  batch_axis="dp", seq_axis: Optional[str] = None,
                   feed_specs=None,
                   build_strategy: Optional[BuildStrategy] = None):
-        """Full N-D mesh compilation: dp (batch) + tp (param shards, from
+        """Full N-D mesh compilation: dp (batch) + fsdp (ZeRO-3 param
+        shards — the batch shards over dp×fsdp, so ``batch_axis`` may be
+        a TUPLE of axis names) + tp (param shards, from
         Variable.dist_attr) + sp (sequence shards via feed_specs/ring
         attention) + pp (pipeline stages).  Generalises with_data_parallel
         — the analog of composing the reference's fleet DistributedStrategy
         options (ref: incubate/fleet/collective/__init__.py:343) into one
         declarative layout."""
+        from .mesh_layout import _flat_axes
         self._mesh = mesh
         self._axis_names = tuple(mesh.axis_names)
-        self._batch_axis = batch_axis if batch_axis in mesh.axis_names \
-            else None
+        batch_axes = tuple(a for a in _flat_axes(batch_axis)
+                           if a in mesh.axis_names)
+        self._batch_axis = (batch_axes[0] if len(batch_axes) == 1
+                            else batch_axes) if batch_axes else None
         self._seq_axis = seq_axis if seq_axis and seq_axis in mesh.axis_names \
             else None
         self._feed_specs = dict(feed_specs or {})
         self._loss_name = loss_name
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-        # grads are partial over BOTH dp (batch shards) and sp (token
-        # shards) — reduce over every axis the loss tokens are sharded on
-        reduce_axes = tuple(a for a in (self._batch_axis, self._seq_axis)
+        # grads are partial over dp AND fsdp (both shard the batch) AND
+        # sp (token shards) — reduce over every axis the loss tokens are
+        # sharded on
+        reduce_axes = tuple(a for a in batch_axes + (self._seq_axis,)
                             if a and sizes.get(a, 1) > 1)
         if loss_name is not None and reduce_axes:
             n = int(np.prod([sizes[a] for a in reduce_axes]))
-            self._insert_grad_allreduce(build_strategy or BuildStrategy(),
-                                        n, axis_name=reduce_axes)
+            insert_grad_sync(self._program,
+                             build_strategy or BuildStrategy(), n,
+                             reduce_axes, axis_sizes=sizes)
         return self
 
-    _DTYPE_BYTES = {"float64": 8, "int64": 8, "float32": 4, "int32": 4,
-                    "bfloat16": 2, "float16": 2, "int16": 2, "int8": 1,
-                    "uint8": 1, "bool": 1}
-
-    def _qscale_blocks(self, numel, p_axes, qspec):
-        """Static length of a quantized bucket's stage-2 scale tensor:
-        the op pads the flat payload so every rank of the LAST reduce
-        axis owns whole blocks; one float32 scale per block.  -1 when
-        the mesh (and so the pad) is unknown at insertion time."""
-        sizes = {}
-        if self._mesh is not None:
-            sizes = dict(zip(self._mesh.axis_names,
-                             self._mesh.devices.shape))
-        n = int(sizes.get(p_axes[-1], 0) or 0)
-        if n <= 0:
-            return -1
-        chunk = n * qspec.block_size
-        padded = -(-int(numel) // chunk) * chunk
-        return padded // qspec.block_size
-
+    # retained for back-compat with callers that used the private method
     def _insert_grad_allreduce(self, strategy, nranks, axis_name=None):
-        """Insert the per-step gradient sync after the backward op — the
-        rewrite of the reference's GradAllReduce transpiler
-        (transpiler/collective.py:190-226) minus the stream-sync ops XLA
-        makes unnecessary.
-
-        Two shapes: per-leaf ``scale`` + ``c_allreduce_sum`` (the default,
-        one collective per gradient), or — with
-        ``strategy.fuse_all_reduce_ops`` — bucketed ``c_fused_allreduce_sum``
-        ops (ref: details/fused_all_reduce_op_handle.cc; BuildStrategy
-        fuse_all_reduce_ops + fuse_grad_size_in_MB), which coalesce the
-        grads into ≤N flat buckets partitioned by (dtype, reduce-axes) and
-        capped at ``fuse_grad_size_in_MB`` each.  The mean-loss 1/n scale
-        folds into the fused op, so a bucket of k grads replaces 2k ops
-        with one."""
-        block = self._program.global_block()
-        bw_idx = next((i for i, op in enumerate(block.ops)
-                       if op.type == "backward"), None)
-        if bw_idx is None:
-            return
-        bw = block.ops[bw_idx]
-        if bw.attrs.get("_allreduce_inserted"):
-            return
-        bw.attrs["_allreduce_inserted"] = True
-        scale_strategy = strategy.gradient_scale_strategy
-        need_scale = scale_strategy == \
-            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
-        compress = getattr(strategy, "allreduce_compress_dtype", None)
-        from ..ops.quantize_wire import CompressionSpec
-        qspec = CompressionSpec.from_attr(
-            getattr(strategy, "allreduce_quant_spec", None))
-        if qspec is not None and qspec.dtype == "bfloat16":
-            # the bf16 tier IS the legacy cast path — route it there
-            compress, qspec = "bfloat16", None
-        insert_at = bw_idx + 1
-        all_axes = axis_name if isinstance(axis_name, (tuple, list)) else \
+        axes = axis_name if isinstance(axis_name, (tuple, list)) else \
             (axis_name or self._batch_axis or "dp",)
-
-        leaves = []          # (grad_name, p_axes, dtype, nbytes)
-        for pname in bw.attrs["param_names"]:
-            pvar = block._find_var_recursive(pname)
-            if pvar is not None and getattr(pvar, "is_distributed", False):
-                continue  # ref: collective.py:226 skips distributed params
-            # a param sharded over a reduce axis (e.g. MoE experts over the
-            # batch axis) already receives its full gradient through the
-            # transposed collective — reduce only over the OTHER axes, but
-            # keep the mean-loss 1/n scale, which is per-token not per-axis
-            da = tuple(getattr(pvar, "dist_attr", None) or ())
-            p_axes = tuple(a for a in all_axes if a not in da)
-            dtype = str(getattr(pvar, "dtype", "float32") or "float32")
-            numel = int(abs(np.prod(pvar.shape))) if pvar is not None and \
-                len(tuple(pvar.shape)) else 1
-            nbytes = numel * self._DTYPE_BYTES.get(dtype, 4)
-            leaves.append((grad_var_name(pname), p_axes, dtype, nbytes))
-
-        _FLOAT_DTYPES = ("float32", "float64", "float16", "bfloat16")
-
-        if not getattr(strategy, "fuse_all_reduce_ops", False):
-            for g, p_axes, dtype, _ in leaves:
-                if need_scale:
-                    block._insert_op(insert_at, type="scale",
-                                     inputs={"X": [g]}, outputs={"Out": [g]},
-                                     attrs={"scale": 1.0 / nranks})
-                    insert_at += 1
-                if p_axes:
-                    attrs = {"ring_id": 0,
-                             "_axis_name": tuple(p_axes)
-                             if len(p_axes) > 1 else p_axes[0]}
-                    op_type = "c_allreduce_sum"
-                    if qspec is not None and dtype in _FLOAT_DTYPES:
-                        op_type = "c_quant_allreduce_sum"
-                        attrs["quant_spec"] = qspec.to_attr()
-                    elif compress:
-                        attrs["compress_dtype"] = compress
-                    block._insert_op(insert_at, type=op_type,
-                                     inputs={"X": [g]}, outputs={"Out": [g]},
-                                     attrs=attrs)
-                    insert_at += 1
-            return
-
-        # -- bucketed path ------------------------------------------------
-        cap_mb = getattr(strategy, "fuse_grad_size_in_MB", 32) or 0
-        cap = int(cap_mb * (1 << 20)) if cap_mb > 0 else None
-        groups = {}          # (dtype, p_axes) -> list of buckets
-        order = []
-        for g, p_axes, dtype, nbytes in leaves:
-            key = (dtype, p_axes)
-            if key not in groups:
-                groups[key] = [([], 0)]
-                order.append(key)
-            names, size = groups[key][-1]
-            if names and cap is not None and size + nbytes > cap:
-                groups[key].append(([g], nbytes))
-            else:
-                groups[key][-1] = (names + [g], size + nbytes)
-        for key in order:
-            dtype, p_axes = key
-            for names, bucket_bytes in groups[key]:
-                if not p_axes:
-                    # nothing to reduce over (fully sharded param): the
-                    # mean-scale still applies, per leaf
-                    if need_scale:
-                        for g in names:
-                            block._insert_op(
-                                insert_at, type="scale",
-                                inputs={"X": [g]}, outputs={"Out": [g]},
-                                attrs={"scale": 1.0 / nranks})
-                            insert_at += 1
-                    continue
-                attrs = {"ring_id": 0,
-                         "_axis_name": tuple(p_axes)
-                         if len(p_axes) > 1 else p_axes[0]}
-                if need_scale:
-                    attrs["scale"] = 1.0 / nranks
-                op_type = "c_fused_allreduce_sum"
-                outputs = {"Out": list(names)}
-                if qspec is not None and dtype in _FLOAT_DTYPES:
-                    # quantized bucket: the per-bucket stage-2 scale
-                    # tensor rides alongside the payload — declare it as
-                    # a real var so the static layer (memory analyzer,
-                    # census readers) prices the scales, not just the
-                    # int payload
-                    op_type = "c_fused_quant_allreduce_sum"
-                    attrs["quant_spec"] = qspec.to_attr()
-                    numel = bucket_bytes // self._DTYPE_BYTES.get(dtype, 4)
-                    sv = block.create_var(
-                        name=f"{names[0]}@quant_scale",
-                        shape=(self._qscale_blocks(numel, p_axes, qspec),),
-                        dtype="float32")
-                    outputs["QScale"] = [sv.name]
-                elif compress:
-                    attrs["compress_dtype"] = compress
-                block._insert_op(insert_at, type=op_type,
-                                 inputs={"X": list(names)},
-                                 outputs=outputs,
-                                 attrs=attrs)
-                insert_at += 1
+        sizes = dict(zip(self._mesh.axis_names, self._mesh.devices.shape)) \
+            if self._mesh is not None else None
+        insert_grad_sync(self._program, strategy, nranks, axes,
+                         axis_sizes=sizes)
 
     # retained pass-variant clones (one per fetch list) — bounds memory
     # for fetch-list-churny eval loops while keeping the hot lists cached
@@ -385,3 +246,175 @@ class CompiledProgram:
     # pass-through conveniences so CompiledProgram quacks like Program
     def __getattr__(self, item):
         return getattr(self._program, item)
+
+
+_DTYPE_BYTES = {"float64": 8, "int64": 8, "float32": 4, "int32": 4,
+                "bfloat16": 2, "float16": 2, "int16": 2, "int8": 1,
+                "uint8": 1, "bool": 1}
+
+
+def _qscale_blocks(numel, p_axes, qspec, axis_sizes):
+    """Static length of a quantized bucket's stage-2 scale tensor: the
+    op pads the flat payload so every rank of the LAST reduce axis owns
+    whole blocks; one float32 scale per block.  -1 when the mesh (and so
+    the pad) is unknown at insertion time."""
+    n = int((axis_sizes or {}).get(p_axes[-1], 0) or 0)
+    if n <= 0:
+        return -1
+    chunk = n * qspec.block_size
+    padded = -(-int(numel) // chunk) * chunk
+    return padded // qspec.block_size
+
+
+def insert_grad_sync(program: Program, strategy, nranks, reduce_axes,
+                     axis_sizes=None):
+    """Insert the per-step gradient sync after the backward op — the
+    rewrite of the reference's GradAllReduce transpiler
+    (transpiler/collective.py:190-226) minus the stream-sync ops XLA
+    makes unnecessary.
+
+    Module-level and device-free (``axis_sizes`` is a plain
+    {axis: size} dict) so the shard planner can stamp candidate clones
+    without building meshes; :class:`CompiledProgram` calls it from
+    ``with_data_parallel``/``with_mesh``.
+
+    Two shapes: per-leaf ``scale`` + ``c_allreduce_sum`` (the default,
+    one collective per gradient), or — with
+    ``strategy.fuse_all_reduce_ops`` — bucketed ``c_fused_allreduce_sum``
+    ops (ref: details/fused_all_reduce_op_handle.cc; BuildStrategy
+    fuse_all_reduce_ops + fuse_grad_size_in_MB), which coalesce the
+    grads into ≤N flat buckets partitioned by (dtype, reduce-axes) and
+    capped at ``fuse_grad_size_in_MB`` each.  The mean-loss 1/n scale
+    folds into the fused op, so a bucket of k grads replaces 2k ops
+    with one.
+
+    A param sharded over some axes already (``dist_attr`` — tp splits,
+    MoE experts, ZeRO-3 fsdp shards whose gradients arrive pre-reduced
+    through the transposed ``fsdp_all_gather``) reduces only over the
+    REMAINING axes; the mean-loss 1/n scale is per-token and always
+    applies at full ``nranks``."""
+    from .mesh_layout import _flat_axes
+
+    block = program.global_block()
+    bw_idx = next((i for i, op in enumerate(block.ops)
+                   if op.type == "backward"), None)
+    if bw_idx is None:
+        return
+    bw = block.ops[bw_idx]
+    if bw.attrs.get("_allreduce_inserted"):
+        return
+    bw.attrs["_allreduce_inserted"] = True
+    scale_strategy = strategy.gradient_scale_strategy
+    need_scale = scale_strategy == \
+        BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+    compress = getattr(strategy, "allreduce_compress_dtype", None)
+    from ..ops.quantize_wire import CompressionSpec
+    qspec = CompressionSpec.from_attr(
+        getattr(strategy, "allreduce_quant_spec", None))
+    if qspec is not None and qspec.dtype == "bfloat16":
+        # the bf16 tier IS the legacy cast path — route it there
+        compress, qspec = "bfloat16", None
+    insert_at = bw_idx + 1
+    all_axes = tuple(reduce_axes) if isinstance(reduce_axes, (tuple, list)) \
+        else (reduce_axes or "dp",)
+
+    leaves = []          # (grad_name, p_axes, dtype, nbytes)
+    for pname in bw.attrs["param_names"]:
+        pvar = block._find_var_recursive(pname)
+        if pvar is not None and getattr(pvar, "is_distributed", False):
+            continue  # ref: collective.py:226 skips distributed params
+        # a param sharded over a reduce axis (e.g. MoE experts over the
+        # batch axis) already receives its full gradient through the
+        # transposed collective — reduce only over the OTHER axes, but
+        # keep the mean-loss 1/n scale, which is per-token not per-axis
+        da = _flat_axes(tuple(getattr(pvar, "dist_attr", None) or ()))
+        p_axes = tuple(a for a in all_axes if a not in da)
+        dtype = str(getattr(pvar, "dtype", "float32") or "float32")
+        numel = int(abs(np.prod(pvar.shape))) if pvar is not None and \
+            len(tuple(pvar.shape)) else 1
+        nbytes = numel * _DTYPE_BYTES.get(dtype, 4)
+        leaves.append((grad_var_name(pname), p_axes, dtype, nbytes))
+
+    _FLOAT_DTYPES = ("float32", "float64", "float16", "bfloat16")
+
+    if not getattr(strategy, "fuse_all_reduce_ops", False):
+        for g, p_axes, dtype, _ in leaves:
+            if need_scale:
+                block._insert_op(insert_at, type="scale",
+                                 inputs={"X": [g]}, outputs={"Out": [g]},
+                                 attrs={"scale": 1.0 / nranks})
+                insert_at += 1
+            if p_axes:
+                attrs = {"ring_id": 0,
+                         "_axis_name": tuple(p_axes)
+                         if len(p_axes) > 1 else p_axes[0]}
+                op_type = "c_allreduce_sum"
+                if qspec is not None and dtype in _FLOAT_DTYPES:
+                    op_type = "c_quant_allreduce_sum"
+                    attrs["quant_spec"] = qspec.to_attr()
+                elif compress:
+                    attrs["compress_dtype"] = compress
+                block._insert_op(insert_at, type=op_type,
+                                 inputs={"X": [g]}, outputs={"Out": [g]},
+                                 attrs=attrs)
+                insert_at += 1
+        return
+
+    # -- bucketed path ------------------------------------------------
+    cap_mb = getattr(strategy, "fuse_grad_size_in_MB", 32) or 0
+    cap = int(cap_mb * (1 << 20)) if cap_mb > 0 else None
+    groups = {}          # (dtype, p_axes) -> list of buckets
+    order = []
+    for g, p_axes, dtype, nbytes in leaves:
+        key = (dtype, p_axes)
+        if key not in groups:
+            groups[key] = [([], 0)]
+            order.append(key)
+        names, size = groups[key][-1]
+        if names and cap is not None and size + nbytes > cap:
+            groups[key].append(([g], nbytes))
+        else:
+            groups[key][-1] = (names + [g], size + nbytes)
+    for key in order:
+        dtype, p_axes = key
+        for names, bucket_bytes in groups[key]:
+            if not p_axes:
+                # nothing to reduce over (fully sharded param): the
+                # mean-scale still applies, per leaf
+                if need_scale:
+                    for g in names:
+                        block._insert_op(
+                            insert_at, type="scale",
+                            inputs={"X": [g]}, outputs={"Out": [g]},
+                            attrs={"scale": 1.0 / nranks})
+                        insert_at += 1
+                continue
+            attrs = {"ring_id": 0,
+                     "_axis_name": tuple(p_axes)
+                     if len(p_axes) > 1 else p_axes[0]}
+            if need_scale:
+                attrs["scale"] = 1.0 / nranks
+            op_type = "c_fused_allreduce_sum"
+            outputs = {"Out": list(names)}
+            if qspec is not None and dtype in _FLOAT_DTYPES:
+                # quantized bucket: the per-bucket stage-2 scale
+                # tensor rides alongside the payload — declare it as
+                # a real var so the static layer (memory analyzer,
+                # census readers) prices the scales, not just the
+                # int payload
+                op_type = "c_fused_quant_allreduce_sum"
+                attrs["quant_spec"] = qspec.to_attr()
+                numel = bucket_bytes // _DTYPE_BYTES.get(dtype, 4)
+                sv = block.create_var(
+                    name=f"{names[0]}@quant_scale",
+                    shape=(_qscale_blocks(numel, p_axes, qspec,
+                                          axis_sizes),),
+                    dtype="float32")
+                outputs["QScale"] = [sv.name]
+            elif compress:
+                attrs["compress_dtype"] = compress
+            block._insert_op(insert_at, type=op_type,
+                             inputs={"X": list(names)},
+                             outputs=outputs,
+                             attrs=attrs)
+            insert_at += 1

@@ -90,6 +90,25 @@ class Variable:
         # Optional jax.sharding.PartitionSpec-like annotation used by the
         # distributed lowering (parallel/); None means replicated/auto.
         self.sharding = None
+        self._dist_attr = None
+
+    @property
+    def dist_attr(self):
+        """Distributed layout of this var: a canonical
+        :class:`~.mesh_layout.ShardSpec` (PartitionSpec over named mesh
+        axes), or None for replicated/auto.  The setter coerces the
+        legacy bare-tuple spelling (``w.dist_attr = (None, "tp")``) —
+        ShardSpec subclasses tuple, so every old consumer keeps
+        working."""
+        d = self.__dict__
+        if "_dist_attr" in d:
+            return d["_dist_attr"]
+        return d.get("dist_attr")      # pre-property pickles
+
+    @dist_attr.setter
+    def dist_attr(self, value):
+        from .mesh_layout import ShardSpec
+        self.__dict__["_dist_attr"] = ShardSpec.coerce(value)
 
     # -- python sugar mirroring the reference's Variable operators --------
     def _elementwise(self, other, op):
@@ -379,6 +398,10 @@ class Program:
         # distributed annotations filled by parallel/ transforms
         self._mesh = None
         self._dist_attrs: Dict[str, Any] = {}
+        # canonical named-axis layout (mesh_layout.MeshLayout) stamped by
+        # the shard planner / fleet; carries the mesh axis SIZES so a
+        # saved program reloads with its layout intact
+        self._mesh_layout = None
 
     def __setstate__(self, state):
         # unpickled programs get a fresh cache identity — the serialized
@@ -389,6 +412,7 @@ class Program:
         self.__dict__.setdefault('_is_test', False)
         self.__dict__.setdefault('_mesh', None)
         self.__dict__.setdefault('_dist_attrs', {})
+        self.__dict__.setdefault('_mesh_layout', None)
 
     # -- structure -------------------------------------------------------
     def global_block(self) -> Block:
@@ -432,6 +456,7 @@ class Program:
         p._is_test = for_test or self._is_test
         p._mesh = self._mesh
         p._dist_attrs = dict(self._dist_attrs)
+        p._mesh_layout = self._mesh_layout
         # two passes so sub-block attrs (control-flow ops) can be remapped to
         # the cloned program's blocks by index (the reference stores sub-block
         # *indices* in OpDesc attrs for the same reason, ref:

@@ -256,13 +256,22 @@ def _merge_fetch(v, name, block, ctx, batch_axis, replicated_names,
     var = block._find_var_recursive(name)
     if var is not None and var.persistable:
         return v
-    reduce_axes = tuple(a for a in (batch_axis, seq_axis)
-                        if a and a in ctx.axis_names)
+    # batch_axis may be a TUPLE of axes (the planner's dp×fsdp layout
+    # shards the batch over both) — flatten before membership checks
+    from .mesh_layout import _flat_axes
+    batch_axes = tuple(a for a in _flat_axes(batch_axis)
+                       if a in ctx.axis_names)
+    reduce_axes = batch_axes + tuple(
+        a for a in (seq_axis,) if a and a in ctx.axis_names)
+    if not reduce_axes:
+        return v
     if getattr(v, "ndim", 0) == 0:
         if jnp.issubdtype(v.dtype, jnp.integer):
             return jax.lax.psum(v, reduce_axes)
         return jax.lax.pmean(v, reduce_axes)
-    return jax.lax.all_gather(v, batch_axis, axis=0, tiled=True)
+    if not batch_axes:
+        return v
+    return jax.lax.all_gather(v, batch_axes, axis=0, tiled=True)
 
 
 def _replicated_var_names(ops, bw_idx):
@@ -1373,7 +1382,8 @@ class Executor:
             # curand seed in the reference) — but NOT across tp/pp, where
             # activations are replicated and masks must agree; the carried
             # key advances from the replicated base so state stays replicated
-            fold_axes = [a for a in (batch_axis, seq_axis)
+            from .mesh_layout import _flat_axes
+            fold_axes = [a for a in _flat_axes(batch_axis) + (seq_axis,)
                          if a and a in axis_names]
             if mesh is not None and fold_axes:
                 shard_key = rng_key
@@ -1521,18 +1531,19 @@ class Executor:
         from jax.sharding import PartitionSpec as P
 
         def var_spec(name):
+            from .mesh_layout import ShardSpec
             for b in program.blocks:
                 v = b.vars.get(name)
                 if v is not None:
-                    da = getattr(v, "dist_attr", None)
+                    da = ShardSpec.coerce(getattr(v, "dist_attr", None))
                     if da:
                         # axes absent from THIS mesh replicate: a program
                         # annotated for tp may run on an sp/dp-only mesh
                         # (the collectives degrade to identity the same
                         # way), so dangling axis names must not leak into
-                        # shard_map specs
-                        return P(*(a if a in axis_names else None
-                                   for a in da))
+                        # shard_map specs.  Entries may be axis TUPLES
+                        # (one dim over fsdp×tp) — filtered member-wise.
+                        return P(*da.mesh_entries(axis_names))
                     return P()
             return P()
 

@@ -1,0 +1,195 @@
+"""ZeRO-3 / full FSDP: parameter sharding over the ``fsdp`` mesh axis
+with all-gather-on-demand and discard-after-last-use.
+
+ZeRO-1 (``ShardedUpdateOptimizer``) shards only the optimizer STATE —
+every device still holds every parameter.  This pass shards the
+parameters themselves, which is what makes larger-than-HBM models
+trainable at all:
+
+* each trainable parameter's resident buffer becomes its 1/fsdp shard
+  (``dist_attr`` stamped with the fsdp axis on the shard dim — the
+  executor's shard_map hands each device only its slice, and the
+  donated state round-trip keeps it that way step over step);
+* a ``fsdp_all_gather`` op is inserted at the parameter's FIRST forward
+  use (placed with the PR 5 liveness pass), producing a transient full
+  copy that every consumer is rewritten to read; the temp dies at its
+  last use (XLA frees at last-use), so full parameters exist only
+  inside their layer's window — "windowed" gathers, never a resident
+  full copy;
+* no explicit reduce-scatter is needed: ``lax.all_gather``'s autodiff
+  TRANSPOSE is ``psum_scatter`` over the same axis, so the backward
+  sweep delivers each device exactly its shard's gradient, already
+  summed over fsdp.  The remaining data-axis reduction rides the
+  existing grad-sync machinery (fused buckets / quantized collectives —
+  ``compiler.insert_grad_sync`` skips the fsdp axis for stamped params
+  via their ``dist_attr``, exactly like tp/MoE params);
+* optimizer accumulators shaped like the parameter are stamped with the
+  same spec, so Adam moments etc. shard along with it (ZeRO-1's saving
+  composes structurally: with every param fsdp-sharded there is nothing
+  left for ZeRO-1 to shard).
+
+The batch shards over data×fsdp (both are data axes — the
+``MeshLayout.batch_axes`` contract), so an fsdp-only layout is plain
+ZeRO-3 and a data×fsdp grid is hierarchical (HSDP-style) sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .core import Block, Program, grad_var_name
+from .mesh_layout import MeshLayout, ShardSpec
+
+#: params below this element count stay replicated — a [hidden]-sized
+#: layer-norm scale costs more in gather latency than its shard saves
+DEFAULT_MIN_SHARD_NUMEL = 2048
+
+GATHER_SUFFIX = "@fsdp_full"
+
+
+def _shard_dim(shape: Tuple[int, ...], fsdp: int) -> Optional[int]:
+    """First dim evenly divisible by the fsdp degree (dim 0 preferred —
+    the SpecLayout convention for embeddings/projections)."""
+    for d, s in enumerate(shape):
+        if int(s) >= fsdp and int(s) % fsdp == 0:
+            return d
+    return None
+
+
+def _rename_inputs(op, old: str, new: str):
+    """Rewrite every read of ``old`` to ``new`` on ``op``, recursing
+    into control-flow sub-blocks (a param read inside a while body is
+    rewritten there; the gather itself stays in the parent block — a
+    collective inside divergent control flow would deadlock)."""
+    for slot, names in op.inputs.items():
+        op.inputs[slot] = [new if n == old else n for n in names]
+    for v in op.attrs.values():
+        subs = v if isinstance(v, (list, tuple)) else (v,)
+        for sub in subs:
+            if isinstance(sub, Block):
+                for sub_op in sub.ops:
+                    _rename_inputs(sub_op, old, new)
+
+
+def apply_fsdp_sharding(program: Program, layout: MeshLayout,
+                        min_shard_numel: int = DEFAULT_MIN_SHARD_NUMEL
+                        ) -> Dict[str, Any]:
+    """Rewrite ``program`` in place for ZeRO-3 parameter sharding over
+    ``layout``'s fsdp axis.  Idempotent per program; call AFTER
+    ``optimizer.minimize`` (the backward op and update ops must exist)
+    and BEFORE grad-sync insertion (``CompiledProgram.with_mesh`` /
+    ``insert_grad_sync``, which reads the stamped ``dist_attr`` to skip
+    the fsdp axis).
+
+    Returns the rewrite report: per-param shard dim, gather window
+    ``(first_use, last_use)`` from the liveness pass, and the skip
+    census (too small / indivisible / already sharded).
+    """
+    from .analysis import op_reads_recursive
+    from .memory_analysis import block_liveness
+
+    fsdp = layout.fsdp
+    axis = layout.fsdp_axis
+    report: Dict[str, Any] = {"fsdp_axis": axis, "fsdp_degree": fsdp,
+                              "sharded": [], "skipped": []}
+    if fsdp <= 1:
+        return report
+    block = program.global_block()
+    if any(op.type == "fsdp_all_gather" for op in block.ops):
+        return report                      # already rewritten
+    bw_idx = next((i for i, op in enumerate(block.ops)
+                   if op.type == "backward"), None)
+    if bw_idx is None:
+        raise ValueError(
+            "apply_fsdp_sharding: program has no backward op — ZeRO-3 "
+            "shards TRAINING programs (run optimizer.minimize first)")
+
+    # liveness over the unmodified block: first/last forward use per
+    # param (sub-block reads count at the parent op, so a gather lands
+    # before the control-flow op, outside divergent control flow)
+    liveness = block_liveness(block)
+
+    def forward_uses(pname):
+        return [i for i, op in enumerate(block.ops[:bw_idx])
+                if pname in op_reads_recursive(op)]
+
+    plans = []           # (first_use, last_use, param, shard_dim)
+    for p in block.all_parameters():
+        if not p.trainable:
+            continue
+        if getattr(p, "dist_attr", None):
+            report["skipped"].append((p.name, "already-sharded"))
+            continue
+        shape = tuple(int(s) for s in p.shape)
+        numel = int(np.prod(shape)) if shape else 1
+        if numel < max(min_shard_numel, fsdp):
+            report["skipped"].append((p.name, "below-min-shard-numel"))
+            continue
+        dim = _shard_dim(shape, fsdp)
+        if dim is None:
+            report["skipped"].append((p.name, "no-divisible-dim"))
+            continue
+        uses = forward_uses(p.name)
+        if not uses:
+            report["skipped"].append((p.name, "not-read-in-forward"))
+            continue
+        plans.append((uses[0], uses[-1], p, dim))
+
+    # phase 1: rename every forward read p → p@fsdp_full against the
+    # UNMODIFIED op list (renames don't shift indices); phase 2 inserts
+    # the gathers at first use in DESCENDING index order so each
+    # insertion leaves the remaining insertion points valid
+    for first, last, p, dim in plans:
+        full = block.create_var(name=p.name + GATHER_SUFFIX,
+                                shape=tuple(p.shape), dtype=p.dtype)
+        for op in block.ops[first:bw_idx]:
+            _rename_inputs(op, p.name, full.name)
+    for first, last, p, dim in sorted(plans, key=lambda t: -t[0]):
+        spec = ShardSpec(tuple(axis if d == dim else None
+                               for d in range(len(p.shape))) or (axis,))
+        full_name = p.name + GATHER_SUFFIX
+        block._insert_op(
+            first, type="fsdp_all_gather",
+            inputs={"X": [p.name]}, outputs={"Out": [full_name]},
+            attrs={"ring_id": 0, "_axis_name": axis, "gather_dim": dim,
+                   # liveness window (op indices BEFORE insertion): the
+                   # full copy exists only between its gather and its
+                   # last forward consumer — census tools assert this
+                   "_window": (first, last)})
+        p.dist_attr = spec
+        # the gradient w.r.t. the resident shard arrives pre-scattered
+        # through the gather's transpose — stamp it so grad sync and
+        # the memory/wire model treat it at shard size
+        g = block.vars.get(grad_var_name(p.name))
+        if g is not None:
+            g.dist_attr = spec
+        # optimizer accumulators shaped like the param shard with it
+        # (Adam moments, gradient-merge accumulators): every persistable
+        # the update zone couples to this param/grad
+        coupled = {p.name, grad_var_name(p.name)}
+        for op in block.ops[bw_idx:]:
+            names = set(op.input_names()) | set(op.output_names())
+            if not (names & coupled):
+                continue
+            for n in names:
+                v = block._find_var_recursive(n)
+                if v is None or not v.persistable or n == p.name:
+                    continue
+                if tuple(v.shape) == tuple(p.shape) and \
+                        not getattr(v, "dist_attr", None):
+                    v.dist_attr = spec
+        from ..ops.registry import dtype_nbytes
+        report["sharded"].append(
+            {"param": p.name, "shape": list(p.shape), "shard_dim": dim,
+             "window": [int(first), int(last)],
+             "bytes_full": int(np.prod(p.shape)) * dtype_nbytes(p.dtype),
+             "pinned": bool(liveness.get(p.name) and
+                            liveness[p.name].pinned)})
+    program._bump_version()
+    return report
+
+
+__all__ = ["apply_fsdp_sharding", "GATHER_SUFFIX",
+           "DEFAULT_MIN_SHARD_NUMEL"]

@@ -97,10 +97,13 @@ def sig_bytes(sig, unknown_dim: int = 1) -> int:
 
 
 def _axis_divisor(axes, mesh_axes: Dict[str, int]) -> int:
+    """Product of mesh-axis sizes over ``axes``; entries may be axis
+    names, None, or nested tuples of names (a ShardSpec dim sharded over
+    fsdp×tp, or a tuple batch_axis like ("dp", "fsdp"))."""
+    from .mesh_layout import _flat_axes
     div = 1
-    for a in axes or ():
-        if a:
-            div *= int(mesh_axes.get(a, 1))
+    for a in _flat_axes(axes):
+        div *= int(mesh_axes.get(a, 1))
     return div
 
 
@@ -541,11 +544,16 @@ def analyze_memory(program: Program, feed_shapes=None,
         internal = 0
         for idx, op in enumerate(ops[:bw_idx]):
             outs = op.output_names()
+            # a ZeRO-3 on-demand gather rebuilds the FULL parameter —
+            # replicated across the batch axes, so never divided by the
+            # activation (batch/seq) sharding
+            is_gather = op.type == "fsdp_all_gather"
             for n in outs:
                 v = block._find_var_recursive(n)
                 if v is not None and v.persistable:
                     continue
-                fwd_names.setdefault(n, var_bytes(n, activation=True))
+                fwd_names.setdefault(
+                    n, var_bytes(n, activation=not is_gather))
             internal += _op_backward_extra(op, env) // act_div
             ins = op.input_names()
             if outs and ins and _op_transparent(op.type):
@@ -878,6 +886,109 @@ def estimate(program: Program, feed_shapes=None,
                           unknown_dim=unknown_dim, top_k=top_k)
 
 
+def collective_wire_summary(program: Program, feed_shapes=None,
+                            fetch_names: Iterable[str] = (),
+                            mesh_axes: Optional[Dict[str, int]] = None,
+                            batch_axis=None,
+                            seq_axis: Optional[str] = None,
+                            feed_specs: Optional[Dict[str, Any]] = None,
+                            unknown_dim: int = 1) -> Dict[str, Any]:
+    """Whole-program per-STEP wire-byte summary over the op_spec
+    ``wire`` channel — forward collectives included (Megatron f/g pair,
+    ZeRO-3 ``fsdp_all_gather``), not just the post-backward grad-sync
+    zone :func:`analyze_memory` reports.  This is the cost channel the
+    shard planner ranks candidate layouts with.
+
+    The ``wire`` fns price an op from its inputs' DECLARED (global)
+    signatures; the actual traced payload is the local shard, so each
+    op's bytes are divided by the payload's sharding over axes the op
+    does NOT communicate over: a ``dist_attr``-sharded payload divides
+    by its non-reduce axes (a ZeRO-3 grad reduced over dp divides by
+    fsdp), activations divide by the batch×seq axes, feeds by their
+    ``feed_specs`` entry.  Axes the op communicates over stay whole —
+    an fsdp gather's ring cost is (n-1)/n of the FULL parameter.
+    """
+    from ..ops.registry import OP_SPECS
+    from .mesh_layout import _flat_axes
+
+    mesh_axes = dict(mesh_axes or {})
+    block = program.global_block()
+    feed_sigs = _feed_sigs(program, feed_shapes, unknown_dim)
+    scratch = VerifyResult(program)
+    env = infer_shapes(program, scratch, feed_names=list(feed_sigs),
+                       init_env=dict(feed_sigs))
+
+    def sig_of(name):
+        from ..ops.registry import VarSig
+        s = env.get(name)
+        if s is not None and s.shape is not None:
+            return s
+        v = block._find_var_recursive(name)
+        if v is None:
+            return s
+        return VarSig(tuple(v.shape) or None, v.dtype)
+
+    batch_axes = _flat_axes(batch_axis) + tuple(
+        a for a in (seq_axis,) if a)
+
+    totals = {"wire_bytes": 0, "logical_bytes": 0}
+    by_op: Dict[str, Dict[str, int]] = {}
+    unpriced: List[str] = []
+    for op in block.ops:
+        spec = OP_SPECS.get(op.type)
+        if spec is None or not spec.collective:
+            continue
+        fn = getattr(spec, "wire", None)
+        if fn is None:
+            if op.type not in ("zero_shard_slice", "mp_copy", "c_identity"):
+                unpriced.append(op.type)
+            continue
+        ins = {slot: [sig_of(n) for n in names]
+               for slot, names in op.inputs.items()}
+        try:
+            wb = fn(ins, op.attrs, mesh_axes)
+        except Exception:       # accounting must not kill the planner
+            wb = None
+        if wb is None:
+            unpriced.append(op.type)
+            continue
+        logical, wire = wb
+        op_axes = op.attrs.get("_axis_name") or ()
+        op_axes = set(_flat_axes(op_axes))
+        # divide by the payload's sharding over NON-communicated axes
+        div = None
+        for n in op.input_names():
+            v = block._find_var_recursive(n)
+            da = tuple(getattr(v, "dist_attr", None) or ()) \
+                if v is not None else ()
+            if da:
+                axes = tuple(a for a in _flat_axes(da) if a not in op_axes)
+            elif n in feed_sigs:
+                fspec = (feed_specs or {}).get(n)
+                axes = tuple(a for a in _flat_axes(
+                    tuple(fspec) if fspec is not None else batch_axes)
+                    if a not in op_axes)
+            elif v is not None and v.persistable:
+                axes = ()
+            else:           # activation: batch/seq sharded
+                axes = tuple(a for a in batch_axes if a not in op_axes)
+            d = _axis_divisor(axes, mesh_axes)
+            div = d if div is None else min(div, d)
+        div = div or 1
+        logical, wire = int(logical // div), int(wire // div)
+        row = by_op.setdefault(op.type, {"count": 0, "wire_bytes": 0,
+                                         "logical_bytes": 0})
+        row["count"] += 1
+        row["wire_bytes"] += wire
+        row["logical_bytes"] += logical
+        totals["wire_bytes"] += wire
+        totals["logical_bytes"] += logical
+    return {"wire_bytes": totals["wire_bytes"],
+            "logical_bytes": totals["logical_bytes"],
+            "by_op": by_op,
+            "unpriced_collectives": sorted(set(unpriced))}
+
+
 def mesh_axes_of(mesh) -> Dict[str, int]:
     """{axis name: size} of a jax Mesh (None → {})."""
     if mesh is None:
@@ -890,4 +1001,5 @@ __all__ = [
     "RESIDUAL_FACTOR", "Interval", "LiveTensor", "MemoryEstimate",
     "block_liveness", "program_liveness", "analyze_memory", "estimate",
     "lint_memory", "check_hbm_budget", "mesh_axes_of", "sig_bytes",
+    "collective_wire_summary",
 ]

@@ -1,0 +1,285 @@
+"""Canonical named-axis mesh layout — the sharding substrate.
+
+Before this module every distributed annotation was an ad-hoc tuple of
+axis names stuck on a Variable (``w.dist_attr = (None, "tp")``,
+parallel/tp_layers.py) with the axis SIZES living only in whatever
+``jax.sharding.Mesh`` happened to be passed at run time.  That made
+sharding configurations impossible to reason about statically: a
+program saved on a 32-device pod forgot its mesh shape, and nothing
+could *search* layouts without building real meshes.
+
+This module introduces the two canonical objects (the ``SpecLayout``
+pattern over data/fsdp/tp axes):
+
+* :class:`ShardSpec` — a PartitionSpec-over-named-axes.  It subclasses
+  ``tuple`` so every existing ``dist_attr`` consumer (``tuple(da)``,
+  ``for a in da``, ``a in da``, serialization) keeps working unchanged
+  — the old bare-tuple spelling is the shim, the ShardSpec is the
+  canonical form (``Variable.dist_attr``'s setter coerces).  Entries
+  may be ``None`` (replicated dim), an axis name, or a tuple of axis
+  names (one dim sharded over several axes, e.g. ``("fsdp", "tp")``).
+* :class:`MeshLayout` — the named axes WITH their sizes
+  (``data × fsdp × tp``, extra axes like ``sp`` allowed).  It is the
+  device-free description the shard planner searches over
+  (framework/shard_planner.py), serializes with the program
+  (framework/serialization.py), and materialises into a real
+  ``jax.sharding.Mesh`` only for the winning configuration.
+
+Axis-naming convention (matches the rest of the codebase): the data
+axis is ``"dp"``, the parameter-shard axis ``"fsdp"``, the tensor-model
+axis ``"tp"``.  ``MeshLayout.build_mesh`` SQUEEZES size-1 axes so a
+``(data=8, fsdp=1, tp=1)`` layout lowers on the identical ``("dp",)``
+mesh a hand-flagged data-parallel run uses — bit-identical programs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+#: canonical axis names (the SpecLayout convention, keyed to this
+#: codebase's existing "dp"/"tp" spellings)
+DATA_AXIS = "dp"
+FSDP_AXIS = "fsdp"
+TP_AXIS = "tp"
+
+
+def _flat_axes(entries) -> Tuple[str, ...]:
+    """Flatten spec entries / axis collections into a flat tuple of axis
+    names (drops Nones, recurses into tuple entries)."""
+    out = []
+    if entries is None:
+        return ()
+    if isinstance(entries, str):
+        return (entries,)
+    for e in entries:
+        if e is None:
+            continue
+        if isinstance(e, str):
+            out.append(e)
+        else:
+            out.extend(_flat_axes(e))
+    return tuple(out)
+
+
+class ShardSpec(tuple):
+    """PartitionSpec over named mesh axes, one entry per tensor dim.
+
+    Subclasses ``tuple`` so legacy ``dist_attr`` tuples and ShardSpecs
+    are interchangeable everywhere — the migration shim.  Entries:
+    ``None`` (replicated), ``"axis"``, or ``("axis_a", "axis_b")``.
+    """
+
+    def __new__(cls, entries: Iterable = ()):
+        norm = []
+        for e in entries:
+            if e is None or isinstance(e, str):
+                norm.append(e)
+            elif isinstance(e, (tuple, list)):
+                sub = tuple(a for a in e if a is not None)
+                for a in sub:
+                    if not isinstance(a, str):
+                        raise TypeError(
+                            f"ShardSpec entry {e!r}: axis names must be "
+                            f"strings")
+                norm.append(sub if len(sub) > 1 else
+                            (sub[0] if sub else None))
+            else:
+                raise TypeError(
+                    f"ShardSpec entry {e!r} is not None/str/tuple-of-str")
+        return super().__new__(cls, norm)
+
+    @classmethod
+    def coerce(cls, value) -> Optional["ShardSpec"]:
+        """None-safe normalisation of any dist_attr spelling: legacy
+        bare tuples/lists, jax PartitionSpecs, or ShardSpecs."""
+        if value is None:
+            return None
+        if isinstance(value, ShardSpec):
+            return value
+        return cls(tuple(value))
+
+    @property
+    def axes(self) -> Tuple[str, ...]:
+        """Flat tuple of every axis name the spec shards over."""
+        return _flat_axes(self)
+
+    def divisor(self, axis_sizes: Optional[Dict[str, int]]) -> int:
+        """Product of the (known) sizes of the sharded axes — what one
+        device's resident bytes divide by."""
+        div = 1
+        for a in self.axes:
+            div *= int((axis_sizes or {}).get(a, 1))
+        return div
+
+    def mesh_entries(self, axis_names: Iterable[str]) -> Tuple:
+        """Spec entries with axes absent from ``axis_names`` dropped
+        (dangling axes replicate — a tp-annotated program on a dp-only
+        mesh).  Tuple entries are filtered member-wise."""
+        names = set(axis_names)
+
+        def keep(e):
+            if e is None:
+                return None
+            if isinstance(e, str):
+                return e if e in names else None
+            sub = tuple(a for a in e if a in names)
+            return sub if len(sub) > 1 else (sub[0] if sub else None)
+
+        return tuple(keep(e) for e in self)
+
+    def partition_spec(self, axis_names: Optional[Iterable[str]] = None):
+        """The jax ``PartitionSpec`` this spec lowers to on a mesh with
+        ``axis_names`` (all axes kept when None)."""
+        from jax.sharding import PartitionSpec as P
+        entries = self.mesh_entries(axis_names) if axis_names is not None \
+            else tuple(self)
+        return P(*entries)
+
+    def __repr__(self):
+        return f"ShardSpec{tuple(self)!r}"
+
+
+class MeshLayout:
+    """Named mesh axes with sizes — data / fsdp / tp (+ extras).
+
+    The canonical, device-free description of one sharding
+    configuration: ``MeshLayout(data=4, fsdp=2, tp=1)`` is a 8-device
+    layout whose batch shards over ``dp × fsdp``, parameters over
+    ``fsdp`` (ZeRO-3), and tensor-model weights over ``tp``.
+    """
+
+    def __init__(self, data: int = 1, fsdp: int = 1, tp: int = 1,
+                 extra_axes: Optional[Dict[str, int]] = None,
+                 data_axis: str = DATA_AXIS, fsdp_axis: str = FSDP_AXIS,
+                 tp_axis: str = TP_AXIS):
+        self.data_axis, self.fsdp_axis, self.tp_axis = \
+            data_axis, fsdp_axis, tp_axis
+        self._sizes: Dict[str, int] = {data_axis: int(data),
+                                       fsdp_axis: int(fsdp),
+                                       tp_axis: int(tp)}
+        for k, v in (extra_axes or {}).items():
+            self._sizes[str(k)] = int(v)
+        for name, size in self._sizes.items():
+            if size < 1:
+                raise ValueError(f"MeshLayout axis {name!r}: size {size} < 1")
+
+    # -- queries ---------------------------------------------------------
+    @property
+    def data(self) -> int:
+        return self._sizes[self.data_axis]
+
+    @property
+    def fsdp(self) -> int:
+        return self._sizes[self.fsdp_axis]
+
+    @property
+    def tp(self) -> int:
+        return self._sizes[self.tp_axis]
+
+    @property
+    def sizes(self) -> Dict[str, int]:
+        """{axis name: size} — EVERY axis, size-1 included."""
+        return dict(self._sizes)
+
+    @property
+    def mesh_axes(self) -> Dict[str, int]:
+        """{axis name: size} of the axes that physically exist (>1) —
+        the dict the memory analyzer / wire pricer consume."""
+        return {a: n for a, n in self._sizes.items() if n > 1}
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for s in self._sizes.values():
+            n *= s
+        return n
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self._sizes)
+
+    def __contains__(self, axis: str) -> bool:
+        return axis in self._sizes
+
+    def size(self, axis: str) -> int:
+        return int(self._sizes.get(axis, 1))
+
+    @property
+    def batch_axes(self):
+        """The axes the global batch shards over (data + fsdp — ZeRO-3
+        treats the fsdp axis as a second data axis), squeezed: a plain
+        string when only one axis is real, a tuple when several, None
+        when the layout is single-device along both."""
+        axes = tuple(a for a in (self.data_axis, self.fsdp_axis)
+                     if self._sizes.get(a, 1) > 1)
+        if not axes:
+            return None
+        return axes[0] if len(axes) == 1 else axes
+
+    # -- spec construction ----------------------------------------------
+    def spec(self, *entries) -> ShardSpec:
+        """A :class:`ShardSpec` validated against this layout's axes."""
+        s = ShardSpec(entries)
+        for a in s.axes:
+            if a not in self._sizes:
+                raise ValueError(
+                    f"spec axis {a!r} is not in mesh layout "
+                    f"{self.axis_names}")
+        return s
+
+    # -- materialisation -------------------------------------------------
+    def build_mesh(self, devices=None):
+        """A real ``jax.sharding.Mesh`` over the SQUEEZED axes (size-1
+        axes dropped, so a (8,1,1) layout builds the same ``("dp",)``
+        mesh a hand-flagged dp run uses).  Returns None for a
+        single-device layout."""
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh
+        real = [(a, n) for a, n in self._sizes.items() if n > 1]
+        if not real:
+            return None
+        devs = list(devices) if devices is not None else list(jax.devices())
+        if len(devs) < self.num_devices:
+            raise ValueError(
+                f"mesh layout {self.sizes} needs {self.num_devices} "
+                f"devices, only {len(devs)} available")
+        arr = np.array(devs[:self.num_devices]).reshape(
+            [n for _, n in real])
+        return Mesh(arr, tuple(a for a, _ in real))
+
+    # -- serialization (framework/serialization.py carries this) ---------
+    def to_desc(self) -> Dict[str, Any]:
+        return {"axes": [[a, int(n)] for a, n in self._sizes.items()],
+                "data_axis": self.data_axis, "fsdp_axis": self.fsdp_axis,
+                "tp_axis": self.tp_axis}
+
+    @classmethod
+    def from_desc(cls, d) -> "MeshLayout":
+        if d is None:
+            return None
+        axes = dict((a, int(n)) for a, n in d.get("axes", []))
+        da = d.get("data_axis", DATA_AXIS)
+        fa = d.get("fsdp_axis", FSDP_AXIS)
+        ta = d.get("tp_axis", TP_AXIS)
+        extra = {a: n for a, n in axes.items() if a not in (da, fa, ta)}
+        return cls(data=axes.get(da, 1), fsdp=axes.get(fa, 1),
+                   tp=axes.get(ta, 1), extra_axes=extra,
+                   data_axis=da, fsdp_axis=fa, tp_axis=ta)
+
+    def __eq__(self, other):
+        return isinstance(other, MeshLayout) and \
+            self._sizes == other._sizes and \
+            (self.data_axis, self.fsdp_axis, self.tp_axis) == \
+            (other.data_axis, other.fsdp_axis, other.tp_axis)
+
+    def __hash__(self):
+        return hash((tuple(self._sizes.items()), self.data_axis,
+                     self.fsdp_axis, self.tp_axis))
+
+    def __repr__(self):
+        return f"MeshLayout({self._sizes})"
+
+
+__all__ = ["ShardSpec", "MeshLayout", "DATA_AXIS", "FSDP_AXIS", "TP_AXIS",
+           "_flat_axes"]

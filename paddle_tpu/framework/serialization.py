@@ -166,11 +166,20 @@ def _dec_var(block: Block, d, program: Program) -> Variable:
 
 def program_to_desc(program: Program) -> Dict[str, Any]:
     """Program → versioned primitive-only desc dict (the ProgramDesc
-    analog)."""
+    analog).
+
+    ``mesh_layout`` carries the canonical named-axis layout WITH its
+    axis sizes (mesh_layout.MeshLayout) — a program planned on a
+    32-device pod reloads knowing it was laid out dp×fsdp×tp, not just
+    which axis names its dist_attrs mention.  Per-var ``dist_attr``
+    ShardSpecs ride the existing tuple encoding (ShardSpec subclasses
+    tuple; nested axis-tuples nest the same way)."""
+    layout = getattr(program, "_mesh_layout", None)
     return {
         "format_version": FORMAT_VERSION,
         "random_seed": program.random_seed,
         "is_test": getattr(program, "_is_test", False),
+        "mesh_layout": layout.to_desc() if layout is not None else None,
         "blocks": [{
             "idx": b.idx,
             "parent_idx": b.parent_idx,
@@ -197,6 +206,9 @@ def desc_to_program(desc: Dict[str, Any]) -> Program:
     program = Program()
     program.random_seed = desc.get("random_seed", 0)
     program._is_test = desc.get("is_test", False)
+    if desc.get("mesh_layout") is not None:
+        from .mesh_layout import MeshLayout
+        program._mesh_layout = MeshLayout.from_desc(desc["mesh_layout"])
     # materialise all blocks first so block-index attrs can resolve
     for bd in desc["blocks"][1:]:
         b = Block(program, bd["idx"], bd.get("parent_idx", -1))

@@ -361,6 +361,30 @@ def _zero_all_gather(ctx, ins, attrs):
     return {"Out": full[:numel].reshape(shape)}
 
 
+@register("fsdp_all_gather")
+def _fsdp_all_gather(ctx, ins, attrs):
+    """ZeRO-3 on-demand parameter gather (framework/fsdp.py): the
+    resident param is the 1/n shard along ``gather_dim`` over the fsdp
+    axis; this op rebuilds the full tensor right before its first
+    forward use, and the gathered temp dies at its last use (XLA frees
+    at last-use — the discard-after-last-use half of ZeRO-3 needs no
+    op).  Its autodiff TRANSPOSE is ``psum_scatter`` over the same axis,
+    so the param's gradient arrives already reduce-scattered to the
+    shard — ZeRO-3's grad sync over fsdp costs zero extra ops.
+
+    Off-mesh (axis absent — a single-device parity run) it is identity,
+    like every collective here."""
+    a = x(ins, "X")
+    axis = _ring_axis(ctx, attrs)
+    if axis is None:
+        return {"Out": a}
+    dim = attrs.get("gather_dim", 0)
+    if dim < 0:
+        dim += a.ndim
+    return {"Out": lax.all_gather(a, _axes_tuple(axis)[0], axis=dim,
+                                  tiled=True)}
+
+
 @register("c_broadcast")
 def _c_broadcast(ctx, ins, attrs):
     a = x(ins, "X")

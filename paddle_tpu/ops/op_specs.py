@@ -721,7 +721,13 @@ def _collective_wire(passes):
 
 
 #: collective op type → its ``wire`` accounting fn (2 payload passes for
-#: all-reduce shapes, 1 for scatter/gather halves)
+#: all-reduce shapes, 1 for scatter/gather halves).  Per-STEP training
+#: cost: ops whose backward transposes to another collective price both
+#: directions — fsdp_all_gather (fwd gather + bwd psum_scatter),
+#: mp_allreduce_sum (fwd psum, bwd identity) and mp_copy (fwd identity,
+#: bwd psum) each move the payload the listed number of passes so the
+#: planner's ring-cost channel covers the Megatron f/g pair and the
+#: ZeRO-3 gathers, not just the post-backward grad sync.
 _WIRE_SPECS = {
     "c_allreduce_sum": _collective_wire(2),
     "c_fused_allreduce_sum": _collective_wire(2),
@@ -732,6 +738,9 @@ _WIRE_SPECS = {
     "c_reducescatter": _collective_wire(1),
     "zero_all_gather": _collective_wire(1),
     "c_allgather": _collective_wire(1),
+    "fsdp_all_gather": _collective_wire(2),
+    "mp_allreduce_sum": _collective_wire(2),
+    "mp_copy": _collective_wire(2),
 }
 
 
@@ -871,6 +880,10 @@ def register_default_specs():
                  "local_sgd_sync", "moe_ffn", "mp_copy"):
         op_spec(name, infer=None, collective=True,
                 wire=_WIRE_SPECS.get(name))
+    # ZeRO-3 on-demand parameter gather (framework/fsdp.py): metadata is
+    # GLOBAL throughout, so Out mirrors X's declared signature
+    op_spec("fsdp_all_gather", infer=_infer_collective_same,
+            collective=True, wire=_WIRE_SPECS["fsdp_all_gather"])
     # zero_shard_slice/mp_copy are local ops but ride the collective
     # schedule (their placement must agree across ranks), so they are
     # flagged too.

@@ -20,6 +20,7 @@ from typing import Optional, Tuple
 
 from ..framework.layer_helper import LayerHelper
 from ..framework.core import Variable
+from ..framework.mesh_layout import ShardSpec
 
 
 def moe_ffn(x: Variable, num_experts: int, ffn_hidden: int,
@@ -63,8 +64,8 @@ def moe_ffn(x: Variable, num_experts: int, ffn_hidden: int,
         # expert dim sharded; grads arrive pre-summed through the
         # transposed all_to_all (compiler skips the allreduce over this
         # axis but keeps the 1/n mean-loss scale)
-        w1.dist_attr = (axis_name, None, None)
-        w2.dist_attr = (axis_name, None, None)
+        w1.dist_attr = ShardSpec((axis_name, None, None))
+        w2.dist_attr = ShardSpec((axis_name, None, None))
     inputs = {"X": [x], "GateW": [gate_w], "W1": [w1], "W2": [w2]}
     if bias_attr is not False:
         b1 = helper.create_parameter(_sub(bias_attr, "b1"),
@@ -73,8 +74,8 @@ def moe_ffn(x: Variable, num_experts: int, ffn_hidden: int,
         b2 = helper.create_parameter(_sub(bias_attr, "b2"),
                                      [num_experts, m], x.dtype, is_bias=True)
         if ep > 1:
-            b1.dist_attr = (axis_name, None)
-            b2.dist_attr = (axis_name, None)
+            b1.dist_attr = ShardSpec((axis_name, None))
+            b2.dist_attr = ShardSpec((axis_name, None))
         inputs["B1"], inputs["B2"] = [b1], [b2]
 
     out = helper.create_variable_for_type_inference(x.dtype, x.shape)

@@ -15,6 +15,7 @@ from typing import Optional
 
 from ..framework.layer_helper import LayerHelper, ParamAttr
 from ..framework.core import Variable
+from ..framework.mesh_layout import ShardSpec
 
 
 def _append_tp(helper, op_type, x_var, axis_name):
@@ -38,21 +39,22 @@ def column_parallel_fc(x: Variable, size: int, tp_degree: int,
     helper = LayerHelper(name or "col_parallel_fc", name=name)
     in_dim = int(x.shape[-1])
 
-    # params are declared with GLOBAL shapes + a dist_attr PartitionSpec;
+    # params are declared with GLOBAL shapes + a dist_attr ShardSpec
+    # (PartitionSpec over named mesh axes, framework/mesh_layout.py);
     # the executor's shard_map hands each device its local shard (GSPMD
     # style) — the startup program initialises the global array once.
     # Var shape metadata stays GLOBAL throughout; traced local shapes are
     # what actually flow.
     x = _append_tp(helper, "mp_copy", x, axis_name)     # f: bwd AllReduce
     w = helper.create_parameter(param_attr, [in_dim, size], x.dtype)
-    w.dist_attr = (None, axis_name)
+    w.dist_attr = ShardSpec((None, axis_name))
     out = helper.create_variable_for_type_inference(
         x.dtype, tuple(x.shape[:-1]) + (size,))
     helper.append_op(type="matmul", inputs={"X": [x], "Y": [w]},
                      outputs={"Out": [out]}, attrs={})
     if bias_attr is not False:
         b = helper.create_parameter(bias_attr, [size], x.dtype, is_bias=True)
-        b.dist_attr = (axis_name,)
+        b.dist_attr = ShardSpec((axis_name,))
         out2 = helper.create_variable_for_type_inference(x.dtype, out.shape)
         helper.append_op(type="elementwise_add", inputs={"X": [out], "Y": [b]},
                          outputs={"Out": [out2]}, attrs={"axis": -1})
@@ -80,7 +82,8 @@ def row_parallel_fc(x: Variable, size: int, tp_degree: int,
     if in_dim % tp_degree:
         raise ValueError(f"input dim {in_dim} not divisible by {tp_degree}")
     w = helper.create_parameter(param_attr, [in_dim, size], x.dtype)
-    w.dist_attr = (axis_name, None)   # input-dim sharded → local [in/tp, size]
+    # input-dim sharded → local [in/tp, size]
+    w.dist_attr = ShardSpec((axis_name, None))
     out = helper.create_variable_for_type_inference(
         x.dtype, tuple(x.shape[:-1]) + (size,))
     helper.append_op(type="matmul", inputs={"X": [x], "Y": [w]},
@@ -108,7 +111,7 @@ def vocab_parallel_embedding(ids: Variable, vocab_size: int, embed_dim: int,
     local_vocab = vocab_size // tp_degree
     w = helper.create_parameter(param_attr, [vocab_size, embed_dim],
                                 "float32")
-    w.dist_attr = (axis_name, None)   # vocab dim sharded
+    w.dist_attr = ShardSpec((axis_name, None))   # vocab dim sharded
     out = helper.create_variable_for_type_inference(
         "float32", tuple(ids.shape) + (embed_dim,))
     # c_embedding masks out-of-shard ids and psums partial lookups; its

@@ -571,3 +571,92 @@ def test_dp8_zero1_census_passes_soundness_checks():
         i, j = coll[0], coll[1]
         blk.ops[i], blk.ops[j] = blk.ops[j], blk.ops[i]
         assert not check_collective_consistency([prog, broken]).ok
+
+
+# ---------------------------------------------------------------------------
+# shard-layout soundness (the named-axis MeshLayout/ShardSpec contract)
+# ---------------------------------------------------------------------------
+
+
+def test_detects_shard_layout_unknown_axis():
+    """A stamped dist_attr naming a mesh axis absent from the program's
+    MeshLayout is rejected (it would silently replicate on the real
+    mesh), anchored to the first op touching the var."""
+    from paddle_tpu.framework.analysis import SHARD_LAYOUT_UNKNOWN_AXIS
+    from paddle_tpu.framework.mesh_layout import MeshLayout
+    p = Program()
+    p._mesh_layout = MeshLayout(data=8, fsdp=1, tp=1)
+    b = p.global_block()
+    w = b.create_parameter("w", (8, 8))
+    w.dist_attr = ("fsdq", None)            # typo'd axis name
+    b.create_var(name="y", shape=(8, 8), dtype="float32")
+    b.append_op(type="scale", inputs={"X": ["w"]}, outputs={"Out": ["y"]},
+                attrs={"scale": 1.0})
+    d = _one(verify_program(p), SHARD_LAYOUT_UNKNOWN_AXIS)
+    _assert_anchored(d, "scale")
+    assert "fsdq" in d.message and "w" in d.message
+
+
+def test_detects_shard_gather_of_unsharded_var():
+    """An fsdp_all_gather whose input spec does not cover the gather
+    axis disagrees with the collective schedule — gathering a
+    replicated tensor tiles duplicate copies."""
+    from paddle_tpu.framework.analysis import (
+        SHARD_LAYOUT_COLLECTIVE_MISMATCH)
+    p = Program()
+    b = p.global_block()
+    b.create_parameter("w", (16, 8))        # NO fsdp dist_attr stamped
+    b.create_var(name="w@fsdp_full", shape=(16, 8), dtype="float32")
+    b.append_op(type="fsdp_all_gather", inputs={"X": ["w"]},
+                outputs={"Out": ["w@fsdp_full"]},
+                attrs={"ring_id": 0, "_axis_name": "fsdp",
+                       "gather_dim": 0})
+    d = _one(verify_program(p), SHARD_LAYOUT_COLLECTIVE_MISMATCH)
+    _assert_anchored(d, "fsdp_all_gather")
+    assert "fsdp" in d.message
+
+
+def test_detects_sum_reduce_over_sharded_axis():
+    """A summing collective whose reduce axes intersect the payload's
+    sharded axes double-counts different slices — the per-var spec and
+    the op's schedule disagree."""
+    from paddle_tpu.framework.analysis import (
+        SHARD_LAYOUT_COLLECTIVE_MISMATCH)
+    from paddle_tpu.framework.mesh_layout import ShardSpec
+    p = Program()
+    b = p.global_block()
+    g = b.create_var(name="g", shape=(64,), dtype="float32", is_data=True)
+    g.dist_attr = ShardSpec(("fsdp",))
+    b.append_op(type="c_allreduce_sum", inputs={"X": ["g"]},
+                outputs={"Out": ["g"]},
+                attrs={"ring_id": 0, "_axis_name": ("dp", "fsdp")})
+    d = _one(verify_program(p), SHARD_LAYOUT_COLLECTIVE_MISMATCH)
+    _assert_anchored(d, "c_allreduce_sum")
+    assert "fsdp" in d.message and "double-counts" in d.message
+
+
+def test_zero3_rewritten_program_layout_verifies_clean():
+    """The planner's own output must satisfy its verifier: an fsdp8
+    ZeRO-3 rewrite (gathers + stamped specs + grad sync over the data
+    axis) produces zero shard-layout diagnostics."""
+    from paddle_tpu.framework.compiler import BuildStrategy, insert_grad_sync
+    from paddle_tpu.framework.fsdp import apply_fsdp_sharding
+    from paddle_tpu.framework.mesh_layout import MeshLayout
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu", bias_attr=False)
+        pred = fluid.layers.fc(h, 4, act="softmax", bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    layout = MeshLayout(data=2, fsdp=4)
+    apply_fsdp_sharding(main, layout, min_shard_numel=64)
+    main._mesh_layout = layout
+    bs = BuildStrategy()
+    bs.fuse_all_reduce_ops = True
+    insert_grad_sync(main, bs, 8, ("dp",), axis_sizes=layout.sizes)
+    r = verify_program(main, startup=startup, fetch_names=[loss.name])
+    assert r.ok, r.report()
+    assert not r.by_code("shard-layout-unknown-axis")
+    assert not r.by_code("shard-layout-collective-mismatch")

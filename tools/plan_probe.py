@@ -1,0 +1,124 @@
+"""Auto-sharding plan-search probe: exercise the pre-compile planner on
+the dp8 BERT-tiny workload and emit the auditable ranked-plan artifact.
+
+The planner (framework/shard_planner.py) prices every legal
+(data, fsdp, tp) factorization of the device count with the static
+peak-HBM estimator + the op_spec wire ring-cost channel and picks the
+cheapest config that fits ``hbm_budget_gb`` — with ZERO compiles spent
+on rejected configs.  This probe proves the contract on a real model:
+
+* builds the tensor-parallel-annotated BERT-tiny pretrain step (so the
+  tp search dimension is live: tp ∈ {1, 2} for 2 attention heads);
+* plans at a budget placed between the cheapest and the most expensive
+  config's peak, so the budget gate visibly excludes configs;
+* asserts ≥6 configs priced, exactly one winner, the winner fitting
+  and minimizing wire bytes among fitting configs, and 0 executor
+  compiles during the whole search (monitor stat delta);
+* writes ``PLAN_SEARCH_r12.json`` (asserted in tier-1 by
+  tests/test_shard_planner.py).
+
+Usage:
+    PYTHONPATH=/root/repo python tools/plan_probe.py [out.json]
+    PYTHONPATH=/root/repo python tools/plan_probe.py --selftest
+"""
+
+import json
+import os
+import sys
+
+ARTIFACT = "PLAN_SEARCH_r12.json"
+
+
+def _env8():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def build_plan(num_devices=8):
+    """Plan the tp-annotated BERT-tiny train step; returns (plan,
+    compile_count_delta)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import bert
+    from paddle_tpu.framework.shard_planner import plan_sharding
+    from paddle_tpu.framework.compiler import BuildStrategy
+    from paddle_tpu.monitor import stat
+
+    cfg = bert.BertConfig.tiny()
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        feeds, loss = bert.build_pretrain_network_parallel(cfg, tp_degree=2)
+        fluid.optimizer.Adam(1e-4).minimize(loss)
+    batch = bert.make_fake_parallel_batch(np.random.RandomState(0), cfg,
+                                          batch_size=8, seq_len=64)
+    feed_shapes = {k: (tuple(v.shape), str(v.dtype))
+                   for k, v in batch.items()}
+    bs = BuildStrategy()
+    bs.fuse_all_reduce_ops = True
+
+    compiles_before = int(stat("executor_compile_count").get())
+    # pass 1 (no budget): find the peak spread so the budget provably
+    # excludes some configs and admits others
+    probe = plan_sharding(main_p, num_devices, loss_name=loss.name,
+                          feed_shapes=feed_shapes,
+                          fetch_names=[loss.name], build_strategy=bs,
+                          module="dp8_bert_tiny_tp2_pretrain")
+    peaks = sorted(c.peak_bytes for c in probe.configs
+                   if c.peak_bytes is not None)
+    budget_gb = round((peaks[0] + peaks[-1]) / 2 / float(1 << 30), 6)
+    plan = plan_sharding(main_p, num_devices, loss_name=loss.name,
+                         feed_shapes=feed_shapes, fetch_names=[loss.name],
+                         hbm_budget_gb=budget_gb, build_strategy=bs,
+                         module="dp8_bert_tiny_tp2_pretrain")
+    compile_delta = int(stat("executor_compile_count").get()) \
+        - compiles_before
+    return plan, compile_delta
+
+
+def check_plan(plan, compile_delta):
+    """The artifact's promises (also asserted in tier-1)."""
+    d = plan.as_dict()
+    priced = [c for c in plan.configs if c.est is not None and not c.error]
+    fitting = [c for c in priced if c.fits]
+    over = [c for c in priced if not c.fits]
+    assert d["configs_priced"] >= 6, \
+        f"only {d['configs_priced']} configs priced (need >=6)"
+    assert plan.winner is not None and plan.winner.fits
+    assert sum(c.winner for c in plan.configs) == 1
+    assert over, "budget excluded nothing — gate not exercised"
+    assert plan.winner.wire_bytes == min(c.wire_bytes for c in fitting), \
+        "winner does not minimize wire bytes among budget-fitting configs"
+    assert compile_delta == 0, \
+        f"{compile_delta} compiles attempted during the plan search"
+    tps = {c.layout.tp for c in priced}
+    assert tps >= {1, 2}, f"tp search dimension not live: {tps}"
+    fsdp = {c.layout.fsdp for c in priced}
+    assert max(fsdp) >= 2, "no ZeRO-3 configs priced"
+    return d
+
+
+def main(argv):
+    _env8()
+    out_path = ARTIFACT
+    args = [a for a in argv if not a.startswith("--")]
+    if args:
+        out_path = args[0]
+    plan, compile_delta = build_plan()
+    print(plan.report())
+    d = check_plan(plan, compile_delta)
+    d["compile_count_delta"] = compile_delta
+    with open(out_path, "w") as f:
+        json.dump(d, f, indent=1)
+    print(f"plan probe OK: {d['configs_priced']} configs priced, winner "
+          f"data={plan.winner.layout.data} fsdp={plan.winner.layout.fsdp} "
+          f"tp={plan.winner.layout.tp}, {compile_delta} compiles — "
+          f"wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -16,9 +16,17 @@ echo "== preflight: serve_bench (ragged-packing parity + padding-waste"
 echo "   bound, AOT-cache cold/warm restart, ServingFleet HBM admission) =="
 python tools/serve_bench.py --selftest
 
+echo "== preflight: auto-shard plan probe (dp8 BERT-tiny tp2: >=6 configs"
+echo "   priced, winner min-wire among budget-fitting, 0 compiles) =="
+python tools/plan_probe.py --selftest
+
 echo "== preflight: quant wire-compression census (dp8 BERT bucketed grad"
 echo "   sync: int8 >=3.5x fp32 / >=1.9x bf16 ring-model wire bytes) =="
 python tools/verify_multichip_lowering.py --selftest
+
+echo "== preflight: ZeRO-3 fsdp census (fsdp8 BERT-tiny: resident param"
+echo "   bytes /8, windowed all-gathers + reduce_scatter transposes) =="
+python tools/verify_multichip_lowering.py --fsdp
 
 echo "== preflight: dryrun_multichip(8) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
