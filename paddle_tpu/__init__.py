@@ -7,6 +7,7 @@ Fleet-style distributed strategies.
 """
 
 from . import ops            # registers all JAX op impls
+from . import observability  # noqa: F401 — telemetry/tracing/flight tier
 from . import fluid          # noqa: F401
 from . import dygraph        # noqa: F401
 from .framework.core import TPUPlace, CPUPlace, CUDAPlace  # noqa: F401

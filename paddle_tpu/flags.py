@@ -111,6 +111,18 @@ _register("hbm_budget_gb", 0.0)
 # Hit/miss/store/error counters surface in
 # profiler.step_breakdown()["aot_cache"].
 _register("aot_cache_dir", "")
+# always-on crash flight recorder (observability/flight.py): keep a
+# lock-light ring of recent steps/spans and dump a diagnostic bundle on
+# uncaught executor/serving exceptions and non-finite loss.  The
+# enabled-path cost in the prepared hot loop is one flag lookup + one
+# deque append per step (inside the ≤5% telemetry-overhead budget
+# tests/test_observability.py asserts); turning it off removes even that.
+_register("flight_recorder", True)
+# where flight bundles land (empty = current working directory)
+_register("flight_dump_dir", "")
+# MFU denominator override in FLOP/s (observability/flops.py): 0 = auto
+# from the device-kind peak table (TPU generations) with a CPU fallback
+_register("device_peak_flops", 0.0)
 # quant-small-bucket lint threshold (framework/analysis.py, surfaced by
 # tools/proglint.py): a blockwise-quantized collective whose payload is
 # under this many KiB pays more in per-block scale tensors + the extra

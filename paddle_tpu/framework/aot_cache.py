@@ -112,7 +112,7 @@ def load(cache_dir: str, key: str):
         stat("aot_cache_miss").add()
         return None
     try:
-        with RecordEvent("aot_cache::load"):
+        with RecordEvent("aot_cache::load", key=key[:16]):
             with open(path, "rb") as f:
                 entry = pickle.load(f)
             if not isinstance(entry, dict) or \
@@ -151,7 +151,7 @@ def store(cache_dir: str, key: str, compiled,
     from ..monitor import stat
     from ..profiler import RecordEvent
     try:
-        with RecordEvent("aot_cache::save"):
+        with RecordEvent("aot_cache::save", key=key[:16]):
             from jax.experimental import serialize_executable as _se
             payload, in_tree, out_tree = _se.serialize(compiled)
             entry = {"format": ENTRY_FORMAT, "meta": dict(meta or {}),

@@ -199,9 +199,13 @@ class CompiledProgram:
             variants.move_to_end(vkey)       # promote on hit (true LRU)
             return hit, None
         from .passes import apply_pass
-        clone = self._program.clone()
-        for pname in self._pending_passes:
-            apply_pass(clone, pname, fetch_names=list(fetch_names))
+        from ..profiler import RecordEvent
+        with RecordEvent("compiler::variant",
+                         fetches=",".join(fetch_names),
+                         passes=",".join(self._pending_passes)):
+            clone = self._program.clone()
+            for pname in self._pending_passes:
+                apply_pass(clone, pname, fetch_names=list(fetch_names))
         from ..flags import flag
         if flag("verify_programs"):
             # the rewritten variant is a NEW program (_uid) — verify it

@@ -37,6 +37,14 @@ import jax.numpy as jnp
 from .core import (Program, Variable, Place, TPUPlace, CPUPlace,
                    default_main_program, _jax_device_for, grad_var_name)
 from ..ops.registry import get_op, LoweringContext
+# hot-loop observability hooks, bound once at import: one fused call per
+# prepared step (run-level step-id bump + flight-recorder breadcrumb).
+# Module-level names so the overhead test can swap them for no-ops to
+# measure the delta.
+from ..observability.tracing import (is_enabled as _tracing_enabled,
+                                     next_step_id as _next_step_id)
+from ..observability.flight import step_breadcrumb as _step_breadcrumb
+from ..observability import flight as _flight
 
 _RNG_VAR = "@RNG_STATE@"
 
@@ -139,12 +147,22 @@ def run_ops(ops, env, ctx):
     call site that created it (ref: op_call_stack.cc — the reference
     attaches the Python stack to op errors the same way)."""
     from .errors import EnforceNotMet
+    traced = _tracing_enabled()
     for op in ops:
         if op.type in ("feed", "fetch"):
             continue
         try:
             impl = get_op(op.type)
-            outs = impl(ctx, _gather_inputs(op, env), op.attrs)
+            ins = _gather_inputs(op, env)
+            if traced:
+                # trace-time collective spans (once per compile, zero
+                # steady-state cost): kind/axis/wire bytes land on the
+                # timeline correlated to the compiling step's id
+                from ..ops.collective_ops import maybe_trace_collective
+                with maybe_trace_collective(op, ins, ctx):
+                    outs = impl(ctx, ins, op.attrs)
+            else:
+                outs = impl(ctx, ins, op.attrs)
         except EnforceNotMet:
             raise
         except (KeyboardInterrupt, SystemExit):
@@ -713,7 +731,9 @@ class PreparedStep:
         step = self._steps.get(sig)
         if step is None:
             from ..profiler import RecordEvent
-            with RecordEvent("executor::compile"):
+            with RecordEvent("executor::compile",
+                             program=self._program._uid,
+                             version=self._program._version):
                 step = self._exe._compile(
                     self._program, feed, self._fetch_names, self._scope,
                     self._mesh, self._axis_names, self._batch_axis,
@@ -787,6 +807,10 @@ class PreparedStep:
         block on first read) unless ``return_numpy=True``."""
         from ..flags import flag
         from ..profiler import RecordEvent
+        # run-level step axis: one id per training step, shared with the
+        # compile/serving/checkpoint spans (observability/tracing.py) and
+        # the flight recorder's breadcrumb ring
+        sid = _step_breadcrumb("prepared", self._program._uid)
         feed = dict(feed) if feed else {}
         if self._readers:
             t0 = time.perf_counter_ns()
@@ -835,9 +859,20 @@ class PreparedStep:
                         time.perf_counter_ns() - t0
 
         t0 = time.perf_counter_ns()
-        with RecordEvent("prepared::dispatch"):
-            fetches, state_out, new_key = step.fn(feed_vals, state_in,
-                                                  rng_key)
+        try:
+            with RecordEvent("prepared::dispatch"):
+                fetches, state_out, new_key = step.fn(feed_vals, state_in,
+                                                      rng_key)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            # black box before the stack unwinds: which step died, on
+            # which program, with what caches/flags live
+            _flight.dump("prepared_step_exception", exc=e,
+                         program=self._program,
+                         extra={"step": sid,
+                                "fetches": list(self._fetch_names)})
+            raise
         self.stats["dispatch_ns"] += time.perf_counter_ns() - t0
         self.stats["steps"] += 1
         if self._donate_state:
@@ -1032,7 +1067,10 @@ class Executor:
 
         from ..profiler import RecordEvent
         from ..monitor import stat
-        with RecordEvent("executor::compile"):
+        sid = _next_step_id()
+        _flight.note_step(sid, "run", program._uid)
+        with RecordEvent("executor::compile", program=program._uid,
+                         version=program._version):
             step = self._compile(program, feed, fetch_names, scope, mesh,
                                  axis_names, batch_axis, seq_axis,
                                  feed_specs)
@@ -1068,13 +1106,23 @@ class Executor:
                         for n, v in state_in.items()}
             key = _to_global(mesh, P(), key)
         with RecordEvent("executor::run"):
-            if flag("check_nan_inf") and flag("check_nan_inf_per_op") \
-                    and mesh is None:
-                fetches, state_out, new_key = self._run_per_op_debug(
-                    program, step, feed_vals, state_in, key, fetch_names)
-            else:
-                fetches, state_out, new_key = step.fn(feed_vals, state_in,
-                                                      key)
+            try:
+                if flag("check_nan_inf") and flag("check_nan_inf_per_op") \
+                        and mesh is None:
+                    fetches, state_out, new_key = self._run_per_op_debug(
+                        program, step, feed_vals, state_in, key,
+                        fetch_names)
+                else:
+                    fetches, state_out, new_key = step.fn(feed_vals,
+                                                          state_in, key)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:
+                _flight.dump("executor_run_exception", exc=e,
+                             program=program,
+                             extra={"step": sid,
+                                    "fetches": list(fetch_names)})
+                raise
             if flag("benchmark"):
                 # ref: FLAGS_benchmark forces a device sync per run so
                 # wall-clock timing is accurate; the barrier covers the
@@ -1205,6 +1253,8 @@ class Executor:
             if int(np.sum(all_bad)) and not bad:
                 bad = ["<on another host>"]
         if bad:
+            _flight.dump("non_finite_output",
+                         extra={"bad_vars": list(bad)})
             raise RuntimeError(
                 f"Operator output contains NaN/Inf (FLAGS_check_nan_inf): "
                 f"{bad} (ref: nan_inf_utils_detail PrintNanInf)")
@@ -1312,6 +1362,7 @@ class Executor:
             if flag("print_executor_cache_hits"):
                 print(f"executor cache hit: program v{program._version}")
             return self._cache[key]
+        _compile_t0 = time.perf_counter_ns()
         if flag("hbm_budget_gb"):
             # static pre-compile budget gate (memory_analysis.py): an
             # over-budget program is rejected HERE, with the top live
@@ -1454,6 +1505,12 @@ class Executor:
                         fn = loaded
         if fresh_trace:
             stat("executor_compile_count").add()
+        # wall time of the cold resolution path (trace/compile/AOT load)
+        # — the telemetry recorder diffs this into per-step compile-stall
+        # attribution (goodput accounting)
+        stat("executor_compile_ns").add(time.perf_counter_ns() - _compile_t0)
+        _flight.note_event("compile", program=program._uid,
+                           fresh=fresh_trace)
 
         compiled = _CompiledStep(fn, state_in_names, state_out_names,
                                  feed_names, fetch_names, raw_fn=step,

@@ -400,18 +400,36 @@ class AsyncCheckpointer:
     def save(self, executor, path, train_status: TrainStatus,
              main_program: Optional[Program] = None,
              scope: Optional[Scope] = None):
+        import time as _time
+        from .monitor import stat
+        from .observability import flight as _flight
+        from .observability.tracing import current_step_id, step_scope
+        from .profiler import RecordEvent
         self.wait()
         main_program = main_program or default_main_program()
         scope = scope or global_scope()
-        sync_prepared_state(scope)   # staleness guard (prepared fast path)
-        # synchronous device→host snapshot: values at THIS step
-        snap = {}
-        for name in _persistable_names(main_program):
-            v = scope.find_var(name)
-            if v is not None:
-                snap[name] = _host_value(v, name)
-        rng = scope.find_var(_RNG_VAR)
-        rng_snap = _host_value(rng, _RNG_VAR) if rng is not None else None
+        # the synchronous device→host snapshot is the training-thread
+        # STALL a checkpoint costs — spanned + counted (ns) so the
+        # telemetry recorder attributes it in the goodput accounting
+        _t0 = _time.perf_counter_ns()
+        with RecordEvent("checkpoint::snapshot",
+                         epoch=train_status.epoch_no):
+            sync_prepared_state(scope)   # staleness guard (prepared path)
+            # synchronous device→host snapshot: values at THIS step
+            snap = {}
+            for name in _persistable_names(main_program):
+                v = scope.find_var(name)
+                if v is not None:
+                    snap[name] = _host_value(v, name)
+            rng = scope.find_var(_RNG_VAR)
+            rng_snap = _host_value(rng, _RNG_VAR) if rng is not None \
+                else None
+        stat("checkpoint_snapshot_ns").add(_time.perf_counter_ns() - _t0)
+        stat("checkpoint_saves").add()
+        # the background write keeps the id of the step it snapshotted,
+        # so its span correlates to that step on the merged timeline
+        snap_step_id = current_step_id()
+        _flight.note_event("checkpoint", epoch=train_status.epoch_no)
         status = dict(train_status.to_dict())
         ckpt_id = train_status.epoch_no
         final = os.path.join(path, f"checkpoint_{ckpt_id}")
@@ -420,28 +438,34 @@ class AsyncCheckpointer:
 
         def write():
             try:
-                os.makedirs(tmp, exist_ok=True)
-                np.savez(os.path.join(tmp, "params.npz"), **snap)
-                if rng_snap is not None:
-                    np.save(os.path.join(tmp, "rng.npy"), rng_snap)
-                with open(os.path.join(tmp, "train_status.json"), "w") as f:
-                    json.dump(status, f)
-                if os.path.isdir(final):
-                    # rename aside, swap in, then delete: a crash between
-                    # any two steps leaves either the old or the new dir
-                    # under a loadable name (loaders ignore non-
-                    # 'checkpoint_' names), never a missing checkpoint_{id}
-                    old = final + ".old"
-                    if os.path.isdir(old):
-                        shutil.rmtree(old)
-                    os.replace(final, old)
-                    os.replace(tmp, final)
-                    shutil.rmtree(old)
-                else:
-                    os.replace(tmp, final)
-                _cleanup_stale(path, keep)
+                with step_scope(snap_step_id), \
+                        RecordEvent("checkpoint::write",
+                                    epoch=status.get("epoch_no")):
+                    _write_inner()
             except BaseException as e:   # noqa: BLE001 — re-raised on wait
                 self._error = e
+
+        def _write_inner():
+            os.makedirs(tmp, exist_ok=True)
+            np.savez(os.path.join(tmp, "params.npz"), **snap)
+            if rng_snap is not None:
+                np.save(os.path.join(tmp, "rng.npy"), rng_snap)
+            with open(os.path.join(tmp, "train_status.json"), "w") as f:
+                json.dump(status, f)
+            if os.path.isdir(final):
+                # rename aside, swap in, then delete: a crash between
+                # any two steps leaves either the old or the new dir
+                # under a loadable name (loaders ignore non-
+                # 'checkpoint_' names), never a missing checkpoint_{id}
+                old = final + ".old"
+                if os.path.isdir(old):
+                    shutil.rmtree(old)
+                os.replace(final, old)
+                os.replace(tmp, final)
+                shutil.rmtree(old)
+            else:
+                os.replace(tmp, final)
+            _cleanup_stale(path, keep)
 
         os.makedirs(path, exist_ok=True)
         self._thread = self._threading.Thread(target=write, daemon=False)

@@ -3,7 +3,9 @@ STAT_ADD/STAT_RESET macros).
 
 Framework components bump named counters (executor runs, compiles, datafeed
 batches); users read them for observability, same contract as the
-reference's monitor."""
+reference's monitor.  The labeled gauge/histogram tier and the
+JSON/Prometheus export live in ``paddle_tpu.observability.metrics``; this
+registry stays the cheap integer-counter substrate both consume."""
 
 from __future__ import annotations
 
@@ -27,7 +29,11 @@ class StatValue:
             self._value = v
 
     def get(self) -> int:
-        return self._value
+        # under the lock: an unlocked read could observe a torn/stale
+        # value mid-`add` on free-threaded builds, and the snapshot
+        # contract below depends on reads serializing with writes
+        with self._lock:
+            return self._value
 
     def reset(self):
         self.set(0)
@@ -45,10 +51,23 @@ def stat(name: str) -> StatValue:
         return _stats[name]
 
 
+def stats_snapshot() -> Dict[str, int]:
+    """Consistent point-in-time copy of the whole registry — the read
+    the telemetry recorder diffs per step and the flight recorder dumps.
+    The registry is locked only for the key walk; each value read takes
+    its own lock."""
+    with _reg_lock:
+        items = list(_stats.items())
+    return {k: v.get() for k, v in items}
+
+
 def get_all_stats() -> Dict[str, int]:
-    return {k: v.get() for k, v in _stats.items()}
+    return stats_snapshot()
 
 
 def reset_all():
-    for v in _stats.values():
+    """Zero every counter (tests + recorder run boundaries)."""
+    with _reg_lock:
+        values = list(_stats.values())
+    for v in values:
         v.reset()

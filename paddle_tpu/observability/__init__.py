@@ -1,0 +1,34 @@
+"""Run-level telemetry subsystem (observability tentpole, PR 9).
+
+Four layers, each importable alone:
+
+* :mod:`.tracing` — structured spans with attributes on a shared,
+  monotonically increasing ``step_id`` axis (the substrate
+  ``paddle_tpu.profiler`` now sits on);
+* :mod:`.metrics` — labeled counters/gauges/histograms over the legacy
+  ``monitor`` registry, with ``metrics_snapshot()`` JSON export, a
+  Prometheus text endpoint and a stdlib scrape server;
+* :mod:`.recorder` — :class:`TelemetryRecorder`: an append-only JSONL
+  stream per run with per-step wall time, measured MFU (static op-spec
+  FLOPs ÷ wall ÷ device peak, :mod:`.flops`), goodput, loss finiteness
+  and wire/HBM accounting;
+* :mod:`.flight` — the always-on crash flight recorder: a lock-light
+  ring of recent steps/spans dumped as a diagnostic bundle on uncaught
+  executor/serving exceptions and non-finite loss.
+
+See MIGRATION.md "Observability mapping" for the reference
+(platform/profiler.h DeviceTracer, monitor.h STAT macros) → here map.
+"""
+
+from . import tracing, flight, metrics, flops, recorder  # noqa: F401
+from .tracing import (Span, span, traced, next_step_id,          # noqa: F401
+                      current_step_id, set_step_id, step_scope)
+from .metrics import (counter, gauge, histogram,                 # noqa: F401
+                      metrics_snapshot, prometheus_text, serve_metrics)
+from .recorder import TelemetryRecorder, validate_jsonl          # noqa: F401
+
+__all__ = ["tracing", "flight", "metrics", "flops", "recorder",
+           "Span", "span", "traced", "next_step_id", "current_step_id",
+           "set_step_id", "step_scope", "counter", "gauge", "histogram",
+           "metrics_snapshot", "prometheus_text", "serve_metrics",
+           "TelemetryRecorder", "validate_jsonl"]

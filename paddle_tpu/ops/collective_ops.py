@@ -541,3 +541,51 @@ def _local_sgd_sync(ctx, ins, attrs):
         lambda ps: ps,
         params)
     return {"Out": list(outs)}
+
+
+# ---------------------------------------------------------------------------
+# trace-time collective telemetry (observability tentpole)
+# ---------------------------------------------------------------------------
+
+import contextlib as _contextlib
+
+
+def maybe_trace_collective(op, ins, ctx):
+    """Span for one collective op's lowering, or a null context for
+    non-collectives.  Called from the executor's trace loop ONLY while
+    tracing is enabled, so the cost is per-compile, never per-step: the
+    resulting ``collective::<kind>`` spans put every collective dispatch
+    on the merged timeline (correlated to the compiling step's id) with
+    its mesh axis and — when the op_spec ``wire`` channel prices it —
+    logical/wire payload bytes, mirrored into labeled metrics counters."""
+    from .registry import OP_SPECS, VarSig
+    spec = OP_SPECS.get(op.type)
+    if spec is None or not spec.collective:
+        return _contextlib.nullcontext()
+    from ..observability import metrics
+    from ..observability.tracing import Span
+    attrs = {"kind": op.type,
+             "axis": str(op.attrs.get("_axis_name") or
+                         op.attrs.get("ring_id", 0))}
+    wire_fn = getattr(spec, "wire", None)
+    if wire_fn is not None:
+        try:
+            sigs = {slot: [VarSig(tuple(v.shape), str(v.dtype))
+                           if hasattr(v, "shape") else None
+                           for v in vals]
+                    for slot, vals in ins.items()}
+            axis_sizes = {}
+            if ctx.mesh is not None:
+                axis_sizes = {str(k): int(v)
+                              for k, v in dict(ctx.mesh.shape).items()}
+            priced = wire_fn(sigs, op.attrs, axis_sizes)
+        except Exception:       # pricing must not break tracing
+            priced = None
+        if priced is not None:
+            logical, wire = priced
+            attrs["logical_bytes"] = int(logical)
+            attrs["wire_bytes"] = int(wire)
+            metrics.counter("collective_traced_wire_bytes",
+                            kind=op.type).add(int(wire))
+    metrics.counter("collective_traced", kind=op.type).add()
+    return Span("collective::" + op.type, attrs)

@@ -208,6 +208,66 @@ def _infer_matmul(ins, attrs):
     return {"Out": [VarSig(tuple(batch) + (mx, ny), xv.dtype)]}
 
 
+# -- GEMM FLOPs channel (observability/flops.py MFU numerator): forward
+# FLOPs at 2 per MAC from the inferred signatures; None when any needed
+# dim is unknown so the estimate stays a checked number, not a guess
+
+
+def _flops_mul(ins, outs, attrs):
+    xv, yv = _sig(ins, "X"), _sig(ins, "Y")
+    if xv is None or yv is None or xv.shape is None or yv.shape is None:
+        return None
+    xn = int(attrs.get("x_num_col_dims", 1))
+    yn = int(attrs.get("y_num_col_dims", 1))
+    sx, sy = xv.shape, yv.shape
+    if not _known(sx) or not _known(sy):
+        return None
+    return 2.0 * _numel(sx[:xn]) * _numel(sx[xn:]) * _numel(sy[yn:])
+
+
+def _flops_matmul(ins, outs, attrs):
+    xv, yv = _sig(ins, "X"), _sig(ins, "Y")
+    if xv is None or yv is None or xv.shape is None or yv.shape is None \
+            or len(xv.shape) < 2 or len(yv.shape) < 2:
+        return None
+    tx = bool(attrs.get("transpose_X", attrs.get("trans_x", False)))
+    ty = bool(attrs.get("transpose_Y", attrs.get("trans_y", False)))
+    sx, sy = list(xv.shape), list(yv.shape)
+    m, k = (sx[-1], sx[-2]) if tx else (sx[-2], sx[-1])
+    _, n = (sy[-1], sy[-2]) if ty else (sy[-2], sy[-1])
+    batch_x, batch_y = sx[:-2], sy[:-2]
+    batch = batch_x if len(batch_x) >= len(batch_y) else batch_y
+    if not _known((m, k, n)) or not _known(batch):
+        return None
+    return 2.0 * _numel(batch) * m * k * n
+
+
+def _flops_fused_attention(ins, outs, attrs):
+    """QK^T and PV einsums: 2 GEMMs of [B,H,Sq,dh]x[B,H,dh,Sk] —
+    4·B·Sq·Sk·hidden total (head split cancels)."""
+    q, k = _sig(ins, "Q"), _sig(ins, "K")
+    if q is None or q.shape is None or len(q.shape) < 3:
+        return None
+    ksh = k.shape if k is not None and k.shape is not None else q.shape
+    b, sq, hidden = q.shape[0], q.shape[1], q.shape[-1]
+    sk = ksh[1] if len(ksh) > 1 else sq
+    if not _known((b, sq, sk, hidden)):
+        return None
+    return 4.0 * b * sq * sk * hidden
+
+
+def _flops_conv2d(ins, outs, attrs):
+    xv, wv = _sig(ins, "Input"), _sig(ins, "Filter")
+    ov = _sig(outs, "Output") if outs else None
+    if xv is None or wv is None or ov is None or xv.shape is None or \
+            wv.shape is None or ov.shape is None or len(wv.shape) != 4:
+        return None
+    if not _known(ov.shape) or not _known(wv.shape):
+        return None
+    cout, cin_g, kh, kw = wv.shape
+    return 2.0 * _numel(ov.shape) * cin_g * kh * kw
+
+
 def _infer_mean(ins, attrs):
     v = _sig(ins, "X")
     if v is None:
@@ -791,9 +851,9 @@ def register_default_specs():
     op_spec("dropout", infer=_infer_dropout, mem_transparent=True)
 
     # math
-    op_spec("mul", infer=_infer_mul)
-    op_spec("matmul", infer=_infer_matmul)
-    op_spec("matmul_v2", infer=_infer_matmul)
+    op_spec("mul", infer=_infer_mul, flops=_flops_mul)
+    op_spec("matmul", infer=_infer_matmul, flops=_flops_matmul)
+    op_spec("matmul_v2", infer=_infer_matmul, flops=_flops_matmul)
     op_spec("mean", infer=_infer_mean)
     op_spec("sum", infer=_infer_sum)
     for name in ("reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
@@ -804,8 +864,8 @@ def register_default_specs():
     op_spec("cast", infer=_infer_cast, mem_transparent=True)
 
     # nn
-    op_spec("conv2d", infer=_infer_conv2d)
-    op_spec("depthwise_conv2d", infer=_infer_conv2d)
+    op_spec("conv2d", infer=_infer_conv2d, flops=_flops_conv2d)
+    op_spec("depthwise_conv2d", infer=_infer_conv2d, flops=_flops_conv2d)
     op_spec("pool2d", infer=_infer_pool2d)
     op_spec("layer_norm", infer=_infer_layer_norm)
     op_spec("batch_norm", infer=_infer_batch_norm)
@@ -816,7 +876,8 @@ def register_default_specs():
     op_spec("cross_entropy", infer=_infer_cross_entropy)
     op_spec("cross_entropy2", infer=_infer_cross_entropy)
     op_spec("fused_attention", infer=_infer_fused_attention,
-            mem_backward_extra=_attention_probs_bytes)
+            mem_backward_extra=_attention_probs_bytes,
+            flops=_flops_fused_attention)
 
     # tensor manipulation (views are pure aliases)
     op_spec("reshape2", infer=_infer_reshape2, mem_transparent=True)

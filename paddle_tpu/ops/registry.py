@@ -108,34 +108,43 @@ class OpSpec:
       maps mesh axis name → size, or None when the mesh is unknown).
       Consumed by the memory analyzer's wire summary and the
       quant-small-bucket lint.
+    * ``flops(ins, outs, attrs) -> float`` — forward GEMM-class FLOPs
+      (2 per MAC) from the op's inferred input/output signatures; None
+      when shapes are unknown.  Consumed by the telemetry recorder's
+      static MFU numerator
+      (observability/flops.py estimate_step_flops).
     """
 
     __slots__ = ("name", "infer", "collective", "mem_transparent",
-                 "mem_backward_extra", "wire")
+                 "mem_backward_extra", "wire", "flops")
 
     def __init__(self, name: str, infer: Optional[Callable] = None,
                  collective: bool = False,
                  mem_transparent: Optional[bool] = None,
                  mem_backward_extra: Optional[Callable] = None,
-                 wire: Optional[Callable] = None):
+                 wire: Optional[Callable] = None,
+                 flops: Optional[Callable] = None):
         self.name = name
         self.infer = infer
         self.collective = collective
         self.mem_transparent = mem_transparent
         self.mem_backward_extra = mem_backward_extra
         self.wire = wire
+        self.flops = flops
 
 
 def op_spec(name: str, infer: Optional[Callable] = None,
             collective: bool = False,
             mem_transparent: Optional[bool] = None,
             mem_backward_extra: Optional[Callable] = None,
-            wire: Optional[Callable] = None):
+            wire: Optional[Callable] = None,
+            flops: Optional[Callable] = None):
     """Register static metadata for op ``name`` (idempotent per name —
     re-registration replaces, so spec modules can be reloaded)."""
     spec = OpSpec(name, infer=infer, collective=collective,
                   mem_transparent=mem_transparent,
-                  mem_backward_extra=mem_backward_extra, wire=wire)
+                  mem_backward_extra=mem_backward_extra, wire=wire,
+                  flops=flops)
     OP_SPECS[name] = spec
     return spec
 

@@ -8,6 +8,13 @@ buffer; ``stop_profiler`` prints the reference-style aggregated table
 readable at chrome://tracing — the reference needs tools/timeline.py to
 convert its proto, here the trace is written directly.
 
+Since PR 9 the buffer and the enable flag live in
+``paddle_tpu.observability.tracing``: every marker is a structured SPAN
+carrying an attribute dict and the run-level ``step_id``, so the Chrome
+trace correlates host phases, compiles, cache hits, collective dispatches
+and checkpoint writes on one step axis (``args.step_id`` per event).
+This module keeps the reference-shaped API on top.
+
 Device side: the reference uses a CUPTI DeviceTracer; the TPU analog is
 jax.profiler (XPlane/TensorBoard).  ``start_profiler`` forwards to
 ``jax.profiler.start_trace`` when a trace dir is given."""
@@ -16,52 +23,37 @@ from __future__ import annotations
 
 import contextlib
 import json
-import threading
-import time
 from typing import List, Optional
 
-_enabled = False
-_events: List[tuple] = []   # (name, start_ns, end_ns, tid)
-_lock = threading.Lock()
+from .observability import tracing
+from .observability.tracing import Span as RecordEvent   # noqa: F401 — API
+
 _jax_trace_dir: Optional[str] = None
+_tracer_option: str = "Default"
+
+#: reference tracer options (fluid/profiler.py): Default = framework
+#: markers only; OpDetail/AllOpDetail additionally keep per-op spans the
+#: collective/compile layers emit at trace time
+TRACER_OPTIONS = ("Default", "OpDetail", "AllOpDetail")
 
 
 def is_profiler_enabled() -> bool:
-    return _enabled
+    return tracing.is_enabled()
 
 
-class RecordEvent:
-    """RAII host event marker (ref: platform/profiler.h:201).  Cheap no-op
-    when the profiler is off."""
-
-    def __init__(self, name: str):
-        self.name = name
-        self._start = None
-
-    def __enter__(self):
-        if _enabled:
-            self._start = time.perf_counter_ns()
-        return self
-
-    def __exit__(self, *exc):
-        if self._start is not None:
-            end = time.perf_counter_ns()
-            with _lock:
-                _events.append((self.name, self._start, end,
-                                threading.get_ident()))
-        return False
+def tracer_option() -> str:
+    return _tracer_option
 
 
 @contextlib.contextmanager
-def record_event(name: str):
-    with RecordEvent(name):
+def record_event(name: str, **attrs):
+    with RecordEvent(name, attrs or None):
         yield
 
 
 def reset_profiler():
     """ref: fluid/profiler.py reset_profiler."""
-    with _lock:
-        _events.clear()
+    tracing.clear_events()
 
 
 def start_profiler(state: str = "All", tracer_option: str = "Default",
@@ -69,10 +61,14 @@ def start_profiler(state: str = "All", tracer_option: str = "Default",
     """ref: fluid/profiler.py start_profiler.  ``state`` in
     {CPU, GPU, All} — device states additionally start a jax.profiler trace
     when ``trace_dir`` is given (TensorBoard XPlane, the CUPTI analog)."""
-    global _enabled, _jax_trace_dir
+    global _jax_trace_dir, _tracer_option
     if state not in ("CPU", "GPU", "All"):
         raise ValueError("state must be 'CPU', 'GPU' or 'All'")
-    _enabled = True
+    if tracer_option not in TRACER_OPTIONS:
+        raise ValueError(f"tracer_option must be one of {TRACER_OPTIONS}, "
+                         f"got {tracer_option!r}")
+    _tracer_option = tracer_option
+    tracing.enable()
     if trace_dir and state in ("GPU", "All"):
         import jax
         try:
@@ -85,18 +81,23 @@ def start_profiler(state: str = "All", tracer_option: str = "Default",
 def stop_profiler(sorted_key: str = "total",
                   profile_path: Optional[str] = None):
     """ref: fluid/profiler.py stop_profiler — prints the aggregated event
-    table; writes a Chrome trace JSON to ``profile_path`` if given."""
-    global _enabled, _jax_trace_dir
-    _enabled = False
+    table; writes a Chrome trace JSON to ``profile_path`` if given.
+
+    State restoration is exception-safe: a raising
+    ``jax.profiler.stop_trace`` (backend died mid-trace) still clears
+    ``_jax_trace_dir`` and the enabled flag, so the next
+    ``start_profiler`` starts clean instead of double-stopping."""
+    global _jax_trace_dir
+    tracing.disable()
     if _jax_trace_dir is not None:
         import jax
         try:
             jax.profiler.stop_trace()
         except Exception:
             pass
-        _jax_trace_dir = None
-    with _lock:
-        events = list(_events)
+        finally:
+            _jax_trace_dir = None
+    events = tracing.get_events()
     if profile_path:
         save_chrome_trace(profile_path, events)
     _print_summary(events, sorted_key)
@@ -104,22 +105,34 @@ def stop_profiler(sorted_key: str = "total",
 
 
 def save_chrome_trace(path: str, events=None):
-    """Chrome trace (tools/timeline.py output format parity)."""
-    with _lock:
-        events = list(_events) if events is None else events
-    trace = {"traceEvents": [
-        {"name": name, "cat": "host", "ph": "X",
-         "ts": start / 1e3,                 # chrome wants microseconds
-         "dur": (end - start) / 1e3,
-         "pid": 0, "tid": tid}
-        for name, start, end, tid in events]}
+    """Chrome trace (tools/timeline.py input format): one ``X`` event per
+    span with its attributes (incl. ``step_id``) under ``args``, plus
+    ``thread_name`` metadata per tid so merged multi-process traces keep
+    readable lanes."""
+    if events is None:
+        events = tracing.get_events()
+    trace_events = [
+        {"name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+         "args": {"name": tname}}
+        for tid, tname in sorted(tracing.thread_names().items())]
+    for ev in events:
+        name, start, end, tid = ev[0], ev[1], ev[2], ev[3]
+        attrs = ev[4] if len(ev) > 4 else None
+        rec = {"name": name, "cat": "host", "ph": "X",
+               "ts": start / 1e3,                 # chrome wants microseconds
+               "dur": (end - start) / 1e3,
+               "pid": 0, "tid": tid}
+        if attrs:
+            rec["args"] = attrs
+        trace_events.append(rec)
     with open(path, "w") as f:
-        json.dump(trace, f)
+        json.dump({"traceEvents": trace_events}, f, default=str)
 
 
 def _print_summary(events, sorted_key):
     agg = {}
-    for name, start, end, _ in events:
+    for ev in events:
+        name, start, end = ev[0], ev[1], ev[2]
         ms = (end - start) / 1e6
         c = agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
         c[0] += 1
@@ -143,9 +156,13 @@ def _print_summary(events, sorted_key):
 @contextlib.contextmanager
 def profiler(state: str = "All", sorted_key: str = "total",
              profile_path: Optional[str] = None,
-             trace_dir: Optional[str] = None):
-    """ref: fluid/profiler.py profiler context manager."""
-    start_profiler(state, trace_dir=trace_dir)
+             trace_dir: Optional[str] = None,
+             tracer_option: str = "Default"):
+    """ref: fluid/profiler.py profiler context manager.  ``tracer_option``
+    is forwarded to :func:`start_profiler` (it used to be silently
+    dropped)."""
+    start_profiler(state, tracer_option=tracer_option,
+                   trace_dir=trace_dir)
     try:
         yield
     finally:
@@ -153,8 +170,7 @@ def profiler(state: str = "All", sorted_key: str = "total",
 
 
 def get_events():
-    with _lock:
-        return list(_events)
+    return tracing.get_events()
 
 
 # ---------------------------------------------------------------------------
@@ -181,6 +197,11 @@ SERVING_PHASES = ("serving::wait", "serving::pad", "serving::pack",
 # fresh compile to disk
 AOT_CACHE_PHASES = ("aot_cache::load", "aot_cache::save")
 
+# the async checkpointer's phases (io.py): the synchronous device→host
+# snapshot (a training-thread stall the telemetry recorder attributes)
+# and the background write
+CHECKPOINT_PHASES = ("checkpoint::snapshot", "checkpoint::write")
+
 
 def step_breakdown(events=None):
     """Aggregate the prepared fast path's and the serving engine's
@@ -193,11 +214,12 @@ def step_breakdown(events=None):
     _FeedDeviceCache hit/miss counters and its live
     ``flag("feed_cache_size")`` capacity."""
     if events is None:
-        with _lock:
-            events = list(_events)
-    phases = PREPARED_PHASES + SERVING_PHASES + AOT_CACHE_PHASES
+        events = tracing.get_events()
+    phases = PREPARED_PHASES + SERVING_PHASES + AOT_CACHE_PHASES + \
+        CHECKPOINT_PHASES
     out = {}
-    for name, start, end, _ in events:
+    for ev in events:
+        name, start, end = ev[0], ev[1], ev[2]
         if name in phases:
             rec = out.setdefault(name, {"calls": 0, "total_ms": 0.0})
             rec["calls"] += 1

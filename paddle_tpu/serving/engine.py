@@ -61,6 +61,8 @@ import numpy as np
 
 from ..framework.errors import (ExecutionTimeoutError, InvalidArgumentError,
                                 UnavailableError)
+from ..observability import flight as _flight
+from ..observability.tracing import next_step_id, step_scope
 from ..profiler import RecordEvent, register_serving_engine
 
 
@@ -311,7 +313,7 @@ class _Batch:
     to completion."""
 
     __slots__ = ("picked", "bucket_b", "bucket_s", "rows_total",
-                 "placements", "handles")
+                 "placements", "handles", "step_id")
 
     def __init__(self, picked, bucket_b, bucket_s, rows_total,
                  placements=None):
@@ -321,6 +323,9 @@ class _Batch:
         self.rows_total = rows_total
         self.placements = placements
         self.handles = None
+        # every micro-batch gets its own run-level step id; the worker
+        # pins it (step_scope) so assemble/dispatch/split spans correlate
+        self.step_id = None
 
 
 class ServingEngine:
@@ -707,22 +712,37 @@ class ServingEngine:
         """Assemble + dispatch one batch; device execution proceeds while
         the worker loops back for the next batch (continuous batching)."""
         cfg = self.config
+        batch.step_id = next_step_id()
+        _flight.note_step(batch.step_id, "serving_batch",
+                          (batch.bucket_b, batch.bucket_s))
         try:
-            if cfg.packing:
-                with RecordEvent("serving::pack"):
-                    feed, placements, (bb, bs) = pack_requests(
-                        [r.feed for r in batch.picked], cfg,
-                        self._feed_names)
-                    batch.placements = placements
-                    batch.bucket_b, batch.bucket_s = bb, bs
-            else:
-                with RecordEvent("serving::pad"):
-                    feed = self._assemble(batch.picked, batch.rows_total,
-                                          batch.bucket_b, batch.bucket_s)
-            self._record_bucket(feed, batch.bucket_b, batch.bucket_s)
-            with RecordEvent("serving::run"), self._run_lock:
-                batch.handles = self._run_async(feed)
+            with step_scope(batch.step_id):
+                if cfg.packing:
+                    with RecordEvent("serving::pack",
+                                     requests=len(batch.picked)):
+                        feed, placements, (bb, bs) = pack_requests(
+                            [r.feed for r in batch.picked], cfg,
+                            self._feed_names)
+                        batch.placements = placements
+                        batch.bucket_b, batch.bucket_s = bb, bs
+                else:
+                    with RecordEvent("serving::pad",
+                                     requests=len(batch.picked)):
+                        feed = self._assemble(batch.picked,
+                                              batch.rows_total,
+                                              batch.bucket_b,
+                                              batch.bucket_s)
+                self._record_bucket(feed, batch.bucket_b, batch.bucket_s)
+                with RecordEvent("serving::run",
+                                 bucket=f"{batch.bucket_b}x"
+                                        f"{batch.bucket_s}"), \
+                        self._run_lock:
+                    batch.handles = self._run_async(feed)
         except BaseException as e:
+            _flight.dump("serving_dispatch_exception", exc=e,
+                         extra={"step": batch.step_id,
+                                "bucket": (batch.bucket_b, batch.bucket_s),
+                                "requests": len(batch.picked)})
             for req in batch.picked:
                 if not req.future.done():
                     req.future.set_exception(e)
@@ -739,13 +759,18 @@ class ServingEngine:
         per request."""
         cfg = self.config
         try:
-            with RecordEvent("serving::split"):
+            with step_scope(batch.step_id), \
+                    RecordEvent("serving::split"):
                 outs = [h.numpy() for h in batch.handles]
                 if cfg.packing:
                     self._split_packed(batch, outs)
                 else:
                     self._split_padded(batch, outs)
         except BaseException as e:
+            _flight.dump("serving_complete_exception", exc=e,
+                         extra={"step": batch.step_id,
+                                "bucket": (batch.bucket_b, batch.bucket_s),
+                                "requests": len(batch.picked)})
             for req in batch.picked:
                 if not req.future.done():
                     req.future.set_exception(e)

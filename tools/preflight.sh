@@ -16,6 +16,11 @@ echo "== preflight: serve_bench (ragged-packing parity + padding-waste"
 echo "   bound, AOT-cache cold/warm restart, ServingFleet HBM admission) =="
 python tools/serve_bench.py --selftest
 
+echo "== preflight: observability probe (telemetry JSONL schema, MFU in"
+echo "   (0,1] within 10% of the analytic model, flight bundle on induced"
+echo "   NaN, perfetto timeline merge) =="
+python tools/obs_probe.py --selftest
+
 echo "== preflight: auto-shard plan probe (dp8 BERT-tiny tp2: >=6 configs"
 echo "   priced, winner min-wire among budget-fitting, 0 compiles) =="
 python tools/plan_probe.py --selftest

@@ -1,19 +1,36 @@
-"""Merge per-process profiler traces into one Chrome trace
+"""Merge per-process profiler traces into one Chrome/Perfetto trace
 (ref: tools/timeline.py:32,115 — the reference converts profiler protos;
 here each process already writes Chrome JSON via
 ``profiler.stop_profiler(profile_path=...)`` and this tool merges them,
 one chrome `pid` per training process).
 
+The merge preserves correlation structure, not just events:
+
+* ``thread_name`` metadata (``ph: "M"``) survives per ``tid``, so a
+  merged trace still labels the serving worker / checkpoint-writer /
+  main-loop lanes each process recorded;
+* every process gets a ``process_sort_index`` equal to its position on
+  the command line, so trainer0..trainerN render top-to-bottom in
+  trainer order instead of chrome's load order;
+* span attributes (``args`` — including the run-level ``step_id`` the
+  observability tracer attaches) pass through untouched, which is what
+  makes "find step 4217 across all processes" a timeline query.
+
 Usage:
     python tools/timeline.py --profile_path trainer0.json,trainer1.json \
-        --timeline_path merged.json
+        --timeline_path merged.json [--perfetto]
+
+``--perfetto`` gzips the same JSON (Perfetto's UI and `trace_processor`
+ingest gzipped Chrome JSON directly); ``.gz`` is appended to the output
+path unless already present.
 """
 
 import argparse
+import gzip
 import json
 
 
-def merge(paths, out_path):
+def merge(paths, out_path, perfetto=False):
     merged = {"traceEvents": []}
     for pid, path in enumerate(paths):
         name = path
@@ -24,13 +41,22 @@ def merge(paths, out_path):
         merged["traceEvents"].append(
             {"name": "process_name", "ph": "M", "pid": pid,
              "args": {"name": name}})
+        merged["traceEvents"].append(
+            {"name": "process_sort_index", "ph": "M", "pid": pid,
+             "args": {"sort_index": pid}})
         for ev in trace.get("traceEvents", []):
             ev = dict(ev)
             ev["pid"] = pid
             merged["traceEvents"].append(ev)
-    with open(out_path, "w") as f:
-        json.dump(merged, f)
-    return len(merged["traceEvents"])
+    if perfetto:
+        if not out_path.endswith(".gz"):
+            out_path += ".gz"
+        with gzip.open(out_path, "wt") as f:
+            json.dump(merged, f)
+    else:
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+    return len(merged["traceEvents"]), out_path
 
 
 def main():
@@ -39,9 +65,13 @@ def main():
                     help="comma-separated trace files, optionally "
                          "'displayname:file.json'")
     ap.add_argument("--timeline_path", type=str, required=True)
+    ap.add_argument("--perfetto", action="store_true",
+                    help="write gzipped JSON (Perfetto-ingestable); "
+                         "appends .gz to --timeline_path if needed")
     args = ap.parse_args()
-    n = merge(args.profile_path.split(","), args.timeline_path)
-    print(f"wrote {n} events to {args.timeline_path}")
+    n, out = merge(args.profile_path.split(","), args.timeline_path,
+                   perfetto=args.perfetto)
+    print(f"wrote {n} events to {out}")
 
 
 if __name__ == "__main__":
