@@ -176,6 +176,20 @@ class DistributedStrategy:
         self.quant_allreduce = False
         self.quant_configs = {"dtype": "int8", "block_size": 256,
                               "stochastic_rounding": False}
+        # overlap-aware collective scheduling (compiler.insert_grad_sync
+        # ready-order buckets + executor custom-vjp hooks): grad-sync
+        # buckets split by gradient ready rank (last layer first) and
+        # fire INSIDE the backward sweep, so wire time hides under the
+        # remaining backward compute instead of serialising at the
+        # program tail.  Composes with fuse/bf16/quant tiers (implies
+        # bucketing).  ``bucket_mb`` is the overlap-tuned size cap
+        # (smaller than fuse_grad_size_in_MB — one giant bucket has
+        # nothing to hide behind); ``min_buckets`` re-splits a dtype
+        # group that would coalesce further; ``prefetch_distance``
+        # issues ZeRO-3 fsdp_all_gathers that many layers early under
+        # auto_shard (layer k+1's gather rides layer k's window).
+        self.overlap_grad_sync = False
+        self.overlap_configs = {"bucket_mb": 4, "min_buckets": 4}
         self.mesh = None              # explicit jax Mesh override
         # auto-sharding planner (framework/shard_planner.py): search
         # every legal (data, fsdp, tp) factorization of the device count
@@ -192,6 +206,7 @@ class DistributedStrategy:
             "num_devices": None,       # None → jax.device_count()
             "feed_shapes": None,       # {name: (shape, dtype)} for exact
             "report_path": None,       # write PLAN_SEARCH json here
+            "fsdp_prefetch_distance": 0,   # gather k layers early
         }
         # execution/build strategies accepted and largely absorbed by XLA
         self.exec_strategy = None
@@ -357,6 +372,11 @@ class CollectiveOptimizer:
                     "DistributedStrategy: auto_shard prices per-step grad "
                     "sync that localsgd removes — the cost model would be "
                     "wrong; pick one")
+        if getattr(s, "overlap_grad_sync", False) and s.localsgd:
+            raise ValueError(
+                "DistributedStrategy: overlap_grad_sync schedules the "
+                "per-step grad collectives that localsgd removes — the "
+                "combination is contradictory")
         if s.localsgd and s.gradient_merge:
             raise ValueError(
                 "DistributedStrategy: localsgd and gradient_merge both "
@@ -469,6 +489,11 @@ class CollectiveOptimizer:
         build.fuse_all_reduce_ops = bool(getattr(s, "fuse_all_reduce_ops",
                                                  False))
         build.fuse_grad_size_in_MB = getattr(s, "fuse_grad_size_in_MB", 32)
+        if getattr(s, "overlap_grad_sync", False):
+            ov = dict(getattr(s, "overlap_configs", None) or {})
+            build.overlap_grad_sync = True
+            build.overlap_bucket_size_in_MB = ov.get("bucket_mb", 4)
+            build.overlap_min_buckets = ov.get("min_buckets", 4)
         if getattr(s, "bf16_allreduce", False):
             build.allreduce_compress_dtype = "bfloat16"
         if getattr(s, "quant_allreduce", False):
@@ -527,8 +552,10 @@ class CollectiveOptimizer:
             max_tp=cfgs.get("max_tp"), min_shard_numel=min_numel,
             module="auto_shard",
             report_path=cfgs.get("report_path"))
-        layout = stamp_winning_layout(program, plan,
-                                      min_shard_numel=min_numel)
+        layout = stamp_winning_layout(
+            program, plan, min_shard_numel=min_numel,
+            prefetch_distance=int(cfgs.get("fsdp_prefetch_distance")
+                                  or 0))
         fleet._plan = plan
         fleet._origin_program = program
         mesh = layout.build_mesh()

@@ -123,6 +123,22 @@ _register("flight_dump_dir", "")
 # MFU denominator override in FLOP/s (observability/flops.py): 0 = auto
 # from the device-kind peak table (TPU generations) with a CPU fallback
 _register("device_peak_flops", 0.0)
+# overlap-aware collective scheduling (compiler.insert_grad_sync +
+# executor.lower_block_with_backward): when a strategy requests
+# overlap_grad_sync, ready-ordered grad-sync buckets are emitted INSIDE
+# the backward sweep (each bucket's fused all-reduce fires right after
+# its last contributing backward op) via custom-vjp hooks, so wire time
+# hides under the remaining backward compute.  This flag is the lowering
+# switch: off, the same ready-ordered buckets trace at program tail
+# (identical IR, identical math — the bit-parity baseline
+# tests/test_overlap.py compares against).
+_register("overlap_lowering", True)
+# assumed ICI ring bandwidth in GB/s per device for the STATIC
+# exposed-comm roofline (memory_analysis.exposed_comm_model):
+# wire_time = wire_bytes / (ici_gbps · 1e9).  The default is a v5e-class
+# per-chip ICI figure; override per fabric.  Only the ranking between
+# configs consumes it, so absolute accuracy matters less than ordering.
+_register("ici_gbps", 90.0)
 # quant-small-bucket lint threshold (framework/analysis.py, surfaced by
 # tools/proglint.py): a blockwise-quantized collective whose payload is
 # under this many KiB pays more in per-block scale tensors + the extra
@@ -167,3 +183,35 @@ def set_flags(flags: Dict[str, Any]):
 def flag(name: str):
     """Internal fast accessor."""
     return _REGISTRY[name]
+
+
+#: XLA flags that let the compiler's latency-hiding scheduler keep the
+#: ready-ordered grad-sync collectives where the trace put them (async
+#: collectives overlapped with compute instead of re-sunk to the tail).
+#: These are process-start flags — they must be in XLA_FLAGS before the
+#: first backend touch, which is why they are plumbed as data here
+#: instead of set_flags entries.
+OVERLAP_XLA_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+)
+
+
+def overlap_xla_flags():
+    """The XLA latency-hiding-scheduler flag strings the overlap
+    scheduler wants active on TPU (see OVERLAP_XLA_FLAGS)."""
+    return list(OVERLAP_XLA_FLAGS)
+
+
+def apply_overlap_xla_flags(environ=None):
+    """Append any missing overlap XLA flags to ``XLA_FLAGS`` in
+    ``environ`` (default ``os.environ``).  Call BEFORE the first jax
+    backend initialisation; returns the flags that were added."""
+    env = os.environ if environ is None else environ
+    current = env.get("XLA_FLAGS", "")
+    added = [f for f in OVERLAP_XLA_FLAGS if f not in current]
+    if added:
+        env["XLA_FLAGS"] = (current + " " + " ".join(added)).strip()
+    return added

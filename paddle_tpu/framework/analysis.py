@@ -57,6 +57,10 @@ BF16_ALLREDUCE_INTEGER = "bf16-allreduce-integer"
 QUANT_COLLECTIVE_INTEGER = "quant-collective-integer"
 QUANT_NON_SUM = "quant-collective-non-sum"
 QUANT_SMALL_BUCKET = "quant-small-bucket"
+# overlap-aware collective scheduling soundness (the ready-order bucket
+# pass — compiler.insert_grad_sync under strategy.overlap_grad_sync)
+OVERLAP_SINGLE_BUCKET = "overlap-single-bucket"
+OVERLAP_TAIL_SUNK = "overlap-tail-sunk"
 DONATED_VAR_FETCHED = "donated-var-fetched"
 READ_AFTER_DONATE = "read-after-donate"
 # named-axis layout soundness (the MeshLayout/ShardSpec contract —
@@ -606,6 +610,51 @@ def verify_distributed(program: Program, result: VerifyResult,
                 f"bucket full-precision",
                 op, block.idx, idx)
 
+    # (b3) overlap-aware grad-sync soundness (compiler.insert_grad_sync
+    # ready-order buckets).  Two misuse classes: (i) overlap requested
+    # but a (dtype, axes) group coalesced into ONE bucket — a single
+    # collective has no peer to interleave with, so nothing can hide
+    # (raise overlap_min_buckets / shrink overlap_bucket_size_in_MB);
+    # (ii) a ready-ordered collective with no usable hook position —
+    # the lowering cannot fire it inside the backward sweep, so it
+    # sinks to the program tail with no backward compute after it.
+    ov_groups: Dict[Any, List[int]] = {}
+    for idx, op in enumerate(block.ops):
+        if not op.attrs.get("_overlap") or op.type not in collectives:
+            continue
+        dt = None
+        for n in op.input_names():
+            v = block._find_var_recursive(n)
+            if v is not None:
+                dt = str(v.dtype)
+                break
+        key = (dt, str(op.attrs.get("_axis_name") or
+                       op.attrs.get("ring_id", 0)))
+        ov_groups.setdefault(key, []).append(idx)
+        if op.attrs.get("_overlap_hook_pos") is None:
+            result.add(
+                "warning", OVERLAP_TAIL_SUNK,
+                f"ready-ordered collective {op.type!r} "
+                f"({sorted(op.input_names())}) has no overlap hook "
+                f"position — its bucket's params have no recorded "
+                f"forward use, so the collective traces at the program "
+                f"tail with no backward compute left to hide it",
+                op, block.idx, idx)
+    for (dt, axes), idxs in sorted(ov_groups.items(),
+                                   key=lambda kv: kv[1][0]):
+        if len(idxs) == 1:
+            idx = idxs[0]
+            op = block.ops[idx]
+            result.add(
+                "warning", OVERLAP_SINGLE_BUCKET,
+                f"overlap_grad_sync requested but the ({dt}, {axes}) "
+                f"gradient group coalesced into ONE bucket "
+                f"({op.type!r}) — a lone collective cannot interleave "
+                f"with later backward compute, so nothing hides; "
+                f"shrink overlap_bucket_size_in_MB or raise "
+                f"overlap_min_buckets",
+                op, block.idx, idx)
+
     # (c) donation/aliasing conflicts (the PR 2 bug class).  State vars
     # (persistables written by the program) are donated on the jit
     # boundary; a fetch of the same name aliases a buffer the NEXT step's
@@ -1010,6 +1059,7 @@ def check_pass_invariants(program: Program, pass_name: str,
 __all__ = [
     "Diagnostic", "VerifyResult", "PassInvariantError",
     "QUANT_COLLECTIVE_INTEGER", "QUANT_NON_SUM", "QUANT_SMALL_BUCKET",
+    "OVERLAP_SINGLE_BUCKET", "OVERLAP_TAIL_SUNK",
     "SHARD_LAYOUT_UNKNOWN_AXIS", "SHARD_LAYOUT_COLLECTIVE_MISMATCH",
     "verify_program", "verify_inference", "verify_cached",
     "clear_verify_cache",

@@ -65,6 +65,21 @@ class BuildStrategy:
         # None = no quantization.  Mutually exclusive with
         # allreduce_compress_dtype (fleet validates the strategy flags).
         self.allreduce_quant_spec = None
+        # overlap-aware collective scheduling: split the fused buckets by
+        # gradient READY rank (reverse layer order — the last layer's
+        # grads are final first in the reverse sweep) and emit each
+        # bucket's fused all-reduce immediately after its last
+        # contributing backward op instead of at program tail, so wire
+        # time hides under the remaining backward compute ("Automatic
+        # Cross-Replica Sharding of Weight Update", arXiv:2004.13336's
+        # core overlap trick).  Implies bucketing.  The overlap cap is
+        # deliberately smaller than fuse_grad_size_in_MB (one giant
+        # bucket leaves nothing to hide behind), and a (dtype, axes)
+        # group is re-split to ≥ overlap_min_buckets buckets when the
+        # cap alone would coalesce it further.
+        self.overlap_grad_sync = False
+        self.overlap_bucket_size_in_MB = 4
+        self.overlap_min_buckets = 4
         # off by default like the reference (build_strategy.h); XLA fuses
         # elementwise chains anyway — enabling only shrinks the op list
         self.fuse_elewise_add_act_ops = False
@@ -270,6 +285,22 @@ def _qscale_blocks(numel, p_axes, qspec, axis_sizes):
     return padded // qspec.block_size
 
 
+def _bucketize(group, cap):
+    """Split one (dtype, axes) group's leaves ``(grad, nbytes, hook)``
+    into contiguous size-capped buckets, each carrying the MIN hook
+    position over its members (None member poisons the bucket — the
+    reverse sweep cannot fire its collective early)."""
+    buckets = []
+    for g, nbytes, hook in group:
+        if buckets and (cap is None or buckets[-1][1] + nbytes <= cap):
+            names, size, h = buckets[-1]
+            h = None if (h is None or hook is None) else min(h, hook)
+            buckets[-1] = (names + [g], size + nbytes, h)
+        else:
+            buckets.append(([g], nbytes, hook))
+    return buckets
+
+
 def insert_grad_sync(program: Program, strategy, nranks, reduce_axes,
                      axis_sizes=None):
     """Insert the per-step gradient sync after the backward op — the
@@ -296,7 +327,21 @@ def insert_grad_sync(program: Program, strategy, nranks, reduce_axes,
     MoE experts, ZeRO-3 fsdp shards whose gradients arrive pre-reduced
     through the transposed ``fsdp_all_gather``) reduces only over the
     REMAINING axes; the mean-loss 1/n scale is per-token and always
-    applies at full ``nranks``."""
+    applies at full ``nranks``.
+
+    With ``strategy.overlap_grad_sync`` the bucketed path switches to
+    READY-ORDER scheduling: buckets are split by gradient ready rank
+    (descending first-forward-use — the order cotangents become final in
+    the reverse sweep), capped at the overlap-tuned
+    ``overlap_bucket_size_in_MB`` (re-split to ≥ ``overlap_min_buckets``
+    per dtype-group when the cap alone would coalesce further), and each
+    bucket op carries ``_overlap``/``_ready_rank``/``_bucket_index``/
+    ``_overlap_hook_pos`` attrs.  The executor's lowering reads the hook
+    position (an index into the non-feed forward op list) and fires the
+    bucket's collective INSIDE the backward sweep via a custom-vjp
+    identity hook on the bucket's params, so the collective lands right
+    after its last contributing backward op in the lowered module
+    instead of at the tail (see lower_block_with_backward)."""
     from .mesh_layout import _flat_axes
 
     block = program.global_block()
@@ -322,7 +367,24 @@ def insert_grad_sync(program: Program, strategy, nranks, reduce_axes,
     all_axes = tuple(reduce_axes) if isinstance(reduce_axes, (tuple, list)) \
         else (reduce_axes or "dp",)
 
-    leaves = []          # (grad_name, p_axes, dtype, nbytes)
+    overlap = bool(getattr(strategy, "overlap_grad_sync", False))
+    first_use = {}
+    if overlap:
+        # first forward read per param, indexed over the executor's op
+        # space (feed/fetch filtered out) — the custom-vjp hook wraps the
+        # param right before this op, so its transpose (the bucket's
+        # collective) fires as soon as every member's cotangent is final
+        from .analysis import op_reads_recursive
+        want = set(bw.attrs["param_names"])
+        pos = 0
+        for op in block.ops[:bw_idx]:
+            if op.type in ("feed", "fetch"):
+                continue
+            for n in (op_reads_recursive(op) & want):
+                first_use.setdefault(n, pos)
+            pos += 1
+
+    leaves = []          # (grad_name, p_axes, dtype, nbytes, first_use)
     for pname in bw.attrs["param_names"]:
         pvar = block._find_var_recursive(pname)
         if pvar is not None and getattr(pvar, "is_distributed", False):
@@ -337,12 +399,13 @@ def insert_grad_sync(program: Program, strategy, nranks, reduce_axes,
         numel = int(abs(np.prod(pvar.shape))) if pvar is not None and \
             len(tuple(pvar.shape)) else 1
         nbytes = numel * _DTYPE_BYTES.get(dtype, 4)
-        leaves.append((grad_var_name(pname), p_axes, dtype, nbytes))
+        leaves.append((grad_var_name(pname), p_axes, dtype, nbytes,
+                       first_use.get(pname)))
 
     _FLOAT_DTYPES = ("float32", "float64", "float16", "bfloat16")
 
-    if not getattr(strategy, "fuse_all_reduce_ops", False):
-        for g, p_axes, dtype, _ in leaves:
+    if not getattr(strategy, "fuse_all_reduce_ops", False) and not overlap:
+        for g, p_axes, dtype, _, _ in leaves:
             if need_scale:
                 block._insert_op(insert_at, type="scale",
                                  inputs={"X": [g]}, outputs={"Out": [g]},
@@ -366,59 +429,99 @@ def insert_grad_sync(program: Program, strategy, nranks, reduce_axes,
 
     # -- bucketed path ------------------------------------------------
     cap_mb = getattr(strategy, "fuse_grad_size_in_MB", 32) or 0
+    if overlap:
+        ov_mb = getattr(strategy, "overlap_bucket_size_in_MB", 4) or 0
+        cap_mb = min(cap_mb, ov_mb) if cap_mb > 0 and ov_mb > 0 \
+            else (cap_mb or ov_mb)
+        # ready order: descending first forward use — the reverse sweep
+        # finalises a param's cotangent when it passes the param's first
+        # use, so later-used (deeper) params' grads are ready first.
+        # Unread params (first_use None) sort last: their sync has no
+        # backward compute left to hide under (the overlap-tail-sunk
+        # lint names them).
+        leaves = sorted(leaves,
+                        key=lambda t: -1 if t[4] is None else t[4],
+                        reverse=True)
     cap = int(cap_mb * (1 << 20)) if cap_mb > 0 else None
-    groups = {}          # (dtype, p_axes) -> list of buckets
+    group_leaves = {}    # (dtype, p_axes) -> [(grad, nbytes, hook), ...]
     order = []
-    for g, p_axes, dtype, nbytes in leaves:
+    for g, p_axes, dtype, nbytes, fuse_pos in leaves:
         key = (dtype, p_axes)
-        if key not in groups:
-            groups[key] = [([], 0)]
+        if key not in group_leaves:
+            group_leaves[key] = []
             order.append(key)
-        names, size = groups[key][-1]
-        if names and cap is not None and size + nbytes > cap:
-            groups[key].append(([g], nbytes))
-        else:
-            groups[key][-1] = (names + [g], size + nbytes)
-    for key in order:
+        group_leaves[key].append((g, nbytes, fuse_pos))
+    if overlap:
+        min_buckets = int(getattr(strategy, "overlap_min_buckets", 4) or 0)
+        flat = []
+        for key in order:
+            ls = group_leaves[key]
+            gcap = cap
+            if min_buckets > 1 and len(ls) >= min_buckets:
+                # overlap-tuned cap: one giant bucket has nothing to
+                # hide behind, so shrink the cap until the group splits
+                # into ≥ min_buckets buckets (leaf granularity allowing)
+                auto = -(-sum(n for _, n, _ in ls) // min_buckets)
+                gcap = auto if gcap is None else min(gcap, auto)
+            flat.extend((key, b) for b in _bucketize(ls, gcap))
+        # emit in global ready order (descending hook position) so the
+        # IR op order matches the order the collectives fire in the
+        # lowered module; unhookable buckets (hook None) go last
+        flat.sort(key=lambda kb: -1 if kb[1][2] is None else kb[1][2],
+                  reverse=True)
+        ranked = [(key, names, bucket_bytes, hook, rank)
+                  for rank, (key, (names, bucket_bytes, hook))
+                  in enumerate(flat)]
+    else:
+        ranked = [(key, names, bucket_bytes, None, None)
+                  for key in order
+                  for names, bucket_bytes, _
+                  in _bucketize(group_leaves[key], cap)]
+    for key, names, bucket_bytes, hook_pos, ready_rank in ranked:
         dtype, p_axes = key
-        for names, bucket_bytes in groups[key]:
-            if not p_axes:
-                # nothing to reduce over (fully sharded param): the
-                # mean-scale still applies, per leaf
-                if need_scale:
-                    for g in names:
-                        block._insert_op(
-                            insert_at, type="scale",
-                            inputs={"X": [g]}, outputs={"Out": [g]},
-                            attrs={"scale": 1.0 / nranks})
-                        insert_at += 1
-                continue
-            attrs = {"ring_id": 0,
-                     "_axis_name": tuple(p_axes)
-                     if len(p_axes) > 1 else p_axes[0]}
+        if not p_axes:
+            # nothing to reduce over (fully sharded param): the
+            # mean-scale still applies, per leaf
             if need_scale:
-                attrs["scale"] = 1.0 / nranks
-            op_type = "c_fused_allreduce_sum"
-            outputs = {"Out": list(names)}
-            if qspec is not None and dtype in _FLOAT_DTYPES:
-                # quantized bucket: the per-bucket stage-2 scale
-                # tensor rides alongside the payload — declare it as
-                # a real var so the static layer (memory analyzer,
-                # census readers) prices the scales, not just the
-                # int payload
-                op_type = "c_fused_quant_allreduce_sum"
-                attrs["quant_spec"] = qspec.to_attr()
-                numel = bucket_bytes // _DTYPE_BYTES.get(dtype, 4)
-                sv = block.create_var(
-                    name=f"{names[0]}@quant_scale",
-                    shape=(_qscale_blocks(numel, p_axes, qspec,
-                                          axis_sizes),),
-                    dtype="float32")
-                outputs["QScale"] = [sv.name]
-            elif compress:
-                attrs["compress_dtype"] = compress
-            block._insert_op(insert_at, type=op_type,
-                             inputs={"X": list(names)},
-                             outputs=outputs,
-                             attrs=attrs)
-            insert_at += 1
+                for g in names:
+                    block._insert_op(
+                        insert_at, type="scale",
+                        inputs={"X": [g]}, outputs={"Out": [g]},
+                        attrs={"scale": 1.0 / nranks})
+                    insert_at += 1
+            continue
+        attrs = {"ring_id": 0,
+                 "_axis_name": tuple(p_axes)
+                 if len(p_axes) > 1 else p_axes[0]}
+        if need_scale:
+            attrs["scale"] = 1.0 / nranks
+        if ready_rank is not None:
+            attrs["_overlap"] = True
+            attrs["_ready_rank"] = int(ready_rank)
+            attrs["_bucket_index"] = int(ready_rank)
+            if hook_pos is not None:
+                attrs["_overlap_hook_pos"] = int(hook_pos)
+        op_type = "c_fused_allreduce_sum"
+        outputs = {"Out": list(names)}
+        if qspec is not None and dtype in _FLOAT_DTYPES:
+            # quantized bucket: the per-bucket stage-2 scale
+            # tensor rides alongside the payload — declare it as
+            # a real var so the static layer (memory analyzer,
+            # census readers) prices the scales, not just the
+            # int payload
+            op_type = "c_fused_quant_allreduce_sum"
+            attrs["quant_spec"] = qspec.to_attr()
+            numel = bucket_bytes // _DTYPE_BYTES.get(dtype, 4)
+            sv = block.create_var(
+                name=f"{names[0]}@quant_scale",
+                shape=(_qscale_blocks(numel, p_axes, qspec,
+                                      axis_sizes),),
+                dtype="float32")
+            outputs["QScale"] = [sv.name]
+        elif compress:
+            attrs["compress_dtype"] = compress
+        block._insert_op(insert_at, type=op_type,
+                         inputs={"X": list(names)},
+                         outputs=outputs,
+                         attrs=attrs)
+        insert_at += 1

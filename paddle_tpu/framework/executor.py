@@ -201,6 +201,85 @@ def _live_names_after(segments, seg_idx, always_live):
     return live
 
 
+def _make_overlap_hook(op, ctx, bucket_seed):
+    """Identity custom-vjp hook over one ready-order bucket's params
+    whose TRANSPOSE runs the bucket's (possibly quantized) fused grad
+    collective — the overlap-aware scheduling rewrite: applied right
+    before the bucket's earliest forward use, the hook's backward fires
+    in the reverse sweep exactly when every member's cotangent is final,
+    so the collective lands after its last contributing backward op in
+    the lowered module instead of sinking to the program tail, and its
+    wire time hides under the remaining backward compute.
+
+    The cotangents pass through an ``optimization_barrier`` first, which
+    pins the bucket together against XLA re-fusing it across buckets
+    (the latency-hiding scheduler flags in ``flags.OVERLAP_XLA_FLAGS``
+    keep the async collective where the trace put it on TPU).  A
+    quantized bucket's stochastic-rounding key derives from a fixed
+    per-bucket seed (the outer RNG chain is not threadable through a
+    custom-vjp transpose)."""
+    impl = get_op(op.type)
+    mesh, axis_names, is_test = ctx.mesh, ctx.axis_names, ctx.is_test
+    attrs = op.attrs
+
+    @jax.custom_vjp
+    def hook(*params):
+        return params
+
+    def h_fwd(*params):
+        return params, None
+
+    def h_bwd(_, cots):
+        cots = list(jax.lax.optimization_barrier(tuple(cots)))
+        hctx = LoweringContext(jax.random.PRNGKey(bucket_seed), mesh,
+                               axis_names, is_test)
+        ins = {"X": cots}
+        if _tracing_enabled():
+            from ..ops.collective_ops import maybe_trace_collective
+            with maybe_trace_collective(op, ins, hctx):
+                outs = impl(hctx, ins, attrs)
+        else:
+            outs = impl(hctx, ins, attrs)
+        res = outs.get("Out", cots)
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        return tuple(res)
+
+    hook.defvjp(h_fwd, h_bwd)
+    return hook
+
+
+def _overlap_schedule(fwd_ops, tail_ops, param_names):
+    """Resolve the ready-order hooks for this lowering: for each
+    overlap-annotated grad-sync op in the tail, the bucket's param
+    names and the hook position (min first forward use over members,
+    recomputed HERE against the op list actually being lowered so
+    clones/prunes can never leave a stale position behind).  Returns
+    ``[(pos, pnames, op), ...]`` sorted by position."""
+    from .analysis import op_reads_recursive
+    from .core import grad_var_name as gvn
+    overlap_ops = [op for op in tail_ops
+                   if op.attrs.get("_overlap")
+                   and op.attrs.get("_overlap_hook_pos") is not None]
+    if not overlap_ops:
+        return []
+    grad_to_param = {gvn(n): n for n in param_names}
+    first_use: Dict[str, int] = {}
+    want = set(param_names)
+    for i, op in enumerate(fwd_ops):
+        for n in (op_reads_recursive(op) & want):
+            first_use.setdefault(n, i)
+    hooks = []
+    for op in overlap_ops:
+        pnames = [grad_to_param.get(g) for g in op.inputs.get("X", ())]
+        if not pnames or any(p is None or p not in first_use
+                             for p in pnames):
+            continue            # falls back to tail placement
+        hooks.append((min(first_use[p] for p in pnames), pnames, op))
+    hooks.sort(key=lambda t: t[0])
+    return hooks
+
+
 def lower_block_with_backward(ops, env, ctx, bw_idx, fetch_names,
                               state_out_names):
     """Lower [forward ops][backward meta-op][update ops] with value_and_grad."""
@@ -220,12 +299,36 @@ def lower_block_with_backward(ops, env, ctx, bw_idx, fetch_names,
 
     segments = _segment_at_checkpoints(fwd_ops, checkpoints)
 
+    # overlap-aware grad sync (compiler.insert_grad_sync ready-order
+    # buckets): hooked collectives fire INSIDE the backward sweep; the
+    # tail op is then skipped (its outputs already hold the reduced
+    # grads).  Recompute-checkpointed programs keep tail placement (the
+    # hook positions don't survive segment re-execution).
+    from ..flags import flag
+    hooks = []
+    if len(segments) == 1 and tail_ops and flag("overlap_lowering"):
+        hooks = _overlap_schedule(fwd_ops, tail_ops, param_names)
+    hooked_ids = {id(op) for _, _, op in hooks}
+
     def fwd(p, key):
         e = dict(base_env)
         e.update(p)
         sub = LoweringContext(key, ctx.mesh, ctx.axis_names, ctx.is_test)
         if len(segments) == 1:
-            e = run_ops(segments[0], e, sub)
+            if hooks:
+                seg, cur = segments[0], 0
+                for pos, pnames, op in hooks:
+                    pos = min(max(pos, cur), len(seg))
+                    e = run_ops(seg[cur:pos], e, sub)
+                    seed = int(op.attrs.get("_bucket_index", 0)) + 0x0eaf
+                    vals = _make_overlap_hook(op, ctx, seed)(
+                        *[e[pn] for pn in pnames])
+                    for pn, v in zip(pnames, vals):
+                        e[pn] = v
+                    cur = pos
+                e = run_ops(seg[cur:], e, sub)
+            else:
+                e = run_ops(segments[0], e, sub)
         else:
             for i, seg in enumerate(segments):
                 live = _live_names_after(segments, i, always_live)
@@ -254,6 +357,13 @@ def lower_block_with_backward(ops, env, ctx, bw_idx, fetch_names,
     for n in param_names:
         env2[grad_var_name(n)] = grads[n]
     env2[grad_var_name(loss_name)] = jnp.ones_like(env2[loss_name])
+    if hooked_ids:
+        # hooked buckets already reduced inside the backward sweep —
+        # their grads arrived through value_and_grad; the tail op is
+        # skipped.  (A quantized bucket's QScale var stays unset: it is
+        # declared for the static byte-accounting layer only and has no
+        # runtime reader.)
+        tail_ops = [op for op in tail_ops if id(op) not in hooked_ids]
     return run_ops(tail_ops, env2, ctx)
 
 
@@ -1357,6 +1467,7 @@ class Executor:
         key = (program._uid, program._version, self._feed_signature(feed),
                tuple(fetch_names), _mesh_identity(mesh),
                flag("use_flash_attention"), flag("use_pallas_fused"),
+               flag("overlap_lowering"),
                donate_state, str(flag("aot_cache_dir") or ""))
         if key in self._cache:
             if flag("print_executor_cache_hits"):
@@ -1536,7 +1647,8 @@ class Executor:
 
         feed_sig = self._feed_signature(feed)
         trace_flags = (flag("use_flash_attention"),
-                       flag("use_pallas_fused"))
+                       flag("use_pallas_fused"),
+                       flag("overlap_lowering"))
         key = aot_cache.entry_key(program, feed_sig, fetch_names,
                                   donate_state, trace_flags)
         cached = aot_cache.load(cache_dir, key)

@@ -74,8 +74,8 @@ def _rename_inputs(op, old: str, new: str):
 
 
 def apply_fsdp_sharding(program: Program, layout: MeshLayout,
-                        min_shard_numel: int = DEFAULT_MIN_SHARD_NUMEL
-                        ) -> Dict[str, Any]:
+                        min_shard_numel: int = DEFAULT_MIN_SHARD_NUMEL,
+                        prefetch_distance: int = 0) -> Dict[str, Any]:
     """Rewrite ``program`` in place for ZeRO-3 parameter sharding over
     ``layout``'s fsdp axis.  Idempotent per program; call AFTER
     ``optimizer.minimize`` (the backward op and update ops must exist)
@@ -86,6 +86,16 @@ def apply_fsdp_sharding(program: Program, layout: MeshLayout,
     Returns the rewrite report: per-param shard dim, gather window
     ``(first_use, last_use)`` from the liveness pass, and the skip
     census (too small / indivisible / already sharded).
+
+    ``prefetch_distance`` > 0 issues each gather EARLY: layer *k*'s
+    ``fsdp_all_gather`` is inserted at the first-use position of layer
+    *k − prefetch_distance* (gathers ordered by first use), so the
+    gather's wire time for the NEXT layer(s) overlaps the current
+    layer's compute window instead of serialising at first use — the
+    forward half of the overlap-aware collective schedule.  The
+    liveness ``_window`` attr keeps the ORIGINAL (first_use, last_use);
+    the issue position is recorded as ``_issue``.  0 (default) keeps
+    gather-at-first-use.
     """
     from .analysis import op_reads_recursive
     from .memory_analysis import block_liveness
@@ -139,25 +149,34 @@ def apply_fsdp_sharding(program: Program, layout: MeshLayout,
 
     # phase 1: rename every forward read p → p@fsdp_full against the
     # UNMODIFIED op list (renames don't shift indices); phase 2 inserts
-    # the gathers at first use in DESCENDING index order so each
+    # the gathers at their ISSUE position (first use, pulled earlier by
+    # prefetch_distance gather slots) in DESCENDING index order so each
     # insertion leaves the remaining insertion points valid
     for first, last, p, dim in plans:
         full = block.create_var(name=p.name + GATHER_SUFFIX,
                                 shape=tuple(p.shape), dtype=p.dtype)
         for op in block.ops[first:bw_idx]:
             _rename_inputs(op, p.name, full.name)
-    for first, last, p, dim in sorted(plans, key=lambda t: -t[0]):
-        spec = ShardSpec(tuple(axis if d == dim else None
-                               for d in range(len(p.shape))) or (axis,))
+    d = max(int(prefetch_distance or 0), 0)
+    report["prefetch_distance"] = d
+    by_first = sorted(plans, key=lambda t: t[0])
+    issue_of = {id(t[2]): by_first[max(i - d, 0)][0]
+                for i, t in enumerate(by_first)}
+    for first, last, p, dim in sorted(plans,
+                                      key=lambda t: -issue_of[id(t[2])]):
+        spec = ShardSpec(tuple(axis if d2 == dim else None
+                               for d2 in range(len(p.shape))) or (axis,))
         full_name = p.name + GATHER_SUFFIX
+        issue = issue_of[id(p)]
         block._insert_op(
-            first, type="fsdp_all_gather",
+            issue, type="fsdp_all_gather",
             inputs={"X": [p.name]}, outputs={"Out": [full_name]},
             attrs={"ring_id": 0, "_axis_name": axis, "gather_dim": dim,
                    # liveness window (op indices BEFORE insertion): the
                    # full copy exists only between its gather and its
                    # last forward consumer — census tools assert this
-                   "_window": (first, last)})
+                   "_window": (first, last),
+                   "_issue": int(issue)})
         p.dist_attr = spec
         # the gradient w.r.t. the resident shard arrives pre-scattered
         # through the gather's transpose — stamp it so grad sync and
@@ -183,7 +202,7 @@ def apply_fsdp_sharding(program: Program, layout: MeshLayout,
         from ..ops.registry import dtype_nbytes
         report["sharded"].append(
             {"param": p.name, "shape": list(p.shape), "shard_dim": dim,
-             "window": [int(first), int(last)],
+             "window": [int(first), int(last)], "issue": int(issue),
              "bytes_full": int(np.prod(p.shape)) * dtype_nbytes(p.dtype),
              "pinned": bool(liveness.get(p.name) and
                             liveness[p.name].pinned)})

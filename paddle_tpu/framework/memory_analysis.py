@@ -931,10 +931,13 @@ def collective_wire_summary(program: Program, feed_shapes=None,
     batch_axes = _flat_axes(batch_axis) + tuple(
         a for a in (seq_axis,) if a)
 
-    totals = {"wire_bytes": 0, "logical_bytes": 0}
+    totals = {"wire_bytes": 0, "logical_bytes": 0,
+              "grad_sync_wire_bytes": 0, "forward_wire_bytes": 0}
+    bw_idx = next((i for i, op in enumerate(block.ops)
+                   if op.type == "backward"), None)
     by_op: Dict[str, Dict[str, int]] = {}
     unpriced: List[str] = []
-    for op in block.ops:
+    for op_idx, op in enumerate(block.ops):
         spec = OP_SPECS.get(op.type)
         if spec is None or not spec.collective:
             continue
@@ -983,10 +986,72 @@ def collective_wire_summary(program: Program, feed_shapes=None,
         row["logical_bytes"] += logical
         totals["wire_bytes"] += wire
         totals["logical_bytes"] += logical
+        # placement split for the exposed-comm roofline: collectives
+        # after the backward op are grad sync (hideable under the
+        # remaining backward compute when overlap-scheduled); an
+        # fsdp_all_gather is priced for both directions, so half its
+        # wire is its backward psum_scatter transpose (free overlap)
+        # and half the forward gather
+        if bw_idx is not None and op_idx > bw_idx:
+            totals["grad_sync_wire_bytes"] += wire
+        elif op.type == "fsdp_all_gather":
+            totals["grad_sync_wire_bytes"] += wire // 2
+            totals["forward_wire_bytes"] += wire - wire // 2
+        elif op.type == "mp_copy":
+            # fwd identity, bwd psum: all its priced wire is the
+            # Megatron g-transpose riding the backward sweep
+            totals["grad_sync_wire_bytes"] += wire
+        else:
+            totals["forward_wire_bytes"] += wire
     return {"wire_bytes": totals["wire_bytes"],
             "logical_bytes": totals["logical_bytes"],
+            "grad_sync_wire_bytes": totals["grad_sync_wire_bytes"],
+            "forward_wire_bytes": totals["forward_wire_bytes"],
             "by_op": by_op,
             "unpriced_collectives": sorted(set(unpriced))}
+
+
+def exposed_comm_model(wire_summary, flops_total, num_devices=1,
+                       overlap=False, has_backward=True,
+                       ici_gbps=None, peak_flops=None) -> Dict[str, Any]:
+    """Static step-time roofline for one program/config: how much
+    collective wire time is EXPOSED (not hidden under compute).
+
+    ``exposed_comm = forward_wire_time +
+                     max(0, grad_sync_wire_time − overlappable_compute)``
+
+    where ``overlappable_compute`` is the backward sweep's compute time
+    (2/3 of the 3× fwd+bwd GEMM total the PR 9 ``flops`` channel
+    prices) when the grad sync is overlap-scheduled
+    (``strategy.overlap_grad_sync``), else 0 — a tail-fused schedule
+    hides nothing.  Forward collectives (Megatron f/g, un-prefetched
+    fsdp gathers) serialise with compute by data dependence and count
+    exposed.  Wire time = bytes / (``flag("ici_gbps")`` · 1e9); peak
+    FLOPs from the device table (``flag("device_peak_flops")``
+    override).  Only the RANKING between configs consumes this, so
+    ordering fidelity matters more than absolute accuracy."""
+    from ..flags import flag
+    from ..observability import flops as _flops
+    bw = float(ici_gbps if ici_gbps is not None
+               else flag("ici_gbps")) * 1e9
+    peak = float(peak_flops) if peak_flops else _flops.device_peak_flops()
+    per_dev = float(flops_total or 0.0) / max(int(num_devices or 1), 1)
+    compute_s = per_dev / peak if peak > 0 else 0.0
+    bwd_compute_s = compute_s * (2.0 / 3.0) if has_backward else 0.0
+    grad_wire_s = wire_summary.get("grad_sync_wire_bytes", 0) / bw
+    fwd_wire_s = wire_summary.get("forward_wire_bytes", 0) / bw
+    hidden_s = min(grad_wire_s, bwd_compute_s) if overlap else 0.0
+    return {
+        "ici_gbps": bw / 1e9,
+        "peak_flops": peak,
+        "compute_s": compute_s,
+        "overlappable_compute_s": bwd_compute_s if overlap else 0.0,
+        "wire_time_s": fwd_wire_s + grad_wire_s,
+        "grad_sync_wire_s": grad_wire_s,
+        "forward_wire_s": fwd_wire_s,
+        "hidden_s": hidden_s,
+        "exposed_comm_s": fwd_wire_s + grad_wire_s - hidden_s,
+    }
 
 
 def mesh_axes_of(mesh) -> Dict[str, int]:
@@ -1001,5 +1066,5 @@ __all__ = [
     "RESIDUAL_FACTOR", "Interval", "LiveTensor", "MemoryEstimate",
     "block_liveness", "program_liveness", "analyze_memory", "estimate",
     "lint_memory", "check_hbm_budget", "mesh_axes_of", "sig_bytes",
-    "collective_wire_summary",
+    "collective_wire_summary", "exposed_comm_model",
 ]

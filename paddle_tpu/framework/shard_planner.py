@@ -19,9 +19,16 @@ the whole program, over the canonical named-axis
 :class:`~.mesh_layout.MeshLayout`.
 
 Selection rule: among configs whose static peak fits the budget, the
-winner minimizes per-step wire bytes; ties break toward more data
-parallelism (fewer collectives on the critical path), then less fsdp,
-then less tp.  The full ranking is emitted as an auditable plan report
+winner minimizes per-step EXPOSED communication time — the step-time
+roofline ``exposed = fwd_wire_time + max(0, grad_sync_wire_time −
+overlappable_backward_compute)`` over the op-spec ``wire`` ring cost
+and the PR 9 ``flops`` channel (``memory_analysis.exposed_comm_model``;
+grad sync is overlappable when ``strategy.overlap_grad_sync`` is on,
+else nothing hides and exposed time degenerates to total wire time, so
+the historical min-wire ranking is the overlap-off special case).
+Ties break toward fewer total wire bytes, then more data parallelism
+(fewer collectives on the critical path), then less fsdp, then less
+tp.  The full ranking is emitted as an auditable plan report
 (``PLAN_SEARCH_*.json`` — tools/plan_probe.py).
 
 Wired through ``DistributedStrategy.auto_shard = True``
@@ -44,7 +51,7 @@ from .errors import InvalidArgumentError
 from .mesh_layout import (DATA_AXIS, FSDP_AXIS, TP_AXIS, MeshLayout,
                           _flat_axes)
 
-PLAN_FORMAT_VERSION = 1
+PLAN_FORMAT_VERSION = 2
 
 
 # ---------------------------------------------------------------------------
@@ -116,6 +123,7 @@ class PlanConfig:
         self.layout = layout
         self.est = None                   # MemoryEstimate
         self.wire: Dict[str, Any] = {}
+        self.exposed: Dict[str, Any] = {}  # exposed_comm_model output
         self.fits = True
         self.winner = False
         self.fsdp_report: Dict[str, Any] = {}
@@ -129,9 +137,18 @@ class PlanConfig:
     def wire_bytes(self) -> Optional[int]:
         return self.wire.get("wire_bytes") if self.wire else None
 
+    @property
+    def exposed_comm_s(self) -> Optional[float]:
+        return self.exposed.get("exposed_comm_s") if self.exposed else None
+
     def sort_key(self):
-        # min wire; ties → more data parallel, then less fsdp, less tp
-        return (self.wire_bytes if self.wire_bytes is not None else 2**62,
+        # min exposed comm (step-time roofline); ties → fewer total
+        # wire bytes, more data parallel, then less fsdp, less tp.
+        # Exposed time is rounded to ns so float noise can't shadow the
+        # deterministic byte tie-break.
+        exp = self.exposed_comm_s
+        return (round(exp * 1e9) if exp is not None else 2**62,
+                self.wire_bytes if self.wire_bytes is not None else 2**62,
                 -self.layout.data, self.layout.fsdp, self.layout.tp)
 
     def as_dict(self) -> Dict[str, Any]:
@@ -146,8 +163,19 @@ class PlanConfig:
         if self.wire:
             d["wire_bytes"] = int(self.wire["wire_bytes"])
             d["wire_mb"] = round(self.wire["wire_bytes"] / mb, 3)
+            d["grad_sync_wire_bytes"] = int(
+                self.wire.get("grad_sync_wire_bytes", 0))
+            d["forward_wire_bytes"] = int(
+                self.wire.get("forward_wire_bytes", 0))
             d["wire_by_op"] = {k: dict(v) for k, v
                                in self.wire.get("by_op", {}).items()}
+        if self.exposed:
+            d["exposed_comm_ms"] = round(
+                self.exposed["exposed_comm_s"] * 1e3, 6)
+            d["wire_time_ms"] = round(self.exposed["wire_time_s"] * 1e3, 6)
+            d["overlappable_compute_ms"] = round(
+                self.exposed["overlappable_compute_s"] * 1e3, 6)
+            d["hidden_ms"] = round(self.exposed["hidden_s"] * 1e3, 6)
         if self.fsdp_report.get("sharded"):
             d["fsdp_sharded_params"] = len(self.fsdp_report["sharded"])
         if self.error:
@@ -185,7 +213,10 @@ class Plan:
             "winner": self.winner.as_dict() if self.winner else None,
             "pricing": "memory_analysis.analyze_memory (peak HBM) + "
                        "op_spec wire ring-cost channel "
-                       "(collective_wire_summary)",
+                       "(collective_wire_summary) + exposed-comm "
+                       "roofline (exposed_comm_model over the op_spec "
+                       "flops channel; ranking = min exposed comm, "
+                       "ties → fewer wire bytes)",
         }
 
     def write_report(self, path: str):
@@ -204,9 +235,12 @@ class Plan:
                 is not None else "        ?"
             wire = f"{c.wire_bytes / mb:9.2f} MiB" if c.wire_bytes \
                 is not None else "        ?"
+            exp = f"{c.exposed_comm_s * 1e3:8.3f} ms" \
+                if c.exposed_comm_s is not None else "       ?"
             lines.append(
                 f" {mark} data={c.layout.data:<3d} fsdp={c.layout.fsdp:<3d} "
-                f"tp={c.layout.tp:<3d} peak {peak}  wire {wire}"
+                f"tp={c.layout.tp:<3d} peak {peak}  wire {wire}  "
+                f"exposed {exp}"
                 + (f"  [{c.error}]" if c.error else ""))
         if self.winner is None:
             lines.append("  NO config fits the budget")
@@ -217,17 +251,22 @@ def price_config(program: Program, layout: MeshLayout,
                  loss_name: Optional[str] = None, feed_shapes=None,
                  fetch_names: Iterable[str] = (),
                  build_strategy=None,
-                 min_shard_numel: int = 2048) -> PlanConfig:
+                 min_shard_numel: int = 2048,
+                 flops_total: Optional[float] = None) -> PlanConfig:
     """Price ONE layout on a clone of ``program``: apply the ZeRO-3
     rewrite (fsdp > 1) and grad-sync insertion the real compile would
-    apply, then run the static estimators.  The clone is discarded —
-    the input program is never mutated and nothing compiles."""
+    apply, then run the static estimators (peak HBM, wire bytes, and —
+    when ``flops_total`` is given — the exposed-comm roofline).  The
+    clone is discarded — the input program is never mutated and nothing
+    compiles."""
     from .compiler import BuildStrategy, insert_grad_sync
     from .fsdp import apply_fsdp_sharding
-    from .memory_analysis import analyze_memory, collective_wire_summary
+    from .memory_analysis import (analyze_memory, collective_wire_summary,
+                                  exposed_comm_model)
 
     cfg = PlanConfig(layout)
     clone = program.clone()
+    strategy = build_strategy or BuildStrategy()
     try:
         if layout.fsdp > 1:
             cfg.fsdp_report = apply_fsdp_sharding(
@@ -237,13 +276,22 @@ def price_config(program: Program, layout: MeshLayout,
                             if sizes.get(a, 1) > 1)
         if loss_name is not None and reduce_axes:
             n = int(np.prod([sizes[a] for a in reduce_axes]))
-            insert_grad_sync(clone, build_strategy or BuildStrategy(), n,
+            insert_grad_sync(clone, strategy, n,
                              reduce_axes, axis_sizes=sizes)
         kw = dict(feed_shapes=feed_shapes, fetch_names=list(fetch_names),
                   mesh_axes=layout.mesh_axes,
                   batch_axis=layout.batch_axes)
         cfg.est = analyze_memory(clone, **kw)
         cfg.wire = collective_wire_summary(clone, **kw)
+        if flops_total is not None:
+            has_bw = any(op.type == "backward"
+                         for op in clone.global_block().ops)
+            cfg.exposed = exposed_comm_model(
+                cfg.wire, flops_total,
+                num_devices=layout.data * layout.fsdp * layout.tp,
+                overlap=bool(getattr(strategy, "overlap_grad_sync",
+                                     False)),
+                has_backward=has_bw)
     except Exception as e:      # a pricing bug must not kill the search
         cfg.error = f"{type(e).__name__}: {e}"
     return cfg
@@ -270,13 +318,24 @@ def plan_sharding(program: Program, num_devices: int,
     0 compiles are attempted: pricing runs on program clones through
     the static memory/wire model only."""
     budget = float(hbm_budget_gb) if hbm_budget_gb else None
+    # whole-program GEMM FLOPs priced ONCE on the base program (layout
+    # rewrites never change the math) — the exposed-comm roofline's
+    # compute term, shared by every config
+    try:
+        from ..observability.flops import estimate_step_flops
+        flops_total = estimate_step_flops(
+            program, feed_shapes=feed_shapes,
+            fetch_names=list(fetch_names))["total_flops"]
+    except Exception:
+        flops_total = None
     configs = []
     for layout in enumerate_layouts(program, num_devices, max_tp=max_tp):
         cfg = price_config(program, layout, loss_name=loss_name,
                            feed_shapes=feed_shapes,
                            fetch_names=fetch_names,
                            build_strategy=build_strategy,
-                           min_shard_numel=min_shard_numel)
+                           min_shard_numel=min_shard_numel,
+                           flops_total=flops_total)
         if budget is not None and cfg.est is not None:
             cfg.fits = cfg.est.peak_gb <= budget
         configs.append(cfg)
@@ -287,11 +346,13 @@ def plan_sharding(program: Program, num_devices: int,
 
 
 def stamp_winning_layout(program: Program, plan: Plan,
-                         min_shard_numel: int = 2048) -> MeshLayout:
+                         min_shard_numel: int = 2048,
+                         prefetch_distance: int = 0) -> MeshLayout:
     """Apply ``plan.winner`` to the REAL program: the ZeRO-3 rewrite
-    (fsdp > 1) plus the canonical ``_mesh_layout`` stamp.  Grad-sync
-    insertion stays with ``CompiledProgram.with_mesh`` (it reads the
-    stamped dist_attrs).  Raises when no config fit."""
+    (fsdp > 1, gathers prefetched ``prefetch_distance`` layers early)
+    plus the canonical ``_mesh_layout`` stamp.  Grad-sync insertion
+    stays with ``CompiledProgram.with_mesh`` (it reads the stamped
+    dist_attrs).  Raises when no config fit."""
     if plan.winner is None:
         raise InvalidArgumentError(
             "auto_shard: no sharding configuration fits "
@@ -301,7 +362,8 @@ def stamp_winning_layout(program: Program, plan: Plan,
     if layout.fsdp > 1:
         from .fsdp import apply_fsdp_sharding
         apply_fsdp_sharding(program, layout,
-                            min_shard_numel=min_shard_numel)
+                            min_shard_numel=min_shard_numel,
+                            prefetch_distance=prefetch_distance)
     program._mesh_layout = layout
     return layout
 

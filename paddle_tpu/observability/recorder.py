@@ -131,6 +131,33 @@ class TelemetryRecorder:
                 static["wire_bytes_per_step"] = int(wire["wire_bytes"])
                 static["logical_bytes_per_step"] = \
                     int(wire["logical_bytes"])
+                static["grad_sync_wire_bytes"] = int(
+                    wire.get("grad_sync_wire_bytes", 0))
+                static["forward_wire_bytes"] = int(
+                    wire.get("forward_wire_bytes", 0))
+                # static exposed-comm roofline (the overlap scheduler's
+                # cost model): collective wire time not coverable by
+                # compute — each step reports the fraction of its
+                # measured wall this exposure accounts for, so overlap
+                # wins show up in MFU/goodput, not just in the census
+                from ..framework.memory_analysis import exposed_comm_model
+                blk = program.global_block()
+                overlap = any(op.attrs.get("_overlap") for op in blk.ops)
+                has_bw = any(op.type == "backward" for op in blk.ops)
+                ndev = 1
+                for sz in (mesh_axes or {}).values():
+                    ndev *= max(int(sz), 1)
+                model = exposed_comm_model(
+                    wire, static.get("flops_per_step") or 0.0,
+                    num_devices=ndev, overlap=overlap,
+                    has_backward=has_bw, peak_flops=self.peak_flops)
+                static["overlap_grad_sync"] = bool(overlap)
+                static["exposed_comm_s_per_step"] = \
+                    model["exposed_comm_s"]
+                static["exposed_comm_model"] = {
+                    k: model[k] for k in
+                    ("wire_time_s", "overlappable_compute_s",
+                     "hidden_s", "ici_gbps")}
             except Exception as e:
                 static["wire_bytes_per_step"] = None
                 static["wire_error"] = str(e)
@@ -235,6 +262,13 @@ class TelemetryRecorder:
             "aot_cache": {"hits": deltas["aot_cache_hit"],
                           "misses": deltas["aot_cache_miss"]},
         }
+        exposed_s = self.static.get("exposed_comm_s_per_step")
+        if exposed_s is not None:
+            # share of this step's measured wall the statically-priced
+            # exposed collective time accounts for (0 = fully hidden)
+            rec["exposed_comm_ms"] = round(exposed_s * 1e3, 4)
+            rec["exposed_comm_frac"] = round(
+                max(0.0, min(1.0, exposed_s * 1e9 / wall_ns)), 6)
         headroom = self._hbm_headroom()
         if headroom is not None:
             rec["hbm_headroom_bytes"] = headroom
@@ -361,6 +395,9 @@ def validate_jsonl(path: str) -> Dict[str, Any]:
             if not (0.0 < s["mfu"] <= 1.0):
                 raise ValueError(f"mfu out of (0,1]: {s}")
             mfus.append(s["mfu"])
+        if s.get("exposed_comm_frac") is not None and \
+                not (0.0 <= s["exposed_comm_frac"] <= 1.0):
+            raise ValueError(f"exposed_comm_frac out of [0,1]: {s}")
     sids = [s["step"] for s in steps]
     if sids != sorted(sids):
         raise ValueError("step ids are not monotonically increasing")

@@ -567,6 +567,15 @@ def maybe_trace_collective(op, ins, ctx):
     attrs = {"kind": op.type,
              "axis": str(op.attrs.get("_axis_name") or
                          op.attrs.get("ring_id", 0))}
+    # overlap-aware schedule correlation: ready-order buckets stamp
+    # their index/rank so tools/timeline.py renders the interleaving
+    # (which bucket fired where, in ready order) on the merged trace
+    if "_bucket_index" in op.attrs:
+        attrs["bucket_index"] = int(op.attrs["_bucket_index"])
+    if "_ready_rank" in op.attrs:
+        attrs["ready_rank"] = int(op.attrs["_ready_rank"])
+    if "_overlap" in op.attrs:
+        attrs["overlap"] = bool(op.attrs["_overlap"])
     wire_fn = getattr(spec, "wire", None)
     if wire_fn is not None:
         try:

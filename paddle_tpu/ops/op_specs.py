@@ -724,6 +724,19 @@ def _infer_collective_same(ins, attrs):
     return same_as_input()(ins, attrs)
 
 
+def _infer_c_embedding(ins, attrs):
+    """Vocab-parallel embedding lookup: Out = Ids.shape + [dim] (the
+    row dim is vocab-sharded; the psum restores the full [.., dim])."""
+    w, ids = _sig(ins, "W"), _sig(ins, "Ids")
+    if w is None or ids is None or w.shape is None or ids.shape is None:
+        return None
+    if len(w.shape) != 2:
+        raise SpecMismatch(
+            f"c_embedding: W must be 2-D [vocab_shard, dim], got "
+            f"{list(w.shape)}", kind="shape")
+    return {"Out": [VarSig(tuple(ids.shape) + (w.shape[1],), w.dtype)]}
+
+
 # -- wire-byte accounting (the ``wire`` op_spec channel) --------------------
 #
 # Ring cost model over one reduce axis of size n (the standard
@@ -746,7 +759,12 @@ _WIRE_DTYPE_BYTES = {"float64": 8, "int64": 8, "float32": 4, "int32": 4,
 
 def _ring_factor(attrs, axis_sizes, passes):
     """Σ over the op's reduce axes of passes·(n-1)/n; falls back to
-    ``passes`` per axis when the mesh is unknown (n → ∞ bound)."""
+    ``passes`` per axis when the mesh is unknown (n → ∞ bound).  With a
+    KNOWN mesh, an axis absent from it (or of size 1) is an identity
+    collective — zero wire, not the ∞ bound: pricing a tp-annotated
+    program at tp = 1 must not carry phantom Megatron bytes (the
+    exposed-comm ranking compares tp = 1 configs against real tp
+    splits)."""
     axes = attrs.get("_axis_name") or ()
     axes = (axes,) if isinstance(axes, str) else tuple(axes)
     if not axes:
@@ -754,7 +772,10 @@ def _ring_factor(attrs, axis_sizes, passes):
     total = 0.0
     for ax in axes:
         n = (axis_sizes or {}).get(ax) if ax is not None else None
-        total += passes * ((n - 1) / n if n and n > 1 else 1.0)
+        if n is None and ax is not None and axis_sizes:
+            continue                 # known mesh, axis not on it
+        total += passes * ((n - 1) / n if n and n > 1 else
+                           (0.0 if n == 1 else 1.0))
     return total
 
 
@@ -937,10 +958,20 @@ def register_default_specs():
                  "c_reducescatter", "c_concat", "c_split", "alltoall",
                  "collective_permute", "zero_reduce_scatter",
                  "quant_reduce_scatter",
-                 "zero_all_gather", "zero_shard_slice", "c_embedding",
-                 "local_sgd_sync", "moe_ffn", "mp_copy"):
+                 "zero_all_gather", "zero_shard_slice",
+                 "local_sgd_sync", "moe_ffn"):
         op_spec(name, infer=None, collective=True,
                 wire=_WIRE_SPECS.get(name))
+    # vocab-parallel embedding: Out = Ids.shape + [dim] exactly like
+    # lookup_table_v2 (the psum keeps the global [.., dim] width).
+    # Without this the tp-BERT shape propagation stalled at op 0 and
+    # the flops channel priced the whole encoder at 0 — the exposed-
+    # comm roofline then had no compute term to hide wire under.
+    op_spec("c_embedding", infer=_infer_c_embedding, collective=True,
+            wire=_WIRE_SPECS.get("c_embedding"))
+    # Megatron f op: identity forward (psum transpose in backward)
+    op_spec("mp_copy", infer=_infer_collective_same, collective=True,
+            wire=_WIRE_SPECS.get("mp_copy"))
     # ZeRO-3 on-demand parameter gather (framework/fsdp.py): metadata is
     # GLOBAL throughout, so Out mirrors X's declared signature
     op_spec("fsdp_all_gather", infer=_infer_collective_same,

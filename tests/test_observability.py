@@ -126,6 +126,54 @@ def test_compile_span_carries_program_identity():
     assert compiles[0]["version"] == main._version
 
 
+def test_collective_spans_carry_bucket_index_and_ready_rank():
+    """Overlap-scheduled grad-sync buckets stamp their ready order on
+    the ``collective::*`` spans (bucket_index / ready_rank / overlap
+    attrs land in the Chrome trace ``args``), so tools/timeline.py
+    renders WHICH bucket fired where in the interleaving."""
+    import jax
+    from paddle_tpu.framework.compiler import (BuildStrategy,
+                                               CompiledProgram, make_mesh)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16])
+        h = x
+        for _ in range(5):
+            h = fluid.layers.fc(h, 32, act="relu", bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.fc(h, 4))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    mesh = make_mesh(8, "dp")
+    bs = BuildStrategy()
+    bs.fuse_all_reduce_ops = True
+    bs.overlap_grad_sync = True
+    prog = CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, mesh=mesh, build_strategy=bs)
+    n_buckets = sum(1 for op in main.global_block().ops
+                    if op.type == "c_fused_allreduce_sum")
+    assert n_buckets >= 4
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    feed = {"x": np.ones((16, 16), np.float32)}
+    tracing.enable()
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(prog, feed=feed, fetch_list=[loss])
+    finally:
+        tracing.disable()
+    spans = [a for n, *_x, a in tracing.get_events()
+             if n == "collective::c_fused_allreduce_sum"]
+    assert len(spans) == n_buckets
+    assert all(a.get("overlap") is True for a in spans)
+    ranks = sorted(a["ready_rank"] for a in spans)
+    assert ranks == list(range(n_buckets))
+    assert sorted(a["bucket_index"] for a in spans) == ranks
+    # wire pricing still rides the span (the hook passes real payloads)
+    assert all(a.get("wire_bytes", 0) > 0 for a in spans)
+
+
 def test_serving_spans_share_the_batch_step_id(tmp_path):
     from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
     from paddle_tpu.serving import ServingConfig, ServingEngine

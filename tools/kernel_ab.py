@@ -3,10 +3,19 @@ next-round #2): flash attention and the fused LN/add-LN/bias-GELU/Adam
 kernels, flag on vs off, same window, same methodology as bench.py
 (device-resident feeds, pipelined dispatch, one final sync).
 
-Prints one line per configuration:
-    {"config": ..., "samples_per_sec": N, "ms_per_step": N}
+Emits one JSON line per configuration and a JSON artifact
+(``KERNEL_AB_r14.json``) carrying every row — the same probe-tool
+contract as serve_bench/obs_probe/plan_probe.
 
-Run on the real chip: python tools/kernel_ab.py [steps]
+``--selftest`` is the CPU-safe preflight leg: BERT-tiny shapes, few
+steps, Pallas kernels running through their interpret-mode/jnp
+fallbacks — it asserts every flag configuration trains to a finite
+loss and the artifact schema holds, without claiming speedups (CPU
+relative timings are framework noise; the full run on a real chip is
+what measures the kernels).
+
+Run on the real chip: python tools/kernel_ab.py [steps] [--json out]
+Preflight:            python tools/kernel_ab.py --selftest
 """
 
 import json
@@ -18,8 +27,17 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+ARTIFACT = "KERNEL_AB_r14.json"
 
-def bench_config(flash, fused, steps):
+CONFIGS = (
+    ("baseline (no pallas)", False, False),
+    ("+flash_attention", True, False),
+    ("+fused_ln_adam", False, True),
+    ("both (bench default)", True, True),
+)
+
+
+def bench_config(flash, fused, steps, tiny=False):
     import jax
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models import bert
@@ -31,8 +49,12 @@ def bench_config(flash, fused, steps):
     fluid.set_flags({"FLAGS_use_flash_attention": flash,
                      "FLAGS_use_pallas_fused": fused})
 
-    batch, seq, num_masks = 96, 128, 20
-    cfg = bert.BertConfig.base()
+    if tiny:
+        batch, seq, num_masks = 4, 64, 3
+        cfg = bert.BertConfig.tiny()
+    else:
+        batch, seq, num_masks = 96, 128, 20
+        cfg = bert.BertConfig.base()
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
         feeds, total, mlm, nsp = bert.build_pretrain_network(cfg)
@@ -56,24 +78,70 @@ def bench_config(flash, fused, steps):
     for _ in range(steps):
         l, = exe.run(main_prog, feed=data, fetch_list=[total],
                      return_numpy=False)
-    np.asarray(l)
+    loss = float(np.asarray(l).reshape(()))
     jax.block_until_ready(list(global_scope().vars.values()))
     dt = (time.perf_counter() - t0) / steps
-    return batch / dt, dt * 1e3
+    return batch / dt, dt * 1e3, loss
+
+
+def run(steps, tiny=False, out_path=ARTIFACT):
+    import jax
+    rows = []
+    for name, flash, fused in CONFIGS:
+        sps, ms, loss = bench_config(flash, fused, steps, tiny=tiny)
+        row = {"config": name, "use_flash_attention": flash,
+               "use_pallas_fused": fused,
+               "samples_per_sec": round(sps, 2),
+               "ms_per_step": round(ms, 2), "final_loss": loss}
+        rows.append(row)
+        print(json.dumps(row))
+    artifact = {
+        "artifact": "KERNEL_AB",
+        "revision": "r14",
+        "mode": "selftest" if tiny else "bench",
+        "model": "bert_tiny" if tiny else "bert_base",
+        "steps": steps,
+        "platform": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", None),
+        "configs": rows,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"wrote {out_path}")
+    return artifact
+
+
+def selftest():
+    """Preflight gate (CPU-safe): every Pallas flag configuration must
+    train BERT-tiny to a finite loss through the interpret/jnp fallback
+    paths, and the artifact must carry one well-formed row per config."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    art = run(steps=2, tiny=True, out_path=ARTIFACT)
+    ok = len(art["configs"]) == len(CONFIGS) and all(
+        np.isfinite(r["final_loss"]) and r["ms_per_step"] > 0
+        for r in art["configs"])
+    losses = {r["final_loss"] for r in art["configs"]}
+    # the flag ladder changes kernels, not the model: losses agree
+    # loosely (flash/fused run different numerics, so not bitwise)
+    spread = max(losses) - min(losses)
+    ok = ok and spread < 1e-2
+    print(f"kernel_ab selftest {'OK' if ok else 'FAILED'} "
+          f"(loss spread {spread:.2e})")
+    return 0 if ok else 1
 
 
 def main():
-    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 20
-    configs = [
-        ("baseline (no pallas)", False, False),
-        ("+flash_attention", True, False),
-        ("+fused_ln_adam", False, True),
-        ("both (bench default)", True, True),
-    ]
-    for name, flash, fused in configs:
-        sps, ms = bench_config(flash, fused, steps)
-        print(json.dumps({"config": name, "samples_per_sec": round(sps, 2),
-                          "ms_per_step": round(ms, 2)}))
+    argv = sys.argv[1:]
+    if "--selftest" in argv:
+        sys.exit(selftest())
+    out_path = ARTIFACT
+    if "--json" in argv:
+        i = argv.index("--json")
+        out_path = argv[i + 1]
+        del argv[i:i + 2]
+    steps = int(argv[0]) if argv else 20
+    run(steps, tiny=False, out_path=out_path)
 
 
 if __name__ == "__main__":

@@ -11,11 +11,17 @@ on rejected configs.  This probe proves the contract on a real model:
   tp search dimension is live: tp ∈ {1, 2} for 2 attention heads);
 * plans at a budget placed between the cheapest and the most expensive
   config's peak, so the budget gate visibly excludes configs;
+* prices with ``overlap_grad_sync`` on, so every config carries the
+  exposed-comm roofline (``memory_analysis.exposed_comm_model``:
+  forward wire + max(0, grad-sync wire − overlappable backward
+  compute)) and the winner minimizes EXPOSED comm among fitting
+  configs (ties → fewer total wire bytes);
 * asserts ≥6 configs priced, exactly one winner, the winner fitting
-  and minimizing wire bytes among fitting configs, and 0 executor
+  and minimizing exposed comm among fitting configs, and 0 executor
   compiles during the whole search (monitor stat delta);
-* writes ``PLAN_SEARCH_r12.json`` (asserted in tier-1 by
-  tests/test_shard_planner.py).
+* writes ``PLAN_SEARCH_r14.json`` (asserted in tier-1 by
+  tests/test_overlap.py; the r12 wire-ranked artifact's contract is
+  unchanged on disk).
 
 Usage:
     PYTHONPATH=/root/repo python tools/plan_probe.py [out.json]
@@ -26,7 +32,7 @@ import json
 import os
 import sys
 
-ARTIFACT = "PLAN_SEARCH_r12.json"
+ARTIFACT = "PLAN_SEARCH_r14.json"
 
 
 def _env8():
@@ -59,6 +65,7 @@ def build_plan(num_devices=8):
                    for k, v in batch.items()}
     bs = BuildStrategy()
     bs.fuse_all_reduce_ops = True
+    bs.overlap_grad_sync = True       # exposed-comm pricing live
 
     compiles_before = int(stat("executor_compile_count").get())
     # pass 1 (no budget): find the peak spread so the budget provably
@@ -90,8 +97,15 @@ def check_plan(plan, compile_delta):
     assert plan.winner is not None and plan.winner.fits
     assert sum(c.winner for c in plan.configs) == 1
     assert over, "budget excluded nothing — gate not exercised"
-    assert plan.winner.wire_bytes == min(c.wire_bytes for c in fitting), \
-        "winner does not minimize wire bytes among budget-fitting configs"
+    assert all(c.exposed_comm_s is not None for c in priced), \
+        "exposed-comm roofline missing from priced configs"
+    best = min(round(c.exposed_comm_s * 1e9) for c in fitting)
+    assert round(plan.winner.exposed_comm_s * 1e9) == best, \
+        "winner does not minimize exposed comm among fitting configs"
+    tied = [c for c in fitting
+            if round(c.exposed_comm_s * 1e9) == best]
+    assert plan.winner.wire_bytes == min(c.wire_bytes for c in tied), \
+        "exposed-comm tie not broken toward fewer wire bytes"
     assert compile_delta == 0, \
         f"{compile_delta} compiles attempted during the plan search"
     tps = {c.layout.tp for c in priced}

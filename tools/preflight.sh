@@ -21,9 +21,19 @@ echo "   (0,1] within 10% of the analytic model, flight bundle on induced"
 echo "   NaN, perfetto timeline merge) =="
 python tools/obs_probe.py --selftest
 
+echo "== preflight: kernel A/B probe (pallas flag ladder: flash attention"
+echo "   + fused LN/Adam, CPU-safe interpret-mode leg, JSON artifact) =="
+python tools/kernel_ab.py --selftest
+
 echo "== preflight: auto-shard plan probe (dp8 BERT-tiny tp2: >=6 configs"
-echo "   priced, winner min-wire among budget-fitting, 0 compiles) =="
+echo "   priced, winner min-EXPOSED-comm among budget-fitting, ties to"
+echo "   fewer wire bytes, 0 compiles) =="
 python tools/plan_probe.py --selftest
+
+echo "== preflight: overlap census (dp8 BERT ready-order grad sync: >=4"
+echo "   interleaved collectives each preceding later backward compute,"
+echo "   loss bit-parity vs the tail-fused path) =="
+python tools/verify_multichip_lowering.py --overlap
 
 echo "== preflight: quant wire-compression census (dp8 BERT bucketed grad"
 echo "   sync: int8 >=3.5x fp32 / >=1.9x bf16 ring-model wire bytes) =="

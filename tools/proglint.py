@@ -216,6 +216,49 @@ def selftest(memory=False) -> int:
               "collective was not rejected")
         return 1
 
+    # overlap-scheduling lints (the ready-order grad-sync pass): a
+    # (dtype, axes) group that coalesced into ONE overlap bucket must
+    # warn (a lone collective has nothing to interleave with), a
+    # ready-ordered collective with no hook position must warn (it
+    # sinks to the program tail), and a well-split group must be clean
+    from paddle_tpu.framework.analysis import (OVERLAP_SINGLE_BUCKET,
+                                               OVERLAP_TAIL_SUNK)
+    ov = Program()
+    ob = ov.global_block()
+    for n in ("og0", "og1", "og2", "ot0"):
+        ob.create_var(name=n, shape=(1 << 16,), dtype="float32",
+                      is_data=True)
+    oattrs = {"ring_id": 0, "_axis_name": "dp", "_overlap": True}
+    # dp group: two hooked buckets + one hook-less straggler
+    ob.append_op(type="c_fused_allreduce_sum", inputs={"X": ["og0"]},
+                 outputs={"Out": ["og0"]},
+                 attrs=dict(oattrs, _ready_rank=0, _bucket_index=0,
+                            _overlap_hook_pos=7))
+    ob.append_op(type="c_fused_allreduce_sum", inputs={"X": ["og1"]},
+                 outputs={"Out": ["og1"]},
+                 attrs=dict(oattrs, _ready_rank=1, _bucket_index=1,
+                            _overlap_hook_pos=2))
+    ob.append_op(type="c_fused_allreduce_sum", inputs={"X": ["og2"]},
+                 outputs={"Out": ["og2"]},
+                 attrs=dict(oattrs, _ready_rank=2, _bucket_index=2))
+    # tp group: a single coalesced bucket — nothing can hide
+    ob.append_op(type="c_fused_allreduce_sum", inputs={"X": ["ot0"]},
+                 outputs={"Out": ["ot0"]},
+                 attrs={"ring_id": 0, "_axis_name": "tp",
+                        "_overlap": True, "_ready_rank": 3,
+                        "_bucket_index": 3, "_overlap_hook_pos": 0})
+    ores = verify_program(ov)
+    single = ores.by_code(OVERLAP_SINGLE_BUCKET)
+    sunk = ores.by_code(OVERLAP_TAIL_SUNK)
+    if len(single) != 1 or "tp" not in single[0].message:
+        print(f"proglint selftest: overlap-single-bucket fired "
+              f"{len(single)}x (expected once, on the tp group)")
+        return 1
+    if len(sunk) != 1 or "og2" not in sunk[0].message:
+        print(f"proglint selftest: overlap-tail-sunk fired {len(sunk)}x "
+              f"(expected once, on the hook-less bucket)")
+        return 1
+
     if memory:
         from paddle_tpu.framework.errors import InvalidArgumentError
         from paddle_tpu.framework.memory_analysis import (analyze_memory,

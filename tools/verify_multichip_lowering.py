@@ -148,6 +148,39 @@ def collective_census(mlir_txt):
     return out
 
 
+#: module ops counted as "backward/forward compute" by the ordering
+#: census — the GEMM family is what the overlap scheduler hides behind
+COMPUTE_OPS = ("dot_general", "dot", "convolution")
+
+
+def ordering_census(mlir_txt):
+    """Collective-vs-compute ORDERING of a StableHLO module: one row per
+    collective with its line position and how many GEMM-class compute
+    ops appear AFTER it in the module text (jaxpr emission order — the
+    order the trace scheduled them).  A tail-fused grad sync shows every
+    all_reduce with ``compute_after == 0``; the overlap scheduler's
+    ready-order buckets each precede the remaining backward GEMMs."""
+    events = []
+    for i, line in enumerate(mlir_txt.splitlines()):
+        m = re.search(r"stablehlo\.(\w+)", line)
+        if not m:
+            continue
+        kind = m.group(1)
+        if kind in COLLECTIVES:
+            events.append((i, "collective", kind))
+        elif kind in COMPUTE_OPS:
+            events.append((i, "compute", kind))
+    compute_pos = [i for i, t, _ in events if t == "compute"]
+    rows = []
+    for i, t, kind in events:
+        if t != "collective":
+            continue
+        rows.append({"line": i, "kind": kind,
+                     "compute_after": sum(1 for p in compute_pos
+                                          if p > i)})
+    return rows
+
+
 def donation_ratio(mlir_txt):
     """(donated_args, total_args) of @main — the buffer-donation census
     (tf.aliasing_output annotations; the XLA image of the reference's
@@ -214,6 +247,166 @@ def lower_dp8_bert_census(mode):
             exported = jexp.export(step.fn, platforms=("tpu",))(
                 feed, state, jax.random.PRNGKey(0))
     return collective_census(exported.mlir_module())
+
+
+def _dp8_overlap_build(mode, overlap, min_buckets=8):
+    """Build the dp8 BERT-tiny bucketed train step with the grad sync
+    at wire tier ``mode`` and (optionally) overlap-aware ready-order
+    scheduling.  Returns (program, mesh, strategy, loss_var)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.compiler import BuildStrategy, make_mesh
+    from paddle_tpu.models import bert
+
+    cfg = bert.BertConfig.tiny()
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        feeds, total, mlm, nsp = bert.build_pretrain_network(cfg)
+        fluid.optimizer.Adam(1e-4).minimize(total)
+    mesh = make_mesh(8, "dp")
+    bs = BuildStrategy()
+    bs.fuse_all_reduce_ops = True
+    bs.overlap_grad_sync = overlap
+    bs.overlap_min_buckets = min_buckets
+    if mode == "bf16":
+        bs.allreduce_compress_dtype = "bfloat16"
+    elif mode in ("int8", "int4"):
+        bs.allreduce_quant_spec = {"dtype": mode, "block_size": 256}
+    fluid.CompiledProgram(main_p).with_data_parallel(
+        loss_name=total.name, mesh=mesh, build_strategy=bs)
+    return main_p, startup, mesh, total
+
+
+def _dp8_run_and_lower(main_p, startup, mesh, total, steps=2):
+    """Train ``steps`` dp8 steps (losses collected bitwise-comparable)
+    and cross-lower the step for TPU; returns (losses, mlir_txt)."""
+    import jax
+    import numpy as np
+    from jax import export as jexp
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import bert
+    from paddle_tpu.ops.pallas import lowering_target
+
+    cfg = bert.BertConfig.tiny()
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = None
+        for _ in range(steps):
+            data = bert.make_fake_batch(rng, cfg, batch_size=8,
+                                        seq_len=64, num_masks=3)
+            feed = {k: np.asarray(v) for k, v in data.items()}
+            l, = exe.run(main_p, feed=feed, fetch_list=[total.name])
+            losses.append(np.asarray(l))
+        step = exe._compile(main_p, feed, [total.name], scope, mesh,
+                            ("dp",), "dp")
+        state = {n: np.asarray(scope.find_var(n))
+                 for n in step.state_in_names}
+        with lowering_target("tpu"):
+            exported = jexp.export(step.fn, platforms=("tpu",))(
+                feed, state, jax.random.PRNGKey(0))
+    return losses, exported.mlir_module()
+
+
+def overlap_dp8_section(min_buckets=8):
+    """The overlap-scheduling proof the r14 artifact carries: the dp8
+    BERT-tiny grad sync, tail-fused vs ready-order overlapped —
+
+    * ordering census of both lowered modules: tail mode's grad-sync
+      all_reduces all have 0 compute after them; overlap mode shows
+      ≥ 4 interleaved grad-sync collectives, each preceding later
+      backward GEMMs in the module;
+    * bit-parity: the overlapped run's per-step losses equal the
+      tail-fused run's BITWISE (overlap moves the collectives, not the
+      math), plus the same ready-order IR lowered with
+      ``flag("overlap_lowering") = False`` (identical buckets, tail
+      placement) as the schedule-only control."""
+    import numpy as np
+    from paddle_tpu import flags
+
+    import paddle_tpu.fluid as fluid  # noqa: F401 (env init)
+
+    def census_of(txt):
+        rows = ordering_census(txt)
+        ar = [r for r in rows if r["kind"] == "all_reduce"]
+        return rows, sum(1 for r in ar if r["compute_after"] > 0)
+
+    # tail-fused baseline
+    losses_tail, txt_tail = _dp8_run_and_lower(
+        *_dp8_overlap_build("fp32", overlap=False))
+    rows_tail, inter_tail = census_of(txt_tail)
+
+    # ready-order overlapped
+    losses_ov, txt_ov = _dp8_run_and_lower(
+        *_dp8_overlap_build("fp32", overlap=True,
+                            min_buckets=min_buckets))
+    rows_ov, inter_ov = census_of(txt_ov)
+
+    # schedule-only control: same ready-order IR, hooks disabled
+    flags.set_flags({"overlap_lowering": False})
+    try:
+        losses_ctl, _ = _dp8_run_and_lower(
+            *_dp8_overlap_build("fp32", overlap=True,
+                                min_buckets=min_buckets))
+    finally:
+        flags.set_flags({"overlap_lowering": True})
+
+    bit_tail = bool(all(np.array_equal(a, b)
+                        for a, b in zip(losses_ov, losses_tail)))
+    bit_ctl = bool(all(np.array_equal(a, b)
+                       for a, b in zip(losses_ov, losses_ctl)))
+    return {
+        "module": "dp8_bert_tiny_train_bucketed",
+        "overlap_min_buckets": min_buckets,
+        "tail_fused": {
+            "grad_sync_collectives": sum(
+                1 for r in rows_tail if r["kind"] == "all_reduce"),
+            "interleaved": inter_tail,
+            "ordering": rows_tail,
+        },
+        "overlapped": {
+            "grad_sync_collectives": sum(
+                1 for r in rows_ov if r["kind"] == "all_reduce"),
+            "interleaved": inter_ov,
+            "ordering": rows_ov,
+        },
+        "loss_bit_parity_vs_tail_fused": bit_tail,
+        "loss_bit_parity_vs_tail_sunk_control": bit_ctl,
+        "losses": [float(np.asarray(l).reshape(())) for l in losses_ov],
+    }
+
+
+def overlap_main(argv):
+    """``--overlap [out.json]``: run the overlap-scheduling census and
+    write the r14 artifact (ordering census + bit-parity; asserted in
+    tier-1 by tests/test_overlap.py)."""
+    _env8()
+    section = overlap_dp8_section()
+    ov, tail = section["overlapped"], section["tail_fused"]
+    ok = (ov["interleaved"] >= 4
+          and tail["interleaved"] == 0
+          and ov["grad_sync_collectives"] >
+          tail["grad_sync_collectives"]
+          and section["loss_bit_parity_vs_tail_fused"]
+          and section["loss_bit_parity_vs_tail_sunk_control"])
+    out = {"artifact": "OVERLAP_CENSUS",
+           "revision": "r14",
+           "overlap_dp8": section,
+           "ok": bool(ok)}
+    path = next((a for a in argv if not a.startswith("--")),
+                "OVERLAP_CENSUS_r14.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"overlap census {'OK' if ok else 'FAILED'}: "
+          f"{ov['interleaved']}/{ov['grad_sync_collectives']} "
+          f"interleaved grad-sync collectives (tail mode: "
+          f"{tail['interleaved']}/{tail['grad_sync_collectives']}), "
+          f"bit parity vs tail-fused="
+          f"{section['loss_bit_parity_vs_tail_fused']} — wrote {path}")
+    return 0 if ok else 1
 
 
 def quant_dp8_section():
@@ -474,6 +667,8 @@ def fsdp_main(argv):
 if __name__ == "__main__":
     if "--fsdp" in sys.argv:
         sys.exit(fsdp_main(sys.argv[1:]))
+    if "--overlap" in sys.argv:
+        sys.exit(overlap_main(sys.argv[1:]))
     if "--selftest" in sys.argv:
         sys.exit(selftest())
     main()
