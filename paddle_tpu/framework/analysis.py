@@ -1056,6 +1056,75 @@ def check_pass_invariants(program: Program, pass_name: str,
     raise PassInvariantError("\n".join(lines))
 
 
+# ---------------------------------------------------------------------------
+# Pallas kernel-routing report (the custom-kernel tier, statically)
+# ---------------------------------------------------------------------------
+
+
+def kernel_routing_report(program: Program, feed_shapes=None,
+                          backend: str = "tpu", mesh_axes=None,
+                          fetch_names: Iterable[str] = ()) -> Dict:
+    """Per-program Pallas routing, with ZERO compiles and zero traces.
+
+    For every op in the global block that carries a ``pallas`` channel
+    (ops/op_specs.py), evaluate the route's flag/backend/shape gates at
+    the op's statically inferred signatures — answering "which ops WILL
+    lower to a custom kernel at these shapes on ``backend``, and why do
+    the rest fall back".  Shapes come from the op_spec ``infer`` channel
+    seeded with ``feed_shapes`` (name → shape tuple), exactly like the
+    memory analyzer; ``mesh_axes`` (axis → size) defaults to the
+    program's stamped :class:`MeshLayout` and scopes the routes that
+    depend on device-local shards (the ring route divides the sequence
+    by the sp size; the dequant-accumulate route needs the peer count).
+
+    Returns ``{"backend", "rows": [{op, index, route, kernel, reason,
+    kernels}], "summary": {kernel: {"pallas": n, "fallback": n}}}`` —
+    the report tools/proglint.py prints under ``--kernels`` and the
+    kernel census embeds in ``KERNEL_CENSUS_r15.json``."""
+    from ..ops.registry import OP_SPECS, VarSig, pallas_route
+    from .memory_analysis import _feed_sigs
+
+    if mesh_axes is None:
+        layout = getattr(program, "_mesh_layout", None)
+        if layout is not None:
+            mesh_axes = {a: s for a, s in layout.sizes.items()}
+    result = VerifyResult()
+    init_env = _feed_sigs(program, feed_shapes, unknown_dim=-1) \
+        if feed_shapes else None
+    env = infer_shapes(program, result, init_env=init_env)
+    block = program.global_block()
+    rows: List[Dict] = []
+    summary: Dict[str, Dict[str, int]] = {}
+    for idx, op in enumerate(block.ops):
+        spec = OP_SPECS.get(op.type)
+        if spec is None or not getattr(spec, "pallas", None):
+            continue
+        ins = {slot: [env.get(n) or _declared_sig(block, n)
+                      or VarSig(None, "float32") for n in names]
+               for slot, names in op.inputs.items()}
+        route, reason = pallas_route(op.type, ins, op.attrs,
+                                     axis_sizes=mesh_axes,
+                                     backend=backend, count=False)
+        if route is not None:
+            row = {"op": op.type, "index": idx, "route": "pallas",
+                   "kernel": route.kernel, "reason": reason,
+                   "kernels": list(route.kernels)}
+        else:
+            matching = [r for r in spec.pallas
+                        if r.match is None or r.match(op.attrs, mesh_axes)]
+            label = (matching or spec.pallas)[0].kernel
+            row = {"op": op.type, "index": idx, "route": "fallback",
+                   "kernel": label, "reason": reason,
+                   "kernels": []}
+        rows.append(row)
+        s = summary.setdefault(row["kernel"],
+                               {"pallas": 0, "fallback": 0})
+        s["pallas" if route is not None else "fallback"] += 1
+    return {"backend": backend,
+            "mesh_axes": dict(mesh_axes or {}),
+            "rows": rows, "summary": summary}
+
+
 __all__ = [
     "Diagnostic", "VerifyResult", "PassInvariantError",
     "QUANT_COLLECTIVE_INTEGER", "QUANT_NON_SUM", "QUANT_SMALL_BUCKET",
@@ -1067,4 +1136,5 @@ __all__ = [
     "verify_distributed", "verify_shard_layout", "collective_signature",
     "check_collective_consistency", "pass_snapshot",
     "check_pass_invariants", "op_reads_recursive", "VERIFY_STATS",
+    "kernel_routing_report",
 ]

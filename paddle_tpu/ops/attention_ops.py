@@ -6,19 +6,22 @@ One op takes projected Q/K/V in (B, S, H*D) layout plus an additive
 attention bias and produces the context in (B, S, H*D).  Keeping the whole
 attention in a single op gives a clean seam to swap the implementation for
 the Pallas flash-attention kernel (ops/pallas/flash_attention.py) on TPU
-while the jnp composition remains the CPU/interpret fallback."""
+while the jnp composition remains the CPU/interpret fallback.
+
+Routing goes through the registry's Pallas channel
+(``pallas_route("fused_attention", ...)`` — ops/op_specs.py registers the
+``flash_attention`` and ``ring_flash_attention`` routes), so the gate is
+statically enumerable, every hit/fallback lands in
+``observability.metrics`` counters labeled by op + reason, and fallback
+warnings name the EFFECTIVE lowering backend (ops.pallas), not
+``jax.default_backend()``."""
 
 from __future__ import annotations
-
-import logging
 
 import jax
 import jax.numpy as jnp
 
-from .registry import register, x
-
-_log = logging.getLogger(__name__)
-_warned_fallback = False
+from .registry import pallas_route, register, x
 
 
 def _split_heads(t, n_head):
@@ -29,6 +32,30 @@ def _split_heads(t, n_head):
 def _merge_heads(t):
     b, h, s, d = t.shape
     return t.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+
+def _resolve_heads(q, attrs):
+    """tensor-parallel callers pass the GLOBAL head count + head_dim; the
+    local head count follows from the traced width (hidden/tp inside
+    shard_map, full hidden off-mesh) so one program is correct under
+    both lowerings."""
+    n_head = attrs["n_head"]
+    head_dim = attrs.get("head_dim")
+    if head_dim:
+        n_head = max(1, int(q.shape[-1]) // int(head_dim))
+    return n_head
+
+
+def _attn_bias(ins):
+    """The additive bias: explicit AttnBias, else derived from the
+    [B, S] 0/1 valid-key KVMask."""
+    bias = x(ins, "AttnBias")
+    if bias is None:
+        kv_mask = x(ins, "KVMask")
+        if kv_mask is not None:
+            bias = (1.0 - kv_mask.astype(jnp.float32))[:, None, None, :] \
+                * -1e9
+    return bias
 
 
 def reference_attention(q, k, v, bias, n_head, dropout_rate, ctx,
@@ -59,67 +86,65 @@ def reference_attention(q, k, v, bias, n_head, dropout_rate, ctx,
     return _merge_heads(ctxv)
 
 
+def lower_flash_attention(ctx, ins, attrs):
+    """The ``flash_attention`` Pallas route: blockwise online-softmax
+    kernel on head-split operands (pallas_route guarantees the shape
+    tiles before this is called)."""
+    from .pallas.flash_attention import flash_attention_bshd
+    q, k, v = x(ins, "Q"), x(ins, "K"), x(ins, "V")
+    n_head = _resolve_heads(q, attrs)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    rate = 0.0 if is_test else float(attrs.get("dropout_rate", 0.0))
+    seed = None
+    if rate:
+        # derive a per-step int32 seed from the program RNG so the
+        # in-kernel PRNG mask changes every step but fwd/bwd agree
+        seed = jax.random.randint(ctx.next_key(), (1,), 0,
+                                  jnp.iinfo(jnp.int32).max,
+                                  dtype=jnp.int32)
+    out = flash_attention_bshd(
+        _split_heads(q, n_head), _split_heads(k, n_head),
+        _split_heads(v, n_head), _attn_bias(ins), dropout_rate=rate,
+        seed=seed, causal=bool(attrs.get("causal", False)))
+    return {"Out": _merge_heads(out)}
+
+
+def lower_ring_attention(ctx, ins, attrs, use_flash=False):
+    """Sequence-parallel attention: ring over the sp axis, inner step
+    either the Pallas blockwise flash kernel (the
+    ``ring_flash_attention`` route) or the einsum composition."""
+    from ..parallel.ring_attention import ring_attention
+    q, k, v = x(ins, "Q"), x(ins, "K"), x(ins, "V")
+    n_head = _resolve_heads(q, attrs)
+    kv_mask = x(ins, "KVMask")
+    out = ring_attention(
+        _split_heads(q, n_head), _split_heads(k, n_head),
+        _split_heads(v, n_head), attrs["_seq_axis"],
+        causal=attrs.get("causal", False), kv_mask=kv_mask,
+        use_flash=use_flash)
+    return {"Out": _merge_heads(out)}
+
+
 @register("fused_attention")
 def _fused_attention(ctx, ins, attrs):
     q, k, v = x(ins, "Q"), x(ins, "K"), x(ins, "V")
-    bias = x(ins, "AttnBias")
-    n_head = attrs["n_head"]
-    # tensor-parallel callers pass the GLOBAL head count + head_dim; the
-    # local head count follows from the traced width (hidden/tp inside
-    # shard_map, full hidden off-mesh) so one program is correct under
-    # both lowerings
-    head_dim = attrs.get("head_dim")
-    if head_dim:
-        n_head = max(1, int(q.shape[-1]) // int(head_dim))
+    n_head = _resolve_heads(q, attrs)
     dropout_rate = attrs.get("dropout_rate", 0.0)
     is_test = attrs.get("is_test", False) or ctx.is_test
-    from ..flags import flag
-    use_pallas = attrs.get("use_flash", flag("use_flash_attention"))
     # sequence parallelism: attention rings over the sp axis (the q/k/v
     # entering here hold only this device's sequence shard)
     seq_axis = attrs.get("_seq_axis")
     if seq_axis and seq_axis in ctx.axis_names:
-        from ..parallel.ring_attention import ring_attention
-        kv_mask = x(ins, "KVMask")
-        out = ring_attention(
-            _split_heads(q, n_head), _split_heads(k, n_head),
-            _split_heads(v, n_head), seq_axis,
-            causal=attrs.get("causal", False), kv_mask=kv_mask)
-        return {"Out": _merge_heads(out)}
-    if bias is None:
-        kv_mask = x(ins, "KVMask")
-        if kv_mask is not None:        # [B, S] 0/1 valid-key mask → bias
-            bias = (1.0 - kv_mask.astype(jnp.float32))[:, None, None, :] \
-                * -1e9
-    causal = bool(attrs.get("causal", False))
-    if use_pallas:
-        from .pallas.flash_attention import flash_attention_bshd, supported
-        b, s, hd = q.shape
-        sk = k.shape[1]
-        d = hd // n_head
-        if supported((b, n_head, s, d), k_seq=sk) and \
-                (not causal or s == sk):
-            rate = 0.0 if is_test else float(dropout_rate)
-            seed = None
-            if rate:
-                # derive a per-step int32 seed from the program RNG so the
-                # in-kernel PRNG mask changes every step but fwd/bwd agree
-                seed = jax.random.randint(ctx.next_key(), (1,), 0,
-                                          jnp.iinfo(jnp.int32).max,
-                                          dtype=jnp.int32)
-            out = flash_attention_bshd(
-                _split_heads(q, n_head), _split_heads(k, n_head),
-                _split_heads(v, n_head), bias, dropout_rate=rate,
-                seed=seed, causal=causal)
-            return {"Out": _merge_heads(out)}
-        global _warned_fallback
-        if not _warned_fallback:
-            _warned_fallback = True
-            _log.warning(
-                "fused_attention: pallas flash kernel unavailable for "
-                "shape B=%d H=%d Sq=%d Sk=%d D=%d on backend %s — using "
-                "jnp composition (S must tile 128; D must be 64 or a "
-                "multiple of 128)", b, n_head, s, sk, d,
-                jax.default_backend())
-    return {"Out": reference_attention(q, k, v, bias, n_head, dropout_rate,
-                                       ctx, is_test, causal=causal)}
+        route, _ = pallas_route("fused_attention", ins, attrs,
+                                kernel="ring_flash_attention")
+        if route is not None:
+            return route.lower(ctx, ins, attrs)
+        return lower_ring_attention(ctx, ins, attrs, use_flash=False)
+    route, _ = pallas_route("fused_attention", ins, attrs,
+                            kernel="flash_attention")
+    if route is not None:
+        return route.lower(ctx, ins, attrs)
+    return {"Out": reference_attention(q, k, v, _attn_bias(ins), n_head,
+                                       dropout_rate, ctx, is_test,
+                                       causal=bool(attrs.get("causal",
+                                                             False)))}

@@ -151,11 +151,38 @@ def _quant_key(ctx, spec, ax):
     return jax.random.fold_in(k, lax.axis_index(ax))
 
 
-def _quant_allreduce_axis(flat, ax, spec, ctx):
+def _recv_use_kernel(spec, n, shard_blocks, use_kernel):
+    """Per-axis re-check of the fused receive-stage kernel gate (the
+    op-level pallas_route decided from the FIRST reduce axis; later axes
+    of a dp×sp grid may differ in size)."""
+    if not use_kernel:
+        return False
+    from .pallas.quant_kernels import supported
+    ok, _ = supported(n, shard_blocks, spec, backend="tpu")
+    return ok
+
+
+def _recv_accumulate(qx, sx, spec, n, shard_blocks, use_kernel):
+    """The receive stage: n peer contributions (wire-width payload +
+    scales) → the local f32 reduced shard.  One fused VMEM pass when the
+    dequant-accumulate kernel is routed, else the jnp multi-pass."""
+    if _recv_use_kernel(spec, n, shard_blocks, use_kernel):
+        from .pallas.quant_kernels import dequant_accumulate
+        return dequant_accumulate(qx.reshape(n * shard_blocks, -1),
+                                  sx.reshape(-1), spec, n)
+    contrib = dequantize_blockwise(
+        qx.reshape(n * shard_blocks, -1), sx.reshape(-1), spec)
+    return contrib.reshape(n, -1).sum(axis=0)
+
+
+def _quant_allreduce_axis(flat, ax, spec, ctx, use_kernel=False):
     """One reduce axis of the two-stage quantized all-reduce: quantize →
     all_to_all shards (wire-width payload + f32 scales) → dequant →
     upcast-accumulate → requantize → all_gather → dequant.  Returns the
-    reduced f32 flat array at the input length."""
+    reduced f32 flat array at the input length.  With ``use_kernel``
+    (the registry's dequant_accumulate pallas route) the receive stage
+    runs as one fused VMEM pass — and for round-to-nearest int8 the
+    requantization fuses too, so the local f32 sum never touches HBM."""
     n = axis_size(ax)
     numel = flat.shape[0]
     bs = spec.block_size
@@ -168,11 +195,16 @@ def _quant_allreduce_axis(flat, ax, spec, ctx):
                         split_axis=0, concat_axis=0)
     sx = lax.all_to_all(s.reshape(n, shard_blocks), ax,
                         split_axis=0, concat_axis=0)
-    contrib = dequantize_blockwise(
-        qx.reshape(n * shard_blocks, -1), sx.reshape(-1), spec)
-    local = contrib.reshape(n, -1).sum(axis=0)
-    q2, s2 = quantize_blockwise(local, spec,
-                                key=_quant_key(ctx, spec, ax))
+    if (spec.dtype == "int8" and not spec.stochastic_rounding
+            and _recv_use_kernel(spec, n, shard_blocks, use_kernel)):
+        from .pallas.quant_kernels import dequant_accumulate_requant
+        q2, s2 = dequant_accumulate_requant(
+            qx.reshape(n * shard_blocks, -1), sx.reshape(-1), spec, n)
+    else:
+        local = _recv_accumulate(qx, sx, spec, n, shard_blocks,
+                                 use_kernel)
+        q2, s2 = quantize_blockwise(local, spec,
+                                    key=_quant_key(ctx, spec, ax))
     # stage 2: rebuild the full reduced tensor — same bytes on every
     # rank, so local dequant cannot diverge across replicas
     qf = lax.all_gather(q2.reshape(-1), ax, axis=0, tiled=True)
@@ -181,15 +213,25 @@ def _quant_allreduce_axis(flat, ax, spec, ctx):
     return full[:numel], sf
 
 
-def _quant_allreduce_flat(flat, axes, spec, ctx):
+def _quant_allreduce_flat(flat, axes, spec, ctx, use_kernel=False):
     """Sequential per-axis quantized all-reduce (dp×sp grids reduce one
     axis at a time; quantization error compounds per stage, the byte
     saving applies on every axis).  Returns (reduced flat f32, last
     stage-2 scale tensor)."""
     scales = None
     for ax in _axes_tuple(axes):
-        flat, scales = _quant_allreduce_axis(flat, ax, spec, ctx)
+        flat, scales = _quant_allreduce_axis(flat, ax, spec, ctx,
+                                             use_kernel=use_kernel)
     return flat, scales
+
+
+def _quant_route(op_type, ins, attrs, axis):
+    """Op-level pallas_route for a quantized collective's receive stage
+    (counts the hit/fallback in observability.metrics)."""
+    from .registry import pallas_route
+    axis_sizes = {ax: axis_size(ax) for ax in _axes_tuple(axis)}
+    route, _ = pallas_route(op_type, ins, attrs, axis_sizes=axis_sizes)
+    return route is not None
 
 
 @register("c_quant_allreduce_sum")
@@ -207,8 +249,10 @@ def _c_quant_allreduce_sum(ctx, ins, attrs):
         return {"Out": a}
     spec = CompressionSpec.from_attr(attrs["quant_spec"])
     orig = a.dtype
+    use_kernel = _quant_route("c_quant_allreduce_sum", ins, attrs, axis)
     flat, _ = _quant_allreduce_flat(
-        a.reshape(-1).astype(jnp.float32), axis, spec, ctx)
+        a.reshape(-1).astype(jnp.float32), axis, spec, ctx,
+        use_kernel=use_kernel)
     return {"Out": flat.reshape(a.shape).astype(orig)}
 
 
@@ -234,8 +278,10 @@ def _c_fused_quant_allreduce_sum(ctx, ins, attrs):
     sizes = [int(np.prod(a.shape)) if a.ndim else 1 for a in outs]
     flat = jnp.concatenate([a.reshape(-1) for a in outs])
     orig = flat.dtype
+    use_kernel = _quant_route("c_fused_quant_allreduce_sum", ins, attrs,
+                              axis)
     red, scales = _quant_allreduce_flat(
-        flat.astype(jnp.float32), axis, spec, ctx)
+        flat.astype(jnp.float32), axis, spec, ctx, use_kernel=use_kernel)
     red = red.astype(orig)
     pieces, off = [], 0
     for a, n in zip(outs, sizes):
@@ -284,9 +330,8 @@ def _quant_reduce_scatter(ctx, ins, attrs):
                         split_axis=0, concat_axis=0)
     sx = lax.all_to_all(s.reshape(n, shard_blocks), scatter_ax,
                         split_axis=0, concat_axis=0)
-    contrib = dequantize_blockwise(
-        qx.reshape(n * shard_blocks, -1), sx.reshape(-1), spec)
-    out = contrib.reshape(n, -1).sum(axis=0)
+    use_kernel = _quant_route("quant_reduce_scatter", ins, attrs, axes)
+    out = _recv_accumulate(qx, sx, spec, n, shard_blocks, use_kernel)
     return {"Out": out.astype(orig)}
 
 
@@ -316,7 +361,10 @@ def _zero_reduce_scatter(ctx, ins, attrs):
     axes = _axes_tuple(axis)
     scatter_ax, rest = axes[0], axes[1:]
     n = axis_size(scatter_ax)
-    flat = _flat_pad(g, n)
+    # ``align`` mirrors zero_shard_slice: the sharded optimizer pads
+    # flat shards to the fused-Adam kernel's 128-lane layout, so grad
+    # and param shards must cover identical element ranges
+    flat = _flat_pad(g, n, align=attrs.get("align", 1))
     comp = attrs.get("compress_dtype")
     orig = flat.dtype
     if comp and jnp.issubdtype(orig, jnp.floating):

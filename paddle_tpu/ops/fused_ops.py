@@ -34,17 +34,15 @@ def _fused_elemwise_activation(ctx, ins, attrs):
     binary, unary = functors[0], functors[1]
     a, b = x(ins, "X"), x(ins, "Y")
     # bias+gelu: route onto the fused Pallas kernel (one VMEM pass,
-    # recompute-based backward) when the shape tiles
-    from ..flags import flag
-    axis = attrs.get("axis", -1)
-    if (binary == "elementwise_add" and unary == "gelu"
-            and flag("use_pallas_fused") and a is not None and b is not None
-            and b.ndim == 1 and a.shape[-1] == b.shape[0]
-            and axis in (-1, a.ndim - 1)):
-        from .pallas.fused_ops import bias_gelu, bg_supported
-        d = a.shape[-1]
-        r = int(a.size // d)
-        if bg_supported(r, d):
+    # recompute-based backward) when the shape tiles — gate lives in
+    # the registry's pallas channel (ops/op_specs.py)
+    from .registry import pallas_route
+    if a is not None and b is not None:
+        route, _ = pallas_route("fused_elemwise_activation", ins, attrs)
+        if route is not None:
+            from .pallas.fused_ops import bias_gelu
+            d = a.shape[-1]
+            r = int(a.size // d)
             out = bias_gelu(a.reshape(r, d), b).reshape(a.shape)
             return {"Out": out}
     # delegate the binary to the stock elementwise op so axis-broadcast
@@ -70,15 +68,15 @@ def _fused_add_layernorm(ctx, ins, attrs):
     for s in a.shape[bna:]:
         d *= int(s)
     r = int(a.size // d)
-    from ..flags import flag
-    if flag("use_pallas_fused") and scale is not None and bias is not None:
-        from .pallas.fused_ops import add_layer_norm, ln_supported
-        if ln_supported(r, d):
-            y = add_layer_norm(a.reshape(r, d), res.reshape(r, d),
-                               scale.reshape(d), bias.reshape(d),
-                               eps).reshape(a.shape)
-            zeros = jnp.zeros(a.shape[:bna], jnp.float32)
-            return {"Y": y, "Mean": zeros, "Variance": zeros}
+    from .registry import pallas_route
+    route, _ = pallas_route("fused_add_layernorm", ins, attrs)
+    if route is not None:
+        from .pallas.fused_ops import add_layer_norm
+        y = add_layer_norm(a.reshape(r, d), res.reshape(r, d),
+                           scale.reshape(d), bias.reshape(d),
+                           eps).reshape(a.shape)
+        zeros = jnp.zeros(a.shape[:bna], jnp.float32)
+        return {"Y": y, "Mean": zeros, "Variance": zeros}
     from .registry import get_op
     summed = a + res
     return get_op("layer_norm")(ctx, {"X": [summed], "Scale": ins.get(
@@ -115,21 +113,21 @@ def _multihead_matmul(ctx, ins, attrs):
     post = (1.0 - dropout_rate) \
         if (dropout_rate and is_test and impl == "downgrade_in_infer") \
         else 1.0
-    from ..flags import flag
-    if (not dropout_rate or is_test) and flag("use_flash_attention"):
-        try:
-            from .pallas.flash_attention import flash_attention_bshd
-            # the kernel scales scores by 1/sqrt(d) internally; fold the
-            # matched pattern's alpha in by pre-scaling q
-            d = q.shape[-1]
-            comp = alpha * (d ** 0.5)
-            qq = q if comp == 1.0 else q * jnp.asarray(comp, q.dtype)
-            out = flash_attention_bshd(qq, k, v, bias)
-            if post != 1.0:
-                out = out * jnp.asarray(post, out.dtype)
-            return {"Out": out}
-        except Exception:
-            pass  # CPU/interpret or unsupported shape: jnp fallback
+    from .registry import pallas_route
+    route, _ = pallas_route(
+        "multihead_matmul", ins,
+        dict(attrs, is_test=is_test))
+    if route is not None:
+        from .pallas.flash_attention import flash_attention_bshd
+        # the kernel scales scores by 1/sqrt(d) internally; fold the
+        # matched pattern's alpha in by pre-scaling q
+        d = q.shape[-1]
+        comp = alpha * (d ** 0.5)
+        qq = q if comp == 1.0 else q * jnp.asarray(comp, q.dtype)
+        out = flash_attention_bshd(qq, k, v, bias)
+        if post != 1.0:
+            out = out * jnp.asarray(post, out.dtype)
+        return {"Out": out}
     if alpha != 1.0:
         q = q * jnp.asarray(alpha, q.dtype)
     scores = jnp.einsum("bhsd,bhtd->bhst", q, k,

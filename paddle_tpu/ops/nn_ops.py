@@ -201,21 +201,21 @@ def _layer_norm(ctx, ins, attrs):
         d *= int(s)
     r = int(a.size // d)
 
-    from ..flags import flag
-    if flag("use_pallas_fused") and scale is not None and bias is not None:
-        from .pallas.fused_ops import layer_norm as pallas_ln, ln_supported
-        if ln_supported(r, d):
-            y = pallas_ln(a.reshape(r, d), scale.reshape(d),
-                          bias.reshape(d), eps).reshape(a.shape)
-            # Mean/Variance are rarely-consumed auxiliaries; computed
-            # outside the kernel (DCE removes them when unfetched) and
-            # non-differentiable, matching the fused path's bwd contract
-            mean = lax.stop_gradient(jnp.mean(
-                a.astype(jnp.float32), axis=axes))
-            var = lax.stop_gradient(jnp.var(
-                a.astype(jnp.float32), axis=axes))
-            return {"Y": y, "Mean": mean.reshape(a.shape[:bna]),
-                    "Variance": var.reshape(a.shape[:bna])}
+    from .registry import pallas_route
+    route, _ = pallas_route("layer_norm", ins, attrs)
+    if route is not None:
+        from .pallas.fused_ops import layer_norm as pallas_ln
+        y = pallas_ln(a.reshape(r, d), scale.reshape(d),
+                      bias.reshape(d), eps).reshape(a.shape)
+        # Mean/Variance are rarely-consumed auxiliaries; computed
+        # outside the kernel (DCE removes them when unfetched) and
+        # non-differentiable, matching the fused path's bwd contract
+        mean = lax.stop_gradient(jnp.mean(
+            a.astype(jnp.float32), axis=axes))
+        var = lax.stop_gradient(jnp.var(
+            a.astype(jnp.float32), axis=axes))
+        return {"Y": y, "Mean": mean.reshape(a.shape[:bna]),
+                "Variance": var.reshape(a.shape[:bna])}
 
     mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
     var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)

@@ -26,7 +26,8 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from .registry import SpecMismatch, VarSig, op_spec
+from .registry import (PallasLowering, SpecMismatch, VarSig, _shape_of,
+                       op_spec)
 
 _INT_DTYPES = ("int8", "uint8", "int16", "int32", "int64", "bool")
 
@@ -836,6 +837,249 @@ def collective_wire_bytes(op_type, ins, attrs, axis_sizes=None):
     return fn(ins, attrs, axis_sizes)
 
 
+# ---------------------------------------------------------------------------
+# Pallas lowering channel — the per-op custom-kernel tier
+# ---------------------------------------------------------------------------
+#
+# Each PallasLowering below carries a TRACE-FREE supported() predicate
+# mirroring exactly what its kernel rejects (flash tiling rules, the
+# fused-Adam size/alignment floor, the dequant-accumulate block layout),
+# so analysis.kernel_routing_report can state per program which ops WILL
+# lower to a custom kernel at given shapes — and why the rest fall back —
+# with zero compiles.  The predicates accept VarSig (static analysis) and
+# traced jax arrays (op-impl dispatch) interchangeably via _shape_of.
+# ``axis_sizes`` is the mesh map for GLOBAL (program-level) shapes; the
+# trace-time convention is axis_sizes=None with shapes already
+# device-local.
+
+
+def _attn_bhsd(ins, attrs):
+    """(b, h, s, sk, d) from the fused_attention Q/K/V slots, or None."""
+    q = _shape_of(_sig(ins, "Q"))
+    k = _shape_of(_sig(ins, "K"))
+    if q is None or k is None or len(q) != 3:
+        return None
+    hd = q[-1]
+    if hd < 0 or q[1] < 0 or k[1] < 0:
+        return None
+    n_head = attrs.get("n_head", 1)
+    head_dim = attrs.get("head_dim")
+    if head_dim:
+        n_head = max(1, hd // int(head_dim))
+    if n_head <= 0 or hd % n_head:
+        return None
+    return q[0], n_head, q[1], k[1], hd // n_head
+
+
+def _flash_tiles(s, sk, d, causal=False):
+    """The flash kernel's static tiling rules → (ok, reason)."""
+    if s % 128 or sk % 128:
+        return False, f"seq:{s}x{sk}%128"
+    if d % 128 and d != 64:
+        return False, f"head-dim:{d}"
+    if causal and s != sk:
+        return False, "causal-rectangular"
+    return True, ""
+
+
+def _pl_flash_supported(ins, attrs, axis_sizes=None):
+    dims = _attn_bhsd(ins, attrs)
+    if dims is None:
+        return False, "shape-unknown"
+    b, h, s, sk, d = dims
+    return _flash_tiles(s, sk, d, causal=bool(attrs.get("causal")))
+
+
+def _pl_ring_supported(ins, attrs, axis_sizes=None):
+    if _sig(ins, "AttnBias") is not None:
+        return False, "ring-explicit-bias"
+    dims = _attn_bhsd(ins, attrs)
+    if dims is None:
+        return False, "shape-unknown"
+    b, h, s, sk, d = dims
+    ax = attrs.get("_seq_axis")
+    if axis_sizes is not None:
+        # static view: program shapes are global — the ring step sees
+        # the 1/sp sequence shard
+        sp = axis_sizes.get(ax)
+        if not sp:
+            return False, f"sp-axis:{ax}-unknown"
+        if s % sp or sk % sp:
+            return False, f"seq:{s}%sp{sp}"
+        s, sk = s // sp, sk // sp
+    return _flash_tiles(s, sk, d)
+
+
+def _ring_stamped(attrs, axis_sizes):
+    ax = attrs.get("_seq_axis")
+    return bool(ax) and (axis_sizes is None or ax in (axis_sizes or {}))
+
+
+def _pl_adam_supported(ins, attrs, axis_sizes=None):
+    if attrs.get("lazy_mode") and ins.get("SparseRows"):
+        return False, "sparse-rows"
+    shapes = [_shape_of(_sig(ins, slot))
+              for slot in ("Param", "Grad", "Moment1")]
+    if any(sh is None or any(d < 0 for d in sh) for sh in shapes):
+        return False, "shape-unknown"
+    if not shapes[0] == shapes[1] == shapes[2]:
+        return False, "param-grad-moment-shapes"
+    n = _numel(shapes[0])
+    if n % 128:
+        return False, f"numel:{n}%128"
+    if n < 1024:
+        return False, f"numel:{n}<1024"
+    return True, ""
+
+
+def _rows_last_dim(sig, bna):
+    sh = _shape_of(sig)
+    if sh is None or any(d < 0 for d in sh[bna:]):
+        return None
+    d = _numel(sh[bna:])
+    r = -1 if any(x < 0 for x in sh[:bna]) else _numel(sh[:bna])
+    return r, d
+
+
+def _pl_ln_supported(ins, attrs, axis_sizes=None):
+    if _sig(ins, "Scale") is None or _sig(ins, "Bias") is None:
+        return False, "no-affine"
+    rd = _rows_last_dim(_sig(ins, "X"), attrs.get("begin_norm_axis", 1))
+    if rd is None:
+        return False, "shape-unknown"
+    _, d = rd
+    if d % 128 or d > 8192:
+        return False, f"norm-dim:{d}"
+    return True, ""
+
+
+def _pl_add_ln_supported(ins, attrs, axis_sizes=None):
+    if _sig(ins, "Residual") is None:
+        return False, "no-residual"
+    return _pl_ln_supported(ins, attrs, axis_sizes)
+
+
+def _pl_bias_gelu_supported(ins, attrs, axis_sizes=None):
+    functors = list(attrs.get("functor_list",
+                              ["elementwise_add", "relu"]))
+    if functors != ["elementwise_add", "gelu"]:
+        return False, "functors:" + "+".join(functors)
+    xs = _shape_of(_sig(ins, "X"))
+    ys = _shape_of(_sig(ins, "Y"))
+    if xs is None or ys is None:
+        return False, "shape-unknown"
+    if len(ys) != 1 or xs[-1] != ys[0]:
+        return False, "bias-not-last-dim"
+    axis = attrs.get("axis", -1)
+    if axis not in (-1, len(xs) - 1):
+        return False, f"axis:{axis}"
+    d = xs[-1]
+    if d < 0:
+        return False, "shape-unknown"
+    if d % 128 or d > 16384:
+        return False, f"dim:{d}"
+    return True, ""
+
+
+def _pl_mhm_supported(ins, attrs, axis_sizes=None):
+    if attrs.get("dropout_rate") and not attrs.get("is_test"):
+        return False, "dropout"
+    q = _shape_of(_sig(ins, "Q"))
+    k = _shape_of(_sig(ins, "K"))
+    if q is None or k is None or len(q) != 4:
+        return False, "shape-unknown"
+    if q[2] < 0 or k[2] < 0:
+        return False, "shape-unknown"
+    return _flash_tiles(q[2], k[2], q[3])
+
+
+def _quant_shard_blocks(ins, attrs, axis_sizes):
+    """(n_peers, per-shard quant blocks, spec) for a quantized
+    collective, or (None, None, spec) when the mesh/payload is
+    unknown."""
+    from .quantize_wire import CompressionSpec
+    spec = CompressionSpec.from_attr(attrs.get("quant_spec"))
+    axes = attrs.get("_axis_name") or ()
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    n = (axis_sizes or {}).get(axes[0]) if axes else None
+    numel = 0
+    for sig in ins.get("X", []):
+        sh = _shape_of(sig)
+        if sh is None or any(d < 0 for d in sh):
+            return None, None, spec
+        numel += _numel(sh)
+    if not numel or not n:
+        return n, None, spec
+    pad = n * spec.block_size
+    shard_blocks = (numel + pad - 1) // pad
+    return n, shard_blocks, spec
+
+
+def _pl_dequant_acc_supported(ins, attrs, axis_sizes=None):
+    from .pallas import quant_kernels as qk
+    n, shard_blocks, spec = _quant_shard_blocks(ins, attrs, axis_sizes)
+    if spec is None:
+        return False, "no-quant-spec"
+    # backend is re-checked by pallas_route; pass a TPU backend so this
+    # predicate reports only the shape/layout capability
+    return qk.supported(n, shard_blocks, spec, backend="tpu")
+
+
+def _lower_flash_attention(ctx, ins, attrs):
+    from .attention_ops import lower_flash_attention
+    return lower_flash_attention(ctx, ins, attrs)
+
+
+def _lower_ring_flash_attention(ctx, ins, attrs):
+    from .attention_ops import lower_ring_attention
+    return lower_ring_attention(ctx, ins, attrs, use_flash=True)
+
+
+_FLASH_KERNELS = ("_fwd_kernel", "_bwd_dq_kernel", "_bwd_dkv_kernel")
+
+#: the Pallas tier, one route table entry per op (kernel names are the
+#: census contract: each must appear as a tpu_custom_call kernel_name in
+#: the TPU-lowered module when the route reports a hit)
+_PL_FLASH = PallasLowering(
+    "flash_attention", flag="use_flash_attention", attr="use_flash",
+    match=lambda attrs, ax: not _ring_stamped(attrs, ax),
+    supported=_pl_flash_supported, lower=_lower_flash_attention,
+    kernels=_FLASH_KERNELS)
+_PL_RING = PallasLowering(
+    "ring_flash_attention", flag="use_flash_attention", attr="use_flash",
+    match=_ring_stamped,
+    supported=_pl_ring_supported, lower=_lower_ring_flash_attention,
+    kernels=_FLASH_KERNELS)
+_PL_ADAM = PallasLowering(
+    "fused_adam", flag="use_pallas_fused",
+    supported=_pl_adam_supported,
+    kernels=("_adam_kernel",))
+_PL_LN = PallasLowering(
+    "fused_layer_norm", flag="use_pallas_fused",
+    supported=_pl_ln_supported,
+    kernels=("_ln_fwd_kernel", "_ln_bwd_kernel"))
+_PL_ADD_LN = PallasLowering(
+    "fused_add_layer_norm", flag="use_pallas_fused",
+    supported=_pl_add_ln_supported,
+    kernels=("_aln_fwd_kernel", "_aln_bwd_kernel"))
+_PL_BIAS_GELU = PallasLowering(
+    "fused_bias_gelu", flag="use_pallas_fused",
+    supported=_pl_bias_gelu_supported,
+    kernels=("_bg_fwd_kernel", "_bg_bwd_kernel"))
+_PL_MHM = PallasLowering(
+    "flash_attention", flag="use_flash_attention",
+    supported=_pl_mhm_supported,
+    kernels=("_fwd_kernel",))
+_PL_DEQUANT_ACC = PallasLowering(
+    "dequant_accumulate", flag="use_pallas_fused",
+    supported=_pl_dequant_acc_supported,
+    kernels=("_dq_acc_kernel",))
+_PL_DEQUANT_ACC_AR = PallasLowering(
+    "dequant_accumulate", flag="use_pallas_fused",
+    supported=_pl_dequant_acc_supported,
+    kernels=("_dq_acc_kernel", "_dq_acc_requant_kernel"))
+
+
 def register_default_specs():
     """Register the built-in spec library (idempotent).
 
@@ -888,7 +1132,7 @@ def register_default_specs():
     op_spec("conv2d", infer=_infer_conv2d, flops=_flops_conv2d)
     op_spec("depthwise_conv2d", infer=_infer_conv2d, flops=_flops_conv2d)
     op_spec("pool2d", infer=_infer_pool2d)
-    op_spec("layer_norm", infer=_infer_layer_norm)
+    op_spec("layer_norm", infer=_infer_layer_norm, pallas=(_PL_LN,))
     op_spec("batch_norm", infer=_infer_batch_norm)
     op_spec("lookup_table", infer=_infer_lookup_table)
     op_spec("lookup_table_v2", infer=_infer_lookup_table_v2)
@@ -898,7 +1142,8 @@ def register_default_specs():
     op_spec("cross_entropy2", infer=_infer_cross_entropy)
     op_spec("fused_attention", infer=_infer_fused_attention,
             mem_backward_extra=_attention_probs_bytes,
-            flops=_flops_fused_attention)
+            flops=_flops_fused_attention,
+            pallas=(_PL_RING, _PL_FLASH))
 
     # tensor manipulation (views are pure aliases)
     op_spec("reshape2", infer=_infer_reshape2, mem_transparent=True)
@@ -918,10 +1163,13 @@ def register_default_specs():
                  "truncated_gaussian_random"):
         op_spec(name, infer=from_shape_attr())
 
-    # optimizer updates
-    for name in ("sgd", "momentum", "adam", "adamw", "adamax", "adagrad",
+    # optimizer updates (adam/adamw carry the fused flat-shard kernel
+    # route — the ZeRO-1/ZeRO-3 1-D state shards are its ideal shape)
+    for name in ("sgd", "momentum", "adamax", "adagrad",
                  "rmsprop", "lars_momentum", "lamb"):
         op_spec(name, infer=_infer_opt_update)
+    for name in ("adam", "adamw"):
+        op_spec(name, infer=_infer_opt_update, pallas=(_PL_ADAM,))
 
     # meta ops (known to the static layer, no shape opinion)
     for name in ("feed", "fetch", "backward", "pipeline", "assign_value",
@@ -933,12 +1181,17 @@ def register_default_specs():
                  "while_loop", "conditional_block", "switch_case",
                  "static_rnn", "py_func", "print", "beam_gather",
                  "gather_tree", "gather_tokens",
-                 "multihead_matmul", "fused_elemwise_activation",
-                 "fused_bn_activation", "fused_add_layernorm",
+                 "fused_bn_activation",
                  "fused_embedding_eltwise_layernorm", "fc",
                  "affine_channel",
                  "uniform_random_batch_size_like", "seed"):
         op_spec(name, infer=None)
+    # fused-pattern ops with Pallas routes (no shape opinion, but the
+    # kernel tier gate is statically enumerable)
+    op_spec("multihead_matmul", infer=None, pallas=(_PL_MHM,))
+    op_spec("fused_elemwise_activation", infer=None,
+            pallas=(_PL_BIAS_GELU,))
+    op_spec("fused_add_layernorm", infer=None, pallas=(_PL_ADD_LN,))
     op_spec("flatten2", infer=None, mem_transparent=True)
     op_spec("flatten", infer=None, mem_transparent=True)
 
@@ -949,19 +1202,27 @@ def register_default_specs():
         op_spec(name, infer=_infer_collective_same, collective=True,
                 wire=_WIRE_SPECS.get(name))
     op_spec("c_quant_allreduce_sum", infer=_infer_collective_same,
-            collective=True, wire=_WIRE_SPECS["c_quant_allreduce_sum"])
+            collective=True, wire=_WIRE_SPECS["c_quant_allreduce_sum"],
+            pallas=(_PL_DEQUANT_ACC_AR,))
     op_spec("c_identity", infer=_infer_collective_same)
     op_spec("c_sync_calc_stream", infer=_infer_collective_same)
     op_spec("c_sync_comm_stream", infer=_infer_collective_same)
-    for name in ("c_fused_allreduce_sum", "c_fused_quant_allreduce_sum",
+    for name in ("c_fused_allreduce_sum",
                  "c_broadcast", "c_allgather",
                  "c_reducescatter", "c_concat", "c_split", "alltoall",
                  "collective_permute", "zero_reduce_scatter",
-                 "quant_reduce_scatter",
                  "zero_all_gather", "zero_shard_slice",
                  "local_sgd_sync", "moe_ffn"):
         op_spec(name, infer=None, collective=True,
                 wire=_WIRE_SPECS.get(name))
+    # quantized collectives: the receive stage routes onto the fused
+    # dequant-upcast-accumulate(-requantize) kernel
+    op_spec("c_fused_quant_allreduce_sum", infer=None, collective=True,
+            wire=_WIRE_SPECS["c_fused_quant_allreduce_sum"],
+            pallas=(_PL_DEQUANT_ACC_AR,))
+    op_spec("quant_reduce_scatter", infer=None, collective=True,
+            wire=_WIRE_SPECS["quant_reduce_scatter"],
+            pallas=(_PL_DEQUANT_ACC,))
     # vocab-parallel embedding: Out = Ids.shape + [dim] exactly like
     # lookup_table_v2 (the psum keeps the global [.., dim] width).
     # Without this the tp-BERT shape propagation stalled at op 0 and

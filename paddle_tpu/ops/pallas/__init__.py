@@ -37,3 +37,15 @@ def effective_backend() -> str:
         return jax.default_backend()
     except Exception:
         return "cpu"
+
+
+#: backends whose canonical lowering is the TPU Mosaic pipeline — the
+#: only platforms the Pallas kernel tier routes onto (the registry's
+#: pallas_route and every kernel supported() gate consult this)
+TPU_BACKENDS = ("tpu", "axon")
+
+
+def is_tpu_backend(backend=None) -> bool:
+    """Is ``backend`` (default: the effective lowering backend) one the
+    Pallas kernels compile for?"""
+    return (backend or effective_backend()) in TPU_BACKENDS

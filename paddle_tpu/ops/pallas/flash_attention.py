@@ -285,7 +285,8 @@ def _flash_fwd(q, k, v, bias, seed, rate, causal, interpret):
     )(seed, q, k, v, barg)
 
 
-def _flash_bwd(q, k, v, bias, seed, o, lse, g, rate, causal, interpret):
+def _flash_bwd(q, k, v, bias, seed, o, lse, g, rate, causal, interpret,
+               dlse=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
     num_q = sq // BLOCK_Q
@@ -294,6 +295,11 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, g, rate, causal, interpret):
     has_bias = bias is not None
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)         # (BH, Sq, 1)
+    if dlse is not None:
+        # dL/ds_ij = p_ij·(dp_ij − delta_i) + dlse_i·p_ij — an lse
+        # cotangent folds into the SAME kernels as delta' = delta − dlse
+        # (the ring-attention merge differentiates through lse)
+        delta = delta - dlse.astype(jnp.float32)
 
     qblk = pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0),
                         memory_space=pltpu.VMEM)
@@ -348,24 +354,31 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, g, rate, causal, interpret):
 
 
 @functools.lru_cache(maxsize=None)
-def _make_flash(rate, has_bias, causal, interpret):
+def _make_flash(rate, has_bias, causal, interpret, with_lse=False):
     """custom_vjp'd flash attention specialised on (dropout rate, bias
     presence, causal, interpret mode) — all static, so each variant
-    traces once."""
+    traces once.  ``with_lse=True`` additionally returns the per-row
+    logsumexp as a differentiable output (the ring-attention merge needs
+    it); its cotangent folds into the existing backward kernels via
+    delta' = delta − dlse."""
 
     @jax.custom_vjp
     def f(q, k, v, bias, seed):
-        o, _ = _flash_fwd(q, k, v, bias, seed, rate, causal, interpret)
-        return o
+        o, lse = _flash_fwd(q, k, v, bias, seed, rate, causal, interpret)
+        return (o, lse) if with_lse else o
 
     def fwd(q, k, v, bias, seed):
         o, lse = _flash_fwd(q, k, v, bias, seed, rate, causal, interpret)
-        return o, (q, k, v, bias, seed, o, lse)
+        return ((o, lse) if with_lse else o), (q, k, v, bias, seed, o, lse)
 
     def bwd(res, g):
         q, k, v, bias, seed, o, lse = res
+        if with_lse:
+            g, dlse = g
+        else:
+            dlse = None
         dq, dk, dv = _flash_bwd(q, k, v, bias, seed, o, lse, g, rate,
-                                causal, interpret)
+                                causal, interpret, dlse=dlse)
         # bias grad is zero by contract (mask bias, stop-gradiented at the
         # kernel wrapper); seed is integer → float0 cotangent
         dbias = jnp.zeros_like(bias) if has_bias else None
@@ -396,7 +409,7 @@ def _reference(q, k, v, bias, causal=False):
 
 
 # backends whose canonical lowering is the TPU Mosaic pipeline
-_TPU_BACKENDS = ("tpu", "axon")
+from . import TPU_BACKENDS as _TPU_BACKENDS
 
 
 def supported(shape_bhsd, k_seq=None, backend=None):
@@ -463,3 +476,37 @@ def flash_attention_bshd(q, k, v, bias=None, dropout_rate=0.0, seed=None,
     fn = _make_flash(float(dropout_rate), bf is not None, bool(causal),
                      interpret)
     return fn(qf, kf, vf, bf, seed).reshape(b, h, s, d)
+
+
+def flash_attention_with_lse(q, k, v, bias=None, interpret=False):
+    """Blockwise attention over ONE K/V block with residuals: returns
+    ``(out, lse)`` where ``lse`` is the per-row logsumexp, both
+    differentiable — the building block ring attention merges across
+    rotated KV shards with the standard online-softmax combine
+    (exp(lse_i − m)·o_i accumulation).  q/k/v: (B, H, Sq, D); bias:
+    broadcastable (B, 1|H, 1|Sq, Sk) additive mask bias (stop-gradiented
+    by contract, same as flash_attention_bshd).  lse: (B, H, Sq) f32."""
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    if not supported((b, h, s, d), k_seq=sk,
+                     backend="tpu" if interpret else None):
+        raise ValueError(
+            f"flash_attention_with_lse: unsupported shape/backend "
+            f"(Sq={s} must tile {BLOCK_Q}, Sk={sk} must tile {BLOCK_K}, "
+            f"D={d} must be 64 or a multiple of 128)")
+    seed = jnp.zeros((1,), jnp.int32)
+    qf = q.reshape(b * h, s, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    bf = None
+    if bias is not None:
+        if bias.shape[2] == 1:
+            bias = jnp.broadcast_to(bias, bias.shape[:2] + (s, sk))
+        if bias.shape[1] == 1:
+            bf = bias.reshape(b, s, sk)
+        else:
+            bf = jnp.broadcast_to(bias, (b, h, s, sk)).reshape(b * h, s, sk)
+        bf = lax.stop_gradient(bf)
+    fn = _make_flash(0.0, bf is not None, False, interpret, with_lse=True)
+    o, lse = fn(qf, kf, vf, bf, seed)
+    return o.reshape(b, h, s, d), lse.reshape(b, h, s)

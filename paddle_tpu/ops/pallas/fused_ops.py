@@ -36,8 +36,8 @@ BLOCK_R = 128          # row-block for [R, D] layouts
 
 
 def _on_tpu() -> bool:
-    from . import effective_backend
-    return effective_backend() not in ("cpu", "gpu")
+    from . import is_tpu_backend
+    return is_tpu_backend()
 
 
 def _row_mask(i, r_total, block_rows):

@@ -84,6 +84,164 @@ class SpecMismatch(Exception):
         self.kind = kind
 
 
+class PallasLowering:
+    """One Pallas kernel route for an op — the per-op custom-kernel
+    lowering channel (``op_spec(name, pallas=[...])``).
+
+    The reference links its fused CUDA kernels unconditionally and picks
+    them in ChooseKernel; here every custom-kernel routing decision is a
+    (flag, backend, shape) gate, so the gates live in ONE statically
+    enumerable table instead of ad-hoc ``flag(...)`` call-sites buried in
+    op impls.  Fields:
+
+    * ``kernel`` — route name (``"flash_attention"``, ``"fused_adam"``,
+      ``"dequant_accumulate"``, ...), the unit the census reports on;
+    * ``flag`` — the flags.py gate; ``attr`` optionally names an op attr
+      that overrides the flag per-op (``use_flash``);
+    * ``match(attrs, axis_sizes)`` — cheap applicability (is this route
+      even in play for this op instance — e.g. the ring route only when
+      ``_seq_axis`` is stamped); non-matching routes are skipped
+      silently, they are not "fallbacks";
+    * ``supported(ins, attrs, axis_sizes)`` → ``(ok, reason)`` — the
+      static capability gate, trace-free: ``ins`` maps slots to lists of
+      objects with ``.shape``/``.dtype`` (VarSig during static analysis,
+      traced jax arrays during lowering — the predicate must accept
+      both); ``axis_sizes`` maps mesh axis → size (None when shapes are
+      already device-local, the trace-time convention);
+    * ``lower(ctx, ins, attrs)`` — the trace-time lowering onto the
+      Pallas kernel, same signature/contract as an op impl;
+    * ``kernels`` — the Pallas kernel function names this route is
+      expected to place in a TPU-lowered module (``kernel_name = ...``
+      on the ``tpu_custom_call``) — the census contract.
+    """
+
+    __slots__ = ("kernel", "flag", "attr", "match", "supported", "lower",
+                 "kernels")
+
+    def __init__(self, kernel: str, flag: Optional[str] = None,
+                 attr: Optional[str] = None,
+                 match: Optional[Callable] = None,
+                 supported: Optional[Callable] = None,
+                 lower: Optional[Callable] = None,
+                 kernels=()):
+        self.kernel = kernel
+        self.flag = flag
+        self.attr = attr
+        self.match = match
+        self.supported = supported
+        self.lower = lower
+        self.kernels = tuple(kernels)
+
+
+def _shape_of(sig):
+    """Static shape tuple of a VarSig OR a traced array (None/-1 dims
+    count as unknown), shared by PallasLowering predicates."""
+    if sig is None:
+        return None
+    shape = getattr(sig, "shape", None)
+    if shape is None:
+        return None
+    try:
+        return tuple(int(s) for s in shape)
+    except (TypeError, ValueError):
+        return None
+
+
+_PALLAS_WARNED: set = set()
+
+
+def pallas_route(op_type: str, ins, attrs, axis_sizes=None, backend=None,
+                 count: bool = True, kernel: Optional[str] = None):
+    """Resolve the Pallas route for one op instance.
+
+    Returns ``(route, reason)`` — ``route`` is the winning
+    :class:`PallasLowering` (call ``route.lower(ctx, ins, attrs)``) or
+    None with ``reason`` naming why every matching route fell back
+    (``flag:...=off`` / ``backend:cpu`` / the shape reason).  With
+    ``count=True`` (the trace-time default) hit/fallback counters land in
+    ``observability.metrics`` labeled by op + kernel + reason, so tests
+    and the census observe EVERY routing decision, not just the first;
+    static callers (analysis.kernel_routing_report) pass ``count=False``.
+    ``kernel`` filters to one named route (op impls that already know
+    which path they are on — e.g. fused_attention's ring branch)."""
+    spec = OP_SPECS.get(op_type)
+    routes = getattr(spec, "pallas", None) if spec is not None else None
+    if not routes:
+        return None, "no-pallas-channel"
+    from . import pallas as _pallas
+    if backend is None:
+        backend = _pallas.effective_backend()
+    reasons = []
+    matched = []
+    for route in routes:
+        if kernel is not None and route.kernel != kernel:
+            continue
+        if route.match is not None and not route.match(attrs, axis_sizes):
+            continue
+        matched.append(route.kernel)
+        enabled = True
+        if route.flag is not None:
+            from ..flags import flag as _flag
+            enabled = _flag(route.flag)
+        if route.attr is not None and attrs.get(route.attr) is not None:
+            enabled = attrs[route.attr]
+        if not enabled:
+            reasons.append(f"flag:{route.flag}=off")
+            continue
+        if backend not in _pallas.TPU_BACKENDS:
+            reasons.append(f"backend:{backend}")
+            continue
+        ok, why = (True, "") if route.supported is None else \
+            route.supported(ins, attrs, axis_sizes)
+        if ok:
+            if count:
+                _pallas_count(op_type, route.kernel, "hit", "supported")
+            return route, "supported"
+        reasons.append(why)
+    reason = "; ".join(reasons) if reasons else "no-matching-route"
+    if count and routes:
+        kname = kernel or (matched[0] if matched else routes[0].kernel)
+        _pallas_count(op_type, kname, "fallback", reason)
+        _pallas_warn(op_type, kname, reason, backend)
+    return None, reason
+
+
+def _pallas_count(op_type: str, kernel: str, outcome: str, reason: str):
+    try:
+        from ..observability import metrics
+        metrics.counter("pallas_routes", op=op_type, kernel=kernel,
+                        outcome=outcome, reason=reason).add()
+    except Exception:        # metrics must never break a trace
+        pass
+
+
+def _pallas_warn(op_type: str, kernel: str, reason: str, backend: str):
+    """Log shape-capability fallbacks once per (op, reason) — flag-off
+    and wrong-backend fallbacks are expected states, not surprises.
+    Reports the EFFECTIVE lowering backend (ops.pallas), not
+    jax.default_backend(): cross-lowering for TPU on a CPU host must
+    name the platform the gates actually saw."""
+    if reason.startswith(("flag:", "backend:")) or \
+            (op_type, reason) in _PALLAS_WARNED:
+        return
+    _PALLAS_WARNED.add((op_type, reason))
+    import logging
+    logging.getLogger(__name__).warning(
+        "%s: pallas kernel %r unavailable on backend %s — falling back "
+        "to the jnp composition (%s)", op_type, kernel, backend, reason)
+
+
+def pallas_table() -> Dict[str, tuple]:
+    """The statically enumerable Pallas tier: op type → its registered
+    route tuple (analysis/census consumers iterate this)."""
+    out = {}
+    for name, spec in OP_SPECS.items():
+        routes = getattr(spec, "pallas", None)
+        if routes:
+            out[name] = tuple(routes)
+    return out
+
+
 class OpSpec:
     """Static metadata for one op type.
 
@@ -113,17 +271,22 @@ class OpSpec:
       when shapes are unknown.  Consumed by the telemetry recorder's
       static MFU numerator
       (observability/flops.py estimate_step_flops).
+    * ``pallas`` — tuple of :class:`PallasLowering` routes, the per-op
+      custom-kernel lowering channel: op impls dispatch through
+      :func:`pallas_route` and the static layer enumerates the table
+      via :func:`pallas_table` / analysis.kernel_routing_report.
     """
 
     __slots__ = ("name", "infer", "collective", "mem_transparent",
-                 "mem_backward_extra", "wire", "flops")
+                 "mem_backward_extra", "wire", "flops", "pallas")
 
     def __init__(self, name: str, infer: Optional[Callable] = None,
                  collective: bool = False,
                  mem_transparent: Optional[bool] = None,
                  mem_backward_extra: Optional[Callable] = None,
                  wire: Optional[Callable] = None,
-                 flops: Optional[Callable] = None):
+                 flops: Optional[Callable] = None,
+                 pallas=None):
         self.name = name
         self.infer = infer
         self.collective = collective
@@ -131,6 +294,7 @@ class OpSpec:
         self.mem_backward_extra = mem_backward_extra
         self.wire = wire
         self.flops = flops
+        self.pallas = tuple(pallas) if pallas else None
 
 
 def op_spec(name: str, infer: Optional[Callable] = None,
@@ -138,13 +302,14 @@ def op_spec(name: str, infer: Optional[Callable] = None,
             mem_transparent: Optional[bool] = None,
             mem_backward_extra: Optional[Callable] = None,
             wire: Optional[Callable] = None,
-            flops: Optional[Callable] = None):
+            flops: Optional[Callable] = None,
+            pallas=None):
     """Register static metadata for op ``name`` (idempotent per name —
     re-registration replaces, so spec modules can be reloaded)."""
     spec = OpSpec(name, infer=infer, collective=collective,
                   mem_transparent=mem_transparent,
                   mem_backward_extra=mem_backward_extra, wire=wire,
-                  flops=flops)
+                  flops=flops, pallas=pallas)
     OP_SPECS[name] = spec
     return spec
 

@@ -923,8 +923,15 @@ class ShardedUpdateOptimizer(Optimizer):
         # quantized grad scatter pads flat payloads so every rank's shard
         # is a whole number of quantization blocks — the param slice must
         # use the same alignment or param/grad shards would cover
-        # different element ranges
-        align = self._quant.block_size if self._quant is not None else 1
+        # different element ranges.  Unquantized shards align to 128 (the
+        # fused flat-shard Adam kernel's lane layout, ops/pallas/fused_ops
+        # adam_update): zero-padding is update-inert (0 grad keeps 0
+        # param/moments) and shard boundaries don't change the math, but
+        # the 1-D state shards become the kernel's ideal shape on TPU.
+        if self._quant is not None:
+            align = self._quant.block_size
+        else:
+            align = 128
         for p, g in params_grads:
             if getattr(p, "dist_attr", None) or \
                     getattr(p, "is_distributed", False):
@@ -942,6 +949,7 @@ class ShardedUpdateOptimizer(Optimizer):
                 scatter_attrs["quant_spec"] = self._quant.to_attr()
             else:
                 scatter_type = "zero_reduce_scatter"
+                scatter_attrs["align"] = align
                 if self._compress:
                     scatter_attrs["compress_dtype"] = self._compress
             block.append_op(

@@ -1,0 +1,467 @@
+"""The per-op Pallas lowering tier (ops/registry.py pallas channel):
+static routing report, hit/fallback metrics counters, interpret-mode
+parity of the grafted kernels (ring-attention-via-flash, flat-shard
+Adam, dequant-accumulate), and the KERNEL_CENSUS_r15.json artifact
+contract produced by tools/verify_lowering.py --census."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bert_tiny_train():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import bert
+    cfg = bert.BertConfig.tiny()
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        feeds, total, mlm, nsp = bert.build_pretrain_network(cfg)
+        fluid.optimizer.Adam(1e-4).minimize(total)
+    return cfg, main_p, startup, total
+
+
+def _feed_arrays(cfg, seq):
+    from paddle_tpu.models import bert
+    data = bert.make_fake_batch(np.random.RandomState(0), cfg,
+                                batch_size=4, seq_len=seq, num_masks=3)
+    return {k: np.asarray(v) for k, v in data.items()}
+
+
+# ---------------------------------------------------------------------------
+# static routing report
+# ---------------------------------------------------------------------------
+
+
+def test_routing_report_flash_hit_at_128_fallback_at_64():
+    from paddle_tpu.framework.analysis import kernel_routing_report
+    cfg, main_p, _, total = _bert_tiny_train()
+    rep = kernel_routing_report(main_p, feed_shapes=_feed_arrays(cfg, 128),
+                                backend="tpu")
+    assert rep["summary"]["flash_attention"]["pallas"] == 2
+    assert rep["summary"]["flash_attention"]["fallback"] == 0
+    assert rep["summary"]["fused_layer_norm"]["pallas"] > 0
+    # BERT-tiny's 128-wide square params tile the fused-Adam layout;
+    # the small bias/scale leaves fall back with the size floor named
+    assert rep["summary"]["fused_adam"]["pallas"] > 0
+    assert rep["summary"]["fused_adam"]["fallback"] > 0
+    rep64 = kernel_routing_report(main_p,
+                                  feed_shapes=_feed_arrays(cfg, 64),
+                                  backend="tpu")
+    fb = [r for r in rep64["rows"] if r["op"] == "fused_attention"]
+    assert fb and all(r["route"] == "fallback" for r in fb)
+    assert all("seq" in r["reason"] for r in fb)
+
+
+def test_routing_report_zero_compiles(monkeypatch):
+    """The report is pure static analysis — no Executor compile, no jax
+    trace may happen."""
+    from paddle_tpu.framework import executor as executor_mod
+    from paddle_tpu.framework.analysis import kernel_routing_report
+
+    def _boom(*a, **kw):
+        raise AssertionError("kernel_routing_report triggered a compile")
+
+    monkeypatch.setattr(executor_mod.Executor, "_compile", _boom)
+    monkeypatch.setattr(jax, "jit",
+                        lambda *a, **kw: _boom())
+    cfg, main_p, _, _ = _bert_tiny_train()
+    rep = kernel_routing_report(main_p, feed_shapes=_feed_arrays(cfg, 128),
+                                backend="tpu")
+    assert rep["rows"]
+
+
+def test_routing_report_cpu_backend_all_fallback():
+    from paddle_tpu.framework.analysis import kernel_routing_report
+    cfg, main_p, _, _ = _bert_tiny_train()
+    rep = kernel_routing_report(main_p, feed_shapes=_feed_arrays(cfg, 128),
+                                backend="cpu")
+    assert all(r["route"] == "fallback" for r in rep["rows"])
+    assert any("backend:cpu" in r["reason"] for r in rep["rows"])
+
+
+def test_routing_report_ring_route_with_sp_mesh():
+    """A fused_attention op stamped with _seq_axis routes to the ring
+    flash kernel when the sp shard tiles, with the sp size taken from
+    the mesh map."""
+    from paddle_tpu.framework.analysis import kernel_routing_report
+    from paddle_tpu.framework.core import Program, program_guard
+
+    main_p = Program()
+    with program_guard(main_p, Program()):
+        b = main_p.global_block()
+        for n, shape in (("q", (2, 512, 128)), ("k", (2, 512, 128)),
+                         ("v", (2, 512, 128))):
+            b.create_var(name=n, shape=shape, dtype="float32",
+                         is_data=True)
+        b.create_var(name="o", shape=(2, 512, 128), dtype="float32")
+        b.append_op(type="fused_attention",
+                    inputs={"Q": ["q"], "K": ["k"], "V": ["v"]},
+                    outputs={"Out": ["o"]},
+                    attrs={"n_head": 2, "_seq_axis": "sp"})
+    rep = kernel_routing_report(main_p, backend="tpu",
+                                mesh_axes={"sp": 4})
+    (row,) = rep["rows"]
+    assert row["kernel"] == "ring_flash_attention"
+    assert row["route"] == "pallas"          # 512/4 = 128 tiles
+    rep8 = kernel_routing_report(main_p, backend="tpu",
+                                 mesh_axes={"sp": 8})
+    (row8,) = rep8["rows"]
+    assert row8["route"] == "fallback"       # 512/8 = 64 does not
+    assert "seq" in row8["reason"]
+
+
+# ---------------------------------------------------------------------------
+# hit/fallback counters (the _warned_fallback replacement)
+# ---------------------------------------------------------------------------
+
+
+def _attn_sigs(s, hidden=128):
+    from paddle_tpu.ops.registry import VarSig
+    sig = VarSig((2, s, hidden), "float32")
+    return {"Q": [sig], "K": [sig], "V": [sig]}
+
+
+def test_pallas_route_counters_every_fallback_counted():
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.ops.pallas import lowering_target
+    from paddle_tpu.ops.registry import pallas_route
+
+    metrics.reset_metrics()
+    attrs = {"n_head": 2}
+    with lowering_target("tpu"):
+        for _ in range(3):
+            route, reason = pallas_route("fused_attention",
+                                         _attn_sigs(100), attrs)
+            assert route is None and "seq" in reason
+        route, reason = pallas_route("fused_attention", _attn_sigs(128),
+                                     attrs)
+        assert route is not None and route.kernel == "flash_attention"
+    c_fb = metrics.counter("pallas_routes", op="fused_attention",
+                           kernel="flash_attention", outcome="fallback",
+                           reason="seq:100x100%128")
+    assert c_fb.get() == 3            # EVERY fallback counted, not one
+    c_hit = metrics.counter("pallas_routes", op="fused_attention",
+                            kernel="flash_attention", outcome="hit",
+                            reason="supported")
+    assert c_hit.get() == 1
+
+
+def test_pallas_route_flag_and_backend_reasons():
+    from paddle_tpu import flags
+    from paddle_tpu.ops.pallas import lowering_target
+    from paddle_tpu.ops.registry import pallas_route
+
+    route, reason = pallas_route("fused_attention", _attn_sigs(128),
+                                 {"n_head": 2}, backend="cpu")
+    assert route is None and "backend:cpu" in reason
+    flags.set_flags({"use_flash_attention": False})
+    try:
+        with lowering_target("tpu"):
+            route, reason = pallas_route("fused_attention",
+                                         _attn_sigs(128), {"n_head": 2})
+        assert route is None and "flag:use_flash_attention=off" in reason
+    finally:
+        flags.set_flags({"use_flash_attention": True})
+
+
+def test_fallback_warning_names_effective_backend(caplog):
+    """Cross-lowering for TPU on this CPU host must log the LOWERING
+    platform (tpu), not jax.default_backend() (cpu) — the old
+    attention_ops warn-once got this wrong."""
+    import logging
+    from paddle_tpu.ops import registry
+    from paddle_tpu.ops.pallas import lowering_target
+
+    registry._PALLAS_WARNED.clear()
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.ops.registry"):
+        with lowering_target("tpu"):
+            registry.pallas_route("fused_attention", _attn_sigs(72),
+                                  {"n_head": 2})
+    msgs = [r.getMessage() for r in caplog.records
+            if "pallas kernel" in r.getMessage()]
+    assert msgs and "backend tpu" in msgs[0]
+    assert "cpu" not in msgs[0]
+
+
+def test_pallas_table_enumerates_the_tier():
+    from paddle_tpu.ops.registry import pallas_table
+    table = pallas_table()
+    for op in ("fused_attention", "adam", "adamw", "layer_norm",
+               "fused_add_layernorm", "fused_elemwise_activation",
+               "multihead_matmul", "c_quant_allreduce_sum",
+               "c_fused_quant_allreduce_sum", "quant_reduce_scatter"):
+        assert op in table, op
+    kernels = {r.kernel for routes in table.values() for r in routes}
+    assert {"flash_attention", "ring_flash_attention", "fused_adam",
+            "dequant_accumulate"} <= kernels
+
+
+# ---------------------------------------------------------------------------
+# interpret-mode parity: the three grafted hot paths
+# ---------------------------------------------------------------------------
+
+
+def _sp_mesh(n):
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:n]), ("sp",))
+
+
+def test_ring_attention_flash_matches_einsum_composition():
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.framework.jax_compat import shard_map
+    from paddle_tpu.parallel.ring_attention import ring_attention
+
+    mesh = _sp_mesh(4)
+    B, H, S, D = 1, 2, 512, 64
+    rng = np.random.RandomState(0)
+    q, k, v = (rng.randn(B, H, S, D).astype(np.float32) for _ in range(3))
+    mask = (rng.rand(B, S) > 0.15).astype(np.float32)
+    mask[:, 0] = 1.0
+
+    def make(use_flash, causal):
+        def g(q, k, v, m):
+            return ring_attention(q, k, v, "sp", causal=causal, kv_mask=m,
+                                  use_flash=use_flash,
+                                  interpret=use_flash)
+        return jax.jit(shard_map(
+            g, mesh=mesh,
+            in_specs=(P(None, None, "sp"),) * 3 + (P(None, "sp"),),
+            out_specs=P(None, None, "sp"), check_vma=False))
+
+    for causal in (False, True):
+        ref = make(False, causal)(q, k, v, mask)
+        out = make(True, causal)(q, k, v, mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5,
+                                   err_msg=f"causal={causal}")
+
+
+def test_ring_attention_flash_grads_match():
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.framework.jax_compat import shard_map
+    from paddle_tpu.parallel.ring_attention import ring_attention
+
+    mesh = _sp_mesh(4)
+    B, H, S, D = 1, 1, 512, 64
+    rng = np.random.RandomState(1)
+    q, k, v = (rng.randn(B, H, S, D).astype(np.float32) for _ in range(3))
+    mask = np.ones((B, S), np.float32)
+
+    def loss(use_flash):
+        def g(q, k, v, m):
+            return ring_attention(q, k, v, "sp", causal=True, kv_mask=m,
+                                  use_flash=use_flash,
+                                  interpret=use_flash)
+        fn = jax.jit(shard_map(
+            g, mesh=mesh,
+            in_specs=(P(None, None, "sp"),) * 3 + (P(None, "sp"),),
+            out_specs=P(None, None, "sp"), check_vma=False))
+        return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v, mask)))
+
+    gr = jax.grad(loss(False), argnums=(0, 1, 2))(q, k, v)
+    gk = jax.grad(loss(True), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gr, gk):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=2e-4, err_msg=f"d{name}")
+
+
+def test_flash_with_lse_grads_include_lse_cotangent():
+    """The (out, lse) variant must propagate a NON-ZERO lse cotangent
+    correctly (the ring merge differentiates through lse) — checked
+    against jax.grad of the jnp logsumexp composition."""
+    from paddle_tpu.ops.pallas.flash_attention import \
+        flash_attention_with_lse
+
+    B, H, S, D = 1, 1, 128, 64
+    rng = np.random.RandomState(2)
+    q, k, v = (jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+               for _ in range(3))
+
+    def ker(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, interpret=True)
+        return jnp.sum(jnp.sin(o)) + jnp.sum(jnp.cos(lse))
+
+    def ref(q, k, v):
+        s = jnp.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(D)
+        o = jnp.einsum("bhst,bhtd->bhsd", jax.nn.softmax(s, axis=-1), v)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        return jnp.sum(jnp.sin(o)) + jnp.sum(jnp.cos(lse))
+
+    gk = jax.grad(ker, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gr, gk):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=5e-4, err_msg=f"d{name}")
+
+
+def test_flat_shard_adam_matches_per_leaf_chain():
+    """The fused kernel on a ZeRO-style flat 128-aligned shard vs the
+    per-leaf elementwise chain it replaces."""
+    from paddle_tpu.ops.pallas.fused_ops import adam_update
+
+    rng = np.random.RandomState(3)
+    n = 5 * 1024 + 384            # 128-aligned, not a power of two
+    p = rng.randn(n).astype(np.float32)
+    g = rng.randn(n).astype(np.float32)
+    m = rng.randn(n).astype(np.float32) * 0.1
+    v = np.abs(rng.randn(n)).astype(np.float32) * 0.01
+    beta1, beta2, eps, lr_t = 0.9, 0.999, 1e-8, 0.01
+    po, mo, vo = adam_update(jnp.asarray(p), jnp.asarray(g),
+                             jnp.asarray(m), jnp.asarray(v), lr_t,
+                             beta1=beta1, beta2=beta2, eps=eps,
+                             interpret=True)
+    m_ref = beta1 * m + (1 - beta1) * g
+    v_ref = beta2 * v + (1 - beta2) * g * g
+    p_ref = p - lr_t * m_ref / (np.sqrt(v_ref) + eps)
+    np.testing.assert_allclose(np.asarray(po), p_ref, rtol=1e-4,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(mo), m_ref, rtol=1e-4,
+                               atol=1e-7)
+    np.testing.assert_allclose(np.asarray(vo), v_ref, rtol=1e-4,
+                               atol=1e-7)
+
+
+def test_sharded_update_pads_flat_shards_to_128():
+    """ZeRO-1 flat shards are 128-aligned (the fused-Adam kernel's lane
+    layout) and the grad scatter carries the matching align attr."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.core import Program, program_guard
+    from paddle_tpu.optimizer import ShardedUpdateOptimizer
+
+    main_p, startup = Program(), Program()
+    with program_guard(main_p, startup):
+        x = fluid.layers.data("x", shape=[100], dtype="float32")
+        y = fluid.layers.fc(x, size=77)      # 100*77 + 77: neither tiles
+        loss = fluid.layers.reduce_mean(y)
+        ShardedUpdateOptimizer(fluid.optimizer.Adam(1e-3),
+                               nranks=8).minimize(loss)
+    scatters = [op for op in main_p.global_block().ops
+                if op.type == "zero_reduce_scatter"]
+    assert scatters
+    for op in scatters:
+        assert op.attrs.get("align") == 128
+        out = main_p.global_block()._find_var_recursive(
+            op.outputs["Out"][0])
+        assert out.shape[0] % (8 * 128) == 0
+
+
+def test_dequant_accumulate_parity_int8_int4():
+    from paddle_tpu.ops.pallas import quant_kernels as qk
+    from paddle_tpu.ops.quantize_wire import (CompressionSpec,
+                                              dequantize_blockwise,
+                                              quantize_blockwise)
+
+    rng = np.random.RandomState(4)
+    for dtype in ("int8", "int4"):
+        spec = CompressionSpec(dtype=dtype, block_size=256)
+        n, sb = 8, 12
+        numel = sb * spec.block_size
+        qs, ss = zip(*(quantize_blockwise(
+            jnp.asarray(rng.randn(numel).astype(np.float32)), spec)
+            for _ in range(n)))
+        payload, scales = jnp.concatenate(qs, 0), jnp.concatenate(ss, 0)
+        ref = sum(dequantize_blockwise(q, s, spec)
+                  for q, s in zip(qs, ss))
+        got = qk.dequant_accumulate(payload, scales, spec, n,
+                                    interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-5, err_msg=dtype)
+
+
+def test_dequant_accumulate_requant_matches_jnp_requantize():
+    from paddle_tpu.ops.pallas import quant_kernels as qk
+    from paddle_tpu.ops.quantize_wire import (CompressionSpec,
+                                              dequantize_blockwise,
+                                              quantize_blockwise)
+
+    rng = np.random.RandomState(5)
+    spec = CompressionSpec(dtype="int8", block_size=256)
+    n, sb = 4, 16
+    numel = sb * spec.block_size
+    qs, ss = zip(*(quantize_blockwise(
+        jnp.asarray(rng.randn(numel).astype(np.float32)), spec)
+        for _ in range(n)))
+    payload, scales = jnp.concatenate(qs, 0), jnp.concatenate(ss, 0)
+    ref = sum(dequantize_blockwise(q, s, spec) for q, s in zip(qs, ss))
+    q2r, s2r = quantize_blockwise(ref, spec)
+    q2k, s2k = qk.dequant_accumulate_requant(payload, scales, spec, n,
+                                             interpret=True)
+    # round-to-nearest on near-identical f32 sums: payloads bit-match
+    assert bool(jnp.all(q2k == q2r))
+    np.testing.assert_allclose(np.asarray(s2k), np.asarray(s2r),
+                               rtol=1e-6)
+
+
+def test_dequant_kernel_gate_mirrors_kernel():
+    from paddle_tpu.ops.pallas import quant_kernels as qk
+    from paddle_tpu.ops.quantize_wire import CompressionSpec
+
+    i8 = CompressionSpec(dtype="int8", block_size=256)
+    assert qk.supported(8, 16, i8, backend="tpu") == (True, "")
+    ok, why = qk.supported(8, 16, i8, backend="cpu")
+    assert not ok and "backend" in why
+    ok, why = qk.supported(1, 16, i8, backend="tpu")
+    assert not ok and "peers" in why
+    odd = CompressionSpec(dtype="int8", block_size=192)
+    ok, why = qk.supported(8, 16, odd, backend="tpu")
+    assert not ok and "block-size" in why
+    bf = CompressionSpec(dtype="bfloat16")
+    ok, why = qk.supported(8, 16, bf, backend="tpu")
+    assert not ok and "wire-dtype" in why
+
+
+# ---------------------------------------------------------------------------
+# KERNEL_CENSUS_r15.json artifact contract
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_census_artifact_contract():
+    path = os.path.join(REPO, "KERNEL_CENSUS_r15.json")
+    assert os.path.exists(path), \
+        "run: python tools/verify_lowering.py --census"
+    with open(path) as f:
+        art = json.load(f)
+    assert art["artifact"] == "KERNEL_CENSUS"
+    assert art["revision"] == "r15"
+    assert art["lowered_for"] == "tpu"
+    assert art["ok"] is True
+    secs = art["sections"]
+    # every grafted kernel is present as a custom call in the TPU-
+    # cross-lowered module of its hot path
+    assert "_fwd_kernel" in secs["single_device_bert_tiny_seq128"]["kernels"]
+    assert "_adam_kernel" in secs["single_device_bert_tiny_seq128"]["kernels"]
+    assert "_fwd_kernel" in secs["ring_attention_sp4"]["kernels"]
+    for k in ("_bwd_dq_kernel", "_bwd_dkv_kernel"):
+        assert k in secs["ring_attention_sp4_grad"]["kernels"]
+    assert "_adam_kernel" in secs["zero1_dp8_flat_shard_adam"]["kernels"]
+    assert "_dq_acc_requant_kernel" in secs["quant_int8_dp8"]["kernels"]
+    assert "_dq_acc_kernel" in secs["quant_int4_dp8"]["kernels"]
+    for s in secs.values():
+        assert s["complete"], s["leg"]
+        assert s["tpu_custom_call_sites"] > 0
+    # parity recorded and within bounds; quantized legs carry PR 6's
+    # end-to-end wire-tier contract
+    par = art["parity"]
+    for key in ("ring_flash_vs_einsum_fwd", "ring_flash_vs_einsum_grad",
+                "flat_shard_adam", "dequant_acc_int8", "dequant_acc_int4"):
+        assert par[key]["measured"] <= par[key]["bound"], key
+    assert par["ring_flash_vs_einsum_fwd"]["bound"] <= 1e-5
+    assert secs["quant_int8_dp8"]["wire_tier_parity_bound"] == 5e-2
+    assert secs["quant_int4_dp8"]["wire_tier_parity_bound"] == 2.5e-1
+    # the embedded static routing report agrees with the module census
+    rep = secs["single_device_bert_tiny_seq128"]["routing_report"]
+    assert rep["summary"]["flash_attention"]["pallas"] > 0
+    assert rep["summary"]["fused_adam"]["pallas"] > 0
+
+
+def test_census_selftest_wired_into_preflight():
+    with open(os.path.join(REPO, "tools", "preflight.sh")) as f:
+        sh = f.read()
+    assert "verify_lowering.py --selftest" in sh

@@ -112,12 +112,55 @@ def run(steps, tiny=False, out_path=ARTIFACT):
     return artifact
 
 
+def cross_lower_flag_ladder():
+    """Cross-lower the BERT-tiny seq-128 step for TPU per flag config
+    (ops.pallas.lowering_target) and census the Pallas kernel names in
+    each module — the A/B flags must actually ADD/REMOVE tpu_custom_call
+    kernels, not just toggle a python branch.  Returns per-config kernel
+    sets (also recorded in the artifact)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.core import reset_default_programs
+    from paddle_tpu.framework.executor import global_scope
+    from paddle_tpu.framework.export import lower_train_step_for_tpu
+    from paddle_tpu.models import bert
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from verify_lowering import kernel_counts
+
+    rows = {}
+    for name, flash, fused in CONFIGS:
+        reset_default_programs()
+        global_scope().drop_all()
+        fluid.set_flags({"FLAGS_use_flash_attention": flash,
+                         "FLAGS_use_pallas_fused": fused})
+        cfg = bert.BertConfig.tiny()
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            feeds, total, mlm, nsp = bert.build_pretrain_network(cfg)
+            fluid.optimizer.Adam(1e-4).minimize(total)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            data = bert.make_fake_batch(np.random.RandomState(0), cfg,
+                                        batch_size=4, seq_len=128,
+                                        num_masks=3)
+            exported = lower_train_step_for_tpu(main_prog, data, [total],
+                                                scope=scope)
+        rows[name] = sorted(kernel_counts(exported.mlir_module()))
+    fluid.set_flags({"FLAGS_use_flash_attention": True,
+                     "FLAGS_use_pallas_fused": True})
+    return rows
+
+
 def selftest():
     """Preflight gate (CPU-safe): every Pallas flag configuration must
     train BERT-tiny to a finite loss through the interpret/jnp fallback
-    paths, and the artifact must carry one well-formed row per config."""
+    paths, the artifact must carry one well-formed row per config, AND
+    the TPU cross-lowering of each config must prove the flags gate the
+    kernels in/out of the compiled module."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    art = run(steps=2, tiny=True, out_path=ARTIFACT)
+    art = run(steps=2, tiny=True, out_path=None)
     ok = len(art["configs"]) == len(CONFIGS) and all(
         np.isfinite(r["final_loss"]) and r["ms_per_step"] > 0
         for r in art["configs"])
@@ -126,8 +169,25 @@ def selftest():
     # loosely (flash/fused run different numerics, so not bitwise)
     spread = max(losses) - min(losses)
     ok = ok and spread < 1e-2
+
+    ladder = cross_lower_flag_ladder()
+    base = set(ladder["baseline (no pallas)"])
+    flash = set(ladder["+flash_attention"])
+    fused = set(ladder["+fused_ln_adam"])
+    both = set(ladder["both (bench default)"])
+    ok = ok and not base                     # flags off → NO pallas calls
+    ok = ok and {"_fwd_kernel", "_bwd_dq_kernel",
+                 "_bwd_dkv_kernel"} <= flash
+    ok = ok and {"_ln_fwd_kernel", "_ln_bwd_kernel",
+                 "_adam_kernel"} <= fused
+    ok = ok and (flash | fused) <= both
+    art["cross_lowered_kernels"] = ladder
+    with open(ARTIFACT, "w") as f:
+        json.dump(art, f, indent=1)
+    print(f"wrote {ARTIFACT}")
     print(f"kernel_ab selftest {'OK' if ok else 'FAILED'} "
-          f"(loss spread {spread:.2e})")
+          f"(loss spread {spread:.2e}; cross-lowered kernel ladder "
+          f"{ {k: len(v) for k, v in ladder.items()} })")
     return 0 if ok else 1
 
 

@@ -25,6 +25,11 @@ echo "== preflight: kernel A/B probe (pallas flag ladder: flash attention"
 echo "   + fused LN/Adam, CPU-safe interpret-mode leg, JSON artifact) =="
 python tools/kernel_ab.py --selftest
 
+echo "== preflight: pallas kernel census (TPU cross-lowering: flash attn"
+echo "   incl. ring inner step, flat-shard Adam, dequant-accumulate all"
+echo "   present as tpu_custom_calls; interpret-mode parity bounds) =="
+python tools/verify_lowering.py --selftest
+
 echo "== preflight: auto-shard plan probe (dp8 BERT-tiny tp2: >=6 configs"
 echo "   priced, winner min-EXPOSED-comm among budget-fitting, ties to"
 echo "   fewer wire bytes, 0 compiles) =="

@@ -30,8 +30,13 @@ Options:
                      fetch-retention / grad-accum-doubling) and print the
                      static per-device peak-HBM estimate with the top
                      live tensors at the peak point
+  --kernels          print the Pallas kernel-routing report (which ops
+                     WILL lower to a custom kernel for TPU at the
+                     program's static shapes, and why the rest fall
+                     back) — analysis.kernel_routing_report, 0 compiles
   --json             machine-readable report on stdout (diagnostics,
-                     unspecced-op census, memory estimate) for CI
+                     unspecced-op census, memory estimate, kernel
+                     routing) for CI
   --strict           exit non-zero on warnings too, AND whenever the
                      unspecced-op census is non-empty — op_spec coverage
                      can never silently regress under a --strict CI gate
@@ -75,8 +80,8 @@ def load_program(path: str):
 
 
 def lint(program, startup=None, feed_names=(), fetch_names=(),
-         strict=False, inference=False, memory=False, as_json=False,
-         out=None):
+         strict=False, inference=False, memory=False, kernels=False,
+         as_json=False, out=None):
     out = out if out is not None else sys.stdout
     from paddle_tpu.framework.analysis import (verify_inference,
                                                verify_program)
@@ -97,6 +102,10 @@ def lint(program, startup=None, feed_names=(), fetch_names=(),
                                                           lint_memory)
         lint_memory(program, fetch_names=fetch_names, result=result)
         estimate = analyze_memory(program, fetch_names=fetch_names)
+    routing = None
+    if kernels:
+        from paddle_tpu.framework.analysis import kernel_routing_report
+        routing = kernel_routing_report(program, fetch_names=fetch_names)
     if as_json:
         payload = {
             "errors": len(result.errors()),
@@ -111,11 +120,23 @@ def lint(program, startup=None, feed_names=(), fetch_names=(),
         }
         if estimate is not None:
             payload["memory"] = estimate.as_dict()
+        if routing is not None:
+            payload["kernel_routing"] = routing
         print(json.dumps(payload, indent=1), file=out)
     else:
         print(result.report(), file=out)
         if estimate is not None:
             print(estimate.report(), file=out)
+        if routing is not None:
+            print(f"pallas kernel routing (backend={routing['backend']}, "
+                  "0 compiles):", file=out)
+            for kernel, s in sorted(routing["summary"].items()):
+                print(f"  {kernel}: {s['pallas']} pallas / "
+                      f"{s['fallback']} fallback", file=out)
+            for r in routing["rows"]:
+                if r["route"] == "fallback":
+                    print(f"    op[{r['index']}] {r['op']} -> fallback "
+                          f"({r['reason']})", file=out)
     if result.errors():
         return 1
     if strict and (result.warnings() or result.unspecced_ops):
@@ -259,6 +280,29 @@ def selftest(memory=False) -> int:
               f"(expected once, on the hook-less bucket)")
         return 1
 
+    # kernel-routing report (the Pallas tier, statically): the training
+    # program must yield a non-empty report whose fused-Adam summary has
+    # hits (the 128-wide BERT-tiny params tile), every row carries a
+    # route + reason, and the --kernels --json payload embeds it
+    from paddle_tpu.framework.analysis import kernel_routing_report
+    krep = kernel_routing_report(main, fetch_names=[total.name])
+    if not krep["rows"] or "fused_adam" not in krep["summary"] or \
+            krep["summary"]["fused_adam"]["pallas"] < 1:
+        print("proglint selftest: kernel-routing report empty or missing "
+              "fused_adam hits: " + json.dumps(krep["summary"]))
+        return 1
+    if any(r["route"] not in ("pallas", "fallback") or not r["reason"]
+           for r in krep["rows"]):
+        print("proglint selftest: kernel-routing rows malformed")
+        return 1
+    sink = _io.StringIO()
+    rc = lint(main, fetch_names=[total.name], kernels=True, as_json=True,
+              out=sink)
+    if rc or '"kernel_routing"' not in sink.getvalue():
+        print("proglint selftest: --kernels --json report missing the "
+              "routing section")
+        return 1
+
     if memory:
         from paddle_tpu.framework.errors import InvalidArgumentError
         from paddle_tpu.framework.memory_analysis import (analyze_memory,
@@ -304,6 +348,7 @@ def main(argv=None) -> int:
     ap.add_argument("--startup")
     ap.add_argument("--inference", action="store_true")
     ap.add_argument("--memory", action="store_true")
+    ap.add_argument("--kernels", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--strict", action="store_true")
     ap.add_argument("--selftest", action="store_true")
@@ -318,7 +363,7 @@ def main(argv=None) -> int:
     return lint(program, startup=startup, feed_names=args.feed,
                 fetch_names=args.fetch, strict=args.strict,
                 inference=args.inference, memory=args.memory,
-                as_json=args.as_json)
+                kernels=args.kernels, as_json=args.as_json)
 
 
 if __name__ == "__main__":

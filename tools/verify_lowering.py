@@ -1,20 +1,86 @@
-"""Tunnel-independent perf verification artifact (VERDICT r4 ask #1).
+"""Tunnel-independent perf verification artifacts (VERDICT r4 ask #1 +
+the Pallas-tier kernel census).
 
-Cross-lowers the EXACT bench.py configuration (BERT-base 12-layer, batch
-96, seq 128, pure-bf16 Adam) for platforms=("tpu",) on this CPU host and
-reports what is provably inside the compiled TPU program:
+Two modes:
 
-  * every Pallas kernel custom_call, by kernel_name, with counts
-  * state-buffer donation coverage
-  * module size / executable count
+* **default** — cross-lower the EXACT bench.py configuration (BERT-base
+  12-layer, batch 96, seq 128, pure-bf16 Adam) for platforms=("tpu",)
+  on this CPU host and report what is provably inside the compiled TPU
+  program (Pallas kernel custom_calls by kernel_name, donation
+  coverage, GEMM operand dtypes).
 
-Usage: PYTHONPATH=/root/repo python tools/verify_lowering.py [out.txt]
+* **--census / --selftest** — the per-op Pallas lowering tier proven
+  end-to-end with NO TPU: every grafted hot path is cross-lowered for
+  TPU under ``ops.pallas.lowering_target("tpu")`` and its kernels are
+  asserted present as ``tpu_custom_call`` sites in the StableHLO module
+  (a kernel Mosaic cannot compile fails the lowering, so this is a real
+  gate, not a string match):
+
+    - single-device BERT-tiny train step at seq 128 → flash attention
+      fwd+bwd, fused LayerNorm fwd+bwd, fused Adam;
+    - sp4 ring attention fwd+grad → the blockwise flash kernels inside
+      the rotated-KV scan (the einsum inner step replaced);
+    - dp8 BERT-tiny ZeRO-1 sharded update → fused Adam over the flat
+      1/n state shards;
+    - dp8 BERT-tiny int8/int4 bucketed quantized grad sync → the fused
+      dequant-upcast-accumulate(-requantize) receive stage;
+
+  plus interpret-mode (CPU ``pallas_call(interpret=True)``) numerical
+  parity for each grafted kernel vs its jnp composition, and the STATIC
+  per-op routing report (analysis.kernel_routing_report, 0 compiles).
+  Everything lands in ``KERNEL_CENSUS_r15.json`` whose contract tier-1
+  asserts (tests/test_pallas_tier.py); ``--selftest`` additionally
+  fails loudly on any missing kernel or out-of-bound parity — the
+  preflight gate.
+
+Usage:
+    PYTHONPATH=/root/repo python tools/verify_lowering.py [out.txt]
+    PYTHONPATH=/root/repo python tools/verify_lowering.py --census \
+        [--json KERNEL_CENSUS_r15.json]
+    PYTHONPATH=/root/repo python tools/verify_lowering.py --selftest
 """
 
+import json
+import os
 import re
 import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ARTIFACT = "KERNEL_CENSUS_r15.json"
+
+#: interpret-mode parity bounds per grafted kernel (max abs err vs the
+#: jnp composition at f32); the quantized-collective rows additionally
+#: carry PR 6's measured END-TO-END wire-tier bounds so the kernel-level
+#: numbers always travel with the training-parity contract they serve
+PARITY_BOUNDS = {
+    "ring_flash_vs_einsum_fwd": 1e-5,
+    "ring_flash_vs_einsum_grad": 2e-4,
+    "flat_shard_adam": 1e-5,
+    "dequant_acc_int8": 1e-5,
+    "dequant_acc_int4": 1e-5,
+    "dequant_acc_requant_int8": 2e-6,   # vs jnp requantize, dequantized
+}
+WIRE_TIER_BOUNDS = {"int8": 5e-2, "int4": 2.5e-1}   # PR 6 contract
+
+
+def _env8():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def kernel_counts(txt):
+    """tpu_custom_call kernel_name census of one MLIR module."""
+    kernels = {}
+    for n in re.findall(r'kernel_name = "(\w+)"', txt):
+        kernels[n] = kernels.get(n, 0) + 1
+    return kernels
 
 
 def main():
@@ -41,9 +107,7 @@ def main():
                                             scope=scope)
 
     txt = exported.mlir_module()
-    kernels = {}
-    for n in re.findall(r'kernel_name = "(\w+)"', txt):
-        kernels[n] = kernels.get(n, 0) + 1
+    kernels = kernel_counts(txt)
     gemm_pairs = {}
     for line in txt.splitlines():
         if "stablehlo.dot_general" not in line:
@@ -79,10 +143,331 @@ def main():
     lines.append(f"donation: {'OK' if donated >= 50 else 'INSUFFICIENT'}")
     out = "\n".join(lines)
     print(out)
-    if len(sys.argv) > 1:
-        with open(sys.argv[1], "w") as f:
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    if args:
+        with open(args[0], "w") as f:
             f.write(out + "\n")
 
 
+# ---------------------------------------------------------------------------
+# kernel census (--census / --selftest)
+# ---------------------------------------------------------------------------
+
+
+def _section(name, txt, required):
+    kernels = kernel_counts(txt)
+    missing = sorted(set(required) - set(kernels))
+    return {"leg": name,
+            "tpu_custom_call_sites": txt.count("tpu_custom_call"),
+            "kernels": kernels,
+            "required": sorted(required),
+            "missing": missing,
+            "complete": not missing}
+
+
+def census_single_device():
+    """BERT-tiny seq-128 train step, single device: the flash attention
+    fwd+bwd, fused LN fwd+bwd and fused Adam kernels all engage."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.core import reset_default_programs
+    from paddle_tpu.framework.executor import global_scope
+    from paddle_tpu.framework.export import lower_train_step_for_tpu
+    from paddle_tpu.models import bert
+
+    reset_default_programs()
+    global_scope().drop_all()
+    cfg = bert.BertConfig.tiny()
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        feeds, total, mlm, nsp = bert.build_pretrain_network(cfg)
+        fluid.optimizer.Adam(1e-4).minimize(total)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        data = bert.make_fake_batch(np.random.RandomState(0), cfg,
+                                    batch_size=4, seq_len=128, num_masks=3)
+        exported = lower_train_step_for_tpu(main_p, data, [total],
+                                            scope=scope)
+    txt = exported.mlir_module()
+    sec = _section("single_device_bert_tiny_seq128", txt,
+                   ("_fwd_kernel", "_bwd_dq_kernel", "_bwd_dkv_kernel",
+                    "_ln_fwd_kernel", "_ln_bwd_kernel", "_adam_kernel"))
+    # the static report must agree with what the module proves
+    from paddle_tpu.framework.analysis import kernel_routing_report
+    sec["routing_report"] = kernel_routing_report(
+        main_p, feed_shapes={k: np.asarray(v) for k, v in data.items()},
+        backend="tpu")
+    return sec
+
+
+def _ring_fns(mesh, causal=True):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.framework.jax_compat import shard_map
+    from paddle_tpu.parallel.ring_attention import ring_attention
+
+    def make(use_flash, interpret):
+        def g(q, k, v, m):
+            return ring_attention(q, k, v, "sp", causal=causal, kv_mask=m,
+                                  use_flash=use_flash, interpret=interpret)
+        return jax.jit(shard_map(
+            g, mesh=mesh,
+            in_specs=(P(None, None, "sp"),) * 3 + (P(None, "sp"),),
+            out_specs=P(None, None, "sp"), check_vma=False))
+
+    def grad_of(fn):
+        return jax.jit(jax.grad(
+            lambda q, k, v, m: jnp.sum(jnp.sin(fn(q, k, v, m))),
+            argnums=(0, 1, 2)))
+    return make, grad_of
+
+
+def census_ring_sp4():
+    """sp4 ring attention (s_loc 128, d 64): the inner step lowers to
+    the blockwise flash kernel on each rotated KV shard, fwd AND grad —
+    cross-lowered for TPU, plus interpret-mode parity vs the einsum
+    composition on CPU."""
+    import jax
+    from jax import export as jexp
+    from jax.sharding import Mesh
+
+    from paddle_tpu.ops.pallas import lowering_target
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("sp",))
+    B, H, S, D = 1, 2, 512, 64
+    rng = np.random.RandomState(0)
+    q, k, v = (rng.randn(B, H, S, D).astype(np.float32) for _ in range(3))
+    mask = (rng.rand(B, S) > 0.15).astype(np.float32)
+    mask[:, 0] = 1.0          # causal rows keep >= 1 visible key
+    make, grad_of = _ring_fns(mesh)
+
+    with lowering_target("tpu"):
+        fwd_txt = jexp.export(make(True, False), platforms=("tpu",))(
+            q, k, v, mask).mlir_module()
+        grad_txt = jexp.export(grad_of(make(True, False)),
+                               platforms=("tpu",))(
+            q, k, v, mask).mlir_module()
+    sec = _section("ring_attention_sp4", fwd_txt, ("_fwd_kernel",))
+    gsec = _section("ring_attention_sp4_grad", grad_txt,
+                    ("_fwd_kernel", "_bwd_dq_kernel", "_bwd_dkv_kernel"))
+
+    # interpret-mode parity vs the einsum inner step (CPU, no TPU)
+    import jax.numpy as jnp
+    ref = make(False, False)(q, k, v, mask)
+    out = make(True, True)(q, k, v, mask)
+    fwd_err = float(jnp.max(jnp.abs(out - ref)))
+    gr = grad_of(make(False, False))(q, k, v, mask)
+    gk = grad_of(make(True, True))(q, k, v, mask)
+    grad_err = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(gr, gk))
+    parity = {
+        "ring_flash_vs_einsum_fwd": {
+            "measured": fwd_err,
+            "bound": PARITY_BOUNDS["ring_flash_vs_einsum_fwd"]},
+        "ring_flash_vs_einsum_grad": {
+            "measured": grad_err,
+            "bound": PARITY_BOUNDS["ring_flash_vs_einsum_grad"]},
+    }
+    return sec, gsec, parity
+
+
+def _dp8_step_module(quant_mode=None, sharded_update=False):
+    """Build the dp8 BERT-tiny bucketed train step (optionally ZeRO-1
+    sharded update / int8-int4 wire tier) and cross-lower it for TPU;
+    returns the MLIR text."""
+    import jax
+    from jax import export as jexp
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.compiler import BuildStrategy, make_mesh
+    from paddle_tpu.framework.core import reset_default_programs
+    from paddle_tpu.framework.executor import global_scope
+    from paddle_tpu.models import bert
+    from paddle_tpu.ops.pallas import lowering_target
+
+    reset_default_programs()
+    global_scope().drop_all()
+    cfg = bert.BertConfig.tiny()
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        feeds, total, mlm, nsp = bert.build_pretrain_network(cfg)
+        if sharded_update:
+            from paddle_tpu.optimizer import ShardedUpdateOptimizer
+            ShardedUpdateOptimizer(fluid.optimizer.Adam(1e-4),
+                                   nranks=8).minimize(total)
+        else:
+            fluid.optimizer.Adam(1e-4).minimize(total)
+    mesh = make_mesh(8, "dp")
+    bs = BuildStrategy()
+    bs.fuse_all_reduce_ops = True
+    if quant_mode:
+        bs.allreduce_quant_spec = {"dtype": quant_mode, "block_size": 256}
+    fluid.CompiledProgram(main_p).with_data_parallel(
+        loss_name=total.name, mesh=mesh, build_strategy=bs)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        data = bert.make_fake_batch(np.random.RandomState(0), cfg,
+                                    batch_size=8, seq_len=64, num_masks=3)
+        feed = {k: np.asarray(v) for k, v in data.items()}
+        step = exe._compile(main_p, feed, [total.name], scope, mesh,
+                            ("dp",), "dp")
+        state = {n: np.asarray(scope.find_var(n))
+                 for n in step.state_in_names}
+        with lowering_target("tpu"):
+            exported = jexp.export(step.fn, platforms=("tpu",))(
+                feed, state, jax.random.PRNGKey(0))
+    return exported.mlir_module()
+
+
+def census_zero1_dp8():
+    """dp8 ZeRO-1 sharded update: the fused Adam kernel engages on the
+    flat 128-aligned 1/n state shards inside shard_map."""
+    txt = _dp8_step_module(sharded_update=True)
+    return _section("zero1_dp8_flat_shard_adam", txt, ("_adam_kernel",))
+
+
+def census_quant_dp8(mode):
+    """dp8 int8/int4 bucketed quantized grad sync: the receive stage is
+    the fused dequant-accumulate kernel (int8 round-to-nearest also
+    fuses the requantization)."""
+    txt = _dp8_step_module(quant_mode=mode)
+    required = ("_dq_acc_requant_kernel",) if mode == "int8" \
+        else ("_dq_acc_kernel",)
+    sec = _section(f"quant_{mode}_dp8", txt, required)
+    sec["wire_tier_parity_bound"] = WIRE_TIER_BOUNDS[mode]
+    return sec
+
+
+def parity_flat_shard_adam():
+    """Interpret-mode fused Adam on a 128-aligned flat shard vs the
+    per-leaf jnp chain."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.fused_ops import adam_update
+
+    rng = np.random.RandomState(1)
+    n = 9 * 1024 + 128          # flat, 128-aligned, not a power of two
+    p = rng.randn(n).astype(np.float32)
+    g = rng.randn(n).astype(np.float32)
+    m = rng.randn(n).astype(np.float32) * 0.1
+    v = np.abs(rng.randn(n)).astype(np.float32) * 0.01
+    beta1, beta2, eps, lr_t = 0.9, 0.999, 1e-8, 0.01
+    po, mo, vo = adam_update(jnp.asarray(p), jnp.asarray(g),
+                             jnp.asarray(m), jnp.asarray(v), lr_t,
+                             beta1=beta1, beta2=beta2, eps=eps,
+                             interpret=True)
+    m_ref = beta1 * m + (1 - beta1) * g
+    v_ref = beta2 * v + (1 - beta2) * g * g
+    p_ref = p - lr_t * m_ref / (np.sqrt(v_ref) + eps)
+    err = max(float(np.max(np.abs(np.asarray(po) - p_ref))),
+              float(np.max(np.abs(np.asarray(mo) - m_ref))),
+              float(np.max(np.abs(np.asarray(vo) - v_ref))))
+    return {"flat_shard_adam": {"measured": err,
+                                "bound": PARITY_BOUNDS["flat_shard_adam"]}}
+
+
+def parity_dequant_acc():
+    """Interpret-mode fused receive stage vs the jnp dequant+sum (and
+    requantize) composition, int8 + int4."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas import quant_kernels as qk
+    from paddle_tpu.ops.quantize_wire import (CompressionSpec,
+                                              dequantize_blockwise,
+                                              quantize_blockwise)
+
+    rng = np.random.RandomState(2)
+    out = {}
+    for dtype in ("int8", "int4"):
+        spec = CompressionSpec(dtype=dtype, block_size=256)
+        n, sb = 8, 20
+        numel = sb * spec.block_size
+        qs, ss = zip(*(quantize_blockwise(
+            jnp.asarray(rng.randn(numel).astype(np.float32)), spec)
+            for _ in range(n)))
+        payload = jnp.concatenate(qs, 0)
+        scales = jnp.concatenate(ss, 0)
+        ref = sum(dequantize_blockwise(q, s, spec)
+                  for q, s in zip(qs, ss))
+        got = qk.dequant_accumulate(payload, scales, spec, n,
+                                    interpret=True)
+        err = float(jnp.max(jnp.abs(got - ref)))
+        key = f"dequant_acc_{dtype}"
+        out[key] = {"measured": err, "bound": PARITY_BOUNDS[key]}
+        if dtype == "int8":
+            q2r, s2r = quantize_blockwise(ref, spec)
+            q2k, s2k = qk.dequant_accumulate_requant(payload, scales,
+                                                     spec, n,
+                                                     interpret=True)
+            rerr = float(jnp.max(jnp.abs(
+                dequantize_blockwise(q2k, s2k, spec)
+                - dequantize_blockwise(q2r, s2r, spec))))
+            out["dequant_acc_requant_int8"] = {
+                "measured": rerr,
+                "bound": PARITY_BOUNDS["dequant_acc_requant_int8"],
+                "payload_bit_identical": bool(jnp.all(q2k == q2r))}
+    return out
+
+
+def run_census(out_path=ARTIFACT):
+    import jax
+
+    sections = [census_single_device()]
+    ring_sec, ring_grad_sec, parity = census_ring_sp4()
+    sections += [ring_sec, ring_grad_sec]
+    sections.append(census_zero1_dp8())
+    sections.append(census_quant_dp8("int8"))
+    sections.append(census_quant_dp8("int4"))
+    parity.update(parity_flat_shard_adam())
+    parity.update(parity_dequant_acc())
+
+    for name, row in parity.items():
+        row["ok"] = row["measured"] <= row["bound"]
+    artifact = {
+        "artifact": "KERNEL_CENSUS",
+        "revision": "r15",
+        "platform_host": jax.devices()[0].platform,
+        "lowered_for": "tpu",
+        "sections": {s["leg"]: s for s in sections},
+        "parity": parity,
+    }
+    ok = all(s["complete"] for s in sections) and \
+        all(p["ok"] for p in parity.values())
+    artifact["ok"] = ok
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"wrote {out_path}")
+    for s in sections:
+        print(f"{s['leg']}: {'COMPLETE' if s['complete'] else 'MISSING ' + str(s['missing'])} "
+              f"({s['tpu_custom_call_sites']} tpu_custom_call sites)")
+    for name, row in parity.items():
+        print(f"parity {name}: {row['measured']:.2e} "
+              f"(bound {row['bound']:.0e}) "
+              f"{'OK' if row['ok'] else 'FAILED'}")
+    return artifact
+
+
+def census_main(argv):
+    _env8()
+    out_path = ARTIFACT
+    if "--json" in argv:
+        i = argv.index("--json")
+        out_path = argv[i + 1]
+    art = run_census(out_path)
+    if "--selftest" in argv:
+        print(f"kernel census selftest "
+              f"{'OK' if art['ok'] else 'FAILED'}")
+        return 0 if art["ok"] else 1
+    return 0
+
+
 if __name__ == "__main__":
+    if "--census" in sys.argv or "--selftest" in sys.argv:
+        sys.exit(census_main(sys.argv[1:]))
     main()
