@@ -309,9 +309,14 @@ class _Fleet:
                                   main_program, **kw)
 
     def load_checkpoint(self, executor, path, trainer_id=0,
-                        main_program=None):
+                        main_program=None, **kw):
+        """Reshard-aware restore: when the checkpoint's stamped layout
+        differs from the program's (an elastic relaunch on a different
+        device count), io.load_checkpoint plans + executes the transfer
+        (``dst_layout=`` / ``reshard=`` pass through)."""
         from .. import io
-        return io.load_checkpoint(executor, path, trainer_id, main_program)
+        return io.load_checkpoint(executor, path, trainer_id,
+                                  main_program, **kw)
 
 
 fleet = _Fleet()

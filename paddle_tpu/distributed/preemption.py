@@ -7,8 +7,23 @@ ref: incubate/fleet/collective/__init__.py:236,294).
 flag the training loop polls between steps: on the next step boundary
 the loop saves a consistent checkpoint (params + optimizer state + RNG
 stream + TrainStatus) and exits with a distinctive code the launcher
-can treat as "reschedule me".  Resume is bit-exact: the checkpoint
-carries everything the step function reads.
+can treat as "reschedule me".  Resume is bit-exact on the identical
+mesh; on a DIFFERENT mesh (a shrunk pod slice) the checkpoint's v2
+layout manifest lets ``io.load_checkpoint`` plan and execute the
+resharding transfer (framework/reshard.py), so preemption handling is
+real elasticity: relaunch on the surviving devices, ``auto_shard``
+replans, the restore reshards, training continues.
+
+Robustness contract:
+
+* pre-existing signal handlers are CHAINED, never clobbered — a
+  framework that already traps SIGTERM (a launcher's own drain hook)
+  keeps working;
+* SIGINT is opt-in (``catch_sigint=True``) so interactive ^C keeps its
+  default behavior unless the job asks for checkpoint-on-interrupt;
+* any in-flight :class:`~paddle_tpu.io.AsyncCheckpointer` write is
+  DRAINED before ``os._exit`` — a preemption can never tear a
+  half-written checkpoint.
 """
 
 from __future__ import annotations
@@ -40,7 +55,10 @@ class PreemptionHandler:
                  save_interval: Optional[int] = None,
                  signals: Iterable[int] = (signal.SIGTERM,),
                  exit_on_preempt: bool = True,
-                 max_checkpoints: int = 3):
+                 max_checkpoints: int = 3,
+                 catch_sigint: bool = False,
+                 checkpointer: Optional["io.AsyncCheckpointer"] = None,
+                 layout=None):
         self._exe = executor
         self._path = path
         self._program = main_program
@@ -48,14 +66,27 @@ class PreemptionHandler:
         self._save_interval = save_interval
         self._exit_on_preempt = exit_on_preempt
         self._max_checkpoints = max_checkpoints
+        self._checkpointer = checkpointer
+        self._layout = layout
         self._preempted = False
         self._status = io.TrainStatus(-1)
-        for sig in signals:
-            signal.signal(sig, self._on_signal)
+        self._chained = {}
+        sigs = list(signals)
+        if catch_sigint and signal.SIGINT not in sigs:
+            sigs.append(signal.SIGINT)
+        for sig in sigs:
+            # chain (don't clobber) whatever handler was installed
+            # before us — ours runs first, then delegates
+            prev = signal.signal(sig, self._on_signal)
+            if callable(prev) and prev is not self._on_signal:
+                self._chained[sig] = prev
 
     def _on_signal(self, signum, frame):
         # only set a flag — checkpointing mid-step would tear the state
         self._preempted = True
+        prev = self._chained.get(signum)
+        if prev is not None:
+            prev(signum, frame)
 
     @property
     def preempted(self) -> bool:
@@ -63,7 +94,9 @@ class PreemptionHandler:
 
     # -- lifecycle -------------------------------------------------------
     def restore(self) -> io.TrainStatus:
-        """Load the newest checkpoint (no-op on cold start)."""
+        """Load the newest valid checkpoint (no-op on cold start);
+        reshards automatically when it was written under a different
+        mesh layout (the elastic-relaunch path)."""
         st = io.load_checkpoint(self._exe, self._path,
                                 main_program=self._program,
                                 scope=self._scope)
@@ -76,12 +109,21 @@ class PreemptionHandler:
         self._status = io.TrainStatus(epoch_no=step, step=step)
         io.save_checkpoint(self._exe, self._path, self._status,
                            self._program, scope=self._scope,
-                           max_checkpoints=self._max_checkpoints)
+                           max_checkpoints=self._max_checkpoints,
+                           layout=self._layout)
+
+    def _drain_inflight(self):
+        """Join any in-flight async checkpoint write so the exit path
+        never leaves a torn tmp dir behind."""
+        ck = self._checkpointer
+        if ck is not None:
+            ck.drain()
 
     def step_done(self, step: int):
         """Call at every step boundary: periodic checkpoint + preemption
         checkpoint-and-exit."""
         if self._preempted:
+            self._drain_inflight()
             self.save(step)
             if self._exit_on_preempt:
                 os._exit(PREEMPTED_EXIT_CODE)   # skip atexit: be gone
@@ -92,4 +134,5 @@ class PreemptionHandler:
         return False
 
     def finish(self, step: int):
+        self._drain_inflight()
         self.save(step)

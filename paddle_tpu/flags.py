@@ -97,6 +97,14 @@ _register("verify_passes", False)
 #     (donation-gap / fetch-retention / grad-accum-doubling) reports
 #     the retention bugs that flag used to paper over.
 _register("hbm_budget_gb", 0.0)
+# checkpoint-write resilience (io.py): transient OSError/IOError on a
+# checkpoint file write retries up to this many times with bounded
+# exponential backoff (base below, doubling, capped at 2 s) before the
+# error propagates.  Every retry bumps the ``checkpoint::retry`` metrics
+# counter and drops a flight-recorder breadcrumb, so a flaky blob store
+# is visible instead of silently slowing saves.  0 disables retries.
+_register("checkpoint_retries", 3)
+_register("checkpoint_retry_backoff_s", 0.05)
 # persistent AOT executable cache directory (framework/aot_cache.py):
 # when set, single-device compiles (Executor._compile with no mesh — the
 # serving regime) serialize their XLA executables to disk

@@ -1125,6 +1125,95 @@ def kernel_routing_report(program: Program, feed_shapes=None,
             "rows": rows, "summary": summary}
 
 
+# ---------------------------------------------------------------------------
+# reshard-plan validation (elastic restore: framework/reshard.py)
+# ---------------------------------------------------------------------------
+
+#: anchored diagnostic codes for resharding-restore plans
+RESHARD_INDIVISIBLE = "reshard-indivisible"
+RESHARD_AXIS_DANGLING = "reshard-axis-dangling"
+RESHARD_FLAT_SHAPE = "reshard-flat-shape"
+RESHARD_UNKNOWN_STEP = "reshard-unknown-step"
+RESHARD_UNLOWERABLE = "reshard-unlowerable-step"
+RESHARD_DIVS_UNRESOLVED = "reshard-divs-unresolved"
+RESHARD_NEGATIVE_WIRE = "reshard-negative-wire"
+RESHARD_CANDIDATE_ORDER = "reshard-candidate-order"
+RESHARD_NOOP = "reshard-noop"
+
+
+def verify_reshard(plan, result: Optional[VerifyResult] = None
+                   ) -> VerifyResult:
+    """Validate a :class:`~.reshard.ReshardPlan` before anything moves:
+    schedule well-formedness (every step lowers to a registered op, the
+    step chain lands exactly on the destination shard counts), byte
+    accounting sanity (no negative wire, the chosen candidate is the
+    cheapest priced), plus the per-var planning issues (indivisible
+    dims, dangling axes, flat-shard metadata mismatches) as anchored
+    ``reshard-*`` diagnostics.  Zero compiles — pure plan inspection."""
+    from ..ops.registry import OP_SPECS
+    from .reshard import STEP_LOWERING
+
+    result = result or VerifyResult()
+    for sev, code, msg in plan.issues():
+        result.add(sev, code, msg)
+    if plan.identity and plan.transfers:
+        src = plan.src_layout.sizes if plan.src_layout else None
+        dst = plan.dst_layout.sizes if plan.dst_layout else None
+        if src == dst:
+            result.add("warning", RESHARD_NOOP,
+                       f"reshard plan {src} -> {dst} moves nothing — "
+                       f"the layouts are identical")
+    local_ops = {"slice", "concat", "reshape", "c_identity"}
+    for t in plan.transfers.values():
+        if t.identity:
+            continue
+        cur = list(t.src_divs)
+        for s in t.steps:
+            if s.kind not in STEP_LOWERING:
+                result.add("error", RESHARD_UNKNOWN_STEP,
+                           f"persistable {t.name!r}: step kind "
+                           f"{s.kind!r} has no lowering")
+                continue
+            for op in s.lowers_to:
+                if op not in OP_SPECS and op not in local_ops:
+                    result.add(
+                        "error", RESHARD_UNLOWERABLE,
+                        f"persistable {t.name!r}: step {s.kind!r} "
+                        f"lowers to unregistered op {op!r}")
+            if s.wire_bytes < 0:
+                result.add("error", RESHARD_NEGATIVE_WIRE,
+                           f"persistable {t.name!r}: step {s.kind!r} "
+                           f"prices negative wire ({s.wire_bytes})")
+            if s.kind != "repad" and s.dim < len(cur):
+                if cur[s.dim] != s.src_parts:
+                    result.add(
+                        "error", RESHARD_DIVS_UNRESOLVED,
+                        f"persistable {t.name!r}: step {s.kind!r} on "
+                        f"dim {s.dim} expects {s.src_parts} source "
+                        f"part(s), chain has {cur[s.dim]}")
+                cur[s.dim] = s.dst_parts
+            elif s.kind == "repad":
+                cur = list(t.dst_divs)
+        if t.flat is None and cur != list(t.dst_divs):
+            result.add("error", RESHARD_DIVS_UNRESOLVED,
+                       f"persistable {t.name!r}: schedule ends at shard "
+                       f"counts {cur}, destination needs {t.dst_divs}")
+        if t.candidates:
+            chosen = [c for c in t.candidates if c.get("chosen")]
+            if len(chosen) != 1:
+                result.add("error", RESHARD_CANDIDATE_ORDER,
+                           f"persistable {t.name!r}: "
+                           f"{len(chosen)} chosen candidate(s), want 1")
+            elif any(c["wire_bytes"] < chosen[0]["wire_bytes"]
+                     for c in t.candidates):
+                result.add(
+                    "error", RESHARD_CANDIDATE_ORDER,
+                    f"persistable {t.name!r}: a rejected candidate is "
+                    f"cheaper than the chosen schedule "
+                    f"({t.candidates})")
+    return result
+
+
 __all__ = [
     "Diagnostic", "VerifyResult", "PassInvariantError",
     "QUANT_COLLECTIVE_INTEGER", "QUANT_NON_SUM", "QUANT_SMALL_BUCKET",
@@ -1136,5 +1225,9 @@ __all__ = [
     "verify_distributed", "verify_shard_layout", "collective_signature",
     "check_collective_consistency", "pass_snapshot",
     "check_pass_invariants", "op_reads_recursive", "VERIFY_STATS",
-    "kernel_routing_report",
+    "kernel_routing_report", "verify_reshard",
+    "RESHARD_INDIVISIBLE", "RESHARD_AXIS_DANGLING", "RESHARD_FLAT_SHAPE",
+    "RESHARD_UNKNOWN_STEP", "RESHARD_UNLOWERABLE",
+    "RESHARD_DIVS_UNRESOLVED", "RESHARD_NEGATIVE_WIRE",
+    "RESHARD_CANDIDATE_ORDER", "RESHARD_NOOP",
 ]

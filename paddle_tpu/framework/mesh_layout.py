@@ -216,6 +216,23 @@ class MeshLayout:
             return None
         return axes[0] if len(axes) == 1 else axes
 
+    def spec_shards(self, spec, ndim: Optional[int] = None
+                    ) -> Tuple[int, ...]:
+        """Per-dim shard counts a :class:`ShardSpec` induces under THIS
+        layout (axes absent from the layout — or present at size 1 —
+        don't shard).  The geometry the resharding planner
+        (framework/reshard.py) diffs between a checkpoint's source
+        layout and the restore target."""
+        entries = tuple(spec) if spec is not None else ()
+        n = len(entries) if ndim is None else int(ndim)
+        out = [1] * n
+        for d, entry in enumerate(entries[:n]):
+            parts = 1
+            for a in _flat_axes((entry,)):
+                parts *= self._sizes.get(a, 1)
+            out[d] = parts
+        return tuple(out)
+
     # -- spec construction ----------------------------------------------
     def spec(self, *entries) -> ShardSpec:
         """A :class:`ShardSpec` validated against this layout's axes."""

@@ -11,18 +11,177 @@ auto-cleanup of stale checkpoints) matches the reference."""
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import pickle
 import shutil
-from typing import List, Optional
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from .framework.core import Program, Variable, default_main_program
+from .framework.errors import InvalidArgumentError
 from .framework.executor import Scope, global_scope, sync_prepared_state
 
 _RNG_VAR = "@RNG_STATE@"
+
+#: checkpoint format v2: layout-stamped, content-hashed manifests
+#: (``ckpt_manifest.json``) enable resharding restore onto a different
+#: mesh (framework/reshard.py) and corrupt/partial-checkpoint detection
+CKPT_FORMAT_VERSION = 2
+MANIFEST_FILE = "ckpt_manifest.json"
+
+
+def _sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return "sha256:" + h.hexdigest()
+
+
+def _retry_io(what: str, fn):
+    """Run a checkpoint file operation with bounded exponential backoff
+    on transient IO errors (``flag("checkpoint_retries")`` attempts,
+    ``checkpoint::retry`` metrics counter + flight breadcrumb per
+    retry).  Non-OSError failures propagate immediately."""
+    from .flags import flag
+    retries = int(flag("checkpoint_retries") or 0)
+    base = float(flag("checkpoint_retry_backoff_s") or 0.05)
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except OSError as e:
+            attempt += 1
+            if attempt > retries:
+                raise
+            from .monitor import stat
+            from .observability import flight as _flight
+            from .observability import metrics as _metrics
+            _metrics.counter("checkpoint::retry", stage=what).add()
+            stat("checkpoint_retry_total").add()
+            _flight.note_event("checkpoint_retry", stage=what,
+                               attempt=attempt, error=repr(e))
+            time.sleep(min(base * (2 ** (attempt - 1)), 2.0))
+
+
+def _spec_desc(da) -> List:
+    """JSON-able spelling of a dist_attr/ShardSpec (tuples → lists)."""
+    return [list(e) if isinstance(e, (tuple, list)) else e
+            for e in tuple(da)]
+
+
+def _spec_from_desc(d):
+    from .framework.mesh_layout import ShardSpec
+    if d is None:
+        return None
+    return ShardSpec(tuple(tuple(e) if isinstance(e, list) else e
+                           for e in d))
+
+
+def _layout_view(main_program: Optional[Program], layout=None
+                 ) -> Tuple[Any, Dict[str, List], Dict[str, Dict]]:
+    """(mesh layout, per-var shard specs, ZeRO-1 flat alignment meta) —
+    the layout stamp checkpoint format v2 embeds so restore can plan a
+    reshard instead of dying on a different mesh."""
+    specs: Dict[str, List] = {}
+    flat: Dict[str, Dict] = {}
+    if main_program is not None:
+        layout = layout or getattr(main_program, "_mesh_layout", None)
+        block = main_program.global_block()
+        for v in main_program.list_vars():
+            if v.persistable and getattr(v, "dist_attr", None):
+                specs[v.name] = _spec_desc(v.dist_attr)
+        from .framework.reshard import flat_shard_meta
+        for name, rec in flat_shard_meta(main_program).items():
+            rec = dict(rec)
+            v = block.vars.get(name)
+            if v is not None and len(tuple(v.shape)) == 1:
+                rec["pad"] = int(v.shape[0])
+            if layout is not None:
+                n = 1
+                for a in rec.get("axes") or ():
+                    n *= layout.size(a)
+                rec["n"] = max(int(n), 1)
+            flat[name] = rec
+    return layout, specs, flat
+
+
+def _manifest_dict(layout, specs, flat) -> Dict[str, Any]:
+    return {"format_version": CKPT_FORMAT_VERSION,
+            "mesh_layout": layout.to_desc() if layout is not None else None,
+            "shard_specs": specs, "flat_meta": flat,
+            "rng_vars": [_RNG_VAR], "files": {}}
+
+
+def _write_manifest(d: str, main_program: Optional[Program] = None,
+                    layout=None, manifest: Optional[Dict] = None):
+    """Write ``ckpt_manifest.json`` LAST (atomic tmp → rename), with a
+    content hash per checkpoint file — a torn save is detectable (and
+    restore falls back to the newest checkpoint whose hashes verify)."""
+    if manifest is None:
+        layout, specs, flat = _layout_view(main_program, layout)
+        manifest = _manifest_dict(layout, specs, flat)
+    files = {}
+    for fn in sorted(os.listdir(d)):
+        p = os.path.join(d, fn)
+        if fn == MANIFEST_FILE or fn.startswith(".") or \
+                not os.path.isfile(p):
+            continue
+        files[fn] = _retry_io("hash", lambda p=p: _sha256(p))
+    manifest = dict(manifest)
+    manifest["files"] = files
+    tmp = os.path.join(d, "." + MANIFEST_FILE + ".tmp")
+
+    def w():
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, os.path.join(d, MANIFEST_FILE))
+
+    _retry_io("manifest", w)
+    return manifest
+
+
+def _read_manifest(d: str) -> Optional[Dict[str, Any]]:
+    p = os.path.join(d, MANIFEST_FILE)
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def validate_checkpoint_dir(d: str) -> Tuple[bool, str]:
+    """(loadable, reason): verify the v2 manifest's per-file content
+    hashes; v1 checkpoints (no manifest) are loadable-but-unverifiable
+    as long as their core files exist."""
+    man = _read_manifest(d)
+    if man is None:
+        if not os.path.exists(os.path.join(d, "train_status.json")):
+            return False, "missing:train_status.json"
+        has_params = os.path.exists(os.path.join(d, "params.npz")) or \
+            any(n.startswith("shard_manifest_") for n in os.listdir(d))
+        return (True, "no-manifest") if has_params \
+            else (False, "missing:params")
+    for fn, want in (man.get("files") or {}).items():
+        p = os.path.join(d, fn)
+        if not os.path.exists(p):
+            return False, f"missing:{fn}"
+        try:
+            got = _sha256(p)
+        except OSError as e:
+            return False, f"unreadable:{fn}:{e!r}"
+        if got != want:
+            return False, f"hash-mismatch:{fn}"
+    return True, "ok"
 
 
 def _host_value(v, name="<var>"):
@@ -67,7 +226,8 @@ def save_persistables(executor, dirname, main_program: Optional[Program] = None,
         v = scope.find_var(name)
         if v is not None:
             arrays[name] = _host_value(v, name)
-    np.savez(os.path.join(dirname, filename), **arrays)
+    _retry_io("params", lambda: np.savez(
+        os.path.join(dirname, filename), **arrays))
 
 
 def load_persistables(executor, dirname, main_program: Optional[Program] = None,
@@ -178,24 +338,37 @@ class TrainStatus:
 def save_checkpoint(executor, path, train_status: TrainStatus,
                     main_program: Optional[Program] = None,
                     scope: Optional[Scope] = None, remain_all_checkpoint=False,
-                    max_checkpoints: int = 3, sharded: bool = False):
-    """Checkpoint = persistables + rng state + TrainStatus; keeps the last
-    ``max_checkpoints`` dirs (ref auto-cleanup: collective/__init__.py:206).
-    ``sharded=True`` writes per-process shard files (required once state is
-    sharded across hosts)."""
+                    max_checkpoints: int = 3, sharded: bool = False,
+                    layout=None):
+    """Checkpoint = persistables + rng state + TrainStatus + the v2
+    layout-stamped manifest (source :class:`MeshLayout`, per-var
+    ``ShardSpec``, ZeRO-1 flat-shard alignment metadata, per-file
+    content hashes); keeps the last ``max_checkpoints`` dirs (ref
+    auto-cleanup: collective/__init__.py:206).  ``sharded=True`` writes
+    per-process shard files (required once state is sharded across
+    hosts).  ``layout`` overrides the program's stamped
+    ``_mesh_layout`` as the recorded source layout."""
     scope = scope or global_scope()
     ckpt_id = train_status.epoch_no
     d = os.path.join(path, f"checkpoint_{ckpt_id}")
     os.makedirs(d, exist_ok=True)
     if sharded:
-        save_persistables_sharded(executor, d, main_program, scope=scope)
+        save_persistables_sharded(executor, d, main_program, scope=scope,
+                                  layout=layout)
     else:
         save_persistables(executor, d, main_program, scope=scope)
     rng = scope.find_var(_RNG_VAR)
     if rng is not None:
-        np.save(os.path.join(d, "rng.npy"), _host_value(rng, _RNG_VAR))
-    with open(os.path.join(d, "train_status.json"), "w") as f:
-        json.dump(train_status.to_dict(), f)
+        _retry_io("rng", lambda: np.save(os.path.join(d, "rng.npy"),
+                                         _host_value(rng, _RNG_VAR)))
+
+    def _ts():
+        with open(os.path.join(d, "train_status.json"), "w") as f:
+            json.dump(train_status.to_dict(), f)
+
+    _retry_io("train_status", _ts)
+    _write_manifest(d, main_program or default_main_program(),
+                    layout=layout)
     if not remain_all_checkpoint:
         _cleanup_stale(path, max_checkpoints)
     return d
@@ -240,20 +413,218 @@ def _cleanup_stale(path, keep):
             shutil.rmtree(os.path.join(path, n), ignore_errors=True)
 
 
+def _layout_name(layout) -> str:
+    return repr(dict(layout.sizes)) if layout is not None else "<unstamped>"
+
+
+def _maybe_reshard(arrays: Dict[str, np.ndarray], manifest: Optional[Dict],
+                   program: Optional[Program], dst_layout, reshard: bool
+                   ) -> Tuple[Dict[str, np.ndarray], Optional[Dict]]:
+    """Reshard restored host arrays onto the destination layout when the
+    checkpoint was written under a different one (framework/reshard.py:
+    plan → verify → execute, all statically priced, 0 compiles)."""
+    from .framework.mesh_layout import MeshLayout
+    from .framework.reshard import (execute_reshard, flat_shard_meta,
+                                    plan_reshard)
+
+    manifest = manifest or {}
+    src_layout = MeshLayout.from_desc(manifest.get("mesh_layout"))
+    if dst_layout is None and program is not None:
+        dst_layout = getattr(program, "_mesh_layout", None)
+    src_specs = {k: _spec_from_desc(v)
+                 for k, v in (manifest.get("shard_specs") or {}).items()}
+    src_flat = manifest.get("flat_meta") or {}
+
+    dst_specs: Dict[str, Any] = {}
+    dst_flat: Dict[str, Dict] = {}
+    block = program.global_block() if program is not None else None
+    if program is not None:
+        for v in program.list_vars():
+            if v.persistable and getattr(v, "dist_attr", None):
+                dst_specs[v.name] = v.dist_attr
+        dst_flat = flat_shard_meta(program)
+
+    flat_meta: Dict[str, Dict] = {}
+    for name, rec in src_flat.items():
+        if name not in arrays:
+            continue
+        dv = block.vars.get(name) if block is not None else None
+        dst_pad = int(dv.shape[0]) if dv is not None and \
+            len(tuple(dv.shape)) == 1 else None
+        dst_rec = dst_flat.get(name) or {}
+        n_dst = None
+        if dst_layout is not None:
+            n_dst = 1
+            for a in (dst_rec.get("axes") or rec.get("axes") or ()):
+                n_dst *= dst_layout.size(a)
+            n_dst = max(int(n_dst), 1)
+        if dst_pad is None:
+            continue             # var not in the dst program: passthrough
+        flat_meta[name] = {
+            "numel": rec["numel"],
+            "align": dst_rec.get("align", rec.get("align", 1)),
+            "axes": rec.get("axes"),
+            "src_pad": rec.get("pad") or int(arrays[name].shape[0]),
+            "n_src": rec.get("n"), "dst_pad": dst_pad, "n_dst": n_dst}
+
+    layouts_differ = (src_layout is not None and dst_layout is not None
+                      and src_layout.sizes != dst_layout.sizes)
+    flat_differs = any(f["src_pad"] != f["dst_pad"]
+                       for f in flat_meta.values())
+    if not layouts_differ and not flat_differs:
+        return arrays, None
+    if not reshard:
+        raise InvalidArgumentError(
+            f"load_checkpoint: checkpoint layout "
+            f"{_layout_name(src_layout)} does not match the program's "
+            f"layout {_layout_name(dst_layout)} and resharding is "
+            f"disabled — restore onto the identical mesh or pass "
+            f"reshard=True")
+
+    var_sigs = {name: (tuple(int(s) for s in arr.shape), str(arr.dtype))
+                for name, arr in arrays.items()}
+    plan = plan_reshard(src_layout, dst_layout, var_sigs=var_sigs,
+                        src_specs=src_specs,
+                        dst_specs=dst_specs if dst_specs else None,
+                        flat_meta=flat_meta, validate=False)
+    from .framework.analysis import verify_reshard
+    res = verify_reshard(plan)
+    if not res.ok:
+        raise InvalidArgumentError(
+            f"load_checkpoint: cannot reshard checkpoint layout "
+            f"{_layout_name(src_layout)} onto program layout "
+            f"{_layout_name(dst_layout)}:\n" + res.report())
+
+    from .monitor import stat
+    from .observability import flight as _flight
+    from .profiler import RecordEvent
+    import time as _time
+    t0 = _time.perf_counter_ns()
+    with RecordEvent("checkpoint::reshard",
+                     src=_layout_name(src_layout),
+                     dst=_layout_name(dst_layout)):
+        out, stats = execute_reshard(plan, arrays)
+    stat("checkpoint_reshards").add()
+    stat("checkpoint_reshard_ns").add(_time.perf_counter_ns() - t0)
+    _flight.note_event("checkpoint_reshard",
+                       src=_layout_name(src_layout),
+                       dst=_layout_name(dst_layout),
+                       wire_bytes=stats["wire_bytes"],
+                       vars_moved=stats["vars_moved"])
+    info = {"src_layout": src_layout.sizes if src_layout else None,
+            "dst_layout": dst_layout.sizes if dst_layout else None,
+            "wire_bytes": int(stats["wire_bytes"]),
+            "vars_moved": int(stats["vars_moved"]),
+            "steps_by_kind": plan.steps_by_kind(),
+            "candidates_rejected": plan.candidates_rejected(),
+            "compiles_attempted": plan.compiles_attempted,
+            "plan": plan}
+    return out, info
+
+
+def _check_restore_shapes(program: Program, arrays: Dict[str, np.ndarray],
+                          manifest: Optional[Dict], dst_layout):
+    """verify_programs gate: a restored array whose shape disagrees with
+    the program's declared persistable must fail HERE, naming both
+    layouts — not as a shape error deep in the executor."""
+    from .framework.mesh_layout import MeshLayout
+    src_layout = MeshLayout.from_desc((manifest or {}).get("mesh_layout"))
+    if dst_layout is None:
+        dst_layout = getattr(program, "_mesh_layout", None)
+    block = program.global_block()
+    for name, arr in arrays.items():
+        v = block._find_var_recursive(name)
+        if v is None:
+            continue
+        want = tuple(int(s) for s in v.shape)
+        got = tuple(int(s) for s in np.shape(arr))
+        if want and -1 not in want and want != got:
+            raise InvalidArgumentError(
+                f"load_checkpoint: restored persistable {name!r} has "
+                f"shape {got} but the program declares {want} — the "
+                f"checkpoint was written under layout "
+                f"{_layout_name(src_layout)} and does not fit the "
+                f"program's layout {_layout_name(dst_layout)}; save "
+                f"with the v2 layout manifest (io.save_checkpoint) so "
+                f"restore can plan a reshard, or restore onto the "
+                f"original mesh")
+
+
 def load_checkpoint(executor, path, trainer_id=0,
                     main_program: Optional[Program] = None,
-                    scope: Optional[Scope] = None) -> TrainStatus:
-    """Load the newest checkpoint; returns its TrainStatus (epoch -1 when
-    none exists — cold start)."""
+                    scope: Optional[Scope] = None, dst_layout=None,
+                    reshard: bool = True) -> TrainStatus:
+    """Load the newest *valid* checkpoint; returns its TrainStatus
+    (epoch -1 when none exists — cold start).
+
+    v2 behavior (elastic restore):
+
+    * per-file content hashes from the manifest are verified; a
+      corrupt/partial checkpoint is skipped (recorded on the returned
+      status as ``skipped_checkpoints`` + a flight breadcrumb) and the
+      newest older valid checkpoint loads instead of crashing;
+    * when the checkpoint's stamped source layout differs from the
+      program's (``dst_layout`` override, else
+      ``main_program._mesh_layout``), the minimal resharding schedule is
+      planned, verified (``reshard-*`` diagnostics), priced, and
+      executed on the restored arrays (``checkpoint::reshard`` span) —
+      the same state continues on a shrunk or regrown slice;
+    * a failed restore dumps a flight-recorder bundle before raising."""
     scope = scope or global_scope()
+    program = main_program if main_program is not None \
+        else default_main_program()
     cks = _list_checkpoints(path)
     if not cks:
-        return TrainStatus(-1)
-    _, d = cks[-1]
-    if os.path.exists(os.path.join(d, "shard_manifest_0.json")):
-        load_persistables_sharded(executor, d, main_program, scope=scope)
+        st = TrainStatus(-1)
+        st.skipped_checkpoints = []
+        return st
+    skipped: List[Dict[str, str]] = []
+    chosen = None
+    for _, d in reversed(cks):
+        ok, reason = validate_checkpoint_dir(d)
+        if ok:
+            chosen = d
+            break
+        skipped.append({"dir": d, "reason": reason})
+        from .monitor import stat
+        from .observability import flight as _flight
+        stat("checkpoint_restore_skipped").add()
+        _flight.note_event("checkpoint_skipped", path=d, reason=reason)
+    if chosen is None:
+        raise InvalidArgumentError(
+            f"load_checkpoint: no valid checkpoint under {path!r} — "
+            f"skipped {[(s['dir'], s['reason']) for s in skipped]}")
+    try:
+        st = _restore_dir(chosen, program, scope, dst_layout=dst_layout,
+                          reshard=reshard)
+    except BaseException as e:
+        from .observability import flight as _flight
+        _flight.dump("checkpoint_restore_failed", exc=e, program=program,
+                     extra={"checkpoint": chosen,
+                            "skipped": skipped})
+        raise
+    st.skipped_checkpoints = skipped
+    st.restored_from = chosen
+    return st
+
+
+def _restore_dir(d: str, program: Optional[Program], scope: Scope,
+                 dst_layout=None, reshard: bool = True) -> TrainStatus:
+    from .flags import flag
+    manifest = _read_manifest(d)
+    wanted = set(_persistable_names(program)) if program is not None \
+        else None
+    sharded = any(n.startswith("shard_manifest_") for n in os.listdir(d))
+    if sharded:
+        arrays = _read_sharded_arrays(d, wanted)
     else:
-        load_persistables(executor, d, main_program, scope=scope)
+        arrays = _read_whole_arrays(d, wanted)
+    arrays, reshard_info = _maybe_reshard(arrays, manifest, program,
+                                          dst_layout, reshard)
+    if flag("verify_programs") and program is not None:
+        _check_restore_shapes(program, arrays, manifest, dst_layout)
+    for name, arr in arrays.items():
+        scope.set_var(name, arr)
     rng_path = os.path.join(d, "rng.npy")
     if os.path.exists(rng_path):
         import jax
@@ -261,7 +632,20 @@ def load_checkpoint(executor, path, trainer_id=0,
         key = jax.numpy.asarray(raw)
         scope.set_var(_RNG_VAR, key)
     with open(os.path.join(d, "train_status.json")) as f:
-        return TrainStatus.from_dict(json.load(f))
+        st = TrainStatus.from_dict(json.load(f))
+    st.reshard = reshard_info
+    return st
+
+
+def _read_whole_arrays(d: str, wanted=None,
+                       filename: str = "params.npz"
+                       ) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    with np.load(os.path.join(d, filename)) as data:
+        for name in data.files:
+            if wanted is None or name in wanted:
+                out[name] = np.array(data[name])
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -283,11 +667,15 @@ def _index_sig(idx, shape):
 
 def save_persistables_sharded(executor, dirname,
                               main_program: Optional[Program] = None,
-                              scope: Optional[Scope] = None):
+                              scope: Optional[Scope] = None,
+                              layout=None):
     """Each process writes ONLY its addressable shards plus a manifest of
     their global offsets — no host ever materialises a tensor it does not
     own (the multi-host/model-parallel save path the whole-array writer
-    refuses).  Layout: shard_data_{p}.npz + shard_manifest_{p}.json."""
+    refuses).  Layout: shard_data_{p}.npz + shard_manifest_{p}.json.
+    Format v2 embeds the source :class:`MeshLayout`, per-var
+    ``ShardSpec`` and ZeRO-1 flat alignment metadata in the manifest so
+    a restore on a different slice can plan the resharding transfer."""
     import jax
     main_program = main_program or default_main_program()
     scope = scope or global_scope()
@@ -322,30 +710,39 @@ def save_persistables_sharded(executor, dirname,
                               "dtype": str(arrays[f"{name}@full"].dtype),
                               "shards": [{"key": f"{name}@full",
                                           "index": None}]}
-    np.savez(os.path.join(dirname, f"shard_data_{p}.npz"), **arrays)
-    with open(os.path.join(dirname, f"shard_manifest_{p}.json"), "w") as f:
-        json.dump(manifest, f)
+    _retry_io("shard_data", lambda: np.savez(
+        os.path.join(dirname, f"shard_data_{p}.npz"), **arrays))
+    lay, specs, flat = _layout_view(main_program, layout)
+    payload = {"format_version": CKPT_FORMAT_VERSION,
+               "mesh_layout": lay.to_desc() if lay is not None else None,
+               "shard_specs": specs, "flat_meta": flat,
+               "vars": manifest}
+
+    def w():
+        with open(os.path.join(dirname, f"shard_manifest_{p}.json"),
+                  "w") as f:
+            json.dump(payload, f)
+
+    _retry_io("shard_manifest", w)
 
 
-def load_persistables_sharded(executor, dirname,
-                              main_program: Optional[Program] = None,
-                              scope: Optional[Scope] = None):
-    """Reassemble from every process's shard files (a restarted job may
-    have a different host count — reassembly is by global offsets, not by
-    writer rank)."""
-    main_program = main_program or default_main_program()
-    scope = scope or global_scope()
-    wanted = set(_persistable_names(main_program))
-    full = {}
+def _read_sharded_arrays(dirname, wanted=None) -> Dict[str, np.ndarray]:
+    """Reassemble global arrays from every process's shard files (a
+    restarted job may have a different host count — reassembly is by
+    global offsets, not by writer rank).  Handles both the v1 flat
+    manifest schema and the v2 layout-stamped one."""
+    full: Dict[str, np.ndarray] = {}
     for fn in sorted(os.listdir(dirname)):
         if not fn.startswith("shard_manifest_"):
             continue
         pid = fn[len("shard_manifest_"):-len(".json")]
         with open(os.path.join(dirname, fn)) as f:
             manifest = json.load(f)
+        if "format_version" in manifest and "vars" in manifest:
+            manifest = manifest["vars"]
         with np.load(os.path.join(dirname, f"shard_data_{pid}.npz")) as data:
             for name, rec in manifest.items():
-                if name not in wanted:
+                if wanted is not None and name not in wanted:
                     continue
                 dst = full.setdefault(name, np.zeros(
                     rec["shape"], np.dtype(rec["dtype"])))
@@ -357,7 +754,17 @@ def load_persistables_sharded(executor, dirname,
                     else:
                         sel = tuple(slice(a, b) for a, b in e["index"])
                         dst[sel] = data[e["key"]]
-    for name, arr in full.items():
+    return full
+
+
+def load_persistables_sharded(executor, dirname,
+                              main_program: Optional[Program] = None,
+                              scope: Optional[Scope] = None):
+    """Scope-writing wrapper over :func:`_read_sharded_arrays`."""
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    wanted = set(_persistable_names(main_program))
+    for name, arr in _read_sharded_arrays(dirname, wanted).items():
         scope.set_var(name, arr)
 
 
@@ -397,6 +804,26 @@ class AsyncCheckpointer:
             e, self._error = self._error, None
             raise RuntimeError("async checkpoint write failed") from e
 
+    @property
+    def in_flight(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def drain(self) -> bool:
+        """Best-effort join of any in-flight write (the preemption exit
+        path: a SIGTERM must never tear a half-written checkpoint).
+        Returns True when the drain finished clean, False when the write
+        had failed (the error is reported, not raised — the caller is
+        about to ``os._exit``)."""
+        try:
+            self.wait()
+            return True
+        except Exception as e:      # noqa: BLE001 — exit path, report only
+            import sys
+            print(f"paddle_tpu.AsyncCheckpointer: in-flight checkpoint "
+                  f"write failed during drain: {e!r}", file=sys.stderr)
+            return False
+
     def save(self, executor, path, train_status: TrainStatus,
              main_program: Optional[Program] = None,
              scope: Optional[Scope] = None):
@@ -435,6 +862,11 @@ class AsyncCheckpointer:
         final = os.path.join(path, f"checkpoint_{ckpt_id}")
         tmp = os.path.join(path, f".tmp_checkpoint_{ckpt_id}_{os.getpid()}")
         keep = self._max
+        # layout view captured on the TRAINING thread (program access is
+        # not thread-safe against concurrent passes) — the background
+        # write only serializes it
+        lay, specs, flat = _layout_view(main_program)
+        manifest = _manifest_dict(lay, specs, flat)
 
         def write():
             try:
@@ -447,11 +879,22 @@ class AsyncCheckpointer:
 
         def _write_inner():
             os.makedirs(tmp, exist_ok=True)
-            np.savez(os.path.join(tmp, "params.npz"), **snap)
+            _retry_io("params", lambda: np.savez(
+                os.path.join(tmp, "params.npz"), **snap))
             if rng_snap is not None:
-                np.save(os.path.join(tmp, "rng.npy"), rng_snap)
-            with open(os.path.join(tmp, "train_status.json"), "w") as f:
-                json.dump(status, f)
+                _retry_io("rng", lambda: np.save(
+                    os.path.join(tmp, "rng.npy"), rng_snap))
+
+            def _ts():
+                with open(os.path.join(tmp, "train_status.json"),
+                          "w") as f:
+                    json.dump(status, f)
+
+            _retry_io("train_status", _ts)
+            # manifest (with content hashes) lands INSIDE the tmp dir,
+            # so the atomic tmp→final rename publishes a fully
+            # verifiable checkpoint or nothing
+            _write_manifest(tmp, manifest=manifest)
             if os.path.isdir(final):
                 # rename aside, swap in, then delete: a crash between
                 # any two steps leaves either the old or the new dir

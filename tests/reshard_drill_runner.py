@@ -1,0 +1,142 @@
+"""Elastic-training drill trainer (test_preemption.py): trains an MLP
+under ``strategy.auto_shard`` with an HBM budget tuned so the planner
+picks a ZeRO-3 (fsdp > 1) layout, with a PreemptionHandler armed.
+
+    python reshard_drill_runner.py CKPT_DIR MAX_STEPS NDEV [slow]
+
+* SIGTERM mid-run → consistent v2 (layout-stamped) checkpoint + exit 42;
+* relaunched with a DIFFERENT ``NDEV`` (the surviving devices), the
+  planner replans on that count, ``load_checkpoint`` reshards the
+  restored state onto the new layout, and training continues — the
+  parent test asserts the loss curve matches an uninterrupted run.
+"""
+
+import json
+import os
+import sys
+
+NDEV = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+
+if __name__ == "__main__":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={NDEV}").strip()
+
+import numpy as np
+
+
+def _batch(step):
+    rng = np.random.RandomState(7000 + step)
+    xs = rng.randn(64, 16).astype(np.float32)
+    ys = (xs.sum(1) > 0).astype(np.int64).reshape(-1, 1) * 3
+    return xs, ys
+
+
+def _model(fluid):
+    x = fluid.layers.data("x", shape=[16])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, 32, act="relu",
+                        param_attr=fluid.ParamAttr(
+                            name="w1",
+                            initializer=fluid.initializer.Constant(0.05)),
+                        bias_attr=False)
+    h = fluid.layers.fc(h, 32, act="relu",
+                        param_attr=fluid.ParamAttr(
+                            name="w2",
+                            initializer=fluid.initializer.Constant(0.04)),
+                        bias_attr=False)
+    pred = fluid.layers.fc(h, 4, act="softmax",
+                           param_attr=fluid.ParamAttr(
+                               name="w3",
+                               initializer=fluid.initializer.Constant(0.05)),
+                           bias_attr=False)
+    return fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+
+
+def _zero3_budget_gb(ndev):
+    """Probe pass: price every layout on a throwaway build and place the
+    budget just under the pure-dp peak, so auto_shard must pick an
+    fsdp > 1 (ZeRO-3) layout — 0 compiles spent here."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.core import reset_default_programs
+    from paddle_tpu.framework.shard_planner import plan_sharding
+    reset_default_programs()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss = _model(fluid)
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    plan = plan_sharding(main, ndev, loss_name=loss.name,
+                         fetch_names=[loss.name], min_shard_numel=64)
+    peaks = {(c.layout.data, c.layout.fsdp): c.peak_bytes
+             for c in plan.configs if c.peak_bytes is not None}
+    pure_dp = peaks[(ndev, 1)]
+    lowest = min(peaks.values())
+    assert lowest < pure_dp, "fsdp must save memory for the drill to bite"
+    return (lowest + pure_dp) / 2 / float(1 << 30)
+
+
+def main(ckpt_dir, max_steps, slow):
+    import time
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed.fleet import (fleet, DistributedStrategy,
+                                              distributed_optimizer,
+                                              UserDefinedRoleMaker)
+    from paddle_tpu.distributed.preemption import PreemptionHandler
+    from paddle_tpu.framework.core import reset_default_programs
+
+    budget = _zero3_budget_gb(NDEV)
+    reset_default_programs()
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        loss = _model(fluid)
+        fleet.init(UserDefinedRoleMaker(0, 1))
+        s = DistributedStrategy()
+        s.auto_shard = True
+        s.auto_shard_configs["min_shard_numel"] = 64
+        s.auto_shard_configs["num_devices"] = NDEV
+        s.auto_shard_configs["hbm_budget_gb"] = budget
+        opt = distributed_optimizer(fluid.optimizer.Adam(5e-3), s)
+        opt.minimize(loss)
+    layout = main_p._mesh_layout
+    assert layout is not None and layout.fsdp > 1, \
+        f"drill expects a ZeRO-3 replan, got {layout}"
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    handler = PreemptionHandler(exe, ckpt_dir, main_p)
+    status = handler.restore()
+    reshard = getattr(status, "reshard", None)
+
+    losses = []
+    for step in range(status.step + 1, max_steps):
+        xs, ys = _batch(step)
+        l, = exe.run(fleet.main_program, feed={"x": xs, "label": ys},
+                     fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(())))
+        handler.step_done(step)
+        if slow:
+            print(f"STEP {step}", flush=True)
+            time.sleep(0.25)
+    handler.finish(max_steps - 1)
+
+    print("RESULT " + json.dumps({
+        "first_step": status.step + 1,
+        "ndev": NDEV,
+        "layout": dict(layout.sizes),
+        "resharded": reshard is not None,
+        "reshard_steps": (reshard or {}).get("steps_by_kind", {}),
+        "reshard_compiles": (reshard or {}).get("compiles_attempted"),
+        "losses": losses,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], int(sys.argv[2]),
+                  slow=len(sys.argv) > 4 and sys.argv[4] == "slow"))

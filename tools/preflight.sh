@@ -30,6 +30,11 @@ echo "   incl. ring inner step, flat-shard Adam, dequant-accumulate all"
 echo "   present as tpu_custom_calls; interpret-mode parity bounds) =="
 python tools/verify_lowering.py --selftest
 
+echo "== preflight: reshard probe (elastic restore: dp8/ZeRO-3 BERT-tiny"
+echo "   checkpoint onto dp4/dp16 + tp2->tp1 flip, planned==executed wire"
+echo "   bytes, parity <=1e-6, 0 compiles on rejected candidates) =="
+python tools/reshard_probe.py --selftest
+
 echo "== preflight: auto-shard plan probe (dp8 BERT-tiny tp2: >=6 configs"
 echo "   priced, winner min-EXPOSED-comm among budget-fitting, ties to"
 echo "   fewer wire bytes, 0 compiles) =="
