@@ -152,8 +152,15 @@ class DistributedStrategy:
         self.sharded_update = False
         self.tensor_parallel = False
         self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        # pipeline parallelism (framework/pipe.py): split the forward
+        # into ``num_stages`` liveness-cut stages over a ``pp`` mesh
+        # axis and run a 1F1B schedule with ``accumulate_steps``
+        # microbatches per step (the reference's PipelineOptimizer
+        # accumulate_steps).  num_stages=None derives from the mesh's
+        # pp axis (or uses every device when no mesh is given).
         self.pipeline = False
-        self.pipeline_configs = {"accumulate_steps": 1}
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "num_stages": None}
         # legacy knobs kept for script compat; XLA owns these
         self.nccl_comm_num = 1
         self.use_hierarchical_allreduce = False
@@ -207,6 +214,16 @@ class DistributedStrategy:
             "feed_shapes": None,       # {name: (shape, dtype)} for exact
             "report_path": None,       # write PLAN_SEARCH json here
             "fsdp_prefetch_distance": 0,   # gather k layers early
+            # the pipeline/remat search dimensions (framework/pipe.py):
+            # max_pipe > 1 enumerates pipe stages (priced with the
+            # (pipe-1)/num_microbatches 1F1B bubble term);
+            # num_microbatches is the per-step 1F1B accumulation depth;
+            # remat=True prices a rematerialized sibling for every
+            # budget-rejected config (recompute checkpoints at the
+            # liveness peak, FLOPs delta in the roofline)
+            "max_pipe": 1,
+            "num_microbatches": 1,
+            "remat": False,
         }
         # execution/build strategies accepted and largely absorbed by XLA
         self.exec_strategy = None
@@ -377,6 +394,21 @@ class CollectiveOptimizer:
                     "DistributedStrategy: auto_shard prices per-step grad "
                     "sync that localsgd removes — the cost model would be "
                     "wrong; pick one")
+        if getattr(s, "pipeline", False):
+            if s.localsgd:
+                raise ValueError(
+                    "DistributedStrategy: pipeline accumulates "
+                    "per-microbatch grads into one update per step; "
+                    "localsgd removes that per-step sync — the "
+                    "combination is contradictory")
+            if s.recompute:
+                from ..framework.errors import InvalidArgumentError
+                raise InvalidArgumentError(
+                    "DistributedStrategy: pipeline=True and "
+                    "recompute=True both claim the recompute schedule — "
+                    "the 1F1B lowering already rematerializes each "
+                    "stage's forward at its backward tick, so explicit "
+                    "recompute checkpoints would be ignored; drop one")
         if getattr(s, "overlap_grad_sync", False) and s.localsgd:
             raise ValueError(
                 "DistributedStrategy: overlap_grad_sync schedules the "
@@ -556,11 +588,15 @@ class CollectiveOptimizer:
             build_strategy=self._build_strategy(),
             max_tp=cfgs.get("max_tp"), min_shard_numel=min_numel,
             module="auto_shard",
-            report_path=cfgs.get("report_path"))
+            report_path=cfgs.get("report_path"),
+            max_pipe=int(cfgs.get("max_pipe") or 1),
+            num_microbatches=int(cfgs.get("num_microbatches") or 1),
+            remat=bool(cfgs.get("remat")))
         layout = stamp_winning_layout(
             program, plan, min_shard_numel=min_numel,
             prefetch_distance=int(cfgs.get("fsdp_prefetch_distance")
-                                  or 0))
+                                  or 0),
+            feed_shapes=cfgs.get("feed_shapes"))
         fleet._plan = plan
         fleet._origin_program = program
         mesh = layout.build_mesh()
@@ -597,6 +633,9 @@ class CollectiveOptimizer:
 
         program = loss.block.program
         fleet._origin_program = program
+        if getattr(self._strategy, "pipeline", False):
+            return self._finish_pipeline(program, loss, mesh, opt_ops,
+                                         params_grads)
         fleet._mesh = mesh
         if mesh is not None and mesh.devices.size > 1:
             from ..framework.compiler import CompiledProgram
@@ -614,6 +653,52 @@ class CollectiveOptimizer:
                 build_strategy=self._build_strategy())
         else:
             fleet._compiled_program = None
+        return opt_ops, params_grads
+
+
+    def _finish_pipeline(self, program, loss, mesh, opt_ops,
+                         params_grads):
+        """``strategy.pipeline`` path: stage-cut the trained program
+        (framework/pipe.apply_pipeline) and compile onto a mesh whose
+        ``pp`` axis carries the stages.  An explicit ``strategy.mesh``
+        must declare the pp axis; otherwise the device pool splits into
+        (dp, pp) with pp = ``pipeline_configs["num_stages"]`` (default:
+        every device is a stage)."""
+        import jax
+        from jax.sharding import Mesh
+        from ..framework.compiler import CompiledProgram
+        from ..framework.errors import InvalidArgumentError
+        from ..framework.pipe import apply_pipeline
+
+        s = self._strategy
+        pcfg = dict(s.pipeline_configs or {})
+        M = int(pcfg.get("accumulate_steps") or 1)
+        if mesh is not None and s.mesh is not None:
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            S = int(sizes.get("pp", 0))
+            if S < 2:
+                raise InvalidArgumentError(
+                    "DistributedStrategy: pipeline=True needs a mesh "
+                    f"with a 'pp' axis of size >= 2; got axes {sizes}")
+        else:
+            ndev = len(jax.devices())
+            S = int(pcfg.get("num_stages") or 0) or ndev
+            if ndev % S:
+                raise InvalidArgumentError(
+                    f"DistributedStrategy: num_stages={S} does not "
+                    f"divide the device count {ndev}")
+            dp = ndev // S
+            devs = np.array(jax.devices()[:dp * S])
+            mesh = Mesh(devs.reshape(dp, S), ("dp", "pp")) if dp > 1 \
+                else Mesh(devs, ("pp",))
+        apply_pipeline(program, S, M)
+        fleet._mesh = mesh
+        sharded = getattr(s, "sharded_update", False) or \
+            getattr(s, "sharding", False)
+        ln = None if sharded else loss.name
+        fleet._compiled_program = CompiledProgram(program).with_mesh(
+            mesh, loss_name=ln, batch_axis="dp",
+            build_strategy=self._build_strategy())
         return opt_ops, params_grads
 
 

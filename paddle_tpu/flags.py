@@ -147,6 +147,25 @@ _register("overlap_lowering", True)
 # per-chip ICI figure; override per fabric.  Only the ranking between
 # configs consumes it, so absolute accuracy matters less than ordering.
 _register("ici_gbps", 90.0)
+# fraction of a training step's compute that sits in the backward sweep
+# and can hide overlap-scheduled grad-sync wire time
+# (memory_analysis.exposed_comm_model).  The historical hard-coded value
+# was 2/3 — backward GEMMs are 2 of the 3 fwd+bwd GEMM units the op_spec
+# ``flops`` channel prices — and the default preserves that constant
+# bit-for-bit (planner rankings are unchanged at the default).  Exposed
+# as a flag so the measured-cost calibration loop can fit it from
+# telemetry instead of trusting the analytic 2/3.
+_register("overlap_compute_frac", 2.0 / 3.0)
+# when the static hbm_budget_gb gate rejects a TRAINING program, attempt
+# activation rematerialization first (framework/pipe.plan_remat): insert
+# recompute checkpoints at the liveness-identified peak (the cheapest-to-
+# recompute residual boundaries), re-estimate, and only raise if the
+# program still does not fit.  The inserted checkpoints ride the backward
+# op's existing ``checkpoints`` attr (jax.checkpoint segments).  Off by
+# default: budget rejection stays loud unless the caller opts into the
+# automatic memory/compute trade (the auto-shard planner prices remat
+# explicitly regardless of this flag).
+_register("remat_on_reject", False)
 # quant-small-bucket lint threshold (framework/analysis.py, surfaced by
 # tools/proglint.py): a blockwise-quantized collective whose payload is
 # under this many KiB pays more in per-block scale tensors + the extra

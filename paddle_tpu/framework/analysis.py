@@ -67,6 +67,10 @@ READ_AFTER_DONATE = "read-after-donate"
 # framework/mesh_layout.py, stamped by the auto-shard planner)
 SHARD_LAYOUT_UNKNOWN_AXIS = "shard-layout-unknown-axis"
 SHARD_LAYOUT_COLLECTIVE_MISMATCH = "shard-layout-collective-mismatch"
+# pipeline/remat soundness (the stage-cut + recompute rewrites —
+# framework/pipe.py, lowered by the executor's 1F1B scan)
+PIPE_COLLECTIVE_CROSSES_STAGE = "pipe-collective-crosses-stage"
+REMAT_RECOMPUTE_SIDE_EFFECT = "remat-recompute-side-effect"
 UNSPECCED_OP = "unspecced-op"
 PASS_INVARIANT = "pass-invariant"
 # inference/serving profile (a SERVED program must be a pure read-only
@@ -853,6 +857,96 @@ def check_collective_consistency(programs: Sequence[Program],
 # ---------------------------------------------------------------------------
 
 
+def verify_pipeline(program: Program,
+                    result: Optional[VerifyResult] = None) -> VerifyResult:
+    """Pipeline/remat soundness over a rewritten program
+    (framework/pipe.py):
+
+    * ``pipe-collective-crosses-stage`` (error) — a forward collective
+      reads a value produced in a DIFFERENT pipeline stage.  Under the
+      1F1B lowering each pipe rank executes only its own stage's
+      branch, and cross-stage values arrive via the scheduled ppermute
+      at a different tick: a collective fed across a cut would
+      rendezvous its mesh peers against mismatched schedules.  The
+      stage-cut planner refuses such positions; a hand-stamped or
+      pass-mutated program is caught here.
+    * ``remat-recompute-side-effect`` (warning) — a recompute segment
+      (between ``backward.checkpoints`` boundaries) contains an
+      RNG-drawing op with no ``_folded_key``/``fix_seed`` marker: the
+      segment re-executes during the backward sweep, and randomness not
+      derived from the replayed segment key would redraw, making the
+      recomputed forward disagree with the original (wrong gradients).
+      The executor's ``jax.checkpoint`` lowering threads the segment
+      key explicitly — ``pipe.apply_remat`` stamps ``_folded_key`` after
+      that audit; hand-set checkpoints get the warning."""
+    result = result or VerifyResult(program)
+    block = program.global_block()
+    ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+    bw_idx = next((i for i, op in enumerate(ops)
+                   if op.type == "backward"), None)
+    if bw_idx is None:
+        return result
+    bw = ops[bw_idx]
+    fwd_ops = ops[:bw_idx]
+
+    if bw.attrs.get("pipe_stages"):
+        from ..ops.registry import OP_SPECS
+        def_stage: Dict[str, Any] = {}
+        for op in fwd_ops:
+            s = op.attrs.get("_pipe_stage")
+            for n in op.output_names():
+                def_stage.setdefault(n, s)
+        for idx, op in enumerate(fwd_ops):
+            spec = OP_SPECS.get(op.type)
+            if spec is None or not getattr(spec, "collective", False) \
+                    or op.type == "pipe_stage_boundary":
+                continue
+            s = op.attrs.get("_pipe_stage")
+            for n in op.input_names():
+                ds = def_stage.get(n)
+                if ds is not None and s is not None and ds != s:
+                    result.add(
+                        "error", PIPE_COLLECTIVE_CROSSES_STAGE,
+                        f"collective op {op.type!r} in pipeline stage "
+                        f"{s} reads {n!r} produced in stage {ds} — a "
+                        f"collective fed across a stage cut would "
+                        f"rendezvous against mismatched 1F1B schedules "
+                        f"(move the cut, or keep the collective with "
+                        f"its producers)",
+                        op, block.idx, idx)
+
+    checkpoints = set(bw.attrs.get("checkpoints") or ())
+    if checkpoints:
+        # the recompute region = every op up to the LAST checkpoint
+        # marker's producer (the final segment is never re-executed)
+        last_seg_start = -1
+        remaining = set(checkpoints)
+        for idx, op in enumerate(fwd_ops):
+            produced = set(op.output_names()) & remaining
+            if produced:
+                remaining -= produced
+                last_seg_start = idx
+        from .pipe import RNG_OP_TYPES
+        for idx, op in enumerate(fwd_ops[:last_seg_start + 1]):
+            if op.type not in RNG_OP_TYPES:
+                continue
+            if op.type == "dropout" and op.attrs.get("is_test"):
+                continue
+            if op.attrs.get("_folded_key") or op.attrs.get("fix_seed"):
+                continue
+            result.add(
+                "warning", REMAT_RECOMPUTE_SIDE_EFFECT,
+                f"RNG op {op.type!r} sits inside a recompute segment "
+                f"(backward checkpoints re-execute it during the "
+                f"reverse sweep) with no folded key: if its randomness "
+                f"is not derived from the replayed segment key, the "
+                f"recomputed forward diverges from the original and "
+                f"the gradients are wrong — stamp `_folded_key` after "
+                f"auditing (pipe.apply_remat does), or set fix_seed",
+                op, block.idx, idx)
+    return result
+
+
 def verify_program(program: Program, startup: Optional[Program] = None,
                    feed_names: Iterable[str] = (),
                    fetch_names: Iterable[str] = (),
@@ -866,6 +960,7 @@ def verify_program(program: Program, startup: Optional[Program] = None,
     infer_shapes(program, result, feed_names)
     verify_distributed(program, result, fetch_names)
     verify_shard_layout(program, result)
+    verify_pipeline(program, result)
     return result
 
 
@@ -1219,7 +1314,9 @@ __all__ = [
     "QUANT_COLLECTIVE_INTEGER", "QUANT_NON_SUM", "QUANT_SMALL_BUCKET",
     "OVERLAP_SINGLE_BUCKET", "OVERLAP_TAIL_SUNK",
     "SHARD_LAYOUT_UNKNOWN_AXIS", "SHARD_LAYOUT_COLLECTIVE_MISMATCH",
+    "PIPE_COLLECTIVE_CROSSES_STAGE", "REMAT_RECOMPUTE_SIDE_EFFECT",
     "verify_program", "verify_inference", "verify_cached",
+    "verify_pipeline",
     "clear_verify_cache",
     "verify_structure", "verify_startup_agreement", "infer_shapes",
     "verify_distributed", "verify_shard_layout", "collective_signature",

@@ -301,6 +301,50 @@ def _bucketize(group, cap):
     return buckets
 
 
+def insert_pipe_grad_sync(program: Program, pipe_axis: str = "pp"):
+    """Sum every parameter gradient over the pipe axis — the pipeline's
+    own grad sync (framework/pipe.apply_pipeline calls this).
+
+    Under the 1F1B lowering each pipe rank accumulates cotangents only
+    for its OWN stage's parameters (the other stages' entries stay
+    zero), so a plain sum over ``pipe_axis`` reconstructs the full
+    gradient on every rank — no mean scale (the per-token 1/n lives
+    with the data-axis sync, with which this sum commutes, so insertion
+    order against ``insert_grad_sync`` is irrelevant).  Grads are
+    coalesced into one fused collective per dtype; the ops degrade to
+    identity on a mesh without the pipe axis (the pipe = 1 parity
+    baseline runs the identical IR).  Returns the number of collective
+    ops inserted."""
+    block = program.global_block()
+    bw_idx = next((i for i, op in enumerate(block.ops)
+                   if op.type == "backward"), None)
+    if bw_idx is None:
+        return 0
+    bw = block.ops[bw_idx]
+    if bw.attrs.get("_pipe_allreduce_inserted"):
+        return 0
+    bw.attrs["_pipe_allreduce_inserted"] = True
+    groups = {}
+    order = []
+    for pname in bw.attrs["param_names"]:
+        pvar = block._find_var_recursive(pname)
+        dtype = str(getattr(pvar, "dtype", "float32") or "float32")
+        if dtype not in groups:
+            groups[dtype] = []
+            order.append(dtype)
+        groups[dtype].append(grad_var_name(pname))
+    insert_at = bw_idx + 1
+    for dtype in order:
+        block._insert_op(
+            insert_at, type="c_fused_allreduce_sum",
+            inputs={"X": list(groups[dtype])},
+            outputs={"Out": list(groups[dtype])},
+            attrs={"ring_id": 0, "_axis_name": pipe_axis,
+                   "_pipe_grad_sync": True})
+        insert_at += 1
+    return len(order)
+
+
 def insert_grad_sync(program: Program, strategy, nranks, reduce_axes,
                      axis_sizes=None):
     """Insert the per-step gradient sync after the backward op — the

@@ -280,10 +280,317 @@ def _overlap_schedule(fwd_ops, tail_ops, param_names):
     return hooks
 
 
+def _microbatch_feeds(feeds, M):
+    """Split every feed [B, ...] → [M, B/M, ...] (dim-0 microbatching —
+    the gradient-merge substrate the pipeline loop rides)."""
+    out = {}
+    for n, v in feeds.items():
+        if v.shape[0] % M:
+            raise ValueError(
+                f"pipeline microbatching: feed {n!r} batch {v.shape[0]} "
+                f"not divisible by num_microbatches={M}")
+        out[n] = v.reshape((M, v.shape[0] // M) + tuple(v.shape[1:]))
+    return out
+
+
+def _check_pipe_fetches(env, fetch_names, what):
+    missing = [n for n in fetch_names if n not in env]
+    if missing:
+        from .errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            f"{what}: fetch target(s) {missing} are per-microbatch "
+            f"forward intermediates — under the microbatched/pipelined "
+            f"lowering only the loss, persistables and update-zone "
+            f"values are fetchable")
+
+
+def _lower_microbatched(ops, env, ctx, bw_idx, fetch_names,
+                        state_out_names):
+    """Microbatch-accumulation lowering (pipe_microbatches > 1, no pipe
+    mesh axis): scan the feeds in M slices through the whole forward,
+    differentiate the mean of the per-microbatch losses — grads come out
+    as ``(1/M) Σ_m g_m``, arithmetic-identical to
+    ``GradientMergeOptimizer`` accumulating the same microbatch stream
+    (bitwise at M = 2, where two-term addition order commutes exactly).
+    This is also the pipe = 1 degenerate of the 1F1B lowering: stage
+    cuts lower as identity, so the SAME pipelined program is its own
+    non-pipelined parity baseline."""
+    bw_op = ops[bw_idx]
+    fwd_ops = [op for op in ops[:bw_idx]]
+    tail_ops = ops[bw_idx + 1:]
+    attrs = bw_op.attrs
+    param_names = list(attrs["param_names"])
+    loss_name = attrs["loss_name"]
+    loss_scale = attrs.get("loss_scale", 1.0)
+    M = int(attrs["pipe_microbatches"])
+    feed_names = [n for n in attrs.get("pipe_feed_names", ()) if n in env]
+
+    pvals = {n: env[n] for n in param_names}
+    feeds = {n: env[n] for n in feed_names}
+    base_env = {k: v for k, v in env.items()
+                if k not in pvals and k not in feeds}
+    mb_feeds = _microbatch_feeds(feeds, M)
+
+    def fwd(p, key):
+        def body(k, mb):
+            k_step, k_next = jax.random.split(k)
+            sub = LoweringContext(k_step, ctx.mesh, ctx.axis_names,
+                                  ctx.is_test)
+            e = dict(base_env)
+            e.update(p)
+            e.update(mb)
+            e = run_ops(fwd_ops, e, sub)
+            return k_next, (jnp.sum(e[loss_name]) * loss_scale,
+                            e[loss_name])
+        k_final, (totals, losses) = jax.lax.scan(body, key, mb_feeds)
+        return jnp.mean(totals), (jnp.mean(losses, axis=0), k_final)
+
+    (_, (loss_val, new_key)), grads = jax.value_and_grad(
+        fwd, has_aux=True)(pvals, ctx.key)
+    ctx.key = new_key
+    env2 = dict(base_env)
+    env2.update(feeds)
+    env2.update(pvals)
+    env2[loss_name] = loss_val
+    for n in param_names:
+        env2[grad_var_name(n)] = grads[n]
+    env2[grad_var_name(loss_name)] = jnp.ones_like(loss_val)
+    env2 = run_ops(tail_ops, env2, ctx)
+    _check_pipe_fetches(env2, fetch_names, "microbatched lowering")
+    return env2
+
+
+def _lower_pipelined_1f1b(ops, env, ctx, bw_idx, fetch_names,
+                          state_out_names):
+    """1F1B pipeline lowering over the ``pp`` mesh axis.
+
+    The program's forward was partitioned by framework/pipe.py into S
+    stage segments separated by ``pipe_stage_boundary`` markers.  Every
+    pipe rank runs ONE ``lax.switch`` branch per scheduled tick — its
+    own stage — following the static 1F1B tables
+    (``pipe.schedule_1f1b``): warm-up forwards capped at ``S − s``
+    in-flight microbatches, then strict one-forward-one-backward
+    alternation.  Boundary activations hop stage→stage+1 and cotangents
+    hop stage→stage−1 with one ``lax.ppermute`` each per tick.
+
+    A backward tick RECOMPUTES its stage's forward from the saved stage
+    input (``jax.vjp`` at the tick — activation recompute is built into
+    the schedule), so per-device in-flight state is the saved boundary
+    ring (≤ S microbatch inputs) + one stage's residuals during its
+    backward — the 1F1B memory contract the static estimator prices.
+    Parameter cotangents accumulate into per-rank buffers (each rank
+    only produces its own stage's — the rest stay zero); the pipe-axis
+    fused all-reduce framework/pipe.py inserted after the backward op
+    reconstructs the full gradient, and the ordinary data-axis grad
+    sync / ZeRO-1 / quantized tiers ride the tail untouched."""
+    bw_op = ops[bw_idx]
+    attrs = bw_op.attrs
+    S = int(attrs["pipe_stages"])
+    M = int(attrs["pipe_microbatches"])
+    axis = attrs.get("pipe_axis", "pp")
+    boundaries = [list(b) for b in attrs["pipe_boundaries"]]
+    param_names = list(attrs["param_names"])
+    loss_name = attrs["loss_name"]
+    loss_scale = attrs.get("loss_scale", 1.0)
+    feed_names = [n for n in attrs.get("pipe_feed_names", ()) if n in env]
+    tail_ops = ops[bw_idx + 1:]
+
+    from .jax_compat import axis_size
+    n_pp = axis_size(axis)
+    if n_pp != S:
+        raise ValueError(
+            f"pipelined program has {S} stages but the {axis!r} mesh "
+            f"axis has size {n_pp}")
+
+    segments = [[] for _ in range(S)]
+    for op in ops[:bw_idx]:
+        if op.type == "pipe_stage_boundary":
+            continue
+        segments[int(op.attrs.get("_pipe_stage", 0))].append(op)
+    b_union: List[str] = []
+    for names in boundaries:
+        for n in names:
+            if n not in b_union:
+                b_union.append(n)
+
+    pvals = {n: env[n] for n in param_names}
+    feeds = {n: env[n] for n in feed_names}
+    base_env = {k: v for k, v in env.items()
+                if k not in pvals and k not in feeds}
+    mb_feeds = _microbatch_feeds(feeds, M)
+    mb0 = {n: v[0] for n, v in mb_feeds.items()}
+    base_key = ctx.key
+
+    def stage_fn(s, p, f, bnd_in, key):
+        """One stage's segment on one microbatch: (boundary out, loss
+        seed, loss var) — loss only materialises on the last stage."""
+        e = dict(base_env)
+        e.update(p)
+        e.update(f)
+        for n in (boundaries[s - 1] if s > 0 else ()):
+            e[n] = bnd_in[n]
+        sub = LoweringContext(key, ctx.mesh, ctx.axis_names, ctx.is_test)
+        e = run_ops(segments[s], e, sub)
+        out = {n: e[n] for n in (boundaries[s] if s < S - 1 else ())}
+        if s == S - 1:
+            lvar = e[loss_name]
+            total = jnp.sum(lvar) * loss_scale
+        else:
+            lvar, total = None, jnp.asarray(0.0, jnp.float32)
+        return out, total, lvar
+
+    # boundary/loss buffer shapes: abstract-eval one microbatch through
+    # the whole forward (no compile, no device work)
+    def probe(p, f, key):
+        e = dict(base_env)
+        e.update(p)
+        e.update(f)
+        sub = LoweringContext(key, ctx.mesh, ctx.axis_names, ctx.is_test)
+        for seg in segments:
+            e = run_ops(seg, e, sub)
+        return {n: e[n] for n in b_union}, e[loss_name]
+
+    bshapes, lshape = jax.eval_shape(probe, pvals, mb0, base_key)
+
+    def zeros_of(sd):
+        return jnp.zeros(sd.shape, sd.dtype)
+
+    from .pipe import schedule_1f1b
+    sch = schedule_1f1b(S, M)
+    W = int(sch["slots"])
+    fwd_tbl = jnp.asarray(np.array(sch["fwd"], dtype=np.int32))
+    bwd_tbl = jnp.asarray(np.array(sch["bwd"], dtype=np.int32))
+    arr_tbl = jnp.asarray(np.array(sch["arrive"], dtype=np.int32))
+
+    def mb_key(i, s):
+        # deterministic per (microbatch, stage): the backward tick's
+        # recompute replays the forward tick's randomness exactly
+        return jax.random.fold_in(jax.random.fold_in(base_key, i), s)
+
+    def make_branch(s):
+        seg_in = boundaries[s - 1] if s > 0 else []
+        seg_out = boundaries[s] if s < S - 1 else []
+        last = s == S - 1
+
+        def branch(carry, frow, brow, arow):
+            saved, bnd_in, ct_in, acc, lvar_sum = carry
+            # 1) store the arriving stage input into the saved ring
+            if s > 0:
+                ai = arow[s]
+                slot = jnp.clip(ai, 0, M - 1) % W
+                store = ai >= 0
+                saved = {
+                    n: jnp.where(
+                        store,
+                        jax.lax.dynamic_update_index_in_dim(
+                            saved[n], bnd_in[n], slot, 0),
+                        saved[n])
+                    for n in b_union}
+            # 2) backward unit (priority slot of the 1F1B alternation):
+            #    recompute this stage's forward from the saved input,
+            #    pull the downstream cotangent through it
+            j = brow[s]
+            jj = jnp.clip(j, 0, M - 1)
+            f_j = {n: v[jj] for n, v in mb_feeds.items()}
+            bnd_j = {n: saved[n][jj % W] for n in seg_in}
+
+            def f_vjp(p_, bnd_):
+                out, total, _ = stage_fn(s, p_, f_j, bnd_, mb_key(jj, s))
+                return out, total
+
+            (_, _), vjp_fn = jax.vjp(f_vjp, pvals, bnd_j)
+            ct_out = {n: ct_in[n] for n in seg_out}
+            seed = jnp.asarray(1.0 / M, jnp.float32) if last \
+                else jnp.asarray(0.0, jnp.float32)
+            dp, dbnd = vjp_fn((ct_out, seed))
+            valid_b = j >= 0
+            acc = {n: acc[n] + jnp.where(valid_b, dp[n].astype(
+                acc[n].dtype), jnp.zeros_like(acc[n]))
+                for n in acc}
+            ct_send = {
+                n: (jnp.where(valid_b, dbnd[n].astype(bshapes[n].dtype),
+                              zeros_of(bshapes[n]))
+                    if n in dbnd else zeros_of(bshapes[n]))
+                for n in b_union}
+            # 3) forward unit
+            i = frow[s]
+            ii = jnp.clip(i, 0, M - 1)
+            f_i = {n: v[ii] for n, v in mb_feeds.items()}
+            bnd_i = {n: saved[n][ii % W] for n in seg_in}
+            out_i, _, lvar_i = stage_fn(s, pvals, f_i, bnd_i,
+                                        mb_key(ii, s))
+            valid_f = i >= 0
+            bnd_send = {
+                n: (jnp.where(valid_f, out_i[n].astype(bshapes[n].dtype),
+                              zeros_of(bshapes[n]))
+                    if n in out_i else zeros_of(bshapes[n]))
+                for n in b_union}
+            if last:
+                lvar_sum = lvar_sum + jnp.where(
+                    valid_f, lvar_i.astype(lvar_sum.dtype),
+                    jnp.zeros_like(lvar_sum))
+            return saved, acc, lvar_sum, bnd_send, ct_send
+
+        return branch
+
+    branches = [make_branch(s) for s in range(S)]
+    idx = jax.lax.axis_index(axis)
+    perm_down = [(i, i + 1) for i in range(S - 1)]
+    perm_up = [(i + 1, i) for i in range(S - 1)]
+
+    def tick(carry, rows):
+        frow, brow, arow = rows
+        saved, acc, lvar_sum, bnd_send, ct_send = jax.lax.switch(
+            idx, branches, carry, frow, brow, arow)
+        bnd_in = {n: jax.lax.ppermute(bnd_send[n], axis, perm_down)
+                  for n in b_union}
+        ct_in = {n: jax.lax.ppermute(ct_send[n], axis, perm_up)
+                 for n in b_union}
+        return (saved, bnd_in, ct_in, acc, lvar_sum), None
+
+    init = (
+        {n: jnp.zeros((W,) + tuple(bshapes[n].shape), bshapes[n].dtype)
+         for n in b_union},
+        {n: zeros_of(bshapes[n]) for n in b_union},
+        {n: zeros_of(bshapes[n]) for n in b_union},
+        {n: jnp.zeros(v.shape, v.dtype) for n, v in pvals.items()},
+        jnp.zeros(lshape.shape, lshape.dtype),
+    )
+    (_, _, _, acc, lvar_sum), _ = jax.lax.scan(
+        tick, init, (fwd_tbl, bwd_tbl, arr_tbl))
+
+    # only the last pipe rank accumulated the loss (zeros elsewhere) —
+    # the psum broadcasts it; grads stay stage-partial here, summed by
+    # the pipe-axis fused all-reduce in the tail
+    lvar_mean = jax.lax.psum(lvar_sum, axis) / M
+    ctx.key = jax.random.split(base_key, 1)[0]
+    env2 = dict(base_env)
+    env2.update(feeds)
+    env2.update(pvals)
+    env2[loss_name] = lvar_mean
+    for n in param_names:
+        env2[grad_var_name(n)] = acc[n]
+    env2[grad_var_name(loss_name)] = jnp.ones_like(lvar_mean)
+    env2 = run_ops(tail_ops, env2, ctx)
+    _check_pipe_fetches(env2, fetch_names, "1F1B pipeline lowering")
+    return env2
+
+
 def lower_block_with_backward(ops, env, ctx, bw_idx, fetch_names,
                               state_out_names):
     """Lower [forward ops][backward meta-op][update ops] with value_and_grad."""
     bw_op = ops[bw_idx]
+    pipe_S = int(bw_op.attrs.get("pipe_stages") or 1)
+    pipe_M = int(bw_op.attrs.get("pipe_microbatches") or 1)
+    pipe_axis = bw_op.attrs.get("pipe_axis") or "pp"
+    if pipe_S > 1 and ctx.axis_names and pipe_axis in ctx.axis_names:
+        return _lower_pipelined_1f1b(ops, env, ctx, bw_idx, fetch_names,
+                                     state_out_names)
+    if pipe_M > 1:
+        # pipelined program on a mesh WITHOUT the pipe axis (pipe = 1
+        # degenerate), or the bare microbatch-accumulation substrate
+        return _lower_microbatched(ops, env, ctx, bw_idx, fetch_names,
+                                   state_out_names)
     fwd_ops = ops[:bw_idx]
     tail_ops = ops[bw_idx + 1:]
     param_names = list(bw_op.attrs["param_names"])

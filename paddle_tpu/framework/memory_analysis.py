@@ -538,23 +538,34 @@ def analyze_memory(program: Program, feed_shapes=None,
 
     if bw_idx is not None:
         # ---- training step: peak sits at the backward sweep ------------
-        checkpoints = set(ops[bw_idx].attrs.get("checkpoints") or ())
+        bw_attrs = ops[bw_idx].attrs
+        checkpoints = set(bw_attrs.get("checkpoints") or ())
+        pipe_S = int(bw_attrs.get("pipe_stages") or 1)
+        pipe_M = int(bw_attrs.get("pipe_microbatches") or 1)
         aliases = _AliasSets()
         fwd_names: Dict[str, int] = {}
+        def_pos: Dict[str, int] = {}
+        last_read: Dict[str, int] = {}
+        internal_per_op: List[int] = []
         internal = 0
         for idx, op in enumerate(ops[:bw_idx]):
             outs = op.output_names()
+            for n in op_reads_recursive(op):
+                last_read[n] = idx
             # a ZeRO-3 on-demand gather rebuilds the FULL parameter —
             # replicated across the batch axes, so never divided by the
             # activation (batch/seq) sharding
             is_gather = op.type == "fsdp_all_gather"
             for n in outs:
+                def_pos.setdefault(n, idx)
                 v = block._find_var_recursive(n)
                 if v is not None and v.persistable:
                     continue
                 fwd_names.setdefault(
                     n, var_bytes(n, activation=not is_gather))
-            internal += _op_backward_extra(op, env) // act_div
+            extra = _op_backward_extra(op, env) // act_div
+            internal_per_op.append(extra)
+            internal += extra
             ins = op.input_names()
             if outs and ins and _op_transparent(op.type):
                 # ALL outputs join the input's class (a dropout's Out AND
@@ -570,19 +581,67 @@ def analyze_memory(program: Program, feed_shapes=None,
             if cur is None or b > cur[0]:
                 classes[r] = (b, n)
         if checkpoints:
-            # recompute segments: only checkpointed values persist to the
-            # backward sweep; everything else re-materialises per segment
-            kept = {r: (b, n) for r, (b, n) in classes.items()
-                    if n in checkpoints or aliases.find(n) in
-                    {aliases.find(c) for c in checkpoints if c in fwd_names}}
+            # recompute segments (jax.checkpoint over the op list,
+            # executor._segment_at_checkpoints): what survives to the
+            # backward sweep is each segment's INPUT live set — the
+            # residual classes live across a segment boundary — plus the
+            # checkpoint markers themselves; everything interior to a
+            # segment re-materialises during its backward
+            cuts = sorted({def_pos[c] + 1 for c in checkpoints
+                           if c in def_pos})
+            kept_roots = set()
+            for n in fwd_names:
+                d = def_pos.get(n)
+                lu = last_read.get(n, -1)
+                if n in checkpoints or (
+                        d is not None and
+                        any(d < c <= lu for c in cuts)):
+                    kept_roots.add(aliases.find(n))
+            kept = {r: v for r, v in classes.items() if r in kept_roots}
             dropped = sum(b for r, (b, n) in classes.items()
                           if r not in kept)
             est.notes.append(
                 f"recompute checkpoints: {len(checkpoints)} boundaries, "
                 f"{dropped / (1 << 20):.2f} MiB of residuals not retained")
             classes = kept or classes
+            if cuts:
+                # one segment's op-internal extras (attention probs, CE
+                # logit copies) are live at a time during its recompute
+                edges = [0] + cuts + [len(internal_per_op)]
+                internal = max(
+                    sum(internal_per_op[a:b])
+                    for a, b in zip(edges, edges[1:])) if internal_per_op \
+                    else 0
         est.residual_bytes = sum(b for b, _ in classes.values())
         est.internal_bytes = internal
+        pipe_inflight = 0
+        if pipe_S > 1 and pipe_M >= 1:
+            # 1F1B lowering: each backward tick recomputes its stage's
+            # forward from the saved stage input, so per-device residual
+            # state is ONE stage's classes at ONE microbatch, plus the
+            # saved boundary ring (≤ pipe_S microbatch inputs per stage)
+            # and the two in-transit carries (boundary + cotangent)
+            stage_bytes: Dict[int, int] = {}
+            for r, (b, n) in classes.items():
+                iv = liveness.get(n)
+                op = iv.def_op if iv is not None else None
+                s = int(op.attrs.get("_pipe_stage", 0)) \
+                    if op is not None else 0
+                stage_bytes[s] = stage_bytes.get(s, 0) + b
+            est.residual_bytes = max(stage_bytes.values()) // pipe_M \
+                if stage_bytes else 0
+            est.internal_bytes = internal // pipe_M
+            bnd = 0
+            for names in bw_attrs.get("pipe_boundaries") or ():
+                for n in names:
+                    bnd += var_bytes(n, activation=True)
+            pipe_inflight = (pipe_S + 2) * bnd // max(pipe_M, 1)
+            est.notes.append(
+                f"pipeline {pipe_S} stages x {pipe_M} microbatches: "
+                f"max-stage residual "
+                f"{est.residual_bytes / (1 << 20):.2f} MiB per "
+                f"microbatch + {pipe_inflight / (1 << 20):.2f} MiB "
+                f"in-flight boundary state")
         # grad-sync collectives after the backward op keep BOTH their
         # source and result buffers live (a psum cannot update in place;
         # a reduce_scatter's full-grad input coexists with its 1/n
@@ -592,6 +651,13 @@ def analyze_memory(program: Program, feed_shapes=None,
         # grad-sync zone the gradient set contributes no extra term.
         scatter_ops = {"zero_reduce_scatter", "quant_reduce_scatter",
                        "c_reducescatter", "reduce_scatter"}
+        # each gradient buffer counts at most once as a collective
+        # SOURCE and once as a RESULT across the whole grad-sync zone —
+        # a chain of collectives over the same name (the pipe-axis sum
+        # feeding the data-axis sync) reuses the same two buffers, it
+        # does not stack a fresh pair per hop
+        seen_in: set = set()
+        seen_out: set = set()
         for op in ops[bw_idx + 1:]:
             spec = OP_SPECS.get(op.type)
             if spec is None or not spec.collective:
@@ -599,10 +665,16 @@ def analyze_memory(program: Program, feed_shapes=None,
             axes = op.attrs.get("_axis_name")
             axes = (axes,) if isinstance(axes, str) else tuple(axes or ())
             for n in op.input_names():
+                if n in seen_in:
+                    continue
+                seen_in.add(n)
                 v = block._find_var_recursive(n)
                 if v is None or not v.persistable:
                     est.grad_bytes += var_bytes(n)
             for n in op.output_names():
+                if n in seen_out:
+                    continue
+                seen_out.add(n)
                 v = block._find_var_recursive(n)
                 if v is None or not v.persistable:
                     b = var_bytes(n)
@@ -629,7 +701,8 @@ def analyze_memory(program: Program, feed_shapes=None,
                 est.wire_logical_bytes += logical
                 est.wire_bytes += wire
         est.transient_bytes = int(RESIDUAL_FACTOR * est.residual_bytes
-                                  + est.internal_bytes + est.grad_bytes)
+                                  + est.internal_bytes + est.grad_bytes
+                                  + pipe_inflight)
         est.peak_op_idx = bw_idx
         # top-k live at the peak: params/state + residual classes
         for n in state_in:
@@ -844,8 +917,8 @@ def check_hbm_budget(program: Program, feed_shapes=None,
     budget: an over-budget program is rejected in milliseconds with the
     top live tensors and their creation sites, not after a multi-minute
     XLA compile with an opaque HLO buffer name."""
+    from ..flags import flag
     if budget_gb is None:
-        from ..flags import flag
         budget_gb = float(flag("hbm_budget_gb") or 0.0)
     if not budget_gb or budget_gb <= 0:
         return None
@@ -853,6 +926,31 @@ def check_hbm_budget(program: Program, feed_shapes=None,
                          fetch_names=fetch_names, mesh_axes=mesh_axes,
                          batch_axis=batch_axis, seq_axis=seq_axis,
                          feed_specs=feed_specs, donate_state=donate_state)
+    if est.peak_gb > budget_gb and flag("remat_on_reject"):
+        # the rematerialization escape hatch (framework/pipe.py): insert
+        # recompute checkpoints at the liveness-identified residual
+        # minima instead of failing — the memory/compute trade is priced
+        # (recompute FLOPs delta via the op_spec flops channel) and the
+        # program only raises when even the deepest recompute plan still
+        # exceeds the budget
+        from .pipe import apply_remat, plan_remat
+        plan = plan_remat(program, feed_shapes=feed_shapes,
+                          fetch_names=fetch_names, mesh_axes=mesh_axes,
+                          batch_axis=batch_axis, seq_axis=seq_axis,
+                          budget_gb=budget_gb, donate_state=donate_state)
+        if plan is not None and plan.fits:
+            apply_remat(program, plan)
+            est = analyze_memory(program, feed_shapes=feed_shapes,
+                                 fetch_names=fetch_names,
+                                 mesh_axes=mesh_axes,
+                                 batch_axis=batch_axis, seq_axis=seq_axis,
+                                 feed_specs=feed_specs,
+                                 donate_state=donate_state)
+            est.notes.append(
+                f"remat_on_reject: inserted {len(plan.checkpoints)} "
+                f"recompute checkpoint(s) "
+                f"(+{plan.flops_delta / 1e9:.3f} GFLOP recompute) to fit "
+                f"hbm_budget_gb={budget_gb:g}")
     if est.peak_gb > budget_gb:
         raise InvalidArgumentError(
             f"program exceeds hbm_budget_gb={budget_gb:g}: static "
@@ -1013,7 +1111,8 @@ def collective_wire_summary(program: Program, feed_shapes=None,
 
 def exposed_comm_model(wire_summary, flops_total, num_devices=1,
                        overlap=False, has_backward=True,
-                       ici_gbps=None, peak_flops=None) -> Dict[str, Any]:
+                       ici_gbps=None, peak_flops=None,
+                       bubble_frac=0.0) -> Dict[str, Any]:
     """Static step-time roofline for one program/config: how much
     collective wire time is EXPOSED (not hidden under compute).
 
@@ -1021,15 +1120,24 @@ def exposed_comm_model(wire_summary, flops_total, num_devices=1,
                      max(0, grad_sync_wire_time − overlappable_compute)``
 
     where ``overlappable_compute`` is the backward sweep's compute time
-    (2/3 of the 3× fwd+bwd GEMM total the PR 9 ``flops`` channel
-    prices) when the grad sync is overlap-scheduled
-    (``strategy.overlap_grad_sync``), else 0 — a tail-fused schedule
-    hides nothing.  Forward collectives (Megatron f/g, un-prefetched
-    fsdp gathers) serialise with compute by data dependence and count
-    exposed.  Wire time = bytes / (``flag("ici_gbps")`` · 1e9); peak
-    FLOPs from the device table (``flag("device_peak_flops")``
-    override).  Only the RANKING between configs consumes this, so
-    ordering fidelity matters more than absolute accuracy."""
+    — ``flag("overlap_compute_frac")`` of the 3× fwd+bwd GEMM total the
+    PR 9 ``flops`` channel prices; the default 2/3 preserves the
+    historical constant bit-for-bit, and the measured-cost calibration
+    loop can refit it from telemetry — when the grad sync is
+    overlap-scheduled (``strategy.overlap_grad_sync``), else 0 — a
+    tail-fused schedule hides nothing.  Forward collectives (Megatron
+    f/g, un-prefetched fsdp gathers) serialise with compute by data
+    dependence and count exposed.  Wire time = bytes /
+    (``flag("ici_gbps")`` · 1e9); peak FLOPs from the device table
+    (``flag("device_peak_flops")`` override).
+
+    ``bubble_frac`` prices a 1F1B pipeline's idle bubble — the canonical
+    ``(pipe − 1) / num_microbatches`` fraction of the busy step: the
+    model charges ``pipe_bubble_s = bubble_frac × (compute_s +
+    exposed)`` on top, and the planner ranks by the total ``cost_s``.
+    0 (the default, every non-pipelined config) leaves all historical
+    rankings unchanged.  Only the RANKING between configs consumes this
+    model, so ordering fidelity matters more than absolute accuracy."""
     from ..flags import flag
     from ..observability import flops as _flops
     bw = float(ici_gbps if ici_gbps is not None
@@ -1037,20 +1145,27 @@ def exposed_comm_model(wire_summary, flops_total, num_devices=1,
     peak = float(peak_flops) if peak_flops else _flops.device_peak_flops()
     per_dev = float(flops_total or 0.0) / max(int(num_devices or 1), 1)
     compute_s = per_dev / peak if peak > 0 else 0.0
-    bwd_compute_s = compute_s * (2.0 / 3.0) if has_backward else 0.0
+    frac = float(flag("overlap_compute_frac"))
+    bwd_compute_s = compute_s * frac if has_backward else 0.0
     grad_wire_s = wire_summary.get("grad_sync_wire_bytes", 0) / bw
     fwd_wire_s = wire_summary.get("forward_wire_bytes", 0) / bw
     hidden_s = min(grad_wire_s, bwd_compute_s) if overlap else 0.0
+    exposed_s = fwd_wire_s + grad_wire_s - hidden_s
+    bubble_s = float(bubble_frac or 0.0) * (compute_s + exposed_s)
     return {
         "ici_gbps": bw / 1e9,
         "peak_flops": peak,
         "compute_s": compute_s,
+        "overlap_compute_frac": frac,
         "overlappable_compute_s": bwd_compute_s if overlap else 0.0,
         "wire_time_s": fwd_wire_s + grad_wire_s,
         "grad_sync_wire_s": grad_wire_s,
         "forward_wire_s": fwd_wire_s,
         "hidden_s": hidden_s,
-        "exposed_comm_s": fwd_wire_s + grad_wire_s - hidden_s,
+        "exposed_comm_s": exposed_s,
+        "bubble_frac": float(bubble_frac or 0.0),
+        "pipe_bubble_s": bubble_s,
+        "cost_s": exposed_s + bubble_s,
     }
 
 

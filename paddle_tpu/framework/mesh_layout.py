@@ -37,10 +37,11 @@ from __future__ import annotations
 from typing import Any, Dict, Iterable, Optional, Tuple
 
 #: canonical axis names (the SpecLayout convention, keyed to this
-#: codebase's existing "dp"/"tp" spellings)
+#: codebase's existing "dp"/"tp"/"pp" spellings)
 DATA_AXIS = "dp"
 FSDP_AXIS = "fsdp"
 TP_AXIS = "tp"
+PIPE_AXIS = "pp"
 
 
 def _flat_axes(entries) -> Tuple[str, ...]:
@@ -149,14 +150,21 @@ class MeshLayout:
     """
 
     def __init__(self, data: int = 1, fsdp: int = 1, tp: int = 1,
+                 pipe: int = 1,
                  extra_axes: Optional[Dict[str, int]] = None,
                  data_axis: str = DATA_AXIS, fsdp_axis: str = FSDP_AXIS,
-                 tp_axis: str = TP_AXIS):
+                 tp_axis: str = TP_AXIS, pipe_axis: str = PIPE_AXIS):
         self.data_axis, self.fsdp_axis, self.tp_axis = \
             data_axis, fsdp_axis, tp_axis
+        self.pipe_axis = pipe_axis
         self._sizes: Dict[str, int] = {data_axis: int(data),
                                        fsdp_axis: int(fsdp),
                                        tp_axis: int(tp)}
+        if int(pipe) != 1:
+            # the pipe axis joins the layout only when real, so a
+            # pipe-less layout keeps the exact (data, fsdp, tp) sizes
+            # dict every pre-pipe artifact/serialization recorded
+            self._sizes[pipe_axis] = int(pipe)
         for k, v in (extra_axes or {}).items():
             self._sizes[str(k)] = int(v)
         for name, size in self._sizes.items():
@@ -175,6 +183,10 @@ class MeshLayout:
     @property
     def tp(self) -> int:
         return self._sizes[self.tp_axis]
+
+    @property
+    def pipe(self) -> int:
+        return self._sizes.get(self.pipe_axis, 1)
 
     @property
     def sizes(self) -> Dict[str, int]:
@@ -269,7 +281,7 @@ class MeshLayout:
     def to_desc(self) -> Dict[str, Any]:
         return {"axes": [[a, int(n)] for a, n in self._sizes.items()],
                 "data_axis": self.data_axis, "fsdp_axis": self.fsdp_axis,
-                "tp_axis": self.tp_axis}
+                "tp_axis": self.tp_axis, "pipe_axis": self.pipe_axis}
 
     @classmethod
     def from_desc(cls, d) -> "MeshLayout":
@@ -279,24 +291,29 @@ class MeshLayout:
         da = d.get("data_axis", DATA_AXIS)
         fa = d.get("fsdp_axis", FSDP_AXIS)
         ta = d.get("tp_axis", TP_AXIS)
-        extra = {a: n for a, n in axes.items() if a not in (da, fa, ta)}
+        pa = d.get("pipe_axis", PIPE_AXIS)
+        extra = {a: n for a, n in axes.items()
+                 if a not in (da, fa, ta, pa)}
         return cls(data=axes.get(da, 1), fsdp=axes.get(fa, 1),
-                   tp=axes.get(ta, 1), extra_axes=extra,
-                   data_axis=da, fsdp_axis=fa, tp_axis=ta)
+                   tp=axes.get(ta, 1), pipe=axes.get(pa, 1),
+                   extra_axes=extra,
+                   data_axis=da, fsdp_axis=fa, tp_axis=ta, pipe_axis=pa)
 
     def __eq__(self, other):
         return isinstance(other, MeshLayout) and \
             self._sizes == other._sizes and \
-            (self.data_axis, self.fsdp_axis, self.tp_axis) == \
-            (other.data_axis, other.fsdp_axis, other.tp_axis)
+            (self.data_axis, self.fsdp_axis, self.tp_axis,
+             self.pipe_axis) == \
+            (other.data_axis, other.fsdp_axis, other.tp_axis,
+             other.pipe_axis)
 
     def __hash__(self):
         return hash((tuple(self._sizes.items()), self.data_axis,
-                     self.fsdp_axis, self.tp_axis))
+                     self.fsdp_axis, self.tp_axis, self.pipe_axis))
 
     def __repr__(self):
         return f"MeshLayout({self._sizes})"
 
 
 __all__ = ["ShardSpec", "MeshLayout", "DATA_AXIS", "FSDP_AXIS", "TP_AXIS",
-           "_flat_axes"]
+           "PIPE_AXIS", "_flat_axes"]

@@ -1,0 +1,673 @@
+"""Pipeline parallelism + activation rematerialization as Program rewrites.
+
+The planner (framework/shard_planner.py) searches (data, fsdp, tp) — the
+two remaining memory/compute levers for pod-scale models are pipeline
+stages and activation recompute, and both are PROGRAM-level decisions the
+static layer can already price:
+
+* **stage cuts** — the liveness analyzer (memory_analysis.block_liveness)
+  knows every tensor's def/last-use, so the cost of cutting the forward
+  between op c−1 and op c is exactly the bytes of the live set crossing
+  c (the values one stage must hand the next, per microbatch).
+  :func:`plan_stage_cuts` picks the ``S−1`` cut points minimizing total
+  boundary bytes under a compute-balance constraint (per-op FLOPs from
+  the PR 9 op_spec ``flops`` channel), skipping positions that would
+  strand a collective from its producers (the
+  ``pipe-collective-crosses-stage`` hazard).
+* **the rewrite** — :func:`apply_pipeline` stamps every forward op with
+  ``_pipe_stage``, inserts a ``pipe_stage_boundary`` op at each cut
+  (in-place identity carrying a ``wire()`` spec: one ppermute hop per
+  microbatch each direction, so the census and the exposed-comm roofline
+  price the boundary traffic), stamps the 1F1B metadata on the
+  ``backward`` meta-op, and appends a fused ``c_allreduce_sum`` over the
+  pipe axis for every parameter gradient (each pipe rank produces only
+  its own stage's cotangents — the cross-stage sum is the pipeline's
+  grad sync, riding BEFORE the ordinary data-axis sync, with which it
+  commutes).
+* **the schedule** — :func:`schedule_1f1b` simulates the canonical
+  non-interleaved 1F1B order (warm-up forwards capped at ``S − s``
+  in-flight microbatches, then strict alternation, backward prioritized)
+  into static per-tick tables the executor's scan consumes and the
+  census artifact records.  Each backward tick RECOMPUTES its stage's
+  forward from the saved stage input (``jax.vjp`` at the tick), so
+  in-flight state is bounded by the saved boundary ring (≤ ``S``
+  microbatch inputs per stage) instead of one full residual set per
+  in-flight microbatch — the 1F1B memory contract.
+* **rematerialization** — :func:`plan_remat` turns an over-budget reject
+  into a fitting config: it picks recompute segment boundaries at the
+  liveness-identified minima (the cheapest-to-retain residual
+  frontiers), prices the recompute FLOPs delta with the ``flops``
+  channel, and re-runs the static HBM estimate with the candidate
+  ``checkpoints`` — the same ``backward.checkpoints`` attr the executor
+  already lowers with ``jax.checkpoint`` — choosing the fewest segments
+  that fit.
+
+Fluid mapping: the reference's ``PipelineOptimizer._split_program``
+(optimizer.py:3628) splits by hand-written ``device_guard`` annotations
+into section programs run by a thread per stage
+(framework/pipeline_trainer.cc, section_worker.cc); here the split is
+chosen automatically from liveness, the whole pipeline stays ONE SPMD
+program over the ``pp`` mesh axis, and the microbatch loop is a
+``lax.scan`` following the 1F1B tables (executor.py lowering).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from .core import Program, grad_var_name
+from .errors import InvalidArgumentError
+from .mesh_layout import PIPE_AXIS
+
+BOUNDARY_OP = "pipe_stage_boundary"
+
+#: ops whose outputs draw fresh randomness per execution — the set the
+#: ``remat-recompute-side-effect`` lint scans recompute regions for
+RNG_OP_TYPES = frozenset({
+    "dropout", "uniform_random", "gaussian_random",
+    "truncated_gaussian_random", "uniform_random_batch_size_like", "seed",
+})
+
+
+# ---------------------------------------------------------------------------
+# forward-region introspection
+# ---------------------------------------------------------------------------
+
+
+def _fwd_region(program: Program):
+    """(block, exec_ops, bw_idx): the executor's op space (feed/fetch
+    filtered) and the backward meta-op index (None: inference)."""
+    block = program.global_block()
+    ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+    bw_idx = next((i for i, op in enumerate(ops)
+                   if op.type == "backward"), None)
+    return block, ops, bw_idx
+
+
+def _sig_env(program: Program, feed_shapes):
+    from .analysis import VerifyResult, infer_shapes
+    from .memory_analysis import _feed_sigs
+    feed_sigs = _feed_sigs(program, feed_shapes, 1)
+    scratch = VerifyResult(program)
+    env = infer_shapes(program, scratch, feed_names=list(feed_sigs),
+                       init_env=dict(feed_sigs))
+    return env, feed_sigs
+
+
+def _fwd_liveness(block, fwd_ops):
+    """(def_idx, last_use) per name over the FORWARD op list only —
+    sub-block reads count at the parent op (the closure contract)."""
+    from .analysis import op_reads_recursive
+    def_idx: Dict[str, int] = {}
+    last_use: Dict[str, int] = {}
+    for i, op in enumerate(fwd_ops):
+        for n in op_reads_recursive(op):
+            last_use[n] = i
+        for n in op.output_names():
+            def_idx.setdefault(n, i)
+    return def_idx, last_use
+
+
+def _per_op_flops(block, fwd_ops, env):
+    """GEMM-class FLOPs per forward op (0 for unpriced ops) via the
+    op_spec ``flops`` channel — the stage-balance weight."""
+    from ..ops.registry import OP_SPECS, VarSig
+
+    def sig_of(name):
+        s = env.get(name)
+        if s is not None and s.shape is not None:
+            return s
+        v = block._find_var_recursive(name)
+        if v is None:
+            return s
+        return VarSig(tuple(v.shape) or None, v.dtype)
+
+    out = []
+    for op in fwd_ops:
+        spec = OP_SPECS.get(op.type)
+        fn = getattr(spec, "flops", None) if spec is not None else None
+        f = 0.0
+        if fn is not None:
+            ins = {slot: [sig_of(n) for n in names]
+                   for slot, names in op.inputs.items()}
+            outs = {slot: [sig_of(n) for n in names]
+                    for slot, names in op.outputs.items()}
+            try:
+                f = float(fn(ins, outs, op.attrs) or 0.0)
+            except Exception:
+                f = 0.0
+        out.append(f)
+    return out
+
+
+def _boundary_at(block, fwd_ops, cut, def_idx, last_use, env, feed_sigs):
+    """(names, bytes) of the live set crossing ``cut`` (an index into the
+    forward op list: the cut sits between op cut−1 and op cut).  Feeds
+    and persistables are excluded — every stage holds them locally; only
+    produced activations ride the ppermute.  ``bytes`` is None when a
+    crossing tensor's shape is unknown (the cut is unusable — the
+    boundary buffer cannot be built)."""
+    from .memory_analysis import sig_bytes
+    names, total = [], 0
+    for n, d in def_idx.items():
+        lu = last_use.get(n, -1)
+        if not (d < cut <= lu):
+            continue
+        v = block._find_var_recursive(n)
+        if v is not None and (v.persistable or v.is_data):
+            continue
+        if n in feed_sigs:
+            continue
+        sig = env.get(n)
+        if sig is None or sig.shape is None or \
+                any(int(s) < 0 for s in sig.shape):
+            return names + [n], None
+        names.append(n)
+        total += sig_bytes(sig)
+    return sorted(names), total
+
+
+def _collective_forbidden(block, fwd_ops, def_idx):
+    """Cut positions that would strand a forward collective from one of
+    its producers (the collective would read a var defined in an earlier
+    stage — the ``pipe-collective-crosses-stage`` hazard): a collective
+    at index i reading a var defined at j forbids every cut in (j, i]."""
+    from ..ops.registry import OP_SPECS
+    forbidden = set()
+    for i, op in enumerate(fwd_ops):
+        spec = OP_SPECS.get(op.type)
+        if spec is None or not getattr(spec, "collective", False):
+            continue
+        for n in op.input_names():
+            j = def_idx.get(n)
+            if j is not None and j < i:
+                forbidden.update(range(j + 1, i + 1))
+    return forbidden
+
+
+# ---------------------------------------------------------------------------
+# stage-cut planning
+# ---------------------------------------------------------------------------
+
+
+class StageCutPlan:
+    """One planned S-way partition of the forward region."""
+
+    def __init__(self, cuts, boundaries, boundary_bytes, stage_flops,
+                 stage_ops, num_ops):
+        self.cuts = list(cuts)                    # S-1 indices, ascending
+        self.boundaries = [list(b) for b in boundaries]
+        self.boundary_bytes = [int(b) for b in boundary_bytes]
+        self.stage_flops = [float(f) for f in stage_flops]
+        self.stage_ops = [int(n) for n in stage_ops]
+        self.num_ops = int(num_ops)
+
+    @property
+    def num_stages(self) -> int:
+        return len(self.cuts) + 1
+
+    @property
+    def total_boundary_bytes(self) -> int:
+        return sum(self.boundary_bytes)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"num_stages": self.num_stages,
+                "cuts": list(self.cuts),
+                "boundaries": [list(b) for b in self.boundaries],
+                "boundary_bytes": list(self.boundary_bytes),
+                "total_boundary_bytes": self.total_boundary_bytes,
+                "stage_flops": list(self.stage_flops),
+                "stage_ops": list(self.stage_ops)}
+
+
+def plan_stage_cuts(program: Program, num_stages: int,
+                    feed_shapes=None,
+                    balance_tol: float = 0.35) -> StageCutPlan:
+    """Choose the ``num_stages − 1`` forward cut points minimizing total
+    live-tensor transfer bytes at the boundaries, subject to every
+    stage's FLOPs staying within ``(1 + balance_tol)`` of the even share
+    (relaxed geometrically when infeasible — a boundary-optimal but
+    grossly unbalanced pipeline is still better than no pipeline, and
+    the bubble term prices the imbalance the roofline can see)."""
+    S = int(num_stages)
+    block, ops, bw_idx = _fwd_region(program)
+    if bw_idx is None:
+        raise InvalidArgumentError(
+            "plan_stage_cuts: program has no backward op — pipeline "
+            "stages partition TRAINING programs (run minimize first)")
+    fwd_ops = ops[:bw_idx]
+    F = len(fwd_ops)
+    if S < 2:
+        raise InvalidArgumentError(f"plan_stage_cuts: num_stages={S} < 2")
+    if F < S:
+        raise InvalidArgumentError(
+            f"plan_stage_cuts: {F} forward op(s) cannot split into "
+            f"{S} stages")
+    env, feed_sigs = _sig_env(program, feed_shapes)
+    def_idx, last_use = _fwd_liveness(block, fwd_ops)
+    flops = _per_op_flops(block, fwd_ops, env)
+    # every op carries a floor weight so FLOPs-free stretches (embedding
+    # lookups, masks) still spread across stages
+    w = [f + 1.0 for f in flops]
+    prefix = np.concatenate([[0.0], np.cumsum(w)])
+    total = float(prefix[-1])
+
+    forbidden = _collective_forbidden(block, fwd_ops, def_idx)
+    cost: Dict[int, Tuple[List[str], int]] = {}
+    for c in range(1, F):
+        if c in forbidden:
+            continue
+        names, b = _boundary_at(block, fwd_ops, c, def_idx, last_use,
+                                env, feed_sigs)
+        if b is None:
+            continue                    # unknown-shape crossing tensor
+        cost[c] = (names, b)
+    if len(cost) < S - 1:
+        raise InvalidArgumentError(
+            f"plan_stage_cuts: only {len(cost)} legal cut position(s) "
+            f"for {S} stages (collective-producer spans and "
+            f"unknown-shape boundaries excluded)")
+
+    positions = sorted(cost)
+    tol = float(balance_tol)
+    for _ in range(8):
+        cap = (1.0 + tol) * total / S
+        # dp[k][c]: min boundary bytes splitting ops[0:c] into k stages
+        # with the k-th stage ending at cut c
+        INF = float("inf")
+        dp = [{0: 0.0}]
+        back: List[Dict[int, int]] = [{}]
+        feasible_ends = [0] + positions
+        for k in range(1, S):
+            row: Dict[int, float] = {}
+            brow: Dict[int, int] = {}
+            for c in positions:
+                best, arg = INF, None
+                for p, v in dp[k - 1].items():
+                    if p >= c:
+                        continue
+                    if prefix[c] - prefix[p] > cap:
+                        continue
+                    cand = v + cost[c][1]
+                    if cand < best:
+                        best, arg = cand, p
+                if arg is not None:
+                    row[c] = best
+                    brow[c] = arg
+            dp.append(row)
+            back.append(brow)
+        best, last = INF, None
+        for c, v in dp[S - 1].items():
+            if total - prefix[c] > cap:
+                continue
+            if v < best:
+                best, last = v, c
+        if last is not None:
+            cuts = [last]
+            k = S - 1
+            while k > 1:
+                last = back[k][last]
+                cuts.append(last)
+                k -= 1
+            cuts = sorted(cuts)
+            edges = [0] + cuts + [F]
+            return StageCutPlan(
+                cuts,
+                [cost[c][0] for c in cuts],
+                [cost[c][1] for c in cuts],
+                [float(prefix[b] - prefix[a] - (b - a))
+                 for a, b in zip(edges, edges[1:])],
+                [b - a for a, b in zip(edges, edges[1:])], F)
+        tol *= 1.8                       # relax the balance cap and retry
+    raise InvalidArgumentError(
+        f"plan_stage_cuts: no feasible {S}-stage partition of {F} "
+        f"forward ops (legal cuts at {positions[:16]}...)")
+
+
+# ---------------------------------------------------------------------------
+# the 1F1B schedule (static tables)
+# ---------------------------------------------------------------------------
+
+
+def schedule_1f1b(num_stages: int, num_microbatches: int) -> Dict[str, Any]:
+    """Simulate the canonical non-interleaved 1F1B schedule: stage ``s``
+    runs at most ``S − s`` in-flight microbatches (warm-up forwards),
+    then strictly alternates, backward prioritized as soon as the
+    downstream cotangent has arrived.  One work unit per stage per tick;
+    boundary/cotangent hops take one tick (ppermute latency).
+
+    Returns the static per-tick tables the executor's scan consumes —
+    ``fwd[t][s]`` / ``bwd[t][s]`` (microbatch index, −1 idle),
+    ``arrive[t][s]`` (microbatch whose stage input lands this tick) —
+    plus the saved-input ring size ``slots`` and the flattened
+    ``order`` census ``[(tick, stage, phase, microbatch), ...]``."""
+    S, M = int(num_stages), int(num_microbatches)
+    fwd_tick = [[None] * M for _ in range(S)]
+    bwd_tick = [[None] * M for _ in range(S)]
+    fwd_n = [0] * S
+    bwd_n = [0] * S
+    fwd_rows, bwd_rows = [], []
+    t = 0
+    while any(b < M for b in bwd_n) and t < 4 * (M + S) + 8:
+        frow, brow = [-1] * S, [-1] * S
+        for s in range(S):
+            j = bwd_n[s]
+            bwd_ready = j < M and (
+                (s == S - 1 and fwd_tick[s][j] is not None
+                 and fwd_tick[s][j] < t) or
+                (s < S - 1 and bwd_tick[s + 1][j] is not None
+                 and bwd_tick[s + 1][j] < t))
+            if bwd_ready:
+                brow[s] = j
+                bwd_tick[s][j] = t
+                bwd_n[s] += 1
+                continue
+            i = fwd_n[s]
+            fwd_ready = i < M and (fwd_n[s] - bwd_n[s]) < (S - s) and (
+                s == 0 or (fwd_tick[s - 1][i] is not None
+                           and fwd_tick[s - 1][i] < t))
+            if fwd_ready:
+                frow[s] = i
+                fwd_tick[s][i] = t
+                fwd_n[s] += 1
+        fwd_rows.append(frow)
+        bwd_rows.append(brow)
+        t += 1
+    if any(b < M for b in bwd_n):
+        raise AssertionError(
+            f"schedule_1f1b: simulation did not converge (S={S}, M={M})")
+    T = t
+    # stage-input arrivals: stage s's input for microbatch i lands one
+    # tick after stage s−1 produced it (stage 0 recomputes from feeds)
+    arrive = [[-1] * S for _ in range(T)]
+    for s in range(1, S):
+        for i in range(M):
+            ta = fwd_tick[s - 1][i] + 1
+            if ta < T:
+                arrive[ta][s] = i
+    # saved-input ring: slot i % W must be free when microbatch i + W
+    # arrives, i.e. bwd(s, i) strictly before arrive(s, i + W)
+    W = 1
+    for s in range(1, S):
+        for i in range(M):
+            need = 1
+            for k in range(i):
+                if bwd_tick[s][k] >= fwd_tick[s - 1][i] + 1:
+                    need = max(need, i - k + 1)
+            W = max(W, need)
+    W = min(max(W, 1), M) if M else 1
+    order = []
+    for tick in range(T):
+        for s in range(S):
+            if fwd_rows[tick][s] >= 0:
+                order.append((tick, s, "F", fwd_rows[tick][s]))
+            if bwd_rows[tick][s] >= 0:
+                order.append((tick, s, "B", bwd_rows[tick][s]))
+    return {"num_stages": S, "num_microbatches": M, "ticks": T,
+            "fwd": fwd_rows, "bwd": bwd_rows, "arrive": arrive,
+            "slots": W, "order": order,
+            "bubble_frac": (S - 1) / M if M else 0.0}
+
+
+# ---------------------------------------------------------------------------
+# the pipeline rewrite
+# ---------------------------------------------------------------------------
+
+
+def set_microbatches(program: Program, num_microbatches: int):
+    """Stamp the per-step microbatch-accumulation substrate WITHOUT
+    stage cuts: the executor scans the feeds in ``num_microbatches``
+    slices, accumulating ``(1/M) Σ grads`` — arithmetic-identical to
+    ``GradientMergeOptimizer`` over the same microbatch stream (the
+    gradient-merge × pipeline composition contract, bitwise at M = 2).
+    A pipelined program gets this automatically via
+    :func:`apply_pipeline`."""
+    block, ops, bw_idx = _fwd_region(program)
+    if bw_idx is None:
+        raise InvalidArgumentError(
+            "set_microbatches: program has no backward op")
+    M = int(num_microbatches)
+    if M < 1:
+        raise InvalidArgumentError(f"num_microbatches={M} < 1")
+    bw = ops[bw_idx]
+    bw.attrs["pipe_microbatches"] = M
+    bw.attrs["pipe_feed_names"] = sorted(
+        v.name for v in block.vars.values() if v.is_data)
+    program._bump_version()
+    return bw
+
+
+def apply_pipeline(program: Program, num_stages: int,
+                   num_microbatches: int, pipe_axis: str = PIPE_AXIS,
+                   feed_shapes=None,
+                   plan: Optional[StageCutPlan] = None) -> Dict[str, Any]:
+    """Rewrite ``program`` in place for ``num_stages``-way pipeline
+    parallelism over ``pipe_axis`` with a ``num_microbatches`` 1F1B
+    schedule.  Call AFTER ``optimizer.minimize`` (the backward op must
+    exist) and BEFORE ``CompiledProgram.with_mesh`` (whose data-axis
+    grad sync composes with — and commutes with — the pipe-axis sum
+    inserted here).  Idempotent per program.
+
+    The rewrite is metadata + boundary ops only; the actual microbatch
+    loop/1F1B scan happens at executor lowering, so the SAME program
+    runs unpipelined (stages sequential, microbatches still
+    accumulated) on a mesh without the pipe axis — the pipe = 1
+    degenerate the parity tests compare against."""
+    S = int(num_stages)
+    M = int(num_microbatches)
+    if M < 1:
+        raise InvalidArgumentError(f"num_microbatches={M} < 1")
+    block, ops, bw_idx = _fwd_region(program)
+    if bw_idx is None:
+        raise InvalidArgumentError(
+            "apply_pipeline: program has no backward op — pipeline "
+            "partitions TRAINING programs (run minimize first)")
+    bw = ops[bw_idx]
+    if bw.attrs.get("pipe_stages"):
+        return {"already_pipelined": True,
+                "num_stages": bw.attrs["pipe_stages"]}
+    if S < 2:
+        set_microbatches(program, M)
+        return {"num_stages": 1, "num_microbatches": M, "cuts": [],
+                "boundaries": [], "boundary_bytes": []}
+    if M % 1 or M < 1:
+        raise InvalidArgumentError(f"num_microbatches={M} invalid")
+    if bw.attrs.get("loss_scale_var"):
+        raise InvalidArgumentError(
+            "apply_pipeline: dynamic loss scaling (AMP fp16) does not "
+            "compose with the 1F1B lowering — use pure-bf16 AMP or "
+            "static loss_scale")
+    plan = plan or plan_stage_cuts(program, S, feed_shapes=feed_shapes)
+
+    fwd_ops = ops[:bw_idx]
+    edges = [0] + list(plan.cuts) + [len(fwd_ops)]
+    for s, (a, b) in enumerate(zip(edges, edges[1:])):
+        for op in fwd_ops[a:b]:
+            op.attrs["_pipe_stage"] = s
+
+    # boundary ops (descending cut order keeps earlier indices valid);
+    # in-place identity X→Out on the crossing names so every downstream
+    # reader is untouched — the ppermute hop happens in the scheduled
+    # lowering, and the op's wire() spec prices it statically
+    for i in reversed(range(len(plan.cuts))):
+        c = plan.cuts[i]
+        names = plan.boundaries[i]
+        pos = block.ops.index(fwd_ops[c])
+        block._insert_op(
+            pos, type=BOUNDARY_OP,
+            inputs={"X": list(names)}, outputs={"Out": list(names)},
+            attrs={"_axis_name": pipe_axis, "_pipe_cut": int(i),
+                   "_pipe_stage": int(i),
+                   "boundary_bytes": int(plan.boundary_bytes[i])})
+
+    bw.attrs["pipe_stages"] = S
+    bw.attrs["pipe_microbatches"] = M
+    bw.attrs["pipe_axis"] = pipe_axis
+    bw.attrs["pipe_boundaries"] = [list(b) for b in plan.boundaries]
+    bw.attrs["pipe_cuts"] = list(plan.cuts)
+    bw.attrs["pipe_feed_names"] = sorted(
+        v.name for v in block.vars.values() if v.is_data)
+
+    from .compiler import insert_pipe_grad_sync
+    sync_ops = insert_pipe_grad_sync(program, pipe_axis)
+    program._bump_version()
+    report = plan.as_dict()
+    report.update({"num_microbatches": M, "pipe_axis": pipe_axis,
+                   "grad_sync_ops": sync_ops,
+                   "schedule": schedule_1f1b(S, M)})
+    return report
+
+
+# ---------------------------------------------------------------------------
+# activation rematerialization
+# ---------------------------------------------------------------------------
+
+
+class RematPlan:
+    """A candidate recompute insertion: segment boundaries + pricing."""
+
+    def __init__(self, checkpoints, positions, num_segments, est_before,
+                 est_after, flops_delta, fits):
+        self.checkpoints = list(checkpoints)
+        self.positions = list(positions)
+        self.num_segments = int(num_segments)
+        self.est_before = est_before
+        self.est_after = est_after
+        self.flops_delta = float(flops_delta)
+        self.fits = bool(fits)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"checkpoints": list(self.checkpoints),
+                "positions": list(self.positions),
+                "num_segments": self.num_segments,
+                "peak_bytes_before": int(self.est_before.peak_bytes),
+                "peak_bytes_after": int(self.est_after.peak_bytes),
+                "recompute_flops_delta": self.flops_delta,
+                "fits": self.fits}
+
+
+def plan_remat(program: Program, feed_shapes=None,
+               fetch_names: Iterable[str] = (),
+               mesh_axes: Optional[Dict[str, int]] = None,
+               batch_axis=None, seq_axis=None,
+               budget_gb: Optional[float] = None,
+               donate_state: bool = True,
+               max_segments: int = 16) -> Optional[RematPlan]:
+    """Pick recompute ``checkpoints`` at the liveness-identified
+    residual minima and price the trade: retained peak HBM after vs the
+    forward-FLOPs delta of re-running every non-final segment once in
+    the backward sweep.  Segment counts are tried smallest-first
+    (2, 4, 8, …): the cheapest recompute that fits ``budget_gb`` wins;
+    with no budget — or nothing fitting — the deepest evaluated plan is
+    returned (caller reads ``fits``).  Returns None when the program has
+    no backward op or already carries checkpoints."""
+    from .memory_analysis import analyze_memory
+    block, ops, bw_idx = _fwd_region(program)
+    if bw_idx is None:
+        return None
+    bw = ops[bw_idx]
+    if bw.attrs.get("checkpoints"):
+        return None
+    fwd_ops = ops[:bw_idx]
+    F = len(fwd_ops)
+    if F < 4:
+        return None
+    env, feed_sigs = _sig_env(program, feed_shapes)
+    def_idx, last_use = _fwd_liveness(block, fwd_ops)
+    flops = _per_op_flops(block, fwd_ops, env)
+    fprefix = np.concatenate([[0.0], np.cumsum(flops)])
+
+    cost: Dict[int, int] = {}
+    for c in range(1, F):
+        # the checkpoint marker is an output of op c−1: segments end
+        # right after a checkpoint var is produced
+        if not fwd_ops[c - 1].output_names():
+            continue
+        names, b = _boundary_at(block, fwd_ops, c, def_idx, last_use,
+                                env, feed_sigs)
+        if b is None:
+            continue
+        cost[c] = b
+    if not cost:
+        return None
+    positions = sorted(cost)
+
+    kw = dict(feed_shapes=feed_shapes, fetch_names=list(fetch_names),
+              mesh_axes=mesh_axes, batch_axis=batch_axis,
+              seq_axis=seq_axis, donate_state=donate_state)
+    est_before = analyze_memory(program, **kw)
+
+    def pick(K):
+        """K−1 cut positions: the min-boundary candidate inside each
+        even-spacing window."""
+        chosen = []
+        for k in range(1, K):
+            center = k * F / K
+            half = max(F / (2 * K), 1.0)
+            window = [c for c in positions
+                      if center - half <= c <= center + half
+                      and c not in chosen]
+            if not window:
+                window = [c for c in positions if c not in chosen]
+                if not window:
+                    return None
+                window = [min(window, key=lambda c: abs(c - center))]
+            chosen.append(min(window, key=lambda c: (cost[c], c)))
+        return sorted(chosen)
+
+    best: Optional[RematPlan] = None
+    K = 2
+    while K <= min(int(max_segments), F):
+        cuts = pick(K)
+        if cuts is None:
+            break
+        markers = []
+        for c in cuts:
+            outs = fwd_ops[c - 1].output_names()
+            markers.append(outs[0])
+        clone = program.clone()
+        _, cops, cbw = _fwd_region(clone)
+        cops[cbw].attrs["checkpoints"] = list(markers)
+        est_after = analyze_memory(clone, **kw)
+        # every non-final segment's forward re-runs once in the
+        # backward sweep — the priced memory/compute trade
+        delta = float(fprefix[cuts[-1]])
+        fits = budget_gb is not None and \
+            est_after.peak_gb <= float(budget_gb)
+        cand = RematPlan(markers, cuts, K, est_before, est_after,
+                         delta, fits)
+        if fits:
+            return cand
+        if best is None or est_after.peak_bytes < \
+                best.est_after.peak_bytes:
+            best = cand
+        K *= 2
+    return best
+
+
+def apply_remat(program: Program, plan: RematPlan):
+    """Apply a :class:`RematPlan` to the real program: set the backward
+    op's ``checkpoints`` (the executor lowers the segments with
+    ``jax.checkpoint``) and stamp ``_folded_key`` on RNG ops inside the
+    recompute regions — the executor threads the segment RNG key
+    explicitly through ``jax.checkpoint``, so the replayed randomness is
+    deterministic (what the ``remat-recompute-side-effect`` lint
+    audits)."""
+    block, ops, bw_idx = _fwd_region(program)
+    if bw_idx is None:
+        raise InvalidArgumentError("apply_remat: no backward op")
+    bw = ops[bw_idx]
+    bw.attrs["checkpoints"] = list(plan.checkpoints)
+    last_cut = max(plan.positions) if plan.positions else 0
+    for op in ops[:last_cut]:
+        if op.type in RNG_OP_TYPES:
+            op.attrs["_folded_key"] = True
+    program._bump_version()
+    return bw
+
+
+__all__ = ["BOUNDARY_OP", "RNG_OP_TYPES", "StageCutPlan", "RematPlan",
+           "plan_stage_cuts", "schedule_1f1b", "apply_pipeline",
+           "set_microbatches", "plan_remat", "apply_remat"]

@@ -392,6 +392,64 @@ class ReshardPlan:
         t = self.transfers.get(name)
         return t.dst_shape if t is not None else None
 
+    # -- rank-local restore reads ----------------------------------------
+    def dst_block_rows(self, name: str, block: int
+                       ) -> Optional[Tuple[int, int]]:
+        """The GLOBAL dim-0 source row interval dst block ``block``
+        needs from the checkpoint.  On the host-side restore there are
+        no device collectives — dst block b's content is exactly its
+        slice of the (logically ordered) source rows: ``[b·h, (b+1)·h)``
+        with h the dst dim-0 block height; a ZeRO-1 flat repad clamps
+        to the logical numel (padding is appended, never interleaved).
+        None → the var has no dim-0 sharding for this block count (read
+        everything)."""
+        t = self.transfers.get(name)
+        if t is None or not t.shape:
+            return None
+        if t.flat:
+            n_dst = int(t.flat["n_dst"])
+            if block < 0 or block >= n_dst:
+                return None
+            h = int(t.flat["dst_pad"]) // n_dst
+            lo = block * h
+            hi = min((block + 1) * h, int(t.flat["numel"]))
+            return (lo, max(hi, lo))
+        d0 = t.dst_divs[0] if t.dst_divs else 1
+        if d0 <= 1 or block < 0 or block >= d0 or t.shape[0] % d0:
+            return None
+        h = t.shape[0] // d0
+        return (block * h, (block + 1) * h)
+
+    def dst_read_ranges(self, owned_blocks: Dict[str, Iterable[int]]
+                        ) -> Dict[str, List[Tuple[int, int]]]:
+        """Per-var merged GLOBAL dim-0 row ranges a process owning
+        ``owned_blocks[name]`` (dim-0 dst block indices) must read from
+        the checkpoint — what ``io._read_sharded_arrays`` turns into
+        byte-range reads.  Vars absent from ``owned_blocks`` (or with no
+        dim-0 sharding) are omitted: the reader falls back to reading
+        them whole."""
+        out: Dict[str, List[Tuple[int, int]]] = {}
+        for name, blocks in owned_blocks.items():
+            ivs = []
+            for b in blocks:
+                iv = self.dst_block_rows(name, int(b))
+                if iv is None:
+                    ivs = None
+                    break
+                if iv[1] > iv[0]:
+                    ivs.append(iv)
+            if not ivs:
+                continue
+            ivs.sort()
+            merged = [list(ivs[0])]
+            for lo, hi in ivs[1:]:
+                if lo <= merged[-1][1]:
+                    merged[-1][1] = max(merged[-1][1], hi)
+                else:
+                    merged.append([lo, hi])
+            out[name] = [tuple(iv) for iv in merged]
+        return out
+
     # -- pricing (the planner's cost model, reused) ----------------------
     def wire_summary(self) -> Dict[str, Any]:
         """A ``collective_wire_summary``-shaped dict so the existing

@@ -101,13 +101,44 @@ def legal_tp_degrees(program: Program, num_devices: int,
     return out
 
 
+def legal_pipe_degrees(program: Program, num_devices: int,
+                       max_pipe: Optional[int] = None) -> List[int]:
+    """pipe degrees the PROGRAM supports: 1 always; >1 only when a
+    backward op exists (pipeline partitions training programs) and the
+    degree leaves at least one forward op per stage.  ``max_pipe``
+    (default 1) is the search opt-in — the pipe dimension only
+    enumerates when the caller provides microbatching."""
+    cap = int(max_pipe or 1)
+    if cap <= 1:
+        return [1]
+    block = program.global_block()
+    ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+    bw_idx = next((i for i, op in enumerate(ops)
+                   if op.type == "backward"), None)
+    if bw_idx is None:
+        return [1]
+    out = []
+    for p in range(1, num_devices + 1):
+        if num_devices % p:
+            continue
+        if p > cap or p > bw_idx:
+            continue
+        out.append(p)
+    return out or [1]
+
+
 def enumerate_layouts(program: Program, num_devices: int,
-                      max_tp: Optional[int] = None) -> List[MeshLayout]:
-    """Every legal (data, fsdp, tp) MeshLayout for ``num_devices``."""
+                      max_tp: Optional[int] = None,
+                      max_pipe: Optional[int] = None) -> List[MeshLayout]:
+    """Every legal (data, fsdp, tp, pipe) MeshLayout for
+    ``num_devices`` (pipe > 1 only when ``max_pipe`` opts the pipeline
+    dimension in)."""
     layouts = []
-    for t in legal_tp_degrees(program, num_devices, max_tp=max_tp):
-        for d, f in _divisor_pairs(num_devices // t):
-            layouts.append(MeshLayout(data=d, fsdp=f, tp=t))
+    for p in legal_pipe_degrees(program, num_devices, max_pipe=max_pipe):
+        for t in legal_tp_degrees(program, num_devices // p,
+                                  max_tp=max_tp):
+            for d, f in _divisor_pairs(num_devices // p // t):
+                layouts.append(MeshLayout(data=d, fsdp=f, tp=t, pipe=p))
     return layouts
 
 
@@ -127,6 +158,8 @@ class PlanConfig:
         self.fits = True
         self.winner = False
         self.fsdp_report: Dict[str, Any] = {}
+        self.pipe_report: Dict[str, Any] = {}
+        self.remat_plan = None             # pipe.RematPlan (remat rows)
         self.error: Optional[str] = None
 
     @property
@@ -141,21 +174,46 @@ class PlanConfig:
     def exposed_comm_s(self) -> Optional[float]:
         return self.exposed.get("exposed_comm_s") if self.exposed else None
 
+    @property
+    def remat(self) -> bool:
+        return self.remat_plan is not None
+
+    @property
+    def cost_s(self) -> Optional[float]:
+        """The step-time ranking cost: exposed comm + the 1F1B bubble
+        (0 for every non-pipelined config, so pre-pipe rankings are
+        bit-identical)."""
+        if not self.exposed:
+            return None
+        return self.exposed.get("cost_s", self.exposed["exposed_comm_s"])
+
     def sort_key(self):
-        # min exposed comm (step-time roofline); ties → fewer total
-        # wire bytes, more data parallel, then less fsdp, less tp.
-        # Exposed time is rounded to ns so float noise can't shadow the
-        # deterministic byte tie-break.
-        exp = self.exposed_comm_s
-        return (round(exp * 1e9) if exp is not None else 2**62,
+        # min cost (exposed comm + pipe bubble — the step-time
+        # roofline); ties → fewer total wire bytes, more data parallel,
+        # then less fsdp, less tp, less pipe, remat-free first.  Cost is
+        # rounded to ns so float noise can't shadow the deterministic
+        # byte tie-break.
+        c = self.cost_s
+        return (round(c * 1e9) if c is not None else 2**62,
                 self.wire_bytes if self.wire_bytes is not None else 2**62,
-                -self.layout.data, self.layout.fsdp, self.layout.tp)
+                -self.layout.data, self.layout.fsdp, self.layout.tp,
+                self.layout.pipe, 1 if self.remat else 0)
 
     def as_dict(self) -> Dict[str, Any]:
         mb = 1 << 20
         d = {"data": self.layout.data, "fsdp": self.layout.fsdp,
-             "tp": self.layout.tp, "axes": self.layout.sizes,
+             "tp": self.layout.tp, "pipe": self.layout.pipe,
+             "axes": self.layout.sizes,
+             "remat": self.remat,
              "fits": bool(self.fits), "winner": bool(self.winner)}
+        if self.remat_plan is not None:
+            d["remat_plan"] = self.remat_plan.as_dict()
+        if self.pipe_report:
+            d["pipe_report"] = {
+                k: self.pipe_report.get(k)
+                for k in ("cuts", "boundary_bytes",
+                          "total_boundary_bytes", "stage_ops",
+                          "num_microbatches")}
         if self.est is not None:
             d["peak_hbm_bytes"] = int(self.est.peak_bytes)
             d["peak_hbm_mb"] = round(self.est.peak_bytes / mb, 3)
@@ -176,6 +234,10 @@ class PlanConfig:
             d["overlappable_compute_ms"] = round(
                 self.exposed["overlappable_compute_s"] * 1e3, 6)
             d["hidden_ms"] = round(self.exposed["hidden_s"] * 1e3, 6)
+            if self.exposed.get("pipe_bubble_s"):
+                d["pipe_bubble_ms"] = round(
+                    self.exposed["pipe_bubble_s"] * 1e3, 6)
+                d["cost_ms"] = round(self.exposed["cost_s"] * 1e3, 6)
         if self.fsdp_report.get("sharded"):
             d["fsdp_sharded_params"] = len(self.fsdp_report["sharded"])
         if self.error:
@@ -187,11 +249,13 @@ class Plan:
     """Ranked plan-search result (the auditable artifact)."""
 
     def __init__(self, configs: List[PlanConfig], num_devices: int,
-                 budget_gb: Optional[float], module: str = "program"):
+                 budget_gb: Optional[float], module: str = "program",
+                 num_microbatches: int = 1):
         self.configs = configs
         self.num_devices = num_devices
         self.budget_gb = budget_gb
         self.module = module
+        self.num_microbatches = int(num_microbatches)
         fitting = [c for c in configs
                    if c.fits and c.error is None and c.est is not None]
         self.winner: Optional[PlanConfig] = \
@@ -205,6 +269,7 @@ class Plan:
             "format_version": PLAN_FORMAT_VERSION,
             "module": self.module,
             "num_devices": self.num_devices,
+            "num_microbatches": self.num_microbatches,
             "hbm_budget_gb": self.budget_gb,
             "compiles_attempted": 0,    # pricing is static by construction
             "configs_priced": len([c for c in self.configs
@@ -215,7 +280,8 @@ class Plan:
                        "op_spec wire ring-cost channel "
                        "(collective_wire_summary) + exposed-comm "
                        "roofline (exposed_comm_model over the op_spec "
-                       "flops channel; ranking = min exposed comm, "
+                       "flops channel; ranking = min exposed comm + "
+                       "1F1B bubble (pipe−1)/num_microbatches, "
                        "ties → fewer wire bytes)",
         }
 
@@ -235,12 +301,13 @@ class Plan:
                 is not None else "        ?"
             wire = f"{c.wire_bytes / mb:9.2f} MiB" if c.wire_bytes \
                 is not None else "        ?"
-            exp = f"{c.exposed_comm_s * 1e3:8.3f} ms" \
-                if c.exposed_comm_s is not None else "       ?"
+            exp = f"{c.cost_s * 1e3:8.3f} ms" \
+                if c.cost_s is not None else "       ?"
             lines.append(
                 f" {mark} data={c.layout.data:<3d} fsdp={c.layout.fsdp:<3d} "
-                f"tp={c.layout.tp:<3d} peak {peak}  wire {wire}  "
-                f"exposed {exp}"
+                f"tp={c.layout.tp:<3d} pipe={c.layout.pipe:<3d}"
+                f"{'R' if c.remat else ' '} peak {peak}  wire {wire}  "
+                f"cost {exp}"
                 + (f"  [{c.error}]" if c.error else ""))
         if self.winner is None:
             lines.append("  NO config fits the budget")
@@ -252,17 +319,26 @@ def price_config(program: Program, layout: MeshLayout,
                  fetch_names: Iterable[str] = (),
                  build_strategy=None,
                  min_shard_numel: int = 2048,
-                 flops_total: Optional[float] = None) -> PlanConfig:
+                 flops_total: Optional[float] = None,
+                 num_microbatches: int = 1,
+                 remat: bool = False,
+                 hbm_budget_gb: Optional[float] = None) -> PlanConfig:
     """Price ONE layout on a clone of ``program``: apply the ZeRO-3
-    rewrite (fsdp > 1) and grad-sync insertion the real compile would
-    apply, then run the static estimators (peak HBM, wire bytes, and —
-    when ``flops_total`` is given — the exposed-comm roofline).  The
-    clone is discarded — the input program is never mutated and nothing
-    compiles."""
+    rewrite (fsdp > 1), the pipeline stage-cut rewrite (pipe > 1, with
+    ``num_microbatches`` 1F1B microbatching) and grad-sync insertion the
+    real compile would apply, then run the static estimators (peak HBM,
+    wire bytes, and — when ``flops_total`` is given — the exposed-comm
+    roofline with the ``(pipe − 1)/num_microbatches`` bubble term).
+    With ``remat=True`` the clone additionally gets recompute
+    checkpoints from :func:`~.pipe.plan_remat` (the remat search
+    dimension: the FLOPs delta lands in ``remat_plan`` and the
+    estimate reflects the dropped residuals).  The clone is discarded —
+    the input program is never mutated and nothing compiles."""
     from .compiler import BuildStrategy, insert_grad_sync
     from .fsdp import apply_fsdp_sharding
     from .memory_analysis import (analyze_memory, collective_wire_summary,
                                   exposed_comm_model)
+    from .pipe import apply_pipeline, apply_remat, plan_remat
 
     cfg = PlanConfig(layout)
     clone = program.clone()
@@ -271,6 +347,10 @@ def price_config(program: Program, layout: MeshLayout,
         if layout.fsdp > 1:
             cfg.fsdp_report = apply_fsdp_sharding(
                 clone, layout, min_shard_numel=min_shard_numel)
+        if layout.pipe > 1:
+            cfg.pipe_report = apply_pipeline(
+                clone, layout.pipe, num_microbatches,
+                pipe_axis=layout.pipe_axis, feed_shapes=feed_shapes)
         sizes = layout.sizes
         reduce_axes = tuple(a for a in _flat_axes(layout.batch_axes)
                             if sizes.get(a, 1) > 1)
@@ -281,17 +361,33 @@ def price_config(program: Program, layout: MeshLayout,
         kw = dict(feed_shapes=feed_shapes, fetch_names=list(fetch_names),
                   mesh_axes=layout.mesh_axes,
                   batch_axis=layout.batch_axes)
+        if remat:
+            rplan = plan_remat(clone, feed_shapes=feed_shapes,
+                               fetch_names=list(fetch_names),
+                               mesh_axes=layout.mesh_axes,
+                               batch_axis=layout.batch_axes,
+                               budget_gb=hbm_budget_gb)
+            if rplan is None:
+                cfg.error = "remat: no recompute plan available"
+                return cfg
+            apply_remat(clone, rplan)
+            cfg.remat_plan = rplan
         cfg.est = analyze_memory(clone, **kw)
         cfg.wire = collective_wire_summary(clone, **kw)
         if flops_total is not None:
             has_bw = any(op.type == "backward"
                          for op in clone.global_block().ops)
+            bubble = (layout.pipe - 1) / max(int(num_microbatches), 1) \
+                if layout.pipe > 1 else 0.0
+            flops = flops_total
+            if cfg.remat_plan is not None:
+                flops = flops + cfg.remat_plan.flops_delta
             cfg.exposed = exposed_comm_model(
-                cfg.wire, flops_total,
-                num_devices=layout.data * layout.fsdp * layout.tp,
+                cfg.wire, flops,
+                num_devices=layout.num_devices,
                 overlap=bool(getattr(strategy, "overlap_grad_sync",
                                      False)),
-                has_backward=has_bw)
+                has_backward=has_bw, bubble_frac=bubble)
     except Exception as e:      # a pricing bug must not kill the search
         cfg.error = f"{type(e).__name__}: {e}"
     return cfg
@@ -309,11 +405,21 @@ def plan_sharding(program: Program, num_devices: int,
                   build_strategy=None, max_tp: Optional[int] = None,
                   min_shard_numel: int = 2048,
                   module: str = "program",
-                  report_path: Optional[str] = None) -> Plan:
-    """Search every legal (data, fsdp, tp) factorization of
+                  report_path: Optional[str] = None,
+                  max_pipe: Optional[int] = None,
+                  num_microbatches: int = 1,
+                  remat: bool = False) -> Plan:
+    """Search every legal (data, fsdp, tp, pipe) factorization of
     ``num_devices``, price each statically, and rank them.  Returns the
     :class:`Plan`; ``plan.winner`` is None when no config fits the
     budget (the caller decides whether that is fatal).
+
+    ``max_pipe`` > 1 opts the pipeline dimension in (each pipe > 1
+    config is priced on a stage-cut clone with a
+    ``(pipe − 1)/num_microbatches`` bubble term); ``remat=True`` adds a
+    rematerialized sibling row for every budget-rejected config — when
+    the recompute plan fits, the reject flips to an admitted config
+    carrying the priced FLOPs delta.
 
     0 compiles are attempted: pricing runs on program clones through
     the static memory/wire model only."""
@@ -328,18 +434,29 @@ def plan_sharding(program: Program, num_devices: int,
             fetch_names=list(fetch_names))["total_flops"]
     except Exception:
         flops_total = None
+    kw = dict(loss_name=loss_name, feed_shapes=feed_shapes,
+              fetch_names=fetch_names, build_strategy=build_strategy,
+              min_shard_numel=min_shard_numel, flops_total=flops_total,
+              num_microbatches=num_microbatches)
     configs = []
-    for layout in enumerate_layouts(program, num_devices, max_tp=max_tp):
-        cfg = price_config(program, layout, loss_name=loss_name,
-                           feed_shapes=feed_shapes,
-                           fetch_names=fetch_names,
-                           build_strategy=build_strategy,
-                           min_shard_numel=min_shard_numel,
-                           flops_total=flops_total)
+    for layout in enumerate_layouts(program, num_devices, max_tp=max_tp,
+                                    max_pipe=max_pipe):
+        cfg = price_config(program, layout, **kw)
         if budget is not None and cfg.est is not None:
             cfg.fits = cfg.est.peak_gb <= budget
         configs.append(cfg)
-    plan = Plan(configs, num_devices, budget, module=module)
+        if budget is not None and remat and not cfg.fits and \
+                cfg.error is None:
+            # the remat dimension: a rejected config's rematerialized
+            # sibling — recompute checkpoints at the liveness peak,
+            # priced FLOPs delta in the bubble-aware roofline
+            rcfg = price_config(program, layout, remat=True,
+                                hbm_budget_gb=budget, **kw)
+            if rcfg.est is not None and rcfg.error is None:
+                rcfg.fits = rcfg.est.peak_gb <= budget
+                configs.append(rcfg)
+    plan = Plan(configs, num_devices, budget, module=module,
+                num_microbatches=num_microbatches)
     if report_path:
         plan.write_report(report_path)
     return plan
@@ -347,9 +464,12 @@ def plan_sharding(program: Program, num_devices: int,
 
 def stamp_winning_layout(program: Program, plan: Plan,
                          min_shard_numel: int = 2048,
-                         prefetch_distance: int = 0) -> MeshLayout:
+                         prefetch_distance: int = 0,
+                         feed_shapes=None) -> MeshLayout:
     """Apply ``plan.winner`` to the REAL program: the ZeRO-3 rewrite
-    (fsdp > 1, gathers prefetched ``prefetch_distance`` layers early)
+    (fsdp > 1, gathers prefetched ``prefetch_distance`` layers early),
+    the pipeline stage-cut rewrite (pipe > 1, with the plan's
+    microbatch count), the winner's recompute checkpoints (remat rows)
     plus the canonical ``_mesh_layout`` stamp.  Grad-sync insertion
     stays with ``CompiledProgram.with_mesh`` (it reads the stamped
     dist_attrs).  Raises when no config fit."""
@@ -364,10 +484,21 @@ def stamp_winning_layout(program: Program, plan: Plan,
         apply_fsdp_sharding(program, layout,
                             min_shard_numel=min_shard_numel,
                             prefetch_distance=prefetch_distance)
+    if layout.pipe > 1:
+        from .pipe import apply_pipeline
+        apply_pipeline(program, layout.pipe, plan.num_microbatches,
+                       pipe_axis=layout.pipe_axis,
+                       feed_shapes=feed_shapes)
+    elif plan.num_microbatches > 1:
+        from .pipe import set_microbatches
+        set_microbatches(program, plan.num_microbatches)
+    if plan.winner.remat_plan is not None:
+        from .pipe import apply_remat
+        apply_remat(program, plan.winner.remat_plan)
     program._mesh_layout = layout
     return layout
 
 
 __all__ = ["Plan", "PlanConfig", "plan_sharding", "price_config",
-           "enumerate_layouts", "legal_tp_degrees", "stamp_winning_layout",
-           "PLAN_FORMAT_VERSION"]
+           "enumerate_layouts", "legal_tp_degrees", "legal_pipe_degrees",
+           "stamp_winning_layout", "PLAN_FORMAT_VERSION"]

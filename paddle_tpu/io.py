@@ -615,12 +615,18 @@ def _restore_dir(d: str, program: Optional[Program], scope: Scope,
     wanted = set(_persistable_names(program)) if program is not None \
         else None
     sharded = any(n.startswith("shard_manifest_") for n in os.listdir(d))
+    read_stats = _new_read_stats()
     if sharded:
-        arrays = _read_sharded_arrays(d, wanted)
+        ranges = _planned_read_ranges(d, manifest, program, dst_layout,
+                                      reshard)
+        arrays = _read_sharded_arrays(d, wanted, row_ranges=ranges,
+                                      read_stats=read_stats)
     else:
         arrays = _read_whole_arrays(d, wanted)
     arrays, reshard_info = _maybe_reshard(arrays, manifest, program,
                                           dst_layout, reshard)
+    if reshard_info is not None and sharded:
+        reshard_info["read_stats"] = dict(read_stats)
     if flag("verify_programs") and program is not None:
         _check_restore_shapes(program, arrays, manifest, dst_layout)
     for name, arr in arrays.items():
@@ -726,11 +732,176 @@ def save_persistables_sharded(executor, dirname,
     _retry_io("shard_manifest", w)
 
 
-def _read_sharded_arrays(dirname, wanted=None) -> Dict[str, np.ndarray]:
+def _manifest_var_sigs(d: str) -> Dict[str, Any]:
+    """Global (shape, dtype) per persistable from the shard manifests —
+    lets a resharding restore PLAN before reading any array data."""
+    sigs: Dict[str, Any] = {}
+    for fn in sorted(os.listdir(d)):
+        if not fn.startswith("shard_manifest_"):
+            continue
+        with open(os.path.join(d, fn)) as f:
+            m = json.load(f)
+        for name, rec in (m.get("vars") or m).items():
+            if isinstance(rec, dict) and "shape" in rec:
+                sigs[name] = (tuple(int(s) for s in rec["shape"]),
+                              str(rec["dtype"]))
+    return sigs
+
+
+def _process_dst_blocks(plan) -> Dict[str, list]:
+    """{var: dim-0 dst block indices} this PROCESS's devices own under
+    the plan's destination layout — the rank-local slice assignment the
+    byte-range reader restricts to."""
+    import jax
+    layout = plan.dst_layout
+    if layout is None:
+        return {}
+    mesh = layout.build_mesh()
+    if mesh is None:
+        return {}
+    from .framework.mesh_layout import _flat_axes
+    local = {dev.id for dev in jax.local_devices()}
+    shape = mesh.devices.shape
+    axes = list(mesh.axis_names)
+    local_coords = [c for c in np.ndindex(*shape)
+                    if mesh.devices[c].id in local]
+    blocks: Dict[str, list] = {}
+    for name, t in plan.transfers.items():
+        if t.flat:
+            dim0_axes = [a for a in (t.flat.get("axes") or ())
+                         if a in axes]
+        elif t.dst_spec is not None and tuple(t.dst_spec):
+            dim0_axes = [a for a in _flat_axes((tuple(t.dst_spec)[0],))
+                         if a in axes]
+        else:
+            continue
+        if not dim0_axes:
+            continue
+        owned = set()
+        for coords in local_coords:
+            b = 0
+            for a in dim0_axes:
+                ai = axes.index(a)
+                b = b * shape[ai] + coords[ai]
+            owned.add(b)
+        blocks[name] = sorted(owned)
+    return blocks
+
+
+def _planned_read_ranges(d: str, manifest, program, dst_layout,
+                         reshard: bool):
+    """Multi-host restore read plan: which GLOBAL dim-0 rows this
+    process must read, per the reshard schedule's slice assignment
+    (``ReshardPlan.dst_read_ranges``).  None (read everything) for
+    single-process restores — the partial-read path only pays off when
+    other hosts own the remaining slices — and whenever planning fails
+    (the reader degrading to a whole read can never cost correctness)."""
+    import jax
+    if jax.process_count() <= 1 or not reshard or not manifest or \
+            program is None:
+        return None
+    try:
+        from .framework.mesh_layout import MeshLayout
+        from .framework.reshard import flat_shard_meta, plan_reshard
+        src_layout = MeshLayout.from_desc(manifest.get("mesh_layout"))
+        if dst_layout is None:
+            dst_layout = getattr(program, "_mesh_layout", None)
+        if src_layout is None or dst_layout is None:
+            return None
+        var_sigs = _manifest_var_sigs(d)
+        src_specs = {k: _spec_from_desc(v) for k, v in
+                     (manifest.get("shard_specs") or {}).items()}
+        dst_specs = {v.name: v.dist_attr for v in program.list_vars()
+                     if v.persistable and getattr(v, "dist_attr", None)}
+        plan = plan_reshard(src_layout, dst_layout, var_sigs=var_sigs,
+                            src_specs=src_specs,
+                            dst_specs=dst_specs or None,
+                            flat_meta=flat_shard_meta(program) or None,
+                            validate=False)
+        return plan.dst_read_ranges(_process_dst_blocks(plan)) or None
+    except Exception:
+        return None
+
+
+def _npz_member_meta(path: str) -> Dict[str, Any]:
+    """{member: (abs_data_offset, dtype, shape, fortran)} for the
+    byte-range restore reader.  ``np.savez`` stores members
+    UNCOMPRESSED (ZIP_STORED), so each .npy's data is one contiguous
+    span of the outer file — a dim-0 row range is a single seek+read.
+    Compressed/odd members map to None (the reader falls back to a
+    whole-member read)."""
+    import struct
+    import zipfile
+    from numpy.lib import format as npy_format
+    out: Dict[str, Any] = {}
+    with zipfile.ZipFile(path) as z, open(path, "rb") as f:
+        for zi in z.infolist():
+            name = zi.filename
+            key = name[:-4] if name.endswith(".npy") else name
+            if zi.compress_type != zipfile.ZIP_STORED:
+                out[key] = None
+                continue
+            f.seek(zi.header_offset)
+            hdr = f.read(30)
+            if len(hdr) < 30 or hdr[:4] != b"PK\x03\x04":
+                out[key] = None
+                continue
+            n, m = struct.unpack("<HH", hdr[26:30])
+            f.seek(zi.header_offset + 30 + n + m)
+            try:
+                version = npy_format.read_magic(f)
+                if version == (1, 0):
+                    shape, fortran, dtype = \
+                        npy_format.read_array_header_1_0(f)
+                elif version == (2, 0):
+                    shape, fortran, dtype = \
+                        npy_format.read_array_header_2_0(f)
+                else:
+                    out[key] = None
+                    continue
+            except Exception:
+                out[key] = None
+                continue
+            out[key] = (f.tell(), dtype, tuple(int(s) for s in shape),
+                        bool(fortran))
+    return out
+
+
+def _intersect_rows(ranges, lo, hi):
+    """``ranges`` ∩ [lo, hi) — the wanted global rows inside one stored
+    shard's dim-0 extent."""
+    out = []
+    for a, b in ranges:
+        a2, b2 = max(a, lo), min(b, hi)
+        if b2 > a2:
+            out.append((a2, b2))
+    return out
+
+
+def _new_read_stats() -> Dict[str, int]:
+    return {"bytes_read": 0, "bytes_skipped": 0, "members_read": 0,
+            "members_partial": 0, "members_skipped": 0}
+
+
+def _read_sharded_arrays(dirname, wanted=None, row_ranges=None,
+                         read_stats=None) -> Dict[str, np.ndarray]:
     """Reassemble global arrays from every process's shard files (a
     restarted job may have a different host count — reassembly is by
     global offsets, not by writer rank).  Handles both the v1 flat
-    manifest schema and the v2 layout-stamped one."""
+    manifest schema and the v2 layout-stamped one.
+
+    ``row_ranges`` (from ``ReshardPlan.dst_read_ranges`` — the reshard
+    schedule's slice assignment for this rank) restricts the read to
+    GLOBAL dim-0 row intervals per var: stored shards that do not
+    intersect are skipped entirely, partially-covered shards are read
+    with seek+read over exactly the needed byte spans (np.savez members
+    are uncompressed), and only full-covering shards fall back to a
+    whole-member read.  ``read_stats`` (dict) accumulates payload
+    ``bytes_read`` / ``bytes_skipped`` so the restore can assert
+    bytes-read == planned slice bytes."""
+    stats = read_stats if read_stats is not None else _new_read_stats()
+    for k, v in _new_read_stats().items():
+        stats.setdefault(k, v)
     full: Dict[str, np.ndarray] = {}
     for fn in sorted(os.listdir(dirname)):
         if not fn.startswith("shard_manifest_"):
@@ -740,20 +911,80 @@ def _read_sharded_arrays(dirname, wanted=None) -> Dict[str, np.ndarray]:
             manifest = json.load(f)
         if "format_version" in manifest and "vars" in manifest:
             manifest = manifest["vars"]
-        with np.load(os.path.join(dirname, f"shard_data_{pid}.npz")) as data:
-            for name, rec in manifest.items():
-                if wanted is not None and name not in wanted:
-                    continue
-                dst = full.setdefault(name, np.zeros(
-                    rec["shape"], np.dtype(rec["dtype"])))
-                for e in rec["shards"]:
-                    if e["key"] not in data:
+        data_path = os.path.join(dirname, f"shard_data_{pid}.npz")
+        meta = _npz_member_meta(data_path) if row_ranges else {}
+        raw = open(data_path, "rb") if row_ranges else None
+        try:
+            with np.load(data_path) as data:
+                for name, rec in manifest.items():
+                    if wanted is not None and name not in wanted:
                         continue
-                    if e["index"] is None:
-                        dst[...] = data[e["key"]]
-                    else:
-                        sel = tuple(slice(a, b) for a, b in e["index"])
-                        dst[sel] = data[e["key"]]
+                    dst = full.setdefault(name, np.zeros(
+                        rec["shape"], np.dtype(rec["dtype"])))
+                    want = (row_ranges or {}).get(name)
+                    for e in rec["shards"]:
+                        if e["key"] not in data:
+                            continue
+                        idx = e["index"]
+                        sel = tuple(slice(a, b) for a, b in idx) \
+                            if idx is not None else Ellipsis
+                        lo, hi = (idx[0] if idx is not None
+                                  else (0, int(rec["shape"][0])
+                                        if rec["shape"] else 1))
+                        row_nbytes = int(
+                            np.dtype(rec["dtype"]).itemsize *
+                            np.prod([b - a for a, b in (idx or [])][1:]
+                                    or [int(s) for s in
+                                        rec["shape"][1:]] or [1]))
+                        if want is None:
+                            arr = data[e["key"]]
+                            stats["bytes_read"] += int(arr.nbytes)
+                            stats["members_read"] += 1
+                            if sel is Ellipsis:
+                                dst[...] = arr
+                            else:
+                                dst[sel] = arr
+                            continue
+                        inter = _intersect_rows(want, lo, hi)
+                        if not inter:
+                            stats["members_skipped"] += 1
+                            stats["bytes_skipped"] += \
+                                (hi - lo) * row_nbytes
+                            continue
+                        mm = meta.get(e["key"])
+                        if inter == [(lo, hi)] or mm is None or mm[3] \
+                                or idx is None:
+                            # full cover (or unsliceable member) — read
+                            # the whole shard
+                            arr = data[e["key"]]
+                            stats["bytes_read"] += int(arr.nbytes)
+                            stats["members_read"] += 1
+                            if sel is Ellipsis:
+                                dst[...] = arr
+                            else:
+                                dst[sel] = arr
+                            continue
+                        # byte-range read of exactly the needed rows
+                        off, dtype, shape, _ = mm
+                        tail = shape[1:]
+                        rb = int(dtype.itemsize * int(np.prod(tail or
+                                                              (1,))))
+                        stats["members_partial"] += 1
+                        for a, b in inter:
+                            raw.seek(off + (a - lo) * rb)
+                            buf = raw.read((b - a) * rb)
+                            stats["bytes_read"] += len(buf)
+                            rows = np.frombuffer(
+                                buf, dtype=dtype).reshape((b - a,) + tail)
+                            dsel = (slice(a, b),) + tuple(
+                                slice(c, d) for c, d in idx[1:])
+                            dst[dsel] = rows
+                        stats["bytes_skipped"] += \
+                            (hi - lo) * row_nbytes - sum(
+                                (b - a) * rb for a, b in inter)
+        finally:
+            if raw is not None:
+                raw.close()
     return full
 
 

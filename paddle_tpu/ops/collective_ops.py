@@ -535,6 +535,20 @@ register("c_gen_nccl_id")(_noop)
 register("barrier")(_noop)
 
 
+@register("pipe_stage_boundary")
+def _pipe_stage_boundary(ctx, ins, attrs):
+    """Stage-cut marker (framework/pipe.apply_pipeline): the live
+    tensors crossing one pipeline cut.  As an OP it is the identity —
+    the actual stage→stage+1 ``ppermute`` hop happens inside the
+    executor's scheduled 1F1B scan, which partitions the op list AT
+    these markers; running the ops sequentially (pipe = 1, or a mesh
+    without the pipe axis) must be a no-op.  The op exists so the
+    static layer sees the boundary: its ``wire()`` spec prices the
+    per-step ppermute traffic (payload × 2 — forward boundary plus the
+    backward cotangent hop) and the census reports per-cut bytes."""
+    return {"Out": list(ins.get("X", []))}
+
+
 @register("collective_permute")
 def _collective_permute(ctx, ins, attrs):
     """Ring shift (used by pipeline/sequence parallelism)."""

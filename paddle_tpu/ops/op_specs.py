@@ -725,6 +725,16 @@ def _infer_collective_same(ins, attrs):
     return same_as_input()(ins, attrs)
 
 
+def _infer_pipe_boundary(ins, attrs):
+    """Stage-cut marker: each crossing tensor passes through unchanged
+    (X[i] → Out[i], slot-aligned — NOT the unary same_as_input, which
+    would stamp every output with the first input's signature)."""
+    xs = ins.get("X") or []
+    if not xs or any(v is None for v in xs):
+        return None
+    return {"Out": [VarSig(v.shape, v.dtype) for v in xs]}
+
+
 def _infer_c_embedding(ins, attrs):
     """Vocab-parallel embedding lookup: Out = Ids.shape + [dim] (the
     row dim is vocab-sharded; the psum restores the full [.., dim])."""
@@ -810,7 +820,33 @@ def _collective_wire(passes):
 #: bwd psum) each move the payload the listed number of passes so the
 #: planner's ring-cost channel covers the Megatron f/g pair and the
 #: ZeRO-3 gathers, not just the post-backward grad sync.
+def _pipe_boundary_wire(ins, attrs, axis_sizes=None):
+    """Per-STEP wire bytes of one pipeline stage cut: the boundary
+    payload crosses the cut once per microbatch forward (ppermute hop to
+    stage+1) and once per microbatch backward (the cotangent hop back),
+    and the microbatch slices sum to the full batch — so per step the
+    cut moves 2 × payload point-to-point, independent of the pipe
+    degree.  Zero when the mesh is known and the pipe axis is absent or
+    size 1 (the identity degenerate)."""
+    numel_bytes = 0
+    for sig in ins.get("X", []):
+        if sig is None or sig.shape is None or not _known(sig.shape):
+            return None
+        numel_bytes += _numel(sig.shape) * \
+            _WIRE_DTYPE_BYTES.get(sig.dtype, 4)
+    if not numel_bytes:
+        return None
+    ax = attrs.get("_axis_name")
+    if axis_sizes is not None:
+        n = (axis_sizes or {}).get(ax, 1)
+        if not n or n <= 1:
+            return 0, 0
+    total = 2 * numel_bytes
+    return total, total
+
+
 _WIRE_SPECS = {
+    "pipe_stage_boundary": _pipe_boundary_wire,
     "c_allreduce_sum": _collective_wire(2),
     "c_fused_allreduce_sum": _collective_wire(2),
     "c_quant_allreduce_sum": _collective_wire(2),
@@ -1233,6 +1269,11 @@ def register_default_specs():
     # Megatron f op: identity forward (psum transpose in backward)
     op_spec("mp_copy", infer=_infer_collective_same, collective=True,
             wire=_WIRE_SPECS.get("mp_copy"))
+    # pipeline stage-cut marker (framework/pipe.py): identity op whose
+    # wire spec prices the per-microbatch ppermute hops (fwd boundary +
+    # bwd cotangent) the scheduled 1F1B lowering realises at the cut
+    op_spec("pipe_stage_boundary", infer=_infer_pipe_boundary,
+            collective=True, wire=_WIRE_SPECS["pipe_stage_boundary"])
     # ZeRO-3 on-demand parameter gather (framework/fsdp.py): metadata is
     # GLOBAL throughout, so Out mirrors X's declared signature
     op_spec("fsdp_all_gather", infer=_infer_collective_same,

@@ -1,0 +1,576 @@
+"""Pipeline parallelism + rematerialization as planner dimensions.
+
+Covers the framework/pipe.py rewrites (liveness-driven stage cuts, the
+1F1B schedule, remat planning), the executor's microbatched/1F1B
+lowerings (gradient-merge bitwise composition, pp-mesh parity), the
+extended (data, fsdp, tp, pipe, remat) planner with its 0-compile and
+budget-flip contracts, the new analysis diagnostics, and the
+``PIPE_SEARCH_r17.json`` artifact contract."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework.core import (Program, program_guard,
+                                       reset_default_programs)
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.framework.compiler import BuildStrategy, CompiledProgram
+from paddle_tpu.framework.mesh_layout import MeshLayout
+from paddle_tpu.framework.pipe import (apply_pipeline, apply_remat,
+                                       plan_remat, plan_stage_cuts,
+                                       schedule_1f1b, set_microbatches)
+from paddle_tpu.framework.shard_planner import (enumerate_layouts,
+                                                plan_sharding)
+from paddle_tpu.monitor import stat
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STEPS = 5
+
+
+def _model(width=32):
+    x = layers.data("x", shape=[-1, 16], append_batch_size=False)
+    y = layers.data("label", shape=[-1, 1], dtype="float32",
+                    append_batch_size=False)
+    h = layers.fc(x, width, act="relu",
+                  param_attr=fluid.ParamAttr(name="w1"))
+    h = layers.fc(h, width, act="relu",
+                  param_attr=fluid.ParamAttr(name="w2"))
+    p = layers.fc(h, 1, param_attr=fluid.ParamAttr(name="w3"))
+    return layers.mean(layers.square(p - y))
+
+
+_RNG = np.random.RandomState(0)
+_XS = _RNG.randn(STEPS, 8, 16).astype("float32")
+_YS = _RNG.randn(STEPS, 8, 1).astype("float32")
+
+
+def _train(mutate, mesh_axes=(), fuse=True):
+    """Build + mutate + train the MLP; returns (losses, w1)."""
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        loss = _model()
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    mutate(main)
+    prog = main
+    if mesh_axes:
+        names = tuple(a for a, _ in mesh_axes)
+        sizes = tuple(n for _, n in mesh_axes)
+        n = int(np.prod(sizes))
+        mesh = Mesh(np.array(jax.devices()[:n]).reshape(sizes), names)
+        bs = BuildStrategy()
+        bs.fuse_all_reduce_ops = fuse
+        prog = CompiledProgram(main).with_mesh(
+            mesh, loss_name=loss.name, batch_axis="dp",
+            build_strategy=bs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i in range(STEPS):
+            (l,) = exe.run(prog, feed={"x": _XS[i], "label": _YS[i]},
+                           fetch_list=[loss])
+            losses.append(np.asarray(l).ravel())
+        w1 = np.asarray(scope.find_var("w1"))
+    return losses, w1
+
+
+# ---------------------------------------------------------------------------
+# stage-cut planning + schedule
+# ---------------------------------------------------------------------------
+
+
+def test_plan_stage_cuts_minimizes_boundary_and_balances():
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        loss = _model()
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    plan = plan_stage_cuts(main, 2,
+                           feed_shapes={"x": ((8, 16), "float32"),
+                                        "label": ((8, 1), "float32")})
+    assert len(plan.cuts) == 1 and len(plan.boundaries) == 1
+    assert plan.boundary_bytes[0] > 0
+    assert all(n > 0 for n in plan.stage_ops)
+    # both stages carry compute (the FLOPs-balance constraint held)
+    assert all(f > 0 for f in plan.stage_flops)
+
+
+def test_plan_stage_cuts_requires_backward():
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        _model()
+    with pytest.raises(InvalidArgumentError, match="backward"):
+        plan_stage_cuts(main, 2)
+
+
+def test_schedule_1f1b_shape_and_alternation():
+    for S, M in ((2, 4), (4, 4), (3, 6)):
+        sch = schedule_1f1b(S, M)
+        order = sch["order"]
+        # every (stage, phase, microbatch) unit exactly once
+        assert len(order) == 2 * S * M
+        assert len({(s, ph, m) for _, s, ph, m in order}) == 2 * S * M
+        # last stage alternates F,B strictly — the 1F1B contract
+        last = [(ph, m) for _, s, ph, m in order if s == S - 1]
+        assert last == [(ph, m) for m in range(M) for ph in ("F", "B")]
+        # a backward never precedes its own forward; cotangents flow
+        # stage s+1 → s one tick apart
+        ftick = {(s, m): t for t, s, ph, m in order if ph == "F"}
+        btick = {(s, m): t for t, s, ph, m in order if ph == "B"}
+        for (s, m), t in btick.items():
+            assert t > ftick[(s, m)]
+            if s < S - 1:
+                assert t == btick[(s + 1, m)] + 1
+        assert 1 <= sch["slots"] <= S
+        assert sch["bubble_frac"] == (S - 1) / M
+
+
+def test_apply_pipeline_idempotent_and_stamps():
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        loss = _model()
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    rep = apply_pipeline(main, 2, 2)
+    assert rep["num_stages"] == 2 and rep["grad_sync_ops"] >= 1
+    block = main.global_block()
+    assert sum(1 for op in block.ops
+               if op.type == "pipe_stage_boundary") == 1
+    bw = next(op for op in block.ops if op.type == "backward")
+    assert bw.attrs["pipe_stages"] == 2
+    assert bw.attrs["pipe_microbatches"] == 2
+    assert bw.attrs["pipe_boundaries"] == rep["boundaries"]
+    # second application is a no-op
+    rep2 = apply_pipeline(main, 4, 8)
+    assert rep2.get("already_pipelined")
+    assert sum(1 for op in block.ops
+               if op.type == "pipe_stage_boundary") == 1
+
+
+# ---------------------------------------------------------------------------
+# gradient-merge × pipeline composition (the microbatch substrate)
+# ---------------------------------------------------------------------------
+
+
+def test_microbatch_accumulation_matches_gradient_merge_bitwise():
+    """pipe = 1, M = 2: the in-step microbatch scan must equal
+    GradientMergeOptimizer over the same microbatch stream BITWISE
+    (two-term accumulation commutes exactly; the 1/2 mean is an exact
+    scale)."""
+    lm, wm = _train(lambda p: set_microbatches(p, 2))
+
+    def gm():
+        reset_default_programs()
+        from paddle_tpu.optimizer import GradientMergeOptimizer
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            loss = _model()
+            GradientMergeOptimizer(fluid.optimizer.Adam(5e-3), k_steps=2,
+                                   avg=True).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for i in range(STEPS):
+                sub = []
+                for m in range(2):
+                    (l,) = exe.run(
+                        main,
+                        feed={"x": _XS[i][m * 4:(m + 1) * 4],
+                              "label": _YS[i][m * 4:(m + 1) * 4]},
+                        fetch_list=[loss])
+                    sub.append(np.asarray(l).reshape(()))
+                losses.append((sub[0] + sub[1]) / np.float32(2))
+            w1 = np.asarray(scope.find_var("w1"))
+        return losses, w1
+
+    lg, wg = gm()
+    assert np.array_equal(np.asarray(lm).ravel(), np.asarray(lg).ravel())
+    assert np.array_equal(wm, wg)
+
+
+def test_pipe2_matches_gradient_merge_1e6():
+    """pipe = 2 (1F1B over a pp2 mesh): same math as gradient merge up
+    to the schedule's reassociation — ≤ 1e-6 over 5 steps."""
+    lm, wm = _train(lambda p: set_microbatches(p, 2))
+    lp, wp = _train(lambda p: apply_pipeline(p, 2, 2),
+                    mesh_axes=(("pp", 2),))
+    a = np.asarray(lm, dtype=np.float64).ravel()
+    b = np.asarray(lp, dtype=np.float64).ravel()
+    assert np.abs(a - b).max() <= 1e-6
+    assert np.abs(wm - wp).max() <= 1e-6
+
+
+# ---------------------------------------------------------------------------
+# 1F1B mesh lowering parity
+# ---------------------------------------------------------------------------
+
+
+def test_dp2_pp2_parity_and_composition():
+    lb, wb = _train(lambda p: set_microbatches(p, 4),
+                    mesh_axes=(("dp", 2),))
+    lp, wp = _train(lambda p: apply_pipeline(p, 2, 4),
+                    mesh_axes=(("dp", 2), ("pp", 2)))
+    a = np.asarray(lb, dtype=np.float64).ravel()
+    b = np.asarray(lp, dtype=np.float64).ravel()
+    assert np.abs(a - b).max() <= 1e-6
+    assert np.abs(wb - wp).max() <= 1e-6
+
+
+def test_pp4_parity():
+    lb, wb = _train(lambda p: set_microbatches(p, 4))
+    lp, wp = _train(lambda p: apply_pipeline(p, 4, 4),
+                    mesh_axes=(("pp", 4),))
+    a = np.asarray(lb, dtype=np.float64).ravel()
+    b = np.asarray(lp, dtype=np.float64).ravel()
+    assert np.abs(a - b).max() <= 1e-6
+    assert np.abs(wb - wp).max() <= 1e-6
+
+
+def test_pipe_zero1_composition():
+    """1F1B × ZeRO-1: the pipe-axis grad sum feeds the dp-axis
+    reduce-scatter untouched."""
+    from paddle_tpu.optimizer import ShardedUpdateOptimizer
+
+    def build(pipelined):
+        reset_default_programs()
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            loss = _model()
+            ShardedUpdateOptimizer(fluid.optimizer.Adam(5e-3), nranks=2,
+                                   axis_name="dp").minimize(loss)
+        if pipelined:
+            apply_pipeline(main, 2, 2)
+            axes, shape = ("dp", "pp"), (2, 2)
+        else:
+            set_microbatches(main, 2)
+            axes, shape = ("dp",), (2,)
+        n = int(np.prod(shape))
+        mesh = Mesh(np.array(jax.devices()[:n]).reshape(shape), axes)
+        prog = CompiledProgram(main).with_mesh(
+            mesh, loss_name=None, batch_axis="dp",
+            build_strategy=BuildStrategy())
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for i in range(STEPS):
+                (l,) = exe.run(prog, feed={"x": _XS[i], "label": _YS[i]},
+                               fetch_list=[loss])
+                losses.append(np.asarray(l).ravel())
+            w1 = np.asarray(scope.find_var("w1"))
+        return np.asarray(losses, dtype=np.float64), w1
+
+    lb, wb = build(False)
+    lp, wp = build(True)
+    assert np.abs(lb - lp).max() <= 1e-6
+    assert np.abs(wb - wp).max() <= 1e-6
+
+
+def test_pipelined_fetch_of_intermediate_raises():
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 16], append_batch_size=False)
+        y = layers.data("label", shape=[-1, 1], dtype="float32",
+                        append_batch_size=False)
+        h = layers.fc(x, 32, act="relu",
+                      param_attr=fluid.ParamAttr(name="w1"))
+        p = layers.fc(h, 1, param_attr=fluid.ParamAttr(name="w3"))
+        loss = layers.mean(layers.square(p - y))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    set_microbatches(main, 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(InvalidArgumentError,
+                           match="per-microbatch"):
+            exe.run(main, feed={"x": _XS[0], "label": _YS[0]},
+                    fetch_list=[h.name])
+
+
+# ---------------------------------------------------------------------------
+# rematerialization
+# ---------------------------------------------------------------------------
+
+
+def _bert_tiny_train():
+    from paddle_tpu.models import bert
+    cfg = bert.BertConfig.tiny()
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        feeds, loss = bert.build_pretrain_network_parallel(cfg)
+        fluid.optimizer.Adam(1e-4).minimize(loss)
+    batch = bert.make_fake_parallel_batch(np.random.RandomState(0), cfg,
+                                          batch_size=8, seq_len=64)
+    fs = {k: (tuple(v.shape), str(v.dtype)) for k, v in batch.items()}
+    return main, startup, loss, fs, batch
+
+
+def test_plan_remat_reduces_estimate_and_prices_flops():
+    main, _, loss, fs, _ = _bert_tiny_train()
+    plan = plan_remat(main, feed_shapes=fs, fetch_names=[loss.name])
+    assert plan is not None
+    assert plan.est_after.peak_bytes < plan.est_before.peak_bytes
+    assert plan.flops_delta > 0
+    assert plan.checkpoints and plan.num_segments >= 2
+
+
+def test_remat_on_reject_flag_admits_over_budget_program():
+    from paddle_tpu import flags
+    from paddle_tpu.framework.memory_analysis import (analyze_memory,
+                                                      check_hbm_budget)
+    main, _, loss, fs, _ = _bert_tiny_train()
+    est = analyze_memory(main, feed_shapes=fs, fetch_names=[loss.name])
+    plan = plan_remat(main.clone(), feed_shapes=fs,
+                      fetch_names=[loss.name])
+    # a budget between the remat-ed and the base peak: base rejects,
+    # remat fits
+    budget = (plan.est_after.peak_bytes + est.peak_bytes) / 2 / (1 << 30)
+    with pytest.raises(InvalidArgumentError, match="hbm_budget_gb"):
+        check_hbm_budget(main.clone(), feed_shapes=fs,
+                         fetch_names=[loss.name], budget_gb=budget)
+    flags.set_flags({"remat_on_reject": True})
+    try:
+        est2 = check_hbm_budget(main, feed_shapes=fs,
+                                fetch_names=[loss.name],
+                                budget_gb=budget)
+    finally:
+        flags.set_flags({"remat_on_reject": False})
+    assert est2 is not None and est2.peak_gb <= budget
+    bw = next(op for op in main.global_block().ops
+              if op.type == "backward")
+    assert bw.attrs.get("checkpoints")
+
+
+def test_remat_program_still_trains_to_parity():
+    def remat(p):
+        plan = plan_remat(p, feed_shapes={"x": ((8, 16), "float32"),
+                                          "label": ((8, 1), "float32")})
+        assert plan is not None
+        apply_remat(p, plan)
+
+    lb, wb = _train(lambda p: None)
+    lr, wr = _train(remat)
+    a = np.asarray(lb, dtype=np.float64).ravel()
+    b = np.asarray(lr, dtype=np.float64).ravel()
+    assert np.abs(a - b).max() <= 1e-6
+    assert np.abs(wb - wr).max() <= 1e-6
+
+
+# ---------------------------------------------------------------------------
+# the extended planner
+# ---------------------------------------------------------------------------
+
+
+def test_enumerate_layouts_pipe_dimension():
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        loss = _model()
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    # opt-in only: default stays (data, fsdp, tp)
+    assert all(l.pipe == 1 for l in enumerate_layouts(main, 8))
+    layouts = enumerate_layouts(main, 8, max_pipe=4)
+    pipes = {l.pipe for l in layouts}
+    assert pipes == {1, 2, 4}
+    assert all(l.num_devices == 8 for l in layouts)
+    # inference programs never enumerate pipe > 1
+    reset_default_programs()
+    infer, startup = Program(), Program()
+    with program_guard(infer, startup):
+        _model()
+    assert all(l.pipe == 1
+               for l in enumerate_layouts(infer, 8, max_pipe=4))
+
+
+def test_planner_pipe_and_remat_rows_zero_compiles():
+    main, _, loss, fs, _ = _bert_tiny_train()
+    bs = BuildStrategy()
+    bs.fuse_all_reduce_ops = True
+    before = int(stat("executor_compile_count").get())
+    probe = plan_sharding(main, 4, loss_name=loss.name, feed_shapes=fs,
+                          fetch_names=[loss.name], build_strategy=bs,
+                          max_pipe=2, num_microbatches=4)
+    peaks = [c.peak_bytes for c in probe.configs
+             if c.peak_bytes is not None]
+    budget = min(peaks) * 0.92 / (1 << 30)
+    plan = plan_sharding(main, 4, loss_name=loss.name, feed_shapes=fs,
+                         fetch_names=[loss.name], build_strategy=bs,
+                         max_pipe=2, num_microbatches=4,
+                         hbm_budget_gb=budget, remat=True)
+    assert int(stat("executor_compile_count").get()) == before, \
+        "the plan search attempted a compile"
+    pipes = {c.layout.pipe for c in plan.configs}
+    assert pipes == {1, 2}
+    # pipe rows carry the bubble term: cost > exposed
+    for c in plan.configs:
+        if c.layout.pipe > 1 and c.exposed:
+            assert c.exposed["pipe_bubble_s"] > 0
+            assert c.cost_s > c.exposed_comm_s
+    # every base row rejected; at least one remat sibling admitted with
+    # a priced FLOPs delta — the budget flip
+    assert all(not c.fits for c in plan.configs if not c.remat)
+    flipped = [c for c in plan.configs if c.remat and c.fits]
+    assert flipped and all(c.remat_plan.flops_delta > 0 for c in flipped)
+    assert plan.winner is not None and plan.winner.remat
+
+
+def test_auto_shard_pipe_winner_runs():
+    """auto_shard with the pipe dimension forced to win (pipe-only
+    device split) stamps, builds the pp mesh and trains."""
+    from paddle_tpu.distributed.fleet import (DistributedStrategy,
+                                              distributed_optimizer,
+                                              fleet)
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        loss = _model()
+        s = DistributedStrategy()
+        s.auto_shard = True
+        s.auto_shard_configs = dict(
+            s.auto_shard_configs, num_devices=2, max_pipe=2,
+            num_microbatches=2,
+            feed_shapes={"x": ((8, 16), "float32"),
+                         "label": ((8, 1), "float32")})
+        opt = distributed_optimizer(fluid.optimizer.Adam(5e-3), s)
+        opt.minimize(loss)
+    assert fleet.plan is not None
+    assert {c.layout.pipe for c in fleet.plan.configs} == {1, 2}
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (l,) = exe.run(fleet.main_program,
+                       feed={"x": _XS[0], "label": _YS[0]},
+                       fetch_list=[loss])
+        assert np.isfinite(np.asarray(l)).all()
+
+
+# ---------------------------------------------------------------------------
+# diagnostics + satellite knobs
+# ---------------------------------------------------------------------------
+
+
+def test_pipe_collective_crosses_stage_diagnostic():
+    from paddle_tpu.framework.analysis import (
+        PIPE_COLLECTIVE_CROSSES_STAGE, verify_program)
+    prog = Program()
+    b = prog.global_block()
+    b.create_var(name="x", shape=(8, 4), dtype="float32", is_data=True)
+    b.create_var(name="h", shape=(8, 4), dtype="float32")
+    b.append_op(type="scale", inputs={"X": ["x"]}, outputs={"Out": ["h"]},
+                attrs={"scale": 1.0, "_pipe_stage": 0})
+    b.append_op(type="c_allreduce_sum", inputs={"X": ["h"]},
+                outputs={"Out": ["h"]},
+                attrs={"ring_id": 0, "_axis_name": "tp",
+                       "_pipe_stage": 1})
+    b.append_op(type="backward", inputs={}, outputs={},
+                attrs={"loss_name": "h", "param_names": [],
+                       "pipe_stages": 2, "pipe_microbatches": 2,
+                       "pipe_axis": "pp", "pipe_boundaries": [["h"]]})
+    res = verify_program(prog)
+    hits = res.by_code(PIPE_COLLECTIVE_CROSSES_STAGE)
+    assert len(hits) == 1 and "stage 0" in hits[0].message
+
+
+def test_remat_recompute_side_effect_diagnostic():
+    from paddle_tpu.framework.analysis import (
+        REMAT_RECOMPUTE_SIDE_EFFECT, verify_program)
+    prog = Program()
+    b = prog.global_block()
+    b.create_var(name="x", shape=(8, 4), dtype="float32", is_data=True)
+    for n in ("d", "m", "ck"):
+        b.create_var(name=n, shape=(8, 4), dtype="float32")
+    b.append_op(type="dropout", inputs={"X": ["x"]},
+                outputs={"Out": ["d"], "Mask": ["m"]},
+                attrs={"dropout_prob": 0.5, "is_test": False})
+    b.append_op(type="scale", inputs={"X": ["d"]},
+                outputs={"Out": ["ck"]}, attrs={"scale": 1.0})
+    b.append_op(type="backward", inputs={}, outputs={},
+                attrs={"loss_name": "ck", "param_names": [],
+                       "checkpoints": ["ck"]})
+    res = verify_program(prog)
+    assert len(res.by_code(REMAT_RECOMPUTE_SIDE_EFFECT)) == 1
+    # the audited-key stamp (pipe.apply_remat's contract) silences it
+    b.ops[0].attrs["_folded_key"] = True
+    prog._bump_version()
+    assert not verify_program(prog).by_code(REMAT_RECOMPUTE_SIDE_EFFECT)
+
+
+def test_overlap_compute_frac_flag():
+    """Satellite: the 2/3 overlap constant is a flag now — default
+    bit-identical, tunable for measured-cost calibration."""
+    from paddle_tpu import flags
+    from paddle_tpu.framework.memory_analysis import exposed_comm_model
+    wire = {"grad_sync_wire_bytes": 9 * 10 ** 9,
+            "forward_wire_bytes": 10 ** 9}
+    base = exposed_comm_model(wire, flops_total=3e12, num_devices=2,
+                              overlap=True, ici_gbps=1.0,
+                              peak_flops=1e12)
+    # default = the historical hard-coded constant, bit-for-bit
+    assert base["overlap_compute_frac"] == 2.0 / 3.0
+    assert base["overlappable_compute_s"] == \
+        pytest.approx(1.5 * (2.0 / 3.0))
+    assert base["cost_s"] == base["exposed_comm_s"]
+    flags.set_flags({"overlap_compute_frac": 0.5})
+    try:
+        half = exposed_comm_model(wire, flops_total=3e12, num_devices=2,
+                                  overlap=True, ici_gbps=1.0,
+                                  peak_flops=1e12)
+    finally:
+        flags.set_flags({"overlap_compute_frac": 2.0 / 3.0})
+    assert half["overlappable_compute_s"] == pytest.approx(0.75)
+    assert half["exposed_comm_s"] > base["exposed_comm_s"]
+
+
+def test_mesh_layout_pipe_axis_roundtrip():
+    lay = MeshLayout(data=2, fsdp=1, tp=1, pipe=4)
+    assert lay.pipe == 4 and lay.num_devices == 8
+    assert lay.sizes["pp"] == 4
+    assert lay.batch_axes == "dp"        # pipe never shards the batch
+    back = MeshLayout.from_desc(lay.to_desc())
+    assert back == lay and back.pipe == 4
+    # pipe-less layouts keep the exact historical sizes dict
+    assert MeshLayout(data=8).sizes == {"dp": 8, "fsdp": 1, "tp": 1}
+
+
+# ---------------------------------------------------------------------------
+# the artifact contract (tools/pipe_probe.py)
+# ---------------------------------------------------------------------------
+
+
+def test_pipe_search_artifact_contract():
+    path = os.path.join(REPO, "PIPE_SEARCH_r17.json")
+    assert os.path.exists(path), "run tools/pipe_probe.py"
+    with open(path) as f:
+        art = json.load(f)
+    assert art["artifact"] == "PIPE_SEARCH"
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import pipe_probe
+    finally:
+        sys.path.pop(0)
+    assert pipe_probe.check(art)
+
+
+def test_pipe_probe_wired_into_preflight():
+    with open(os.path.join(REPO, "tools", "preflight.sh")) as f:
+        sh = f.read()
+    assert "pipe_probe.py --selftest" in sh

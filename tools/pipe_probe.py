@@ -1,0 +1,356 @@
+"""Pipeline-parallelism + rematerialization probe: prove the 1F1B
+stage-cut lowering and the extended planner on the BERT-tiny workload
+and emit the auditable ``PIPE_SEARCH_r17.json`` artifact.
+
+Four legs (all CPU, 8 virtual devices; every assertion re-runs in
+tier-1 via tests/test_pipeline.py's artifact-contract test):
+
+* **parity** — the SAME stage-cut program trains on dp2·pp2 (1F1B over
+  the ``pp`` mesh axis, through the PREPARED fast path) and on a plain
+  dp2 mesh (the pipe = 1 degenerate: stages sequential, microbatches
+  still accumulated); per-step losses must agree ≤ 1e-6 over ≥ 5 steps.
+  A pp4 leg (4 stages, no data axis) checks the deeper pipeline against
+  the single-device microbatched baseline.
+* **census** — the stage partition (op counts, FLOPs balance), per-cut
+  boundary tensors and their statically priced ppermute wire bytes (the
+  ``pipe_stage_boundary`` op's ``wire()`` spec), and the full static
+  1F1B schedule table (``pipe.schedule_1f1b`` — warm-up, steady
+  one-forward-one-backward alternation, cooldown) the lowering's scan
+  follows.
+* **plan search** — ``plan_sharding`` over (data, fsdp, tp, pipe) with
+  ``max_pipe=4`` × microbatching: every config priced statically, pipe
+  configs carrying the ``(pipe−1)/M`` bubble term, and ZERO executor
+  compiles during the whole search (monitor stat delta).
+* **budget flip** — with ``hbm_budget_gb`` forced below every config's
+  peak, the base rows all reject; ``remat=True`` prices rematerialized
+  siblings (recompute checkpoints at the liveness-identified residual
+  minima) and at least one flips to an ADMITTED config with the
+  recompute FLOPs delta recorded — an over-budget reject becomes a
+  fitting plan instead of a failure.
+
+Usage:
+    PYTHONPATH=/root/repo python tools/pipe_probe.py [out.json]
+    PYTHONPATH=/root/repo python tools/pipe_probe.py --selftest
+"""
+
+import json
+import os
+import sys
+
+ARTIFACT = "PIPE_SEARCH_r17.json"
+STEPS = 5
+MICROBATCHES = 4
+
+
+def _env8():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _build(cfg):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.core import (Program,
+                                           reset_default_programs)
+    from paddle_tpu.models import bert
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        feeds, loss = bert.build_pretrain_network_parallel(cfg)
+        fluid.optimizer.Adam(1e-4).minimize(loss)
+    return main, startup, loss
+
+
+def _train(main, startup, loss, mesh_axes, build_strategy):
+    """STEPS batches through the PREPARED fast path; returns the
+    per-step loss vectors (fetch merge over the data axis)."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.compiler import CompiledProgram
+
+    prog = main
+    if mesh_axes:
+        names = tuple(a for a, _ in mesh_axes)
+        sizes = tuple(n for _, n in mesh_axes)
+        ndev = int(np.prod(sizes))
+        devs = np.array(jax.devices()[:ndev]).reshape(sizes)
+        mesh = Mesh(devs, names)
+        prog = CompiledProgram(main).with_mesh(
+            mesh, loss_name=loss.name, batch_axis="dp",
+            build_strategy=build_strategy)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    from paddle_tpu.models import bert
+    cfg = bert.BertConfig.tiny()
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        prepared = exe.prepare(prog, fetch_list=[loss], scope=scope)
+        for i in range(STEPS):
+            batch = bert.make_fake_parallel_batch(
+                np.random.RandomState(100 + i), cfg, batch_size=8,
+                seq_len=64)
+            (h,) = prepared.run(batch)
+            losses.append(np.asarray(h.numpy()).ravel().tolist())
+        prepared.close()
+    return losses
+
+
+def run_parity():
+    """dp2·pp2 and pp4 vs their non-pipelined microbatched baselines."""
+    import numpy as np
+    from paddle_tpu.framework.compiler import BuildStrategy
+    from paddle_tpu.framework.pipe import apply_pipeline, set_microbatches
+    from paddle_tpu.models import bert
+
+    cfg = bert.BertConfig.tiny()
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+    batch = bert.make_fake_parallel_batch(np.random.RandomState(0), cfg,
+                                          batch_size=8, seq_len=64)
+    feed_shapes = {k: (tuple(v.shape), str(v.dtype))
+                   for k, v in batch.items()}
+
+    def bs():
+        b = BuildStrategy()
+        b.fuse_all_reduce_ops = True
+        return b
+
+    legs = {}
+    reports = {}
+    # dp2 baseline (microbatched, no stages)
+    main, startup, loss = _build(cfg)
+    set_microbatches(main, MICROBATCHES)
+    legs["dp2_base"] = _train(main, startup, loss, [("dp", 2)], bs())
+    # dp2 x pp2
+    main, startup, loss = _build(cfg)
+    reports["pp2"] = apply_pipeline(main, 2, MICROBATCHES,
+                                    feed_shapes=feed_shapes)
+    legs["dp2_pp2"] = _train(main, startup, loss,
+                             [("dp", 2), ("pp", 2)], bs())
+    # single-device baseline
+    main, startup, loss = _build(cfg)
+    set_microbatches(main, MICROBATCHES)
+    legs["dp1_base"] = _train(main, startup, loss, [], bs())
+    # pp4
+    main, startup, loss = _build(cfg)
+    reports["pp4"] = apply_pipeline(main, 4, MICROBATCHES,
+                                    feed_shapes=feed_shapes)
+    legs["pp4"] = _train(main, startup, loss, [("pp", 4)], bs())
+
+    def max_delta(a, b):
+        return max(abs(x - y) for ra, rb in zip(a, b)
+                   for x, y in zip(ra, rb))
+
+    parity = {
+        "steps": STEPS,
+        "num_microbatches": MICROBATCHES,
+        "losses": legs,
+        "dp2_pp2_max_loss_delta": max_delta(legs["dp2_base"],
+                                            legs["dp2_pp2"]),
+        "pp4_max_loss_delta": max_delta(legs["dp1_base"], legs["pp4"]),
+        "bound": 1e-6,
+        "prepared_fast_path": True,
+    }
+    return parity, reports
+
+
+def run_census(reports):
+    """Static stage/boundary/wire census of the pipelined programs."""
+    import numpy as np
+    from paddle_tpu.framework.memory_analysis import \
+        collective_wire_summary
+    from paddle_tpu.framework.pipe import apply_pipeline
+    from paddle_tpu.models import bert
+
+    cfg = bert.BertConfig.tiny()
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+    batch = bert.make_fake_parallel_batch(np.random.RandomState(0), cfg,
+                                          batch_size=8, seq_len=64)
+    feed_shapes = {k: (tuple(v.shape), str(v.dtype))
+                   for k, v in batch.items()}
+    main, startup, loss = _build(cfg)
+    rep = apply_pipeline(main, 2, MICROBATCHES, feed_shapes=feed_shapes)
+    wire = collective_wire_summary(
+        main, feed_shapes=feed_shapes, fetch_names=[loss.name],
+        mesh_axes={"dp": 2, "pp": 2}, batch_axis="dp")
+    block = main.global_block()
+    n_boundary = sum(1 for op in block.ops
+                     if op.type == "pipe_stage_boundary")
+    sched = rep["schedule"]
+    return {
+        "stages": rep["num_stages"],
+        "num_microbatches": rep["num_microbatches"],
+        "cuts": rep["cuts"],
+        "stage_ops": rep["stage_ops"],
+        "stage_flops": rep["stage_flops"],
+        "boundaries": rep["boundaries"],
+        "boundary_bytes": rep["boundary_bytes"],
+        "boundary_ops": n_boundary,
+        "pipe_grad_sync_ops": rep["grad_sync_ops"],
+        "wire_by_op": {k: dict(v) for k, v in wire["by_op"].items()},
+        "schedule_1f1b": {
+            "ticks": sched["ticks"],
+            "slots": sched["slots"],
+            "bubble_frac": sched["bubble_frac"],
+            "order": [list(t) for t in sched["order"]],
+        },
+    }
+
+
+def run_plan():
+    """The (data, fsdp, tp, pipe, remat) search + the forced budget
+    flip; returns (plan_dict, flip_dict, compile_delta)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.core import Program, reset_default_programs
+    from paddle_tpu.framework.compiler import BuildStrategy
+    from paddle_tpu.framework.shard_planner import plan_sharding
+    from paddle_tpu.models import bert
+    from paddle_tpu.monitor import stat
+
+    cfg = bert.BertConfig.tiny()
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        feeds, loss = bert.build_pretrain_network_parallel(cfg,
+                                                           tp_degree=2)
+        fluid.optimizer.Adam(1e-4).minimize(loss)
+    batch = bert.make_fake_parallel_batch(np.random.RandomState(0), cfg,
+                                          batch_size=8, seq_len=64)
+    feed_shapes = {k: (tuple(v.shape), str(v.dtype))
+                   for k, v in batch.items()}
+    bs = BuildStrategy()
+    bs.fuse_all_reduce_ops = True
+    bs.overlap_grad_sync = True
+
+    compiles_before = int(stat("executor_compile_count").get())
+    probe = plan_sharding(main, 8, loss_name=loss.name,
+                          feed_shapes=feed_shapes,
+                          fetch_names=[loss.name], build_strategy=bs,
+                          max_pipe=4, num_microbatches=MICROBATCHES,
+                          module="dp8_bert_tiny_tp2_pipe")
+    peaks = sorted(c.peak_bytes for c in probe.configs
+                   if c.peak_bytes is not None)
+    # budget BELOW every base config's peak: everything rejects, only
+    # remat siblings can fit — the forced flip
+    budget_gb = round(peaks[0] * 0.92 / float(1 << 30), 6)
+    plan = plan_sharding(main, 8, loss_name=loss.name,
+                         feed_shapes=feed_shapes,
+                         fetch_names=[loss.name],
+                         hbm_budget_gb=budget_gb, build_strategy=bs,
+                         max_pipe=4, num_microbatches=MICROBATCHES,
+                         remat=True,
+                         module="dp8_bert_tiny_tp2_pipe")
+    compile_delta = int(stat("executor_compile_count").get()) \
+        - compiles_before
+    flipped = [c for c in plan.configs if c.remat and c.fits]
+    flip = {
+        "hbm_budget_gb": budget_gb,
+        "base_configs_fitting": sum(
+            1 for c in plan.configs if not c.remat and c.fits),
+        "remat_configs_admitted": len(flipped),
+        "winner_remat": bool(plan.winner is not None
+                             and plan.winner.remat),
+        "flipped": [
+            {"data": c.layout.data, "fsdp": c.layout.fsdp,
+             "tp": c.layout.tp, "pipe": c.layout.pipe,
+             "peak_bytes": c.peak_bytes,
+             "recompute_flops_delta": c.remat_plan.flops_delta,
+             "num_segments": c.remat_plan.num_segments}
+            for c in flipped],
+    }
+    return plan.as_dict(), flip, compile_delta
+
+
+def check(art):
+    """The artifact's promises (re-asserted in tier-1)."""
+    p = art["parity"]
+    assert p["steps"] >= 5
+    assert p["dp2_pp2_max_loss_delta"] <= p["bound"], \
+        f"dp2·pp2 loss parity {p['dp2_pp2_max_loss_delta']} > 1e-6"
+    assert p["pp4_max_loss_delta"] <= p["bound"], \
+        f"pp4 loss parity {p['pp4_max_loss_delta']} > 1e-6"
+    c = art["census"]
+    assert c["stages"] == 2 and len(c["cuts"]) == 1
+    assert c["boundary_ops"] == 1 and c["pipe_grad_sync_ops"] >= 1
+    assert all(b > 0 for b in c["boundary_bytes"])
+    assert "pipe_stage_boundary" in c["wire_by_op"] and \
+        c["wire_by_op"]["pipe_stage_boundary"]["wire_bytes"] > 0
+    sched = c["schedule_1f1b"]
+    order = [tuple(t) for t in sched["order"]]
+    # the 1F1B shape: every (stage, phase, mb) unit exactly once, and
+    # in the steady state the last stage strictly alternates F/B
+    S, M = c["stages"], c["num_microbatches"]
+    assert len(order) == 2 * S * M
+    last_stage = [t for t in order if t[1] == S - 1]
+    phases = [t[2] for t in last_stage]
+    assert phases == ["F", "B"] * M, \
+        f"last stage is not 1F1B-alternating: {phases}"
+    assert sched["bubble_frac"] == (S - 1) / M
+    plan = art["plan"]
+    assert plan["compiles_attempted"] == 0
+    assert art["plan_compile_delta"] == 0, \
+        f"{art['plan_compile_delta']} compiles during the search"
+    pipes = {cfg["pipe"] for cfg in plan["configs"]}
+    assert pipes >= {1, 2, 4}, f"pipe dimension not searched: {pipes}"
+    assert {cfg["tp"] for cfg in plan["configs"]} >= {1, 2}
+    assert any(cfg["remat"] for cfg in plan["configs"])
+    flip = art["budget_flip"]
+    assert flip["base_configs_fitting"] == 0, \
+        "budget did not reject the base configs"
+    assert flip["remat_configs_admitted"] >= 1, \
+        "remat flipped nothing into admission"
+    assert plan["winner"] is not None and plan["winner"]["remat"]
+    assert all(f["recompute_flops_delta"] > 0 for f in flip["flipped"])
+    return True
+
+
+def main(argv):
+    _env8()
+    out_path = ARTIFACT
+    selftest = "--selftest" in argv
+    args = [a for a in argv if not a.startswith("--")]
+    if args:
+        out_path = args[0]
+
+    parity, reports = run_parity()
+    census = run_census(reports)
+    plan, flip, compile_delta = run_plan()
+    art = {
+        "artifact": "PIPE_SEARCH",
+        "format_version": 1,
+        "module": "bert_tiny_pipeline",
+        "parity": parity,
+        "census": census,
+        "plan": plan,
+        "plan_compile_delta": compile_delta,
+        "budget_flip": flip,
+    }
+    check(art)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isabs(out_path):
+        out_path = os.path.join(repo, out_path)
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(f"wrote {out_path}")
+    print(f"  dp2·pp2 max loss delta {parity['dp2_pp2_max_loss_delta']:g}"
+          f" / pp4 {parity['pp4_max_loss_delta']:g} (bound 1e-6)")
+    print(f"  plan: {len(plan['configs'])} configs, 0 compiles; "
+          f"remat admitted {flip['remat_configs_admitted']} config(s) "
+          f"under the forced budget")
+    if selftest:
+        print("pipe_probe selftest OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

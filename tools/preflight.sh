@@ -35,6 +35,12 @@ echo "   checkpoint onto dp4/dp16 + tp2->tp1 flip, planned==executed wire"
 echo "   bytes, parity <=1e-6, 0 compiles on rejected candidates) =="
 python tools/reshard_probe.py --selftest
 
+echo "== preflight: pipeline probe (dp2.pp2 + pp4 BERT-tiny 1F1B parity"
+echo "   <=1e-6 vs the microbatched baseline, stage/boundary census, the"
+echo "   (data,fsdp,tp,pipe,remat) search with 0 compiles + remat budget"
+echo "   flip -> PIPE_SEARCH_r17.json) =="
+python tools/pipe_probe.py --selftest
+
 echo "== preflight: auto-shard plan probe (dp8 BERT-tiny tp2: >=6 configs"
 echo "   priced, winner min-EXPOSED-comm among budget-fitting, ties to"
 echo "   fewer wire bytes, 0 compiles) =="

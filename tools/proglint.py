@@ -280,6 +280,63 @@ def selftest(memory=False) -> int:
               f"(expected once, on the hook-less bucket)")
         return 1
 
+    # pipeline/remat soundness (framework/pipe.py rewrites): a collective
+    # stranded across a stage cut must error; an RNG op inside a
+    # recompute segment must warn until its key is audited (_folded_key)
+    from paddle_tpu.framework.analysis import (
+        PIPE_COLLECTIVE_CROSSES_STAGE, REMAT_RECOMPUTE_SIDE_EFFECT)
+    pp = Program()
+    pb = pp.global_block()
+    for n in ("px", "ph"):
+        pb.create_var(name=n, shape=(8, 16), dtype="float32",
+                      is_data=(n == "px"))
+    pb.create_var(name="pd", shape=(8, 16), dtype="float32")
+    pb.append_op(type="scale", inputs={"X": ["px"]},
+                 outputs={"Out": ["ph"]},
+                 attrs={"scale": 2.0, "_pipe_stage": 0})
+    pb.append_op(type="dropout", inputs={"X": ["ph"]},
+                 outputs={"Out": ["pd"], "Mask": ["pd_mask"]},
+                 attrs={"dropout_prob": 0.5, "is_test": False,
+                        "_pipe_stage": 0})
+    pb.create_var(name="pd_mask", shape=(8, 16), dtype="float32")
+    pb.append_op(type="pipe_stage_boundary", inputs={"X": ["pd"]},
+                 outputs={"Out": ["pd"]},
+                 attrs={"_axis_name": "pp", "_pipe_cut": 0,
+                        "_pipe_stage": 0})
+    # the stranded collective: stage 1, reading a stage-0 value
+    pb.append_op(type="c_allreduce_sum", inputs={"X": ["ph"]},
+                 outputs={"Out": ["ph"]},
+                 attrs={"ring_id": 0, "_axis_name": "tp",
+                        "_pipe_stage": 1})
+    pb.append_op(type="backward", inputs={}, outputs={},
+                 attrs={"loss_name": "pd", "param_names": [],
+                        "pipe_stages": 2, "pipe_microbatches": 2,
+                        "pipe_axis": "pp", "pipe_boundaries": [["pd"]],
+                        "checkpoints": ["pd"]})
+    pres = verify_program(pp)
+    crossed = pres.by_code(PIPE_COLLECTIVE_CROSSES_STAGE)
+    rng_warn = pres.by_code(REMAT_RECOMPUTE_SIDE_EFFECT)
+    if len(crossed) != 1 or "c_allreduce_sum" not in crossed[0].message:
+        print(f"proglint selftest: pipe-collective-crosses-stage fired "
+              f"{len(crossed)}x (expected once, on the stranded "
+              f"collective)")
+        return 1
+    if len(rng_warn) != 1 or "dropout" not in rng_warn[0].message:
+        print(f"proglint selftest: remat-recompute-side-effect fired "
+              f"{len(rng_warn)}x (expected once, on the recomputed "
+              f"dropout)")
+        return 1
+    # stamping the audited key silences the warning (pipe.apply_remat's
+    # contract)
+    for op in pb.ops:
+        if op.type == "dropout":
+            op.attrs["_folded_key"] = True
+    pp._bump_version()
+    if verify_program(pp).by_code(REMAT_RECOMPUTE_SIDE_EFFECT):
+        print("proglint selftest: remat-recompute-side-effect still "
+              "fires after _folded_key")
+        return 1
+
     # kernel-routing report (the Pallas tier, statically): the training
     # program must yield a non-empty report whose fused-Adam summary has
     # hits (the 128-wide BERT-tiny params tile), every row carries a
