@@ -71,6 +71,13 @@ class PreemptionHandler:
         self._preempted = False
         self._status = io.TrainStatus(-1)
         self._chained = {}
+        # restore atomicity: signals arriving while load_checkpoint /
+        # execute_reshard is mid-flight are DEFERRED (not flagged, not
+        # chained) until the scope holds fully-restored state — a
+        # handler firing mid-restore must never lead to publishing a
+        # checkpoint of half-restored state
+        self._restoring = False
+        self._deferred: list = []
         sigs = list(signals)
         if catch_sigint and signal.SIGINT not in sigs:
             sigs.append(signal.SIGINT)
@@ -82,6 +89,12 @@ class PreemptionHandler:
                 self._chained[sig] = prev
 
     def _on_signal(self, signum, frame):
+        if self._restoring:
+            # mid-restore: defer everything (flag AND chain) until the
+            # restore completes — the chained handler may exit/save, and
+            # either would act on half-restored state
+            self._deferred.append(signum)
+            return
         # only set a flag — checkpointing mid-step would tear the state
         self._preempted = True
         prev = self._chained.get(signum)
@@ -96,16 +109,31 @@ class PreemptionHandler:
     def restore(self) -> io.TrainStatus:
         """Load the newest valid checkpoint (no-op on cold start);
         reshards automatically when it was written under a different
-        mesh layout (the elastic-relaunch path)."""
-        st = io.load_checkpoint(self._exe, self._path,
-                                main_program=self._program,
-                                scope=self._scope)
+        mesh layout (the elastic-relaunch path).  Restore is ATOMIC with
+        respect to the handled signals: a SIGTERM landing mid-load /
+        mid-reshard-execute is deferred until the scope holds the fully
+        restored state, then replayed (flag + chain)."""
+        self._restoring = True
+        try:
+            st = io.load_checkpoint(self._exe, self._path,
+                                    main_program=self._program,
+                                    scope=self._scope)
+        finally:
+            self._restoring = False
+            deferred, self._deferred = self._deferred, []
+            for signum in deferred:
+                self._on_signal(signum, None)
         if st.epoch_no < 0:
             st.step = -1          # cold start: resume loop starts at 0
         self._status = st
         return self._status
 
     def save(self, step: int):
+        if self._restoring:
+            from ..framework.errors import PreconditionNotMetError
+            raise PreconditionNotMetError(
+                "PreemptionHandler.save() during restore — a checkpoint "
+                "of half-restored state must never be published")
         self._status = io.TrainStatus(epoch_no=step, step=step)
         io.save_checkpoint(self._exe, self._path, self._status,
                            self._program, scope=self._scope,

@@ -173,6 +173,46 @@ _register("remat_on_reject", False)
 # the verifier warns so tiny buckets stay full-precision (raise
 # fuse_grad_size_in_MB to coalesce them instead).  0 disables the lint.
 _register("quant_min_bucket_kb", 16)
+# -- self-healing step runtime (framework/guardrails.py +
+# observability/watchdog.py) ------------------------------------------------
+# non-finite step defense: compute a fused all-finite reduction over the
+# loss + raw parameter gradients INSIDE the compiled step and gate every
+# written persistable with jnp.where on the result — a NaN/Inf step
+# leaves params and optimizer state BITWISE unchanged (no host sync; the
+# flag is part of the executable identity).  Off by default: the gate
+# adds extra state plumbing every census/baseline would have to absorb.
+_register("guard_nonfinite", False)
+# consecutive-skip budget: after this many non-finite steps IN A ROW the
+# prepared loop escalates to a controlled abort — flight bundle (with
+# the offending step's feed/RNG/program as replayable sidecars for
+# tools/replay_step.py) + GuardrailViolation.  0 disables escalation
+# (steps keep skipping forever).
+_register("max_skipped_steps", 10)
+# unified dynamic loss scaling for NON-AMP runs: scale the loss by the
+# guard's scale state before backward, unscale the grads, and drive the
+# scale through the SAME backoff/regrow policy the AMP decorator's
+# update_loss_scaling op uses (guardrails.scale_policy_update).  When
+# the program already carries AMP dynamic scaling this flag is ignored
+# (pick-one: AMP owns the scale; the guard still gates the update).
+_register("guard_loss_scale", False)
+_register("guard_loss_scale_init", 2.0 ** 15)
+_register("guard_incr_every_n_steps", 1000)
+_register("guard_incr_ratio", 2.0)
+_register("guard_decr_ratio", 0.5)
+_register("guard_loss_scale_max", 2.0 ** 16)
+# hang watchdog (observability/watchdog.py): when > 0, a daemon monitor
+# thread checks the step/serving/checkpoint progress beacons and, if a
+# unit of work has been in flight longer than this many seconds, dumps
+# all-thread stacks + a flight bundle and bumps watchdog::trip — a
+# silent wedge (stalled collective, deadlocked worker) becomes a
+# diagnosable event.  0 (default) disables the watchdog.
+_register("step_deadline_s", 0.0)
+# when the watchdog trips: also abort the process (os._exit) with
+# WATCHDOG_EXIT_CODE so a supervisor can restart it.  Off by default —
+# dump-and-continue is the observability mode; abort is the production
+# unattended-run mode.
+_register("watchdog_abort", False)
+
 # accepted no-ops: XLA owns these concerns (ref: flags.cc lines noted)
 _register("fraction_of_gpu_memory_to_use", 0.92, noop=True)   # :343
 _register("eager_delete_tensor_gb", 0.0, noop=True)           # :257

@@ -63,6 +63,14 @@ class FatalError(Error):
     code = "FATAL"
 
 
+class GuardrailViolation(Error):
+    """The self-healing step runtime's controlled abort: the bounded
+    consecutive-skip budget (``flag("max_skipped_steps")``) was
+    exhausted by non-finite steps — a flight bundle with replayable
+    sidecars was dumped before this raised (framework/guardrails.py)."""
+    code = "GUARDRAIL_VIOLATION"
+
+
 class EnforceNotMet(Error):
     """Runtime op failure with the op's Python creation site attached
     (ref: enforce.h EnforceNotMet + op_call_stack.cc

@@ -45,8 +45,27 @@ from ..observability.tracing import (is_enabled as _tracing_enabled,
                                      next_step_id as _next_step_id)
 from ..observability.flight import step_breadcrumb as _step_breadcrumb
 from ..observability import flight as _flight
+# hang-watchdog progress beacons (observability/watchdog.py): one
+# begin/end pair brackets each prepared step so a stalled
+# dispatch/collective is detectable; bound once like the breadcrumb
+from ..observability.watchdog import (begin as _wd_begin, end as _wd_end,
+                                      ensure_started as _wd_ensure)
+# deterministic fault-injection seams (testing/faultline.py); _FL_ARMED
+# is the live armed-spec dict — its truthiness gates every hot-path
+# crossing down to one dict test
+from ..testing import faultline as _faultline
+from ..testing.faultline import _ARMED as _FL_ARMED, _EPOCH as _FL_EPOCH
+from . import guardrails as _guardrails
 
 _RNG_VAR = "@RNG_STATE@"
+
+#: guardrail host-poll cadence: decode the (cumulative) guard counters
+#: from the newest completed step every N prepared steps.  Budget
+#: escalation therefore lags a NaN burst by at most N + the in-flight
+#: window; every blocking sync point (wait, guard_info(sync=True),
+#: telemetry reads) decodes immediately.
+_GUARD_DECODE_EVERY = 16
+_GUARD_PENDING_CAP = 64
 
 
 class Scope:
@@ -154,6 +173,12 @@ def run_ops(ops, env, ctx):
         try:
             impl = get_op(op.type)
             ins = _gather_inputs(op, env)
+            if _FL_ARMED:
+                # trace-time injection seam: a drill can make a chosen
+                # op's lowering raise (spec match={"op": <type>}) —
+                # wrapped below into the same EnforceNotMet a real
+                # lowering failure produces
+                _faultline.crossing("collective_impl", op=op.type)
             if traced:
                 # trace-time collective spans (once per compile, zero
                 # steady-state cost): kind/axis/wire bytes land on the
@@ -355,6 +380,8 @@ def _lower_microbatched(ops, env, ctx, bw_idx, fetch_names,
     for n in param_names:
         env2[grad_var_name(n)] = grads[n]
     env2[grad_var_name(loss_name)] = jnp.ones_like(loss_val)
+    _guardrails.stash_probe(env2, loss_name,
+                            [grad_var_name(n) for n in param_names], ctx)
     env2 = run_ops(tail_ops, env2, ctx)
     _check_pipe_fetches(env2, fetch_names, "microbatched lowering")
     return env2
@@ -571,6 +598,10 @@ def _lower_pipelined_1f1b(ops, env, ctx, bw_idx, fetch_names,
     for n in param_names:
         env2[grad_var_name(n)] = acc[n]
     env2[grad_var_name(loss_name)] = jnp.ones_like(lvar_mean)
+    # stage-partial grads: a NaN on any pp rank poisons the probe on
+    # every rank through the guard's all-axis psum
+    _guardrails.stash_probe(env2, loss_name,
+                            [grad_var_name(n) for n in param_names], ctx)
     env2 = run_ops(tail_ops, env2, ctx)
     _check_pipe_fetches(env2, fetch_names, "1F1B pipeline lowering")
     return env2
@@ -655,6 +686,15 @@ def lower_block_with_backward(ops, env, ctx, bw_idx, fetch_names,
         if loss_scale_var is not None:
             total = total * jax.lax.stop_gradient(
                 e[loss_scale_var].reshape(()).astype(total.dtype))
+        guard = getattr(ctx, "guard", None)
+        if guard is not None and guard.use_scale:
+            # guardrail dynamic loss scaling for non-AMP runs: same
+            # scale-into-backward shape as the AMP path above; the
+            # grads are unscaled (and the scale state updated through
+            # the shared policy) after value_and_grad returns
+            total = total * jax.lax.stop_gradient(
+                jnp.asarray(e[_guardrails.GUARD_SCALE]).reshape(())
+                .astype(total.dtype))
         return total, (e, sub.key)
 
     (loss_val, (env2, new_key)), grads = jax.value_and_grad(
@@ -664,6 +704,17 @@ def lower_block_with_backward(ops, env, ctx, bw_idx, fetch_names,
     for n in param_names:
         env2[grad_var_name(n)] = grads[n]
     env2[grad_var_name(loss_name)] = jnp.ones_like(env2[loss_name])
+    gnames = [grad_var_name(n) for n in param_names]
+    # non-finite defense: fault injection + fused finite probe over the
+    # RAW (possibly scaled) grads, before the tail's collectives /
+    # check_finite can rewrite them (framework/guardrails.py)
+    _guardrails.stash_probe(env2, loss_name, gnames, ctx)
+    guard = getattr(ctx, "guard", None)
+    if guard is not None and guard.use_scale:
+        s = jnp.asarray(env2[_guardrails.GUARD_SCALE]).reshape(())
+        for gn in gnames:
+            g = env2[gn]
+            env2[gn] = g / s.astype(g.dtype)
     if hooked_ids:
         # hooked buckets already reduced inside the backward sweep —
         # their grads arrived through value_and_grad; the tail op is
@@ -729,7 +780,10 @@ def _replicated_var_names(ops, bw_idx):
 class _CompiledStep:
     def __init__(self, fn, state_in_names, state_out_names, feed_names,
                  fetch_names, raw_fn=None, mesh=None, feed_spec_fn=None,
-                 state_in_specs=None, jit_fn=None):
+                 state_in_specs=None, jit_fn=None, guard=None):
+        # guardrail policy this step compiled with (None = unguarded);
+        # a guarded step's fetches carry the guard scalar tail
+        self.guard = guard
         self.fn = fn                 # jitted, donating state buffers
         self.raw_fn = raw_fn or fn   # unjitted pure step (for export)
         # the re-lowerable jax.jit wrapper when fn is a deserialized
@@ -1126,6 +1180,17 @@ class PreparedStep:
         self.stats = {"steps": 0, "blocking_syncs": 0, "max_inflight": 0,
                       "dispatch_ns": 0, "feed_wait_ns": 0,
                       "fetch_wait_ns": 0}
+        # guardrail bookkeeping (framework/guardrails.py): per-dispatch
+        # guard fetch handles pending a non-blocking host poll, and the
+        # latest resolved skip/scale facts for telemetry
+        self._guard_pending: collections.deque = collections.deque()
+        self._guard_tick = 0
+        self._guard_f32 = None
+        self._fl_epoch = _FL_EPOCH[0]
+        self.guard_stats: Dict[str, Any] = {
+            "steps": 0, "skipped_total": 0, "consecutive": 0,
+            "last_skipped": False, "loss_scale": None, "step": None}
+        _wd_ensure()        # hang watchdog, when step_deadline_s is set
         scope._prepared.add(self)
         if feed is not None:
             feed = dict(feed)
@@ -1175,9 +1240,13 @@ class PreparedStep:
                 if n not in self._state:
                     v = self._scope.find_var(n)
                     if v is None:
-                        raise RuntimeError(
-                            f"persistable var {n!r} not initialised in "
-                            f"scope — run the startup program first")
+                        if _guardrails.is_guard_var(n):
+                            v = _guardrails.init_value(n, step.guard)
+                        else:
+                            raise RuntimeError(
+                                f"persistable var {n!r} not initialised "
+                                f"in scope — run the startup program "
+                                f"first")
                     self._state[n] = v
         return step
 
@@ -1190,10 +1259,13 @@ class PreparedStep:
         for n in step.state_in_names:
             v = scope.find_var(n)
             if v is None:
-                raise RuntimeError(
-                    f"persistable var {n!r} not initialised in scope — run "
-                    f"the startup program first (ref semantics: executor.cc "
-                    f"scope vars)")
+                if _guardrails.is_guard_var(n):
+                    v = _guardrails.init_value(n, step.guard)
+                else:
+                    raise RuntimeError(
+                        f"persistable var {n!r} not initialised in scope "
+                        f"— run the startup program first (ref semantics: "
+                        f"executor.cc scope vars)")
             state[n] = v
         self._state = state
         rng = scope.find_var(_RNG_VAR)
@@ -1222,6 +1294,18 @@ class PreparedStep:
     def run(self, feed=None, return_numpy=False):
         """One training step.  Returns ``FetchHandle``s (device-resident;
         block on first read) unless ``return_numpy=True``."""
+        # watchdog beacon brackets the whole step so a stalled dispatch
+        # or window sync is detectable; the stall seam is the drill's
+        # way to induce exactly that hang
+        _wd_begin("prepared")
+        try:
+            if _FL_ARMED:
+                _faultline.crossing("step_stall")
+            return self._run_inner(feed, return_numpy)
+        finally:
+            _wd_end("prepared")
+
+    def _run_inner(self, feed, return_numpy):
         from ..flags import flag
         from ..profiler import RecordEvent
         # run-level step axis: one id per training step, shared with the
@@ -1237,6 +1321,15 @@ class PreparedStep:
                         for k, v in reader._next_feed().items():
                             feed.setdefault(k, v)
             self.stats["feed_wait_ns"] += time.perf_counter_ns() - t0
+        if self._fl_epoch != _FL_EPOCH[0]:
+            # faultline arm/disarm invalidates compiled steps: trace-time
+            # injections must never be masked by (or leak out of) a
+            # cached executable.  One list-index compare on the hot path.
+            self._fl_epoch = _FL_EPOCH[0]
+            self._steps.clear()
+            self._cur = None
+            self._cur_sig = None
+            self._cur_check = []
         if self._cur is not None and self._feed_matches(feed):
             step = self._cur
         else:
@@ -1306,6 +1399,26 @@ class PreparedStep:
             if len(self._inflight) > self.stats["max_inflight"]:
                 self.stats["max_inflight"] = len(self._inflight)
 
+        if step.guard is not None:
+            # split the non-donated guard scalar tail off the fetches
+            # and queue it for a NON-blocking host poll.  The decode
+            # (a device scalar read) runs every _GUARD_DECODE_EVERY
+            # steps — skip counters are CUMULATIVE, so sampling the
+            # newest completed step loses nothing — keeping the
+            # per-step cost to a deque append + counter check (the
+            # ≤5% stub-loop budget).  Blocking sync points (wait,
+            # guard_info(sync=True)) always decode, so the budget abort
+            # lags a burst by at most decode-period + window steps.
+            gvals = fetches[len(self._fetch_names):]
+            fetches = fetches[:len(self._fetch_names)]
+            pend = self._guard_pending
+            pend.append((sid, gvals, feed_vals, rng_key))
+            self._guard_tick += 1
+            if self._guard_tick >= _GUARD_DECODE_EVERY or \
+                    len(pend) > _GUARD_PENDING_CAP:
+                self._guard_tick = 0
+                self._guard_poll(block=False)
+
         if flag("benchmark"):
             # per-step wall-clock mode: barrier covers fetches AND the
             # carried state + RNG key, like Executor.run's
@@ -1317,6 +1430,74 @@ class PreparedStep:
         if return_numpy:
             return [h.numpy() for h in handles]
         return handles
+
+    # -- guardrails -------------------------------------------------------
+    def _guard_poll(self, block=False):
+        """Decode the NEWEST completed guard tail into ``guard_stats``
+        and enforce the consecutive-skip budget.  Older completed
+        entries are discarded undecoded — every guard counter is
+        cumulative, so the newest verdict subsumes them; this is what
+        keeps the hot-loop cost amortized to a fraction of a device
+        scalar read.  ``block=True`` (wait / guard_info(sync=True))
+        drains everything dispatched.  Raises
+        :class:`GuardrailViolation` (after dumping a flight bundle with
+        replayable sidecars) when the budget is exhausted."""
+        pend = self._guard_pending
+        if not pend:
+            return
+        newest = None
+        if block:
+            newest = pend[-1]
+            pend.clear()
+        else:
+            while pend:
+                e = pend[0]
+                ready = getattr(e[1][0], "is_ready", None)
+                if ready is not None and not ready():
+                    break
+                newest = pend.popleft()
+            if newest is None:
+                return
+        sid, gvals, feed_vals, rng_key = newest
+        i = np.asarray(_fetch_numpy(gvals[0])).reshape(4)
+        gs = self.guard_stats
+        gs["steps"] = int(i[3])
+        gs["last_skipped"] = bool(int(i[0]))
+        gs["consecutive"] = int(i[1])
+        gs["skipped_total"] = int(i[2])
+        gs["step"] = sid
+        # loss scale / probe decode deferred to guard_info (the f32 read
+        # is only paid by consumers that want it)
+        self._guard_f32 = gvals[1]
+        policy = self._cur.guard if self._cur is not None else None
+        budget = policy.max_skipped if policy is not None else 0
+        if budget and int(i[1]) > budget:
+            f = np.asarray(_fetch_numpy(gvals[1])).reshape(2)
+            _guardrails.dump_abort_bundle(
+                "guardrail_skip_budget_exhausted",
+                program=self._program, step_id=sid,
+                consecutive=int(i[1]), total=int(i[2]),
+                probe=np.float32(f[0]), scale=float(f[1]),
+                rng_key=rng_key, feed=feed_vals,
+                step_counter=int(i[3]) - 1)
+            from .errors import GuardrailViolation
+            raise GuardrailViolation(
+                f"non-finite step defense: {int(i[1])} consecutive "
+                f"skipped steps exceed flag('max_skipped_steps')="
+                f"{budget} at step {sid} — flight bundle dumped "
+                f"(framework/guardrails.py)")
+
+    def guard_info(self, sync=False) -> Dict[str, Any]:
+        """Latest resolved guardrail facts (skipped/consecutive/loss
+        scale) — the telemetry recorder's per-step source.  ``sync=True``
+        blocks until every dispatched step's verdict is in."""
+        self._guard_poll(block=sync)
+        f32 = getattr(self, "_guard_f32", None)
+        if f32 is not None:
+            f = np.asarray(_fetch_numpy(f32)).reshape(2)
+            self.guard_stats["loss_scale"] = float(f[1])
+            self._guard_f32 = None
+        return dict(self.guard_stats)
 
     # -- sync points ------------------------------------------------------
     def sync_scope(self):
@@ -1343,6 +1524,7 @@ class PreparedStep:
         if self._key is not None:
             jax.block_until_ready(self._key)
         self._inflight.clear()
+        self._guard_poll(block=True)
         return self
 
     def close(self):
@@ -1386,6 +1568,8 @@ class PreparedStep:
             v = state_src.get(n)
             if v is None:
                 v = self._scope.find_var(n)
+            if v is None and _guardrails.is_guard_var(n):
+                v = _guardrails.init_value(n, step.guard)
             if not hasattr(v, "dtype"):
                 v = np.asarray(v)
             abss[n] = jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
@@ -1496,9 +1680,13 @@ class Executor:
         for n in step.state_in_names:
             v = scope.find_var(n)
             if v is None:
-                raise RuntimeError(
-                    f"persistable var {n!r} not initialised in scope — run the "
-                    f"startup program first (ref semantics: executor.cc scope vars)")
+                if _guardrails.is_guard_var(n):
+                    v = _guardrails.init_value(n, step.guard)
+                else:
+                    raise RuntimeError(
+                        f"persistable var {n!r} not initialised in scope — "
+                        f"run the startup program first (ref semantics: "
+                        f"executor.cc scope vars)")
             state_in[n] = v
         key = scope.find_var(_RNG_VAR)
         if key is None:
@@ -1522,10 +1710,12 @@ class Executor:
                                       v)
                         for n, v in state_in.items()}
             key = _to_global(mesh, P(), key)
+        used_fast_path = True
         with RecordEvent("executor::run"):
             try:
                 if flag("check_nan_inf") and flag("check_nan_inf_per_op") \
                         and mesh is None:
+                    used_fast_path = False
                     fetches, state_out, new_key = self._run_per_op_debug(
                         program, step, feed_vals, state_in, key,
                         fetch_names)
@@ -1551,6 +1741,31 @@ class Executor:
         scope.set_var(_RNG_VAR, new_key)
         for n, v in state_out.items():
             scope.set_var(n, v)
+
+        if step.guard is not None and used_fast_path:
+            # split the non-donated guard scalar tail off the fetches
+            # and enforce the consecutive-skip budget (slow path: a
+            # scalar host read per run is fine here)
+            gvals = fetches[len(fetch_names):]
+            fetches = fetches[:len(fetch_names)]
+            gd = _guardrails.decode_tail(_fetch_numpy(gvals[0]),
+                                         _fetch_numpy(gvals[1]))
+            cons = gd["consecutive"]
+            budget = step.guard.max_skipped
+            if budget and cons > budget:
+                _guardrails.dump_abort_bundle(
+                    "guardrail_skip_budget_exhausted", program=program,
+                    step_id=sid, consecutive=cons,
+                    total=gd["skipped_total"], probe=gd["probe"],
+                    scale=gd["loss_scale"], rng_key=key,
+                    feed={k: feed[k] for k in step.feed_names},
+                    step_counter=gd["step_counter"] - 1)
+                from .errors import GuardrailViolation
+                raise GuardrailViolation(
+                    f"non-finite step defense: {cons} consecutive "
+                    f"skipped steps exceed flag('max_skipped_steps')="
+                    f"{budget} — flight bundle dumped "
+                    f"(framework/guardrails.py)")
 
         if flag("check_nan_inf"):
             # ref: FLAGS_check_nan_inf scans every op output
@@ -1649,6 +1864,10 @@ class Executor:
         bad = []
         multihost = False
         for n, v in list(zip(fetch_names, fetches)) + list(state_out.items()):
+            if _guardrails.is_guard_var(n):
+                # the guard's own probe is DESIGNED to carry the NaN;
+                # the skip machinery already handled the step
+                continue
             if isinstance(v, jax.Array) and not v.is_fully_addressable:
                 # multi-host array: scan the shards this process owns
                 multihost = True
@@ -1775,6 +1994,8 @@ class Executor:
                tuple(fetch_names), _mesh_identity(mesh),
                flag("use_flash_attention"), flag("use_pallas_fused"),
                flag("overlap_lowering"),
+               flag("guard_nonfinite"), flag("guard_loss_scale"),
+               _faultline.epoch(),
                donate_state, str(flag("aot_cache_dir") or ""))
         if key in self._cache:
             if flag("print_executor_cache_hits"):
@@ -1845,6 +2066,30 @@ class Executor:
         is_test = program._is_test
         replicated_names = _replicated_var_names(ops, bw_idx)
 
+        # self-healing step runtime (framework/guardrails.py): resolve
+        # the guard policy for this compile; active, it threads extra
+        # reserved state (step/skip/scale counters) through the step and
+        # appends a non-donated guard fetch tail the host polls
+        guard = None
+        no_gate: List[str] = []
+        if bw_idx is not None and donate_state:
+            bw_attrs = ops[bw_idx].attrs
+            pipelined = int(bw_attrs.get("pipe_microbatches") or 1) > 1 \
+                or int(bw_attrs.get("pipe_stages") or 1) > 1
+            guard = _guardrails.active_policy(
+                True, amp_scale_var=bw_attrs.get("loss_scale_var"),
+                pipelined=pipelined)
+        if guard is not None:
+            for n in _guardrails.STATE_VARS:
+                if n not in state_in_names:
+                    state_in_names.append(n)
+                if n not in state_out_names:
+                    state_out_names.append(n)
+            # the AMP scale-policy state must ADVANCE on a bad step —
+            # backoff is the response, not a casualty of the gate
+            no_gate = [n for op in ops if op.type == "update_loss_scaling"
+                       for n in op.output_names()]
+
         def step(feed_vals, state_vals, rng_key):
             # distinct randomness per data/sequence shard (dropout masks must
             # differ across devices, as each device has a different NCCL-rank
@@ -1863,6 +2108,7 @@ class Executor:
             else:
                 shard_key, next_base = rng_key, None
             ctx = LoweringContext(shard_key, mesh, axis_names, is_test)
+            ctx.guard = guard
             env = {}
             env.update(state_vals)
             env.update(feed_vals)
@@ -1874,7 +2120,18 @@ class Executor:
             fetches = [_merge_fetch(env[n], n, block, ctx, batch_axis,
                                     replicated_names, seq_axis)
                        for n in fetch_names]
-            state_out = {n: env[n] for n in state_out_names}
+            if guard is not None:
+                # gate every written persistable on the fused finite
+                # verdict (bitwise no-op step on NaN/Inf) and append the
+                # guard scalars as NON-donated fetch outputs so the host
+                # can poll skip state without touching the state chain
+                state_out, guard_tail = _guardrails.guarded_state_out(
+                    env, state_vals, state_out_names,
+                    axis_names if mesh is not None else (), guard,
+                    no_gate)
+                fetches = list(fetches) + guard_tail
+            else:
+                state_out = {n: env[n] for n in state_out_names}
             return fetches, state_out, \
                 (next_base if next_base is not None else ctx.key)
 
@@ -1934,7 +2191,7 @@ class Executor:
                                  feed_names, fetch_names, raw_fn=step,
                                  mesh=mesh, feed_spec_fn=feed_spec_fn,
                                  state_in_specs=state_in_specs,
-                                 jit_fn=jit_fn)
+                                 jit_fn=jit_fn, guard=guard)
         self._cache[key] = compiled
         return compiled
 
@@ -1955,7 +2212,9 @@ class Executor:
         feed_sig = self._feed_signature(feed)
         trace_flags = (flag("use_flash_attention"),
                        flag("use_pallas_fused"),
-                       flag("overlap_lowering"))
+                       flag("overlap_lowering"),
+                       flag("guard_nonfinite"), flag("guard_loss_scale"),
+                       _faultline.epoch())
         key = aot_cache.entry_key(program, feed_sig, fetch_names,
                                   donate_state, trace_flags)
         cached = aot_cache.load(cache_dir, key)

@@ -1,0 +1,373 @@
+"""Non-finite step defense: detect a poisoned step INSIDE the lowered
+step and make it a no-op instead of a parameter corruption.
+
+The reference's ``FLAGS_check_nan_inf`` (and this repo's port of it)
+scans step OUTPUTS on the host — by the time the scan raises, the
+optimizer already folded the NaN into the parameters and the run is
+dead.  The guardrail moves the defense inside the compiled step:
+
+* a **fused all-finite reduction** over the loss and every raw
+  parameter gradient — each leaf is multiplied by zero and summed, the
+  per-leaf scalars sum into ONE f32 probe, so any NaN/Inf anywhere
+  poisons the probe (``x*0`` is NaN for non-finite ``x``) and the whole
+  check is a reduction XLA fuses into the backward epilogue, not a
+  host sync.  Under a mesh the probe is ``psum``-ed over every axis so
+  all replicas agree on the verdict (a one-sided skip would diverge
+  replicated state);
+* the finite flag **gates every written persistable** with
+  ``jnp.where`` — on a poisoned step parameters, optimizer moments,
+  BN stats and LR-scheduler state come out BITWISE equal to their
+  inputs (the update zone still runs; its results are discarded by the
+  select, which XLA turns into a predicated copy);
+* a **unified dynamic loss-scale policy** (:func:`scale_policy_update`)
+  shared verbatim by the AMP decorator's ``update_loss_scaling`` op and
+  the guardrail's own scale state, so fp16, bf16 and fp32 runs back off
+  and regrow through ONE code path;
+* a bounded **consecutive-skip budget** (``flag("max_skipped_steps")``)
+  escalates to a controlled abort: flight bundle (with the offending
+  step's feed, RNG key and serialized program as replayable sidecars —
+  see tools/replay_step.py) + :class:`GuardrailViolation`.
+
+Enabled by ``flag("guard_nonfinite")``; per-step ``skipped`` /
+``loss_scale`` land in the telemetry JSONL when a recorder is attached
+to the prepared loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..flags import flag
+from .errors import GuardrailViolation  # noqa: F401  (re-export)
+
+#: reserved scope/state names (same convention as @RNG_STATE@) — carried
+#: as extra state through the compiled step, never checkpointed
+GUARD_STEP = "@GUARD_STEP@"            # int32 device step counter
+GUARD_SKIP = "@GUARD_SKIP@"            # int32 CONSECUTIVE skipped steps
+GUARD_SKIP_TOTAL = "@GUARD_SKIP_TOTAL@"  # int32 total skipped steps
+GUARD_LAST = "@GUARD_LAST@"            # int32: 1 iff last step skipped
+GUARD_SCALE = "@GUARD_SCALE@"          # f32 guard loss scale
+GUARD_GOOD = "@GUARD_GOOD@"            # int32 good steps since growth
+GUARD_PROBE = "@GUARD_PROBE@"          # f32 finite probe of last step
+
+STATE_VARS = (GUARD_STEP, GUARD_SKIP, GUARD_SKIP_TOTAL, GUARD_LAST,
+              GUARD_SCALE, GUARD_GOOD, GUARD_PROBE)
+
+#: env key the lowering paths stash the pre-psum probe under
+RAW_PROBE = "@GUARD_RAW_PROBE@"
+
+GUARD_PREFIX = "@GUARD_"
+
+
+def is_guard_var(name: str) -> bool:
+    return name.startswith(GUARD_PREFIX)
+
+
+class GuardPolicy:
+    """Resolved guardrail configuration for one compiled step."""
+
+    __slots__ = ("use_scale", "init_scale", "incr_every", "incr_ratio",
+                 "decr_ratio", "max_scale", "max_skipped", "scale_fetch")
+
+    def __init__(self, use_scale: bool, scale_fetch: Optional[str] = None):
+        self.use_scale = bool(use_scale)
+        # without guard scaling the scale state is parked at a neutral
+        # 1.0 (telemetry honesty: no phantom 2^15 on an fp32 run)
+        self.init_scale = float(flag("guard_loss_scale_init")) \
+            if self.use_scale else 1.0
+        self.incr_every = int(flag("guard_incr_every_n_steps"))
+        self.incr_ratio = float(flag("guard_incr_ratio"))
+        self.decr_ratio = float(flag("guard_decr_ratio"))
+        self.max_scale = float(flag("guard_loss_scale_max"))
+        self.max_skipped = int(flag("max_skipped_steps"))
+        # the var the telemetry "loss_scale" field reads: AMP's dynamic
+        # scale var when the program carries one, else the guard's own
+        self.scale_fetch = scale_fetch or GUARD_SCALE
+
+
+def active_policy(has_backward: bool,
+                  amp_scale_var: Optional[str] = None,
+                  pipelined: bool = False) -> Optional[GuardPolicy]:
+    """The policy for a compile, or None when the guard is off or the
+    program has nothing to guard (no backward)."""
+    if not flag("guard_nonfinite") or not has_backward:
+        return None
+    use_scale = bool(flag("guard_loss_scale")) and amp_scale_var is None
+    if use_scale and pipelined:
+        from .errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            "flag('guard_loss_scale') is not supported on "
+            "pipelined/microbatched programs yet — the guard's finite "
+            "check and skip gating compose with 1F1B, the scale "
+            "application does not; use AMP's decorator scaling or "
+            "disable guard_loss_scale")
+    return GuardPolicy(use_scale, scale_fetch=amp_scale_var)
+
+
+def init_value(name: str, policy: Optional[GuardPolicy] = None):
+    """Host-side initial value for a guard state var (pulled when the
+    scope has no entry yet — first step of a run)."""
+    if name == GUARD_SCALE:
+        scale = policy.init_scale if policy is not None \
+            else float(flag("guard_loss_scale_init"))
+        return np.asarray(scale, np.float32)
+    if name == GUARD_PROBE:
+        return np.asarray(0.0, np.float32)
+    return np.asarray(0, np.int32)
+
+
+# ---------------------------------------------------------------------------
+# traced pieces (called inside the jitted step)
+# ---------------------------------------------------------------------------
+
+
+def finite_probe(leaves: Sequence[Any]):
+    """ONE f32 scalar that is finite iff every float leaf is: each leaf
+    contributes ``sum(leaf * 0)`` (0.0 when finite, NaN when any element
+    is NaN/Inf) and the per-leaf scalars sum.  A pure reduction — no
+    comparisons, no bool reductions, no host sync — fused by XLA into
+    the producing computation."""
+    import jax.numpy as jnp
+    probe = jnp.zeros((), jnp.float32)
+    for v in leaves:
+        if v is None:
+            continue
+        if not hasattr(v, "dtype") or not jnp.issubdtype(
+                jnp.asarray(v).dtype, jnp.floating):
+            continue
+        probe = probe + jnp.sum(jnp.asarray(v).astype(jnp.float32) * 0.0)
+    return probe
+
+
+def scale_policy_update(found_inf, scale, good, bad,
+                        incr_every_n_steps: int,
+                        decr_every_n_nan_or_inf: int,
+                        incr_ratio: float, decr_ratio: float,
+                        max_scale: Optional[float] = None):
+    """THE dynamic loss-scale backoff/regrow policy — the single
+    implementation behind both the AMP decorator's
+    ``update_loss_scaling`` op and the guardrail's scale state
+    (ref: operators/amp/update_loss_scaling_op.h):
+
+    * a bad (non-finite) step zeroes the good counter, bumps the bad
+      counter; ``decr_every_n_nan_or_inf`` bad steps back the scale off
+      by ``decr_ratio`` (floored at 1.0);
+    * ``incr_every_n_steps`` consecutive good steps regrow it by
+      ``incr_ratio`` (optionally capped at ``max_scale``).
+
+    Returns ``(new_scale, new_good, new_bad)`` (counters int32)."""
+    import jax.numpy as jnp
+    good_new = jnp.where(found_inf, 0, good + 1)
+    bad_new = jnp.where(found_inf, bad + 1, 0)
+    scale_up = good_new >= incr_every_n_steps
+    scale_down = bad_new >= decr_every_n_nan_or_inf
+    grown = scale * incr_ratio
+    if max_scale is not None:
+        grown = jnp.minimum(grown, max_scale)
+    new_scale = jnp.where(
+        scale_up, grown,
+        jnp.where(scale_down, jnp.maximum(scale * decr_ratio, 1.0),
+                  scale))
+    good_new = jnp.where(scale_up, 0, good_new)
+    bad_new = jnp.where(scale_down, 0, bad_new)
+    return (new_scale, good_new.astype(jnp.int32),
+            bad_new.astype(jnp.int32))
+
+
+def stash_probe(env: Dict[str, Any], loss_name: str,
+                grad_names: Sequence[str], ctx):
+    """Called by each backward lowering path right after the gradients
+    materialize (BEFORE the tail ops, whose check_finite/collectives may
+    rewrite them): apply any armed ``grad_nonfinite`` faultline
+    injection, then stash the fused finite probe over loss + raw grads
+    under :data:`RAW_PROBE`.  No-op when the guard is inactive for this
+    compile and no injection is armed."""
+    from ..testing import faultline
+    import jax.numpy as jnp
+    guard = getattr(ctx, "guard", None)
+    spec = faultline.peek("grad_nonfinite")
+    if guard is None and spec is None:
+        return
+    grads = [g for g in grad_names if g in env]
+    if spec is not None:
+        spec.hits += 1
+        target = spec.params.get("var")
+        gname = target if target in env else (grads[0] if grads else None)
+        if gname is not None:
+            spec.fired += 1
+            g = env[gname]
+            k = spec.params.get("step")
+            if k is not None and GUARD_STEP in env:
+                cond = jnp.asarray(env[GUARD_STEP]).reshape(()) == int(k)
+                env[gname] = jnp.where(cond, jnp.full_like(g, jnp.nan), g)
+            else:
+                env[gname] = jnp.full_like(g, jnp.nan)
+    if guard is not None:
+        env[RAW_PROBE] = finite_probe(
+            [env.get(loss_name)] + [env[g] for g in grads])
+
+
+def guarded_state_out(env: Dict[str, Any], state_vals: Dict[str, Any],
+                      state_out_names: Sequence[str], axis_names,
+                      policy: GuardPolicy, no_gate: Sequence[str]):
+    """The traced guard epilogue of the compiled step: derive the finite
+    flag from the stashed probe, gate every WRITTEN persistable back to
+    its input value on a poisoned step, and advance the guard state.
+    Returns ``(state_out, guard_out)`` where ``guard_out`` maps the
+    guard fetch names to their post-step values."""
+    import jax
+    import jax.numpy as jnp
+    probe = env.pop(RAW_PROBE, None)
+    if probe is None:
+        # inference-style program slipped through — nothing to guard
+        probe = jnp.zeros((), jnp.float32)
+    if axis_names:
+        # every replica must reach the same verdict: psum propagates a
+        # NaN probe from any shard to all of them
+        probe = jax.lax.psum(probe, tuple(axis_names))
+    finite = jnp.isfinite(probe)
+    no_gate = set(no_gate)
+
+    state_out: Dict[str, Any] = {}
+    for n in state_out_names:
+        if is_guard_var(n):
+            continue
+        new = env[n]
+        old = state_vals.get(n)
+        if n in no_gate or old is None or new is old:
+            # pass-through state (same buffer) and the AMP scale-policy
+            # vars (which must advance on a bad step) skip the select
+            state_out[n] = new
+            continue
+        state_out[n] = jnp.where(finite, new, old)
+
+    step_prev = jnp.asarray(state_vals[GUARD_STEP]).reshape(())
+    skip_prev = jnp.asarray(state_vals[GUARD_SKIP]).reshape(())
+    total_prev = jnp.asarray(state_vals[GUARD_SKIP_TOTAL]).reshape(())
+    scale_prev = jnp.asarray(state_vals[GUARD_SCALE]).reshape(())
+    good_prev = jnp.asarray(state_vals[GUARD_GOOD]).reshape(())
+    skipped_i = jnp.where(finite, 0, 1).astype(jnp.int32)
+    new_scale, new_good, _ = scale_policy_update(
+        ~finite, scale_prev, good_prev, skip_prev,
+        incr_every_n_steps=policy.incr_every,
+        decr_every_n_nan_or_inf=1,          # guard backs off per skip
+        incr_ratio=policy.incr_ratio, decr_ratio=policy.decr_ratio,
+        max_scale=policy.max_scale)
+    if not policy.use_scale and policy.scale_fetch == GUARD_SCALE:
+        # scale not applied to the loss: keep it parked at init so the
+        # telemetry field is honest (no phantom backoff)
+        new_scale = scale_prev
+        new_good = good_prev
+    state_out[GUARD_STEP] = step_prev + 1
+    state_out[GUARD_SKIP] = jnp.where(finite, 0, skip_prev + 1) \
+        .astype(jnp.int32)
+    state_out[GUARD_SKIP_TOTAL] = (total_prev + skipped_i) \
+        .astype(jnp.int32)
+    state_out[GUARD_LAST] = skipped_i
+    state_out[GUARD_SCALE] = new_scale.astype(jnp.float32)
+    state_out[GUARD_GOOD] = new_good
+    state_out[GUARD_PROBE] = probe
+
+    scale_out = env.get(policy.scale_fetch) \
+        if policy.scale_fetch != GUARD_SCALE else new_scale
+    if scale_out is None:
+        scale_out = new_scale
+    # the guard fetch tail is packed into TWO arrays (i32[4] + f32[2])
+    # so the host pays two tiny device reads per polled step, not six
+    g_i32 = jnp.stack([state_out[GUARD_LAST],
+                       state_out[GUARD_SKIP],
+                       state_out[GUARD_SKIP_TOTAL],
+                       jnp.asarray(state_out[GUARD_STEP], jnp.int32)])
+    g_f32 = jnp.stack([probe,
+                       jnp.asarray(scale_out).reshape(())
+                       .astype(jnp.float32)])
+    return state_out, [g_i32, g_f32]
+
+
+#: number of packed arrays the guard appends to the step's fetches
+#: (fetch outputs are NOT donated, so the host can poll them without
+#: touching the donated state chain)
+GUARD_TAIL_LEN = 2
+
+
+def decode_tail(g_i32, g_f32) -> Dict[str, Any]:
+    """Host-side decode of one step's packed guard tail."""
+    i = np.asarray(g_i32).reshape(4)
+    f = np.asarray(g_f32).reshape(2)
+    return {"last_skipped": bool(int(i[0])),
+            "consecutive": int(i[1]),
+            "skipped_total": int(i[2]),
+            "step_counter": int(i[3]),
+            "probe": np.float32(f[0]),
+            "loss_scale": float(f[1])}
+
+
+def probe_bits(value) -> str:
+    """The f32 probe's exact bit pattern as hex — the replay tool's
+    bit-exactness token."""
+    return format(
+        int(np.asarray(value, np.float32).reshape(()).view(np.uint32)),
+        "08x")
+
+
+# ---------------------------------------------------------------------------
+# host-side escalation (cold path)
+# ---------------------------------------------------------------------------
+
+
+def dump_abort_bundle(reason: str, *, program, step_id, consecutive,
+                      total, probe, scale, rng_key, feed,
+                      step_counter) -> Optional[str]:
+    """Flight bundle + replayable sidecars for the skip-budget abort:
+    the bundle's ``guard`` extra carries the offending step's identity
+    (device step counter, run step id, probe bits, loss scale) and the
+    paths of two sidecars — the step's feed + RNG key (npz) and the
+    serialized program (json) — which is everything
+    tools/replay_step.py needs to re-execute the step."""
+    import json
+    import os
+    from ..observability import flight
+    from ..testing import faultline
+
+    out_dir = flight.dump_dir()
+    feed_file = prog_file = None
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        tag = f"{os.getpid()}_{step_id}"
+        feed_file = os.path.join(out_dir, f"flight_step_{tag}.npz")
+        payload = {k: np.asarray(v) for k, v in (feed or {}).items()}
+        payload["__rng_key__"] = np.asarray(rng_key)
+        payload["__step_counter__"] = np.asarray(step_counter, np.int64)
+        payload["__loss_scale__"] = np.asarray(scale, np.float32)
+        np.savez(feed_file, **payload)
+        from .serialization import program_to_desc
+        prog_file = os.path.join(out_dir, f"flight_program_{tag}.json")
+        with open(prog_file, "w") as f:
+            json.dump(program_to_desc(program), f)
+    except Exception:          # sidecar failure must not mask the abort
+        pass
+    extra = {
+        "guard": {
+            "step": step_id,
+            "step_counter": int(step_counter),
+            "consecutive_skipped": int(consecutive),
+            "skipped_total": int(total),
+            "probe_bits": probe_bits(probe),
+            "loss_scale": float(np.asarray(scale).reshape(())),
+            "feed_file": feed_file,
+            "program_file": prog_file,
+        },
+        "faultline": faultline.armed(),
+    }
+    return flight.dump(reason, program=program, extra=extra)
+
+
+__all__ = ["GuardPolicy", "GuardrailViolation", "active_policy",
+           "init_value", "finite_probe", "scale_policy_update",
+           "stash_probe", "guarded_state_out", "dump_abort_bundle",
+           "probe_bits", "is_guard_var", "STATE_VARS", "GUARD_TAIL_LEN",
+           "decode_tail",
+           "RAW_PROBE", "GUARD_STEP", "GUARD_SKIP", "GUARD_SKIP_TOTAL",
+           "GUARD_LAST", "GUARD_SCALE", "GUARD_GOOD", "GUARD_PROBE"]

@@ -756,6 +756,7 @@ def execute_reshard(plan: ReshardPlan, arrays: Dict[str, np.ndarray],
     Returns ``(dst arrays, stats)`` where stats carries the
     actually-moved wire bytes per var — equal to the plan's static
     accounting by construction, asserted when ``strict``."""
+    from ..testing import faultline as _faultline
     out: Dict[str, np.ndarray] = {}
     stats = {"wire_bytes": 0, "vars_moved": 0,
              "by_var": {}}
@@ -764,6 +765,10 @@ def execute_reshard(plan: ReshardPlan, arrays: Dict[str, np.ndarray],
         if tr is None or tr.identity:
             out[name] = arr
             continue
+        # drill seam: a fault (exception / delivered signal) striking
+        # mid-restore, between per-var transfers — the preemption-
+        # atomicity drill injects here
+        _faultline.crossing("reshard_execute", var=name)
         dst, moved = _execute_var(tr, np.asarray(arr))
         if tuple(dst.shape) != tuple(tr.dst_shape):
             raise InvalidArgumentError(

@@ -24,6 +24,7 @@ import numpy as np
 from .framework.core import Program, Variable, default_main_program
 from .framework.errors import InvalidArgumentError
 from .framework.executor import Scope, global_scope, sync_prepared_state
+from .testing import faultline as _faultline
 
 _RNG_VAR = "@RNG_STATE@"
 
@@ -43,6 +44,55 @@ def _sha256(path: str, chunk: int = 1 << 20) -> str:
                 break
             h.update(b)
     return "sha256:" + h.hexdigest()
+
+
+class ChecksumMismatchError(OSError):
+    """A just-written checkpoint file read back with the wrong content
+    hash (bit rot, torn write, lying page cache).  Subclasses OSError so
+    ``_retry_io`` treats it like any transient IO fault: the write is
+    retried with backoff and counted on ``checkpoint::retry``."""
+
+
+def _verified_write(what: str, path: str, data):
+    """Write ``data`` (bytes, or a callable producing them — serialized
+    fresh per attempt, so a transient failure inside serialization
+    retries too) to ``path`` and VERIFY it by reading the file back and
+    comparing content hashes — the manifest's per-file sha is only as
+    trustworthy as the bytes that actually landed on disk.  A mismatch
+    raises :class:`ChecksumMismatchError`, which ``_retry_io`` converts
+    into a retried write (``checkpoint::retry`` metric, stage
+    ``{what}``), extending PR 12's transient-OSError retry to silent
+    corruption."""
+    data_fn = data if callable(data) else (lambda: data)
+
+    def w():
+        payload = data_fn()
+        expect = "sha256:" + hashlib.sha256(payload).hexdigest()
+        with open(path, "wb") as f:
+            f.write(payload)
+        # drill seam: corrupt/fail the file between write and readback
+        _faultline.crossing("checkpoint_write", stage=what, path=path)
+        got = _sha256(path)
+        if got != expect:
+            raise ChecksumMismatchError(
+                f"checkpoint file {path!r} ({what}) failed readback "
+                f"verification: wrote {expect}, read {got}")
+
+    _retry_io(what, w)
+
+
+def _npz_bytes(arrays: Dict[str, np.ndarray]) -> bytes:
+    import io as _io
+    buf = _io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _npy_bytes(arr) -> bytes:
+    import io as _io
+    buf = _io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
 
 
 def _retry_io(what: str, fn):
@@ -138,13 +188,9 @@ def _write_manifest(d: str, main_program: Optional[Program] = None,
     manifest = dict(manifest)
     manifest["files"] = files
     tmp = os.path.join(d, "." + MANIFEST_FILE + ".tmp")
-
-    def w():
-        with open(tmp, "w") as f:
-            json.dump(manifest, f)
-        os.replace(tmp, os.path.join(d, MANIFEST_FILE))
-
-    _retry_io("manifest", w)
+    _verified_write("manifest", tmp, json.dumps(manifest).encode())
+    _retry_io("manifest",
+              lambda: os.replace(tmp, os.path.join(d, MANIFEST_FILE)))
     return manifest
 
 
@@ -226,8 +272,8 @@ def save_persistables(executor, dirname, main_program: Optional[Program] = None,
         v = scope.find_var(name)
         if v is not None:
             arrays[name] = _host_value(v, name)
-    _retry_io("params", lambda: np.savez(
-        os.path.join(dirname, filename), **arrays))
+    _verified_write("params", os.path.join(dirname, filename),
+                    lambda: _npz_bytes(arrays))
 
 
 def load_persistables(executor, dirname, main_program: Optional[Program] = None,
@@ -359,14 +405,11 @@ def save_checkpoint(executor, path, train_status: TrainStatus,
         save_persistables(executor, d, main_program, scope=scope)
     rng = scope.find_var(_RNG_VAR)
     if rng is not None:
-        _retry_io("rng", lambda: np.save(os.path.join(d, "rng.npy"),
-                                         _host_value(rng, _RNG_VAR)))
-
-    def _ts():
-        with open(os.path.join(d, "train_status.json"), "w") as f:
-            json.dump(train_status.to_dict(), f)
-
-    _retry_io("train_status", _ts)
+        rng_val = _host_value(rng, _RNG_VAR)
+        _verified_write("rng", os.path.join(d, "rng.npy"),
+                        lambda: _npy_bytes(rng_val))
+    _verified_write("train_status", os.path.join(d, "train_status.json"),
+                    json.dumps(train_status.to_dict()).encode())
     _write_manifest(d, main_program or default_main_program(),
                     layout=layout)
     if not remain_all_checkpoint:
@@ -1014,6 +1057,8 @@ class AsyncCheckpointer:
         self._thread = None
         self._error = None
         self._max = max_checkpoints
+        from .observability import watchdog as _watchdog
+        _watchdog.ensure_started()   # hang watchdog (step_deadline_s)
         # a failed FINAL write must not vanish when the loop exits without
         # wait(): drain at interpreter shutdown and shout if it failed
         atexit.register(self._drain_at_exit)
@@ -1100,6 +1145,8 @@ class AsyncCheckpointer:
         manifest = _manifest_dict(lay, specs, flat)
 
         def write():
+            from .observability import watchdog as _watchdog
+            _watchdog.begin("checkpoint")
             try:
                 with step_scope(snap_step_id), \
                         RecordEvent("checkpoint::write",
@@ -1107,21 +1154,19 @@ class AsyncCheckpointer:
                     _write_inner()
             except BaseException as e:   # noqa: BLE001 — re-raised on wait
                 self._error = e
+            finally:
+                _watchdog.end("checkpoint")
 
         def _write_inner():
             os.makedirs(tmp, exist_ok=True)
-            _retry_io("params", lambda: np.savez(
-                os.path.join(tmp, "params.npz"), **snap))
+            _verified_write("params", os.path.join(tmp, "params.npz"),
+                            lambda: _npz_bytes(snap))
             if rng_snap is not None:
-                _retry_io("rng", lambda: np.save(
-                    os.path.join(tmp, "rng.npy"), rng_snap))
-
-            def _ts():
-                with open(os.path.join(tmp, "train_status.json"),
-                          "w") as f:
-                    json.dump(status, f)
-
-            _retry_io("train_status", _ts)
+                _verified_write("rng", os.path.join(tmp, "rng.npy"),
+                                lambda: _npy_bytes(rng_snap))
+            _verified_write("train_status",
+                            os.path.join(tmp, "train_status.json"),
+                            json.dumps(status).encode())
             # manifest (with content hashes) lands INSIDE the tmp dir,
             # so the atomic tmp→final rename publishes a fully
             # verifiable checkpoint or nothing

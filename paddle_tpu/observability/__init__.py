@@ -14,20 +14,24 @@ Four layers, each importable alone:
   and wire/HBM accounting;
 * :mod:`.flight` — the always-on crash flight recorder: a lock-light
   ring of recent steps/spans dumped as a diagnostic bundle on uncaught
-  executor/serving exceptions and non-finite loss.
+  executor/serving exceptions and non-finite loss;
+* :mod:`.watchdog` — the hang watchdog (PR 14): progress beacons on the
+  prepared loop / serving worker / checkpoint writer + a monitor thread
+  (``flag("step_deadline_s")``) that dumps all-thread stacks and a
+  flight bundle when a unit of work stalls past the deadline.
 
 See MIGRATION.md "Observability mapping" for the reference
 (platform/profiler.h DeviceTracer, monitor.h STAT macros) → here map.
 """
 
-from . import tracing, flight, metrics, flops, recorder  # noqa: F401
+from . import tracing, flight, metrics, flops, recorder, watchdog  # noqa: F401,E501
 from .tracing import (Span, span, traced, next_step_id,          # noqa: F401
                       current_step_id, set_step_id, step_scope)
 from .metrics import (counter, gauge, histogram,                 # noqa: F401
                       metrics_snapshot, prometheus_text, serve_metrics)
 from .recorder import TelemetryRecorder, validate_jsonl          # noqa: F401
 
-__all__ = ["tracing", "flight", "metrics", "flops", "recorder",
+__all__ = ["tracing", "flight", "metrics", "flops", "recorder", "watchdog",
            "Span", "span", "traced", "next_step_id", "current_step_id",
            "set_step_id", "step_scope", "counter", "gauge", "histogram",
            "metrics_snapshot", "prometheus_text", "serve_metrics",

@@ -95,6 +95,16 @@ def last_dumps() -> List[str]:
     return list(_dumps)
 
 
+def dump_dir() -> str:
+    """The directory bundles (and their replayable sidecars) land in —
+    ``flag("flight_dump_dir")``, defaulting to a tmpdir subfolder."""
+    out_dir = str(_FLAGS.get("flight_dump_dir") or "")
+    if not out_dir:
+        import tempfile
+        out_dir = os.path.join(tempfile.gettempdir(), "paddle_tpu_flight")
+    return out_dir
+
+
 def _jsonable(v):
     if isinstance(v, (type(None), bool, int, float, str)):
         return v
@@ -142,10 +152,7 @@ def dump(reason: str, exc: Optional[BaseException] = None,
         bundle["program"] = prog
     if extra:
         bundle["extra"] = {k: _jsonable(v) for k, v in extra.items()}
-    out_dir = str(_FLAGS.get("flight_dump_dir") or "")
-    if not out_dir:
-        import tempfile
-        out_dir = os.path.join(tempfile.gettempdir(), "paddle_tpu_flight")
+    out_dir = dump_dir()
     try:
         os.makedirs(out_dir, exist_ok=True)
         path = os.path.join(
@@ -183,5 +190,5 @@ def validate_bundle(path: str) -> Dict[str, Any]:
 
 
 __all__ = ["enabled", "note_step", "step_breadcrumb", "note_event",
-           "dump", "validate_bundle",
+           "dump", "dump_dir", "validate_bundle",
            "steps_snapshot", "reset", "last_dumps", "SCHEMA", "MAX_DUMPS"]

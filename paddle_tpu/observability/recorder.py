@@ -262,6 +262,18 @@ class TelemetryRecorder:
             "aot_cache": {"hits": deltas["aot_cache_hit"],
                           "misses": deltas["aot_cache_miss"]},
         }
+        # guardrail facts (framework/guardrails.py): when the attached
+        # prepared loop runs with guard_nonfinite, each step records
+        # whether it was skipped and the live loss scale — the JSONL is
+        # the run's recovery ledger, not just its perf ledger
+        ginfo = getattr(self._prepared, "guard_info", None)
+        if ginfo is not None:
+            gs = ginfo(sync=False)
+            if gs.get("step") is not None:
+                rec["skipped"] = bool(gs["last_skipped"])
+                rec["skipped_total"] = int(gs["skipped_total"])
+                if gs.get("loss_scale") is not None:
+                    rec["loss_scale"] = float(gs["loss_scale"])
         exposed_s = self.static.get("exposed_comm_s_per_step")
         if exposed_s is not None:
             # share of this step's measured wall the statically-priced
@@ -398,6 +410,12 @@ def validate_jsonl(path: str) -> Dict[str, Any]:
         if s.get("exposed_comm_frac") is not None and \
                 not (0.0 <= s["exposed_comm_frac"] <= 1.0):
             raise ValueError(f"exposed_comm_frac out of [0,1]: {s}")
+        if "skipped" in s and not isinstance(s["skipped"], bool):
+            raise ValueError(f"skipped must be a bool: {s}")
+        if s.get("loss_scale") is not None and \
+                not (isinstance(s["loss_scale"], (int, float))
+                     and s["loss_scale"] >= 1.0):
+            raise ValueError(f"loss_scale must be >= 1.0: {s}")
     sids = [s["step"] for s in steps]
     if sids != sorted(sids):
         raise ValueError("step ids are not monotonically increasing")

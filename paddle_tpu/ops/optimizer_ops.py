@@ -284,28 +284,26 @@ def _amp_check_finite_and_scale(ctx, ins, attrs):
 
 @register("update_loss_scaling")
 def _update_loss_scaling(ctx, ins, attrs):
-    """ref: operators/amp/update_loss_scaling_op.h — dynamic loss scale."""
+    """ref: operators/amp/update_loss_scaling_op.h — dynamic loss scale.
+
+    The backoff/regrow math lives in
+    framework/guardrails.scale_policy_update — ONE policy shared with
+    the non-AMP guardrail scale state, so fp16/bf16/fp32 runs recover
+    through the same code path."""
+    from ..framework.guardrails import scale_policy_update
     found_inf = x(ins, "FoundInfinite")
     scale = x(ins, "PrevLossScaling")
     good = x(ins, "InGoodSteps")
     bad = x(ins, "InBadSteps")
-    incr_every = attrs.get("incr_every_n_steps", 1000)
-    decr_every = attrs.get("decr_every_n_nan_or_inf", 2)
-    incr_ratio = attrs.get("incr_ratio", 2.0)
-    decr_ratio = attrs.get("decr_ratio", 0.5)
-    good_new = jnp.where(found_inf, 0, good + 1)
-    bad_new = jnp.where(found_inf, bad + 1, 0)
-    scale_up = good_new >= incr_every
-    scale_down = bad_new >= decr_every
-    new_scale = jnp.where(scale_up, scale * incr_ratio,
-                          jnp.where(scale_down,
-                                    jnp.maximum(scale * decr_ratio, 1.0), scale))
-    good_new = jnp.where(scale_up, 0, good_new)
-    bad_new = jnp.where(scale_down, 0, bad_new)
+    new_scale, good_new, bad_new = scale_policy_update(
+        found_inf, scale, good, bad,
+        incr_every_n_steps=attrs.get("incr_every_n_steps", 1000),
+        decr_every_n_nan_or_inf=attrs.get("decr_every_n_nan_or_inf", 2),
+        incr_ratio=attrs.get("incr_ratio", 2.0),
+        decr_ratio=attrs.get("decr_ratio", 0.5))
     outs = [jnp.where(found_inf, jnp.zeros_like(g), g) for g in ins.get("X", [])]
     return {"Out": outs, "LossScaling": new_scale,
-            "OutGoodSteps": good_new.astype(jnp.int32),
-            "OutBadSteps": bad_new.astype(jnp.int32)}
+            "OutGoodSteps": good_new, "OutBadSteps": bad_new}
 
 
 @register("average_accumulates")

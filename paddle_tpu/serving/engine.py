@@ -62,8 +62,11 @@ import numpy as np
 from ..framework.errors import (ExecutionTimeoutError, InvalidArgumentError,
                                 UnavailableError)
 from ..observability import flight as _flight
+from ..observability import watchdog as _watchdog
 from ..observability.tracing import next_step_id, step_scope
 from ..profiler import RecordEvent, register_serving_engine
+from ..testing import faultline as _faultline
+from ..testing.faultline import _ARMED as _FL_ARMED
 
 
 def _default_batch_buckets(max_batch_size: int) -> Tuple[int, ...]:
@@ -371,6 +374,7 @@ class ServingEngine:
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._accepting = True
+        self._unhealthy: Optional[BaseException] = None
         self._active = 0             # picked batches not yet completed
         self._spurious_wakeups = 0   # idle-wait wakeups that found no work
         # stats (under _stats_lock)
@@ -391,6 +395,7 @@ class ServingEngine:
         # LRU-eviction levers)
         self._bucket_sigs: Dict[Tuple, Any] = {}
         self._bucket_used: Dict[Tuple, float] = {}
+        _watchdog.ensure_started()   # hang watchdog (step_deadline_s)
         register_serving_engine(self)
         if auto_start:
             self.start()
@@ -509,6 +514,10 @@ class ServingEngine:
             deadline = time.monotonic() + cfg.timeout_ms / 1e3
         req = _Request(arrs, rows, seq, group, deadline)
         with self._cond:
+            if self._unhealthy is not None:
+                raise UnavailableError(
+                    f"serving engine is unhealthy — its worker died with "
+                    f"{self._unhealthy!r}; restart the engine")
             if not self._accepting:
                 raise UnavailableError("serving engine is shut down")
             self._queue.append(req)
@@ -535,8 +544,25 @@ class ServingEngine:
 
     # -- worker -----------------------------------------------------------
     def _worker_loop(self):
+        """Worker thread entry: the inner loop wrapped in FATAL-exception
+        hardening.  An exception escaping the per-batch recovery in
+        ``_dispatch``/``_complete`` used to kill the thread silently —
+        every queued and in-flight future then hung forever and later
+        ``submit`` calls piled onto a dead queue.  Now it fails ALL of
+        them with the error, dumps a flight bundle, and marks the engine
+        unhealthy so subsequent ``submit`` raises immediately."""
         inflight: List[_Batch] = []
+        try:
+            self._worker_loop_inner(inflight)
+        except BaseException as e:   # noqa: BLE001 — worker last line
+            self._worker_fatal(e, inflight)
+
+    def _worker_loop_inner(self, inflight: List[_Batch]):
         while True:
+            if _FL_ARMED:
+                # drill seam: an uncaught worker exception, outside the
+                # per-batch try blocks
+                _faultline.crossing("serving_worker")
             if len(inflight) >= self.config.max_inflight_batches:
                 self._complete(inflight.pop(0))
                 continue
@@ -544,13 +570,41 @@ class ServingEngine:
             if got is None:                      # stop, queue drained
                 break
             if isinstance(got, _Batch):
-                batch = self._dispatch(got)
+                _watchdog.begin("serving")
+                try:
+                    batch = self._dispatch(got)
+                finally:
+                    _watchdog.end("serving")
                 if batch is not None:
                     inflight.append(batch)
             elif inflight:
                 self._complete(inflight.pop(0))
         while inflight:
             self._complete(inflight.pop(0))
+
+    def _worker_fatal(self, exc: BaseException, inflight: List[_Batch]):
+        """Terminal worker failure: no future may be left pending."""
+        _flight.dump("serving_worker_fatal", exc=exc,
+                     extra={"queued": len(self._queue),
+                            "inflight": len(inflight)})
+        failed = 0
+        with self._cond:
+            self._unhealthy = exc
+            self._accepting = False
+            self._stop = True
+            victims = [r for b in inflight for r in b.picked] + \
+                list(self._queue)
+            self._queue.clear()
+            self._active = 0
+            for req in victims:
+                if not req.future.done():
+                    req.future.set_exception(UnavailableError(
+                        f"serving engine worker died: {exc!r} — request "
+                        f"failed (flight bundle dumped)"))
+                    failed += 1
+            self._cond.notify_all()
+        with self._stats_lock:
+            self._failed += failed
 
     def _earliest_deadline(self):
         ds = [r.deadline for r in self._queue if r.deadline is not None]
@@ -979,6 +1033,7 @@ class ServingEngine:
             out["pending"] = len(self._queue)
             out["inflight"] = self._active
             out["spurious_wakeups"] = self._spurious_wakeups
+            out["unhealthy"] = self._unhealthy is not None
         return out
 
 

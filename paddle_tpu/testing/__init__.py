@@ -1,0 +1,5 @@
+"""Deterministic test/drill harnesses (fault injection seams)."""
+
+from . import faultline  # noqa: F401
+
+__all__ = ["faultline"]

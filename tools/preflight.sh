@@ -30,6 +30,12 @@ echo "   incl. ring inner step, flat-shard Adam, dequant-accumulate all"
 echo "   present as tpu_custom_calls; interpret-mode parity bounds) =="
 python tools/verify_lowering.py --selftest
 
+echo "== preflight: chaos probe (self-healing drills: NaN step skipped"
+echo "   bitwise + scale backoff/regrow, skip-budget abort -> replayed"
+echo "   bit-exactly, watchdog stall stacks + false-positive bound,"
+echo "   serving worker fatal hardening, checkpoint readback verify)"
+python tools/chaos_probe.py --selftest
+
 echo "== preflight: reshard probe (elastic restore: dp8/ZeRO-3 BERT-tiny"
 echo "   checkpoint onto dp4/dp16 + tp2->tp1 flip, planned==executed wire"
 echo "   bytes, parity <=1e-6, 0 compiles on rejected candidates) =="
