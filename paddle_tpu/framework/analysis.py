@@ -79,6 +79,10 @@ INFERENCE_COLLECTIVE = "inference-collective"
 INFERENCE_TRAINING_OP = "inference-training-op"
 INFERENCE_STATE_WRITE = "inference-state-write"
 INFERENCE_DONATED_READ = "inference-donated-read"
+# decode profile (a decode-engine program may write ONLY its declared
+# KV-cache pool persistables — see verify_decode)
+DECODE_STATE_WRITE = "decode-state-write"
+DECODE_CACHE_UNDECLARED = "decode-cache-undeclared"
 
 #: meta-ops interpreted by the executor itself, not the registry
 META_OPS = frozenset({"feed", "fetch", "backward", "pipeline"})
@@ -1032,6 +1036,76 @@ def verify_inference(program: Program, feed_names: Iterable[str] = (),
     return result
 
 
+def verify_decode(program: Program, feed_names: Iterable[str] = (),
+                  fetch_names: Iterable[str] = (),
+                  scope_names: Iterable[str] = (),
+                  cache_vars: Iterable[str] = ()) -> VerifyResult:
+    """Decode-engine verification profile (the autoregressive serving
+    runtime, paddle_tpu/serving/decode.py): the inference rules with ONE
+    carve-out — a decode program is a read-only function of its feeds
+    AND ITS KV-CACHE POOLS, which it appends to in place:
+
+    * **collectives** / **training ops** are rejected exactly as in
+      :func:`verify_inference` (a decode replica is a single serving
+      process);
+    * **persistable writes** are allowed ONLY to the declared
+      ``cache_vars`` (the paged pool the engine owns the lifecycle of);
+      any other persistable write (``decode-state-write``) would mutate
+      weights token-to-token;
+    * every declared cache var must actually exist in the program
+      (``decode-cache-undeclared``) — a typo'd pool name would silently
+      re-enable the weight-write hole.
+
+    Wired at :class:`DecodeEngine` start under
+    ``flag("verify_programs")`` for both the prefill and decode-step
+    programs."""
+    result = verify_program(program, feed_names=feed_names,
+                            fetch_names=fetch_names,
+                            scope_names=scope_names)
+    collectives = _collective_types()
+    cache_vars = set(cache_vars)
+    declared = set(program.global_block().vars)
+    for name in sorted(cache_vars - declared):
+        result.add(
+            "error", DECODE_CACHE_UNDECLARED,
+            f"decode cache var {name!r} is not declared in the program — "
+            f"the write allow-list would not cover anything", None, 0, -1)
+
+    def scan(block: Block):
+        for idx, op in enumerate(block.ops):
+            if op.type in collectives:
+                result.add(
+                    "error", INFERENCE_COLLECTIVE,
+                    f"decode program contains collective op {op.type!r} — "
+                    f"a single decode replica has no mesh peers and "
+                    f"deadlocks at the rendezvous",
+                    op, block.idx, idx)
+            if op.type == "backward" or op.type.endswith("_grad"):
+                result.add(
+                    "error", INFERENCE_TRAINING_OP,
+                    f"decode program contains training op {op.type!r} — "
+                    f"the backward graph leaked into the serving path",
+                    op, block.idx, idx)
+            for n in op.output_names():
+                if n in cache_vars:
+                    continue
+                v = block._find_var_recursive(n)
+                if v is not None and v.persistable:
+                    result.add(
+                        "error", DECODE_STATE_WRITE,
+                        f"decode program writes persistable {n!r} (op "
+                        f"{op.type!r}) outside the declared cache pool "
+                        f"{sorted(cache_vars)} — only the KV-cache may "
+                        f"be appended to; anything else mutates weights "
+                        f"token-to-token",
+                        op, block.idx, idx)
+            for sub in _iter_sub_blocks(op):
+                scan(sub)
+
+    scan(program.global_block())
+    return result
+
+
 #: verification cache — a program is verified at most once per
 #: (_uid, _version, feeds, fetches); ``stats`` is asserted by tier-1
 _VERIFY_CACHE: Dict[Tuple, VerifyResult] = {}
@@ -1315,8 +1389,9 @@ __all__ = [
     "OVERLAP_SINGLE_BUCKET", "OVERLAP_TAIL_SUNK",
     "SHARD_LAYOUT_UNKNOWN_AXIS", "SHARD_LAYOUT_COLLECTIVE_MISMATCH",
     "PIPE_COLLECTIVE_CROSSES_STAGE", "REMAT_RECOMPUTE_SIDE_EFFECT",
-    "verify_program", "verify_inference", "verify_cached",
-    "verify_pipeline",
+    "verify_program", "verify_inference", "verify_decode",
+    "verify_cached", "verify_pipeline",
+    "DECODE_STATE_WRITE", "DECODE_CACHE_UNDECLARED",
     "clear_verify_cache",
     "verify_structure", "verify_startup_agreement", "infer_shapes",
     "verify_distributed", "verify_shard_layout", "collective_signature",

@@ -1466,6 +1466,16 @@ class PreparedStep:
         gs["consecutive"] = int(i[1])
         gs["skipped_total"] = int(i[2])
         gs["step"] = sid
+        try:
+            # guardrail state on the scrape surface: operators watch the
+            # skip counter without attaching a recorder (ROADMAP PR 14
+            # follow-up; loss_scale lands in guard_info, its decoder)
+            from ..observability import metrics as _obs_metrics
+            _obs_metrics.gauge("guardrail::skipped_total").set(int(i[2]))
+            _obs_metrics.gauge(
+                "guardrail::consecutive_skipped").set(int(i[1]))
+        except Exception:        # metrics must never break the hot loop
+            pass
         # loss scale / probe decode deferred to guard_info (the f32 read
         # is only paid by consumers that want it)
         self._guard_f32 = gvals[1]
@@ -1497,6 +1507,12 @@ class PreparedStep:
             f = np.asarray(_fetch_numpy(f32)).reshape(2)
             self.guard_stats["loss_scale"] = float(f[1])
             self._guard_f32 = None
+            try:
+                from ..observability import metrics as _obs_metrics
+                _obs_metrics.gauge("guardrail::loss_scale").set(
+                    float(f[1]))
+            except Exception:    # metrics must never break the hot loop
+                pass
         return dict(self.guard_stats)
 
     # -- sync points ------------------------------------------------------

@@ -984,6 +984,69 @@ def estimate(program: Program, feed_shapes=None,
                           unknown_dim=unknown_dim, top_k=top_k)
 
 
+def plan_cache_pool(program: Program, feed_shapes=None,
+                    fetch_names: Iterable[str] = (),
+                    cache_vars: Iterable[str] = (),
+                    block_bytes: int = 0,
+                    budget_gb: Optional[float] = None,
+                    min_blocks: int = 1) -> Dict[str, Any]:
+    """Size a paged KV-cache pool at DECODE-ENGINE START — the
+    generalization of ``ServingFleet``'s HBM admission from "one more
+    bucket executable" to "one more cache block".
+
+    ``program`` is the decode-step program built with a PROBE pool (any
+    block count) at its largest batch bucket's ``feed_shapes``; the
+    estimate splits into the pool persistables (``cache_vars``) vs
+    everything else (weights + the variant working set), and the blocks
+    affordable under ``budget_gb`` follow statically — no trace, no
+    compile, no device allocation:
+
+        blocks = (budget - (peak - probe_pool)) // block_bytes
+
+    Returns ``{"blocks", "fixed_bytes", "block_bytes", "budget_bytes",
+    "estimate"}``; ``blocks`` is None when no budget applies (caller
+    keeps its configured default).  Raises ``InvalidArgumentError`` when
+    even ``min_blocks`` (one sequence's worth) cannot fit — at engine
+    start, with the program's top live tensors in the message, instead
+    of as a device OOM mid-traffic."""
+    from ..flags import flag
+    if budget_gb is None:
+        budget_gb = float(flag("hbm_budget_gb") or 0.0)
+    est = estimate(program, feed_shapes=feed_shapes,
+                   fetch_names=fetch_names, donate_state=True)
+    cache_vars = set(cache_vars)
+    probe_pool = 0
+    block = program.global_block()
+    from ..ops.registry import dtype_nbytes
+    for name in cache_vars:
+        v = block.vars.get(name)
+        if v is None or not v.shape:
+            continue
+        n = 1
+        for d in v.shape:
+            n *= int(d)
+        probe_pool += n * dtype_nbytes(v.dtype)
+    fixed = max(0, est.peak_bytes - probe_pool)
+    out = {"blocks": None, "fixed_bytes": int(fixed),
+           "block_bytes": int(block_bytes), "budget_bytes": None,
+           "estimate": est}
+    if not budget_gb or budget_gb <= 0:
+        return out
+    budget = int(budget_gb * _GIB)
+    out["budget_bytes"] = budget
+    blocks = (budget - fixed) // max(1, int(block_bytes))
+    if blocks < min_blocks:
+        raise InvalidArgumentError(
+            f"decode cache admission: hbm_budget_gb={budget_gb:g} leaves "
+            f"{max(0, budget - fixed)} bytes for the KV-cache pool — "
+            f"fewer than min_blocks={min_blocks} blocks of "
+            f"{block_bytes} bytes (weights + decode working set cost "
+            f"{fixed} bytes).  Rejected at engine start, before any "
+            f"compile.\n" + est.report())
+    out["blocks"] = int(blocks)
+    return out
+
+
 def collective_wire_summary(program: Program, feed_shapes=None,
                             fetch_names: Iterable[str] = (),
                             mesh_axes: Optional[Dict[str, int]] = None,

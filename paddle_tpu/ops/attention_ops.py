@@ -109,6 +109,37 @@ def lower_flash_attention(ctx, ins, attrs):
     return {"Out": _merge_heads(out)}
 
 
+def lower_cached_attention(ctx, ins, attrs, use_flash=False):
+    """Cache-read attention for the paged decode runtime: K/V come from
+    the block pools THROUGH the per-sequence block table instead of a
+    fresh projection.  The gather-based einsum composition is the
+    CPU/tier-1 fallback; ``use_flash=True`` (the ``cached_flash_attention``
+    Pallas route) runs the same gather and hands the gathered context to
+    the blockwise flash kernel — both read the cache identically, so
+    routing can never change which bytes attention sees.
+
+    Positions at or beyond ``CtxLen`` (padded table entries, reused
+    blocks carrying another sequence's leftovers) are masked to an
+    EXACT-zero softmax weight, which is what makes co-batched and
+    block-reuse results bitwise equal to a lone run."""
+    from .cache_ops import ctx_len_bias, gather_cache
+    q = x(ins, "Q")
+    kpool, vpool = x(ins, "KPool"), x(ins, "VPool")
+    table, ctx_len = x(ins, "BlockTable"), x(ins, "CtxLen")
+    n_head = _resolve_heads(q, attrs)
+    keys = gather_cache(kpool, table)
+    vals = gather_cache(vpool, table)
+    bias = ctx_len_bias(ctx_len, keys.shape[1])
+    if use_flash:
+        from .pallas.flash_attention import flash_attention_bshd
+        out = flash_attention_bshd(
+            _split_heads(q, n_head), _split_heads(keys, n_head),
+            _split_heads(vals, n_head), bias)
+        return {"Out": _merge_heads(out)}
+    return {"Out": reference_attention(q, keys, vals, bias, n_head,
+                                       0.0, ctx, True, causal=False)}
+
+
 def lower_ring_attention(ctx, ins, attrs, use_flash=False):
     """Sequence-parallel attention: ring over the sp axis, inner step
     either the Pallas blockwise flash kernel (the
@@ -131,6 +162,14 @@ def _fused_attention(ctx, ins, attrs):
     n_head = _resolve_heads(q, attrs)
     dropout_rate = attrs.get("dropout_rate", 0.0)
     is_test = attrs.get("is_test", False) or ctx.is_test
+    # paged KV-cache read (serving/decode.py): K/V through the block
+    # pools instead of fresh projections
+    if x(ins, "KPool") is not None:
+        route, _ = pallas_route("fused_attention", ins, attrs,
+                                kernel="cached_flash_attention")
+        if route is not None:
+            return route.lower(ctx, ins, attrs)
+        return lower_cached_attention(ctx, ins, attrs, use_flash=False)
     # sequence parallelism: attention rings over the sp axis (the q/k/v
     # entering here hold only this device's sequence shard)
     seq_axis = attrs.get("_seq_axis")

@@ -249,9 +249,14 @@ def _flops_fused_attention(ins, outs, attrs):
     q, k = _sig(ins, "Q"), _sig(ins, "K")
     if q is None or q.shape is None or len(q.shape) < 3:
         return None
-    ksh = k.shape if k is not None and k.shape is not None else q.shape
     b, sq, hidden = q.shape[0], q.shape[1], q.shape[-1]
-    sk = ksh[1] if len(ksh) > 1 else sq
+    if _sig(ins, "KPool") is not None:
+        sk = _cached_attn_total(ins)
+        if sk is None:
+            return None
+    else:
+        ksh = k.shape if k is not None and k.shape is not None else q.shape
+        sk = ksh[1] if len(ksh) > 1 else sq
     if not _known((b, sq, sk, hidden)):
         return None
     return 4.0 * b * sq * sk * hidden
@@ -419,12 +424,36 @@ def _infer_dropout(ins, attrs):
             "Mask": [VarSig(v.shape, v.dtype)]}
 
 
+def _cached_attn_total(ins):
+    """Gathered context length T = max_blocks_per_seq * block_size of
+    the cache-read fused_attention variant, or None."""
+    pool = _shape_of(_sig(ins, "KPool"))
+    table = _shape_of(_sig(ins, "BlockTable"))
+    if pool is None or table is None or len(pool) != 3 or len(table) != 2:
+        return None
+    if pool[1] < 0 or table[1] < 0:
+        return None
+    return table[1] * pool[1]
+
+
 def _infer_fused_attention(ins, attrs):
     """Out mirrors Q ([B, Sq, hidden]); K/V must agree on the hidden
-    width and on Sk between themselves."""
-    q, k, v = _sig(ins, "Q"), _sig(ins, "K"), _sig(ins, "V")
+    width and on Sk between themselves.  The cache-read variant
+    (KPool/VPool/BlockTable/CtxLen inputs — serving/decode.py) checks
+    the pool hidden width against Q instead."""
+    q = _sig(ins, "Q")
     if q is None or q.shape is None:
         return None
+    kpool = _sig(ins, "KPool")
+    if kpool is not None:
+        if kpool.shape is not None and len(kpool.shape) == 3 and \
+                kpool.shape[-1] >= 0 and q.shape[-1] >= 0 and \
+                kpool.shape[-1] != q.shape[-1]:
+            raise SpecMismatch(
+                f"fused_attention: KPool hidden width {kpool.shape[-1]} "
+                f"!= Q hidden width {q.shape[-1]}", kind="shape")
+        return {"Out": [VarSig(q.shape, q.dtype)]}
+    k, v = _sig(ins, "K"), _sig(ins, "V")
     for other, nm in ((k, "K"), (v, "V")):
         if other is None or other.shape is None:
             continue
@@ -435,6 +464,35 @@ def _infer_fused_attention(ins, attrs):
                 f"fused_attention: {nm} hidden width {other.shape[-1]} "
                 f"!= Q hidden width {q.shape[-1]}", kind="shape")
     return {"Out": [VarSig(q.shape, q.dtype)]}
+
+
+def _infer_cache_write(ins, attrs):
+    """Pool outputs alias the pool inputs; K/V must agree with the pool
+    hidden width and Slots with the K/V token count."""
+    kpool, vpool = _sig(ins, "KPool"), _sig(ins, "VPool")
+    k = _sig(ins, "K")
+    if kpool is None or kpool.shape is None:
+        return None
+    if k is not None and k.shape is not None and \
+            k.shape[-1] >= 0 and kpool.shape[-1] >= 0 and \
+            k.shape[-1] != kpool.shape[-1]:
+        raise SpecMismatch(
+            f"cache_write: K hidden width {k.shape[-1]} != pool hidden "
+            f"width {kpool.shape[-1]}", kind="shape")
+    slots = _sig(ins, "Slots")
+    if slots is not None and slots.shape is not None and \
+            k is not None and k.shape is not None and \
+            all(d >= 0 for d in slots.shape) and \
+            all(d >= 0 for d in k.shape[:-1]):
+        import numpy as _np
+        if int(_np.prod(slots.shape)) != int(_np.prod(k.shape[:-1])):
+            raise SpecMismatch(
+                f"cache_write: Slots covers {list(slots.shape)} tokens "
+                f"but K carries {list(k.shape[:-1])}", kind="shape")
+    out = [VarSig(kpool.shape, kpool.dtype)]
+    vout = [VarSig(vpool.shape, vpool.dtype)] if vpool is not None and \
+        vpool.shape is not None else out
+    return {"KPoolOut": out, "VPoolOut": vout}
 
 
 def _attention_probs_bytes(ins, outs, attrs):
@@ -1071,6 +1129,35 @@ def _lower_ring_flash_attention(ctx, ins, attrs):
     return lower_ring_attention(ctx, ins, attrs, use_flash=True)
 
 
+def _lower_cached_flash_attention(ctx, ins, attrs):
+    from .attention_ops import lower_cached_attention
+    return lower_cached_attention(ctx, ins, attrs, use_flash=True)
+
+
+def _pl_cached_supported(ins, attrs, axis_sizes=None):
+    """Cache-read route gate: the gathered context hands the SAME
+    blockwise flash kernel a (B, H, Sq, T) problem, so the kernel's
+    tiling rules apply with Sk = the table-window length T.  Decode
+    steps (Sq=1) fall back to the gather+einsum composition — the
+    kernel's 128-row query tile cannot price a one-token query."""
+    if _sig(ins, "KPool") is None:
+        return False, "not-cached"
+    q = _shape_of(_sig(ins, "Q"))
+    t = _cached_attn_total(ins)
+    if q is None or len(q) != 3 or t is None:
+        return False, "shape-unknown"
+    hd = q[-1]
+    if hd < 0 or q[1] < 0:
+        return False, "shape-unknown"
+    n_head = attrs.get("n_head", 1)
+    head_dim = attrs.get("head_dim")
+    if head_dim:
+        n_head = max(1, hd // int(head_dim))
+    if n_head <= 0 or hd % n_head:
+        return False, "shape-unknown"
+    return _flash_tiles(q[1], t, hd // n_head)
+
+
 _FLASH_KERNELS = ("_fwd_kernel", "_bwd_dq_kernel", "_bwd_dkv_kernel")
 
 #: the Pallas tier, one route table entry per op (kernel names are the
@@ -1078,13 +1165,24 @@ _FLASH_KERNELS = ("_fwd_kernel", "_bwd_dq_kernel", "_bwd_dkv_kernel")
 #: the TPU-lowered module when the route reports a hit)
 _PL_FLASH = PallasLowering(
     "flash_attention", flag="use_flash_attention", attr="use_flash",
-    match=lambda attrs, ax: not _ring_stamped(attrs, ax),
+    match=lambda attrs, ax: not _ring_stamped(attrs, ax)
+    and not attrs.get("_cached"),
     supported=_pl_flash_supported, lower=_lower_flash_attention,
     kernels=_FLASH_KERNELS)
 _PL_RING = PallasLowering(
     "ring_flash_attention", flag="use_flash_attention", attr="use_flash",
     match=_ring_stamped,
     supported=_pl_ring_supported, lower=_lower_ring_flash_attention,
+    kernels=_FLASH_KERNELS)
+_PL_CACHED = PallasLowering(
+    "cached_flash_attention", flag="use_flash_attention",
+    attr="use_flash",
+    # applicability rides the builder-stamped `_cached` attr (match
+    # cannot see the input slots): a non-cached fused_attention skips
+    # this route SILENTLY instead of polluting its fallback reasons
+    match=lambda attrs, ax: bool(attrs.get("_cached"))
+    and not _ring_stamped(attrs, ax),
+    supported=_pl_cached_supported, lower=_lower_cached_flash_attention,
     kernels=_FLASH_KERNELS)
 _PL_ADAM = PallasLowering(
     "fused_adam", flag="use_pallas_fused",
@@ -1179,7 +1277,8 @@ def register_default_specs():
     op_spec("fused_attention", infer=_infer_fused_attention,
             mem_backward_extra=_attention_probs_bytes,
             flops=_flops_fused_attention,
-            pallas=(_PL_RING, _PL_FLASH))
+            pallas=(_PL_RING, _PL_CACHED, _PL_FLASH))
+    op_spec("cache_write", infer=_infer_cache_write)
 
     # tensor manipulation (views are pure aliases)
     op_spec("reshape2", infer=_infer_reshape2, mem_transparent=True)

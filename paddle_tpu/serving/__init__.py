@@ -31,9 +31,12 @@ Multi-tenant (one device, several models, static HBM admission):
     fleet.shutdown()
 """
 
+from .decode import (DecodeConfig, DecodeEngine, GenerationResult,
+                     blocks_needed)
 from .engine import (ServingConfig, ServingEngine, pack_requests,
                      pad_request)
 from .fleet import ServingFleet
 
 __all__ = ["ServingConfig", "ServingEngine", "ServingFleet",
-           "pack_requests", "pad_request"]
+           "DecodeConfig", "DecodeEngine", "GenerationResult",
+           "blocks_needed", "pack_requests", "pad_request"]

@@ -1,0 +1,881 @@
+"""Autoregressive decode engine: paged KV-cache + continuous token-level
+batching + prefill/decode split executables.
+
+The reference's generation story is ops inside one scoring program
+(`beam_search`, `sampling_id`, the `sequence_*` family) served by
+re-running the WHOLE prefix through AnalysisPredictor per emitted token
+— O(prefix) recompute per token, one request at a time.  TPU-natively,
+generation throughput is won on cache residency and batch occupancy,
+so the decode runtime composes every serving substrate piece built so
+far:
+
+* **paged/block KV-cache** — one preallocated pool of fixed-size blocks
+  per layer per K/V (``[num_blocks, block_size, hidden]`` persistables);
+  sequences own i32 block tables, attention reads THROUGH the table
+  (``fused_attention``'s cache variant, gather-based on CPU, the
+  ``cached_flash_attention`` Pallas route on TPU), and
+  ``cache_write`` appends via host-computed flat slot ids.  The pool is
+  sized ONCE at engine start by the PR 5 static analyzer
+  (``memory_analysis.plan_cache_pool``) and admission prices
+  :func:`blocks_needed` per request BEFORE any compile — the
+  ``ServingFleet`` HBM-admission idea generalized from "one more bucket
+  executable" to "one more cache block";
+* **continuous batching at token granularity** — the worker runs a
+  scheduling round per decode step: finished sequences retire and free
+  their blocks IMMEDIATELY, waiting prefills slot in the same round,
+  and the decode step batches every live sequence into the next batch
+  bucket.  Prefill rides the PR 7 ragged segment-packing recipe
+  (several prompts share a row, one-hot mask channels make the
+  attention bias block-diagonal; causal masking composes per segment);
+* **prefill/decode split executables** — one bucketed prefill grid
+  (batch x seq buckets: writes cache blocks, emits each segment's first
+  token) and one fixed-shape decode-step executable per batch bucket
+  (reads the cache, appends one token), all resolved through the
+  persistent AOT cache (``flag("aot_cache_dir")``): a warm restart
+  deserializes the whole grid with 0 fresh compiles;
+* **bit-parity contract** — generated TOKENS are the output, and every
+  sequence must match its unbatched greedy reference token-for-token
+  (:meth:`DecodeEngine.greedy_reference` — the reference-shaped
+  full-prefix loop on an isolated weight snapshot) no matter how it was
+  co-batched, delayed behind a full pool, or placed into reused blocks.
+  Masked cache reads contribute EXACT zeros (cache_ops.ctx_len_bias),
+  so neither co-residents nor block leftovers can perturb a row.
+
+Static safety: ``analysis.verify_decode`` checks both programs at
+engine start — no collectives, no persistable writes outside the
+declared cache pool.  Failure containment: the ``serving_decode``
+faultline seam drills the fatal path (all in-flight generation futures
+fail with the error, blocks free, the engine goes unhealthy, ``drain``
+cannot hang).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..framework.errors import InvalidArgumentError, UnavailableError
+from ..observability import flight as _flight
+from ..observability import metrics as _metrics
+from ..observability import watchdog as _watchdog
+from ..observability.tracing import next_step_id, step_scope
+from ..profiler import RecordEvent
+from ..testing import faultline as _faultline
+from ..testing.faultline import _ARMED as _FL_ARMED
+from .engine import _plan_bins
+
+
+def blocks_needed(prompt_len: int, max_new_tokens: int,
+                  block_size: int) -> int:
+    """Cache blocks one sequence needs END-TO-END (prompt + every token
+    it may generate) — the admission unit.  Reserved in full at admit
+    time, so a mid-generation sequence can never stall on an empty
+    pool."""
+    total = int(prompt_len) + int(max_new_tokens)
+    return -(-total // int(block_size))
+
+
+def _pow2_buckets(n: int) -> Tuple[int, ...]:
+    out, b = [], 1
+    while b < n:
+        out.append(b)
+        b *= 2
+    out.append(int(n))
+    return tuple(out)
+
+
+class DecodeConfig:
+    """Decode-engine knobs.
+
+    ``pool_blocks=None`` sizes the pool from ``hbm_budget_gb`` (config
+    value, else the flag) through the static analyzer; with no budget
+    either, the pool defaults to full occupancy
+    (``max_batch_size * max_blocks_per_seq``)."""
+
+    def __init__(self, block_size: int = 8,
+                 max_seq_len: int = 64,
+                 max_batch_size: int = 8,
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 prefill_seq_buckets: Sequence[int] = (16, 32, 64),
+                 prefill_batch_buckets: Optional[Sequence[int]] = None,
+                 pack_max_segments: int = 4,
+                 pool_blocks: Optional[int] = None,
+                 max_new_tokens: int = 16,
+                 eos_token_id: Optional[int] = None,
+                 hbm_budget_gb: Optional[float] = None):
+        if block_size < 1:
+            raise InvalidArgumentError("block_size must be >= 1")
+        if max_batch_size < 1:
+            raise InvalidArgumentError("max_batch_size must be >= 1")
+        self.block_size = int(block_size)
+        self.max_seq_len = int(max_seq_len)
+        self.max_batch_size = int(max_batch_size)
+        self.batch_buckets = tuple(sorted(
+            int(b) for b in (batch_buckets or
+                             _pow2_buckets(self.max_batch_size))))
+        if self.batch_buckets[-1] < self.max_batch_size:
+            raise InvalidArgumentError(
+                f"batch_buckets {list(self.batch_buckets)} must cover "
+                f"max_batch_size={self.max_batch_size}")
+        self.prefill_seq_buckets = tuple(sorted(
+            int(s) for s in prefill_seq_buckets))
+        if not self.prefill_seq_buckets:
+            raise InvalidArgumentError(
+                "prefill_seq_buckets must name at least one bucket")
+        self.prefill_batch_buckets = tuple(sorted(
+            int(b) for b in (prefill_batch_buckets or
+                             _pow2_buckets(self.max_batch_size))))
+        self.pack_max_segments = int(pack_max_segments)
+        if self.pack_max_segments < 1:
+            raise InvalidArgumentError("pack_max_segments must be >= 1")
+        self.pool_blocks = pool_blocks
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.hbm_budget_gb = hbm_budget_gb
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return -(-self.max_seq_len // self.block_size)
+
+    @property
+    def executable_grid(self) -> int:
+        """Executable count a fully-warm engine holds: the prefill
+        (batch x seq) grid plus one decode step per batch bucket."""
+        return (len(self.prefill_batch_buckets) *
+                len(self.prefill_seq_buckets) + len(self.batch_buckets))
+
+
+class GenerationResult:
+    """What a generation future resolves to."""
+
+    __slots__ = ("tokens", "prompt_len", "finish_reason", "steps")
+
+    def __init__(self, tokens, prompt_len, finish_reason, steps):
+        self.tokens = np.asarray(tokens, dtype=np.int64)
+        self.prompt_len = int(prompt_len)
+        self.finish_reason = finish_reason      # "length" | "eos"
+        self.steps = int(steps)                 # decode steps it rode
+
+    def __repr__(self):
+        return (f"GenerationResult(tokens={self.tokens.tolist()}, "
+                f"prompt_len={self.prompt_len}, "
+                f"finish_reason={self.finish_reason!r})")
+
+
+class _Seq:
+    __slots__ = ("prompt", "max_new", "eos", "future", "on_token",
+                 "block_ids", "pos", "out_tokens", "done", "reason",
+                 "t_submit", "steps", "_gather_idx", "waited_rounds")
+
+    def __init__(self, prompt, max_new, eos, on_token):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.eos = eos
+        self.future: Future = Future()
+        self.on_token = on_token
+        self.block_ids: List[int] = []
+        self.pos = 0                   # tokens currently in cache
+        self.out_tokens: List[int] = []
+        self.done = False
+        self.reason = "length"
+        self.t_submit = time.monotonic()
+        self.steps = 0
+        self._gather_idx = 0
+        self.waited_rounds = 0
+
+
+class DecodeEngine:
+    """Continuous-batching generation over a paged KV-cache.
+
+    ::
+
+        model = BertDecoder(cfg)
+        engine = DecodeEngine(model, DecodeConfig(
+            block_size=8, max_seq_len=64, max_batch_size=8,
+            prefill_seq_buckets=(16, 32)))
+        engine.warmup()                       # AOT-compile the grid
+        fut = engine.generate({"src_ids": prompt}, max_new_tokens=16)
+        result = fut.result()                 # GenerationResult
+        engine.shutdown()
+
+    One worker thread owns the device: each scheduling round retires
+    finished sequences (freeing their blocks), admits waiting prefills
+    that fit the pool, and runs one decode step over every live
+    sequence."""
+
+    def __init__(self, model, config: Optional[DecodeConfig] = None,
+                 place=None, auto_start: bool = True):
+        from ..flags import flag
+        from ..framework.core import CPUPlace, TPUPlace
+        from ..framework.executor import Executor, Scope
+
+        self.config = cfg = config or DecodeConfig()
+        self.model = model
+        mcfg = model.cfg
+        if cfg.max_seq_len > mcfg.max_position_embeddings:
+            raise InvalidArgumentError(
+                f"max_seq_len={cfg.max_seq_len} exceeds the model's "
+                f"max_position_embeddings={mcfg.max_position_embeddings}")
+        self._mbps = cfg.max_blocks_per_seq
+
+        # -- pool sizing (the memory analyzer IS the admission model) --
+        budget = cfg.hbm_budget_gb
+        if budget is None:
+            budget = float(flag("hbm_budget_gb") or 0.0)
+        self.pool_plan: Dict[str, Any] = {}
+        pool_blocks = cfg.pool_blocks
+        if pool_blocks is None:
+            if budget:
+                pool_blocks = self._plan_pool(budget)
+            else:
+                pool_blocks = cfg.max_batch_size * self._mbps
+        if pool_blocks < 1:
+            raise InvalidArgumentError(
+                f"pool_blocks={pool_blocks} — the paged cache needs at "
+                f"least one block")
+        # a pool smaller than one max-length sequence is legal (requests
+        # that cannot fit are rejected per-request at generate()); a
+        # budget-SIZED pool keeps the min_blocks=max_blocks_per_seq
+        # floor so admission failures surface at engine start
+        self.pool_blocks = int(pool_blocks)
+
+        # -- programs + state ------------------------------------------
+        self._programs = model.build(self.pool_blocks, cfg.block_size,
+                                     self._mbps, cfg.pack_max_segments)
+        if place is None:
+            import jax
+            place = CPUPlace() if jax.default_backend() == "cpu" \
+                else TPUPlace(0)
+        self._scope = Scope()
+        self._exe = Executor(place)
+        self._exe.run(self._programs.startup, scope=self._scope)
+        import jax.numpy as jnp
+        for name in self._programs.cache_vars:
+            v = self._programs.decode.global_block().var(name)
+            self._scope.set_var(name, jnp.zeros(
+                tuple(v.shape), dtype=np.dtype(v.dtype)))
+        if flag("verify_programs"):
+            from ..framework.analysis import verify_decode
+            for prog, feeds in ((self._programs.prefill,
+                                 self._programs.prefill_feeds),
+                                (self._programs.decode,
+                                 self._programs.decode_feeds)):
+                verify_decode(
+                    prog, feed_names=feeds,
+                    fetch_names=self._programs.fetch_names,
+                    scope_names=self._scope.var_names(),
+                    cache_vars=self._programs.cache_vars
+                ).raise_on_error()
+
+        # isolated weight snapshot for the reference loop — host copies,
+        # taken BEFORE the donated fast path can consume scope buffers
+        self._ref_scope = Scope()
+        for name in self._scope.var_names():
+            if name in self._programs.cache_vars:
+                continue
+            self._ref_scope.set_var(
+                name, np.asarray(self._scope.find_var(name)))
+
+        fetches = list(self._programs.fetch_names)
+        self._prefill = self._exe.prepare(
+            self._programs.prefill,
+            feed_names=self._programs.prefill_feeds,
+            fetch_list=fetches, scope=self._scope, donate_state=True)
+        self._decode = self._exe.prepare(
+            self._programs.decode,
+            feed_names=self._programs.decode_feeds,
+            fetch_list=fetches, scope=self._scope, donate_state=True)
+        self._score = None              # reference path, built lazily
+        self._owner = None              # which prepared step holds state
+
+        # -- scheduling state ------------------------------------------
+        self._free: List[int] = list(range(self.pool_blocks - 1, -1, -1))
+        self._pending: List[_Seq] = []
+        self._active: List[_Seq] = []
+        self._cond = threading.Condition()
+        self._run_lock = threading.Lock()   # device rounds vs warmup
+        self._ref_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._accepting = True
+        self._unhealthy: Optional[BaseException] = None
+
+        self._stats_lock = threading.Lock()
+        self._submitted = 0
+        self._completed = 0
+        self._failed = 0
+        self._rejected = 0
+        self._tokens_out = 0
+        self._decode_steps = 0
+        self._prefill_batches = 0
+        self._decode_batch_hist: Dict[int, int] = {}
+        self._peak_blocks = 0
+        self._block_reuses = 0          # a freed block handed out again
+        self._retired_blocks: set = set()
+        self._admission_waits = 0
+        self._t_first = None
+        self._t_last = None
+        _watchdog.ensure_started()
+        if auto_start:
+            self.start()
+
+    # -- pool sizing ------------------------------------------------------
+    def _plan_pool(self, budget_gb: float) -> int:
+        """Static pool sizing: build a PROBE decode program (minimum
+        viable pool) and let the analyzer price blocks under the budget
+        — 0 compiles, the decode analog of ServingFleet admission."""
+        from ..framework.memory_analysis import plan_cache_pool
+        cfg = self.config
+        probe = self.model.build(self._mbps, cfg.block_size, self._mbps,
+                                 cfg.pack_max_segments)
+        bb = cfg.batch_buckets[-1]
+        feed = self._decode_feed_arrays(
+            bb, [], pad_only=True)
+        plan = plan_cache_pool(
+            probe.decode, feed_shapes=feed,
+            fetch_names=probe.fetch_names,
+            cache_vars=probe.cache_vars,
+            block_bytes=self.model.cache_block_bytes(cfg.block_size),
+            budget_gb=budget_gb, min_blocks=self._mbps)
+        self.pool_plan = {
+            "blocks": plan["blocks"],
+            "block_bytes": plan["block_bytes"],
+            "fixed_bytes": plan["fixed_bytes"],
+            "budget_bytes": plan["budget_bytes"],
+        }
+        return plan["blocks"]
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._worker_loop,
+                                            name="decode-engine-worker",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Block until every submitted generation resolved (or failed).
+        Never hangs on an unhealthy engine — the fatal path resolves
+        every future before marking unhealthy."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            self._cond.notify_all()
+            while self._pending or self._active:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+    def shutdown(self, drain: bool = True, timeout: float = 60.0) -> bool:
+        with self._cond:
+            self._accepting = False
+            if not drain:
+                for seq in self._pending:
+                    seq.future.set_exception(UnavailableError(
+                        "decode engine shut down before the request ran"))
+                self._pending.clear()
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            return not self._thread.is_alive()
+        return True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -- submission -------------------------------------------------------
+    @staticmethod
+    def _normalize_prompt(feed) -> np.ndarray:
+        if isinstance(feed, dict):
+            if "src_ids" not in feed:
+                raise InvalidArgumentError(
+                    "generate() feed must carry 'src_ids' (the prompt "
+                    "token ids)")
+            arr = np.asarray(feed["src_ids"])
+        else:
+            arr = np.asarray(feed)
+        if arr.ndim == 2:
+            if arr.shape[0] != 1:
+                raise InvalidArgumentError(
+                    f"generate() takes ONE sequence per call; got a "
+                    f"batch of {arr.shape[0]} — submit them separately, "
+                    f"the engine co-batches at token granularity")
+            arr = arr[0]
+        if arr.ndim != 1 or arr.size == 0:
+            raise InvalidArgumentError(
+                f"prompt must be a non-empty 1-D (or [1, S]) int array, "
+                f"got shape {list(arr.shape)}")
+        return arr.astype(np.int64)
+
+    def generate(self, feed, max_new_tokens: Optional[int] = None,
+                 eos_token_id: Optional[int] = None,
+                 on_token=None) -> Future:
+        """Submit one prompt; returns a Future of
+        :class:`GenerationResult`.  ``on_token(token_id)`` (optional)
+        streams tokens from the worker thread as they decode.
+
+        Admission prices :func:`blocks_needed` HERE — a request that can
+        never fit the pool (or the model's length budget) is rejected
+        immediately, before any compile or queue time."""
+        cfg = self.config
+        prompt = self._normalize_prompt(feed)
+        plen = int(prompt.size)
+        max_new = cfg.max_new_tokens if max_new_tokens is None \
+            else int(max_new_tokens)
+        if max_new < 1:
+            raise InvalidArgumentError("max_new_tokens must be >= 1")
+        eos = cfg.eos_token_id if eos_token_id is None else eos_token_id
+        if plen + max_new > cfg.max_seq_len:
+            with self._stats_lock:
+                self._rejected += 1
+            raise InvalidArgumentError(
+                f"prompt ({plen} tokens) + max_new_tokens ({max_new}) "
+                f"exceeds max_seq_len={cfg.max_seq_len}")
+        if plen > cfg.prefill_seq_buckets[-1]:
+            with self._stats_lock:
+                self._rejected += 1
+            raise InvalidArgumentError(
+                f"prompt length {plen} exceeds the largest prefill "
+                f"bucket {cfg.prefill_seq_buckets[-1]}")
+        need = blocks_needed(plen, max_new, cfg.block_size)
+        if need > self.pool_blocks:
+            with self._stats_lock:
+                self._rejected += 1
+            raise InvalidArgumentError(
+                f"admission rejected: the request needs {need} cache "
+                f"blocks (prompt {plen} + up to {max_new} new tokens at "
+                f"block_size={cfg.block_size}) but the pool holds "
+                f"{self.pool_blocks} — 0 compiles spent; shrink the "
+                f"request or grow the pool")
+        seq = _Seq(prompt, max_new, eos, on_token)
+        with self._cond:
+            if self._unhealthy is not None:
+                raise UnavailableError(
+                    f"decode engine is unhealthy — its worker died with "
+                    f"{self._unhealthy!r}; restart the engine")
+            if not self._accepting:
+                raise UnavailableError("decode engine is shut down")
+            self._pending.append(seq)
+            self._cond.notify_all()
+        with self._stats_lock:
+            self._submitted += 1
+            if self._t_first is None:
+                self._t_first = seq.t_submit
+        return seq.future
+
+    # -- worker -----------------------------------------------------------
+    def _worker_loop(self):
+        try:
+            self._loop_inner()
+        except BaseException as e:    # noqa: BLE001 — worker last line
+            self._worker_fatal(e)
+
+    def _loop_inner(self):
+        while True:
+            with self._cond:
+                while not self._stop and not self._pending \
+                        and not self._active:
+                    self._cond.wait()
+                if self._stop and not self._pending and not self._active:
+                    return
+            if _FL_ARMED:
+                # drill seam: an uncaught decode-worker exception,
+                # outside any per-step recovery
+                _faultline.crossing("serving_decode")
+            with self._run_lock:
+                admitted = self._admit()
+                if admitted:
+                    self._run_prefill(admitted)
+                    self._retire()
+                if self._active:
+                    self._decode_step()
+                    self._retire()
+            self._update_gauges()
+
+    def _worker_fatal(self, exc: BaseException):
+        """Terminal worker failure: every generation future fails, every
+        cache block frees, the engine goes unhealthy."""
+        _flight.dump("decode_worker_fatal", exc=exc,
+                     extra={"pending": len(self._pending),
+                            "active": len(self._active)})
+        failed = 0
+        with self._cond:
+            self._unhealthy = exc
+            self._accepting = False
+            self._stop = True
+            victims = list(self._active) + list(self._pending)
+            for seq in self._active:
+                self._free.extend(reversed(seq.block_ids))
+                seq.block_ids = []
+            self._active = []
+            self._pending = []
+            for seq in victims:
+                if not seq.future.done():
+                    seq.future.set_exception(UnavailableError(
+                        f"decode engine worker died: {exc!r} — "
+                        f"generation failed (flight bundle dumped)"))
+                    failed += 1
+            self._cond.notify_all()
+        with self._stats_lock:
+            self._failed += failed
+        self._update_gauges()
+
+    # -- scheduling -------------------------------------------------------
+    def _admit(self) -> List[_Seq]:
+        """Pull pending prefills that fit THIS round: decode-slot
+        capacity, prefill row/segment capacity, and — the paged-cache
+        admission — enough free blocks for the sequence's whole
+        reserved span.  Continue-scan (head-of-line fix): a large
+        request waiting on blocks does not starve smaller later ones."""
+        cfg = self.config
+        admitted: List[_Seq] = []
+        row_lens: List[int] = []
+        bucket_s = None
+        free = len(self._free)
+        slots_left = cfg.max_batch_size - len(self._active)
+        with self._cond:
+            for seq in list(self._pending):
+                if slots_left <= len(admitted):
+                    break
+                plen = int(seq.prompt.size)
+                need = blocks_needed(plen, seq.max_new, cfg.block_size)
+                if need > free:
+                    seq.waited_rounds += 1
+                    with self._stats_lock:
+                        self._admission_waits += 1
+                    continue
+                need_s = bucket_s
+                if need_s is None or plen > need_s:
+                    need_s = next(s for s in cfg.prefill_seq_buckets
+                                  if s >= plen)
+                trial = row_lens + [plen]
+                if _plan_bins(trial, need_s, cfg.pack_max_segments,
+                              cfg.prefill_batch_buckets[-1]) is None:
+                    continue
+                self._pending.remove(seq)
+                admitted.append(seq)
+                row_lens = trial
+                bucket_s = need_s
+                free -= need
+        for seq in admitted:
+            # reserve the FULL span now — block ids are pool slots;
+            # handing a previously-used block to a new sequence is the
+            # reuse case the parity contract covers
+            need = blocks_needed(int(seq.prompt.size), seq.max_new,
+                                 cfg.block_size)
+            for _ in range(need):
+                bid = self._free.pop()
+                if bid in self._retired_blocks:
+                    with self._stats_lock:
+                        self._block_reuses += 1
+                seq.block_ids.append(bid)
+        return admitted
+
+    def _slot(self, seq: _Seq, p: int) -> int:
+        bs = self.config.block_size
+        return seq.block_ids[p // bs] * bs + p % bs
+
+    # -- prefill ----------------------------------------------------------
+    def _prefill_feed(self, admitted: List[_Seq]):
+        cfg = self.config
+        K = cfg.pack_max_segments
+        plens = [int(s.prompt.size) for s in admitted]
+        bucket_s = next(s for s in cfg.prefill_seq_buckets
+                        if s >= max(plens))
+        plan = _plan_bins(plens, bucket_s, K,
+                          cfg.prefill_batch_buckets[-1])
+        placements, n_rows = plan
+        bucket_b = next(b for b in cfg.prefill_batch_buckets
+                        if b >= n_rows)
+        src = np.zeros((bucket_b, bucket_s), np.int64)
+        pos = np.zeros((bucket_b, bucket_s), np.int64)
+        mask = np.zeros((bucket_b, bucket_s, K), np.float32)
+        slots = np.full((bucket_b, bucket_s), -1, np.int32)
+        last_pos = np.zeros((bucket_b, K), np.int64)
+        chan = [0] * bucket_b
+        for seq, (row, off) in zip(admitted, placements):
+            plen = int(seq.prompt.size)
+            ch = chan[row]
+            chan[row] += 1
+            src[row, off:off + plen] = seq.prompt
+            pos[row, off:off + plen] = np.arange(plen)
+            mask[row, off:off + plen, ch] = 1.0
+            slots[row, off:off + plen] = [self._slot(seq, p)
+                                          for p in range(plen)]
+            last_pos[row, ch] = off + plen - 1
+            seq._gather_idx = row * K + ch
+        return ({"src_ids": src, "pos_ids": pos, "input_mask": mask,
+                 "slot_ids": slots, "last_pos": last_pos},
+                (bucket_b, bucket_s))
+
+    def _acquire(self, prepared):
+        """Owner handoff between the prefill and decode prepared steps:
+        both donate the shared scope state (weights pass through
+        aliased; the cache pools update in place), so the outgoing
+        owner's device-resident state must flow back through the scope
+        before the other side pulls it — dict writes of device arrays,
+        no host transfer."""
+        if self._owner is not None and self._owner is not prepared:
+            self._owner.sync_scope()
+        self._owner = prepared
+
+    def _run_prefill(self, admitted: List[_Seq]):
+        feed, bucket = self._prefill_feed(admitted)
+        sid = next_step_id()
+        _flight.note_step(sid, "decode_prefill", bucket)
+        _watchdog.begin("decode")
+        try:
+            with step_scope(sid), \
+                    RecordEvent("decode::prefill", requests=len(admitted),
+                                bucket=f"{bucket[0]}x{bucket[1]}"):
+                self._acquire(self._prefill)
+                handles = self._prefill.run(feed)
+                tokens = handles[1].numpy()
+        finally:
+            _watchdog.end("decode")
+        now = time.monotonic()
+        for seq in admitted:
+            tok = int(tokens[seq._gather_idx])
+            seq.pos = int(seq.prompt.size)
+            self._emit(seq, tok)
+        self._active.extend(admitted)
+        with self._stats_lock:
+            self._prefill_batches += 1
+            self._t_last = now
+
+    # -- decode step ------------------------------------------------------
+    def _decode_feed_arrays(self, bucket_b: int, live: List[_Seq],
+                            pad_only: bool = False):
+        tok = np.zeros((bucket_b,), np.int64)
+        pos = np.zeros((bucket_b,), np.int64)
+        slots = np.full((bucket_b, 1), -1, np.int32)
+        table = np.zeros((bucket_b, self._mbps), np.int32)
+        ctx = np.zeros((bucket_b,), np.int32)
+        if not pad_only:
+            for i, seq in enumerate(live):
+                tok[i] = seq.out_tokens[-1]
+                pos[i] = seq.pos
+                slots[i, 0] = self._slot(seq, seq.pos)
+                table[i, :len(seq.block_ids)] = seq.block_ids
+                ctx[i] = seq.pos + 1
+        return {"token_ids": tok, "pos_ids": pos, "slot_ids": slots,
+                "block_table": table, "ctx_len": ctx}
+
+    def _decode_step(self):
+        cfg = self.config
+        live = self._active
+        bucket_b = next(b for b in cfg.batch_buckets if b >= len(live))
+        feed = self._decode_feed_arrays(bucket_b, live)
+        sid = next_step_id()
+        _flight.note_step(sid, "decode_step", (bucket_b, len(live)))
+        _watchdog.begin("decode")
+        try:
+            with step_scope(sid), \
+                    RecordEvent("decode::step", live=len(live),
+                                bucket=bucket_b):
+                self._acquire(self._decode)
+                handles = self._decode.run(feed)
+                tokens = handles[1].numpy()
+        finally:
+            _watchdog.end("decode")
+        now = time.monotonic()
+        for i, seq in enumerate(live):
+            seq.pos += 1
+            seq.steps += 1
+            self._emit(seq, int(tokens[i]))
+        with self._stats_lock:
+            self._decode_steps += 1
+            self._decode_batch_hist[len(live)] = \
+                self._decode_batch_hist.get(len(live), 0) + 1
+            self._t_last = now
+
+    def _emit(self, seq: _Seq, tok: int):
+        seq.out_tokens.append(tok)
+        with self._stats_lock:
+            self._tokens_out += 1
+        if seq.on_token is not None:
+            try:
+                seq.on_token(tok)
+            except Exception:      # noqa: BLE001 — user callback
+                pass
+        if seq.eos is not None and tok == seq.eos:
+            seq.done = True
+            seq.reason = "eos"
+        elif len(seq.out_tokens) >= seq.max_new:
+            seq.done = True
+
+    def _retire(self):
+        with self._stats_lock:
+            in_use = sum(len(s.block_ids) for s in self._active)
+            self._peak_blocks = max(self._peak_blocks, in_use)
+        finished = [s for s in self._active if s.done]
+        if not finished:
+            return
+        with self._cond:
+            self._active = [s for s in self._active if not s.done]
+            for seq in finished:
+                self._retired_blocks.update(seq.block_ids)
+                self._free.extend(reversed(seq.block_ids))
+                seq.block_ids = []
+            self._cond.notify_all()
+        for seq in finished:
+            seq.future.set_result(GenerationResult(
+                seq.out_tokens, int(seq.prompt.size), seq.reason,
+                seq.steps))
+        with self._stats_lock:
+            self._completed += len(finished)
+
+    def _update_gauges(self):
+        try:
+            in_use = self.pool_blocks - len(self._free)
+            _metrics.gauge("decode::cache_blocks_used").set(in_use)
+            _metrics.gauge("decode::active_seqs").set(len(self._active))
+        except Exception:          # noqa: BLE001 — metrics best-effort
+            pass
+
+    # -- warmup -----------------------------------------------------------
+    def warmup(self) -> int:
+        """Compile (or AOT-cache-load) the WHOLE executable grid from
+        canonical feeds: every prefill (batch x seq) bucket and every
+        decode batch bucket.  All warmup writes carry slot -1 /
+        ctx_len 0, so the cache pools stay bitwise untouched.  Returns
+        the combo count — a warm restart under ``flag("aot_cache_dir")``
+        resolves all of them with 0 fresh compiles."""
+        cfg = self.config
+        K = cfg.pack_max_segments
+        n = 0
+        with self._run_lock:
+            for sb in cfg.prefill_seq_buckets:
+                for bb in cfg.prefill_batch_buckets:
+                    feed = {
+                        "src_ids": np.zeros((bb, sb), np.int64),
+                        "pos_ids": np.zeros((bb, sb), np.int64),
+                        "input_mask": np.zeros((bb, sb, K), np.float32),
+                        "slot_ids": np.full((bb, sb), -1, np.int32),
+                        "last_pos": np.zeros((bb, K), np.int64),
+                    }
+                    self._acquire(self._prefill)
+                    self._prefill.run(feed)
+                    n += 1
+            for bb in cfg.batch_buckets:
+                self._acquire(self._decode)
+                self._decode.run(self._decode_feed_arrays(bb, [],
+                                                          pad_only=True))
+                n += 1
+            if self._owner is not None:
+                self._owner.wait()
+        return n
+
+    # -- reference loop ---------------------------------------------------
+    def _score_buckets(self) -> Tuple[int, ...]:
+        cfg = self.config
+        out = set(cfg.prefill_seq_buckets)
+        out.add(cfg.max_seq_len)
+        return tuple(sorted(out))
+
+    def greedy_reference(self, feed, max_new_tokens: Optional[int] = None,
+                         eos_token_id: Optional[int] = None
+                         ) -> GenerationResult:
+        """The unbatched greedy loop — the parity oracle AND the honest
+        baseline: re-scores the FULL prefix through the cache-free
+        scoring program for every emitted token (prefix padded to the
+        seq-bucket ladder, so its compile count stays bounded), exactly
+        the reference AnalysisPredictor serving shape.  Runs on an
+        isolated snapshot of the engine's weights, so live traffic
+        cannot perturb it and it cannot perturb the cache.  Every
+        engine-generated sequence must match this token-for-token."""
+        cfg = self.config
+        prompt = self._normalize_prompt(feed)
+        max_new = cfg.max_new_tokens if max_new_tokens is None \
+            else int(max_new_tokens)
+        eos = cfg.eos_token_id if eos_token_id is None else eos_token_id
+        if int(prompt.size) + max_new > cfg.max_seq_len:
+            raise InvalidArgumentError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new}) "
+                f"exceeds max_seq_len={cfg.max_seq_len}")
+        with self._ref_lock:
+            if self._score is None:
+                self._score = self._exe.prepare(
+                    self._programs.score,
+                    feed_names=self._programs.score_feeds,
+                    fetch_list=list(self._programs.fetch_names),
+                    scope=self._ref_scope, donate_state=False)
+            seq = list(int(t) for t in prompt)
+            out_tokens: List[int] = []
+            reason = "length"
+            buckets = self._score_buckets()
+            for _ in range(max_new):
+                cur = len(seq)
+                sb = next(b for b in buckets if b >= cur)
+                src = np.zeros((1, sb), np.int64)
+                src[0, :cur] = seq
+                pos = np.zeros((1, sb), np.int64)
+                pos[0, :cur] = np.arange(cur)
+                mask = np.zeros((1, sb, 1), np.float32)
+                mask[0, :cur, 0] = 1.0
+                last = np.full((1, 1), cur - 1, np.int64)
+                handles = self._score.run({
+                    "src_ids": src, "pos_ids": pos, "input_mask": mask,
+                    "last_pos": last})
+                tok = int(handles[1].numpy()[0])
+                out_tokens.append(tok)
+                seq.append(tok)
+                if eos is not None and tok == eos:
+                    reason = "eos"
+                    break
+        return GenerationResult(out_tokens, int(prompt.size), reason,
+                                len(out_tokens))
+
+    # -- observability ----------------------------------------------------
+    @property
+    def compiled_executables(self) -> int:
+        n = len(self._prefill._steps) + len(self._decode._steps)
+        if self._score is not None:
+            n += len(self._score._steps)
+        return n
+
+    def stats(self) -> Dict[str, Any]:
+        with self._stats_lock:
+            elapsed = None
+            if self._t_first is not None and self._t_last is not None:
+                elapsed = max(self._t_last - self._t_first, 1e-9)
+            out = {
+                "submitted": self._submitted,
+                "completed": self._completed,
+                "failed": self._failed,
+                "rejected": self._rejected,
+                "tokens_out": self._tokens_out,
+                "tokens_per_s": (self._tokens_out / elapsed)
+                if elapsed else 0.0,
+                "decode_steps": self._decode_steps,
+                "prefill_batches": self._prefill_batches,
+                "decode_batch_hist": dict(self._decode_batch_hist),
+                "admission_waits": self._admission_waits,
+                "block_reuses": self._block_reuses,
+                "pool_blocks": self.pool_blocks,
+                "peak_blocks_used": self._peak_blocks,
+                "peak_occupancy": self._peak_blocks /
+                max(1, self.pool_blocks),
+            }
+        out["cache_blocks_used"] = self.pool_blocks - len(self._free)
+        out["compile_count"] = self.compiled_executables
+        with self._cond:
+            out["pending"] = len(self._pending)
+            out["active"] = len(self._active)
+            out["unhealthy"] = self._unhealthy is not None
+        return out
+
+
+__all__ = ["DecodeConfig", "DecodeEngine", "GenerationResult",
+           "blocks_needed"]

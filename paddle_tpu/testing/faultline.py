@@ -49,6 +49,11 @@ SEAMS: Dict[str, str] = {
     "serving_worker": (
         "ServingEngine worker loop, top of each iteration: an uncaught "
         "worker exception (outside the per-batch recovery)"),
+    "serving_decode": (
+        "DecodeEngine worker loop, top of each scheduling round: an "
+        "uncaught decode-worker exception (outside the per-step "
+        "recovery) — in-flight generations must fail, their cache "
+        "blocks must free, and the engine must go unhealthy"),
     "step_stall": (
         "PreparedStep.run, before dispatch: stall the step on the host "
         "(the hang the watchdog must catch)"),

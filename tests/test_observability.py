@@ -527,6 +527,54 @@ def test_metrics_http_endpoint():
                 f"http://{srv.addr}:{srv.port}/nope")
 
 
+def test_prometheus_guardrail_series():
+    """The PR 14 guardrail state is on the scrape surface (ROADMAP
+    follow-up): ``guardrail::skipped_total`` / ``guardrail::loss_scale``
+    gauges and the ``watchdog::trip`` counter all appear in
+    ``prometheus_text()`` and through the HTTP endpoint after a guarded
+    run + an induced stall."""
+    from paddle_tpu.testing import faultline
+    keep = get_flags(["guard_nonfinite", "guard_loss_scale",
+                      "step_deadline_s"])
+    deadline = 0.3
+    set_flags({"guard_nonfinite": True, "guard_loss_scale": True,
+               "step_deadline_s": deadline})
+    try:
+        main, startup, loss = _fc_train_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        rng = np.random.RandomState(3)
+        feed = {"x": rng.randn(4, 6).astype(np.float32)}
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            prepared = exe.prepare(main, fetch_list=[loss], scope=scope,
+                                   feed=feed)
+            prepared.run(feed)
+            info = prepared.guard_info(sync=True)   # decodes both gauges
+            assert info["loss_scale"] is not None
+            faultline.arm("step_stall", action="stall",
+                          seconds=3 * deadline, times=1)
+            prepared.run(feed)                      # watchdog trips
+            faultline.disarm()
+            prepared.wait()
+            prepared.close()
+        text = metrics.prometheus_text()
+        assert "# TYPE paddle_tpu_guardrail::skipped_total gauge" in text
+        assert "paddle_tpu_guardrail::skipped_total 0" in text
+        assert "# TYPE paddle_tpu_guardrail::loss_scale gauge" in text
+        assert "paddle_tpu_guardrail::loss_scale " in text
+        assert "# TYPE paddle_tpu_watchdog::trip counter" in text
+        assert 'paddle_tpu_watchdog::trip{beacon="prepared"} 1' in text
+        with metrics.serve_metrics(port=0) as srv:
+            scraped = urllib.request.urlopen(srv.url).read().decode()
+        assert "paddle_tpu_guardrail::skipped_total" in scraped
+        assert "paddle_tpu_guardrail::loss_scale" in scraped
+        assert "paddle_tpu_watchdog::trip" in scraped
+    finally:
+        faultline.disarm()
+        set_flags(keep)
+
+
 # ---------------------------------------------------------------------------
 # profiler satellites
 # ---------------------------------------------------------------------------

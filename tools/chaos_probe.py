@@ -58,8 +58,8 @@ SCHEMA = "paddle_tpu.chaos/1"
 #: mapping") — asserted against faultline.seams() so the registry stays
 #: statically enumerable
 DOCUMENTED_SEAMS = ("checkpoint_write", "collective_impl",
-                    "grad_nonfinite", "reshard_execute", "serving_worker",
-                    "step_stall")
+                    "grad_nonfinite", "reshard_execute", "serving_decode",
+                    "serving_worker", "step_stall")
 
 
 def _flags():

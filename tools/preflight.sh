@@ -16,6 +16,12 @@ echo "== preflight: serve_bench (ragged-packing parity + padding-waste"
 echo "   bound, AOT-cache cold/warm restart, ServingFleet HBM admission) =="
 python tools/serve_bench.py --selftest
 
+echo "== preflight: decode bench (paged KV-cache engine: continuous"
+echo "   batching token parity vs the per-request greedy loop, AOT"
+echo "   warm-restart 0 fresh compiles, cache-block admission reject"
+echo "   with 0 compiles + parity under pool churn) =="
+python tools/decode_bench.py --selftest
+
 echo "== preflight: observability probe (telemetry JSONL schema, MFU in"
 echo "   (0,1] within 10% of the analytic model, flight bundle on induced"
 echo "   NaN, perfetto timeline merge) =="
