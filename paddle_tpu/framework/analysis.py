@@ -83,6 +83,7 @@ INFERENCE_DONATED_READ = "inference-donated-read"
 # KV-cache pool persistables — see verify_decode)
 DECODE_STATE_WRITE = "decode-state-write"
 DECODE_CACHE_UNDECLARED = "decode-cache-undeclared"
+DECODE_CHAIN_MISPLACED = "decode-chain-misplaced"
 
 #: meta-ops interpreted by the executor itself, not the registry
 META_OPS = frozenset({"feed", "fetch", "backward", "pipeline"})
@@ -1054,11 +1055,16 @@ def verify_decode(program: Program, feed_names: Iterable[str] = (),
       weights token-to-token;
     * every declared cache var must actually exist in the program
       (``decode-cache-undeclared``) — a typo'd pool name would silently
-      re-enable the weight-write hole.
+      re-enable the weight-write hole;
+    * the ``decode_chain`` marker op (the device-chained decode scan,
+      executor.lower_decode_chain) must be UNIQUE and the program's
+      LAST op (``decode-chain-misplaced``): the executor lowers exactly
+      one marker over everything before it, so a second marker or an op
+      after the marker would silently escape the chained scan.
 
     Wired at :class:`DecodeEngine` start under
-    ``flag("verify_programs")`` for both the prefill and decode-step
-    programs."""
+    ``flag("verify_programs")`` for every engine program (prefill,
+    decode step, each chained executable, chunked prefill)."""
     result = verify_program(program, feed_names=feed_names,
                             fetch_names=fetch_names,
                             scope_names=scope_names)
@@ -1071,8 +1077,35 @@ def verify_decode(program: Program, feed_names: Iterable[str] = (),
             f"decode cache var {name!r} is not declared in the program — "
             f"the write allow-list would not cover anything", None, 0, -1)
 
+    gb = program.global_block()
+    chain_at = [i for i, op in enumerate(gb.ops)
+                if op.type == "decode_chain"]
+    for i in chain_at[1:]:
+        result.add(
+            "error", DECODE_CHAIN_MISPLACED,
+            f"decode program carries {len(chain_at)} decode_chain "
+            f"markers — the executor lowers exactly ONE chain per "
+            f"program; a second marker would never run",
+            gb.ops[i], gb.idx, i)
+    if chain_at and chain_at[0] != len(gb.ops) - 1 and \
+            len(chain_at) == 1:
+        result.add(
+            "error", DECODE_CHAIN_MISPLACED,
+            f"decode_chain marker at op {chain_at[0]} of "
+            f"{len(gb.ops)} — the marker must be the LAST op: "
+            f"everything before it is the scanned step body, and an op "
+            f"AFTER it would silently escape the device chain",
+            gb.ops[chain_at[0]], gb.idx, chain_at[0])
+
     def scan(block: Block):
         for idx, op in enumerate(block.ops):
+            if op.type == "decode_chain" and block is not gb:
+                result.add(
+                    "error", DECODE_CHAIN_MISPLACED,
+                    f"decode_chain marker inside sub-block {block.idx} "
+                    f"— the executor only lowers a chain at the top "
+                    f"level of the step program",
+                    op, block.idx, idx)
             if op.type in collectives:
                 result.add(
                     "error", INFERENCE_COLLECTIVE,
@@ -1392,6 +1425,7 @@ __all__ = [
     "verify_program", "verify_inference", "verify_decode",
     "verify_cached", "verify_pipeline",
     "DECODE_STATE_WRITE", "DECODE_CACHE_UNDECLARED",
+    "DECODE_CHAIN_MISPLACED",
     "clear_verify_cache",
     "verify_structure", "verify_startup_agreement", "infer_shapes",
     "verify_distributed", "verify_shard_layout", "collective_signature",

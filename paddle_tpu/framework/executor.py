@@ -199,6 +199,99 @@ def run_ops(ops, env, ctx):
     return env
 
 
+def lower_decode_chain(ops, chain_idx, env, ctx, pool_names):
+    """Device-chained decode: scan the program body ``chain_length``
+    times entirely on device (serving/decode.py's fast path v2).
+
+    The ``decode_chain`` marker op sits LAST in its program; its input
+    slots name the per-step vars the chain drives (token/position/slot/
+    ctx-len feeds are shadowed per iteration; the body's ``next_tokens``
+    / ``next_logits`` close the loop) and its ``Out`` is the packed
+    ``[chain_length, B]`` token matrix — ONE host fetch per chain
+    instead of one per token.  Everything the single decode step did on
+    the host moves into the carry:
+
+    * slot/ctx computation — ``slot = table[pos // bs] * bs + pos % bs``
+      (bitwise the engine's host arithmetic, so a chain of L steps
+      writes exactly the slots L single steps would);
+    * the next-token feedback edge — greedy rows ride the body's own
+      argmax (bit parity with the single-step program); sampling rows
+      re-draw from ``next_logits`` (ops/sampling_ops.py);
+    * per-row EOS / length masks — finished rows freeze (position and
+      carry token stop advancing), write nothing (slot -1 is the
+      cache_write drop lane) and emit -1, which the host unpacker
+      treats as "row already done".
+
+    The KV pools thread through the scan carry, so the donated state
+    chain is preserved — a chain program is state-compatible with the
+    prefill/chunk executables sharing its scope."""
+    chain_op = ops[chain_idx]
+    body = ops[:chain_idx] + ops[chain_idx + 1:]
+    attrs = chain_op.attrs
+    length = int(attrs["chain_length"])
+    bs = int(attrs["block_size"])
+    with_sampling = bool(attrs.get("with_sampling"))
+
+    def in0(slot):
+        return chain_op.input(slot)[0]
+
+    tok_v, pos_v = in0("TokenIds"), in0("PosIds")
+    slot_v, ctxl_v = in0("SlotIds"), in0("CtxLen")
+    logits_v, tokens_v = in0("Logits"), in0("Tokens")
+    out_v = chain_op.output("Out")[0]
+    # native integer dtypes throughout (no forced int64 — x64 is
+    # usually disabled and an explicit widening astype warns)
+    table = env[in0("BlockTable")].astype(jnp.int32)
+    eos = env[in0("EosIds")].astype(jnp.int32)
+    if with_sampling:
+        from ..ops.sampling_ops import sample_chain_tokens
+        temp = env[in0("Temperature")].astype(jnp.float32)
+        top_k = env[in0("TopK")].astype(jnp.int32)
+        top_p = env[in0("TopP")].astype(jnp.float32)
+        seeds = env[in0("Seeds")].astype(jnp.int32)
+
+    pools = [n for op in body for n in op.output_names()
+             if n in pool_names]
+    pools = list(dict.fromkeys(pools))
+
+    def one_step(carry, _):
+        tok, pos, left, done, pool_vals = carry
+        blk_idx = (pos // bs).astype(jnp.int32)
+        blk = jnp.take_along_axis(table, blk_idx[:, None], axis=1)[:, 0]
+        slot = jnp.where(done, jnp.int32(-1),
+                         blk * bs + (pos % bs).astype(jnp.int32))
+        e = dict(env)
+        for n, v in zip(pools, pool_vals):
+            e[n] = v
+        e[tok_v] = tok
+        e[pos_v] = pos
+        e[slot_v] = slot[:, None]
+        e[ctxl_v] = (pos + 1).astype(jnp.int32)
+        e = run_ops(body, e, ctx)
+        nxt = e[tokens_v].reshape(-1).astype(tok.dtype)
+        if with_sampling:
+            nxt = sample_chain_tokens(e[logits_v], nxt, temp, top_k,
+                                      top_p, seeds,
+                                      pos).astype(tok.dtype)
+        emitted = jnp.where(done, jnp.full_like(nxt, -1), nxt)
+        left2 = jnp.where(done, left, left - 1)
+        done2 = done | (left2 <= 0) | ((eos >= 0) & (nxt == eos))
+        tok2 = jnp.where(done, tok, nxt)
+        pos2 = jnp.where(done, pos, pos + 1)
+        return (tok2, pos2, left2, done2,
+                tuple(e[n] for n in pools)), emitted
+
+    left0 = env[in0("StepsLeft")].astype(jnp.int32)
+    carry0 = (env[tok_v].astype(jnp.int32), env[pos_v].astype(jnp.int32),
+              left0, left0 <= 0, tuple(env[n] for n in pools))
+    carry, emitted = jax.lax.scan(one_step, carry0, None, length=length)
+    out = dict(env)
+    for n, v in zip(pools, carry[4]):
+        out[n] = v
+    out[out_v] = emitted
+    return out
+
+
 def _segment_at_checkpoints(ops, checkpoint_names):
     """Split ops into segments ending right after each checkpoint var is
     produced (for jax.checkpoint, ref: backward.py:629 recompute segments)."""
@@ -2079,6 +2172,12 @@ class Executor:
 
         bw_idx = next((i for i, op in enumerate(ops)
                        if op.type == "backward"), None)
+        # device-chained decode (serving/decode.py): the marker op turns
+        # the whole step into a chain_length-long lax.scan of the body
+        chain_idx = next((i for i, op in enumerate(ops)
+                          if op.type == "decode_chain"), None)
+        chain_pools = frozenset(written_state) if chain_idx is not None \
+            else frozenset()
         is_test = program._is_test
         replicated_names = _replicated_var_names(ops, bw_idx)
 
@@ -2128,7 +2227,10 @@ class Executor:
             env = {}
             env.update(state_vals)
             env.update(feed_vals)
-            if bw_idx is None:
+            if chain_idx is not None:
+                env = lower_decode_chain(ops, chain_idx, env, ctx,
+                                         chain_pools)
+            elif bw_idx is None:
                 env = run_ops(ops, env, ctx)
             else:
                 env = lower_block_with_backward(

@@ -989,7 +989,8 @@ def plan_cache_pool(program: Program, feed_shapes=None,
                     cache_vars: Iterable[str] = (),
                     block_bytes: int = 0,
                     budget_gb: Optional[float] = None,
-                    min_blocks: int = 1) -> Dict[str, Any]:
+                    min_blocks: int = 1,
+                    reserve_blocks: int = 0) -> Dict[str, Any]:
     """Size a paged KV-cache pool at DECODE-ENGINE START — the
     generalization of ``ServingFleet``'s HBM admission from "one more
     bucket executable" to "one more cache block".
@@ -1004,14 +1005,17 @@ def plan_cache_pool(program: Program, feed_shapes=None,
         blocks = (budget - (peak - probe_pool)) // block_bytes
 
     Returns ``{"blocks", "fixed_bytes", "block_bytes", "budget_bytes",
-    "estimate"}``; ``blocks`` is None when no budget applies (caller
-    keeps its configured default).  Raises ``InvalidArgumentError`` when
-    even ``min_blocks`` (one sequence's worth) cannot fit — at engine
-    start, with the program's top live tensors in the message, instead
-    of as a device OOM mid-traffic."""
+    "reserve_blocks", "estimate"}``; ``blocks`` is None when no budget
+    applies (caller keeps its configured default).  Raises
+    ``InvalidArgumentError`` when even ``min_blocks`` (one sequence's
+    worth) plus ``reserve_blocks`` (headroom the caller pledges to the
+    cross-request prefix cache so a full working set cannot starve it)
+    cannot fit — at engine start, with the program's top live tensors
+    in the message, instead of as a device OOM mid-traffic."""
     from ..flags import flag
     if budget_gb is None:
         budget_gb = float(flag("hbm_budget_gb") or 0.0)
+    reserve_blocks = max(0, int(reserve_blocks))
     est = estimate(program, feed_shapes=feed_shapes,
                    fetch_names=fetch_names, donate_state=True)
     cache_vars = set(cache_vars)
@@ -1029,17 +1033,18 @@ def plan_cache_pool(program: Program, feed_shapes=None,
     fixed = max(0, est.peak_bytes - probe_pool)
     out = {"blocks": None, "fixed_bytes": int(fixed),
            "block_bytes": int(block_bytes), "budget_bytes": None,
-           "estimate": est}
+           "reserve_blocks": reserve_blocks, "estimate": est}
     if not budget_gb or budget_gb <= 0:
         return out
     budget = int(budget_gb * _GIB)
     out["budget_bytes"] = budget
     blocks = (budget - fixed) // max(1, int(block_bytes))
-    if blocks < min_blocks:
+    if blocks < min_blocks + reserve_blocks:
         raise InvalidArgumentError(
             f"decode cache admission: hbm_budget_gb={budget_gb:g} leaves "
             f"{max(0, budget - fixed)} bytes for the KV-cache pool — "
-            f"fewer than min_blocks={min_blocks} blocks of "
+            f"fewer than min_blocks={min_blocks} blocks (+ "
+            f"reserve_blocks={reserve_blocks} prefix-cache headroom) of "
             f"{block_bytes} bytes (weights + decode working set cost "
             f"{fixed} bytes).  Rejected at engine start, before any "
             f"compile.\n" + est.report())

@@ -48,8 +48,13 @@ def _attr(name, cfg):
 
 @dataclass
 class DecoderPrograms:
-    """One decoder parameter set lowered three ways (shared param
-    names; ``startup`` initialises all of them once)."""
+    """One decoder parameter set lowered several ways (shared param
+    names; ``startup`` initialises all of them once).  Beyond the
+    prefill / decode-step / score triple, ``chains`` holds one
+    device-chained decode program per configured chain length (the
+    ``decode_chain`` marker op drives executor.lower_decode_chain) and
+    ``chunk`` the [1, C] cache-read chunked-prefill program (absolute
+    ``pos_ids`` double as the QPos causal feed)."""
 
     prefill: Program
     decode: Program
@@ -61,18 +66,30 @@ class DecoderPrograms:
     score_feeds: List[str]
     fetch_names: List[str] = field(
         default_factory=lambda: ["next_logits", "next_tokens"])
+    chains: Dict[int, Program] = field(default_factory=dict)
+    chain_feeds: List[str] = field(default_factory=list)
+    chain_fetch_names: List[str] = field(
+        default_factory=lambda: ["chain_tokens"])
+    chunk: Optional[Program] = None
+    chunk_feeds: List[str] = field(default_factory=list)
 
 
 class _Cache:
     """Per-build cache wiring: the pool vars of the CURRENT program plus
     the slot/table/length feeds the cache ops read."""
 
-    def __init__(self, kpools, vpools, slots, table=None, ctx_len=None):
+    def __init__(self, kpools, vpools, slots, table=None, ctx_len=None,
+                 q_pos=None):
         self.kpools = kpools
         self.vpools = vpools
         self.slots = slots
         self.table = table
         self.ctx_len = ctx_len
+        # absolute query positions ([B, Sq]) — chunked prefill reads the
+        # cache with MORE context than the query's own position, so the
+        # cached attention needs a per-query causal bound on top of the
+        # per-sequence ctx_len bound
+        self.q_pos = q_pos
 
     @property
     def read(self):
@@ -98,6 +115,8 @@ def _attention(q, k, v, attn_bias, cfg, name, cache: Optional[_Cache],
         inputs = {"Q": [q], "KPool": [cache.kpools[layer_idx]],
                   "VPool": [cache.vpools[layer_idx]],
                   "BlockTable": [cache.table], "CtxLen": [cache.ctx_len]}
+        if cache.q_pos is not None:
+            inputs["QPos"] = [cache.q_pos]
         attrs["_cached"] = True     # routes the cached_flash Pallas leg
     else:
         inputs = {"Q": [q], "K": [k], "V": [v]}
@@ -318,9 +337,143 @@ class BertDecoder:
         return main, ["token_ids", "pos_ids", "slot_ids", "block_table",
                       "ctx_len"]
 
+    def _build_chain(self, startup, num_blocks, block_size,
+                     max_blocks_per_seq, chain_length, with_sampling):
+        """The decode-step network plus a trailing ``decode_chain``
+        marker op.  The executor lowers the marker into a
+        ``chain_length``-step ``lax.scan`` over the step body (token
+        feedback, cache writes, block-table walk, EOS/len masks all on
+        device); the host fetches one packed ``[chain, B]`` token block
+        per chain instead of one token per step.  The marker sits LAST
+        and takes the body's ``next_logits``/``next_tokens`` as inputs,
+        which keeps the body alive through fetch-list pruning."""
+        cfg = self.cfg
+        main = Program()
+        main.random_seed = self.seed
+        main._is_test = True
+        with program_guard(main, startup):
+            tok = layers.data("token_ids", shape=[-1], dtype="int64",
+                              append_batch_size=False)
+            pos = layers.data("pos_ids", shape=[-1], dtype="int64",
+                              append_batch_size=False)
+            slots = layers.data("slot_ids", shape=[-1, 1], dtype="int32",
+                                append_batch_size=False)
+            table = layers.data("block_table",
+                                shape=[-1, max_blocks_per_seq],
+                                dtype="int32", append_batch_size=False)
+            ctx_len = layers.data("ctx_len", shape=[-1], dtype="int32",
+                                  append_batch_size=False)
+            steps_left = layers.data("steps_left", shape=[-1],
+                                     dtype="int32",
+                                     append_batch_size=False)
+            eos_ids = layers.data("eos_ids", shape=[-1], dtype="int64",
+                                  append_batch_size=False)
+            sample_feeds = []
+            if with_sampling:
+                sample_feeds = [
+                    layers.data("temperature", shape=[-1],
+                                dtype="float32",
+                                append_batch_size=False),
+                    layers.data("top_k", shape=[-1], dtype="int32",
+                                append_batch_size=False),
+                    layers.data("top_p", shape=[-1], dtype="float32",
+                                append_batch_size=False),
+                    layers.data("seeds", shape=[-1], dtype="int32",
+                                append_batch_size=False)]
+            block = main.global_block()
+            kpools, vpools = self._declare_pools(block, num_blocks,
+                                                 block_size)
+            cache = _Cache(kpools, vpools, slots, table, ctx_len)
+            x = _embed(tok, pos, cfg, lift_1d=True)
+            for i in range(cfg.num_hidden_layers):
+                x = _decoder_layer(x, None, cfg,
+                                   f"{self.name}_layer_{i}", cache, i)
+            h = layers.reshape(x, [-1, cfg.hidden_size])
+            logits, tokens = _lm_head(h, cfg)
+            out = block.create_var(name="chain_tokens",
+                                   shape=(chain_length, -1),
+                                   dtype="int64")
+            helper = LayerHelper("decode_chain")
+            inputs = {"TokenIds": [tok], "PosIds": [pos],
+                      "SlotIds": [slots], "BlockTable": [table],
+                      "CtxLen": [ctx_len], "StepsLeft": [steps_left],
+                      "EosIds": [eos_ids], "Logits": [logits],
+                      "Tokens": [tokens]}
+            if with_sampling:
+                inputs.update({"Temperature": [sample_feeds[0]],
+                               "TopK": [sample_feeds[1]],
+                               "TopP": [sample_feeds[2]],
+                               "Seeds": [sample_feeds[3]]})
+            helper.append_op(type="decode_chain", inputs=inputs,
+                             outputs={"Out": [out]},
+                             attrs={"chain_length": chain_length,
+                                    "block_size": block_size,
+                                    "with_sampling":
+                                        bool(with_sampling)})
+        feeds = ["token_ids", "pos_ids", "slot_ids", "block_table",
+                 "ctx_len", "steps_left", "eos_ids"]
+        if with_sampling:
+            feeds += ["temperature", "top_k", "top_p", "seeds"]
+        return main, feeds
+
+    def _build_chunk(self, startup, num_blocks, block_size,
+                     max_blocks_per_seq):
+        """Chunked prefill: a ``[B, C]`` prompt slice that WRITES its
+        K/V into the pools like prefill but READS attention through the
+        block table like decode, with absolute ``pos_ids`` doubling as
+        the per-query causal bound (QPos).  ``ctx_len`` covers all
+        tokens written so far INCLUDING this chunk, so earlier chunks'
+        cache entries are visible and later positions are masked by
+        QPos."""
+        cfg = self.cfg
+        main = Program()
+        main.random_seed = self.seed
+        main._is_test = True
+        with program_guard(main, startup):
+            src = layers.data("src_ids", shape=[-1, -1], dtype="int64",
+                              append_batch_size=False)
+            pos = layers.data("pos_ids", shape=[-1, -1], dtype="int64",
+                              append_batch_size=False)
+            slots = layers.data("slot_ids", shape=[-1, -1],
+                                dtype="int32", append_batch_size=False)
+            table = layers.data("block_table",
+                                shape=[-1, max_blocks_per_seq],
+                                dtype="int32", append_batch_size=False)
+            ctx_len = layers.data("ctx_len", shape=[-1], dtype="int32",
+                                  append_batch_size=False)
+            last_pos = layers.data("last_pos", shape=[-1, 1],
+                                   dtype="int64",
+                                   append_batch_size=False)
+            block = main.global_block()
+            kpools, vpools = self._declare_pools(block, num_blocks,
+                                                 block_size)
+            cache = _Cache(kpools, vpools, slots, table, ctx_len,
+                           q_pos=pos)
+            x = _embed(src, pos, cfg)
+            for i in range(cfg.num_hidden_layers):
+                x = _decoder_layer(x, None, cfg,
+                                   f"{self.name}_layer_{i}", cache, i)
+            h = _gather_last(x, last_pos, cfg)
+            _lm_head(h, cfg)
+        return main, ["src_ids", "pos_ids", "slot_ids", "block_table",
+                      "ctx_len", "last_pos"]
+
+    def cache_layout_key(self, block_size: int) -> str:
+        """Identity prefix for cross-request prefix-cache keys: two
+        cached blocks are interchangeable ONLY if the model parameters
+        and the pool layout that produced them agree.  Seed stands in
+        for the parameter values (deterministic init)."""
+        cfg = self.cfg
+        return (f"{self.name}/seed={self.seed}/L={cfg.num_hidden_layers}"
+                f"/H={cfg.hidden_size}/heads={cfg.num_attention_heads}"
+                f"/V={cfg.vocab_size}/dtype={cfg.dtype}/bs={block_size}")
+
     def build(self, num_blocks: int, block_size: int,
               max_blocks_per_seq: int,
-              pack_max_segments: int = 1) -> DecoderPrograms:
+              pack_max_segments: int = 1,
+              chain_lengths: tuple = (),
+              with_sampling: bool = False,
+              chunk_tokens: Optional[int] = None) -> DecoderPrograms:
         from ..framework import unique_name
         startup = Program()
         startup.random_seed = self.seed
@@ -339,11 +492,23 @@ class BertDecoder:
                 Program(), num_blocks, block_size, max_blocks_per_seq)
             score, score_feeds = self._build_prefill(
                 Program(), num_blocks, block_size, 1, score_only=True)
+            chains, chain_feeds = {}, []
+            for length in chain_lengths:
+                chains[int(length)], chain_feeds = self._build_chain(
+                    Program(), num_blocks, block_size,
+                    max_blocks_per_seq, int(length), with_sampling)
+            chunk, chunk_feeds = None, []
+            if chunk_tokens:
+                chunk, chunk_feeds = self._build_chunk(
+                    Program(), num_blocks, block_size,
+                    max_blocks_per_seq)
         return DecoderPrograms(
             prefill=prefill, decode=decode, score=score, startup=startup,
             cache_vars=self.cache_var_names(),
             prefill_feeds=prefill_feeds, decode_feeds=decode_feeds,
-            score_feeds=score_feeds)
+            score_feeds=score_feeds, chains=chains,
+            chain_feeds=chain_feeds, chunk=chunk,
+            chunk_feeds=chunk_feeds)
 
 
 __all__ = ["BertDecoder", "DecoderPrograms"]

@@ -11,6 +11,7 @@ from . import optimizer_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import cache_ops     # noqa: F401
+from . import sampling_ops  # noqa: F401
 from . import fused_ops     # noqa: F401
 from . import controlflow_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401

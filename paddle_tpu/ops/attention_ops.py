@@ -121,7 +121,15 @@ def lower_cached_attention(ctx, ins, attrs, use_flash=False):
     Positions at or beyond ``CtxLen`` (padded table entries, reused
     blocks carrying another sequence's leftovers) are masked to an
     EXACT-zero softmax weight, which is what makes co-batched and
-    block-reuse results bitwise equal to a lone run."""
+    block-reuse results bitwise equal to a lone run.
+
+    The optional ``QPos`` input ([B, Sq] absolute query positions —
+    chunked prefill, serving/decode.py) adds a per-query causal term on
+    top: key position t is visible to query position p iff ``t <= p``.
+    Valid (query, key) pairs still get an EXACTLY-zero bias (0.0 + 0.0),
+    so a prompt prefilled in chunks reads bitwise the same cache bytes
+    a packed one-shot prefill reads; without QPos the decode-step bias
+    is bitwise unchanged."""
     from .cache_ops import ctx_len_bias, gather_cache
     q = x(ins, "Q")
     kpool, vpool = x(ins, "KPool"), x(ins, "VPool")
@@ -130,6 +138,14 @@ def lower_cached_attention(ctx, ins, attrs, use_flash=False):
     keys = gather_cache(kpool, table)
     vals = gather_cache(vpool, table)
     bias = ctx_len_bias(ctx_len, keys.shape[1])
+    q_pos = x(ins, "QPos")
+    if q_pos is not None:
+        tpos = jnp.arange(keys.shape[1], dtype=jnp.int32)[None, None, :]
+        causal = jnp.where(
+            tpos <= q_pos.astype(jnp.int32)[:, :, None], 0.0, -1e9)
+        # [B, 1, 1, T] + [B, 1, Sq, T] — both legs contribute exact
+        # zeros on valid pairs, so the sum stays exactly zero there
+        bias = bias + causal[:, None, :, :].astype(bias.dtype)
     if use_flash:
         from .pallas.flash_attention import flash_attention_bshd
         out = flash_attention_bshd(

@@ -452,6 +452,17 @@ def _infer_fused_attention(ins, attrs):
             raise SpecMismatch(
                 f"fused_attention: KPool hidden width {kpool.shape[-1]} "
                 f"!= Q hidden width {q.shape[-1]}", kind="shape")
+        qpos = _sig(ins, "QPos")
+        if qpos is not None and qpos.shape is not None and \
+                q.shape is not None and len(qpos.shape) == 2 and \
+                all(d >= 0 for d in qpos.shape) and \
+                all(d >= 0 for d in q.shape[:2]) and \
+                tuple(qpos.shape) != tuple(q.shape[:2]):
+            raise SpecMismatch(
+                f"fused_attention: QPos {list(qpos.shape)} must match "
+                f"Q's [B, Sq] = {list(q.shape[:2])} (per-query absolute "
+                f"positions of the chunked-prefill causal mask)",
+                kind="shape")
         return {"Out": [VarSig(q.shape, q.dtype)]}
     k, v = _sig(ins, "K"), _sig(ins, "V")
     for other, nm in ((k, "K"), (v, "V")):
@@ -493,6 +504,29 @@ def _infer_cache_write(ins, attrs):
     vout = [VarSig(vpool.shape, vpool.dtype)] if vpool is not None and \
         vpool.shape is not None else out
     return {"KPoolOut": out, "VPoolOut": vout}
+
+
+def _infer_decode_chain(ins, attrs):
+    """The chained-decode marker op (executor.lower_decode_chain): Out
+    is the packed ``[chain_length, B]`` emitted-token matrix the host
+    fetches once per chain (-1 = row already finished)."""
+    tok = _sig(ins, "TokenIds")
+    if tok is None or tok.shape is None or len(tok.shape) != 1:
+        return None
+    length = int(attrs.get("chain_length", 0) or 0)
+    if length < 1:
+        raise SpecMismatch(
+            f"decode_chain: chain_length={length} — the device chain "
+            f"must run at least one step", kind="attr")
+    b = tok.shape[0]
+    steps = _sig(ins, "StepsLeft")
+    if steps is not None and steps.shape is not None and \
+            len(steps.shape) == 1 and steps.shape[0] >= 0 and b >= 0 and \
+            steps.shape[0] != b:
+        raise SpecMismatch(
+            f"decode_chain: StepsLeft rows {steps.shape[0]} != TokenIds "
+            f"rows {b}", kind="shape")
+    return {"Out": [VarSig((length, b), "int64")]}
 
 
 def _attention_probs_bytes(ins, outs, attrs):
@@ -1279,6 +1313,7 @@ def register_default_specs():
             flops=_flops_fused_attention,
             pallas=(_PL_RING, _PL_CACHED, _PL_FLASH))
     op_spec("cache_write", infer=_infer_cache_write)
+    op_spec("decode_chain", infer=_infer_decode_chain)
 
     # tensor manipulation (views are pure aliases)
     op_spec("reshape2", infer=_infer_reshape2, mem_transparent=True)

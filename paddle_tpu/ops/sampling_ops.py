@@ -1,0 +1,102 @@
+"""Device-side sampling for the chained decode scan.
+
+The reference samples through host-side ops bolted onto the scoring
+program (`sampling_id` draws from a softmax'd logits LoDTensor on the
+CPU; `top_k` + host glue approximate nucleus policies).  The chained
+decode runtime (serving/decode.py, executor.lower_decode_chain) keeps
+the whole token loop on device, so sampling must be a pure jnp function
+of the logits and per-sequence policy feeds — no host round-trip, no
+Python RNG:
+
+* **greedy compatibility** — a row with ``temperature <= 0`` returns
+  the body's own argmax tokens BIT-EXACTLY (the parity-reference path):
+  greedy requests co-batched with sampling requests are still covered
+  by the token-for-token contract;
+* **temperature / top-k / top-p** — logits are temperature-scaled,
+  then restricted to the intersection of the top-k set (``top_k > 0``)
+  and the top-p nucleus (``top_p > 0``); the draw is a Gumbel-argmax
+  over the surviving logits (equivalent to a categorical draw, and
+  shape-stable — no host-side renormalisation);
+* **per-sequence folded RNG keys** — each row's key is
+  ``fold_in(fold_in(PRNGKey(0), seed), position)``: a function of the
+  REQUEST's seed and the absolute position only, so a fixed-seed
+  request draws identical tokens no matter which batch row, chain
+  boundary, or scheduling round it rides (deterministic across passes
+  — the sampling analog of the greedy bit-parity contract).
+
+``decode_chain`` itself is a marker op: the executor's compile pass
+(`lower_decode_chain`) consumes it and scans the program body
+``chain_length`` times on device.  The registered impl below only
+raises — hitting it means the program ran through the plain op loop
+instead of a prepared decode step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def chain_row_keys(seeds, positions):
+    """Per-row PRNG keys ``fold_in(fold_in(PRNGKey(0), seed), pos)`` —
+    deterministic in (seed, absolute position) alone."""
+    base = jax.random.PRNGKey(0)
+
+    def one(seed, pos):
+        return jax.random.fold_in(jax.random.fold_in(base, seed), pos)
+
+    return jax.vmap(one)(seeds.astype(jnp.int32),
+                         positions.astype(jnp.int32))
+
+
+def sample_chain_tokens(logits, greedy_tokens, temperature, top_k, top_p,
+                        seeds, positions):
+    """One sampling step over ``[B, V]`` logits with per-row policies.
+
+    ``greedy_tokens`` are the body's argmax tokens ([B] integer); rows
+    with ``temperature <= 0`` return them unchanged (bit parity).
+    ``top_k <= 0`` / ``top_p <= 0`` disable the respective filter.
+    Returns [B] next tokens in ``greedy_tokens``' dtype."""
+    logits = logits.astype(jnp.float32)
+    b, v = logits.shape
+    temperature = temperature.astype(jnp.float32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+
+    # descending sort once; both filters become thresholds on it
+    sorted_desc = -jnp.sort(-scaled, axis=-1)
+    k = jnp.where(top_k.astype(jnp.int32) > 0,
+                  top_k.astype(jnp.int32), v)
+    k = jnp.clip(k, 1, v)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=1)
+    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    p = jnp.where(top_p.astype(jnp.float32) > 0.0,
+                  top_p.astype(jnp.float32), 1.0)[:, None]
+    # nucleus: keep a token while the mass STRICTLY BEFORE it is < p —
+    # the top token always survives, so the argmax below is total
+    keep = (cum - probs_sorted) < p
+    p_thr = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                    keepdims=True)
+    thr = jnp.maximum(kth, p_thr)
+    masked = jnp.where(scaled >= thr, scaled, -jnp.inf)
+
+    keys = chain_row_keys(seeds, positions)
+    gumbel = jax.vmap(lambda key: jax.random.gumbel(key, (v,)))(keys)
+    sampled = jnp.argmax(masked + gumbel,
+                         axis=-1).astype(greedy_tokens.dtype)
+    return jnp.where(temperature <= 0.0, greedy_tokens, sampled)
+
+
+@register("decode_chain")
+def _decode_chain(ctx, ins, attrs):
+    raise RuntimeError(
+        "decode_chain is a compile-time marker: the executor lowers the "
+        "surrounding program into a chain_length-step lax.scan "
+        "(executor.lower_decode_chain).  Running it through the plain op "
+        "loop means the program was executed without a prepared decode "
+        "step — use DecodeEngine / Executor.prepare.")
+
+
+__all__ = ["sample_chain_tokens", "chain_row_keys"]

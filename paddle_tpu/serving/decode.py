@@ -41,9 +41,39 @@ far:
   Masked cache reads contribute EXACT zeros (cache_ops.ctx_len_bias),
   so neither co-residents nor block leftovers can perturb a row.
 
-Static safety: ``analysis.verify_decode`` checks both programs at
+**Decode fast path v2** layers three throughput levers on top:
+
+* **device-chained decode** — the decode step lowers into a
+  ``chain_length``-step ``lax.scan`` (the ``decode_chain`` marker op,
+  ``executor.lower_decode_chain``): next-token feedback, cache writes,
+  block-table walking and per-row EOS/length masks all stay on device,
+  and the host fetches ONE packed ``[chain, B]`` token matrix per chain
+  instead of one token per step.  The scheduler picks the chain length
+  per round: a short chain when admittable work is waiting (so new
+  requests don't sit behind a long chain), the smallest chain covering
+  the longest remaining budget otherwise.  Greedy rows ride the body's
+  own argmax, so chained output is bit-identical to single-stepping;
+  sampling rows (``DecodeConfig(sampling=True)``) draw on device with
+  per-request folded keys (ops/sampling_ops.py) and are deterministic
+  under a fixed seed;
+* **cross-request prefix caching** — completed prefills PROMOTE their
+  full prompt blocks into a content-hash index over the same pool
+  (key = model/layout identity + the exact token prefix the block
+  closes).  A new request charges admission only for its non-shared
+  suffix, reuses the hit blocks by reference, and prefills only the
+  suffix tokens; refcount-0 index blocks are evictable LRU-first, and
+  eviction can never free a block a live sequence references;
+* **chunked prefill** — suffix (and, with ``chunk_tokens`` set, long)
+  prompts prefill in fixed-width chunks through a cache-READING
+  prefill program (absolute positions feed the per-query causal bound,
+  ``QPos``), one chunk per scheduling round, so a long prompt
+  interleaves with live decode chains instead of head-of-line blocking
+  them.  Only the final chunk syncs to the host.
+
+Static safety: ``analysis.verify_decode`` checks every program at
 engine start — no collectives, no persistable writes outside the
-declared cache pool.  Failure containment: the ``serving_decode``
+declared cache pool, and the ``decode_chain`` marker (when present)
+unique and last.  Failure containment: the ``serving_decode``
 faultline seam drills the fatal path (all in-flight generation futures
 fail with the error, blocks free, the engine goes unhealthy, ``drain``
 cannot hang).
@@ -106,7 +136,12 @@ class DecodeConfig:
                  pool_blocks: Optional[int] = None,
                  max_new_tokens: int = 16,
                  eos_token_id: Optional[int] = None,
-                 hbm_budget_gb: Optional[float] = None):
+                 hbm_budget_gb: Optional[float] = None,
+                 chain_lengths: Sequence[int] = (1, 4),
+                 prefix_cache: bool = True,
+                 chunk_tokens: Optional[int] = None,
+                 sampling: bool = False,
+                 prefix_reserve_blocks: int = 0):
         if block_size < 1:
             raise InvalidArgumentError("block_size must be >= 1")
         if max_batch_size < 1:
@@ -136,17 +171,44 @@ class DecodeConfig:
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
         self.hbm_budget_gb = hbm_budget_gb
+        self.chain_lengths = tuple(sorted(
+            {int(v) for v in chain_lengths}))
+        if not self.chain_lengths or self.chain_lengths[0] < 1:
+            raise InvalidArgumentError(
+                f"chain_lengths {list(chain_lengths)} must name at "
+                f"least one length >= 1")
+        self.prefix_cache = bool(prefix_cache)
+        self.chunk_tokens = int(chunk_tokens) if chunk_tokens else None
+        if self.chunk_tokens is not None and self.chunk_tokens < 1:
+            raise InvalidArgumentError("chunk_tokens must be >= 1")
+        self.sampling = bool(sampling)
+        self.prefix_reserve_blocks = int(prefix_reserve_blocks)
+        if self.prefix_reserve_blocks < 0:
+            raise InvalidArgumentError(
+                "prefix_reserve_blocks must be >= 0")
 
     @property
     def max_blocks_per_seq(self) -> int:
         return -(-self.max_seq_len // self.block_size)
 
     @property
+    def chunk_width(self) -> int:
+        """Token width of one prefill chunk (the chunked-prefill
+        executable's fixed [1, C] shape)."""
+        return int(self.chunk_tokens or self.prefill_seq_buckets[-1])
+
+    @property
     def executable_grid(self) -> int:
         """Executable count a fully-warm engine holds: the prefill
-        (batch x seq) grid plus one decode step per batch bucket."""
-        return (len(self.prefill_batch_buckets) *
-                len(self.prefill_seq_buckets) + len(self.batch_buckets))
+        (batch x seq) grid, one chained decode step per (chain length x
+        batch bucket), and the chunked-prefill program when the prefix
+        cache or chunking is on."""
+        n = (len(self.prefill_batch_buckets) *
+             len(self.prefill_seq_buckets) +
+             len(self.chain_lengths) * len(self.batch_buckets))
+        if self.prefix_cache or self.chunk_tokens:
+            n += 1
+        return n
 
 
 class GenerationResult:
@@ -169,9 +231,12 @@ class GenerationResult:
 class _Seq:
     __slots__ = ("prompt", "max_new", "eos", "future", "on_token",
                  "block_ids", "pos", "out_tokens", "done", "reason",
-                 "t_submit", "steps", "_gather_idx", "waited_rounds")
+                 "t_submit", "steps", "_gather_idx", "waited_rounds",
+                 "temperature", "top_k", "top_p", "seed", "hit_blocks",
+                 "_chunk_off")
 
-    def __init__(self, prompt, max_new, eos, on_token):
+    def __init__(self, prompt, max_new, eos, on_token,
+                 temperature=0.0, top_k=0, top_p=0.0, seed=0):
         self.prompt = prompt
         self.max_new = max_new
         self.eos = eos
@@ -186,6 +251,112 @@ class _Seq:
         self.steps = 0
         self._gather_idx = 0
         self.waited_rounds = 0
+        self.temperature = float(temperature)   # <= 0 means greedy
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+        self.hit_blocks = 0            # leading blocks shared by ref
+        self._chunk_off = 0            # prompt tokens already in cache
+
+
+class _PrefixIndex:
+    """Cross-request KV prefix cache: a content-hash index over FULL
+    blocks of the engine's one pool.
+
+    A key is ``sha256(layout_key + prompt[:(j+1)*block_size])`` — the
+    model/layout identity plus the EXACT token prefix the block closes,
+    so two requests share block ``j`` iff every token up to and
+    including that block matches and the bytes in the pool mean the
+    same thing (same parameters, same block geometry).  Entries are
+    refcounted: a probe hit or a promotion holds one reference per
+    user, retirement releases it, and only refcount-0 entries are
+    evictable (LRU-first — a hit refreshes recency).  An indexed block
+    at refcount 0 is *effectively free*: admission counts it as
+    available and :meth:`evict_one` hands it out, which is what lets
+    suffix-priced admission admit where full-span pricing would wait
+    forever."""
+
+    def __init__(self, layout_key: str, block_size: int,
+                 block_bytes: int):
+        from collections import OrderedDict
+        self._layout = layout_key.encode("utf-8")
+        self._bs = int(block_size)
+        self.block_bytes = int(block_bytes)
+        self._entries: "OrderedDict[bytes, list]" = OrderedDict()
+        self._by_block: Dict[int, bytes] = {}
+        self.hits = 0
+        self.misses = 0
+        self.bytes_saved = 0
+        self.evictions = 0
+
+    def _key(self, prompt: np.ndarray, j: int) -> bytes:
+        import hashlib
+        data = self._layout + \
+            np.ascontiguousarray(prompt[:(j + 1) * self._bs],
+                                 dtype=np.int64).tobytes()
+        return hashlib.sha256(data).digest()
+
+    def shareable_blocks(self, prompt_len: int) -> int:
+        """FULL blocks of the prompt a hit may cover — the last prompt
+        token is always recomputed (prefill must emit the first
+        generated token), so the shareable span stops one token short."""
+        return (int(prompt_len) - 1) // self._bs
+
+    def probe(self, prompt: np.ndarray, prompt_len: int) -> List[int]:
+        """Consecutive hit blocks from block 0, each ACQUIRED (one ref
+        held by the caller until release/retire)."""
+        out: List[int] = []
+        for j in range(self.shareable_blocks(prompt_len)):
+            key = self._key(prompt, j)
+            ent = self._entries.get(key)
+            if ent is None:
+                break
+            ent[1] += 1
+            self._entries.move_to_end(key)
+            out.append(ent[0])
+        return out
+
+    def promote(self, prompt: np.ndarray, j: int, block_id: int) -> bool:
+        """Index one freshly-prefilled full block (the promoting
+        sequence holds the initial reference).  A racing identical
+        prompt already holds the key — its twin's block stays private."""
+        key = self._key(prompt, j)
+        if key in self._entries:
+            return False
+        self._entries[key] = [int(block_id), 1]
+        self._by_block[int(block_id)] = key
+        return True
+
+    def contains_block(self, block_id: int) -> bool:
+        return int(block_id) in self._by_block
+
+    def release_block(self, block_id: int):
+        self._entries[self._by_block[int(block_id)]][1] -= 1
+
+    def release(self, block_ids: Sequence[int]):
+        for bid in block_ids:
+            self.release_block(bid)
+
+    def evictable(self) -> int:
+        return sum(1 for ent in self._entries.values() if ent[1] == 0)
+
+    def evict_one(self) -> Optional[int]:
+        """Pop the least-recently-used refcount-0 entry and hand its
+        block back; an entry anybody still references is untouchable."""
+        victim = None
+        for key, ent in self._entries.items():
+            if ent[1] == 0:
+                victim = key
+                break
+        if victim is None:
+            return None
+        bid = self._entries.pop(victim)[0]
+        del self._by_block[bid]
+        self.evictions += 1
+        return bid
+
+    def __len__(self):
+        return len(self._entries)
 
 
 class DecodeEngine:
@@ -244,8 +415,12 @@ class DecodeEngine:
         self.pool_blocks = int(pool_blocks)
 
         # -- programs + state ------------------------------------------
-        self._programs = model.build(self.pool_blocks, cfg.block_size,
-                                     self._mbps, cfg.pack_max_segments)
+        need_chunk = cfg.prefix_cache or cfg.chunk_tokens
+        self._programs = model.build(
+            self.pool_blocks, cfg.block_size, self._mbps,
+            cfg.pack_max_segments, chain_lengths=cfg.chain_lengths,
+            with_sampling=cfg.sampling,
+            chunk_tokens=cfg.chunk_width if need_chunk else None)
         if place is None:
             import jax
             place = CPUPlace() if jax.default_backend() == "cpu" \
@@ -260,13 +435,23 @@ class DecodeEngine:
                 tuple(v.shape), dtype=np.dtype(v.dtype)))
         if flag("verify_programs"):
             from ..framework.analysis import verify_decode
-            for prog, feeds in ((self._programs.prefill,
-                                 self._programs.prefill_feeds),
-                                (self._programs.decode,
-                                 self._programs.decode_feeds)):
+            to_verify = [(self._programs.prefill,
+                          self._programs.prefill_feeds,
+                          self._programs.fetch_names),
+                         (self._programs.decode,
+                          self._programs.decode_feeds,
+                          self._programs.fetch_names)]
+            for prog in self._programs.chains.values():
+                to_verify.append((prog, self._programs.chain_feeds,
+                                  self._programs.chain_fetch_names))
+            if self._programs.chunk is not None:
+                to_verify.append((self._programs.chunk,
+                                  self._programs.chunk_feeds,
+                                  self._programs.fetch_names))
+            for prog, feeds, fetches_v in to_verify:
                 verify_decode(
                     prog, feed_names=feeds,
-                    fetch_names=self._programs.fetch_names,
+                    fetch_names=fetches_v,
                     scope_names=self._scope.var_names(),
                     cache_vars=self._programs.cache_vars
                 ).raise_on_error()
@@ -285,17 +470,42 @@ class DecodeEngine:
             self._programs.prefill,
             feed_names=self._programs.prefill_feeds,
             fetch_list=fetches, scope=self._scope, donate_state=True)
-        self._decode = self._exe.prepare(
-            self._programs.decode,
-            feed_names=self._programs.decode_feeds,
-            fetch_list=fetches, scope=self._scope, donate_state=True)
+        # all decode stepping runs through the chained executables (a
+        # chain of length 1 IS the single step); progs.decode stays for
+        # the pool-sizing probe and verification only
+        self._chains = {
+            length: self._exe.prepare(
+                prog, feed_names=self._programs.chain_feeds,
+                fetch_list=list(self._programs.chain_fetch_names),
+                scope=self._scope, donate_state=True)
+            for length, prog in self._programs.chains.items()}
+        self._chain_lengths = tuple(sorted(self._chains))
+        self._chunk = None
+        if self._programs.chunk is not None:
+            self._chunk = self._exe.prepare(
+                self._programs.chunk,
+                feed_names=self._programs.chunk_feeds,
+                fetch_list=fetches, scope=self._scope,
+                donate_state=True)
         self._score = None              # reference path, built lazily
         self._owner = None              # which prepared step holds state
+
+        # -- cross-request prefix cache --------------------------------
+        self._prefix_index: Optional[_PrefixIndex] = None
+        if cfg.prefix_cache:
+            layout = getattr(model, "cache_layout_key", None)
+            layout_key = layout(cfg.block_size) if layout is not None \
+                else f"{getattr(model, 'name', 'model')}" \
+                     f"/bs={cfg.block_size}"
+            self._prefix_index = _PrefixIndex(
+                layout_key, cfg.block_size,
+                model.cache_block_bytes(cfg.block_size))
 
         # -- scheduling state ------------------------------------------
         self._free: List[int] = list(range(self.pool_blocks - 1, -1, -1))
         self._pending: List[_Seq] = []
         self._active: List[_Seq] = []
+        self._chunking: List[_Seq] = []
         self._cond = threading.Condition()
         self._run_lock = threading.Lock()   # device rounds vs warmup
         self._ref_lock = threading.Lock()
@@ -317,6 +527,13 @@ class DecodeEngine:
         self._block_reuses = 0          # a freed block handed out again
         self._retired_blocks: set = set()
         self._admission_waits = 0
+        self._host_syncs = 0            # one per device->host token fetch
+        self._chains_run = 0
+        self._chain_tokens = 0
+        self._chain_hist: Dict[int, int] = {}
+        self._chunk_steps = 0
+        self._interleaved_rounds = 0    # rounds mixing chunks + chains
+        self._prefill_tokens = 0        # prompt tokens actually computed
         self._t_first = None
         self._t_last = None
         _watchdog.ensure_started()
@@ -340,12 +557,14 @@ class DecodeEngine:
             fetch_names=probe.fetch_names,
             cache_vars=probe.cache_vars,
             block_bytes=self.model.cache_block_bytes(cfg.block_size),
-            budget_gb=budget_gb, min_blocks=self._mbps)
+            budget_gb=budget_gb, min_blocks=self._mbps,
+            reserve_blocks=cfg.prefix_reserve_blocks)
         self.pool_plan = {
             "blocks": plan["blocks"],
             "block_bytes": plan["block_bytes"],
             "fixed_bytes": plan["fixed_bytes"],
             "budget_bytes": plan["budget_bytes"],
+            "reserve_blocks": plan.get("reserve_blocks", 0),
         }
         return plan["blocks"]
 
@@ -365,7 +584,7 @@ class DecodeEngine:
         deadline = time.monotonic() + timeout
         with self._cond:
             self._cond.notify_all()
-            while self._pending or self._active:
+            while self._pending or self._active or self._chunking:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
@@ -420,15 +639,30 @@ class DecodeEngine:
 
     def generate(self, feed, max_new_tokens: Optional[int] = None,
                  eos_token_id: Optional[int] = None,
-                 on_token=None) -> Future:
+                 on_token=None, temperature: Optional[float] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 seed: Optional[int] = None) -> Future:
         """Submit one prompt; returns a Future of
         :class:`GenerationResult`.  ``on_token(token_id)`` (optional)
         streams tokens from the worker thread as they decode.
+
+        ``temperature``/``top_k``/``top_p``/``seed`` select the
+        on-device sampling policy (requires
+        ``DecodeConfig(sampling=True)``); default/``temperature<=0``
+        rows stay greedy and keep the bit-parity contract.  A fixed
+        seed draws the same tokens no matter how the request is
+        co-batched or chain-scheduled.
 
         Admission prices :func:`blocks_needed` HERE — a request that can
         never fit the pool (or the model's length budget) is rejected
         immediately, before any compile or queue time."""
         cfg = self.config
+        if not cfg.sampling and any(
+                v is not None for v in (temperature, top_k, top_p, seed)):
+            raise InvalidArgumentError(
+                "sampling parameters need DecodeConfig(sampling=True) — "
+                "this engine's chain executables were built greedy-only")
         prompt = self._normalize_prompt(feed)
         plen = int(prompt.size)
         max_new = cfg.max_new_tokens if max_new_tokens is None \
@@ -442,12 +676,14 @@ class DecodeEngine:
             raise InvalidArgumentError(
                 f"prompt ({plen} tokens) + max_new_tokens ({max_new}) "
                 f"exceeds max_seq_len={cfg.max_seq_len}")
-        if plen > cfg.prefill_seq_buckets[-1]:
+        if plen > cfg.prefill_seq_buckets[-1] and not cfg.chunk_tokens:
             with self._stats_lock:
                 self._rejected += 1
             raise InvalidArgumentError(
                 f"prompt length {plen} exceeds the largest prefill "
-                f"bucket {cfg.prefill_seq_buckets[-1]}")
+                f"bucket {cfg.prefill_seq_buckets[-1]} — set "
+                f"DecodeConfig(chunk_tokens=...) to prefill long "
+                f"prompts in chunks")
         need = blocks_needed(plen, max_new, cfg.block_size)
         if need > self.pool_blocks:
             with self._stats_lock:
@@ -458,7 +694,9 @@ class DecodeEngine:
                 f"block_size={cfg.block_size}) but the pool holds "
                 f"{self.pool_blocks} — 0 compiles spent; shrink the "
                 f"request or grow the pool")
-        seq = _Seq(prompt, max_new, eos, on_token)
+        seq = _Seq(prompt, max_new, eos, on_token,
+                   temperature=temperature or 0.0, top_k=top_k or 0,
+                   top_p=top_p or 0.0, seed=seed or 0)
         with self._cond:
             if self._unhealthy is not None:
                 raise UnavailableError(
@@ -485,9 +723,10 @@ class DecodeEngine:
         while True:
             with self._cond:
                 while not self._stop and not self._pending \
-                        and not self._active:
+                        and not self._active and not self._chunking:
                     self._cond.wait()
-                if self._stop and not self._pending and not self._active:
+                if self._stop and not self._pending \
+                        and not self._active and not self._chunking:
                     return
             if _FL_ARMED:
                 # drill seam: an uncaught decode-worker exception,
@@ -498,8 +737,14 @@ class DecodeEngine:
                 if admitted:
                     self._run_prefill(admitted)
                     self._retire()
+                if self._chunking:
+                    if self._active:
+                        with self._stats_lock:
+                            self._interleaved_rounds += 1
+                    self._chunk_round()
+                    self._retire()
                 if self._active:
-                    self._decode_step()
+                    self._chain_step()
                     self._retire()
             self._update_gauges()
 
@@ -508,17 +753,19 @@ class DecodeEngine:
         cache block frees, the engine goes unhealthy."""
         _flight.dump("decode_worker_fatal", exc=exc,
                      extra={"pending": len(self._pending),
-                            "active": len(self._active)})
+                            "active": len(self._active),
+                            "chunking": len(self._chunking)})
         failed = 0
         with self._cond:
             self._unhealthy = exc
             self._accepting = False
             self._stop = True
-            victims = list(self._active) + list(self._pending)
-            for seq in self._active:
-                self._free.extend(reversed(seq.block_ids))
-                seq.block_ids = []
+            victims = list(self._active) + list(self._chunking) \
+                + list(self._pending)
+            for seq in self._active + self._chunking:
+                self._release_blocks(seq)
             self._active = []
+            self._chunking = []
             self._pending = []
             for seq in victims:
                 if not seq.future.done():
@@ -532,54 +779,117 @@ class DecodeEngine:
         self._update_gauges()
 
     # -- scheduling -------------------------------------------------------
+    def _availability(self) -> int:
+        """Blocks admission may hand out NOW: the free list plus every
+        refcount-0 indexed block (evictable = effectively free)."""
+        n = len(self._free)
+        if self._prefix_index is not None:
+            n += self._prefix_index.evictable()
+        return n
+
+    def _take_blocks(self, n: int) -> List[int]:
+        """Allocate ``n`` blocks: free list first, then LRU eviction of
+        refcount-0 index entries (availability was checked by the
+        caller, so eviction cannot come up short)."""
+        out: List[int] = []
+        for _ in range(n):
+            if self._free:
+                bid = self._free.pop()
+            else:
+                bid = self._prefix_index.evict_one()
+                if bid is None:
+                    raise UnavailableError(
+                        "cache pool accounting violated: admission "
+                        "priced blocks that are not available")
+            if bid in self._retired_blocks:
+                with self._stats_lock:
+                    self._block_reuses += 1
+            out.append(bid)
+        return out
+
+    def _release_blocks(self, seq: _Seq):
+        """Return a sequence's blocks: indexed blocks drop one reference
+        (staying cached, evictable once nobody references them), the
+        rest go back to the free list."""
+        idx = self._prefix_index
+        for bid in reversed(seq.block_ids):
+            if idx is not None and idx.contains_block(bid):
+                idx.release_block(bid)
+            else:
+                self._free.append(bid)
+        seq.block_ids = []
+
     def _admit(self) -> List[_Seq]:
         """Pull pending prefills that fit THIS round: decode-slot
         capacity, prefill row/segment capacity, and — the paged-cache
-        admission — enough free blocks for the sequence's whole
-        reserved span.  Continue-scan (head-of-line fix): a large
-        request waiting on blocks does not starve smaller later ones."""
+        admission — enough blocks for the sequence's NON-SHARED span
+        (prefix-cache hits ride existing blocks by reference and charge
+        nothing; full-span pricing would keep a hit-heavy request
+        waiting on blocks it never needs).  Continue-scan (head-of-line
+        fix): a large request waiting on blocks does not starve smaller
+        later ones.  Requests with a prefix hit or an over-bucket
+        prompt go to the chunked-prefill queue; the rest return for the
+        packed prefill batch."""
         cfg = self.config
+        idx = self._prefix_index
         admitted: List[_Seq] = []
         row_lens: List[int] = []
         bucket_s = None
-        free = len(self._free)
-        slots_left = cfg.max_batch_size - len(self._active)
+        taken = 0
         with self._cond:
+            slots_left = (cfg.max_batch_size - len(self._active)
+                          - len(self._chunking))
             for seq in list(self._pending):
-                if slots_left <= len(admitted):
+                if taken >= slots_left:
                     break
                 plen = int(seq.prompt.size)
-                need = blocks_needed(plen, seq.max_new, cfg.block_size)
-                if need > free:
+                need_total = blocks_needed(plen, seq.max_new,
+                                           cfg.block_size)
+                # probe acquires refs on the hit blocks so a concurrent
+                # eviction (for an earlier admit this round) can't free
+                # them out from under the pricing below
+                hits = idx.probe(seq.prompt, plen) \
+                    if idx is not None else []
+                need = need_total - len(hits)
+                if need > self._availability():
+                    if hits:
+                        idx.release(hits)
                     seq.waited_rounds += 1
                     with self._stats_lock:
                         self._admission_waits += 1
                     continue
-                need_s = bucket_s
-                if need_s is None or plen > need_s:
-                    need_s = next(s for s in cfg.prefill_seq_buckets
-                                  if s >= plen)
-                trial = row_lens + [plen]
-                if _plan_bins(trial, need_s, cfg.pack_max_segments,
-                              cfg.prefill_batch_buckets[-1]) is None:
-                    continue
+                chunked = bool(hits) or \
+                    plen > cfg.prefill_seq_buckets[-1]
+                if not chunked:
+                    need_s = bucket_s
+                    if need_s is None or plen > need_s:
+                        need_s = next(s for s in cfg.prefill_seq_buckets
+                                      if s >= plen)
+                    trial = row_lens + [plen]
+                    if _plan_bins(trial, need_s, cfg.pack_max_segments,
+                                  cfg.prefill_batch_buckets[-1]) is None:
+                        continue
+                    row_lens = trial
+                    bucket_s = need_s
                 self._pending.remove(seq)
-                admitted.append(seq)
-                row_lens = trial
-                bucket_s = need_s
-                free -= need
-        for seq in admitted:
-            # reserve the FULL span now — block ids are pool slots;
-            # handing a previously-used block to a new sequence is the
-            # reuse case the parity contract covers
-            need = blocks_needed(int(seq.prompt.size), seq.max_new,
-                                 cfg.block_size)
-            for _ in range(need):
-                bid = self._free.pop()
-                if bid in self._retired_blocks:
-                    with self._stats_lock:
-                        self._block_reuses += 1
-                seq.block_ids.append(bid)
+                # hit blocks by reference + the suffix span allocated
+                # fresh; handing a previously-used block to a new
+                # sequence is the reuse case the parity contract covers
+                seq.block_ids = list(hits) + self._take_blocks(need)
+                seq.hit_blocks = len(hits)
+                seq._chunk_off = len(hits) * cfg.block_size
+                taken += 1
+                if idx is not None:
+                    probed = idx.shareable_blocks(plen)
+                    idx.hits += len(hits)
+                    idx.misses += probed - len(hits)
+                    idx.bytes_saved += len(hits) * idx.block_bytes
+                with self._stats_lock:
+                    self._prefill_tokens += plen - seq._chunk_off
+                if chunked:
+                    self._chunking.append(seq)
+                else:
+                    admitted.append(seq)
         return admitted
 
     def _slot(self, seq: _Seq, p: int) -> int:
@@ -649,10 +959,80 @@ class DecodeEngine:
             tok = int(tokens[seq._gather_idx])
             seq.pos = int(seq.prompt.size)
             self._emit(seq, tok)
+            self._promote(seq)
         self._active.extend(admitted)
         with self._stats_lock:
             self._prefill_batches += 1
+            self._host_syncs += 1
             self._t_last = now
+
+    def _promote(self, seq: _Seq):
+        """Index every freshly-written FULL prompt block for
+        cross-request reuse.  Only blocks holding nothing but prompt
+        tokens qualify ((j+1)*bs <= prompt_len) — generation writes
+        start past them, so a promoted block's bytes never change."""
+        idx = self._prefix_index
+        if idx is None:
+            return
+        bs = self.config.block_size
+        plen = int(seq.prompt.size)
+        for j in range(seq.hit_blocks, plen // bs):
+            idx.promote(seq.prompt, j, seq.block_ids[j])
+
+    # -- chunked prefill --------------------------------------------------
+    def _chunk_round(self):
+        """One chunk per chunk-queued sequence per scheduling round —
+        long prompts make progress WITHOUT monopolising the device
+        between decode chains (the anti-head-of-line interleave)."""
+        for seq in list(self._chunking):
+            self._chunk_step(seq)
+
+    def _chunk_step(self, seq: _Seq):
+        cfg = self.config
+        width = cfg.chunk_width
+        plen = int(seq.prompt.size)
+        start = seq._chunk_off
+        end = min(plen, start + width)
+        n = end - start
+        final = end >= plen
+        src = np.zeros((1, width), np.int64)
+        src[0, :n] = seq.prompt[start:end]
+        pos = np.zeros((1, width), np.int64)
+        pos[0, :n] = np.arange(start, end)
+        slots = np.full((1, width), -1, np.int32)
+        slots[0, :n] = [self._slot(seq, p) for p in range(start, end)]
+        table = np.zeros((1, self._mbps), np.int32)
+        table[0, :len(seq.block_ids)] = seq.block_ids
+        ctx = np.array([end], np.int32)
+        last = np.full((1, 1), n - 1 if final else 0, np.int64)
+        feed = {"src_ids": src, "pos_ids": pos, "slot_ids": slots,
+                "block_table": table, "ctx_len": ctx, "last_pos": last}
+        sid = next_step_id()
+        _flight.note_step(sid, "decode_chunk", (start, end))
+        _watchdog.begin("decode")
+        try:
+            with step_scope(sid), \
+                    RecordEvent("decode::chunk", tokens=n,
+                                final=final):
+                self._acquire(self._chunk)
+                handles = self._chunk.run(feed)
+                # only the FINAL chunk's first generated token crosses
+                # to the host — intermediate chunks stay async
+                tok = int(handles[1].numpy()[0]) if final else None
+        finally:
+            _watchdog.end("decode")
+        seq._chunk_off = end
+        with self._stats_lock:
+            self._chunk_steps += 1
+            if final:
+                self._host_syncs += 1
+            self._t_last = time.monotonic()
+        if final:
+            seq.pos = plen
+            self._emit(seq, tok)
+            self._promote(seq)
+            self._chunking.remove(seq)
+            self._active.append(seq)
 
     # -- decode step ------------------------------------------------------
     def _decode_feed_arrays(self, bucket_b: int, live: List[_Seq],
@@ -672,30 +1052,112 @@ class DecodeEngine:
         return {"token_ids": tok, "pos_ids": pos, "slot_ids": slots,
                 "block_table": table, "ctx_len": ctx}
 
-    def _decode_step(self):
+    def _chain_feed_arrays(self, bucket_b: int, live: List[_Seq],
+                           pad_only: bool = False):
+        """Chain feeds = decode-step feeds + the per-row chain-control
+        vectors (remaining token budget, EOS id, sampling policy).
+        Slot/ctx-len entries are placeholders — the device scan
+        recomputes them per iteration from the block table."""
+        cfg = self.config
+        feed = self._decode_feed_arrays(bucket_b, live,
+                                        pad_only=pad_only)
+        left = np.zeros((bucket_b,), np.int32)
+        eos = np.full((bucket_b,), -1, np.int64)
+        if not pad_only:
+            for i, seq in enumerate(live):
+                left[i] = seq.max_new - len(seq.out_tokens)
+                if seq.eos is not None:
+                    eos[i] = int(seq.eos)
+        feed["steps_left"] = left
+        feed["eos_ids"] = eos
+        if cfg.sampling:
+            temp = np.zeros((bucket_b,), np.float32)
+            top_k = np.zeros((bucket_b,), np.int32)
+            top_p = np.zeros((bucket_b,), np.float32)
+            seeds = np.zeros((bucket_b,), np.int32)
+            if not pad_only:
+                for i, seq in enumerate(live):
+                    temp[i] = seq.temperature
+                    top_k[i] = seq.top_k
+                    top_p[i] = seq.top_p
+                    seeds[i] = seq.seed
+            feed.update({"temperature": temp, "top_k": top_k,
+                         "top_p": top_p, "seeds": seeds})
+        return feed
+
+    def _pick_chain(self) -> int:
+        """Chain-length scheduling: the SHORT chain when admittable
+        work is waiting (a pending request that fits blocks + slots, or
+        a prompt mid-chunk) so it isn't parked behind a long device
+        loop; otherwise the smallest chain covering the longest
+        remaining budget — no wasted scan iterations, no extra
+        syncs."""
+        cfg = self.config
+        lengths = self._chain_lengths
+        if len(lengths) == 1:
+            return lengths[0]
+        if self._chunking:
+            return lengths[0]
+        with self._cond:
+            slots_left = (cfg.max_batch_size - len(self._active)
+                          - len(self._chunking))
+            if slots_left > 0:
+                avail = self._availability()
+                for seq in self._pending:
+                    # full-span pricing here (ignores prefix hits) —
+                    # conservative: at worst we chain short once more
+                    need = blocks_needed(int(seq.prompt.size),
+                                         seq.max_new, cfg.block_size)
+                    if need <= avail:
+                        return lengths[0]
+        remaining = max(seq.max_new - len(seq.out_tokens)
+                        for seq in self._active)
+        for length in lengths:
+            if length >= remaining:
+                return length
+        return lengths[-1]
+
+    def _chain_step(self):
+        """Run ONE device chain over every live sequence: L decode
+        steps, one host sync.  -1 entries in the fetched [L, B] matrix
+        mark rows that finished mid-chain (the device froze them)."""
         cfg = self.config
         live = self._active
+        length = self._pick_chain()
         bucket_b = next(b for b in cfg.batch_buckets if b >= len(live))
-        feed = self._decode_feed_arrays(bucket_b, live)
+        feed = self._chain_feed_arrays(bucket_b, live)
         sid = next_step_id()
-        _flight.note_step(sid, "decode_step", (bucket_b, len(live)))
+        _flight.note_step(sid, "decode_chain",
+                          (length, bucket_b, len(live)))
         _watchdog.begin("decode")
         try:
             with step_scope(sid), \
-                    RecordEvent("decode::step", live=len(live),
-                                bucket=bucket_b):
-                self._acquire(self._decode)
-                handles = self._decode.run(feed)
-                tokens = handles[1].numpy()
+                    RecordEvent("decode::chain", live=len(live),
+                                bucket=bucket_b, chain=length):
+                prepared = self._chains[length]
+                self._acquire(prepared)
+                handles = prepared.run(feed)
+                tokens = handles[0].numpy()     # [length, bucket_b]
         finally:
             _watchdog.end("decode")
         now = time.monotonic()
-        for i, seq in enumerate(live):
-            seq.pos += 1
-            seq.steps += 1
-            self._emit(seq, int(tokens[i]))
+        emitted = 0
+        for s in range(length):
+            for i, seq in enumerate(live):
+                tok = int(tokens[s, i])
+                if tok < 0:
+                    continue
+                seq.pos += 1
+                seq.steps += 1
+                self._emit(seq, tok)
+                emitted += 1
         with self._stats_lock:
-            self._decode_steps += 1
+            self._decode_steps += length
+            self._chains_run += 1
+            self._host_syncs += 1
+            self._chain_tokens += emitted
+            self._chain_hist[length] = \
+                self._chain_hist.get(length, 0) + 1
             self._decode_batch_hist[len(live)] = \
                 self._decode_batch_hist.get(len(live), 0) + 1
             self._t_last = now
@@ -717,7 +1179,8 @@ class DecodeEngine:
 
     def _retire(self):
         with self._stats_lock:
-            in_use = sum(len(s.block_ids) for s in self._active)
+            in_use = sum(len(s.block_ids)
+                         for s in self._active + self._chunking)
             self._peak_blocks = max(self._peak_blocks, in_use)
         finished = [s for s in self._active if s.done]
         if not finished:
@@ -726,8 +1189,7 @@ class DecodeEngine:
             self._active = [s for s in self._active if not s.done]
             for seq in finished:
                 self._retired_blocks.update(seq.block_ids)
-                self._free.extend(reversed(seq.block_ids))
-                seq.block_ids = []
+                self._release_blocks(seq)
             self._cond.notify_all()
         for seq in finished:
             seq.future.set_result(GenerationResult(
@@ -736,11 +1198,27 @@ class DecodeEngine:
         with self._stats_lock:
             self._completed += len(finished)
 
+    def _blocks_in_use(self) -> int:
+        """Pool blocks some live sequence actually holds: refcount-0
+        index entries are cached CONTENT, not usage — they are
+        reclaimable on demand, so they count as free."""
+        evictable = self._prefix_index.evictable() \
+            if self._prefix_index is not None else 0
+        return self.pool_blocks - len(self._free) - evictable
+
     def _update_gauges(self):
         try:
-            in_use = self.pool_blocks - len(self._free)
-            _metrics.gauge("decode::cache_blocks_used").set(in_use)
-            _metrics.gauge("decode::active_seqs").set(len(self._active))
+            _metrics.gauge("decode::cache_blocks_used").set(
+                self._blocks_in_use())
+            _metrics.gauge("decode::active_seqs").set(
+                len(self._active) + len(self._chunking))
+            idx = self._prefix_index
+            if idx is not None:
+                _metrics.gauge("decode::prefix_cache_hits").set(idx.hits)
+                _metrics.gauge("decode::prefix_cache_misses").set(
+                    idx.misses)
+                _metrics.gauge("decode::prefix_cache_bytes_saved").set(
+                    idx.bytes_saved)
         except Exception:          # noqa: BLE001 — metrics best-effort
             pass
 
@@ -768,10 +1246,23 @@ class DecodeEngine:
                     self._acquire(self._prefill)
                     self._prefill.run(feed)
                     n += 1
-            for bb in cfg.batch_buckets:
-                self._acquire(self._decode)
-                self._decode.run(self._decode_feed_arrays(bb, [],
-                                                          pad_only=True))
+            for length in self._chain_lengths:
+                for bb in cfg.batch_buckets:
+                    self._acquire(self._chains[length])
+                    self._chains[length].run(self._chain_feed_arrays(
+                        bb, [], pad_only=True))
+                    n += 1
+            if self._chunk is not None:
+                width = cfg.chunk_width
+                self._acquire(self._chunk)
+                self._chunk.run({
+                    "src_ids": np.zeros((1, width), np.int64),
+                    "pos_ids": np.zeros((1, width), np.int64),
+                    "slot_ids": np.full((1, width), -1, np.int32),
+                    "block_table": np.zeros((1, self._mbps), np.int32),
+                    "ctx_len": np.zeros((1,), np.int32),
+                    "last_pos": np.zeros((1, 1), np.int64),
+                })
                 n += 1
             if self._owner is not None:
                 self._owner.wait()
@@ -840,7 +1331,11 @@ class DecodeEngine:
     # -- observability ----------------------------------------------------
     @property
     def compiled_executables(self) -> int:
-        n = len(self._prefill._steps) + len(self._decode._steps)
+        n = len(self._prefill._steps)
+        for prepared in self._chains.values():
+            n += len(prepared._steps)
+        if self._chunk is not None:
+            n += len(self._chunk._steps)
         if self._score is not None:
             n += len(self._score._steps)
         return n
@@ -867,12 +1362,26 @@ class DecodeEngine:
                 "peak_blocks_used": self._peak_blocks,
                 "peak_occupancy": self._peak_blocks /
                 max(1, self.pool_blocks),
+                "host_syncs": self._host_syncs,
+                "chains_run": self._chains_run,
+                "chain_tokens": self._chain_tokens,
+                "chain_hist": dict(self._chain_hist),
+                "chunk_steps": self._chunk_steps,
+                "interleaved_rounds": self._interleaved_rounds,
+                "prefill_tokens": self._prefill_tokens,
             }
-        out["cache_blocks_used"] = self.pool_blocks - len(self._free)
+        out["cache_blocks_used"] = self._blocks_in_use()
         out["compile_count"] = self.compiled_executables
+        idx = self._prefix_index
+        out["prefix_hits"] = idx.hits if idx is not None else 0
+        out["prefix_misses"] = idx.misses if idx is not None else 0
+        out["prefix_bytes_saved"] = idx.bytes_saved \
+            if idx is not None else 0
+        out["prefix_evictions"] = idx.evictions if idx is not None else 0
+        out["prefix_indexed_blocks"] = len(idx) if idx is not None else 0
         with self._cond:
             out["pending"] = len(self._pending)
-            out["active"] = len(self._active)
+            out["active"] = len(self._active) + len(self._chunking)
             out["unhealthy"] = self._unhealthy is not None
         return out
 

@@ -5,7 +5,9 @@ cache-block admission rejecting with 0 compiles (monkeypatch-asserted),
 the in-process AOT warm restart of the prefill+decode grid, the
 ``serving_decode`` chaos drill (all in-flight generations fail, blocks
 free, no drain() hang), the ``verify_decode`` static profile, and the
-DECODE_BENCH_r19 artifact contract."""
+DECODE_BENCH_r20 artifact contract.  The decode fast path v2 surface
+(device-chained decode, sampling, prefix cache, chunked prefill) is
+covered in tests/test_decode_v2.py."""
 
 import json
 import os
@@ -497,16 +499,27 @@ def test_decode_metrics_and_spans(engine):
 
 
 def test_decode_bench_artifact_contract():
-    """The committed DECODE_BENCH_r19.json passes the same assertions
+    """The committed DECODE_BENCH_r20.json passes the same assertions
     the bench applies when it writes: >= 3x tokens/s vs the per-request
     greedy loop, every benched sequence token-for-token equal to its
     unbatched greedy reference, warm restart 0 fresh compiles with the
     whole grid cache-hit, admission reject 0 compiles + parity under
-    pool churn."""
-    from tools.decode_bench import check
-    with open(os.path.join(REPO, "DECODE_BENCH_r19.json")) as f:
+    pool churn, device-chained decode >= 1.5x the single-step engine
+    with <= 1/chain_length host syncs per decoded token + seeded
+    sampling determinism + no regression vs the committed r19 numbers,
+    prefix-cache hits with suffix-only prefill, chunked prefill
+    interleaved with live decodes."""
+    from tools.decode_bench import ARTIFACT, check
+    assert ARTIFACT == "DECODE_BENCH_r20.json"
+    with open(os.path.join(REPO, ARTIFACT)) as f:
         art = json.load(f)
     check(art)
+    ch = art["chained"]
+    assert ch["speedup"] >= 1.5
+    assert ch["syncs_per_decode_token"] <= 1.0 / ch["chain_length"]
+    assert ch["regression"]["pass"] is True
+    assert art["prefix"]["prefix_hits"] > 0
+    assert art["chunked"]["interleaved_rounds"] >= 1
 
 
 def test_decode_bench_wired_into_preflight():

@@ -19,7 +19,9 @@ python tools/serve_bench.py --selftest
 echo "== preflight: decode bench (paged KV-cache engine: continuous"
 echo "   batching token parity vs the per-request greedy loop, AOT"
 echo "   warm-restart 0 fresh compiles, cache-block admission reject"
-echo "   with 0 compiles + parity under pool churn) =="
+echo "   with 0 compiles + parity under pool churn, device-chained"
+echo "   decode w/ seeded-sampling determinism, cross-request prefix"
+echo "   cache suffix-only prefill, chunked prefill interleave) =="
 python tools/decode_bench.py --selftest
 
 echo "== preflight: observability probe (telemetry JSONL schema, MFU in"
