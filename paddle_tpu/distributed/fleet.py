@@ -215,14 +215,23 @@ class DistributedStrategy:
             "report_path": None,       # write PLAN_SEARCH json here
             "fsdp_prefetch_distance": 0,   # gather k layers early
             # the pipeline/remat search dimensions (framework/pipe.py):
-            # max_pipe > 1 enumerates pipe stages (priced with the
-            # (pipe-1)/num_microbatches 1F1B bubble term);
-            # num_microbatches is the per-step 1F1B accumulation depth;
+            # max_pipe > 1 enumerates pipe stages — each pipe row is
+            # priced under ``pipe_schedule`` ("1f1b", "interleaved",
+            # "zero_bubble", or "auto" to take the family/chunking with
+            # the fewest simulated bubble ticks) using the schedule's
+            # exact per-tick bubble fraction, not the old analytic
+            # (pipe-1)/num_microbatches term;
+            # num_microbatches is the per-step accumulation depth;
+            # pipe_shard_weights=True additionally prices + stamps the
+            # pipe-axis ZeRO weight sharding rewrite (params/optimizer
+            # state 1/pipe-resident per rank);
             # remat=True prices a rematerialized sibling for every
             # budget-rejected config (recompute checkpoints at the
             # liveness peak, FLOPs delta in the roofline)
             "max_pipe": 1,
             "num_microbatches": 1,
+            "pipe_schedule": "1f1b",
+            "pipe_shard_weights": False,
             "remat": False,
         }
         # execution/build strategies accepted and largely absorbed by XLA
@@ -591,7 +600,9 @@ class CollectiveOptimizer:
             report_path=cfgs.get("report_path"),
             max_pipe=int(cfgs.get("max_pipe") or 1),
             num_microbatches=int(cfgs.get("num_microbatches") or 1),
-            remat=bool(cfgs.get("remat")))
+            remat=bool(cfgs.get("remat")),
+            pipe_schedule=str(cfgs.get("pipe_schedule") or "1f1b"),
+            pipe_shard_weights=bool(cfgs.get("pipe_shard_weights")))
         layout = stamp_winning_layout(
             program, plan, min_shard_numel=min_numel,
             prefetch_distance=int(cfgs.get("fsdp_prefetch_distance")

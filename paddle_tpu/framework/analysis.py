@@ -68,8 +68,10 @@ READ_AFTER_DONATE = "read-after-donate"
 SHARD_LAYOUT_UNKNOWN_AXIS = "shard-layout-unknown-axis"
 SHARD_LAYOUT_COLLECTIVE_MISMATCH = "shard-layout-collective-mismatch"
 # pipeline/remat soundness (the stage-cut + recompute rewrites —
-# framework/pipe.py, lowered by the executor's 1F1B scan)
+# framework/pipe.py, lowered by the executor's scheduled scan)
 PIPE_COLLECTIVE_CROSSES_STAGE = "pipe-collective-crosses-stage"
+PIPE_SCHEDULE_ORDER = "pipe-schedule-order"
+PIPE_RING_OVERFLOW = "pipe-ring-overflow"
 REMAT_RECOMPUTE_SIDE_EFFECT = "remat-recompute-side-effect"
 UNSPECCED_OP = "unspecced-op"
 PASS_INVARIANT = "pass-invariant"
@@ -875,6 +877,18 @@ def verify_pipeline(program: Program,
       rendezvous its mesh peers against mismatched schedules.  The
       stage-cut planner refuses such positions; a hand-stamped or
       pass-mutated program is caught here.
+    * ``pipe-schedule-order`` (error) — the stamped
+      ``pipe_schedule_order`` tick table violates pipeline dataflow: a
+      unit runs before the unit that produces its input (a forward
+      before its upstream forward, a backward before its own forward or
+      its downstream backward, a zero-bubble W before the B that
+      stashed its cotangent).  The executor's scan consumes these
+      static tables verbatim — a hand-mutated or stale table would read
+      a ring slot before anything arrived in it.
+    * ``pipe-ring-overflow`` (error) — the stamped ``pipe_ring_slots``
+      are smaller than the maximum in-flight saved-input / cotangent
+      count the stamped order actually reaches: slot ``mb % slots``
+      would be overwritten while a live microbatch still needs it.
     * ``remat-recompute-side-effect`` (warning) — a recompute segment
       (between ``backward.checkpoints`` boundaries) contains an
       RNG-drawing op with no ``_folded_key``/``fix_seed`` marker: the
@@ -919,6 +933,83 @@ def verify_pipeline(program: Program,
                         f"(move the cut, or keep the collective with "
                         f"its producers)",
                         op, block.idx, idx)
+
+        order = bw.attrs.get("pipe_schedule_order") or ()
+        if order:
+            V = int(bw.attrs.get("pipe_stages") or 1)
+            ftick: Dict[Any, int] = {}
+            btick: Dict[Any, int] = {}
+            wtick: Dict[Any, int] = {}
+            for t, k, ph, m in order:
+                {"F": ftick, "B": btick, "W": wtick}[ph][(k, m)] = t
+
+            def bad(msg):
+                result.add("error", PIPE_SCHEDULE_ORDER,
+                           f"pipe_schedule_order: {msg} — the "
+                           f"executor's scan replays this table "
+                           f"verbatim, so a dataflow-violating order "
+                           f"reads ring slots before their arrival "
+                           f"(restamp via pipe.apply_pipeline)",
+                           bw, block.idx, bw_idx)
+
+            for (k, m), t in ftick.items():
+                if k > 0 and ftick.get((k - 1, m), t) >= t:
+                    bad(f"F(stage {k}, mb {m}) at tick {t} does not "
+                        f"follow F(stage {k - 1}, mb {m})")
+            for (k, m), t in btick.items():
+                if ftick.get((k, m), t) >= t:
+                    bad(f"B(stage {k}, mb {m}) at tick {t} does not "
+                        f"follow its own forward")
+                if k < V - 1 and (k + 1, m) in btick \
+                        and btick[(k + 1, m)] >= t:
+                    bad(f"B(stage {k}, mb {m}) at tick {t} does not "
+                        f"follow B(stage {k + 1}, mb {m})")
+            for (k, m), t in wtick.items():
+                dep = btick.get((k, m)) if k > 0 else btick.get((1, m))
+                if dep is not None and dep >= t:
+                    bad(f"W(stage {k}, mb {m}) at tick {t} does not "
+                        f"follow the B that stashed its cotangent")
+
+            ring = bw.attrs.get("pipe_ring_slots")
+            if ring:
+                M = int(bw.attrs.get("pipe_microbatches") or 1)
+
+                def need(arrive):
+                    peak = 0
+                    for k in range(V):
+                        events = [iv for iv in
+                                  (arrive(k, m) for m in range(M))
+                                  if iv is not None]
+                        for a, r in events:
+                            live = sum(1 for a2, r2 in events
+                                       if a2 <= a <= r2)
+                            peak = max(peak, live)
+                    return peak
+
+                def f_iv(k, m):
+                    if k == 0 or (k - 1, m) not in ftick:
+                        return None
+                    rel = max(btick.get((k, m), 0), wtick.get((k, m), 0))
+                    return (ftick[(k - 1, m)] + 1, rel)
+
+                def c_iv(k, m):
+                    if k >= V - 1 or (k + 1, m) not in btick:
+                        return None
+                    rel = max(btick.get((k, m), 0), wtick.get((k, m), 0))
+                    return (btick[(k + 1, m)] + 1, rel)
+
+                w_f, w_c = int(ring[0]), int(ring[1])
+                need_f, need_c = need(f_iv), need(c_iv)
+                if need_f > w_f or need_c > w_c:
+                    result.add(
+                        "error", PIPE_RING_OVERFLOW,
+                        f"pipe_ring_slots {ring!r} smaller than the "
+                        f"stamped order's in-flight peak (saved-input "
+                        f"{need_f}, cotangent {need_c}): slot mb % "
+                        f"slots would be overwritten while a live "
+                        f"microbatch still reads it — restamp via "
+                        f"pipe.apply_pipeline",
+                        bw, block.idx, bw_idx)
 
     checkpoints = set(bw.attrs.get("checkpoints") or ())
     if checkpoints:
@@ -1421,7 +1512,8 @@ __all__ = [
     "QUANT_COLLECTIVE_INTEGER", "QUANT_NON_SUM", "QUANT_SMALL_BUCKET",
     "OVERLAP_SINGLE_BUCKET", "OVERLAP_TAIL_SUNK",
     "SHARD_LAYOUT_UNKNOWN_AXIS", "SHARD_LAYOUT_COLLECTIVE_MISMATCH",
-    "PIPE_COLLECTIVE_CROSSES_STAGE", "REMAT_RECOMPUTE_SIDE_EFFECT",
+    "PIPE_COLLECTIVE_CROSSES_STAGE", "PIPE_SCHEDULE_ORDER",
+    "PIPE_RING_OVERFLOW", "REMAT_RECOMPUTE_SIDE_EFFECT",
     "verify_program", "verify_inference", "verify_decode",
     "verify_cached", "verify_pipeline",
     "DECODE_STATE_WRITE", "DECODE_CACHE_UNDECLARED",

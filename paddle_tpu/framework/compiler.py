@@ -326,8 +326,18 @@ def insert_pipe_grad_sync(program: Program, pipe_axis: str = "pp"):
     bw.attrs["_pipe_allreduce_inserted"] = True
     groups = {}
     order = []
+    from .mesh_layout import _flat_axes
     for pname in bw.attrs["param_names"]:
         pvar = block._find_var_recursive(pname)
+        # pipe-sharded params (apply_pipe_weight_sharding) get their
+        # grads reduce-scattered over pp by the scheduled lowering
+        # itself — the scatter IS the cross-stage sum, so an extra
+        # all-reduce here would double-count
+        gvar = block._find_var_recursive(grad_var_name(pname))
+        gda = getattr(gvar, "dist_attr", None) if gvar is not None \
+            else None
+        if gda and pipe_axis in _flat_axes(tuple(gda)):
+            continue
         dtype = str(getattr(pvar, "dtype", "float32") or "float32")
         if dtype not in groups:
             groups[dtype] = []

@@ -480,36 +480,90 @@ def _lower_microbatched(ops, env, ctx, bw_idx, fetch_names,
     return env2
 
 
-def _lower_pipelined_1f1b(ops, env, ctx, bw_idx, fetch_names,
-                          state_out_names):
-    """1F1B pipeline lowering over the ``pp`` mesh axis.
+# primitives that move/alias bytes but execute no arithmetic — the
+# complete set a true no-op schedule branch may lower to (the idle-tick
+# census asserts the idle branch jaxpr stays inside this set)
+_ZERO_FLOP_PRIMS = frozenset({
+    "broadcast_in_dim", "reshape", "convert_element_type", "transpose",
+    "squeeze", "slice", "concatenate", "copy", "stop_gradient", "pjit",
+})
 
-    The program's forward was partitioned by framework/pipe.py into S
-    stage segments separated by ``pipe_stage_boundary`` markers.  Every
-    pipe rank runs ONE ``lax.switch`` branch per scheduled tick — its
-    own stage — following the static 1F1B tables
-    (``pipe.schedule_1f1b``): warm-up forwards capped at ``S − s``
-    in-flight microbatches, then strict one-forward-one-backward
-    alternation.  Boundary activations hop stage→stage+1 and cotangents
-    hop stage→stage−1 with one ``lax.ppermute`` each per tick.
+# census of the most recent scheduled pipeline lowering (family, tick
+# tables, idle accounting, weight-sharding summary) — read by
+# tools/pipe_probe.py and the telemetry recorder
+_LAST_PIPE_REPORT: Dict[str, Any] = {}
 
-    A backward tick RECOMPUTES its stage's forward from the saved stage
-    input (``jax.vjp`` at the tick — activation recompute is built into
-    the schedule), so per-device in-flight state is the saved boundary
-    ring (≤ S microbatch inputs) + one stage's residuals during its
-    backward — the 1F1B memory contract the static estimator prices.
-    Parameter cotangents accumulate into per-rank buffers (each rank
-    only produces its own stage's — the rest stay zero); the pipe-axis
-    fused all-reduce framework/pipe.py inserted after the backward op
-    reconstructs the full gradient, and the ordinary data-axis grad
-    sync / ZeRO-1 / quantized tiers ride the tail untouched."""
+
+def last_pipeline_report() -> Dict[str, Any]:
+    """The census of the most recent scheduled pipeline lowering."""
+    return dict(_LAST_PIPE_REPORT)
+
+
+def _jaxpr_prims(fn, *abstract_args):
+    """Flat primitive inventory of ``fn``'s jaxpr (sub-jaxprs included);
+    None if tracing fails."""
+    out = []
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            out.append(eqn.primitive.name)
+            for p in eqn.params.values():
+                inner = getattr(p, "jaxpr", None)
+                if inner is not None:
+                    walk(inner)
+                elif hasattr(p, "eqns"):
+                    walk(p)
+    try:
+        walk(jax.make_jaxpr(fn)(*abstract_args).jaxpr)
+    except Exception:
+        return None
+    return out
+
+
+def _lower_pipelined_schedule(ops, env, ctx, bw_idx, fetch_names,
+                              state_out_names):
+    """Scheduled pipeline lowering over the ``pp`` mesh axis — one
+    ``lax.scan`` over the static per-tick tables of the stamped schedule
+    family (``pipe.simulate_schedule``): non-interleaved 1F1B,
+    interleaved (virtual-stage) 1F1B, or the zero-bubble B/W split.
+
+    The program's forward was partitioned by framework/pipe.py into
+    ``V = S·chunks`` virtual-stage segments separated by
+    ``pipe_stage_boundary`` markers; virtual stage ``k`` lives on rank
+    ``k % S`` as chunk ``k // S``.  Every tick, each rank runs an outer
+    per-rank ``lax.switch`` branch that (a) performs the masked
+    saved-input / cotangent ring stores for whatever arrived on the
+    wire this tick (pure data movement — byte copies, no FLOPs), then
+    (b) inner-switches on the tick's unit kind: a TRUE no-op branch for
+    idle ticks (XLA conditionals execute only the selected branch, so
+    idle-tick stage compute is exactly zero — the masked idle half-tick
+    PR 13 carried is gone), F (stage forward), B (backward), or — zero
+    bubble — B (activation grad only, the cotangent hop) and W (weight
+    grad only, deferred into bubbles).  Boundary activations hop
+    rank→rank+1 and cotangents rank→rank−1 with one wrapping
+    ``lax.ppermute`` each per tick (the wrap link carries the
+    chunk-transition hop for interleaved and zeros otherwise).
+
+    A backward-kind tick RECOMPUTES its stage's forward from the saved
+    stage input (``jax.vjp`` at the tick), so per-device in-flight
+    state is the saved-input ring + the cotangent stash ring (sizes
+    from the schedule simulation) + one stage's residuals.  Parameter
+    cotangents accumulate into per-rank buffers; replicated params get
+    the pipe-axis fused all-reduce in the tail, while pipe-SHARDED
+    params (``apply_pipe_weight_sharding``) are all-gathered once
+    before the scan and their grads reduce-scattered once after it —
+    the scatter performing the cross-stage sum."""
     bw_op = ops[bw_idx]
     attrs = bw_op.attrs
-    S = int(attrs["pipe_stages"])
+    V = int(attrs["pipe_stages"])
+    chunks = int(attrs.get("pipe_chunks") or 1)
+    family = attrs.get("pipe_schedule") or "1f1b"
+    S = V // max(chunks, 1)
     M = int(attrs["pipe_microbatches"])
     axis = attrs.get("pipe_axis", "pp")
     boundaries = [list(b) for b in attrs["pipe_boundaries"]]
     param_names = list(attrs["param_names"])
+    sharded_params = dict(attrs.get("pipe_sharded_params") or {})
     loss_name = attrs["loss_name"]
     loss_scale = attrs.get("loss_scale", 1.0)
     feed_names = [n for n in attrs.get("pipe_feed_names", ()) if n in env]
@@ -519,10 +573,11 @@ def _lower_pipelined_1f1b(ops, env, ctx, bw_idx, fetch_names,
     n_pp = axis_size(axis)
     if n_pp != S:
         raise ValueError(
-            f"pipelined program has {S} stages but the {axis!r} mesh "
-            f"axis has size {n_pp}")
+            f"pipelined program has {S} ranks ({V} virtual stages x "
+            f"{chunks} chunks) but the {axis!r} mesh axis has size "
+            f"{n_pp}")
 
-    segments = [[] for _ in range(S)]
+    segments = [[] for _ in range(V)]
     for op in ops[:bw_idx]:
         if op.type == "pipe_stage_boundary":
             continue
@@ -541,18 +596,28 @@ def _lower_pipelined_1f1b(ops, env, ctx, bw_idx, fetch_names,
     mb0 = {n: v[0] for n, v in mb_feeds.items()}
     base_key = ctx.key
 
-    def stage_fn(s, p, f, bnd_in, key):
-        """One stage's segment on one microbatch: (boundary out, loss
-        seed, loss var) — loss only materialises on the last stage."""
+    # pipe-sharded weights: gather the 1/S shards ONCE before the tick
+    # scan — every stage body sees full values; the matching
+    # psum_scatter after the scan returns shard grads already summed
+    # across stages
+    full_pvals = dict(pvals)
+    for n, dim in sharded_params.items():
+        full_pvals[n] = jax.lax.all_gather(
+            pvals[n], axis, axis=int(dim), tiled=True)
+
+    def stage_fn(k, p, f, bnd_in, key):
+        """One virtual stage's segment on one microbatch: (boundary
+        out, loss seed, loss var) — loss only materialises on the last
+        virtual stage."""
         e = dict(base_env)
         e.update(p)
         e.update(f)
-        for n in (boundaries[s - 1] if s > 0 else ()):
+        for n in (boundaries[k - 1] if k > 0 else ()):
             e[n] = bnd_in[n]
         sub = LoweringContext(key, ctx.mesh, ctx.axis_names, ctx.is_test)
-        e = run_ops(segments[s], e, sub)
-        out = {n: e[n] for n in (boundaries[s] if s < S - 1 else ())}
-        if s == S - 1:
+        e = run_ops(segments[k], e, sub)
+        out = {n: e[n] for n in (boundaries[k] if k < V - 1 else ())}
+        if k == V - 1:
             lvar = e[loss_name]
             total = jnp.sum(lvar) * loss_scale
         else:
@@ -570,134 +635,262 @@ def _lower_pipelined_1f1b(ops, env, ctx, bw_idx, fetch_names,
             e = run_ops(seg, e, sub)
         return {n: e[n] for n in b_union}, e[loss_name]
 
-    bshapes, lshape = jax.eval_shape(probe, pvals, mb0, base_key)
+    bshapes, lshape = jax.eval_shape(probe, full_pvals, mb0, base_key)
 
     def zeros_of(sd):
         return jnp.zeros(sd.shape, sd.dtype)
 
-    from .pipe import schedule_1f1b
-    sch = schedule_1f1b(S, M)
-    W = int(sch["slots"])
-    fwd_tbl = jnp.asarray(np.array(sch["fwd"], dtype=np.int32))
-    bwd_tbl = jnp.asarray(np.array(sch["bwd"], dtype=np.int32))
-    arr_tbl = jnp.asarray(np.array(sch["arrive"], dtype=np.int32))
+    from .pipe import KIND_B, KIND_F, simulate_schedule
+    sch = simulate_schedule(family, S, M, chunks=chunks)
+    W_f = int(sch["slots"])
+    W_c = int(sch["ct_slots"])
+    T = int(sch["ticks"])
+    has_w = family == "zero_bubble"
+    # inner branch index per (tick, rank): 0 = idle, else
+    # 1 + chunk·KPC + {F: 0, B: 1, W: 2}
+    KPC = 3 if has_w else 2
+    code_rows = [[0] * S for _ in range(T)]
+    for t in range(T):
+        for r in range(S):
+            kind = sch["kind"][t][r]
+            if kind:
+                c = sch["vstage"][t][r] // S
+                code_rows[t][r] = 1 + c * KPC + (
+                    0 if kind == KIND_F else (1 if kind == KIND_B else 2))
+    code_tbl = jnp.asarray(np.array(code_rows, dtype=np.int32))
+    mb_tbl = jnp.asarray(np.array(sch["mb"], dtype=np.int32))
+    fac_tbl = jnp.asarray(np.array(sch["arr_c"], dtype=np.int32))
+    fam_tbl = jnp.asarray(np.array(sch["arr_mb"], dtype=np.int32))
+    cac_tbl = jnp.asarray(np.array(sch["ct_arr_c"], dtype=np.int32))
+    cam_tbl = jnp.asarray(np.array(sch["ct_arr_mb"], dtype=np.int32))
 
-    def mb_key(i, s):
-        # deterministic per (microbatch, stage): the backward tick's
-        # recompute replays the forward tick's randomness exactly
-        return jax.random.fold_in(jax.random.fold_in(base_key, i), s)
+    def mb_key(i, k):
+        # deterministic per (microbatch, virtual stage): a backward
+        # tick's recompute replays the forward tick's randomness
+        return jax.random.fold_in(jax.random.fold_in(base_key, i), k)
 
-    def make_branch(s):
-        seg_in = boundaries[s - 1] if s > 0 else []
-        seg_out = boundaries[s] if s < S - 1 else []
-        last = s == S - 1
+    def zero_sends():
+        return ({n: zeros_of(bshapes[n]) for n in b_union},
+                {n: zeros_of(bshapes[n]) for n in b_union})
 
-        def branch(carry, frow, brow, arow):
-            saved, bnd_in, ct_in, acc, lvar_sum = carry
-            # 1) store the arriving stage input into the saved ring
-            if s > 0:
-                ai = arow[s]
-                slot = jnp.clip(ai, 0, M - 1) % W
-                store = ai >= 0
-                saved = {
-                    n: jnp.where(
-                        store,
-                        jax.lax.dynamic_update_index_in_dim(
-                            saved[n], bnd_in[n], slot, 0),
-                        saved[n])
-                    for n in b_union}
-            # 2) backward unit (priority slot of the 1F1B alternation):
-            #    recompute this stage's forward from the saved input,
-            #    pull the downstream cotangent through it
-            j = brow[s]
-            jj = jnp.clip(j, 0, M - 1)
+    def make_noop():
+        def noop(saved_f, saved_ct, acc, lvar_sum, mb):
+            bnd_send, ct_send = zero_sends()
+            return acc, lvar_sum, bnd_send, ct_send
+        return noop
+
+    def make_f(r, c):
+        k = c * S + r
+        seg_in = boundaries[k - 1] if k > 0 else []
+        last = k == V - 1
+
+        def f_unit(saved_f, saved_ct, acc, lvar_sum, mb):
+            jj = jnp.clip(mb, 0, M - 1)
             f_j = {n: v[jj] for n, v in mb_feeds.items()}
-            bnd_j = {n: saved[n][jj % W] for n in seg_in}
+            bnd_j = {n: saved_f[n][jj % W_f] for n in seg_in}
+            out, _, lvar_i = stage_fn(k, full_pvals, f_j, bnd_j,
+                                      mb_key(jj, k))
+            bnd_send, ct_send = zero_sends()
+            for n, v in out.items():
+                bnd_send[n] = v.astype(bshapes[n].dtype)
+            if last:
+                lvar_sum = lvar_sum + lvar_i.astype(lvar_sum.dtype)
+            return acc, lvar_sum, bnd_send, ct_send
+        return f_unit
 
-            def f_vjp(p_, bnd_):
-                out, total, _ = stage_fn(s, p_, f_j, bnd_, mb_key(jj, s))
-                return out, total
+    def make_b(r, c, weight_grads=True, act_grads=True):
+        k = c * S + r
+        seg_in = boundaries[k - 1] if k > 0 else []
+        seg_out = boundaries[k] if k < V - 1 else []
+        last = k == V - 1
 
-            (_, _), vjp_fn = jax.vjp(f_vjp, pvals, bnd_j)
-            ct_out = {n: ct_in[n] for n in seg_out}
+        def b_unit(saved_f, saved_ct, acc, lvar_sum, mb):
+            jj = jnp.clip(mb, 0, M - 1)
+            f_j = {n: v[jj] for n, v in mb_feeds.items()}
+            bnd_j = {n: saved_f[n][jj % W_f] for n in seg_in}
+            ct_j = {n: saved_ct[n][jj % W_c].astype(bshapes[n].dtype)
+                    for n in seg_out}
             seed = jnp.asarray(1.0 / M, jnp.float32) if last \
                 else jnp.asarray(0.0, jnp.float32)
-            dp, dbnd = vjp_fn((ct_out, seed))
-            valid_b = j >= 0
-            acc = {n: acc[n] + jnp.where(valid_b, dp[n].astype(
-                acc[n].dtype), jnp.zeros_like(acc[n]))
-                for n in acc}
-            ct_send = {
-                n: (jnp.where(valid_b, dbnd[n].astype(bshapes[n].dtype),
-                              zeros_of(bshapes[n]))
-                    if n in dbnd else zeros_of(bshapes[n]))
-                for n in b_union}
-            # 3) forward unit
-            i = frow[s]
-            ii = jnp.clip(i, 0, M - 1)
-            f_i = {n: v[ii] for n, v in mb_feeds.items()}
-            bnd_i = {n: saved[n][ii % W] for n in seg_in}
-            out_i, _, lvar_i = stage_fn(s, pvals, f_i, bnd_i,
-                                        mb_key(ii, s))
-            valid_f = i >= 0
-            bnd_send = {
-                n: (jnp.where(valid_f, out_i[n].astype(bshapes[n].dtype),
-                              zeros_of(bshapes[n]))
-                    if n in out_i else zeros_of(bshapes[n]))
-                for n in b_union}
-            if last:
-                lvar_sum = lvar_sum + jnp.where(
-                    valid_f, lvar_i.astype(lvar_sum.dtype),
-                    jnp.zeros_like(lvar_sum))
-            return saved, acc, lvar_sum, bnd_send, ct_send
+            bnd_send, ct_send = zero_sends()
+            if weight_grads and act_grads:
+                def f_vjp(p_, bnd_):
+                    out, total, _ = stage_fn(k, p_, f_j, bnd_,
+                                             mb_key(jj, k))
+                    return {n: out[n] for n in seg_out}, total
+                _, vjp_fn = jax.vjp(f_vjp, full_pvals, bnd_j)
+                dp, dbnd = vjp_fn((ct_j, seed))
+            elif act_grads:
+                # zero-bubble B: activation grad only — params are
+                # constants, the weight grad waits for the W tick
+                def f_vjp(bnd_):
+                    out, total, _ = stage_fn(k, full_pvals, f_j, bnd_,
+                                             mb_key(jj, k))
+                    return {n: out[n] for n in seg_out}, total
+                _, vjp_fn = jax.vjp(f_vjp, bnd_j)
+                (dbnd,) = vjp_fn((ct_j, seed))
+                dp = None
+            else:
+                # zero-bubble W: weight grad only — the saved input is
+                # a constant, the cotangent was stashed by the B tick
+                def f_vjp(p_):
+                    out, total, _ = stage_fn(k, p_, f_j, bnd_j,
+                                             mb_key(jj, k))
+                    return {n: out[n] for n in seg_out}, total
+                _, vjp_fn = jax.vjp(f_vjp, full_pvals)
+                (dp,) = vjp_fn((ct_j, seed))
+                dbnd = None
+            if dp is not None:
+                acc = {n: acc[n] + dp[n].astype(acc[n].dtype)
+                       for n in acc}
+            if dbnd is not None:
+                for n in seg_in:
+                    if n in dbnd:
+                        ct_send[n] = dbnd[n].astype(bshapes[n].dtype)
+            return acc, lvar_sum, bnd_send, ct_send
+        return b_unit
 
+    def make_rank_branch(r):
+        # per-chunk arrival bookkeeping + the inner unit switch.  The
+        # ring stores are uniform masked byte copies (zero FLOPs) so an
+        # idle tick still files whatever landed on the wire; the unit
+        # compute itself runs ONLY in the selected inner branch.
+        inner = [make_noop()]
+        for c in range(chunks):
+            k = c * S + r
+            inner.append(make_f(r, c))
+            if has_w:
+                # B = activation grad only (never scheduled at k = 0);
+                # W = weight grad only (at k = 0 it IS the whole
+                # backward — no upstream to feed)
+                inner.append(make_b(r, c, weight_grads=False))
+                inner.append(make_b(r, c, act_grads=False))
+            else:
+                inner.append(make_b(r, c))
+
+        def branch(carry, code_row, mb_row, fac, fam, cac, cam):
+            saved_f, saved_ct, bnd_in, ct_in, acc, lvar_sum = carry
+            saved_f, saved_ct = dict(saved_f), dict(saved_ct)
+            for c in range(chunks):
+                k = c * S + r
+                if k > 0:
+                    hit = jnp.logical_and(fac[r] == c, fam[r] >= 0)
+                    slot = jnp.clip(fam[r], 0, M - 1) % W_f
+                    for n in boundaries[k - 1]:
+                        saved_f[n] = jnp.where(
+                            hit,
+                            jax.lax.dynamic_update_index_in_dim(
+                                saved_f[n], bnd_in[n], slot, 0),
+                            saved_f[n])
+                if k < V - 1:
+                    hit = jnp.logical_and(cac[r] == c, cam[r] >= 0)
+                    slot = jnp.clip(cam[r], 0, M - 1) % W_c
+                    for n in boundaries[k]:
+                        saved_ct[n] = jnp.where(
+                            hit,
+                            jax.lax.dynamic_update_index_in_dim(
+                                saved_ct[n], ct_in[n], slot, 0),
+                            saved_ct[n])
+            acc, lvar_sum, bnd_send, ct_send = jax.lax.switch(
+                jnp.clip(code_row[r], 0, len(inner) - 1), inner,
+                saved_f, saved_ct, acc, lvar_sum, mb_row[r])
+            return saved_f, saved_ct, acc, lvar_sum, bnd_send, ct_send
         return branch
 
-    branches = [make_branch(s) for s in range(S)]
+    branches = [make_rank_branch(r) for r in range(S)]
     idx = jax.lax.axis_index(axis)
-    perm_down = [(i, i + 1) for i in range(S - 1)]
-    perm_up = [(i + 1, i) for i in range(S - 1)]
+    # wrapping rings: the S−1 → 0 link carries the interleaved
+    # chunk-transition hop (and zeros for v = 1, which the arrival
+    # tables never file)
+    perm_down = [(i, (i + 1) % S) for i in range(S)]
+    perm_up = [(i, (i - 1) % S) for i in range(S)]
 
     def tick(carry, rows):
-        frow, brow, arow = rows
-        saved, acc, lvar_sum, bnd_send, ct_send = jax.lax.switch(
-            idx, branches, carry, frow, brow, arow)
+        code_row, mb_row, fac, fam, cac, cam = rows
+        saved_f, saved_ct, acc, lvar_sum, bnd_send, ct_send = \
+            jax.lax.switch(idx, branches, carry, code_row, mb_row,
+                           fac, fam, cac, cam)
         bnd_in = {n: jax.lax.ppermute(bnd_send[n], axis, perm_down)
                   for n in b_union}
         ct_in = {n: jax.lax.ppermute(ct_send[n], axis, perm_up)
                  for n in b_union}
-        return (saved, bnd_in, ct_in, acc, lvar_sum), None
+        return (saved_f, saved_ct, bnd_in, ct_in, acc, lvar_sum), None
 
     init = (
-        {n: jnp.zeros((W,) + tuple(bshapes[n].shape), bshapes[n].dtype)
-         for n in b_union},
+        {n: jnp.zeros((W_f,) + tuple(bshapes[n].shape),
+                      bshapes[n].dtype) for n in b_union},
+        {n: jnp.zeros((W_c,) + tuple(bshapes[n].shape),
+                      bshapes[n].dtype) for n in b_union},
         {n: zeros_of(bshapes[n]) for n in b_union},
         {n: zeros_of(bshapes[n]) for n in b_union},
-        {n: jnp.zeros(v.shape, v.dtype) for n, v in pvals.items()},
+        {n: jnp.zeros(v.shape, v.dtype) for n, v in full_pvals.items()},
         jnp.zeros(lshape.shape, lshape.dtype),
     )
-    (_, _, _, acc, lvar_sum), _ = jax.lax.scan(
-        tick, init, (fwd_tbl, bwd_tbl, arr_tbl))
+    (_, _, _, _, acc, lvar_sum), _ = jax.lax.scan(
+        tick, init, (code_tbl, mb_tbl, fac_tbl, fam_tbl,
+                     cac_tbl, cam_tbl))
 
     # only the last pipe rank accumulated the loss (zeros elsewhere) —
-    # the psum broadcasts it; grads stay stage-partial here, summed by
-    # the pipe-axis fused all-reduce in the tail
+    # the psum broadcasts it; replicated-param grads stay stage-partial
+    # here (summed by the pipe-axis fused all-reduce in the tail) while
+    # pipe-sharded grads reduce-scatter NOW — the scatter is their
+    # cross-stage sum
     lvar_mean = jax.lax.psum(lvar_sum, axis) / M
+    grads_out = {}
+    for n in param_names:
+        if n in sharded_params:
+            grads_out[n] = jax.lax.psum_scatter(
+                acc[n], axis, scatter_dimension=int(sharded_params[n]),
+                tiled=True)
+        else:
+            grads_out[n] = acc[n]
     ctx.key = jax.random.split(base_key, 1)[0]
     env2 = dict(base_env)
     env2.update(feeds)
     env2.update(pvals)
     env2[loss_name] = lvar_mean
     for n in param_names:
-        env2[grad_var_name(n)] = acc[n]
+        env2[grad_var_name(n)] = grads_out[n]
     env2[grad_var_name(loss_name)] = jnp.ones_like(lvar_mean)
+
+    # the lowering census: tick tables the scan ACTUALLY consumed, the
+    # no-op branch's primitive inventory (must be pure data movement),
+    # and the weight-sharding summary — pipe_probe asserts census idle
+    # ticks == simulator bubble ticks and idle compute == 0
+    census_idle = int(sum(1 for t in range(T) for r in range(S)
+                          if code_rows[t][r] == 0))
+    noop = make_noop()
+    noop_prims = _jaxpr_prims(
+        lambda mb: noop(init[0], init[1], init[4], init[5], mb),
+        jnp.asarray(0, jnp.int32))
+    idle_flop_prims = [p for p in (noop_prims or ())
+                      if p not in _ZERO_FLOP_PRIMS]
+    global _LAST_PIPE_REPORT
+    _LAST_PIPE_REPORT = {
+        "family": family, "num_ranks": S, "chunks": chunks,
+        "num_virtual_stages": V, "num_microbatches": M,
+        "ticks": T, "census_idle_slots": census_idle,
+        "sim_idle_slots": int(sch["idle_slots"]),
+        "bubble_ticks": float(sch["bubble_ticks"]),
+        "bubble_frac": float(sch["bubble_frac"]),
+        "ring_slots": [W_f, W_c],
+        "idle_branch_prims": list(noop_prims or ()),
+        "idle_branch_flop_prims": list(idle_flop_prims),
+        "sharded_params": {n: int(d) for n, d in sharded_params.items()},
+    }
+
     # stage-partial grads: a NaN on any pp rank poisons the probe on
     # every rank through the guard's all-axis psum
     _guardrails.stash_probe(env2, loss_name,
                             [grad_var_name(n) for n in param_names], ctx)
     env2 = run_ops(tail_ops, env2, ctx)
-    _check_pipe_fetches(env2, fetch_names, "1F1B pipeline lowering")
+    _check_pipe_fetches(env2, fetch_names, "scheduled pipeline lowering")
     return env2
+
+
+# PR 13 name kept for external callers; the 1F1B path is now one row of
+# the schedule family
+_lower_pipelined_1f1b = _lower_pipelined_schedule
 
 
 def lower_block_with_backward(ops, env, ctx, bw_idx, fetch_names,

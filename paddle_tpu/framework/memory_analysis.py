@@ -616,11 +616,14 @@ def analyze_memory(program: Program, feed_shapes=None,
         est.internal_bytes = internal
         pipe_inflight = 0
         if pipe_S > 1 and pipe_M >= 1:
-            # 1F1B lowering: each backward tick recomputes its stage's
-            # forward from the saved stage input, so per-device residual
-            # state is ONE stage's classes at ONE microbatch, plus the
-            # saved boundary ring (≤ pipe_S microbatch inputs per stage)
-            # and the two in-transit carries (boundary + cotangent)
+            # scheduled pipeline lowering: each backward tick recomputes
+            # its stage's forward from the saved stage input, so
+            # per-device residual state is the rank's virtual stages'
+            # classes at ONE microbatch, plus the saved-input /
+            # cotangent rings (sizes from the schedule simulation,
+            # stamped as pipe_ring_slots) and the two in-transit carries
+            pipe_v = int(bw_attrs.get("pipe_chunks") or 1)
+            ranks = max(pipe_S // max(pipe_v, 1), 1)
             stage_bytes: Dict[int, int] = {}
             for r, (b, n) in classes.items():
                 iv = liveness.get(n)
@@ -628,20 +631,28 @@ def analyze_memory(program: Program, feed_shapes=None,
                 s = int(op.attrs.get("_pipe_stage", 0)) \
                     if op is not None else 0
                 stage_bytes[s] = stage_bytes.get(s, 0) + b
-            est.residual_bytes = max(stage_bytes.values()) // pipe_M \
+            # an interleaved rank r hosts virtual stages {r, r+ranks, …}
+            # — its residual is their sum; take the worst rank
+            rank_bytes = [0] * ranks
+            for s, b in stage_bytes.items():
+                rank_bytes[s % ranks] += b
+            est.residual_bytes = max(rank_bytes) // pipe_M \
                 if stage_bytes else 0
             est.internal_bytes = internal // pipe_M
             bnd = 0
             for names in bw_attrs.get("pipe_boundaries") or ():
                 for n in names:
                     bnd += var_bytes(n, activation=True)
-            pipe_inflight = (pipe_S + 2) * bnd // max(pipe_M, 1)
+            ring = bw_attrs.get("pipe_ring_slots")
+            slots = (int(ring[0]) + int(ring[1])) if ring else ranks
+            pipe_inflight = (slots + 2) * bnd // max(pipe_M, 1)
+            sched = bw_attrs.get("pipe_schedule") or "1f1b"
             est.notes.append(
-                f"pipeline {pipe_S} stages x {pipe_M} microbatches: "
-                f"max-stage residual "
+                f"pipeline {sched} on {ranks} ranks x {pipe_v} chunks "
+                f"x {pipe_M} microbatches: max-rank residual "
                 f"{est.residual_bytes / (1 << 20):.2f} MiB per "
                 f"microbatch + {pipe_inflight / (1 << 20):.2f} MiB "
-                f"in-flight boundary state")
+                f"in-flight ring/boundary state")
         # grad-sync collectives after the backward op keep BOTH their
         # source and result buffers live (a psum cannot update in place;
         # a reduce_scatter's full-grad input coexists with its 1/n
@@ -1199,8 +1210,10 @@ def exposed_comm_model(wire_summary, flops_total, num_devices=1,
     (``flag("ici_gbps")`` · 1e9); peak FLOPs from the device table
     (``flag("device_peak_flops")`` override).
 
-    ``bubble_frac`` prices a 1F1B pipeline's idle bubble — the canonical
-    ``(pipe − 1) / num_microbatches`` fraction of the busy step: the
+    ``bubble_frac`` prices a pipeline schedule's idle bubble — the
+    EXACT per-tick bubble fraction of the chosen schedule family
+    (``pipe.simulate_schedule``: 1F1B, interleaved, zero-bubble),
+    replacing the old analytic ``(pipe − 1) / num_microbatches``: the
     model charges ``pipe_bubble_s = bubble_frac × (compute_s +
     exposed)`` on top, and the planner ranks by the total ``cost_s``.
     0 (the default, every non-pipelined config) leaves all historical

@@ -327,88 +327,316 @@ def plan_stage_cuts(program: Program, num_stages: int,
 
 
 # ---------------------------------------------------------------------------
-# the 1F1B schedule (static tables)
+# the schedule family (static tables)
 # ---------------------------------------------------------------------------
+
+#: the static schedules the planner searches.  ``1f1b`` is PR 13's
+#: non-interleaved 1F1B; ``interleaved`` is virtual-stage 1F1B with ``v``
+#: chunks per rank (Megatron-style fixed per-rank unit order: warm-up
+#: forwards, strict 1F:1B alternation, cool-down backwards); and
+#: ``zero_bubble`` splits each backward into an activation-grad tick (B,
+#: the cotangent hop) and a deferrable weight-grad tick (W) that fills
+#: what would otherwise be bubbles.
+SCHEDULE_FAMILIES = ("1f1b", "interleaved", "zero_bubble")
+
+# unit kinds in the per-tick ``kind`` table
+KIND_IDLE, KIND_F, KIND_B, KIND_W = 0, 1, 2, 3
+
+
+def _interleaved_orders(S: int, M: int, v: int, r: int):
+    """Megatron-style unit orders for rank ``r``: microbatch waves of
+    size ``S``, chunks round-robin within a wave (forward ascending,
+    backward descending — the cool-down drains the deepest chunk
+    first)."""
+    def waves(rev):
+        out = []
+        for w in range(0, M, S):
+            cs = reversed(range(v)) if rev else range(v)
+            for c in cs:
+                for j in range(w, min(w + S, M)):
+                    out.append((c * S + r, j))
+        return out
+    f_units, b_units = waves(False), waves(True)
+    warm = min(len(f_units), (S - r - 1) * 2 + (v - 1) * S)
+    seq = [("F",) + u for u in f_units[:warm]]
+    fi, bi = warm, 0
+    while fi < len(f_units) or bi < len(b_units):
+        if fi < len(f_units):
+            seq.append(("F",) + f_units[fi])
+            fi += 1
+        if bi < len(b_units):
+            seq.append(("B",) + b_units[bi])
+            bi += 1
+    return seq
+
+
+def simulate_schedule(family: str, num_stages: int, num_microbatches: int,
+                      chunks: int = 1) -> Dict[str, Any]:
+    """Simulate one member of the schedule family into the static
+    per-tick tables the executor's scan consumes, the planner prices,
+    and the census artifact records.
+
+    The model: ``S`` pipe ranks, ``V = S·chunks`` virtual (program)
+    stages, virtual stage ``k`` living on rank ``k % S`` as chunk
+    ``k // S``; one work unit per rank per tick; boundary/cotangent hops
+    take one tick (ppermute latency).  Unit kinds per virtual stage:
+    F (forward), B (backward), and — ``zero_bubble`` only — the backward
+    split into B (activation grad, the cotangent hop, ``k ≥ 1``) and W
+    (weight grad, deferrable; stage 0 has no cotangent to propagate so
+    its single backward unit is a W consuming the arrived cotangent).
+
+    Bubble accounting: ``idle_slots`` is the RAW count of idle
+    (tick, rank) cells — the quantity the lowering census must match
+    exactly.  ``bubble_ticks`` normalizes capacity to base-stage work so
+    families are comparable: a slot advances ``work_rate`` base units
+    (1 for 1f1b, 1/v for interleaved whose virtual stages are 1/v-size,
+    2/3 for zero_bubble whose F+B+W triple does one F+B of base work),
+    so ``bubble_ticks = work_rate·T·S − 2·M·S`` — wasted capacity in
+    base-tick units.  ``bubble_frac = bubble_ticks / (work_rate·T·S)``
+    is the planner's cost multiplier."""
+    S, M, v = int(num_stages), int(num_microbatches), int(chunks)
+    if family not in SCHEDULE_FAMILIES:
+        raise InvalidArgumentError(
+            f"simulate_schedule: unknown family {family!r} "
+            f"(one of {SCHEDULE_FAMILIES})")
+    if family != "interleaved":
+        v = 1
+    if S < 1 or M < 1 or v < 1:
+        raise InvalidArgumentError(
+            f"simulate_schedule: S={S}, M={M}, chunks={v} invalid")
+    V = S * v
+    has_w = family == "zero_bubble"
+    fwd_tick = [[None] * M for _ in range(V)]
+    bwd_tick = [[None] * M for _ in range(V)]
+    w_tick = [[None] * M for _ in range(V)]
+    fwd_n = [0] * V
+    bwd_n = [0] * V
+    w_n = [0] * V
+    seqs = [_interleaved_orders(S, M, v, r) for r in range(S)] \
+        if family == "interleaved" else None
+    ptr = [0] * S
+
+    def units_left():
+        if seqs is not None:
+            return any(ptr[r] < len(seqs[r]) for r in range(S))
+        if has_w:
+            return any(w_n[k] < M for k in range(V)) \
+                or any(bwd_n[k] < M for k in range(1, V))
+        return any(b < M for b in bwd_n)
+
+    rows = []            # rows[t][r] = (kind, vstage, mb) or None
+    t = 0
+    limit = 8 * (M * v * 3 + V) + 32
+    while units_left() and t < limit:
+        row = [None] * S
+        for r in range(S):
+            if seqs is not None:
+                # sequence-driven (interleaved): execute the fixed unit
+                # order, stalling on unmet hop dependencies
+                if ptr[r] >= len(seqs[r]):
+                    continue
+                ph, k, j = seqs[r][ptr[r]]
+                if ph == "F":
+                    if k == 0 or (fwd_tick[k - 1][j] is not None
+                                  and fwd_tick[k - 1][j] < t):
+                        row[r] = (KIND_F, k, j)
+                        fwd_tick[k][j] = t
+                        fwd_n[k] += 1
+                        ptr[r] += 1
+                else:
+                    f_ok = fwd_tick[k][j] is not None \
+                        and fwd_tick[k][j] < t
+                    up_ok = (k == V - 1) or (
+                        bwd_tick[k + 1][j] is not None
+                        and bwd_tick[k + 1][j] < t)
+                    if f_ok and up_ok:
+                        row[r] = (KIND_B, k, j)
+                        bwd_tick[k][j] = t
+                        bwd_n[k] += 1
+                        ptr[r] += 1
+                continue
+            # greedy families (1f1b / zero_bubble): priority B > F > W
+            k = r
+            j = bwd_n[k]
+            if j < M and not (has_w and k == 0):
+                bwd_ready = (
+                    (k == V - 1 and fwd_tick[k][j] is not None
+                     and fwd_tick[k][j] < t) or
+                    (k < V - 1 and bwd_tick[k + 1][j] is not None
+                     and bwd_tick[k + 1][j] < t))
+                if bwd_ready:
+                    row[r] = (KIND_B, k, j)
+                    bwd_tick[k][j] = t
+                    bwd_n[k] += 1
+                    continue
+            # zero_bubble relaxes the warm-up cap (ZB-H2 style): more
+            # in-flight microbatches buy warm-up bubble elimination,
+            # paid for in saved-input ring slots
+            cap = min(M, 2 * (S - r)) if has_w else (S - r)
+            i = fwd_n[k]
+            if i < M and (fwd_n[k] - bwd_n[k]) < cap and (
+                    k == 0 or (fwd_tick[k - 1][i] is not None
+                               and fwd_tick[k - 1][i] < t)):
+                row[r] = (KIND_F, k, i)
+                fwd_tick[k][i] = t
+                fwd_n[k] += 1
+                continue
+            if has_w:
+                j = w_n[k]
+                if j < M:
+                    if k == 0:
+                        w_ready = (
+                            (V == 1 and fwd_tick[0][j] is not None
+                             and fwd_tick[0][j] < t) or
+                            (V > 1 and bwd_tick[1][j] is not None
+                             and bwd_tick[1][j] < t))
+                    else:
+                        w_ready = bwd_tick[k][j] is not None \
+                            and bwd_tick[k][j] < t
+                    if w_ready:
+                        row[r] = (KIND_W, k, j)
+                        w_tick[k][j] = t
+                        w_n[k] += 1
+                        if k == 0:
+                            bwd_n[k] += 1   # the merged stage-0 backward
+        rows.append(row)
+        t += 1
+    if units_left():
+        raise AssertionError(
+            f"simulate_schedule: simulation did not converge "
+            f"(family={family}, S={S}, M={M}, chunks={v})")
+    T = t
+
+    # per-tick tables (kind / virtual stage / microbatch per rank)
+    kind_rows = [[KIND_IDLE] * S for _ in range(T)]
+    vstage_rows = [[0] * S for _ in range(T)]
+    mb_rows = [[-1] * S for _ in range(T)]
+    for tick, row in enumerate(rows):
+        for r, u in enumerate(row):
+            if u is not None:
+                kind_rows[tick][r] = u[0]
+                vstage_rows[tick][r] = u[1]
+                mb_rows[tick][r] = u[2]
+
+    # arrivals.  Forward: virtual stage k's input for microbatch j lands
+    # on rank k % S one tick after stage k−1 produced it (stage 0
+    # recomputes from feeds).  Cotangent: the grad of stage k's OUTPUT
+    # boundary lands one tick after B(k+1, j) ran downstream.  At most
+    # one of each per rank per tick (the sending neighbor runs one unit
+    # per tick), so one (chunk, microbatch) pair per cell suffices.
+    arr_c = [[-1] * S for _ in range(T)]
+    arr_mb = [[-1] * S for _ in range(T)]
+    ct_c = [[-1] * S for _ in range(T)]
+    ct_mb = [[-1] * S for _ in range(T)]
+    for k in range(1, V):
+        r = k % S
+        for j in range(M):
+            ta = fwd_tick[k - 1][j] + 1
+            if ta < T:
+                arr_c[ta][r] = k // S
+                arr_mb[ta][r] = j
+    for k in range(V - 1):
+        r = k % S
+        for j in range(M):
+            if bwd_tick[k + 1][j] is None:
+                continue
+            ta = bwd_tick[k + 1][j] + 1
+            if ta < T:
+                ct_c[ta][r] = k // S
+                ct_mb[ta][r] = j
+
+    def _ring(arrive_of, release_of, ks):
+        # slot j % W must be free when microbatch j + W arrives: any
+        # earlier microbatch still unreleased at j's arrival widens W
+        need = 1
+        for k in ks:
+            for j in range(M):
+                a = arrive_of(k, j)
+                if a is None:
+                    continue
+                for p in range(j):
+                    rel = release_of(k, p)
+                    if rel is not None and rel >= a:
+                        need = max(need, j - p + 1)
+        return min(max(need, 1), M) if M else 1
+
+    def _release(k, p):
+        rel = bwd_tick[k][p]
+        if has_w and w_tick[k][p] is not None:
+            rel = w_tick[k][p] if rel is None else max(rel, w_tick[k][p])
+        return rel
+
+    slots = _ring(lambda k, j: (fwd_tick[k - 1][j] + 1)
+                  if fwd_tick[k - 1][j] is not None else None,
+                  _release, range(1, V))
+    ct_slots = _ring(lambda k, j: (bwd_tick[k + 1][j] + 1)
+                     if bwd_tick[k + 1][j] is not None else None,
+                     _release, range(V - 1))
+
+    order = []
+    phase_of = {KIND_F: "F", KIND_B: "B", KIND_W: "W"}
+    for tick, row in enumerate(rows):
+        for r, u in enumerate(row):
+            if u is not None:
+                order.append((tick, u[1], phase_of[u[0]], u[2]))
+
+    busy = sum(1 for row in rows for u in row if u is not None)
+    idle_slots = T * S - busy
+    work_rate = (1.0 / v) if family == "interleaved" else (
+        2.0 / 3.0 if has_w else 1.0)
+    bubble_ticks = work_rate * T * S - 2.0 * M * S
+    capacity = work_rate * T * S
+    sch = {"family": family, "num_stages": V, "num_ranks": S,
+           "chunks": v, "num_microbatches": M, "ticks": T,
+           "kind": kind_rows, "vstage": vstage_rows, "mb": mb_rows,
+           "arr_c": arr_c, "arr_mb": arr_mb,
+           "ct_arr_c": ct_c, "ct_arr_mb": ct_mb,
+           "slots": slots, "ct_slots": ct_slots,
+           "order": order, "idle_slots": idle_slots,
+           "work_rate": work_rate,
+           "bubble_ticks": bubble_ticks,
+           "bubble_frac": (bubble_ticks / capacity) if capacity else 0.0}
+    if v == 1:
+        # legacy per-stage tables (the PR 13 census format)
+        fwd_rows = [[-1] * S for _ in range(T)]
+        bwd_rows = [[-1] * S for _ in range(T)]
+        for tick, row in enumerate(rows):
+            for r, u in enumerate(row):
+                if u is None:
+                    continue
+                if u[0] == KIND_F:
+                    fwd_rows[tick][r] = u[2]
+                elif u[0] == KIND_B:
+                    bwd_rows[tick][r] = u[2]
+        sch["fwd"] = fwd_rows
+        sch["bwd"] = bwd_rows
+        sch["arrive"] = [[arr_mb[tk][s] if arr_c[tk][s] == 0 else -1
+                          for s in range(S)] for tk in range(T)]
+    return sch
 
 
 def schedule_1f1b(num_stages: int, num_microbatches: int) -> Dict[str, Any]:
-    """Simulate the canonical non-interleaved 1F1B schedule: stage ``s``
-    runs at most ``S − s`` in-flight microbatches (warm-up forwards),
-    then strictly alternates, backward prioritized as soon as the
-    downstream cotangent has arrived.  One work unit per stage per tick;
-    boundary/cotangent hops take one tick (ppermute latency).
+    """The canonical non-interleaved 1F1B schedule — one row of
+    :func:`simulate_schedule` kept as the stable PR 13 entry point.
+    Stage ``s`` runs at most ``S − s`` in-flight microbatches (warm-up
+    forwards), then strictly alternates, backward prioritized as soon as
+    the downstream cotangent has arrived."""
+    return simulate_schedule("1f1b", num_stages, num_microbatches)
 
-    Returns the static per-tick tables the executor's scan consumes —
-    ``fwd[t][s]`` / ``bwd[t][s]`` (microbatch index, −1 idle),
-    ``arrive[t][s]`` (microbatch whose stage input lands this tick) —
-    plus the saved-input ring size ``slots`` and the flattened
-    ``order`` census ``[(tick, stage, phase, microbatch), ...]``."""
+
+def enumerate_schedules(num_stages: int, num_microbatches: int,
+                        max_chunks: int = 2) -> List[Dict[str, Any]]:
+    """Simulate every schedule-family candidate for ``(S, M)`` — pure
+    table math, zero compiles — sorted by exact ``bubble_ticks`` (ties
+    broken toward the simpler family, 1f1b first)."""
     S, M = int(num_stages), int(num_microbatches)
-    fwd_tick = [[None] * M for _ in range(S)]
-    bwd_tick = [[None] * M for _ in range(S)]
-    fwd_n = [0] * S
-    bwd_n = [0] * S
-    fwd_rows, bwd_rows = [], []
-    t = 0
-    while any(b < M for b in bwd_n) and t < 4 * (M + S) + 8:
-        frow, brow = [-1] * S, [-1] * S
-        for s in range(S):
-            j = bwd_n[s]
-            bwd_ready = j < M and (
-                (s == S - 1 and fwd_tick[s][j] is not None
-                 and fwd_tick[s][j] < t) or
-                (s < S - 1 and bwd_tick[s + 1][j] is not None
-                 and bwd_tick[s + 1][j] < t))
-            if bwd_ready:
-                brow[s] = j
-                bwd_tick[s][j] = t
-                bwd_n[s] += 1
-                continue
-            i = fwd_n[s]
-            fwd_ready = i < M and (fwd_n[s] - bwd_n[s]) < (S - s) and (
-                s == 0 or (fwd_tick[s - 1][i] is not None
-                           and fwd_tick[s - 1][i] < t))
-            if fwd_ready:
-                frow[s] = i
-                fwd_tick[s][i] = t
-                fwd_n[s] += 1
-        fwd_rows.append(frow)
-        bwd_rows.append(brow)
-        t += 1
-    if any(b < M for b in bwd_n):
-        raise AssertionError(
-            f"schedule_1f1b: simulation did not converge (S={S}, M={M})")
-    T = t
-    # stage-input arrivals: stage s's input for microbatch i lands one
-    # tick after stage s−1 produced it (stage 0 recomputes from feeds)
-    arrive = [[-1] * S for _ in range(T)]
-    for s in range(1, S):
-        for i in range(M):
-            ta = fwd_tick[s - 1][i] + 1
-            if ta < T:
-                arrive[ta][s] = i
-    # saved-input ring: slot i % W must be free when microbatch i + W
-    # arrives, i.e. bwd(s, i) strictly before arrive(s, i + W)
-    W = 1
-    for s in range(1, S):
-        for i in range(M):
-            need = 1
-            for k in range(i):
-                if bwd_tick[s][k] >= fwd_tick[s - 1][i] + 1:
-                    need = max(need, i - k + 1)
-            W = max(W, need)
-    W = min(max(W, 1), M) if M else 1
-    order = []
-    for tick in range(T):
-        for s in range(S):
-            if fwd_rows[tick][s] >= 0:
-                order.append((tick, s, "F", fwd_rows[tick][s]))
-            if bwd_rows[tick][s] >= 0:
-                order.append((tick, s, "B", bwd_rows[tick][s]))
-    return {"num_stages": S, "num_microbatches": M, "ticks": T,
-            "fwd": fwd_rows, "bwd": bwd_rows, "arrive": arrive,
-            "slots": W, "order": order,
-            "bubble_frac": (S - 1) / M if M else 0.0}
+    cands = [simulate_schedule("1f1b", S, M)]
+    for v in range(2, int(max_chunks) + 1):
+        cands.append(simulate_schedule("interleaved", S, M, chunks=v))
+    cands.append(simulate_schedule("zero_bubble", S, M))
+    rank = {f: i for i, f in enumerate(SCHEDULE_FAMILIES)}
+    cands.sort(key=lambda c: (c["bubble_ticks"], rank[c["family"]]))
+    return cands
 
 
 # ---------------------------------------------------------------------------
@@ -442,23 +670,45 @@ def set_microbatches(program: Program, num_microbatches: int):
 def apply_pipeline(program: Program, num_stages: int,
                    num_microbatches: int, pipe_axis: str = PIPE_AXIS,
                    feed_shapes=None,
-                   plan: Optional[StageCutPlan] = None) -> Dict[str, Any]:
+                   plan: Optional[StageCutPlan] = None,
+                   schedule: str = "1f1b", chunks: int = 1,
+                   shard_weights: bool = False,
+                   min_shard_numel: Optional[int] = None) -> Dict[str, Any]:
     """Rewrite ``program`` in place for ``num_stages``-way pipeline
-    parallelism over ``pipe_axis`` with a ``num_microbatches`` 1F1B
-    schedule.  Call AFTER ``optimizer.minimize`` (the backward op must
-    exist) and BEFORE ``CompiledProgram.with_mesh`` (whose data-axis
-    grad sync composes with — and commutes with — the pipe-axis sum
-    inserted here).  Idempotent per program.
+    parallelism over ``pipe_axis`` under one of the
+    :data:`SCHEDULE_FAMILIES` (``schedule``; ``chunks`` is the
+    virtual-stage depth per rank for ``interleaved``).  Call AFTER
+    ``optimizer.minimize`` (the backward op must exist) and BEFORE
+    ``CompiledProgram.with_mesh`` (whose data-axis grad sync composes
+    with — and commutes with — the pipe-axis sum inserted here).
+    Idempotent per program.
 
     The rewrite is metadata + boundary ops only; the actual microbatch
-    loop/1F1B scan happens at executor lowering, so the SAME program
-    runs unpipelined (stages sequential, microbatches still
+    loop/scheduled scan happens at executor lowering, so the SAME
+    program runs unpipelined (stages sequential, microbatches still
     accumulated) on a mesh without the pipe axis — the pipe = 1
-    degenerate the parity tests compare against."""
+    degenerate the parity tests compare against.
+
+    ``shard_weights=True`` additionally stamps pipe-axis ``ShardSpec``
+    entries on every eligible parameter (see
+    :func:`apply_pipe_weight_sharding`) so each rank holds only a
+    1/``num_stages`` shard of params + optimizer state; the scheduled
+    lowering gathers weights before the scan and reduce-scatters the
+    grads after it.  Off by default (PR 13 replicated-weight
+    behavior)."""
     S = int(num_stages)
     M = int(num_microbatches)
+    v = int(chunks)
     if M < 1:
         raise InvalidArgumentError(f"num_microbatches={M} < 1")
+    if schedule not in SCHEDULE_FAMILIES:
+        raise InvalidArgumentError(
+            f"apply_pipeline: unknown schedule {schedule!r} "
+            f"(one of {SCHEDULE_FAMILIES})")
+    if schedule != "interleaved":
+        v = 1
+    if v < 1:
+        raise InvalidArgumentError(f"chunks={v} < 1")
     block, ops, bw_idx = _fwd_region(program)
     if bw_idx is None:
         raise InvalidArgumentError(
@@ -472,14 +722,15 @@ def apply_pipeline(program: Program, num_stages: int,
         set_microbatches(program, M)
         return {"num_stages": 1, "num_microbatches": M, "cuts": [],
                 "boundaries": [], "boundary_bytes": []}
-    if M % 1 or M < 1:
-        raise InvalidArgumentError(f"num_microbatches={M} invalid")
     if bw.attrs.get("loss_scale_var"):
         raise InvalidArgumentError(
             "apply_pipeline: dynamic loss scaling (AMP fp16) does not "
-            "compose with the 1F1B lowering — use pure-bf16 AMP or "
-            "static loss_scale")
-    plan = plan or plan_stage_cuts(program, S, feed_shapes=feed_shapes)
+            "compose with the scheduled pipeline lowering — use "
+            "pure-bf16 AMP or static loss_scale")
+    # the PROGRAM is cut into V = S·chunks virtual stages; rank k % S
+    # owns virtual stage k as chunk k // S (the interleaved assignment)
+    V = S * v
+    plan = plan or plan_stage_cuts(program, V, feed_shapes=feed_shapes)
 
     fwd_ops = ops[:bw_idx]
     edges = [0] + list(plan.cuts) + [len(fwd_ops)]
@@ -502,22 +753,120 @@ def apply_pipeline(program: Program, num_stages: int,
                    "_pipe_stage": int(i),
                    "boundary_bytes": int(plan.boundary_bytes[i])})
 
-    bw.attrs["pipe_stages"] = S
+    sch = simulate_schedule(schedule, S, M, chunks=v)
+    bw.attrs["pipe_stages"] = V
+    bw.attrs["pipe_chunks"] = v
+    bw.attrs["pipe_schedule"] = schedule
     bw.attrs["pipe_microbatches"] = M
     bw.attrs["pipe_axis"] = pipe_axis
     bw.attrs["pipe_boundaries"] = [list(b) for b in plan.boundaries]
     bw.attrs["pipe_cuts"] = list(plan.cuts)
+    bw.attrs["pipe_ring_slots"] = [int(sch["slots"]),
+                                   int(sch["ct_slots"])]
+    bw.attrs["pipe_schedule_order"] = [list(u) for u in sch["order"]]
     bw.attrs["pipe_feed_names"] = sorted(
-        v.name for v in block.vars.values() if v.is_data)
+        v2.name for v2 in block.vars.values() if v2.is_data)
+
+    shard_report = None
+    if shard_weights:
+        shard_report = apply_pipe_weight_sharding(
+            program, pipe_axis=pipe_axis, pipe_degree=S,
+            min_shard_numel=min_shard_numel)
 
     from .compiler import insert_pipe_grad_sync
     sync_ops = insert_pipe_grad_sync(program, pipe_axis)
     program._bump_version()
     report = plan.as_dict()
     report.update({"num_microbatches": M, "pipe_axis": pipe_axis,
+                   "num_ranks": S, "chunks": v,
                    "grad_sync_ops": sync_ops,
-                   "schedule": schedule_1f1b(S, M)})
+                   "schedule": sch})
+    if shard_report is not None:
+        report["weight_sharding"] = shard_report
     return report
+
+
+def apply_pipe_weight_sharding(program: Program,
+                               pipe_axis: str = PIPE_AXIS,
+                               pipe_degree: int = 1,
+                               min_shard_numel: Optional[int] = None
+                               ) -> Dict[str, Any]:
+    """Stamp pipe-axis ``ShardSpec`` entries so each pipe rank holds a
+    1/``pipe_degree`` shard of every eligible parameter, its gradient,
+    and its same-shaped optimizer accumulators — the cross-replica
+    weight-update sharding pattern applied over ``pp``.  The scheduled
+    pipeline lowering all-gathers the weight shards once BEFORE the
+    tick scan (full values feed every stage body) and reduce-scatters
+    the accumulated grads once AFTER it, which simultaneously performs
+    the cross-stage pipe sum — so :func:`compiler.insert_pipe_grad_sync`
+    skips these grads.  On a mesh WITHOUT the pipe axis the stamps
+    dangle harmlessly (replicated), keeping the pipe = 1 degenerate
+    parity path byte-identical.
+
+    Metadata-only (no gather/scatter ops are inserted into the IR);
+    ``memory_analysis.var_bytes`` divides resident persistable bytes by
+    the stamped axis automatically, and checkpoint manifests carry the
+    specs so ``reshard.py`` plans pp↔pp/dp flips."""
+    from .fsdp import DEFAULT_MIN_SHARD_NUMEL, _shard_dim
+    from .mesh_layout import ShardSpec
+    degree = int(pipe_degree)
+    if degree < 2:
+        return {"sharded": {}, "skipped": {}, "pipe_degree": degree}
+    if min_shard_numel is None:
+        min_shard_numel = DEFAULT_MIN_SHARD_NUMEL
+    block, ops, bw_idx = _fwd_region(program)
+    read_in_fwd = set()
+    for op in ops[:bw_idx if bw_idx is not None else len(ops)]:
+        read_in_fwd.update(op.input_names())
+    sharded: Dict[str, Any] = {}
+    skipped: Dict[str, str] = {}
+    for p in program.all_parameters():
+        if getattr(p, "dist_attr", None):
+            skipped[p.name] = "already-sharded"
+            continue
+        numel = int(np.prod(p.shape)) if p.shape else 0
+        if numel < int(min_shard_numel):
+            skipped[p.name] = "below-min-shard-numel"
+            continue
+        dim = _shard_dim(p.shape, degree)
+        if dim is None:
+            skipped[p.name] = "no-divisible-dim"
+            continue
+        if p.name not in read_in_fwd:
+            skipped[p.name] = "not-read-in-forward"
+            continue
+        spec = ShardSpec(tuple(pipe_axis if d == dim else None
+                               for d in range(len(p.shape)))
+                         or (pipe_axis,))
+        p.dist_attr = spec
+        g = block.vars.get(grad_var_name(p.name))
+        if g is not None:
+            g.dist_attr = spec
+        # couple the optimizer state: any same-shaped persistable
+        # touched by an update op that also reads this param/grad
+        # shards along (Adam moments, master weights, ...)
+        if bw_idx is not None:
+            coupled = {p.name, grad_var_name(p.name)}
+            for op in ops[bw_idx:]:
+                names = set(op.input_names()) | set(op.output_names())
+                if not (names & coupled):
+                    continue
+                for n in names:
+                    var = block.vars.get(n)
+                    if (var is not None and var.persistable
+                            and tuple(var.shape) == tuple(p.shape)
+                            and not getattr(var, "dist_attr", None)):
+                        var.dist_attr = spec
+        sharded[p.name] = {"dim": int(dim), "numel": numel,
+                           "shard_numel": numel // degree}
+    if bw_idx is not None:
+        # the scheduled lowering reads this to gather shards pre-scan
+        # and reduce-scatter grads post-scan without var lookups
+        ops[bw_idx].attrs["pipe_sharded_params"] = {
+            n: int(info["dim"]) for n, info in sharded.items()}
+    program._bump_version()
+    return {"sharded": sharded, "skipped": skipped,
+            "pipe_degree": degree, "pipe_axis": pipe_axis}
 
 
 # ---------------------------------------------------------------------------

@@ -213,7 +213,12 @@ class PlanConfig:
                 k: self.pipe_report.get(k)
                 for k in ("cuts", "boundary_bytes",
                           "total_boundary_bytes", "stage_ops",
-                          "num_microbatches")}
+                          "num_microbatches", "schedule_summary",
+                          "schedule_candidates")}
+            ws = self.pipe_report.get("weight_sharding")
+            if ws:
+                d["pipe_report"]["weight_sharded_params"] = \
+                    len(ws.get("sharded") or ())
         if self.est is not None:
             d["peak_hbm_bytes"] = int(self.est.peak_bytes)
             d["peak_hbm_mb"] = round(self.est.peak_bytes / mb, 3)
@@ -250,12 +255,13 @@ class Plan:
 
     def __init__(self, configs: List[PlanConfig], num_devices: int,
                  budget_gb: Optional[float], module: str = "program",
-                 num_microbatches: int = 1):
+                 num_microbatches: int = 1, pipe_schedule: str = "1f1b"):
         self.configs = configs
         self.num_devices = num_devices
         self.budget_gb = budget_gb
         self.module = module
         self.num_microbatches = int(num_microbatches)
+        self.pipe_schedule = pipe_schedule
         fitting = [c for c in configs
                    if c.fits and c.error is None and c.est is not None]
         self.winner: Optional[PlanConfig] = \
@@ -270,6 +276,7 @@ class Plan:
             "module": self.module,
             "num_devices": self.num_devices,
             "num_microbatches": self.num_microbatches,
+            "pipe_schedule": self.pipe_schedule,
             "hbm_budget_gb": self.budget_gb,
             "compiles_attempted": 0,    # pricing is static by construction
             "configs_priced": len([c for c in self.configs
@@ -281,7 +288,8 @@ class Plan:
                        "(collective_wire_summary) + exposed-comm "
                        "roofline (exposed_comm_model over the op_spec "
                        "flops channel; ranking = min exposed comm + "
-                       "1F1B bubble (pipe−1)/num_microbatches, "
+                       "the chosen schedule family's exact per-tick "
+                       "bubble fraction (pipe.simulate_schedule), "
                        "ties → fewer wire bytes)",
         }
 
@@ -322,35 +330,89 @@ def price_config(program: Program, layout: MeshLayout,
                  flops_total: Optional[float] = None,
                  num_microbatches: int = 1,
                  remat: bool = False,
+                 pipe_schedule: str = "1f1b",
+                 pipe_shard_weights: bool = False,
                  hbm_budget_gb: Optional[float] = None) -> PlanConfig:
     """Price ONE layout on a clone of ``program``: apply the ZeRO-3
     rewrite (fsdp > 1), the pipeline stage-cut rewrite (pipe > 1, with
-    ``num_microbatches`` 1F1B microbatching) and grad-sync insertion the
-    real compile would apply, then run the static estimators (peak HBM,
-    wire bytes, and — when ``flops_total`` is given — the exposed-comm
-    roofline with the ``(pipe − 1)/num_microbatches`` bubble term).
+    ``num_microbatches`` microbatching under ``pipe_schedule`` — a
+    :data:`~.pipe.SCHEDULE_FAMILIES` name, or ``"auto"`` to pick the
+    family/chunking with the fewest simulated bubble ticks) and
+    grad-sync insertion the real compile would apply, then run the
+    static estimators (peak HBM, wire bytes, and — when ``flops_total``
+    is given — the exposed-comm roofline with the schedule's EXACT
+    per-tick bubble fraction, not the analytic
+    ``(pipe − 1)/num_microbatches``).  ``pipe_shard_weights`` prices
+    the pipe-axis ZeRO weight sharding rewrite on pipe > 1 rows.
     With ``remat=True`` the clone additionally gets recompute
     checkpoints from :func:`~.pipe.plan_remat` (the remat search
     dimension: the FLOPs delta lands in ``remat_plan`` and the
     estimate reflects the dropped residuals).  The clone is discarded —
-    the input program is never mutated and nothing compiles."""
+    the input program is never mutated and nothing compiles: schedule
+    selection is pure simulation (``pipe.enumerate_schedules``)."""
     from .compiler import BuildStrategy, insert_grad_sync
     from .fsdp import apply_fsdp_sharding
     from .memory_analysis import (analyze_memory, collective_wire_summary,
                                   exposed_comm_model)
-    from .pipe import apply_pipeline, apply_remat, plan_remat
+    from .pipe import (apply_pipeline, apply_remat, enumerate_schedules,
+                       plan_remat)
 
     cfg = PlanConfig(layout)
     clone = program.clone()
     strategy = build_strategy or BuildStrategy()
+    bubble = 0.0
     try:
         if layout.fsdp > 1:
             cfg.fsdp_report = apply_fsdp_sharding(
                 clone, layout, min_shard_numel=min_shard_numel)
         if layout.pipe > 1:
-            cfg.pipe_report = apply_pipeline(
-                clone, layout.pipe, num_microbatches,
-                pipe_axis=layout.pipe_axis, feed_shapes=feed_shapes)
+            cands = enumerate_schedules(layout.pipe, num_microbatches)
+            if pipe_schedule == "auto":
+                tries = cands
+            else:
+                tries = [c for c in cands
+                         if c["family"] == pipe_schedule] or cands[:1]
+            rep = None
+            for cand in tries:
+                # interleaving doubles the stage-cut count — small
+                # programs may not split that fine; fall through to the
+                # next-best simulated candidate
+                try:
+                    rep = apply_pipeline(
+                        clone, layout.pipe, num_microbatches,
+                        pipe_axis=layout.pipe_axis,
+                        feed_shapes=feed_shapes,
+                        schedule=cand["family"],
+                        chunks=cand["chunks"],
+                        shard_weights=pipe_shard_weights,
+                        min_shard_numel=min_shard_numel)
+                    break
+                except Exception:
+                    clone = program.clone()
+                    if layout.fsdp > 1:
+                        apply_fsdp_sharding(
+                            clone, layout,
+                            min_shard_numel=min_shard_numel)
+                    rep = None
+            if rep is None:
+                rep = apply_pipeline(
+                    clone, layout.pipe, num_microbatches,
+                    pipe_axis=layout.pipe_axis, feed_shapes=feed_shapes)
+            sch = rep.get("schedule") or {}
+            bubble = float(sch.get("bubble_frac", 0.0))
+            cfg.pipe_report = dict(rep)
+            cfg.pipe_report["schedule_summary"] = {
+                "family": sch.get("family"),
+                "chunks": sch.get("chunks"),
+                "ticks": sch.get("ticks"),
+                "idle_slots": sch.get("idle_slots"),
+                "bubble_ticks": sch.get("bubble_ticks"),
+                "bubble_frac": bubble,
+            }
+            cfg.pipe_report["schedule_candidates"] = [
+                {"family": c["family"], "chunks": c["chunks"],
+                 "bubble_ticks": c["bubble_ticks"],
+                 "bubble_frac": c["bubble_frac"]} for c in cands]
         sizes = layout.sizes
         reduce_axes = tuple(a for a in _flat_axes(layout.batch_axes)
                             if sizes.get(a, 1) > 1)
@@ -377,8 +439,6 @@ def price_config(program: Program, layout: MeshLayout,
         if flops_total is not None:
             has_bw = any(op.type == "backward"
                          for op in clone.global_block().ops)
-            bubble = (layout.pipe - 1) / max(int(num_microbatches), 1) \
-                if layout.pipe > 1 else 0.0
             flops = flops_total
             if cfg.remat_plan is not None:
                 flops = flops + cfg.remat_plan.flops_delta
@@ -408,21 +468,29 @@ def plan_sharding(program: Program, num_devices: int,
                   report_path: Optional[str] = None,
                   max_pipe: Optional[int] = None,
                   num_microbatches: int = 1,
-                  remat: bool = False) -> Plan:
+                  remat: bool = False,
+                  pipe_schedule: str = "1f1b",
+                  pipe_shard_weights: bool = False) -> Plan:
     """Search every legal (data, fsdp, tp, pipe) factorization of
     ``num_devices``, price each statically, and rank them.  Returns the
     :class:`Plan`; ``plan.winner`` is None when no config fits the
     budget (the caller decides whether that is fatal).
 
-    ``max_pipe`` > 1 opts the pipeline dimension in (each pipe > 1
-    config is priced on a stage-cut clone with a
-    ``(pipe − 1)/num_microbatches`` bubble term); ``remat=True`` adds a
+    ``max_pipe`` > 1 opts the pipeline dimension in: each pipe > 1
+    config is priced on a stage-cut clone under ``pipe_schedule``
+    (``"1f1b"``, ``"interleaved"``, ``"zero_bubble"``, or ``"auto"``
+    to let each row take the family/chunking with the fewest simulated
+    bubble ticks) with the schedule's EXACT per-tick bubble fraction in
+    the roofline — the analytic ``(pipe − 1)/num_microbatches`` term is
+    gone.  ``pipe_shard_weights`` additionally prices pipe-axis ZeRO
+    weight sharding on those rows.  ``remat=True`` adds a
     rematerialized sibling row for every budget-rejected config — when
     the recompute plan fits, the reject flips to an admitted config
     carrying the priced FLOPs delta.
 
-    0 compiles are attempted: pricing runs on program clones through
-    the static memory/wire model only."""
+    0 compiles are attempted: pricing (including schedule selection,
+    which is pure ``pipe.simulate_schedule`` arithmetic) runs on
+    program clones through the static memory/wire model only."""
     budget = float(hbm_budget_gb) if hbm_budget_gb else None
     # whole-program GEMM FLOPs priced ONCE on the base program (layout
     # rewrites never change the math) — the exposed-comm roofline's
@@ -437,7 +505,9 @@ def plan_sharding(program: Program, num_devices: int,
     kw = dict(loss_name=loss_name, feed_shapes=feed_shapes,
               fetch_names=fetch_names, build_strategy=build_strategy,
               min_shard_numel=min_shard_numel, flops_total=flops_total,
-              num_microbatches=num_microbatches)
+              num_microbatches=num_microbatches,
+              pipe_schedule=pipe_schedule,
+              pipe_shard_weights=pipe_shard_weights)
     configs = []
     for layout in enumerate_layouts(program, num_devices, max_tp=max_tp,
                                     max_pipe=max_pipe):
@@ -456,7 +526,8 @@ def plan_sharding(program: Program, num_devices: int,
                 rcfg.fits = rcfg.est.peak_gb <= budget
                 configs.append(rcfg)
     plan = Plan(configs, num_devices, budget, module=module,
-                num_microbatches=num_microbatches)
+                num_microbatches=num_microbatches,
+                pipe_schedule=pipe_schedule)
     if report_path:
         plan.write_report(report_path)
     return plan
@@ -486,9 +557,17 @@ def stamp_winning_layout(program: Program, plan: Plan,
                             prefetch_distance=prefetch_distance)
     if layout.pipe > 1:
         from .pipe import apply_pipeline
+        # re-apply exactly what pricing chose: schedule family, chunk
+        # count, and (when priced) pipe-axis weight sharding
+        summ = plan.winner.pipe_report.get("schedule_summary") or {}
+        ws = plan.winner.pipe_report.get("weight_sharding") or {}
         apply_pipeline(program, layout.pipe, plan.num_microbatches,
                        pipe_axis=layout.pipe_axis,
-                       feed_shapes=feed_shapes)
+                       feed_shapes=feed_shapes,
+                       schedule=summ.get("family") or "1f1b",
+                       chunks=int(summ.get("chunks") or 1),
+                       shard_weights=bool(ws.get("sharded")),
+                       min_shard_numel=min_shard_numel)
     elif plan.num_microbatches > 1:
         from .pipe import set_microbatches
         set_microbatches(program, plan.num_microbatches)

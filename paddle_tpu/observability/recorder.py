@@ -164,6 +164,10 @@ class TelemetryRecorder:
         self.static = static
         self.flops_per_step = static.get("flops_per_step")
         self._program = program
+        self._pipelined = bool(program is not None and any(
+            op.type == "backward" and int(op.attrs.get("pipe_stages")
+                                          or 1) > 1
+            for op in program.global_block().ops))
 
         header = {
             "record": "header", "schema": SCHEMA, "run_id": self.run_id,
@@ -274,6 +278,20 @@ class TelemetryRecorder:
                 rec["skipped_total"] = int(gs["skipped_total"])
                 if gs.get("loss_scale") is not None:
                     rec["loss_scale"] = float(gs["loss_scale"])
+        # pipeline-schedule facts (executor scheduled-scan census): the
+        # per-step bubble fraction of the schedule the step ACTUALLY
+        # ran — exact per-tick accounting from the lowering's consumed
+        # tables, so a telemetry reader can line perf regressions up
+        # against schedule choice without reopening the plan artifact
+        if self._pipelined:
+            try:
+                from ..framework.executor import last_pipeline_report
+                prep = last_pipeline_report()
+            except Exception:
+                prep = {}
+            if prep.get("bubble_frac") is not None:
+                rec["bubble_frac"] = round(float(prep["bubble_frac"]), 6)
+                rec["pipe_schedule"] = prep.get("family")
         exposed_s = self.static.get("exposed_comm_s_per_step")
         if exposed_s is not None:
             # share of this step's measured wall the statically-priced
@@ -410,6 +428,9 @@ def validate_jsonl(path: str) -> Dict[str, Any]:
         if s.get("exposed_comm_frac") is not None and \
                 not (0.0 <= s["exposed_comm_frac"] <= 1.0):
             raise ValueError(f"exposed_comm_frac out of [0,1]: {s}")
+        if s.get("bubble_frac") is not None and \
+                not (0.0 <= s["bubble_frac"] <= 1.0):
+            raise ValueError(f"bubble_frac out of [0,1]: {s}")
         if "skipped" in s and not isinstance(s["skipped"], bool):
             raise ValueError(f"skipped must be a bool: {s}")
         if s.get("loss_scale") is not None and \

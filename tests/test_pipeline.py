@@ -1,11 +1,13 @@
 """Pipeline parallelism + rematerialization as planner dimensions.
 
 Covers the framework/pipe.py rewrites (liveness-driven stage cuts, the
-1F1B schedule, remat planning), the executor's microbatched/1F1B
-lowerings (gradient-merge bitwise composition, pp-mesh parity), the
-extended (data, fsdp, tp, pipe, remat) planner with its 0-compile and
-budget-flip contracts, the new analysis diagnostics, and the
-``PIPE_SEARCH_r17.json`` artifact contract."""
+schedule family simulator — 1F1B, interleaved-1F1B, zero-bubble B/W
+split — remat planning, pipe-axis weight sharding), the executor's
+microbatched/scheduled lowerings (gradient-merge bitwise composition,
+pp-mesh parity, census idle == simulator bubble ticks), the extended
+(data, fsdp, tp, pipe, remat) × schedule planner with its 0-compile and
+budget-flip contracts, the new analysis diagnostics, the telemetry
+bubble fraction, and the ``PIPE_SEARCH_r21.json`` artifact contract."""
 
 import json
 import os
@@ -133,7 +135,12 @@ def test_schedule_1f1b_shape_and_alternation():
             if s < S - 1:
                 assert t == btick[(s + 1, m)] + 1
         assert 1 <= sch["slots"] <= S
-        assert sch["bubble_frac"] == (S - 1) / M
+        # exact per-tick accounting (replaces the analytic (S-1)/M):
+        # 1F1B idles 2·S·(S-1) rank-ticks regardless of M
+        assert sch["idle_slots"] == 2 * S * (S - 1)
+        assert sch["bubble_ticks"] == sch["idle_slots"]
+        assert sch["bubble_frac"] == sch["idle_slots"] / (
+            sch["ticks"] * S)
 
 
 def test_apply_pipeline_idempotent_and_stamps():
@@ -551,12 +558,355 @@ def test_mesh_layout_pipe_axis_roundtrip():
 
 
 # ---------------------------------------------------------------------------
+# Pipeline v2: the schedule family simulator
+# ---------------------------------------------------------------------------
+
+
+def test_schedule_simulator_grid_invariants():
+    """Every (family, S, M, v) cell: unit completeness, one unit per
+    (tick, rank) slot, dependency order straight off the order table,
+    exact idle accounting, and bubble ticks non-increasing in M."""
+    from paddle_tpu.framework.pipe import simulate_schedule
+
+    for S in (2, 4, 8):
+        for family, v in (("1f1b", 1), ("interleaved", 2),
+                          ("zero_bubble", 1)):
+            prev_bubble = None
+            for M in (1, 2, 8, 16):
+                sch = simulate_schedule(family, S, M, chunks=v)
+                V = sch["num_stages"]
+                assert V == S * v and sch["num_ranks"] == S
+                order = sch["order"]
+                # unit completeness: F/B (+W for zero-bubble; stage 0's
+                # whole backward IS its W) each exactly once per
+                # (virtual stage, microbatch)
+                units = {(k, ph, m) for _, k, ph, m in order}
+                if family == "zero_bubble":
+                    expect = {(k, ph, m) for k in range(V)
+                              for m in range(M)
+                              for ph in (("F", "W") if k == 0
+                                         else ("F", "B", "W"))}
+                else:
+                    expect = {(k, ph, m) for k in range(V)
+                              for m in range(M) for ph in ("F", "B")}
+                assert units == expect and len(order) == len(expect)
+                # one unit per (tick, rank) slot
+                slots = [(t, k % S) for t, k, ph, m in order]
+                assert len(slots) == len(set(slots))
+                # dependency order from the table itself
+                tick = {(k, ph, m): t for t, k, ph, m in order}
+                for (k, ph, m), t in tick.items():
+                    if ph == "F" and k > 0:
+                        assert t > tick[(k - 1, "F", m)]
+                    if ph == "B":
+                        assert t > tick[(k, "F", m)]
+                        if (k + 1, "B", m) in tick:
+                            assert t > tick[(k + 1, "B", m)]
+                    if ph == "W":
+                        dep = (k, "B", m) if k > 0 else (1, "B", m)
+                        if dep in tick:
+                            assert t >= tick[dep]
+                # exact idle accounting — the census-equality quantity
+                assert sch["idle_slots"] == sch["ticks"] * S - len(order)
+                assert sch["bubble_frac"] <= 1.0
+                if prev_bubble is not None:
+                    assert sch["bubble_ticks"] <= prev_bubble + 1e-9, \
+                        f"{family} S{S}: bubble grew with M"
+                prev_bubble = sch["bubble_ticks"]
+
+
+def test_schedule_family_ordering():
+    """1F1B bubbles are constant in M (2·S·(S−1)); interleaved v=2
+    strictly beats it from M ≥ 2 (ties at M = 1); zero-bubble beats
+    interleaved everywhere on the grid."""
+    from paddle_tpu.framework.pipe import simulate_schedule
+
+    for S in (2, 4, 8):
+        for M in (1, 2, 8, 16):
+            f1 = simulate_schedule("1f1b", S, M)
+            iv = simulate_schedule("interleaved", S, M, chunks=2)
+            zb = simulate_schedule("zero_bubble", S, M)
+            assert f1["bubble_ticks"] == 2 * S * (S - 1)
+            if M == 1:
+                assert iv["bubble_ticks"] == f1["bubble_ticks"]
+            else:
+                assert iv["bubble_ticks"] < f1["bubble_ticks"]
+            assert zb["bubble_ticks"] < iv["bubble_ticks"]
+
+
+def test_enumerate_schedules_ranked():
+    from paddle_tpu.framework.pipe import enumerate_schedules
+
+    cands = enumerate_schedules(4, 8)
+    assert {c["family"] for c in cands} == {"1f1b", "interleaved",
+                                            "zero_bubble"}
+    ticks = [c["bubble_ticks"] for c in cands]
+    assert ticks == sorted(ticks)
+    assert cands[0]["family"] == "zero_bubble"
+
+
+# ---------------------------------------------------------------------------
+# Pipeline v2: scheduled lowering parity + the idle-tick census
+# ---------------------------------------------------------------------------
+
+
+def _pipe_report():
+    from paddle_tpu.framework.executor import last_pipeline_report
+    rep = last_pipeline_report()
+    assert rep, "no pipelined run recorded a report"
+    return rep
+
+
+def test_interleaved_schedule_parity_and_census():
+    lb, wb = _train(lambda p: set_microbatches(p, 4))
+    lp, wp = _train(lambda p: apply_pipeline(p, 2, 4,
+                                             schedule="interleaved",
+                                             chunks=2),
+                    mesh_axes=(("pp", 2),))
+    a = np.asarray(lb, dtype=np.float64).ravel()
+    b = np.asarray(lp, dtype=np.float64).ravel()
+    assert np.abs(a - b).max() <= 1e-6
+    assert np.abs(wb - wp).max() <= 1e-6
+    rep = _pipe_report()
+    assert rep["family"] == "interleaved" and rep["chunks"] == 2
+    assert rep["num_virtual_stages"] == 4
+    assert rep["census_idle_slots"] == rep["sim_idle_slots"]
+    assert rep["idle_branch_flop_prims"] == []
+
+
+def test_zero_bubble_schedule_parity_and_census():
+    lb, wb = _train(lambda p: set_microbatches(p, 4))
+    lp, wp = _train(lambda p: apply_pipeline(p, 4, 4,
+                                             schedule="zero_bubble"),
+                    mesh_axes=(("pp", 4),))
+    a = np.asarray(lb, dtype=np.float64).ravel()
+    b = np.asarray(lp, dtype=np.float64).ravel()
+    assert np.abs(a - b).max() <= 1e-6
+    assert np.abs(wb - wp).max() <= 1e-6
+    rep = _pipe_report()
+    assert rep["family"] == "zero_bubble"
+    assert rep["census_idle_slots"] == rep["sim_idle_slots"]
+    assert rep["idle_branch_flop_prims"] == []
+
+
+def test_1f1b_census_idle_equals_simulator():
+    """The masked idle half-tick is gone: the lowering's per-tick busy
+    census equals the simulator's idle slots EXACTLY, and the idle
+    branch jaxpr contains zero FLOP primitives."""
+    _train(lambda p: apply_pipeline(p, 2, 4), mesh_axes=(("pp", 2),))
+    rep = _pipe_report()
+    assert rep["family"] == "1f1b"
+    assert rep["census_idle_slots"] == rep["sim_idle_slots"] == 4
+    assert rep["idle_branch_flop_prims"] == []
+    assert rep["bubble_frac"] == 4 / (rep["ticks"] * 2)
+
+
+def test_pipe_weight_sharding_parity_and_specs():
+    """shard_weights=True: pipe-axis ShardSpecs on params + coupled
+    optimizer state, same losses/weights ≤ 1e-6, and the lowering
+    census reports the sharded set."""
+    from paddle_tpu.framework.pipe import apply_pipe_weight_sharding
+
+    lb, wb = _train(lambda p: apply_pipeline(p, 2, 4),
+                    mesh_axes=(("pp", 2),))
+    specs = {}
+
+    def mutate(p):
+        apply_pipeline(p, 2, 4, shard_weights=True, min_shard_numel=1)
+        blk = p.global_block()
+        for prm in p.all_parameters():
+            if prm.dist_attr:
+                specs[prm.name] = tuple(prm.dist_attr)
+        # Adam moments coupled to a sharded param carry the same spec
+        m = next((v for n, v in blk.vars.items()
+                  if n.startswith("w1_moment1")), None)
+        assert m is not None
+        assert tuple(m.dist_attr or ()) == specs.get("w1")
+
+    ls, ws = _train(mutate, mesh_axes=(("pp", 2),))
+    assert specs and any("pp" in s for s in specs.values())
+    a = np.asarray(lb, dtype=np.float64).ravel()
+    b = np.asarray(ls, dtype=np.float64).ravel()
+    assert np.abs(a - b).max() <= 1e-6
+    assert np.abs(wb - ws).max() <= 1e-6
+    rep = _pipe_report()
+    assert rep["sharded_params"], "lowering saw no sharded params"
+
+
+def test_pipe_weight_sharding_divides_state_census():
+    """memory_analysis divides resident persistable bytes by the pipe
+    axis for the sharded set."""
+    from paddle_tpu.framework.memory_analysis import analyze_memory
+
+    def build(shard):
+        reset_default_programs()
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            loss = _model()
+            fluid.optimizer.Adam(5e-3).minimize(loss)
+        apply_pipeline(main, 2, 4, shard_weights=shard,
+                       min_shard_numel=1)
+        fs = {"x": ((8, 16), "float32"), "label": ((8, 1), "float32")}
+        return analyze_memory(main, feed_shapes=fs,
+                              fetch_names=[loss.name],
+                              mesh_axes={"pp": 2})
+
+    rep_bytes = build(False).state_bytes
+    sh_bytes = build(True).state_bytes
+    assert sh_bytes < rep_bytes
+    # the MLP's matrices all split: close to ÷2
+    assert sh_bytes <= rep_bytes * 0.6
+
+
+# ---------------------------------------------------------------------------
+# Pipeline v2: schedule diagnostics
+# ---------------------------------------------------------------------------
+
+
+def _pipelined_program():
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        loss = _model()
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    apply_pipeline(main, 2, 2)
+    blk = main.global_block()
+    (bw,) = [op for op in blk.ops if op.type == "backward"]
+    return main, bw
+
+
+def test_pipe_schedule_order_diagnostic():
+    from paddle_tpu.framework.analysis import (PIPE_SCHEDULE_ORDER,
+                                               verify_program)
+    main, bw = _pipelined_program()
+    assert not verify_program(main).by_code(PIPE_SCHEDULE_ORDER)
+    order = [list(u) for u in bw.attrs["pipe_schedule_order"]]
+    # yank the first backward unit to tick 0 — before its own forward
+    for u in order:
+        if u[2] == "B":
+            u[0] = 0
+            break
+    bw.attrs["pipe_schedule_order"] = [tuple(u) for u in order]
+    hits = verify_program(main).by_code(PIPE_SCHEDULE_ORDER)
+    assert hits and all(h.severity == "error" for h in hits)
+
+
+def test_pipe_ring_overflow_diagnostic():
+    from paddle_tpu.framework.analysis import (PIPE_RING_OVERFLOW,
+                                               verify_program)
+    main, bw = _pipelined_program()
+    assert not verify_program(main).by_code(PIPE_RING_OVERFLOW)
+    bw.attrs["pipe_ring_slots"] = [0, 0]
+    hits = verify_program(main).by_code(PIPE_RING_OVERFLOW)
+    assert hits and all(h.severity == "error" for h in hits)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline v2: the schedule-aware planner
+# ---------------------------------------------------------------------------
+
+
+def test_planner_schedule_auto_picks_best_without_compiling(monkeypatch):
+    """pipe_schedule="auto": every pipe row is priced with its
+    bubble-ranked best schedule family — and the whole search runs with
+    Executor._compile monkeypatched to raise, proving the pricing never
+    leaves the static path."""
+    from paddle_tpu.framework import executor as executor_mod
+
+    main, _, loss, fs, _ = _bert_tiny_train()
+    bs = BuildStrategy()
+    bs.fuse_all_reduce_ops = True
+
+    def boom(*a, **k):
+        raise AssertionError("plan search attempted a compile")
+
+    monkeypatch.setattr(executor_mod.Executor, "_compile", boom)
+    plan = plan_sharding(main, 4, loss_name=loss.name, feed_shapes=fs,
+                         fetch_names=[loss.name], build_strategy=bs,
+                         max_pipe=2, num_microbatches=4,
+                         pipe_schedule="auto")
+    assert plan.pipe_schedule == "auto"
+    rows = [c for c in plan.configs if c.layout.pipe > 1
+            and not c.error]
+    assert rows
+    for c in rows:
+        summary = c.pipe_report["schedule_summary"]
+        cands = c.pipe_report["schedule_candidates"]
+        assert len(cands) >= 3
+        assert summary["bubble_ticks"] == \
+            min(x["bubble_ticks"] for x in cands)
+        # the priced bubble is the winner's EXACT per-tick fraction,
+        # not the analytic (pipe-1)/M
+        assert c.exposed["bubble_frac"] == \
+            pytest.approx(summary["bubble_frac"])
+
+
+def test_planner_pipe1_rows_schedule_invariant():
+    """pipe = 1 pricing is bit-stable across schedule knobs: the
+    schedule only exists on pipe > 1 rows."""
+    main, _, loss, fs, _ = _bert_tiny_train()
+    bs = BuildStrategy()
+    bs.fuse_all_reduce_ops = True
+
+    def rows(schedule):
+        plan = plan_sharding(main, 4, loss_name=loss.name,
+                             feed_shapes=fs, fetch_names=[loss.name],
+                             build_strategy=bs, max_pipe=2,
+                             num_microbatches=4,
+                             pipe_schedule=schedule)
+        return {tuple(sorted(c.layout.sizes.items())): c.as_dict()
+                for c in plan.configs if c.layout.pipe == 1}
+
+    base, auto = rows("1f1b"), rows("auto")
+    assert base.keys() == auto.keys()
+    for k in base:
+        assert base[k] == auto[k]
+
+
+# ---------------------------------------------------------------------------
+# Pipeline v2: telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_records_bubble_frac(tmp_path):
+    """A pipelined step's telemetry record carries the schedule's
+    measured bubble fraction; validate_jsonl accepts it."""
+    from paddle_tpu.observability.recorder import (TelemetryRecorder,
+                                                   validate_jsonl)
+
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        loss = _model()
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    apply_pipeline(main, 2, 4)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("pp",))
+    prog = CompiledProgram(main).with_mesh(mesh, loss_name=loss.name,
+                                           batch_axis="dp",
+                                           build_strategy=BuildStrategy())
+    exe = fluid.Executor(fluid.CPUPlace())
+    path = str(tmp_path / "t.jsonl")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with TelemetryRecorder(path, program=main) as rec:
+            (l,) = exe.run(prog, feed={"x": _XS[0], "label": _YS[0]},
+                           fetch_list=[loss])
+            r = rec.record_step(wall_ns=1e9, loss=float(np.mean(l)))
+    from paddle_tpu.framework.pipe import simulate_schedule
+    expect = simulate_schedule("1f1b", 2, 4)["bubble_frac"]
+    assert r["pipe_schedule"] == "1f1b"
+    assert r["bubble_frac"] == pytest.approx(expect, abs=1e-6)
+    facts = validate_jsonl(path)
+    assert facts["steps"] == 1
+
+
+# ---------------------------------------------------------------------------
 # the artifact contract (tools/pipe_probe.py)
 # ---------------------------------------------------------------------------
 
 
 def test_pipe_search_artifact_contract():
-    path = os.path.join(REPO, "PIPE_SEARCH_r17.json")
+    path = os.path.join(REPO, "PIPE_SEARCH_r21.json")
     assert os.path.exists(path), "run tools/pipe_probe.py"
     with open(path) as f:
         art = json.load(f)

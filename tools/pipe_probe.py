@@ -1,32 +1,48 @@
-"""Pipeline-parallelism + rematerialization probe: prove the 1F1B
-stage-cut lowering and the extended planner on the BERT-tiny workload
-and emit the auditable ``PIPE_SEARCH_r17.json`` artifact.
+"""Pipeline-v2 probe: prove the scheduled stage-cut lowering (1F1B,
+interleaved, zero-bubble), pipe-axis weight sharding, and the
+schedule-aware planner on the BERT-tiny workload and emit the auditable
+``PIPE_SEARCH_r21.json`` artifact.
 
-Four legs (all CPU, 8 virtual devices; every assertion re-runs in
+Seven legs (all CPU, 8 virtual devices; every assertion re-runs in
 tier-1 via tests/test_pipeline.py's artifact-contract test):
 
-* **parity** — the SAME stage-cut program trains on dp2·pp2 (1F1B over
-  the ``pp`` mesh axis, through the PREPARED fast path) and on a plain
-  dp2 mesh (the pipe = 1 degenerate: stages sequential, microbatches
-  still accumulated); per-step losses must agree ≤ 1e-6 over ≥ 5 steps.
-  A pp4 leg (4 stages, no data axis) checks the deeper pipeline against
-  the single-device microbatched baseline.
+* **parity** — the SAME stage-cut program trains on dp2·pp2 (scheduled
+  scan over the ``pp`` mesh axis, through the PREPARED fast path) and
+  on a plain dp2 mesh (the pipe = 1 degenerate: stages sequential,
+  microbatches still accumulated); per-step losses must agree ≤ 1e-6
+  over ≥ 5 steps.  A pp4 leg (4 stages, no data axis) checks the
+  deeper pipeline against the single-device microbatched baseline.
+* **schedules** — every schedule family trains the SAME BERT-tiny
+  program on dp2·pp2 and on pp4/M8 to ≤ 1e-6 loss parity with the
+  1F1B row; each leg's lowering census must show census idle ticks ==
+  the simulator's idle slots EXACTLY and a no-op idle branch whose
+  jaxpr contains zero arithmetic primitives (the masked idle half-tick
+  is gone).  At pp4/M8 the measured bubble ticks must order
+  interleaved(v2) < 1f1b and zero_bubble < interleaved.
 * **census** — the stage partition (op counts, FLOPs balance), per-cut
-  boundary tensors and their statically priced ppermute wire bytes (the
-  ``pipe_stage_boundary`` op's ``wire()`` spec), and the full static
-  1F1B schedule table (``pipe.schedule_1f1b`` — warm-up, steady
-  one-forward-one-backward alternation, cooldown) the lowering's scan
-  follows.
+  boundary tensors and their statically priced ppermute wire bytes,
+  and the full static schedule table the lowering's scan follows.
+* **weight sharding** — ``apply_pipeline(..., shard_weights=True)``
+  stamps pipe-axis ShardSpecs on params/grads/optimizer state: the
+  pp4 run keeps ≤ 1e-6 loss parity with the replicated pp4 row while
+  the static resident census divides the sharded persistable bytes by
+  the pipe degree.
+* **reshard** — a pp4 weight-sharded checkpoint restores onto a pp2
+  weight-sharded program mid-run (the pp↔pp spec flip planned by
+  framework/reshard.py, 0 compiles) and the continuation's losses stay
+  ≤ 1e-6 of the uninterrupted pp4 reference.
 * **plan search** — ``plan_sharding`` over (data, fsdp, tp, pipe) with
-  ``max_pipe=4`` × microbatching: every config priced statically, pipe
-  configs carrying the ``(pipe−1)/M`` bubble term, and ZERO executor
-  compiles during the whole search (monitor stat delta).
+  ``max_pipe=4`` × microbatching × ``pipe_schedule="auto"``: every
+  config priced statically with its best schedule family's EXACT
+  per-tick bubble fraction (candidates recorded per row), and ZERO
+  executor compiles during the whole search (monitor stat delta).
 * **budget flip** — with ``hbm_budget_gb`` forced below every config's
   peak, the base rows all reject; ``remat=True`` prices rematerialized
-  siblings (recompute checkpoints at the liveness-identified residual
-  minima) and at least one flips to an ADMITTED config with the
-  recompute FLOPs delta recorded — an over-budget reject becomes a
-  fitting plan instead of a failure.
+  siblings and at least one flips to an ADMITTED config.
+
+A regression gate compares against the committed ``PIPE_SEARCH_r17``
+artifact: the best pp2 bubble fraction and the search breadth may only
+improve.
 
 Usage:
     PYTHONPATH=/root/repo python tools/pipe_probe.py [out.json]
@@ -36,10 +52,13 @@ Usage:
 import json
 import os
 import sys
+import tempfile
 
-ARTIFACT = "PIPE_SEARCH_r17.json"
+ARTIFACT = "PIPE_SEARCH_r21.json"
+PREV_ARTIFACT = "PIPE_SEARCH_r17.json"
 STEPS = 5
 MICROBATCHES = 4
+GRID_MICROBATCHES = 8
 
 
 def _env8():
@@ -64,119 +83,199 @@ def _build(cfg):
     return main, startup, loss
 
 
-def _train(main, startup, loss, mesh_axes, build_strategy):
-    """STEPS batches through the PREPARED fast path; returns the
-    per-step loss vectors (fetch merge over the data axis)."""
+def _feed_shapes(cfg):
     import numpy as np
-    import jax
-    from jax.sharding import Mesh
-    import paddle_tpu.fluid as fluid
-    from paddle_tpu.framework.compiler import CompiledProgram
+    from paddle_tpu.models import bert
+    batch = bert.make_fake_parallel_batch(np.random.RandomState(0), cfg,
+                                          batch_size=8, seq_len=64)
+    return {k: (tuple(v.shape), str(v.dtype)) for k, v in batch.items()}
 
-    prog = main
-    if mesh_axes:
-        names = tuple(a for a, _ in mesh_axes)
-        sizes = tuple(n for _, n in mesh_axes)
-        ndev = int(np.prod(sizes))
-        devs = np.array(jax.devices()[:ndev]).reshape(sizes)
-        mesh = Mesh(devs, names)
-        prog = CompiledProgram(main).with_mesh(
-            mesh, loss_name=loss.name, batch_axis="dp",
-            build_strategy=build_strategy)
-    exe = fluid.Executor(fluid.CPUPlace())
-    scope = fluid.Scope()
+
+def _bert_cfg():
     from paddle_tpu.models import bert
     cfg = bert.BertConfig.tiny()
     cfg.hidden_dropout_prob = 0.0
     cfg.attention_probs_dropout_prob = 0.0
-    losses = []
+    return cfg
+
+
+def _bs():
+    from paddle_tpu.framework.compiler import BuildStrategy
+    b = BuildStrategy()
+    b.fuse_all_reduce_ops = True
+    return b
+
+
+def _compiled(main, loss, mesh_axes):
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+    from paddle_tpu.framework.compiler import CompiledProgram
+    if not mesh_axes:
+        return main
+    names = tuple(a for a, _ in mesh_axes)
+    sizes = tuple(n for _, n in mesh_axes)
+    ndev = int(np.prod(sizes))
+    devs = np.array(jax.devices()[:ndev]).reshape(sizes)
+    return CompiledProgram(main).with_mesh(
+        Mesh(devs, names), loss_name=loss.name, batch_axis="dp",
+        build_strategy=_bs())
+
+
+def _build_plain(cfg):
+    """The non-parallel BERT head: params carry NO tp ShardSpecs, so
+    pipe-axis weight sharding can claim every divisible matrix."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.framework.core import (Program,
+                                           reset_default_programs)
+    from paddle_tpu.models import bert
+    reset_default_programs()
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        feeds, total, mlm, nsp = bert.build_pretrain_network(cfg)
+        fluid.optimizer.Adam(1e-4).minimize(total)
+    return main, startup, total
+
+
+def _feed_shapes_plain(cfg):
+    import numpy as np
+    from paddle_tpu.models import bert
+    batch = bert.make_fake_batch(np.random.RandomState(0), cfg,
+                                 batch_size=8, seq_len=64)
+    return {k: (tuple(v.shape), str(v.dtype)) for k, v in batch.items()}
+
+
+def _train(main, startup, loss, mesh_axes, start=0, steps=STEPS,
+           scope=None, save_dir=None, save_at=None, load_dir=None,
+           plain=False):
+    """``steps`` seeded batches through the PREPARED fast path from
+    step index ``start``; optionally checkpoints after the step whose
+    GLOBAL index is ``save_at``, or restores from ``load_dir`` before
+    running.  Returns (per-step loss vectors, scope, train_status)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import io
+
+    prog = _compiled(main, loss, mesh_axes)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = scope or fluid.Scope()
+    cfg = _bert_cfg()
+    from paddle_tpu.models import bert
+    make = bert.make_fake_batch if plain else bert.make_fake_parallel_batch
+    losses, st = [], None
     with fluid.scope_guard(scope):
         exe.run(startup)
+        if load_dir is not None:
+            st = io.load_checkpoint(exe, load_dir, main_program=main,
+                                    scope=scope)
         prepared = exe.prepare(prog, fetch_list=[loss], scope=scope)
-        for i in range(STEPS):
-            batch = bert.make_fake_parallel_batch(
-                np.random.RandomState(100 + i), cfg, batch_size=8,
-                seq_len=64)
+        for i in range(start, start + steps):
+            batch = make(np.random.RandomState(100 + i), cfg,
+                         batch_size=8, seq_len=64)
             (h,) = prepared.run(batch)
             losses.append(np.asarray(h.numpy()).ravel().tolist())
+            if save_dir is not None and i == save_at:
+                io.save_checkpoint(exe, save_dir,
+                                   io.TrainStatus(i, i), main)
         prepared.close()
-    return losses
+    return losses, scope, st
+
+
+def _max_delta(a, b):
+    return max(abs(x - y) for ra, rb in zip(a, b)
+               for x, y in zip(ra, rb))
 
 
 def run_parity():
     """dp2·pp2 and pp4 vs their non-pipelined microbatched baselines."""
-    import numpy as np
-    from paddle_tpu.framework.compiler import BuildStrategy
     from paddle_tpu.framework.pipe import apply_pipeline, set_microbatches
-    from paddle_tpu.models import bert
 
-    cfg = bert.BertConfig.tiny()
-    cfg.hidden_dropout_prob = 0.0
-    cfg.attention_probs_dropout_prob = 0.0
-    batch = bert.make_fake_parallel_batch(np.random.RandomState(0), cfg,
-                                          batch_size=8, seq_len=64)
-    feed_shapes = {k: (tuple(v.shape), str(v.dtype))
-                   for k, v in batch.items()}
-
-    def bs():
-        b = BuildStrategy()
-        b.fuse_all_reduce_ops = True
-        return b
-
+    cfg = _bert_cfg()
+    feed_shapes = _feed_shapes(cfg)
     legs = {}
     reports = {}
-    # dp2 baseline (microbatched, no stages)
     main, startup, loss = _build(cfg)
     set_microbatches(main, MICROBATCHES)
-    legs["dp2_base"] = _train(main, startup, loss, [("dp", 2)], bs())
-    # dp2 x pp2
+    legs["dp2_base"] = _train(main, startup, loss, [("dp", 2)])[0]
     main, startup, loss = _build(cfg)
     reports["pp2"] = apply_pipeline(main, 2, MICROBATCHES,
                                     feed_shapes=feed_shapes)
     legs["dp2_pp2"] = _train(main, startup, loss,
-                             [("dp", 2), ("pp", 2)], bs())
-    # single-device baseline
+                             [("dp", 2), ("pp", 2)])[0]
     main, startup, loss = _build(cfg)
     set_microbatches(main, MICROBATCHES)
-    legs["dp1_base"] = _train(main, startup, loss, [], bs())
-    # pp4
+    legs["dp1_base"] = _train(main, startup, loss, [])[0]
     main, startup, loss = _build(cfg)
     reports["pp4"] = apply_pipeline(main, 4, MICROBATCHES,
                                     feed_shapes=feed_shapes)
-    legs["pp4"] = _train(main, startup, loss, [("pp", 4)], bs())
-
-    def max_delta(a, b):
-        return max(abs(x - y) for ra, rb in zip(a, b)
-                   for x, y in zip(ra, rb))
+    legs["pp4"] = _train(main, startup, loss, [("pp", 4)])[0]
 
     parity = {
         "steps": STEPS,
         "num_microbatches": MICROBATCHES,
         "losses": legs,
-        "dp2_pp2_max_loss_delta": max_delta(legs["dp2_base"],
-                                            legs["dp2_pp2"]),
-        "pp4_max_loss_delta": max_delta(legs["dp1_base"], legs["pp4"]),
+        "dp2_pp2_max_loss_delta": _max_delta(legs["dp2_base"],
+                                             legs["dp2_pp2"]),
+        "pp4_max_loss_delta": _max_delta(legs["dp1_base"], legs["pp4"]),
         "bound": 1e-6,
         "prepared_fast_path": True,
     }
     return parity, reports
 
 
+def run_schedules():
+    """Every schedule family on dp2·pp2 (M4) and pp4 (M8): loss parity
+    vs the 1F1B row, census idle == simulator idle, zero-FLOP idle
+    branch, and the measured pp4/M8 bubble-tick ordering."""
+    from paddle_tpu.framework.executor import last_pipeline_report
+    from paddle_tpu.framework.pipe import apply_pipeline
+
+    cfg = _bert_cfg()
+    feed_shapes = _feed_shapes(cfg)
+    grid = []
+
+    def leg(pp, M, mesh_axes, family, chunks):
+        main, startup, loss = _build(cfg)
+        apply_pipeline(main, pp, M, feed_shapes=feed_shapes,
+                       schedule=family, chunks=chunks)
+        losses = _train(main, startup, loss, mesh_axes)[0]
+        rep = last_pipeline_report()
+        grid.append({
+            "family": family, "chunks": chunks, "pp": pp,
+            "num_microbatches": M,
+            "losses": losses,
+            "ticks": rep["ticks"],
+            "census_idle_slots": rep["census_idle_slots"],
+            "sim_idle_slots": rep["sim_idle_slots"],
+            "bubble_ticks": rep["bubble_ticks"],
+            "bubble_frac": rep["bubble_frac"],
+            "ring_slots": rep["ring_slots"],
+            "idle_branch_flop_prims": rep["idle_branch_flop_prims"],
+        })
+        return losses
+
+    for pp, M, mesh_axes in ((2, MICROBATCHES, [("dp", 2), ("pp", 2)]),
+                             (4, GRID_MICROBATCHES, [("pp", 4)])):
+        base = leg(pp, M, mesh_axes, "1f1b", 1)
+        for family, chunks in (("interleaved", 2), ("zero_bubble", 1)):
+            losses = leg(pp, M, mesh_axes, family, chunks)
+            grid[-1]["max_loss_delta_vs_1f1b"] = _max_delta(base, losses)
+    return {
+        "steps": STEPS,
+        "bound": 1e-6,
+        "grid": grid,
+    }
+
+
 def run_census(reports):
     """Static stage/boundary/wire census of the pipelined programs."""
-    import numpy as np
     from paddle_tpu.framework.memory_analysis import \
         collective_wire_summary
-    from paddle_tpu.framework.pipe import apply_pipeline
-    from paddle_tpu.models import bert
+    from paddle_tpu.framework.pipe import apply_pipeline, \
+        enumerate_schedules
 
-    cfg = bert.BertConfig.tiny()
-    cfg.hidden_dropout_prob = 0.0
-    cfg.attention_probs_dropout_prob = 0.0
-    batch = bert.make_fake_parallel_batch(np.random.RandomState(0), cfg,
-                                          batch_size=8, seq_len=64)
-    feed_shapes = {k: (tuple(v.shape), str(v.dtype))
-                   for k, v in batch.items()}
+    cfg = _bert_cfg()
+    feed_shapes = _feed_shapes(cfg)
     main, startup, loss = _build(cfg)
     rep = apply_pipeline(main, 2, MICROBATCHES, feed_shapes=feed_shapes)
     wire = collective_wire_summary(
@@ -200,15 +299,119 @@ def run_census(reports):
         "schedule_1f1b": {
             "ticks": sched["ticks"],
             "slots": sched["slots"],
+            "ct_slots": sched["ct_slots"],
+            "idle_slots": sched["idle_slots"],
+            "bubble_ticks": sched["bubble_ticks"],
             "bubble_frac": sched["bubble_frac"],
             "order": [list(t) for t in sched["order"]],
         },
+        "schedule_candidates_pp4_M8": [
+            {"family": c["family"], "chunks": c["chunks"],
+             "ticks": c["ticks"], "idle_slots": c["idle_slots"],
+             "bubble_ticks": c["bubble_ticks"],
+             "bubble_frac": c["bubble_frac"]}
+            for c in enumerate_schedules(4, GRID_MICROBATCHES)],
+    }
+
+
+def run_weight_sharding():
+    """pp4 with pipe-axis weight sharding: loss parity vs the
+    replicated pp4 row + the ÷pipe resident-bytes census."""
+    from paddle_tpu.framework.executor import last_pipeline_report
+    from paddle_tpu.framework.memory_analysis import analyze_memory
+    from paddle_tpu.framework.pipe import apply_pipeline
+
+    cfg = _bert_cfg()
+    feed_shapes = _feed_shapes_plain(cfg)
+    mesh_axes = {"dp": 1, "pp": 4}
+
+    def build(shard):
+        main, startup, loss = _build_plain(cfg)
+        rep = apply_pipeline(main, 4, MICROBATCHES,
+                             feed_shapes=feed_shapes,
+                             shard_weights=shard, min_shard_numel=1)
+        return main, startup, loss, rep
+
+    main, startup, loss, _ = build(False)
+    base = _train(main, startup, loss, [("pp", 4)], plain=True)[0]
+    est_rep = analyze_memory(main, feed_shapes=feed_shapes,
+                             fetch_names=[loss.name],
+                             mesh_axes=mesh_axes)
+    main, startup, loss, rep = build(True)
+    sharded = _train(main, startup, loss, [("pp", 4)], plain=True)[0]
+    census = last_pipeline_report()
+    est_sh = analyze_memory(main, feed_shapes=feed_shapes,
+                            fetch_names=[loss.name],
+                            mesh_axes=mesh_axes)
+    ws = rep["weight_sharding"]
+    # the ÷pipe census on exactly the sharded set: every stamped
+    # persistable (param + same-shaped optimizer state) divides by 4
+    block = main.global_block()
+    shard_names = set(ws["sharded"])
+    coupled = [v for v in block.vars.values()
+               if getattr(v, "persistable", False) and v.dist_attr
+               and any(n in str(v.name) for n in shard_names)]
+    return {
+        "pp": 4, "num_microbatches": MICROBATCHES,
+        "bound": 1e-6,
+        "max_loss_delta_vs_replicated": _max_delta(base, sharded),
+        "sharded_params": len(ws["sharded"]),
+        "skipped_params": len(ws["skipped"]),
+        "sharded_persistables": len(coupled),
+        "pipe_degree": ws["pipe_degree"],
+        "state_bytes_replicated": int(est_rep.state_bytes),
+        "state_bytes_sharded": int(est_sh.state_bytes),
+        "lowering_sharded_params": census["sharded_params"],
+    }
+
+
+def run_reshard():
+    """pp4 weight-sharded checkpoint → pp2 weight-sharded restore
+    mid-run: the continuation must track the uninterrupted pp4
+    reference ≤ 1e-6, with 0 compiles during the restore."""
+    from paddle_tpu.framework.mesh_layout import MeshLayout
+    from paddle_tpu.framework.pipe import apply_pipeline
+    from paddle_tpu.monitor import stat
+
+    cfg = _bert_cfg()
+    feed_shapes = _feed_shapes_plain(cfg)
+    cut = 2
+
+    def build(pp, data):
+        main, startup, loss = _build_plain(cfg)
+        apply_pipeline(main, pp, MICROBATCHES, feed_shapes=feed_shapes,
+                       shard_weights=True, min_shard_numel=1)
+        main._mesh_layout = MeshLayout(data=data, pipe=pp)
+        axes = ([("dp", data)] if data > 1 else []) + [("pp", pp)]
+        return main, startup, loss, axes
+
+    main, startup, loss, axes = build(4, 1)
+    ref = _train(main, startup, loss, axes, plain=True)[0]
+
+    with tempfile.TemporaryDirectory() as td:
+        main, startup, loss, axes = build(4, 1)
+        _train(main, startup, loss, axes, steps=cut,
+               save_dir=td, save_at=cut - 1, plain=True)
+        main2, startup2, loss2, axes2 = build(2, 1)
+        compiles_before = int(stat("executor_compile_count").get())
+        cont, _, st = _train(main2, startup2, loss2, axes2, start=cut,
+                             steps=STEPS - cut, load_dir=td, plain=True)
+        restore_compiles = int(stat("executor_compile_count").get()) \
+            - compiles_before
+    return {
+        "bound": 1e-6,
+        "checkpoint_step": cut - 1,
+        "pp4_to_pp2_max_loss_delta": _max_delta(ref[cut:], cont),
+        "resharded": st is not None and st.reshard is not None,
+        "reshard_steps_by_kind": (st.reshard or {}).get("steps_by_kind")
+        if st is not None else None,
+        "restored_step": st.step if st is not None else None,
     }
 
 
 def run_plan():
-    """The (data, fsdp, tp, pipe, remat) search + the forced budget
-    flip; returns (plan_dict, flip_dict, compile_delta)."""
+    """The (data, fsdp, tp, pipe, remat) × schedule search + the forced
+    budget flip; returns (plan_dict, flip_dict, compile_delta)."""
     import numpy as np
     import paddle_tpu.fluid as fluid
     from paddle_tpu.framework.core import Program, reset_default_programs
@@ -237,6 +440,7 @@ def run_plan():
                           feed_shapes=feed_shapes,
                           fetch_names=[loss.name], build_strategy=bs,
                           max_pipe=4, num_microbatches=MICROBATCHES,
+                          pipe_schedule="auto",
                           module="dp8_bert_tiny_tp2_pipe")
     peaks = sorted(c.peak_bytes for c in probe.configs
                    if c.peak_bytes is not None)
@@ -248,7 +452,7 @@ def run_plan():
                          fetch_names=[loss.name],
                          hbm_budget_gb=budget_gb, build_strategy=bs,
                          max_pipe=4, num_microbatches=MICROBATCHES,
-                         remat=True,
+                         pipe_schedule="auto", remat=True,
                          module="dp8_bert_tiny_tp2_pipe")
     compile_delta = int(stat("executor_compile_count").get()) \
         - compiles_before
@@ -268,7 +472,32 @@ def run_plan():
              "num_segments": c.remat_plan.num_segments}
             for c in flipped],
     }
-    return plan.as_dict(), flip, compile_delta
+    return probe.as_dict(), plan.as_dict(), flip, compile_delta
+
+
+def regression_gate(art, repo):
+    """Bubble fraction and search breadth may only improve on the
+    committed r17 artifact."""
+    prev_path = os.path.join(repo, PREV_ARTIFACT)
+    if not os.path.exists(prev_path):
+        return {"previous": None}
+    with open(prev_path) as f:
+        prev = json.load(f)
+    prev_frac = prev["census"]["schedule_1f1b"]["bubble_frac"]
+    best_pp2 = min(g["bubble_frac"] for g in art["schedules"]["grid"]
+                   if g["pp"] == 2)
+    gate = {
+        "previous": PREV_ARTIFACT,
+        "r17_pp2_bubble_frac": prev_frac,
+        "r21_best_pp2_bubble_frac": best_pp2,
+        "r17_configs_priced": prev["plan"]["configs_priced"],
+        "r21_configs_priced": art["plan"]["configs_priced"],
+    }
+    assert best_pp2 <= prev_frac, \
+        f"pp2 bubble fraction regressed: {best_pp2} > {prev_frac}"
+    assert art["plan"]["configs_priced"] >= \
+        prev["plan"]["configs_priced"], "plan search breadth shrank"
+    return gate
 
 
 def check(art):
@@ -279,6 +508,34 @@ def check(art):
         f"dp2·pp2 loss parity {p['dp2_pp2_max_loss_delta']} > 1e-6"
     assert p["pp4_max_loss_delta"] <= p["bound"], \
         f"pp4 loss parity {p['pp4_max_loss_delta']} > 1e-6"
+
+    # the schedule grid: parity, exact idle-tick census equality, a
+    # genuinely compute-free idle branch, and the bubble ordering
+    sg = art["schedules"]
+    grid = sg["grid"]
+    fams = {(g["family"], g["pp"]) for g in grid}
+    assert {("1f1b", 2), ("interleaved", 2), ("zero_bubble", 2),
+            ("1f1b", 4), ("interleaved", 4),
+            ("zero_bubble", 4)} <= fams, f"schedule grid incomplete: {fams}"
+    for g in grid:
+        assert g["census_idle_slots"] == g["sim_idle_slots"], \
+            (f"{g['family']} pp{g['pp']}: census idle "
+             f"{g['census_idle_slots']} != simulator "
+             f"{g['sim_idle_slots']}")
+        assert g["idle_branch_flop_prims"] == [], \
+            (f"{g['family']} pp{g['pp']}: idle branch computes "
+             f"{g['idle_branch_flop_prims']}")
+        if "max_loss_delta_vs_1f1b" in g:
+            assert g["max_loss_delta_vs_1f1b"] <= sg["bound"], \
+                (f"{g['family']} pp{g['pp']} parity "
+                 f"{g['max_loss_delta_vs_1f1b']} > 1e-6")
+    bt = {g["family"]: g["bubble_ticks"] for g in grid
+          if g["pp"] == 4 and g["num_microbatches"] == GRID_MICROBATCHES}
+    assert bt["interleaved"] < bt["1f1b"], \
+        f"interleaved(v2) not fewer bubble ticks: {bt}"
+    assert bt["zero_bubble"] < bt["interleaved"], \
+        f"zero-bubble not fewer bubble ticks than interleaved: {bt}"
+
     c = art["census"]
     assert c["stages"] == 2 and len(c["cuts"]) == 1
     assert c["boundary_ops"] == 1 and c["pipe_grad_sync_ops"] >= 1
@@ -295,15 +552,51 @@ def check(art):
     phases = [t[2] for t in last_stage]
     assert phases == ["F", "B"] * M, \
         f"last stage is not 1F1B-alternating: {phases}"
-    assert sched["bubble_frac"] == (S - 1) / M
+    # exact per-tick accounting replaced the analytic (S-1)/M
+    assert sched["idle_slots"] == 2 * S * (S - 1)
+    assert sched["bubble_frac"] == \
+        sched["idle_slots"] / (sched["ticks"] * S)
+    cands = c["schedule_candidates_pp4_M8"]
+    assert cands == sorted(cands, key=lambda x: x["bubble_ticks"]), \
+        "schedule candidates not bubble-ranked"
+    assert {x["family"] for x in cands} == {"1f1b", "interleaved",
+                                            "zero_bubble"}
+
+    ws = art["weight_sharding"]
+    assert ws["max_loss_delta_vs_replicated"] <= ws["bound"], \
+        f"weight-sharded parity {ws['max_loss_delta_vs_replicated']}"
+    assert ws["sharded_params"] >= 1 and ws["pipe_degree"] == 4
+    assert ws["lowering_sharded_params"], \
+        "lowering census saw no sharded params"
+    # resident persistable bytes ÷ pipe: with every matrix sharded the
+    # per-rank param + optimizer state census must shrink close to 4×
+    assert ws["state_bytes_sharded"] * 3 <= ws["state_bytes_replicated"], \
+        (f"pipe weight sharding census not ÷pipe: "
+         f"{ws['state_bytes_replicated']} -> {ws['state_bytes_sharded']}")
+
+    rs = art["reshard"]
+    assert rs["resharded"], "pp4→pp2 restore planned no reshard"
+    assert rs["pp4_to_pp2_max_loss_delta"] <= rs["bound"], \
+        f"resharded continuation {rs['pp4_to_pp2_max_loss_delta']}"
+
     plan = art["plan"]
     assert plan["compiles_attempted"] == 0
+    assert plan["pipe_schedule"] == "auto"
     assert art["plan_compile_delta"] == 0, \
         f"{art['plan_compile_delta']} compiles during the search"
     pipes = {cfg["pipe"] for cfg in plan["configs"]}
     assert pipes >= {1, 2, 4}, f"pipe dimension not searched: {pipes}"
     assert {cfg["tp"] for cfg in plan["configs"]} >= {1, 2}
     assert any(cfg["remat"] for cfg in plan["configs"])
+    # every pipe row carries its chosen schedule + the ranked
+    # candidates the exact-bubble pricing considered
+    for cfg in plan["configs"]:
+        if cfg["pipe"] > 1 and not cfg.get("error"):
+            pr = cfg["pipe_report"]
+            assert pr["schedule_summary"]["family"] in (
+                "1f1b", "interleaved", "zero_bubble")
+            assert 0.0 <= pr["schedule_summary"]["bubble_frac"] <= 1.0
+            assert len(pr["schedule_candidates"]) >= 3
     flip = art["budget_flip"]
     assert flip["base_configs_fitting"] == 0, \
         "budget did not reject the base configs"
@@ -311,6 +604,11 @@ def check(art):
         "remat flipped nothing into admission"
     assert plan["winner"] is not None and plan["winner"]["remat"]
     assert all(f["recompute_flops_delta"] > 0 for f in flip["flipped"])
+    gate = art.get("regression_vs_r17") or {}
+    if gate.get("previous"):
+        assert gate["r21_best_pp2_bubble_frac"] <= \
+            gate["r17_pp2_bubble_frac"]
+        assert gate["r21_configs_priced"] >= gate["r17_configs_priced"]
     return True
 
 
@@ -323,20 +621,30 @@ def main(argv):
         out_path = args[0]
 
     parity, reports = run_parity()
+    schedules = run_schedules()
     census = run_census(reports)
-    plan, flip, compile_delta = run_plan()
+    weight_sharding = run_weight_sharding()
+    reshard = run_reshard()
+    probe_plan, plan, flip, compile_delta = run_plan()
     art = {
         "artifact": "PIPE_SEARCH",
-        "format_version": 1,
+        "format_version": 2,
         "module": "bert_tiny_pipeline",
         "parity": parity,
+        "schedules": schedules,
         "census": census,
+        "weight_sharding": weight_sharding,
+        "reshard": reshard,
         "plan": plan,
+        "plan_unconstrained": {
+            "winner": probe_plan["winner"],
+            "configs_priced": probe_plan["configs_priced"]},
         "plan_compile_delta": compile_delta,
         "budget_flip": flip,
     }
-    check(art)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    art["regression_vs_r17"] = regression_gate(art, repo)
+    check(art)
     if not os.path.isabs(out_path):
         out_path = os.path.join(repo, out_path)
     with open(out_path, "w") as f:
@@ -344,9 +652,18 @@ def main(argv):
     print(f"wrote {out_path}")
     print(f"  dp2·pp2 max loss delta {parity['dp2_pp2_max_loss_delta']:g}"
           f" / pp4 {parity['pp4_max_loss_delta']:g} (bound 1e-6)")
-    print(f"  plan: {len(plan['configs'])} configs, 0 compiles; "
-          f"remat admitted {flip['remat_configs_admitted']} config(s) "
-          f"under the forced budget")
+    bt = {g["family"]: g["bubble_ticks"]
+          for g in schedules["grid"] if g["pp"] == 4}
+    print(f"  pp4/M8 bubble ticks: {bt} (census idle == sim idle on "
+          f"every leg)")
+    print(f"  weight sharding: {weight_sharding['sharded_params']} "
+          f"params ÷ {weight_sharding['pipe_degree']}, parity "
+          f"{weight_sharding['max_loss_delta_vs_replicated']:g}; "
+          f"pp4→pp2 reshard {reshard['pp4_to_pp2_max_loss_delta']:g}")
+    print(f"  plan: {len(plan['configs'])} configs, 0 compiles, "
+          f"pipe_schedule=auto; remat admitted "
+          f"{flip['remat_configs_admitted']} config(s) under the "
+          f"forced budget")
     if selftest:
         print("pipe_probe selftest OK")
     return 0

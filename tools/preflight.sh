@@ -49,10 +49,12 @@ echo "   checkpoint onto dp4/dp16 + tp2->tp1 flip, planned==executed wire"
 echo "   bytes, parity <=1e-6, 0 compiles on rejected candidates) =="
 python tools/reshard_probe.py --selftest
 
-echo "== preflight: pipeline probe (dp2.pp2 + pp4 BERT-tiny 1F1B parity"
-echo "   <=1e-6 vs the microbatched baseline, stage/boundary census, the"
-echo "   (data,fsdp,tp,pipe,remat) search with 0 compiles + remat budget"
-echo "   flip -> PIPE_SEARCH_r17.json) =="
+echo "== preflight: pipeline probe (dp2.pp2 + pp4 BERT-tiny schedule grid"
+echo "   {1f1b, interleaved v2, zero-bubble} parity <=1e-6, census idle =="
+echo "   simulator bubble ticks exactly, pipe-axis weight sharding (state"
+echo "   bytes / pipe, pp4->pp2 resharded restore), the (data,fsdp,tp,pipe,"
+echo "   remat) x schedule search with 0 compiles + remat budget"
+echo "   flip -> PIPE_SEARCH_r21.json) =="
 python tools/pipe_probe.py --selftest
 
 echo "== preflight: auto-shard plan probe (dp8 BERT-tiny tp2: >=6 configs"

@@ -337,6 +337,44 @@ def selftest(memory=False) -> int:
               "fires after _folded_key")
         return 1
 
+    # scheduled-scan table soundness (pipe.simulate_schedule stamps):
+    # the genuine simulated tables must stay clean; a backward moved
+    # before any forward must fire pipe-schedule-order; an undersized
+    # saved-input ring must fire pipe-ring-overflow
+    from paddle_tpu.framework.analysis import (PIPE_RING_OVERFLOW,
+                                               PIPE_SCHEDULE_ORDER)
+    from paddle_tpu.framework.pipe import simulate_schedule
+    sch = simulate_schedule("1f1b", 2, 2)
+    bw_pp = next(op for op in pb.ops if op.type == "backward")
+    bw_pp.attrs["pipe_ring_slots"] = [int(sch["slots"]),
+                                      int(sch["ct_slots"])]
+    bw_pp.attrs["pipe_schedule_order"] = [list(u) for u in sch["order"]]
+    pp._bump_version()
+    sres = verify_program(pp)
+    if sres.by_code(PIPE_SCHEDULE_ORDER) or \
+            sres.by_code(PIPE_RING_OVERFLOW):
+        print("proglint selftest: genuine simulated schedule tables "
+              "were flagged")
+        return 1
+    bad_order = [list(u) for u in sch["order"]]
+    for u in bad_order:
+        if u[2] == "B":
+            u[0] = 0       # a backward at tick 0, before any forward
+            break
+    bw_pp.attrs["pipe_schedule_order"] = bad_order
+    pp._bump_version()
+    if not verify_program(pp).by_code(PIPE_SCHEDULE_ORDER):
+        print("proglint selftest: pipe-schedule-order did not fire on "
+              "a backward scheduled before its forward")
+        return 1
+    bw_pp.attrs["pipe_schedule_order"] = [list(u) for u in sch["order"]]
+    bw_pp.attrs["pipe_ring_slots"] = [0, 0]
+    pp._bump_version()
+    if not verify_program(pp).by_code(PIPE_RING_OVERFLOW):
+        print("proglint selftest: pipe-ring-overflow did not fire on "
+              "an undersized ring")
+        return 1
+
     # kernel-routing report (the Pallas tier, statically): the training
     # program must yield a non-empty report whose fused-Adam summary has
     # hits (the 128-wide BERT-tiny params tile), every row carries a
