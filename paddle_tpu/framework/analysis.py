@@ -75,6 +75,16 @@ PIPE_RING_OVERFLOW = "pipe-ring-overflow"
 REMAT_RECOMPUTE_SIDE_EFFECT = "remat-recompute-side-effect"
 UNSPECCED_OP = "unspecced-op"
 PASS_INVARIANT = "pass-invariant"
+# differential spec audit (framework/spec_audit.py): a static op_spec
+# channel disagrees with the ONCE-lowered program's ground truth —
+# shape/dtype vs jaxpr avals (always an error), flops vs XLA
+# cost_analysis / wire vs the module's collective census / peak-HBM vs
+# memory_analysis (errors outside the per-channel tolerance band
+# recorded in SPEC_AUDIT_r*.json)
+SPEC_DRIFT_SHAPE = "spec-drift-shape"
+SPEC_DRIFT_FLOPS = "spec-drift-flops"
+SPEC_DRIFT_WIRE = "spec-drift-wire"
+SPEC_DRIFT_MEM = "spec-drift-mem"
 # inference/serving profile (a SERVED program must be a pure read-only
 # function of its feeds — see verify_inference)
 INFERENCE_COLLECTIVE = "inference-collective"
@@ -1245,8 +1255,15 @@ def verify_cached(program: Program, feed_names: Iterable[str] = (),
     """Cached :func:`verify_program` — the Executor/CompiledProgram wiring
     point.  The full-program walk runs once per program version; repeat
     ``prepare``/``run`` calls hit the cache."""
+    # the mesh layout participates in the key: the SAME program verified
+    # under a different MeshLayout (e.g. replanned after an elastic
+    # restore) must not reuse the stale verdict — the shard-layout and
+    # collective-axis checks read axis sizes
+    layout = getattr(program, "_mesh_layout", None)
+    mesh_axes = tuple(sorted(layout.sizes.items())) \
+        if layout is not None else ()
     key = (program._uid, program._version,
-           tuple(sorted(feed_names)), tuple(fetch_names))
+           tuple(sorted(feed_names)), tuple(fetch_names), mesh_axes)
     result = _VERIFY_CACHE.get(key)
     if result is None:
         VERIFY_STATS["runs"] += 1
@@ -1528,4 +1545,6 @@ __all__ = [
     "RESHARD_UNKNOWN_STEP", "RESHARD_UNLOWERABLE",
     "RESHARD_DIVS_UNRESOLVED", "RESHARD_NEGATIVE_WIRE",
     "RESHARD_CANDIDATE_ORDER", "RESHARD_NOOP",
+    "SPEC_DRIFT_SHAPE", "SPEC_DRIFT_FLOPS", "SPEC_DRIFT_WIRE",
+    "SPEC_DRIFT_MEM",
 ]

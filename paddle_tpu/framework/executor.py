@@ -2506,6 +2506,29 @@ class Executor:
         self._cache[key] = compiled
         return compiled
 
+    def lower_for_audit(self, program, feed, fetch_names, scope,
+                        mesh=None, axis_names=(), batch_axis=None,
+                        seq_axis=None, feed_specs=None,
+                        donate_state=True):
+        """Lower the step ONCE for the differential spec auditor
+        (framework/spec_audit.py): the exact executable path
+        ``_compile`` builds (sharded wrap, guardrails, donation), traced
+        but NOT executed.  Returns ``(step, lowered)`` —
+        ``lowered.as_text()`` is the pre-compile StableHLO the wire
+        census parses; whether to pay ``lowered.compile()`` (the
+        cost/memory-analysis tiers) is the caller's choice.  Reuses the
+        executor's compile cache, so auditing a program the executor
+        already ran costs only the ``.lower`` trace."""
+        step = self._compile(program, feed, fetch_names, scope, mesh,
+                             tuple(axis_names), batch_axis,
+                             seq_axis=seq_axis, feed_specs=feed_specs,
+                             donate_state=donate_state)
+        state = {n: np.asarray(scope.find_var(n))
+                 for n in step.state_in_names}
+        lowered = step.fn.lower({k: feed[k] for k in step.feed_names},
+                                state, jax.random.PRNGKey(0))
+        return step, lowered
+
     def _aot_resolve(self, cache_dir, jit_fn, program, feed, feed_names,
                      fetch_names, scope, state_in_names, donate_state):
         """Disk-backed executable resolution for single-device compiles

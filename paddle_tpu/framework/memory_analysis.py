@@ -434,6 +434,27 @@ def _op_backward_extra(op, env) -> int:
         return 0
 
 
+def mem_uncovered_suspects(program: Program) -> list:
+    """Op types in ``program`` with NO memory opinion: neither a spec
+    ``mem_transparent``/``mem_backward_extra`` channel nor membership in
+    the transparent fallback set.  These are where a peak-HBM drift
+    (``spec-drift-mem``) most plausibly originates — the attribution
+    list the differential spec auditor (framework/spec_audit.py) names
+    in its diagnostics, and the census the backfill ratchet consumes."""
+    from ..framework.analysis import META_OPS
+    from ..ops.registry import OP_SPECS
+    out = set()
+    for op in program.global_block().ops:
+        if op.type in META_OPS or op.type in _TRANSPARENT_FALLBACK:
+            continue
+        spec = OP_SPECS.get(op.type)
+        if spec is not None and (spec.mem_transparent is not None
+                                 or spec.mem_backward_extra is not None):
+            continue
+        out.add(op.type)
+    return sorted(out)
+
+
 class _AliasSets:
     """Union-find over var names for residual-class collapse."""
 
@@ -1263,4 +1284,5 @@ __all__ = [
     "block_liveness", "program_liveness", "analyze_memory", "estimate",
     "lint_memory", "check_hbm_budget", "mesh_axes_of", "sig_bytes",
     "collective_wire_summary", "exposed_comm_model",
+    "mem_uncovered_suspects",
 ]

@@ -268,6 +268,9 @@ class Plan:
             min(fitting, key=PlanConfig.sort_key) if fitting else None
         if self.winner is not None:
             self.winner.winner = True
+        # populated by plan_sharding(audit_winner=True): the static-tier
+        # spec audit of the winning config's rewritten clone
+        self.winner_audit: Optional[Dict[str, Any]] = None
 
     def as_dict(self) -> Dict[str, Any]:
         return {
@@ -283,6 +286,7 @@ class Plan:
                                    if c.est is not None]),
             "configs": [c.as_dict() for c in self.configs],
             "winner": self.winner.as_dict() if self.winner else None,
+            "winner_audit": self.winner_audit,
             "pricing": "memory_analysis.analyze_memory (peak HBM) + "
                        "op_spec wire ring-cost channel "
                        "(collective_wire_summary) + exposed-comm "
@@ -453,6 +457,60 @@ def price_config(program: Program, layout: MeshLayout,
     return cfg
 
 
+def _audit_winner_clone(program: Program, winner: PlanConfig,
+                        loss_name=None, feed_shapes=None,
+                        fetch_names: Iterable[str] = (),
+                        build_strategy=None, min_shard_numel: int = 2048,
+                        num_microbatches: int = 1,
+                        pipe_schedule: str = "1f1b",
+                        pipe_shard_weights: bool = False
+                        ) -> Dict[str, Any]:
+    """Static-tier spec audit of the WINNING config: rebuild the same
+    rewritten clone ``price_config`` priced (fsdp shard rewrite →
+    pipeline stage cuts → grad-sync insertion) and run
+    ``spec_audit.audit_static`` over it — per-op shape channel plus
+    collective wire-pricing coverage, 0 compiles, so the planner's own
+    zero-compile contract holds.  The numbers the search ranked on are
+    only as good as the specs; this proves the winner's clone carries
+    no shape drift and no unpriced collectives before the layout is
+    stamped."""
+    from .compiler import BuildStrategy, insert_grad_sync
+    from .fsdp import apply_fsdp_sharding
+    from .pipe import apply_pipeline
+    from .spec_audit import audit_static
+
+    layout = winner.layout
+    clone = program.clone()
+    if layout.fsdp > 1:
+        apply_fsdp_sharding(clone, layout,
+                            min_shard_numel=min_shard_numel)
+    if layout.pipe > 1:
+        sch = (winner.pipe_report or {}).get("schedule_summary") or {}
+        apply_pipeline(clone, layout.pipe, num_microbatches,
+                       pipe_axis=layout.pipe_axis,
+                       feed_shapes=feed_shapes,
+                       schedule=sch.get("family") or pipe_schedule,
+                       chunks=sch.get("chunks") or 1,
+                       shard_weights=pipe_shard_weights,
+                       min_shard_numel=min_shard_numel)
+    sizes = layout.sizes
+    reduce_axes = tuple(a for a in _flat_axes(layout.batch_axes)
+                        if sizes.get(a, 1) > 1)
+    if loss_name is not None and reduce_axes:
+        n = int(np.prod([sizes[a] for a in reduce_axes]))
+        insert_grad_sync(clone, build_strategy or BuildStrategy(), n,
+                         reduce_axes, axis_sizes=sizes)
+    clone._mesh_layout = layout
+    report = audit_static(clone, feed_shapes=feed_shapes,
+                          fetch_names=list(fetch_names),
+                          mesh_axes=layout.mesh_axes)
+    out = report.as_dict()
+    out.pop("coverage", None)   # the registry census isn't per-plan
+    out["layout"] = {"data": layout.data, "fsdp": layout.fsdp,
+                     "tp": layout.tp, "pipe": layout.pipe}
+    return out
+
+
 # ---------------------------------------------------------------------------
 # the search
 # ---------------------------------------------------------------------------
@@ -470,7 +528,8 @@ def plan_sharding(program: Program, num_devices: int,
                   num_microbatches: int = 1,
                   remat: bool = False,
                   pipe_schedule: str = "1f1b",
-                  pipe_shard_weights: bool = False) -> Plan:
+                  pipe_shard_weights: bool = False,
+                  audit_winner: bool = False) -> Plan:
     """Search every legal (data, fsdp, tp, pipe) factorization of
     ``num_devices``, price each statically, and rank them.  Returns the
     :class:`Plan`; ``plan.winner`` is None when no config fits the
@@ -487,6 +546,14 @@ def plan_sharding(program: Program, num_devices: int,
     rematerialized sibling row for every budget-rejected config — when
     the recompute plan fits, the reject flips to an admitted config
     carrying the priced FLOPs delta.
+
+    ``audit_winner=True`` runs the differential spec auditor's static
+    tier (``spec_audit.audit_static``: per-op shape channel + collective
+    wire-pricing coverage) on a rebuild of the winning config's clone —
+    the search ranked on spec-priced numbers, so the winner's clone is
+    cross-checked for spec drift before anyone stamps it.  The outcome
+    lands in ``plan.winner_audit`` (and the PLAN_SEARCH artifact); an
+    audit failure never kills the search.
 
     0 compiles are attempted: pricing (including schedule selection,
     which is pure ``pipe.simulate_schedule`` arithmetic) runs on
@@ -528,6 +595,19 @@ def plan_sharding(program: Program, num_devices: int,
     plan = Plan(configs, num_devices, budget, module=module,
                 num_microbatches=num_microbatches,
                 pipe_schedule=pipe_schedule)
+    if audit_winner and plan.winner is not None:
+        try:
+            plan.winner_audit = _audit_winner_clone(
+                program, plan.winner, loss_name=loss_name,
+                feed_shapes=feed_shapes, fetch_names=fetch_names,
+                build_strategy=build_strategy,
+                min_shard_numel=min_shard_numel,
+                num_microbatches=num_microbatches,
+                pipe_schedule=pipe_schedule,
+                pipe_shard_weights=pipe_shard_weights)
+        except Exception as e:  # the audit must not kill the search
+            plan.winner_audit = {"ok": None,
+                                 "error": f"{type(e).__name__}: {e}"}
     if report_path:
         plan.write_report(report_path)
     return plan

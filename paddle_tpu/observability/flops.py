@@ -70,6 +70,18 @@ def device_info(device=None) -> Dict[str, Any]:
             "peak_flops": device_peak_flops(device)}
 
 
+#: flops-specced ops whose count is elementwise/transcendental class,
+#: NOT GEMM MACs — priced by the spec channel so the differential spec
+#: auditor (framework/spec_audit.py) can reconcile the program total
+#: against XLA cost_analysis, but EXCLUDED from the MFU numerator:
+#: the MFU convention (bench.bert_flops_per_step, FLOPS_AUDIT_r05)
+#: counts GEMMs only, and the telemetry band tests pin that ratio.
+NON_GEMM_FLOPS_OPS = frozenset({
+    "softmax", "log_softmax", "softmax_with_cross_entropy",
+    "cross_entropy", "cross_entropy2", "c_embedding",
+})
+
+
 def estimate_step_flops(program, feed_shapes=None,
                         fetch_names: Iterable[str] = (),
                         unknown_dim: int = 1) -> Dict[str, Any]:
@@ -82,7 +94,13 @@ def estimate_step_flops(program, feed_shapes=None,
     two GEMMs), else equals ``fwd_flops``.  ``unpriced`` lists op types
     that looked compute-bearing (matmul family) but had no priced spec
     or unknown shapes — a non-empty list means the estimate is a lower
-    bound."""
+    bound.
+
+    Ops in :data:`NON_GEMM_FLOPS_OPS` are priced in ``by_op`` and the
+    ``*_all`` fields (``fwd_flops_all``/``total_flops_all`` — what the
+    spec auditor reconciles against XLA's count) but kept out of
+    ``fwd_flops``/``total_flops`` so the MFU numerator stays the
+    GEMM-only analytic model."""
     from ..ops.registry import OP_SPECS, VarSig
     from ..framework.analysis import VerifyResult, infer_shapes
     from ..framework.memory_analysis import _feed_sigs
@@ -103,6 +121,7 @@ def estimate_step_flops(program, feed_shapes=None,
         return VarSig(tuple(v.shape) or None, v.dtype)
 
     fwd = 0.0
+    fwd_non_gemm = 0.0
     by_op: Dict[str, float] = {}
     unpriced = []
     has_backward = False
@@ -126,13 +145,20 @@ def estimate_step_flops(program, feed_shapes=None,
             unpriced.append(op.type)
             continue
         f = float(f)
-        fwd += f
+        if op.type in NON_GEMM_FLOPS_OPS:
+            fwd_non_gemm += f
+        else:
+            fwd += f
         by_op[op.type] = by_op.get(op.type, 0.0) + f
     total = 3.0 * fwd if has_backward else fwd
+    fwd_all = fwd + fwd_non_gemm
     return {"fwd_flops": fwd, "total_flops": total,
+            "fwd_flops_all": fwd_all,
+            "total_flops_all": 3.0 * fwd_all if has_backward else fwd_all,
             "has_backward": has_backward, "by_op": by_op,
             "unpriced": sorted(set(unpriced))}
 
 
 __all__ = ["device_peak_flops", "device_info", "estimate_step_flops",
-           "DEVICE_PEAK_FLOPS", "CPU_FALLBACK_FLOPS"]
+           "DEVICE_PEAK_FLOPS", "CPU_FALLBACK_FLOPS",
+           "NON_GEMM_FLOPS_OPS"]

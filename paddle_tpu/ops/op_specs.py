@@ -274,6 +274,48 @@ def _flops_conv2d(ins, outs, attrs):
     return 2.0 * _numel(ov.shape) * cin_g * kh * kw
 
 
+# -- elementwise/transcendental FLOPs (the non-GEMM tail): priced so the
+# differential spec auditor (framework/spec_audit.py) can reconcile the
+# program total against XLA cost_analysis; observability/flops.py keeps
+# these OUT of the MFU numerator (NON_GEMM_FLOPS_OPS).  Counting
+# convention matches the auditor's jaxpr prim table — ~1 FLOP per output
+# element per arithmetic/transcendental prim, reductions at operand
+# numel — so per-op attribution closes on the same model.
+
+
+def _flops_elemwise(k, slot="X"):
+    """``k`` FLOPs per element of input ``slot`` (prim-count
+    calibrated: e.g. softmax = reduce_max + sub + exp + reduce_sum +
+    div = 5 prims per logit element)."""
+    def flops(ins, outs, attrs):
+        v = _sig(ins, slot)
+        if v is None or v.shape is None or not _known(v.shape):
+            return None
+        return float(k) * _numel(v.shape)
+    return flops
+
+
+def _flops_softmax_ce(ins, outs, attrs):
+    """The fused loss materialises BOTH softmax and log_softmax over
+    the logits (5 prims each) plus the label gather/mask tail —
+    ~10 per logit element dominates."""
+    v = _sig(ins, "Logits")
+    if v is None or v.shape is None or not _known(v.shape):
+        return None
+    return 10.0 * _numel(v.shape)
+
+
+def _flops_c_embedding(ins, outs, attrs):
+    """Masked vocab-parallel lookup: shift/compare on Ids, clip +
+    where over the [*, dim] gather result, and the psum add —
+    ~2 per output element."""
+    w, ids = _sig(ins, "W"), _sig(ins, "Ids")
+    if w is None or ids is None or w.shape is None or ids.shape is None \
+            or not _known(w.shape) or not _known(ids.shape):
+        return None
+    return 2.0 * _numel(ids.shape) * w.shape[-1]
+
+
 def _infer_mean(ins, attrs):
     v = _sig(ins, "X")
     if v is None:
@@ -420,8 +462,10 @@ def _infer_dropout(ins, attrs):
     v = _sig(ins, "X")
     if v is None:
         return None
+    # the impl materialises Mask as uint8 regardless of X's dtype
+    # (caught by the differential spec auditor's shape channel)
     return {"Out": [VarSig(v.shape, v.dtype)],
-            "Mask": [VarSig(v.shape, v.dtype)]}
+            "Mask": [VarSig(v.shape, "uint8")]}
 
 
 def _cached_attn_total(ins):
@@ -860,6 +904,22 @@ _WIRE_DTYPE_BYTES = {"float64": 8, "int64": 8, "float32": 4, "int32": 4,
                      "uint8": 1, "bool": 1}
 
 
+def _wire_width(dtype) -> int:
+    """On-wire bytes per element.  Dtypes outside the fast table (e.g.
+    float8 variants) price at their true canonical itemsize via
+    registry.dtype_nbytes instead of silently defaulting to 4 — a
+    non-default-dtype pipe boundary or collective must not be priced at
+    fp32 width."""
+    width = _WIRE_DTYPE_BYTES.get(str(dtype))
+    if width is not None:
+        return width
+    try:
+        from .registry import dtype_nbytes
+        return dtype_nbytes(dtype)
+    except Exception:
+        return 4
+
+
 def _ring_factor(attrs, axis_sizes, passes):
     """Σ over the op's reduce axes of passes·(n-1)/n; falls back to
     ``passes`` per axis when the mesh is unknown (n → ∞ bound).  With a
@@ -892,7 +952,7 @@ def _collective_wire(passes):
             if sig is None or sig.shape is None or not _known(sig.shape):
                 return None              # dynamic payload — no claim
             numel += _numel(sig.shape)
-            width = _WIRE_DTYPE_BYTES.get(sig.dtype, 4)
+            width = _wire_width(sig.dtype)
         if not numel:
             return None
         factor = _ring_factor(attrs, axis_sizes, passes)
@@ -924,8 +984,7 @@ def _pipe_boundary_wire(ins, attrs, axis_sizes=None):
     for sig in ins.get("X", []):
         if sig is None or sig.shape is None or not _known(sig.shape):
             return None
-        numel_bytes += _numel(sig.shape) * \
-            _WIRE_DTYPE_BYTES.get(sig.dtype, 4)
+        numel_bytes += _numel(sig.shape) * _wire_width(sig.dtype)
     if not numel_bytes:
         return None
     ax = attrs.get("_axis_name")
@@ -937,8 +996,28 @@ def _pipe_boundary_wire(ins, attrs, axis_sizes=None):
     return total, total
 
 
+def _c_embedding_wire(ins, attrs, axis_sizes=None):
+    """Vocab-parallel embedding: the [*, dim] lookup result is psummed
+    over the model axis in forward (the backward transpose is the
+    identity), so the cut moves one ring all-reduce of the OUT payload
+    — 2·(n-1)/n · ids_numel · dim · width."""
+    w, ids = _sig(ins, "W"), _sig(ins, "Ids")
+    if w is None or ids is None or w.shape is None or ids.shape is None \
+            or not _known(w.shape) or not _known(ids.shape):
+        return None
+    numel = _numel(ids.shape) * w.shape[-1]
+    factor = _ring_factor(attrs, axis_sizes, 2)
+    total = int(numel * _wire_width(w.dtype) * factor)
+    return total, total
+
+
 _WIRE_SPECS = {
     "pipe_stage_boundary": _pipe_boundary_wire,
+    # MoE/reshard dispatch: fwd a2a + the bwd a2a transpose, (n-1)/n each
+    "alltoall": _collective_wire(2),
+    # init-time weight sync: one ring broadcast pass, no backward
+    "c_broadcast": _collective_wire(1),
+    "c_embedding": _c_embedding_wire,
     "c_allreduce_sum": _collective_wire(2),
     "c_fused_allreduce_sum": _collective_wire(2),
     "c_quant_allreduce_sum": _collective_wire(2),
@@ -947,7 +1026,11 @@ _WIRE_SPECS = {
     "quant_reduce_scatter": _collective_wire(1),
     "c_reducescatter": _collective_wire(1),
     "zero_all_gather": _collective_wire(1),
-    "c_allgather": _collective_wire(1),
+    # Megatron forward gather: in the training step autodiff transposes
+    # the all_gather into a reduce_scatter of the cotangent, so the
+    # per-step wire is 2 ring passes (spec_audit compares each half
+    # against its HLO kind)
+    "c_allgather": _collective_wire(2),
     "fsdp_all_gather": _collective_wire(2),
     "mp_allreduce_sum": _collective_wire(2),
     "mp_copy": _collective_wire(2),
@@ -1274,13 +1357,19 @@ def register_default_specs():
     op_spec("logical_not", infer=same_as_input(), mem_transparent=True)
 
     # unary shape/dtype-preserving (all fusible elementwise)
-    for name in ("relu", "relu6", "sigmoid", "tanh", "gelu", "softmax",
-                 "log_softmax", "exp", "log", "sqrt", "rsqrt", "square",
+    for name in ("relu", "relu6", "sigmoid", "tanh", "gelu",
+                 "exp", "log", "sqrt", "rsqrt", "square",
                  "abs", "floor", "ceil", "round", "sign", "softplus",
                  "swish", "hard_swish", "hard_sigmoid", "leaky_relu",
                  "scale", "assign", "clip", "pow",
                  "softsign", "erf", "sin", "cos"):
         op_spec(name, infer=same_as_input(), mem_transparent=True)
+    # softmax family carries the elementwise flops channel (5 prims per
+    # logit element) so the spec auditor's XLA reconciliation closes on
+    # attention-heavy programs; still fusible/transparent for memory
+    for name in ("softmax", "log_softmax"):
+        op_spec(name, infer=same_as_input(), mem_transparent=True,
+                flops=_flops_elemwise(5))
     op_spec("dropout", infer=_infer_dropout, mem_transparent=True)
 
     # math
@@ -1305,9 +1394,12 @@ def register_default_specs():
     op_spec("lookup_table", infer=_infer_lookup_table)
     op_spec("lookup_table_v2", infer=_infer_lookup_table_v2)
     op_spec("softmax_with_cross_entropy", infer=_infer_softmax_with_ce,
-            mem_backward_extra=_softmax_ce_extra_bytes)
-    op_spec("cross_entropy", infer=_infer_cross_entropy)
-    op_spec("cross_entropy2", infer=_infer_cross_entropy)
+            mem_backward_extra=_softmax_ce_extra_bytes,
+            flops=_flops_softmax_ce)
+    op_spec("cross_entropy", infer=_infer_cross_entropy,
+            flops=_flops_elemwise(3))
+    op_spec("cross_entropy2", infer=_infer_cross_entropy,
+            flops=_flops_elemwise(3))
     op_spec("fused_attention", infer=_infer_fused_attention,
             mem_backward_extra=_attention_probs_bytes,
             flops=_flops_fused_attention,
@@ -1399,7 +1491,8 @@ def register_default_specs():
     # the flops channel priced the whole encoder at 0 — the exposed-
     # comm roofline then had no compute term to hide wire under.
     op_spec("c_embedding", infer=_infer_c_embedding, collective=True,
-            wire=_WIRE_SPECS.get("c_embedding"))
+            wire=_WIRE_SPECS.get("c_embedding"),
+            flops=_flops_c_embedding)
     # Megatron f op: identity forward (psum transpose in backward)
     op_spec("mp_copy", infer=_infer_collective_same, collective=True,
             wire=_WIRE_SPECS.get("mp_copy"))

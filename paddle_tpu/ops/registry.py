@@ -314,6 +314,34 @@ def op_spec(name: str, infer: Optional[Callable] = None,
     return spec
 
 
+#: the auditable static channels of an OpSpec, in census order — the
+#: spec_audit coverage ratchet reports one op-name list per entry
+SPEC_CHANNELS = ("infer", "flops", "wire", "mem")
+
+
+def spec_coverage() -> Dict[str, list]:
+    """Census of which registered op types carry each static channel —
+    the raw material of the spec-coverage ratchet (SPEC_AUDIT_r*.json):
+    ``{"infer": [...], "flops": [...], "wire": [...], "mem": [...]}``,
+    each list sorted.  "mem" counts an op that declares EITHER
+    ``mem_transparent`` or ``mem_backward_extra`` (both are opinions the
+    memory analyzer consumes; a None/None spec has no memory opinion).
+    """
+    cov = {ch: [] for ch in SPEC_CHANNELS}
+    for name in sorted(OP_SPECS):
+        spec = OP_SPECS[name]
+        if spec.infer is not None:
+            cov["infer"].append(name)
+        if spec.flops is not None:
+            cov["flops"].append(name)
+        if spec.wire is not None:
+            cov["wire"].append(name)
+        if spec.mem_transparent is not None or \
+                spec.mem_backward_extra is not None:
+            cov["mem"].append(name)
+    return cov
+
+
 def get_op_spec(name: str) -> Optional[OpSpec]:
     return OP_SPECS.get(name)
 

@@ -474,6 +474,35 @@ def test_prepare_verifies_once_per_program_version():
     p1.close(); p2.close(); p3.close()
 
 
+def test_verify_cache_keyed_on_mesh_axis_sizes():
+    """The SAME program version verified under a different MeshLayout
+    must re-run the walk — the shard-layout and collective-axis checks
+    read axis sizes, so a replanned layout invalidates the verdict."""
+    from paddle_tpu.framework.mesh_layout import MeshLayout
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.fc(x, 4)
+        loss = fluid.layers.mean(y)
+    analysis.clear_verify_cache()
+    analysis.verify_cached(main, fetch_names=[loss.name])
+    analysis.verify_cached(main, fetch_names=[loss.name])
+    assert analysis.VERIFY_STATS["runs"] == 1
+    assert analysis.VERIFY_STATS["hits"] == 1
+    main._mesh_layout = MeshLayout(data=4, fsdp=1, tp=2)
+    analysis.verify_cached(main, fetch_names=[loss.name])
+    assert analysis.VERIFY_STATS["runs"] == 2, \
+        "a new mesh layout must not reuse the layout-free verdict"
+    # a DIFFERENT axis-size assignment is a different key too
+    main._mesh_layout = MeshLayout(data=8, fsdp=1, tp=1)
+    analysis.verify_cached(main, fetch_names=[loss.name])
+    assert analysis.VERIFY_STATS["runs"] == 3
+    # ... and each layout's verdict is itself cached
+    analysis.verify_cached(main, fetch_names=[loss.name])
+    assert analysis.VERIFY_STATS["runs"] == 3
+    del main._mesh_layout
+
+
 def test_prepared_run_path_verifies_and_still_trains():
     main, startup = Program(), Program()
     with program_guard(main, startup):

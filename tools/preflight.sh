@@ -57,6 +57,11 @@ echo "   remat) x schedule search with 0 compiles + remat budget"
 echo "   flip -> PIPE_SEARCH_r21.json) =="
 python tools/pipe_probe.py --selftest
 
+echo "== preflight: spec audit probe (differential op_spec proof: clean"
+echo "   ladder shape/flops/mem + dp8 wire reconciled, seeded infer"
+echo "   corruption anchored as spec-drift-shape) =="
+python tools/spec_audit_probe.py --selftest
+
 echo "== preflight: auto-shard plan probe (dp8 BERT-tiny tp2: >=6 configs"
 echo "   priced, winner min-EXPOSED-comm among budget-fitting, ties to"
 echo "   fewer wire bytes, 0 compiles) =="

@@ -34,6 +34,12 @@ Options:
                      WILL lower to a custom kernel for TPU at the
                      program's static shapes, and why the rest fall
                      back) — analysis.kernel_routing_report, 0 compiles
+  --audit            run the differential spec auditor's static tier
+                     (framework/spec_audit.py audit_static): abstract-
+                     evaluate every specced op impl and cross-check the
+                     infer channel's shape/dtype claims, plus the
+                     collective wire-pricing coverage census — 0
+                     compiles; exits non-zero on any spec-drift-* error
   --json             machine-readable report on stdout (diagnostics,
                      unspecced-op census, memory estimate, kernel
                      routing) for CI
@@ -81,7 +87,7 @@ def load_program(path: str):
 
 def lint(program, startup=None, feed_names=(), fetch_names=(),
          strict=False, inference=False, memory=False, kernels=False,
-         as_json=False, out=None):
+         audit=False, as_json=False, out=None):
     out = out if out is not None else sys.stdout
     from paddle_tpu.framework.analysis import (verify_inference,
                                                verify_program)
@@ -106,6 +112,10 @@ def lint(program, startup=None, feed_names=(), fetch_names=(),
     if kernels:
         from paddle_tpu.framework.analysis import kernel_routing_report
         routing = kernel_routing_report(program, fetch_names=fetch_names)
+    audit_report = None
+    if audit:
+        from paddle_tpu.framework.spec_audit import audit_static
+        audit_report = audit_static(program, fetch_names=fetch_names)
     if as_json:
         payload = {
             "errors": len(result.errors()),
@@ -116,17 +126,24 @@ def lint(program, startup=None, feed_names=(), fetch_names=(),
                  "block": d.block_idx, "op_index": d.op_index,
                  "callstack": list(d.callstack)}
                 for d in result.diagnostics],
-            "unspecced_ops": dict(result.unspecced_ops),
+            # sorted for byte-stable CI output: the census is a dict
+            # keyed by discovery order, which varies with block layout
+            "unspecced_ops": {k: result.unspecced_ops[k]
+                              for k in sorted(result.unspecced_ops)},
         }
         if estimate is not None:
             payload["memory"] = estimate.as_dict()
         if routing is not None:
             payload["kernel_routing"] = routing
+        if audit_report is not None:
+            payload["spec_audit"] = audit_report.as_dict()
         print(json.dumps(payload, indent=1), file=out)
     else:
         print(result.report(), file=out)
         if estimate is not None:
             print(estimate.report(), file=out)
+        if audit_report is not None:
+            print(audit_report.report(), file=out)
         if routing is not None:
             print(f"pallas kernel routing (backend={routing['backend']}, "
                   "0 compiles):", file=out)
@@ -138,6 +155,8 @@ def lint(program, startup=None, feed_names=(), fetch_names=(),
                     print(f"    op[{r['index']}] {r['op']} -> fallback "
                           f"({r['reason']})", file=out)
     if result.errors():
+        return 1
+    if audit_report is not None and not audit_report.ok:
         return 1
     if strict and (result.warnings() or result.unspecced_ops):
         return 1
@@ -398,6 +417,40 @@ def selftest(memory=False) -> int:
               "routing section")
         return 1
 
+    # --audit: the static spec-audit tier must pass the clean program
+    # and embed its section in the JSON payload; a corrupted infer spec
+    # must flip the exit code (the differential auditor's CLI face)
+    from paddle_tpu.framework.spec_audit import SPEC_DRIFT_SHAPE  # noqa: F401
+    from paddle_tpu.ops.registry import OP_SPECS, VarSig
+    sink = _io.StringIO()
+    rc = lint(main, fetch_names=[total.name], audit=True, as_json=True,
+              out=sink)
+    payload = json.loads(sink.getvalue())
+    if rc or not payload.get("spec_audit", {}).get("ok"):
+        print("proglint selftest: --audit failed on the clean training "
+              "program")
+        return 1
+    if list(payload["unspecced_ops"]) != sorted(payload["unspecced_ops"]):
+        print("proglint selftest: unspecced-op census is not sorted")
+        return 1
+    gelu_spec = OP_SPECS["gelu"]
+    orig_infer = gelu_spec.infer
+    gelu_spec.infer = lambda ins, attrs: {
+        "Out": [VarSig(ins["X"][0].shape, "float16")]}
+    try:
+        sink = _io.StringIO()
+        rc = lint(main, fetch_names=[total.name], audit=True,
+                  as_json=True, out=sink)
+    finally:
+        gelu_spec.infer = orig_infer
+    drift = [d for d in json.loads(sink.getvalue())
+             .get("spec_audit", {}).get("drift", [])
+             if d["code"] == "spec-drift-shape"]
+    if rc == 0 or not drift or drift[0]["op_type"] != "gelu":
+        print("proglint selftest: --audit did not catch the corrupted "
+              "gelu infer spec")
+        return 1
+
     if memory:
         from paddle_tpu.framework.errors import InvalidArgumentError
         from paddle_tpu.framework.memory_analysis import (analyze_memory,
@@ -444,6 +497,7 @@ def main(argv=None) -> int:
     ap.add_argument("--inference", action="store_true")
     ap.add_argument("--memory", action="store_true")
     ap.add_argument("--kernels", action="store_true")
+    ap.add_argument("--audit", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--strict", action="store_true")
     ap.add_argument("--selftest", action="store_true")
@@ -458,7 +512,8 @@ def main(argv=None) -> int:
     return lint(program, startup=startup, feed_names=args.feed,
                 fetch_names=args.fetch, strict=args.strict,
                 inference=args.inference, memory=args.memory,
-                kernels=args.kernels, as_json=args.as_json)
+                kernels=args.kernels, audit=args.audit,
+                as_json=args.as_json)
 
 
 if __name__ == "__main__":
