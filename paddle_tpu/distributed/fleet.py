@@ -570,7 +570,8 @@ class CollectiveOptimizer:
         # manual per-param fsdp stamps conflict with the planner exactly
         # like manual strategy flags do (tp annotations are fine — the
         # planner searches the tp dimension they declare)
-        from ..framework.mesh_layout import FSDP_AXIS, _flat_axes
+        from ..framework.mesh_layout import (EXPERT_AXIS, FSDP_AXIS,
+                                             _flat_axes)
         for p in program.all_parameters():
             da = getattr(p, "dist_attr", None)
             if da and FSDP_AXIS in _flat_axes(tuple(da)):
@@ -580,6 +581,27 @@ class CollectiveOptimizer:
                     f"({tuple(da)!r}) both claim the {FSDP_AXIS!r} axis "
                     f"and cannot compose — drop the manual stamp or set "
                     f"auto_shard=False")
+            if da and EXPERT_AXIS in _flat_axes(tuple(da)):
+                raise InvalidArgumentError(
+                    f"DistributedStrategy: auto_shard=True and a manual "
+                    f"ep_degree stamp on {p.name!r} ({tuple(da)!r}) both "
+                    f"claim the {EXPERT_AXIS!r} axis and cannot compose "
+                    f"— build the MoE layer dense (ep_degree=None) and "
+                    f"let the planner search max_expert, or set "
+                    f"auto_shard=False")
+        # a manually ep-wired expert exchange conflicts the same way (a
+        # moe_ffn(ep_degree=...) build emits c_expert_alltoall directly)
+        for op in program.global_block().ops:
+            if op.type == "c_expert_alltoall" and \
+                    op.attrs.get("_axis_name"):
+                raise InvalidArgumentError(
+                    "DistributedStrategy: auto_shard=True cannot compose "
+                    "with a manually expert-parallel MoE build (found a "
+                    "c_expert_alltoall over axis "
+                    f"{op.attrs['_axis_name']!r}) — build the MoE layer "
+                    "dense (ep_degree=None) and pass "
+                    "auto_shard_configs={'max_expert': ...}, or set "
+                    "auto_shard=False")
 
         optimizer = self._compose(self._inner, mesh=None)
         opt_ops, params_grads = optimizer.minimize(
@@ -599,6 +621,7 @@ class CollectiveOptimizer:
             module="auto_shard",
             report_path=cfgs.get("report_path"),
             max_pipe=int(cfgs.get("max_pipe") or 1),
+            max_expert=int(cfgs.get("max_expert") or 1),
             num_microbatches=int(cfgs.get("num_microbatches") or 1),
             remat=bool(cfgs.get("remat")),
             pipe_schedule=str(cfgs.get("pipe_schedule") or "1f1b"),

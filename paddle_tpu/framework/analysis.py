@@ -67,6 +67,11 @@ READ_AFTER_DONATE = "read-after-donate"
 # framework/mesh_layout.py, stamped by the auto-shard planner)
 SHARD_LAYOUT_UNKNOWN_AXIS = "shard-layout-unknown-axis"
 SHARD_LAYOUT_COLLECTIVE_MISMATCH = "shard-layout-collective-mismatch"
+# MoE expert-parallel soundness (the parallel/moe.py decomposed route
+# moe_dispatch → c_expert_alltoall → moe_expert_ffn → moe_combine and the
+# fused ops.moe_ffn fallback — both name the exchange axis statically)
+MOE_AXIS_UNKNOWN = "moe-axis-unknown"
+MOE_AXIS_CAPACITY_MISMATCH = "moe-axis-capacity-mismatch"
 # pipeline/remat soundness (the stage-cut + recompute rewrites —
 # framework/pipe.py, lowered by the executor's scheduled scan)
 PIPE_COLLECTIVE_CROSSES_STAGE = "pipe-collective-crosses-stage"
@@ -575,6 +580,11 @@ def verify_distributed(program: Program, result: VerifyResult,
                       "quant_reduce_scatter", "c_allreduce_sum",
                       "c_fused_allreduce_sum", "zero_reduce_scatter",
                       "c_reducescatter"}
+    # quantized PERMUTES are also sound: an all_to_all only re-routes the
+    # payload — every receive slice is dequantized whole (a degenerate
+    # one-operand accumulate), so the per-block scales never have to
+    # cancel across ranks.  The integer-payload check below still applies.
+    _QUANT_PERMUTE_OPS = {"c_expert_alltoall"}
     from ..flags import flag
     min_bucket = float(flag("quant_min_bucket_kb")) * 1024.0
     for idx, op in enumerate(block.ops):
@@ -584,7 +594,8 @@ def verify_distributed(program: Program, result: VerifyResult,
             op.attrs.get("quant_spec") is not None
         if not quantized or op.type not in collectives:
             continue
-        if op.type not in _QUANT_SUM_OPS:
+        if op.type not in _QUANT_SUM_OPS and \
+                op.type not in _QUANT_PERMUTE_OPS:
             result.add(
                 "error", QUANT_NON_SUM,
                 f"collective {op.type!r} carries a quant_spec but is not "
@@ -820,6 +831,85 @@ def verify_shard_layout(program: Program, result: VerifyResult):
                         f"reduce only over the axes the payload is "
                         f"replicated on",
                         op, block.idx, idx)
+
+
+_MOE_AXIS_OPS = ("c_expert_alltoall", "moe_ffn")
+
+
+def verify_moe(program: Program, result: VerifyResult):
+    """MoE expert-parallel soundness (parallel/moe.py's decomposed route
+    moe_dispatch → c_expert_alltoall → moe_expert_ffn → moe_combine, and
+    the fused ops-level moe_ffn fallback — both name the exchange axis
+    statically via ``_axis_name``).
+
+    Two misuse classes, both anchored to the offending op:
+
+    * **moe-axis-unknown** — the op names a mesh axis the stamped
+      :class:`MeshLayout` does not carry.  At run time the impl resolves
+      ``axis_name`` against the live mesh, finds nothing, and silently
+      degrades to the identity: every rank keeps its own tokens and the
+      experts on the other ranks never see a single one — training
+      "works" with 1/ep of the intended expert capacity;
+    * **moe-axis-capacity-mismatch** — the static expert count does not
+      divide the named axis's size, so ranks would hold ragged expert
+      slices and the dispatch/combine all_to_all pair reassembles tokens
+      against the wrong expert offsets."""
+    from .mesh_layout import _flat_axes
+
+    block = program.global_block()
+    layout = getattr(program, "_mesh_layout", None)
+    if layout is None:
+        return
+    layout_axes = set(layout.axis_names)
+    sizes = dict(layout.sizes)
+
+    for idx, op in enumerate(block.ops):
+        if op.type not in _MOE_AXIS_OPS:
+            continue
+        axes = tuple(_flat_axes(op.attrs.get("_axis_name") or ()))
+        if not axes:
+            continue
+        unknown = [a for a in axes if a not in layout_axes]
+        if unknown:
+            result.add(
+                "error", MOE_AXIS_UNKNOWN,
+                f"MoE op {op.type!r} routes its expert exchange over "
+                f"axis(es) {unknown} that do not exist in the program's "
+                f"MeshLayout {sizes} — the exchange would silently "
+                f"degrade to the identity (each rank keeps its own "
+                f"tokens; remote experts never fire); pass the layout's "
+                f"expert axis (axis_name={layout.expert_axis!r}) or "
+                f"build dense and let the planner stamp it",
+                op, block.idx, idx)
+            continue
+        ep = 1
+        for a in axes:
+            ep *= int(sizes.get(a, 1))
+        if ep <= 1:
+            continue
+        # static expert count: fused op carries it as an attr; the
+        # exchange op's payload Xe is [E, G*C, M] dest-major, so dim 0
+        # of its input is E in the (global-shape) dense build.
+        e = int(op.attrs.get("num_experts", 0) or 0)
+        if not e:
+            for n in op.input_names():
+                v = block._find_var_recursive(n)
+                shape = tuple(getattr(v, "shape", ()) or ()) \
+                    if v is not None else ()
+                if len(shape) >= 1 and int(shape[0]) > 0:
+                    e = int(shape[0])
+                    break
+        if e and e % ep != 0:
+            result.add(
+                "error", MOE_AXIS_CAPACITY_MISMATCH,
+                f"MoE op {op.type!r} shards {e} experts over axis(es) "
+                f"{list(axes)} of total size {ep} — {e} % {ep} != 0, so "
+                f"ranks would hold ragged expert slices and the "
+                f"dispatch/combine all_to_all pair reassembles tokens "
+                f"against wrong expert offsets; pick an expert count "
+                f"divisible by the exchange axis (or a smaller "
+                f"ep_degree)",
+                op, block.idx, idx)
 
 
 def collective_signature(program: Program) -> List[Tuple]:
@@ -1066,6 +1156,7 @@ def verify_program(program: Program, startup: Optional[Program] = None,
     infer_shapes(program, result, feed_names)
     verify_distributed(program, result, fetch_names)
     verify_shard_layout(program, result)
+    verify_moe(program, result)
     verify_pipeline(program, result)
     return result
 
@@ -1529,6 +1620,7 @@ __all__ = [
     "QUANT_COLLECTIVE_INTEGER", "QUANT_NON_SUM", "QUANT_SMALL_BUCKET",
     "OVERLAP_SINGLE_BUCKET", "OVERLAP_TAIL_SUNK",
     "SHARD_LAYOUT_UNKNOWN_AXIS", "SHARD_LAYOUT_COLLECTIVE_MISMATCH",
+    "MOE_AXIS_UNKNOWN", "MOE_AXIS_CAPACITY_MISMATCH", "verify_moe",
     "PIPE_COLLECTIVE_CROSSES_STAGE", "PIPE_SCHEDULE_ORDER",
     "PIPE_RING_OVERFLOW", "REMAT_RECOMPUTE_SIDE_EFFECT",
     "verify_program", "verify_inference", "verify_decode",

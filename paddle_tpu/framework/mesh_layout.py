@@ -42,6 +42,7 @@ DATA_AXIS = "dp"
 FSDP_AXIS = "fsdp"
 TP_AXIS = "tp"
 PIPE_AXIS = "pp"
+EXPERT_AXIS = "ep"
 
 
 def _flat_axes(entries) -> Tuple[str, ...]:
@@ -150,13 +151,15 @@ class MeshLayout:
     """
 
     def __init__(self, data: int = 1, fsdp: int = 1, tp: int = 1,
-                 pipe: int = 1,
+                 pipe: int = 1, expert: int = 1,
                  extra_axes: Optional[Dict[str, int]] = None,
                  data_axis: str = DATA_AXIS, fsdp_axis: str = FSDP_AXIS,
-                 tp_axis: str = TP_AXIS, pipe_axis: str = PIPE_AXIS):
+                 tp_axis: str = TP_AXIS, pipe_axis: str = PIPE_AXIS,
+                 expert_axis: str = EXPERT_AXIS):
         self.data_axis, self.fsdp_axis, self.tp_axis = \
             data_axis, fsdp_axis, tp_axis
         self.pipe_axis = pipe_axis
+        self.expert_axis = expert_axis
         self._sizes: Dict[str, int] = {data_axis: int(data),
                                        fsdp_axis: int(fsdp),
                                        tp_axis: int(tp)}
@@ -165,6 +168,10 @@ class MeshLayout:
             # pipe-less layout keeps the exact (data, fsdp, tp) sizes
             # dict every pre-pipe artifact/serialization recorded
             self._sizes[pipe_axis] = int(pipe)
+        if int(expert) != 1:
+            # same back-compat rule as the pipe axis: the expert axis
+            # exists only when an MoE layout actually shards over it
+            self._sizes[expert_axis] = int(expert)
         for k, v in (extra_axes or {}).items():
             self._sizes[str(k)] = int(v)
         for name, size in self._sizes.items():
@@ -187,6 +194,10 @@ class MeshLayout:
     @property
     def pipe(self) -> int:
         return self._sizes.get(self.pipe_axis, 1)
+
+    @property
+    def expert(self) -> int:
+        return self._sizes.get(self.expert_axis, 1)
 
     @property
     def sizes(self) -> Dict[str, int]:
@@ -218,11 +229,14 @@ class MeshLayout:
 
     @property
     def batch_axes(self):
-        """The axes the global batch shards over (data + fsdp — ZeRO-3
-        treats the fsdp axis as a second data axis), squeezed: a plain
+        """The axes the global batch shards over (data + fsdp + expert —
+        ZeRO-3 treats the fsdp axis as a second data axis, and the GShard
+        MoE layout shards tokens over the expert axis too: every device
+        contributes tokens AND owns E/ep experts), squeezed: a plain
         string when only one axis is real, a tuple when several, None
-        when the layout is single-device along both."""
-        axes = tuple(a for a in (self.data_axis, self.fsdp_axis)
+        when the layout is single-device along all of them."""
+        axes = tuple(a for a in (self.data_axis, self.fsdp_axis,
+                                 self.expert_axis)
                      if self._sizes.get(a, 1) > 1)
         if not axes:
             return None
@@ -281,7 +295,8 @@ class MeshLayout:
     def to_desc(self) -> Dict[str, Any]:
         return {"axes": [[a, int(n)] for a, n in self._sizes.items()],
                 "data_axis": self.data_axis, "fsdp_axis": self.fsdp_axis,
-                "tp_axis": self.tp_axis, "pipe_axis": self.pipe_axis}
+                "tp_axis": self.tp_axis, "pipe_axis": self.pipe_axis,
+                "expert_axis": self.expert_axis}
 
     @classmethod
     def from_desc(cls, d) -> "MeshLayout":
@@ -292,28 +307,31 @@ class MeshLayout:
         fa = d.get("fsdp_axis", FSDP_AXIS)
         ta = d.get("tp_axis", TP_AXIS)
         pa = d.get("pipe_axis", PIPE_AXIS)
+        ea = d.get("expert_axis", EXPERT_AXIS)
         extra = {a: n for a, n in axes.items()
-                 if a not in (da, fa, ta, pa)}
+                 if a not in (da, fa, ta, pa, ea)}
         return cls(data=axes.get(da, 1), fsdp=axes.get(fa, 1),
                    tp=axes.get(ta, 1), pipe=axes.get(pa, 1),
-                   extra_axes=extra,
-                   data_axis=da, fsdp_axis=fa, tp_axis=ta, pipe_axis=pa)
+                   expert=axes.get(ea, 1), extra_axes=extra,
+                   data_axis=da, fsdp_axis=fa, tp_axis=ta, pipe_axis=pa,
+                   expert_axis=ea)
 
     def __eq__(self, other):
         return isinstance(other, MeshLayout) and \
             self._sizes == other._sizes and \
             (self.data_axis, self.fsdp_axis, self.tp_axis,
-             self.pipe_axis) == \
+             self.pipe_axis, self.expert_axis) == \
             (other.data_axis, other.fsdp_axis, other.tp_axis,
-             other.pipe_axis)
+             other.pipe_axis, other.expert_axis)
 
     def __hash__(self):
         return hash((tuple(self._sizes.items()), self.data_axis,
-                     self.fsdp_axis, self.tp_axis, self.pipe_axis))
+                     self.fsdp_axis, self.tp_axis, self.pipe_axis,
+                     self.expert_axis))
 
     def __repr__(self):
         return f"MeshLayout({self._sizes})"
 
 
 __all__ = ["ShardSpec", "MeshLayout", "DATA_AXIS", "FSDP_AXIS", "TP_AXIS",
-           "PIPE_AXIS", "_flat_axes"]
+           "PIPE_AXIS", "EXPERT_AXIS", "_flat_axes"]

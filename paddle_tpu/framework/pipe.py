@@ -187,6 +187,28 @@ def _collective_forbidden(block, fwd_ops, def_idx):
     return forbidden
 
 
+def _moe_forbidden(block, fwd_ops, def_idx):
+    """Cut positions inside an MoE block's dispatch→combine span.  The
+    gate lives in ``moe_dispatch`` (it produces both the Combine weights
+    and the block's AuxLoss); splitting the span would put the gate and
+    its combine — one routing decision — on different stages, so the
+    recompute/grad path of the gate softmax and the aux-loss pair it
+    feeds would straddle a ppermute boundary.  A ``moe_combine`` at index
+    i reading a Combine tensor defined at j forbids every cut in (j, i]
+    (the expert exchanges inside the span are collectives and already
+    forbidden by :func:`_collective_forbidden`; this rule also covers the
+    dense ep=1 build, which has no exchange ops)."""
+    forbidden = set()
+    for i, op in enumerate(fwd_ops):
+        if op.type != "moe_combine":
+            continue
+        for n in op.inputs.get("Combine", ()):
+            j = def_idx.get(n)
+            if j is not None and j < i:
+                forbidden.update(range(j + 1, i + 1))
+    return forbidden
+
+
 # ---------------------------------------------------------------------------
 # stage-cut planning
 # ---------------------------------------------------------------------------
@@ -255,6 +277,7 @@ def plan_stage_cuts(program: Program, num_stages: int,
     total = float(prefix[-1])
 
     forbidden = _collective_forbidden(block, fwd_ops, def_idx)
+    forbidden |= _moe_forbidden(block, fwd_ops, def_idx)
     cost: Dict[int, Tuple[List[str], int]] = {}
     for c in range(1, F):
         if c in forbidden:

@@ -127,18 +127,53 @@ def legal_pipe_degrees(program: Program, num_devices: int,
     return out or [1]
 
 
+def legal_expert_degrees(program: Program, num_devices: int,
+                         max_expert: Optional[int] = None) -> List[int]:
+    """expert (ep) degrees the PROGRAM supports: 1 always; >1 only when
+    MoE ops exist (``moe_expert_ffn`` from the decomposed layer, or the
+    legacy fused ``moe_ffn``), the degree divides the device count AND
+    every routed block's expert count (W1's leading dim).  ``max_expert``
+    (default 1) is the search opt-in, like ``max_pipe``."""
+    cap = int(max_expert or 1)
+    if cap <= 1:
+        return [1]
+    block = program.global_block()
+    expert_counts: List[int] = []
+    for op in block.ops:
+        if op.type not in ("moe_expert_ffn", "moe_ffn"):
+            continue
+        names = op.inputs.get("W1") or []
+        v = block.vars.get(names[0]) if names else None
+        if v is not None and v.shape:
+            expert_counts.append(int(v.shape[0]))
+    if not expert_counts:
+        return [1]
+    out = []
+    for e in range(1, num_devices + 1):
+        if num_devices % e or e > cap:
+            continue
+        if all(n % e == 0 for n in expert_counts):
+            out.append(e)
+    return out or [1]
+
+
 def enumerate_layouts(program: Program, num_devices: int,
                       max_tp: Optional[int] = None,
-                      max_pipe: Optional[int] = None) -> List[MeshLayout]:
-    """Every legal (data, fsdp, tp, pipe) MeshLayout for
-    ``num_devices`` (pipe > 1 only when ``max_pipe`` opts the pipeline
-    dimension in)."""
+                      max_pipe: Optional[int] = None,
+                      max_expert: Optional[int] = None
+                      ) -> List[MeshLayout]:
+    """Every legal (data, fsdp, tp, pipe, expert) MeshLayout for
+    ``num_devices`` (pipe > 1 / expert > 1 only when ``max_pipe`` /
+    ``max_expert`` opt those dimensions in)."""
     layouts = []
     for p in legal_pipe_degrees(program, num_devices, max_pipe=max_pipe):
-        for t in legal_tp_degrees(program, num_devices // p,
-                                  max_tp=max_tp):
-            for d, f in _divisor_pairs(num_devices // p // t):
-                layouts.append(MeshLayout(data=d, fsdp=f, tp=t, pipe=p))
+        for e in legal_expert_degrees(program, num_devices // p,
+                                      max_expert=max_expert):
+            for t in legal_tp_degrees(program, num_devices // p // e,
+                                      max_tp=max_tp):
+                for d, f in _divisor_pairs(num_devices // p // e // t):
+                    layouts.append(MeshLayout(data=d, fsdp=f, tp=t,
+                                              pipe=p, expert=e))
     return layouts
 
 
@@ -159,6 +194,7 @@ class PlanConfig:
         self.winner = False
         self.fsdp_report: Dict[str, Any] = {}
         self.pipe_report: Dict[str, Any] = {}
+        self.expert_report: Dict[str, Any] = {}
         self.remat_plan = None             # pipe.RematPlan (remat rows)
         self.error: Optional[str] = None
 
@@ -197,15 +233,21 @@ class PlanConfig:
         return (round(c * 1e9) if c is not None else 2**62,
                 self.wire_bytes if self.wire_bytes is not None else 2**62,
                 -self.layout.data, self.layout.fsdp, self.layout.tp,
-                self.layout.pipe, 1 if self.remat else 0)
+                self.layout.pipe, self.layout.expert,
+                1 if self.remat else 0)
 
     def as_dict(self) -> Dict[str, Any]:
         mb = 1 << 20
         d = {"data": self.layout.data, "fsdp": self.layout.fsdp,
              "tp": self.layout.tp, "pipe": self.layout.pipe,
+             "expert": self.layout.expert,
              "axes": self.layout.sizes,
              "remat": self.remat,
              "fits": bool(self.fits), "winner": bool(self.winner)}
+        if self.expert_report.get("rewritten"):
+            d["expert_exchanges"] = len(self.expert_report["rewritten"])
+            d["expert_sharded_params"] = \
+                len(self.expert_report.get("stamped") or ())
         if self.remat_plan is not None:
             d["remat_plan"] = self.remat_plan.as_dict()
         if self.pipe_report:
@@ -317,7 +359,8 @@ class Plan:
                 if c.cost_s is not None else "       ?"
             lines.append(
                 f" {mark} data={c.layout.data:<3d} fsdp={c.layout.fsdp:<3d} "
-                f"tp={c.layout.tp:<3d} pipe={c.layout.pipe:<3d}"
+                f"tp={c.layout.tp:<3d} pipe={c.layout.pipe:<3d} "
+                f"ep={c.layout.expert:<3d}"
                 f"{'R' if c.remat else ' '} peak {peak}  wire {wire}  "
                 f"cost {exp}"
                 + (f"  [{c.error}]" if c.error else ""))
@@ -360,12 +403,17 @@ def price_config(program: Program, layout: MeshLayout,
                                   exposed_comm_model)
     from .pipe import (apply_pipeline, apply_remat, enumerate_schedules,
                        plan_remat)
+    from ..parallel.moe import apply_expert_sharding
 
     cfg = PlanConfig(layout)
     clone = program.clone()
     strategy = build_strategy or BuildStrategy()
     bubble = 0.0
     try:
+        # expert rewrite FIRST: its dist_attr stamps make the ZeRO-3
+        # pass skip the expert weights (they stay ep-sharded, not fsdp)
+        if layout.expert > 1:
+            cfg.expert_report = apply_expert_sharding(clone, layout)
         if layout.fsdp > 1:
             cfg.fsdp_report = apply_fsdp_sharding(
                 clone, layout, min_shard_numel=min_shard_numel)
@@ -393,6 +441,8 @@ def price_config(program: Program, layout: MeshLayout,
                     break
                 except Exception:
                     clone = program.clone()
+                    if layout.expert > 1:
+                        apply_expert_sharding(clone, layout)
                     if layout.fsdp > 1:
                         apply_fsdp_sharding(
                             clone, layout,
@@ -478,9 +528,12 @@ def _audit_winner_clone(program: Program, winner: PlanConfig,
     from .fsdp import apply_fsdp_sharding
     from .pipe import apply_pipeline
     from .spec_audit import audit_static
+    from ..parallel.moe import apply_expert_sharding
 
     layout = winner.layout
     clone = program.clone()
+    if layout.expert > 1:
+        apply_expert_sharding(clone, layout)
     if layout.fsdp > 1:
         apply_fsdp_sharding(clone, layout,
                             min_shard_numel=min_shard_numel)
@@ -507,7 +560,8 @@ def _audit_winner_clone(program: Program, winner: PlanConfig,
     out = report.as_dict()
     out.pop("coverage", None)   # the registry census isn't per-plan
     out["layout"] = {"data": layout.data, "fsdp": layout.fsdp,
-                     "tp": layout.tp, "pipe": layout.pipe}
+                     "tp": layout.tp, "pipe": layout.pipe,
+                     "expert": layout.expert}
     return out
 
 
@@ -525,6 +579,7 @@ def plan_sharding(program: Program, num_devices: int,
                   module: str = "program",
                   report_path: Optional[str] = None,
                   max_pipe: Optional[int] = None,
+                  max_expert: Optional[int] = None,
                   num_microbatches: int = 1,
                   remat: bool = False,
                   pipe_schedule: str = "1f1b",
@@ -577,7 +632,8 @@ def plan_sharding(program: Program, num_devices: int,
               pipe_shard_weights=pipe_shard_weights)
     configs = []
     for layout in enumerate_layouts(program, num_devices, max_tp=max_tp,
-                                    max_pipe=max_pipe):
+                                    max_pipe=max_pipe,
+                                    max_expert=max_expert):
         cfg = price_config(program, layout, **kw)
         if budget is not None and cfg.est is not None:
             cfg.fits = cfg.est.peak_gb <= budget
@@ -630,6 +686,9 @@ def stamp_winning_layout(program: Program, plan: Plan,
             f"hbm_budget_gb={plan.budget_gb:g} on {plan.num_devices} "
             "device(s); ranked attempts:\n" + plan.report())
     layout = plan.winner.layout
+    if layout.expert > 1:
+        from ..parallel.moe import apply_expert_sharding
+        apply_expert_sharding(program, layout)
     if layout.fsdp > 1:
         from .fsdp import apply_fsdp_sharding
         apply_fsdp_sharding(program, layout,
@@ -660,4 +719,5 @@ def stamp_winning_layout(program: Program, plan: Plan,
 
 __all__ = ["Plan", "PlanConfig", "plan_sharding", "price_config",
            "enumerate_layouts", "legal_tp_degrees", "legal_pipe_degrees",
-           "stamp_winning_layout", "PLAN_FORMAT_VERSION"]
+           "legal_expert_degrees", "stamp_winning_layout",
+           "PLAN_FORMAT_VERSION"]

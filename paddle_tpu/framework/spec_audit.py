@@ -523,6 +523,9 @@ SPEC_KIND_DECOMP = {
     "c_allgather": (("all_gather", 0.5), ("reduce_scatter", 0.5)),
     "fsdp_all_gather": (("all_gather", 0.5), ("reduce_scatter", 0.5)),
     "alltoall": (("all_to_all", 1.0),),
+    # expert exchange (decomposed MoE): its 2 priced passes are the fwd
+    # a2a plus the bwd transposed a2a — both land as HLO all_to_all
+    "c_expert_alltoall": (("all_to_all", 1.0),),
     "pipe_stage_boundary": (("collective_permute", 1.0),),
     "c_broadcast": (("collective_broadcast", 1.0),),
 }

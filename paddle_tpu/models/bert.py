@@ -29,6 +29,15 @@ class BertConfig:
     type_vocab_size: int = 2
     initializer_range: float = 0.02
     dtype: str = "float32"
+    # moe_experts > 0 replaces every FFN with a top-k routed MoE block
+    # (GShard layout, parallel/moe.py) built DENSE — ep comes from the
+    # auto-shard planner (plan_sharding(max_expert=...)) stamping the
+    # c_expert_alltoall pair, never from the model builder
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_group_size: int = 0
+    moe_aux_weight: float = 0.01
 
     @staticmethod
     def base():
@@ -48,6 +57,34 @@ def _init(cfg):
 
 def _attr(name, cfg):
     return ParamAttr(name=name, initializer=_init(cfg))
+
+
+def _ffn_block(x, cfg: BertConfig, name: str, is_test):
+    """Dense two-fc FFN, or (cfg.moe_experts > 0) the routed MoE block.
+    The MoE build is DENSE — ep_degree stays None so the program carries
+    no collectives; the planner's expert rows retrofit the
+    c_expert_alltoall pair via apply_expert_sharding.  The block's aux
+    loss is recorded on the program (parallel.collect_aux_losses drains
+    it in the loss builder)."""
+    d = cfg.hidden_size
+    if cfg.moe_experts:
+        from ..parallel import moe_ffn
+        out, _aux = moe_ffn(
+            x, num_experts=cfg.moe_experts,
+            ffn_hidden=cfg.intermediate_size, top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor, act=cfg.hidden_act,
+            group_size=cfg.moe_group_size,
+            param_attr=_attr(f"{name}_moe", cfg),
+            bias_attr=ParamAttr(name=f"{name}_moe_b"),
+            name=f"{name}_moe")
+        return out
+    ffn = layers.fc(x, cfg.intermediate_size, num_flatten_dims=2,
+                    act=cfg.hidden_act,
+                    param_attr=_attr(f"{name}_ffn1_w", cfg),
+                    bias_attr=ParamAttr(name=f"{name}_ffn1_b"))
+    return layers.fc(ffn, d, num_flatten_dims=2,
+                     param_attr=_attr(f"{name}_ffn2_w", cfg),
+                     bias_attr=ParamAttr(name=f"{name}_ffn2_b"))
 
 
 def encoder_layer(x, attn_bias, cfg: BertConfig, name: str, is_test=False):
@@ -72,13 +109,7 @@ def encoder_layer(x, attn_bias, cfg: BertConfig, name: str, is_test=False):
     x = layers.layer_norm(x + attn_out, begin_norm_axis=2,
                           param_attr=ParamAttr(name=f"{name}_ln1_scale"),
                           bias_attr=ParamAttr(name=f"{name}_ln1_bias"))
-    ffn = layers.fc(x, cfg.intermediate_size, num_flatten_dims=2,
-                    act=cfg.hidden_act,
-                    param_attr=_attr(f"{name}_ffn1_w", cfg),
-                    bias_attr=ParamAttr(name=f"{name}_ffn1_b"))
-    ffn = layers.fc(ffn, d, num_flatten_dims=2,
-                    param_attr=_attr(f"{name}_ffn2_w", cfg),
-                    bias_attr=ParamAttr(name=f"{name}_ffn2_b"))
+    ffn = _ffn_block(x, cfg, name, is_test)
     ffn = layers.dropout(ffn, cfg.hidden_dropout_prob, is_test=is_test,
                          dropout_implementation="upscale_in_train")
     return layers.layer_norm(x + ffn, begin_norm_axis=2,
@@ -208,6 +239,15 @@ def build_pretrain_network(cfg: BertConfig, is_test=False):
                                    cfg, is_test=is_test)
     total, mlm, nsp = bert_pretrain_loss(seq_out, pooled, mask_label,
                                          mask_pos, labels, cfg)
+    if cfg.moe_experts:
+        from ..framework.core import default_main_program
+        from ..parallel import collect_aux_losses
+        aux_terms = collect_aux_losses(default_main_program())
+        if aux_terms:
+            aux = layers.sum(aux_terms) if len(aux_terms) > 1 \
+                else aux_terms[0]
+            total = layers.elementwise_add(
+                total, layers.scale(aux, scale=cfg.moe_aux_weight))
     feeds = [src_ids, pos_ids, sent_ids, input_mask, mask_label, mask_pos,
              labels]
     return feeds, total, mlm, nsp

@@ -147,13 +147,30 @@ def _decoder_layer(x, attn_bias, cfg: BertConfig, name: str,
     x = layers.layer_norm(x + attn_out, begin_norm_axis=2,
                           param_attr=ParamAttr(name=f"{name}_ln1_scale"),
                           bias_attr=ParamAttr(name=f"{name}_ln1_bias"))
-    ffn = layers.fc(x, cfg.intermediate_size, num_flatten_dims=2,
-                    act=cfg.hidden_act,
-                    param_attr=_attr(f"{name}_ffn1_w", cfg),
-                    bias_attr=ParamAttr(name=f"{name}_ffn1_b"))
-    ffn = layers.fc(ffn, d, num_flatten_dims=2,
-                    param_attr=_attr(f"{name}_ffn2_w", cfg),
-                    bias_attr=ParamAttr(name=f"{name}_ffn2_b"))
+    if cfg.moe_experts:
+        # routed MoE FFN on the decode path: dense build (ep_degree
+        # stays None — a served program must be collective-free), the
+        # same expert weights across prefill / decode / chain / chunk
+        # builds via explicit param names, routing fully inside the
+        # moe_dispatch/moe_expert_ffn/moe_combine triple so the chain
+        # body scans over it like any other op
+        from ..parallel import moe_ffn
+        ffn, _aux = moe_ffn(
+            x, num_experts=cfg.moe_experts,
+            ffn_hidden=cfg.intermediate_size, top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor, act=cfg.hidden_act,
+            group_size=cfg.moe_group_size,
+            param_attr=_attr(f"{name}_moe", cfg),
+            bias_attr=ParamAttr(name=f"{name}_moe_b"),
+            name=f"{name}_moe")
+    else:
+        ffn = layers.fc(x, cfg.intermediate_size, num_flatten_dims=2,
+                        act=cfg.hidden_act,
+                        param_attr=_attr(f"{name}_ffn1_w", cfg),
+                        bias_attr=ParamAttr(name=f"{name}_ffn1_b"))
+        ffn = layers.fc(ffn, d, num_flatten_dims=2,
+                        param_attr=_attr(f"{name}_ffn2_w", cfg),
+                        bias_attr=ParamAttr(name=f"{name}_ffn2_b"))
     return layers.layer_norm(x + ffn, begin_norm_axis=2,
                              param_attr=ParamAttr(name=f"{name}_ln2_scale"),
                              bias_attr=ParamAttr(name=f"{name}_ln2_bias"))
@@ -464,9 +481,16 @@ class BertDecoder:
         and the pool layout that produced them agree.  Seed stands in
         for the parameter values (deterministic init)."""
         cfg = self.cfg
-        return (f"{self.name}/seed={self.seed}/L={cfg.num_hidden_layers}"
-                f"/H={cfg.hidden_size}/heads={cfg.num_attention_heads}"
-                f"/V={cfg.vocab_size}/dtype={cfg.dtype}/bs={block_size}")
+        key = (f"{self.name}/seed={self.seed}/L={cfg.num_hidden_layers}"
+               f"/H={cfg.hidden_size}/heads={cfg.num_attention_heads}"
+               f"/V={cfg.vocab_size}/dtype={cfg.dtype}/bs={block_size}")
+        if cfg.moe_experts:
+            # routed FFNs change what a cached block's K/V mean — an MoE
+            # and a dense build of the same geometry must never share
+            # prefix-cache entries
+            key += (f"/moe=E{cfg.moe_experts}k{cfg.moe_top_k}"
+                    f"cf{cfg.moe_capacity_factor}")
+        return key
 
     def build(self, num_blocks: int, block_size: int,
               max_blocks_per_seq: int,

@@ -39,6 +39,7 @@ the surrounding block, Switch-Transformer semantics).
 
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
@@ -158,6 +159,235 @@ def moe_ffn_fn(xf, gate_w, w1, w2, b1=None, b2=None, *, top_k=2,
         ye = ye.reshape(e, g, capacity, m)
     out = jnp.einsum("gsec,egcm->gsm", combine.astype(ye.dtype), ye)
     return out.reshape(n, m).astype(xf.dtype), aux.astype(jnp.float32)
+
+
+def _moe_static_dims(x_shape, num_experts, top_k, capacity_factor,
+                     group_size):
+    """Static (N, G, S_g, C) for declared shapes / infer specs; -1 where
+    the token count is unknown (dynamic leading dims).  Must mirror the
+    runtime arithmetic in ``_moe_dispatch`` exactly — verify_program's
+    ``moe-axis-capacity-mismatch`` diagnostic cross-checks the two."""
+    lead = [int(d) for d in x_shape[:-1]]
+    if lead and all(d > 0 for d in lead):
+        n = 1
+        for d in lead:
+            n *= d
+    else:
+        n = -1
+    e = int(num_experts)
+    if n > 0:
+        sg = int(group_size) or _group_size(n)
+        g = n // sg if n % sg == 0 else -1
+    else:
+        sg = int(group_size) or -1
+        g = -1
+    if sg > 0:
+        c = max(1, int(math.ceil(
+            float(capacity_factor) * int(top_k) * sg / e)))
+    else:
+        c = -1
+    return n, g, sg, c
+
+
+# ---------------------------------------------------------------------------
+# decomposed MoE pipeline: dispatch → c_expert_alltoall → expert FFN →
+# c_expert_alltoall → combine.  Same math as the fused moe_ffn (bitwise,
+# modulo reshape grouping) but the expert exchange is its own registry op,
+# so the wire model prices it, spec_audit reconciles it against the
+# StableHLO census, and the CompressionSpec quant ladder applies to it.
+# ---------------------------------------------------------------------------
+
+
+@register("moe_dispatch")
+def _moe_dispatch(ctx, ins, attrs):
+    """Route tokens into per-expert blocks.  Xe is laid out dest-major
+    ([E_global, G·C, M]) so a leading-dim reshape is exactly the per-
+    destination split the expert all_to_all needs."""
+    a = x(ins, "X")
+    gate_w = x(ins, "GateW")
+    e = int(attrs["num_experts"])
+    top_k = int(attrs.get("top_k", 2))
+    cf = float(attrs.get("capacity_factor", 1.25))
+    m = a.shape[-1]
+    xf = a.reshape(-1, m)
+    n = xf.shape[0]
+    sg = int(attrs.get("group_size", 0)) or _group_size(n)
+    if n % sg:
+        raise ValueError(
+            f"moe_dispatch: group_size {sg} does not divide token "
+            f"count {n}")
+    g = n // sg
+    capacity = max(1, int(math.ceil(cf * top_k * sg / e)))
+    xg = xf.reshape(g, sg, m)
+    gates = jax.nn.softmax(
+        jnp.einsum("gsm,me->gse", xg.astype(jnp.float32),
+                   gate_w.astype(jnp.float32)), axis=-1)
+    dispatch, combine, me, ce = _route(gates, top_k, capacity)
+    aux = e * jnp.sum(me * ce)
+    xe = jnp.einsum("gsec,gsm->egcm", dispatch.astype(a.dtype), xg)
+    return {"Xe": xe.reshape(e, g * capacity, m),
+            "Combine": combine.astype(jnp.float32),
+            "AuxLoss": aux.astype(jnp.float32)}
+
+
+def _expert_exchange(arr, axis, n, direction):
+    """The expert all_to_all on a dest-major [E, B, M] block tensor.
+
+    dispatch: [E_global, b, m] → [E/n, n·b, m] (each device keeps its
+    E/n experts, receives every peer's token block for them); combine is
+    the exact inverse.  Flattened-equivalent to the fused moe_ffn_fn
+    sequences, so dispatch∘combine == identity — which is also why the
+    VJP of one direction is the other direction applied to the
+    cotangent."""
+    if direction == "combine":
+        e_l, bb, m = arr.shape
+        arr = arr.reshape(e_l, n, bb // n, m).transpose(1, 0, 2, 3)
+        arr = lax.all_to_all(arr, axis, split_axis=0, concat_axis=0,
+                             tiled=False)
+        return arr.reshape(n * e_l, bb // n, m)
+    e, b, m = arr.shape
+    arr = arr.reshape(n, e // n, b, m)
+    arr = lax.all_to_all(arr, axis, split_axis=0, concat_axis=0,
+                         tiled=False)
+    return arr.transpose(1, 0, 2, 3).reshape(e // n, n * b, m)
+
+
+def _quant_exchange_impl(arr, axis, n, direction, spec_key, use_kernel):
+    """Blockwise-quantized expert exchange (EQuARX applied to a2a): each
+    per-destination slice is padded to whole quantization blocks,
+    quantized (payload + f32 scales), both ride ONE all_to_all each, and
+    the receive side dequantizes via the PR 11 dequant-accumulate route
+    (n=1 degenerates to a fused dequant pass)."""
+    from .quantize_wire import CompressionSpec, quantize_blockwise
+    from .collective_ops import _recv_accumulate
+    spec = CompressionSpec(dtype=spec_key[0], block_size=spec_key[1])
+    orig = arr.dtype
+    if direction == "combine":
+        e_l, bb, m = arr.shape
+        parts = arr.reshape(e_l, n, bb // n, m).transpose(1, 0, 2, 3)
+        recv_shape = (n, e_l, bb // n, m)
+    else:
+        e, b, m = arr.shape
+        parts = arr.reshape(n, e // n, b, m)
+        recv_shape = (n, e // n, b, m)
+    parts = parts.reshape(n, -1)
+    slice_numel = parts.shape[1]
+    bs = spec.block_size
+    k = -(-slice_numel // bs)                 # blocks per dest slice
+    pad = k * bs - slice_numel
+    pf = parts.astype(jnp.float32)
+    if pad:
+        # pad PER SLICE (not the flat whole): every destination's payload
+        # must stay a whole number of blocks or the post-a2a rows would
+        # straddle block boundaries
+        pf = jnp.pad(pf, ((0, 0), (0, pad)))
+    q, s = quantize_blockwise(pf.reshape(-1), spec)
+    qx = lax.all_to_all(q.reshape(n, k, -1), axis, split_axis=0,
+                        concat_axis=0)
+    sx = lax.all_to_all(s.reshape(n, k), axis, split_axis=0,
+                        concat_axis=0)
+    full = _recv_accumulate(qx, sx, spec, 1, n * k, use_kernel)
+    full = full.reshape(n, k * bs)
+    if pad:
+        full = full[:, :slice_numel]
+    recv = full.reshape(recv_shape)
+    if direction == "combine":
+        out = recv.reshape(n * recv_shape[1], recv_shape[2], recv.shape[3])
+    else:
+        out = recv.transpose(1, 0, 2, 3).reshape(
+            recv_shape[1], n * recv_shape[2], recv.shape[3])
+    return out.astype(orig)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _quant_expert_exchange(arr, axis, n, direction, spec_key, use_kernel):
+    """custom_vjp wrapper: the exchange is a cross-device permutation, so
+    its VJP is the opposite-direction exchange of the cotangent — also
+    quantized, which is what makes the BACKWARD a2a ride the wire tier
+    too.  Rounding is deterministic here (no stochastic-rounding key
+    threading through custom_vjp); spec_key = (dtype, block_size)."""
+    return _quant_exchange_impl(arr, axis, n, direction, spec_key,
+                                use_kernel)
+
+
+def _quant_exchange_fwd(arr, axis, n, direction, spec_key, use_kernel):
+    return _quant_expert_exchange(arr, axis, n, direction, spec_key,
+                                  use_kernel), None
+
+
+def _quant_exchange_bwd(axis, n, direction, spec_key, use_kernel, _res,
+                        ct):
+    back = "combine" if direction == "dispatch" else "dispatch"
+    return (_quant_expert_exchange(ct, axis, n, back, spec_key,
+                                   use_kernel),)
+
+
+_quant_expert_exchange.defvjp(_quant_exchange_fwd, _quant_exchange_bwd)
+
+
+@register("c_expert_alltoall")
+def _c_expert_alltoall(ctx, ins, attrs):
+    """The expert exchange as a first-class collective op.  Identity off
+    mesh / when the axis is absent (single-device run of an ep-stamped
+    program).  ``direction`` ∈ {dispatch, combine}; an optional
+    ``quant_spec`` attr rides the CompressionSpec ladder (bf16 = cast
+    path, int8/int4 = blockwise payload + scales)."""
+    a = x(ins, "X")
+    ep_axis = attrs.get("_axis_name")
+    if not ep_axis or not ctx.axis_names or ep_axis not in ctx.axis_names:
+        return {"Out": a}
+    n = dict(zip(ctx.mesh.axis_names, ctx.mesh.devices.shape))[ep_axis]
+    if n <= 1:
+        return {"Out": a}
+    direction = attrs.get("direction", "dispatch")
+    from .quantize_wire import quant_spec_of
+    spec = quant_spec_of(attrs)
+    if spec is not None and jnp.issubdtype(a.dtype, jnp.floating):
+        if spec.dtype == "bfloat16":
+            out = _expert_exchange(a.astype(jnp.bfloat16), ep_axis, n,
+                                   direction)
+            return {"Out": out.astype(a.dtype)}
+        from .collective_ops import _quant_route
+        use_kernel = _quant_route("c_expert_alltoall", ins, attrs,
+                                  ep_axis)
+        out = _quant_expert_exchange(a, ep_axis, n, direction,
+                                     (spec.dtype, spec.block_size),
+                                     use_kernel)
+        return {"Out": out}
+    return {"Out": _expert_exchange(a, ep_axis, n, direction)}
+
+
+@register("moe_expert_ffn")
+def _moe_expert_ffn(ctx, ins, attrs):
+    """Per-expert FFN on dispatched blocks [E_local, B, M] — batched
+    matmuls through the dtype-aware path (bf16 operands stay bf16 in fwd
+    AND bwd dots)."""
+    xe = x(ins, "Xe")
+    w1, w2 = x(ins, "W1"), x(ins, "W2")
+    b1, b2 = x(ins, "B1"), x(ins, "B2")
+    from .math_ops import _matmul_any
+    h = _matmul_any(xe, w1)
+    if b1 is not None:
+        h = h + b1[:, None, :]
+    h = _ACTS[attrs.get("act", "gelu")](h)
+    ye = _matmul_any(h, w2)
+    if b2 is not None:
+        ye = ye + b2[:, None, :]
+    return {"Out": ye}
+
+
+@register("moe_combine")
+def _moe_combine(ctx, ins, attrs):
+    """Weighted un-route of expert outputs back to token order.  X is a
+    shape/dtype reference only (no data copied) so the declared output
+    matches the block input exactly."""
+    ye = x(ins, "Ye")
+    comb = x(ins, "Combine")
+    ref = x(ins, "X")
+    g, s, e, c = comb.shape
+    ye = ye.reshape(e, g, c, ye.shape[-1])
+    out = jnp.einsum("gsec,egcm->gsm", comb.astype(ye.dtype), ye)
+    return {"Out": out.reshape(ref.shape).astype(ref.dtype)}
 
 
 @register("moe_ffn")

@@ -871,6 +871,101 @@ def _infer_pipe_boundary(ins, attrs):
     return {"Out": [VarSig(v.shape, v.dtype) for v in xs]}
 
 
+def _infer_argsort(ins, attrs):
+    v = _sig(ins, "X")
+    if v is None or v.shape is None:
+        return None
+    return {"Out": [VarSig(v.shape, v.dtype)],
+            "Indices": [VarSig(v.shape, "int64")]}
+
+
+# -- MoE decomposed pipeline (ops/moe_ops.py) -------------------------------
+#
+# The static dims mirror the runtime arithmetic in moe_dispatch exactly
+# (same _moe_static_dims helper), so the shape ladder and the census
+# price the capacity-factor geometry the kernels actually run.
+
+
+def _moe_spec_dims(ins, attrs):
+    """(n, g, sg, c, e, m) from the X/GateW sigs + attrs, or None."""
+    xv, gw = _sig(ins, "X"), _sig(ins, "GateW")
+    if xv is None or xv.shape is None or gw is None or gw.shape is None \
+            or len(gw.shape) != 2:
+        return None
+    e = int(attrs.get("num_experts", gw.shape[1]))
+    if gw.shape[1] != e and gw.shape[1] > 0:
+        raise SpecMismatch(
+            f"moe_dispatch: GateW expert dim {gw.shape[1]} != "
+            f"num_experts attr {e}", kind="shape")
+    m = xv.shape[-1]
+    if m > 0 and gw.shape[0] > 0 and gw.shape[0] != m:
+        raise SpecMismatch(
+            f"moe_dispatch: GateW model dim {gw.shape[0]} != X last "
+            f"dim {m}", kind="shape")
+    from .moe_ops import _moe_static_dims
+    n, g, sg, c = _moe_static_dims(
+        xv.shape, e, attrs.get("top_k", 2),
+        attrs.get("capacity_factor", 1.25), attrs.get("group_size", 0))
+    return n, g, sg, c, e, m
+
+
+def _infer_moe_dispatch(ins, attrs):
+    dims = _moe_spec_dims(ins, attrs)
+    if dims is None:
+        return None
+    n, g, sg, c, e, m = dims
+    xv = _sig(ins, "X")
+    gc = g * c if (g > 0 and c > 0) else -1
+    return {"Xe": [VarSig((e, gc, m), xv.dtype)],
+            "Combine": [VarSig((g, sg, e, c), "float32")],
+            "AuxLoss": [VarSig((), "float32")]}
+
+
+def _flops_moe_dispatch(ins, outs, attrs):
+    """Gate GEMM (2·N·m·E) + the dispatch one-hot einsum
+    (2·G·S·E·C·m = 2·N·E·C·m) — the capacity-factor geometry."""
+    dims = _moe_spec_dims(ins, attrs)
+    if dims is None:
+        return None
+    n, g, sg, c, e, m = dims
+    if min(n, c, e, m) <= 0:
+        return None
+    return 2.0 * n * m * e + 2.0 * n * e * c * m
+
+
+def _infer_moe_expert_ffn(ins, attrs):
+    xe, w1, w2 = _sig(ins, "Xe"), _sig(ins, "W1"), _sig(ins, "W2")
+    if xe is None or xe.shape is None:
+        return None
+    for w, tag in ((w1, "W1"), (w2, "W2")):
+        if w is not None and w.shape is not None and len(w.shape) != 3:
+            raise SpecMismatch(
+                f"moe_expert_ffn: {tag} must be 3-D [E, in, out], got "
+                f"{list(w.shape)}", kind="shape")
+    return {"Out": [VarSig(xe.shape, xe.dtype)]}
+
+
+def _flops_moe_expert_ffn(ins, outs, attrs):
+    """Two batched GEMMs over the dispatched blocks: 4·E·B·m·h, where
+    B = G·C carries the capacity factor."""
+    xe, w1 = _sig(ins, "Xe"), _sig(ins, "W1")
+    if xe is None or xe.shape is None or not _known(xe.shape) \
+            or w1 is None or w1.shape is None or not _known(w1.shape):
+        return None
+    e, b, m = xe.shape
+    h = w1.shape[-1]
+    return 4.0 * e * b * m * h
+
+
+def _flops_moe_combine(ins, outs, attrs):
+    """The combine einsum gsec,egcm→gsm: 2·G·S·E·C·m."""
+    comb, xv = _sig(ins, "Combine"), _sig(ins, "X")
+    if comb is None or comb.shape is None or not _known(comb.shape) \
+            or xv is None or xv.shape is None or xv.shape[-1] <= 0:
+        return None
+    return 2.0 * _numel(comb.shape) * xv.shape[-1]
+
+
 def _infer_c_embedding(ins, attrs):
     """Vocab-parallel embedding lookup: Out = Ids.shape + [dim] (the
     row dim is vocab-sharded; the psum restores the full [.., dim])."""
@@ -1015,6 +1110,11 @@ _WIRE_SPECS = {
     "pipe_stage_boundary": _pipe_boundary_wire,
     # MoE/reshard dispatch: fwd a2a + the bwd a2a transpose, (n-1)/n each
     "alltoall": _collective_wire(2),
+    # expert exchange (decomposed MoE): each of the dispatch/combine ops
+    # moves its payload once forward and once in the backward transpose,
+    # (n-1)/n each — the pair therefore prices 4 a2a passes per step.
+    # quant_spec reprices the payload at the CompressionSpec tier.
+    "c_expert_alltoall": _collective_wire(2),
     # init-time weight sync: one ring broadcast pass, no backward
     "c_broadcast": _collective_wire(1),
     "c_embedding": _c_embedding_wire,
@@ -1418,6 +1518,11 @@ def register_default_specs():
     op_spec("split", infer=_infer_split)
     op_spec("top_k", infer=_infer_top_k)
     op_spec("one_hot", infer=_infer_one_hot)
+    # routing-primitive tail the MoE census exposes (_route lowers to
+    # one_hot/cumsum/argsort-shaped HLO): shape-transparent scan and the
+    # sort pair — specced so the SPEC_AUDIT coverage ratchet advances
+    op_spec("cumsum", infer=same_as_input(), flops=_flops_elemwise(1))
+    op_spec("argsort", infer=_infer_argsort)
     op_spec("fill_zeros_like", infer=_infer_fill_zeros_like)
     op_spec("where", infer=_infer_where)
     op_spec("fill_constant", infer=from_shape_attr())
@@ -1437,8 +1542,8 @@ def register_default_specs():
     for name in ("feed", "fetch", "backward", "pipeline", "assign_value",
                  "fill_constant_batch_size_like", "expand", "expand_as",
                  "slice", "strided_slice", "stack", "gather", "gather_nd",
-                 "scatter", "arg_max", "arg_min", "argsort", "shape",
-                 "accuracy", "auc", "increment", "cumsum", "put_along_axis",
+                 "scatter", "arg_max", "arg_min", "shape",
+                 "accuracy", "auc", "increment", "put_along_axis",
                  "take_along_axis", "tile", "range", "linspace",
                  "while_loop", "conditional_block", "switch_case",
                  "static_rnn", "py_func", "print", "beam_gather",
@@ -1485,6 +1590,19 @@ def register_default_specs():
     op_spec("quant_reduce_scatter", infer=None, collective=True,
             wire=_WIRE_SPECS["quant_reduce_scatter"],
             pallas=(_PL_DEQUANT_ACC,))
+    # decomposed MoE pipeline: the expert exchange is the collective
+    # (global identity — a cross-device permutation, so its quantized
+    # tier is sound blockwise); dispatch/ffn/combine are local compute
+    # with the capacity-factor flops the planner prices
+    op_spec("c_expert_alltoall", infer=_infer_collective_same,
+            collective=True, wire=_WIRE_SPECS["c_expert_alltoall"],
+            pallas=(_PL_DEQUANT_ACC,))
+    op_spec("moe_dispatch", infer=_infer_moe_dispatch,
+            flops=_flops_moe_dispatch)
+    op_spec("moe_expert_ffn", infer=_infer_moe_expert_ffn,
+            flops=_flops_moe_expert_ffn)
+    op_spec("moe_combine", infer=same_as_input(),
+            flops=_flops_moe_combine)
     # vocab-parallel embedding: Out = Ids.shape + [dim] exactly like
     # lookup_table_v2 (the psum keeps the global [.., dim] width).
     # Without this the tp-BERT shape propagation stalled at op 0 and

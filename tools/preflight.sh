@@ -67,6 +67,13 @@ echo "   priced, winner min-EXPOSED-comm among budget-fitting, ties to"
 echo "   fewer wire bytes, 0 compiles) =="
 python tools/plan_probe.py --selftest
 
+echo "== preflight: MoE expert-parallel probe (dp8 MoE BERT-tiny: planner"
+echo "   expert rows priced, budget rejects every dense row, winner dp2.ep4"
+echo "   with 0 compiles; expert all_to_all wire census fp32/bf16/int8"
+echo "   int8 >=3.5x; MoE decode greedy parity + AOT warm restart 0 fresh"
+echo "   compiles -> MOE_SEARCH_r23.json) =="
+python tools/moe_probe.py --selftest
+
 echo "== preflight: overlap census (dp8 BERT ready-order grad sync: >=4"
 echo "   interleaved collectives each preceding later backward compute,"
 echo "   loss bit-parity vs the tail-fused path) =="

@@ -256,6 +256,62 @@ def selftest(memory=False) -> int:
               "collective was not rejected")
         return 1
 
+    # MoE expert-exchange lints (parallel/moe.py): an exchange naming a
+    # mesh axis the stamped MeshLayout lacks must error (at run time it
+    # silently degrades to the identity — remote experts never fire), an
+    # expert count that does not divide the axis must error (ragged
+    # expert slices), a QUANTIZED exchange must NOT fire
+    # quant-collective-non-sum (an all_to_all is a permutation — every
+    # receive slice dequantizes whole), and an integer payload on the
+    # quantized exchange reuses quant-collective-integer
+    from paddle_tpu.framework.analysis import (MOE_AXIS_CAPACITY_MISMATCH,
+                                               MOE_AXIS_UNKNOWN,
+                                               QUANT_NON_SUM)
+    from paddle_tpu.framework.mesh_layout import MeshLayout
+    mp = Program()
+    mb = mp.global_block()
+    mb.create_var(name="xe_bad", shape=(6, 8, 4), dtype="float32",
+                  is_data=True)
+    mb.create_var(name="xe_q", shape=(8, 8, 4), dtype="float32",
+                  is_data=True)
+    mb.create_var(name="xe_int", shape=(8, 8, 4), dtype="int32",
+                  is_data=True)
+    mattrs = {"ring_id": 0, "direction": "dispatch"}
+    qspec = {"dtype": "int8", "block_size": 64}
+    mb.append_op(type="c_expert_alltoall", inputs={"X": ["xe_bad"]},
+                 outputs={"Out": ["xe_bad"]},
+                 attrs=dict(mattrs, _axis_name="xx"))
+    mb.append_op(type="c_expert_alltoall", inputs={"X": ["xe_bad"]},
+                 outputs={"Out": ["xe_bad"]},
+                 attrs=dict(mattrs, _axis_name="ep"))
+    mb.append_op(type="c_expert_alltoall", inputs={"X": ["xe_q"]},
+                 outputs={"Out": ["xe_q"]},
+                 attrs=dict(mattrs, _axis_name="ep", quant_spec=qspec))
+    mb.append_op(type="c_expert_alltoall", inputs={"X": ["xe_int"]},
+                 outputs={"Out": ["xe_int"]},
+                 attrs=dict(mattrs, _axis_name="ep", quant_spec=qspec))
+    mp._mesh_layout = MeshLayout(data=2, expert=4)
+    mres = verify_program(mp)
+    unknown = mres.by_code(MOE_AXIS_UNKNOWN)
+    if len(unknown) != 1 or "xx" not in unknown[0].message:
+        print(f"proglint selftest: moe-axis-unknown fired "
+              f"{len(unknown)}x (expected once, on the 'xx' exchange)")
+        return 1
+    capm = mres.by_code(MOE_AXIS_CAPACITY_MISMATCH)
+    if len(capm) != 1 or "6" not in capm[0].message:
+        print(f"proglint selftest: moe-axis-capacity-mismatch fired "
+              f"{len(capm)}x (expected once, on 6 experts over ep=4)")
+        return 1
+    if mres.by_code(QUANT_NON_SUM):
+        print("proglint selftest: quantized expert all_to_all flagged "
+              "as a non-sum reduction (it is a sound permutation)")
+        return 1
+    if not any("xe_int" in d.message
+               for d in mres.by_code(QUANT_COLLECTIVE_INTEGER)):
+        print("proglint selftest: integer payload on the quantized "
+              "expert all_to_all was not rejected")
+        return 1
+
     # overlap-scheduling lints (the ready-order grad-sync pass): a
     # (dtype, axes) group that coalesced into ONE overlap bucket must
     # warn (a lone collective has nothing to interleave with), a
