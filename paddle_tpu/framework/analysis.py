@@ -101,6 +101,14 @@ INFERENCE_DONATED_READ = "inference-donated-read"
 DECODE_STATE_WRITE = "decode-state-write"
 DECODE_CACHE_UNDECLARED = "decode-cache-undeclared"
 DECODE_CHAIN_MISPLACED = "decode-chain-misplaced"
+# launch audit (framework/launch_audit.py): per-rank collective
+# timelines proven mutually compatible and deadlock-free, and launch
+# fingerprints proven identical, before the first collective fires —
+# the static answer to the silent pod-wide NCCL-style hang (see
+# MIGRATION.md "Launch audit mapping")
+LAUNCH_SCHEDULE_DIVERGENCE = "launch-schedule-divergence"
+LAUNCH_DEADLOCK_CYCLE = "launch-deadlock-cycle"
+LAUNCH_FINGERPRINT_DRIFT = "launch-fingerprint-drift"
 
 #: meta-ops interpreted by the executor itself, not the registry
 META_OPS = frozenset({"feed", "fetch", "backward", "pipeline"})
@@ -912,50 +920,87 @@ def verify_moe(program: Program, result: VerifyResult):
                 op, block.idx, idx)
 
 
+def _collective_sig_ops(program: Program
+                        ) -> List[Tuple[Tuple, Operator, int, int]]:
+    """(signature, op, block idx, op idx) per collective op of the
+    global block — the anchored form of :func:`collective_signature`."""
+    collectives = _collective_types()
+    block = program.global_block()
+    out: List[Tuple[Tuple, Operator, int, int]] = []
+    for idx, op in enumerate(block.ops):
+        if op.type not in collectives:
+            continue
+        axes = op.attrs.get("_axis_name")
+        if isinstance(axes, (list, tuple)):
+            axes = tuple(axes)
+        perm = op.attrs.get("perm")
+        if perm:
+            perm = tuple(tuple(int(x) for x in p) for p in perm)
+        elif op.type == "collective_permute":
+            perm = ("shift", int(op.attrs.get("shift", 1)))
+        elif op.type == "pipe_stage_boundary":
+            perm = ("cut", int(op.attrs.get("_pipe_cut", 0)))
+        else:
+            perm = None
+        groups = op.attrs.get("replica_groups") \
+            or op.attrs.get("rank_groups")
+        if groups:
+            groups = tuple(tuple(int(r) for r in g) for g in groups)
+        sig = (op.type, axes, op.attrs.get("ring_id", 0),
+               tuple(op.input_names()), perm, groups or None)
+        out.append((sig, op, block.idx, idx))
+    return out
+
+
 def collective_signature(program: Program) -> List[Tuple]:
     """The ordered collective schedule of a program: (op type, reduce
-    axes, ring id, operand names) per collective op.  Operand names are
-    part of the schedule — a bucketing pass that splits or reorders the
-    same grads differently on one rank deadlocks the mesh even though
-    the op kinds agree.  Two clones of one program running on different
-    ranks MUST have identical signatures."""
-    collectives = _collective_types()
-    sig = []
-    for op in program.global_block().ops:
-        if op.type in collectives:
-            axes = op.attrs.get("_axis_name")
-            if isinstance(axes, (list, tuple)):
-                axes = tuple(axes)
-            sig.append((op.type, axes, op.attrs.get("ring_id", 0),
-                        tuple(op.input_names())))
-    return sig
+    axes, ring id, operand names, permutation table, replica groups)
+    per collective op.  Operand names are part of the schedule — a
+    bucketing pass that splits or reorders the same grads differently
+    on one rank deadlocks the mesh even though the op kinds agree; so
+    are the ppermute permutation table and replica groups — ranks that
+    agree on kind and order but disagree on WHO exchanges with whom
+    (a pipe-hop reorder, a regrouped reduce) rendezvous mismatched
+    peers.  Two clones of one program running on different ranks MUST
+    have identical signatures."""
+    return [s for s, _op, _b, _i in _collective_sig_ops(program)]
 
 
 def check_collective_consistency(programs: Sequence[Program],
                                  result: Optional[VerifyResult] = None
                                  ) -> VerifyResult:
     """Compare the collective schedules of program clones (one per rank /
-    per pass variant).  Divergence — different op order, bucket split, or
-    reduce axes — is the cross-rank deadlock class the runtime cannot
-    detect (every rank blocks in a different collective)."""
+    per pass variant).  Divergence — different op order, bucket split,
+    reduce axes, ppermute permutation table or replica groups — is the
+    cross-rank deadlock class the runtime cannot detect (every rank
+    blocks in a different collective).  The diagnostic is anchored to
+    the diverging op's creation site."""
     result = result or VerifyResult()
     if len(programs) < 2:
         return result
-    base = collective_signature(programs[0])
+    base = _collective_sig_ops(programs[0])
+    base_sig = [s for s, _op, _b, _i in base]
     for i, p in enumerate(programs[1:], start=1):
-        sig = collective_signature(p)
-        if sig != base:
+        sig_ops = _collective_sig_ops(p)
+        sig = [s for s, _op, _b, _i in sig_ops]
+        if sig != base_sig:
             # find the first divergence point for the message
             j = 0
-            while j < min(len(base), len(sig)) and base[j] == sig[j]:
+            while j < min(len(base_sig), len(sig)) \
+                    and base_sig[j] == sig[j]:
                 j += 1
-            a = base[j] if j < len(base) else "<end of schedule>"
+            a = base_sig[j] if j < len(base_sig) else "<end of schedule>"
             b = sig[j] if j < len(sig) else "<end of schedule>"
+            anchor = sig_ops[j] if j < len(sig_ops) \
+                else (base[j] if j < len(base) else None)
+            op, bidx, oidx = (anchor[1], anchor[2], anchor[3]) \
+                if anchor is not None else (None, 0, -1)
             result.add(
                 "error", COLLECTIVE_SEQ_DIVERGENCE,
                 f"program clone #{i} diverges from clone #0 at collective "
-                f"#{j}: {a} vs {b} ({len(base)} vs {len(sig)} collectives "
-                f"total) — ranks would deadlock mid-step")
+                f"#{j}: {a} vs {b} ({len(base_sig)} vs {len(sig)} "
+                f"collectives total) — ranks would deadlock mid-step",
+                op, bidx, oidx)
     return result
 
 
@@ -1158,6 +1203,12 @@ def verify_program(program: Program, startup: Optional[Program] = None,
     verify_shard_layout(program, result)
     verify_moe(program, result)
     verify_pipeline(program, result)
+    # launch audit (framework/launch_audit.py): pipelined programs get
+    # their stamped schedule expanded into per-rank timelines and proven
+    # compatible + deadlock-free; collectives under divergent control
+    # flow get their hang proven in the wait-for game
+    from .launch_audit import verify_launch
+    verify_launch(program, result)
     return result
 
 
@@ -1353,8 +1404,19 @@ def verify_cached(program: Program, feed_names: Iterable[str] = (),
     layout = getattr(program, "_mesh_layout", None)
     mesh_axes = tuple(sorted(layout.sizes.items())) \
         if layout is not None else ()
+    # the pipe schedule participates for the same reason: a replanner
+    # that restamps the schedule family or microbatch count on the
+    # backward op (without bumping the program version) changes the
+    # per-rank collective timelines — the launch audit must re-prove
+    # them, not reuse the stale verdict
+    bw = next((op for op in program.global_block().ops
+               if op.type == "backward"), None)
+    pipe_key = (bw.attrs.get("pipe_schedule"),
+                bw.attrs.get("pipe_microbatches"),
+                bw.attrs.get("pipe_stages")) if bw is not None else ()
     key = (program._uid, program._version,
-           tuple(sorted(feed_names)), tuple(fetch_names), mesh_axes)
+           tuple(sorted(feed_names)), tuple(fetch_names), mesh_axes,
+           pipe_key)
     result = _VERIFY_CACHE.get(key)
     if result is None:
         VERIFY_STATS["runs"] += 1
@@ -1639,4 +1701,6 @@ __all__ = [
     "RESHARD_CANDIDATE_ORDER", "RESHARD_NOOP",
     "SPEC_DRIFT_SHAPE", "SPEC_DRIFT_FLOPS", "SPEC_DRIFT_WIRE",
     "SPEC_DRIFT_MEM",
+    "LAUNCH_SCHEDULE_DIVERGENCE", "LAUNCH_DEADLOCK_CYCLE",
+    "LAUNCH_FINGERPRINT_DRIFT",
 ]

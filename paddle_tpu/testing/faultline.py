@@ -63,10 +63,16 @@ SEAMS: Dict[str, str] = {
     "reshard_execute": (
         "reshard.execute_reshard, between per-var transfers: raise or "
         "deliver a signal mid-restore (the preemption-atomicity drill)"),
+    "rank_divergence": (
+        "launch_audit.verify_rank_agreement, before the fingerprint "
+        "all-gather: perturb THIS rank's launch fingerprint "
+        "symbolically (params: mode='bucket_reorder'|'flag_flip') — "
+        "the rendezvous must abort with the divergence named, not "
+        "hang (trace-time; the divergent program is never built)"),
 }
 
 #: trace-time seams return their spec from crossing() instead of acting
-_TRACE_SEAMS = frozenset(["grad_nonfinite"])
+_TRACE_SEAMS = frozenset(["grad_nonfinite", "rank_divergence"])
 
 _ARMED: Dict[str, "FaultSpec"] = {}
 _EPOCH = [0]

@@ -269,6 +269,64 @@ def test_collective_sequence_divergence_across_clones():
     assert not check_collective_consistency([a, c]).ok
 
 
+def test_collective_perm_table_and_replica_group_divergence():
+    """Ranks that agree on collective kind and order but disagree on
+    WHO exchanges with whom — a flipped permute direction or regrouped
+    reduce — rendezvous mismatched peers; the signature compares perm
+    tables and replica groups, anchored to the diverging op."""
+
+    def build(shift=1, groups=None):
+        p = Program()
+        b = p.global_block()
+        b.create_var(name="x", shape=(8,), is_data=True)
+        b.append_op(type="collective_permute", inputs={"X": ["x"]},
+                    outputs={"Out": ["x"]},
+                    attrs={"ring_id": 0, "_axis_name": "pp",
+                           "shift": shift})
+        attrs = {"ring_id": 1, "_axis_name": "dp"}
+        if groups:
+            attrs["replica_groups"] = groups
+        b.append_op(type="c_allreduce_sum", inputs={"X": ["x"]},
+                    outputs={"Out": ["x"]}, attrs=attrs)
+        return p
+
+    assert check_collective_consistency([build(), build()]).ok
+    r = check_collective_consistency([build(), build(shift=-1)])
+    d = _one(r, COLLECTIVE_SEQ_DIVERGENCE)
+    _assert_anchored(d, "collective_permute")
+    r = check_collective_consistency(
+        [build(groups=[[0, 1], [2, 3]]), build(groups=[[0, 2], [1, 3]])])
+    d = _one(r, COLLECTIVE_SEQ_DIVERGENCE)
+    _assert_anchored(d, "c_allreduce_sum")
+
+
+def test_pipe_hop_reorder_divergence_anchored():
+    """Two ranks whose stage-cut passes emitted the SAME boundary hops
+    in different cut order: kind/ring/operands all agree, only the
+    (cut → peer-pair) permutation differs — the regression the perm
+    channel of the signature exists to catch."""
+
+    def build(reverse):
+        p = Program()
+        b = p.global_block()
+        b.create_var(name="act", shape=(8,), is_data=True)
+        cuts = (1, 0) if reverse else (0, 1)
+        for cut in cuts:
+            b.append_op(type="pipe_stage_boundary",
+                        inputs={"X": ["act"]}, outputs={"Out": ["act"]},
+                        attrs={"ring_id": 0, "_axis_name": "pipe",
+                               "_pipe_cut": cut, "_pipe_stage": cut,
+                               "boundary_bytes": 32})
+        return p
+
+    assert check_collective_consistency([build(False),
+                                         build(False)]).ok
+    r = check_collective_consistency([build(False), build(True)])
+    d = _one(r, COLLECTIVE_SEQ_DIVERGENCE)
+    _assert_anchored(d, "pipe_stage_boundary")
+    assert "cut" in d.message
+
+
 # ---------------------------------------------------------------------------
 # satellites: create_var conflicts, _prune through sub-blocks
 # ---------------------------------------------------------------------------
@@ -501,6 +559,41 @@ def test_verify_cache_keyed_on_mesh_axis_sizes():
     analysis.verify_cached(main, fetch_names=[loss.name])
     assert analysis.VERIFY_STATS["runs"] == 3
     del main._mesh_layout
+
+
+def test_verify_cache_keyed_on_pipe_schedule_restamp():
+    """Restamping the backward op's pipe schedule family or microbatch
+    count — what the plan-time schedule search does in place, WITHOUT
+    bumping the program version — changes the per-rank collective
+    timelines, so the launch audit must re-prove them instead of
+    reusing the stale verdict."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.fc(x, 4)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    bw = next(op for op in main.global_block().ops
+              if op.type == "backward")
+    analysis.clear_verify_cache()
+    analysis.verify_cached(main, fetch_names=[loss.name])
+    analysis.verify_cached(main, fetch_names=[loss.name])
+    assert analysis.VERIFY_STATS["runs"] == 1
+    assert analysis.VERIFY_STATS["hits"] == 1
+    version = main._version
+    bw.attrs["pipe_schedule"] = "zero_bubble"
+    bw.attrs["pipe_microbatches"] = 4
+    assert main._version == version     # no version bump — the old bug
+    analysis.verify_cached(main, fetch_names=[loss.name])
+    assert analysis.VERIFY_STATS["runs"] == 2, \
+        "a restamped schedule family must not reuse the old verdict"
+    # a different microbatch count is a different key too
+    bw.attrs["pipe_microbatches"] = 8
+    analysis.verify_cached(main, fetch_names=[loss.name])
+    assert analysis.VERIFY_STATS["runs"] == 3
+    # ... and each stamping's verdict is itself cached
+    analysis.verify_cached(main, fetch_names=[loss.name])
+    assert analysis.VERIFY_STATS["runs"] == 3
 
 
 def test_prepared_run_path_verifies_and_still_trains():

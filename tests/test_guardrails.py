@@ -783,7 +783,7 @@ def test_replay_step_reproduces_bundle_anomaly(tmp_path):
 
 def test_chaos_artifact_contract():
     """The committed CHAOS_r18.json passes the same assertions the
-    preflight selftest applies — all six drills ok, seams documented,
+    preflight selftest applies — all seven drills ok, seams documented,
     recovery accounting clean."""
     from tools.chaos_probe import check
     with open(os.path.join(REPO, "CHAOS_r18.json")) as f:

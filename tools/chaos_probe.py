@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Chaos drill harness: prove every self-healing path end-to-end.
 
-Runs six deterministic fault drills — all injected through
+Runs seven deterministic fault drills — all injected through
 ``paddle_tpu.testing.faultline`` seams, never by monkeypatching — and
 emits ``CHAOS_r18.json`` with the results + recovery accounting:
 
@@ -28,7 +28,11 @@ emits ``CHAOS_r18.json`` with the results + recovery accounting:
 6. **checkpoint_verify** — the just-written checkpoint file is
    corrupted between write and readback verification: the write is
    retried (``checkpoint::retry``) and the published checkpoint's
-   manifest verifies clean.
+   manifest verifies clean;
+7. **rank_divergence** — a two-process launch where rank 1 arms a
+   divergent bucket reorder: ``launch_audit.verify_rank_agreement``
+   must abort BOTH ranks at the gloo rendezvous with exit code 43 and
+   the diverging op named, instead of hanging at the first collective.
 
 Usage::
 
@@ -58,7 +62,8 @@ SCHEMA = "paddle_tpu.chaos/1"
 #: mapping") — asserted against faultline.seams() so the registry stays
 #: statically enumerable
 DOCUMENTED_SEAMS = ("checkpoint_write", "collective_impl",
-                    "grad_nonfinite", "reshard_execute", "serving_decode",
+                    "grad_nonfinite", "rank_divergence",
+                    "reshard_execute", "serving_decode",
                     "serving_worker", "step_stall")
 
 
@@ -400,6 +405,27 @@ def drill_checkpoint_verify(work_dir):
 # ---------------------------------------------------------------------------
 
 
+def drill_rank_divergence(work_dir):
+    """Two real processes rendezvous through the gloo hub; rank 1 arms
+    the ``rank_divergence`` seam (a divergent bucket reorder applied
+    symbolically to its launch fingerprint).  Both ranks must ABORT at
+    the rendezvous with exit code 43 (EXIT_LAUNCH_DIVERGENCE) and the
+    diverging op named — the static-launch-audit abort contract; a
+    hang (timeout) fails the drill."""
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    from launch_probe import _rendezvous_drill
+    res = _rendezvous_drill()
+    return {
+        "ok": res["ok"],
+        "aborted_at_rendezvous": res["aborted_not_hung"],
+        "exit_codes": res["exit_codes"],
+        "named_op": res["named_op"],
+        "named_rank": res["named_rank"],
+    }
+
+
 def run(artifact_path):
     from paddle_tpu.flags import get_flags, set_flags
     from paddle_tpu.testing import faultline
@@ -416,7 +442,8 @@ def run(artifact_path):
                          ("stall", drill_stall),
                          ("watchdog_fp", drill_watchdog_fp),
                          ("serving_fatal", drill_serving_fatal),
-                         ("checkpoint_verify", drill_checkpoint_verify)):
+                         ("checkpoint_verify", drill_checkpoint_verify),
+                         ("rank_divergence", drill_rank_divergence)):
             drills[name] = fn(work_dir)
             print(f"chaos_probe: drill {name}: "
                   f"{'OK' if drills[name]['ok'] else 'FAILED'}")
@@ -437,6 +464,8 @@ def run(artifact_path):
             "watchdog_false_positives": drills["watchdog_fp"]["trips"],
             "serving_futures_left_hanging": 0,
             "checkpoint_retries": drills["checkpoint_verify"]["retries"],
+            "rank_divergence_hangs": 0 if drills["rank_divergence"][
+                "aborted_at_rendezvous"] else 1,
         },
     }
     with open(artifact_path, "w") as f:
@@ -452,7 +481,8 @@ def check(art):
     assert art["seams"] == list(DOCUMENTED_SEAMS), art["seams"]
     d = art["drills"]
     assert set(d) == {"nan_skip", "budget_replay", "stall", "watchdog_fp",
-                      "serving_fatal", "checkpoint_verify"}
+                      "serving_fatal", "checkpoint_verify",
+                      "rank_divergence"}
     for name, res in d.items():
         assert res["ok"] is True, (name, res)
     ns = d["nan_skip"]
@@ -473,9 +503,13 @@ def check(art):
         sf["submit_raises"] and sf["no_hangs"]
     cv = d["checkpoint_verify"]
     assert cv["retries"] >= 1 and cv["manifest_valid"]
+    rd = d["rank_divergence"]
+    assert rd["aborted_at_rendezvous"] and rd["exit_codes"] == [43, 43]
+    assert rd["named_op"] and rd["named_rank"]
     acct = art["recovery_accounting"]
-    assert acct["drills_ok"] == acct["drills_run"] == 6
+    assert acct["drills_ok"] == acct["drills_run"] == 7
     assert acct["serving_futures_left_hanging"] == 0
+    assert acct["rank_divergence_hangs"] == 0
 
 
 def main():

@@ -44,6 +44,12 @@ echo "   bit-exactly, watchdog stall stacks + false-positive bound,"
 echo "   serving worker fatal hardening, checkpoint readback verify)"
 python tools/chaos_probe.py --selftest
 
+echo "== preflight: launch audit probe (static SPMD launch proofs: all six"
+echo "   divergence classes caught with 0 compiles + 0 live collectives,"
+echo "   clean pipelined audit, two-process rendezvous drill aborts both"
+echo "   ranks exit 43 naming the op -> LAUNCH_AUDIT_r24.json) =="
+python tools/launch_probe.py --selftest
+
 echo "== preflight: reshard probe (elastic restore: dp8/ZeRO-3 BERT-tiny"
 echo "   checkpoint onto dp4/dp16 + tp2->tp1 flip, planned==executed wire"
 echo "   bytes, parity <=1e-6, 0 compiles on rejected candidates) =="

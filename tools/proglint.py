@@ -34,6 +34,14 @@ Options:
                      WILL lower to a custom kernel for TPU at the
                      program's static shapes, and why the rest fall
                      back) — analysis.kernel_routing_report, 0 compiles
+  --launch           run the static SPMD launch audit
+                     (framework/launch_audit.py audit_launch): extract
+                     the per-rank collective timelines (pipelined
+                     programs expand through the stamped schedule
+                     table), prove pairwise schedule compatibility +
+                     deadlock-freedom, and print the launch fingerprint
+                     — 0 compiles, 0 live collectives; exits non-zero
+                     on any launch-* error.  Implied by --strict.
   --audit            run the differential spec auditor's static tier
                      (framework/spec_audit.py audit_static): abstract-
                      evaluate every specced op impl and cross-check the
@@ -87,7 +95,7 @@ def load_program(path: str):
 
 def lint(program, startup=None, feed_names=(), fetch_names=(),
          strict=False, inference=False, memory=False, kernels=False,
-         audit=False, as_json=False, out=None):
+         audit=False, launch=False, as_json=False, out=None):
     out = out if out is not None else sys.stdout
     from paddle_tpu.framework.analysis import (verify_inference,
                                                verify_program)
@@ -116,6 +124,10 @@ def lint(program, startup=None, feed_names=(), fetch_names=(),
     if audit:
         from paddle_tpu.framework.spec_audit import audit_static
         audit_report = audit_static(program, fetch_names=fetch_names)
+    launch_report = None
+    if launch or strict:
+        from paddle_tpu.framework.launch_audit import audit_launch
+        launch_report = audit_launch(program)
     if as_json:
         payload = {
             "errors": len(result.errors()),
@@ -137,6 +149,8 @@ def lint(program, startup=None, feed_names=(), fetch_names=(),
             payload["kernel_routing"] = routing
         if audit_report is not None:
             payload["spec_audit"] = audit_report.as_dict()
+        if launch_report is not None:
+            payload["launch_audit"] = launch_report.as_dict()
         print(json.dumps(payload, indent=1), file=out)
     else:
         print(result.report(), file=out)
@@ -144,6 +158,8 @@ def lint(program, startup=None, feed_names=(), fetch_names=(),
             print(estimate.report(), file=out)
         if audit_report is not None:
             print(audit_report.report(), file=out)
+        if launch_report is not None:
+            print(launch_report.report(), file=out)
         if routing is not None:
             print(f"pallas kernel routing (backend={routing['backend']}, "
                   "0 compiles):", file=out)
@@ -157,6 +173,8 @@ def lint(program, startup=None, feed_names=(), fetch_names=(),
     if result.errors():
         return 1
     if audit_report is not None and not audit_report.ok:
+        return 1
+    if launch_report is not None and not launch_report.ok:
         return 1
     if strict and (result.warnings() or result.unspecced_ops):
         return 1
@@ -507,6 +525,44 @@ def selftest(memory=False) -> int:
               "gelu infer spec")
         return 1
 
+    # --launch: the static launch auditor must pass the clean training
+    # program (embedding its section in the JSON payload) and catch a
+    # seeded collective under divergent control flow with the anchored
+    # launch-deadlock-cycle — all with 0 compiles
+    from paddle_tpu.framework.analysis import LAUNCH_DEADLOCK_CYCLE
+    sink = _io.StringIO()
+    rc = lint(main, fetch_names=[total.name], launch=True,
+              as_json=True, out=sink)
+    payload = json.loads(sink.getvalue())
+    if rc or not payload.get("launch_audit", {}).get("ok"):
+        print("proglint selftest: --launch failed on the clean training "
+              "program")
+        return 1
+    lp = Program()
+    lb = lp.global_block()
+    lb.create_var(name="lx", shape=(8,), is_data=True)
+    lb.create_var(name="lcond", shape=(1,), dtype="bool", is_data=True)
+    lb.create_var(name="lout", shape=(8,))
+    lsub = lp._create_block()
+    lsub.append_op(type="c_allreduce_sum", inputs={"X": ["lx"]},
+                   outputs={"Out": ["lx"]}, attrs={"ring_id": 0})
+    lp._rollback()
+    lb.append_op(type="conditional_block",
+                 inputs={"Cond": ["lcond"], "Closure": ["lx"]},
+                 outputs={"Out": ["lout"]},
+                 attrs={"true_block": lsub, "false_block": lsub,
+                        "closure_names": ["lx"],
+                        "true_out_names": ["lx"],
+                        "false_out_names": ["lx"]})
+    sink = _io.StringIO()
+    rc = lint(lp, launch=True, as_json=True, out=sink)
+    lcodes = {d["code"] for d in json.loads(sink.getvalue())
+              .get("launch_audit", {}).get("diagnostics", [])}
+    if rc == 0 or LAUNCH_DEADLOCK_CYCLE not in lcodes:
+        print("proglint selftest: --launch did not prove the hang of a "
+              "collective under divergent control flow")
+        return 1
+
     if memory:
         from paddle_tpu.framework.errors import InvalidArgumentError
         from paddle_tpu.framework.memory_analysis import (analyze_memory,
@@ -554,6 +610,7 @@ def main(argv=None) -> int:
     ap.add_argument("--memory", action="store_true")
     ap.add_argument("--kernels", action="store_true")
     ap.add_argument("--audit", action="store_true")
+    ap.add_argument("--launch", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--strict", action="store_true")
     ap.add_argument("--selftest", action="store_true")
@@ -569,7 +626,7 @@ def main(argv=None) -> int:
                 fetch_names=args.fetch, strict=args.strict,
                 inference=args.inference, memory=args.memory,
                 kernels=args.kernels, audit=args.audit,
-                as_json=args.as_json)
+                launch=args.launch, as_json=args.as_json)
 
 
 if __name__ == "__main__":
